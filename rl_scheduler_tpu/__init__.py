@@ -1,0 +1,21 @@
+"""rl_scheduler_tpu — TPU-native RL framework for multi-cloud Kubernetes scheduling.
+
+A ground-up JAX/XLA re-design of the capabilities of
+``saikumar955078/rl-k8s-scheduler`` (see SURVEY.md): the reference's CSV-replay
+cluster simulator becomes a pure-functional, vmappable environment; its Ray
+RLlib PPO (plus a DQN variant) become fused jit-compiled rollout+update loops;
+its empty scheduler-extender stub becomes a real serving path.
+
+Layout
+------
+- ``data/``      — synthetic trace generation, normalization, device loaders
+- ``env/``       — functional env core, vectorized env, Gymnasium adapter
+- ``models/``    — policy zoo: MLP, permutation-invariant transformer, GNN
+- ``ops/``       — GAE, losses, returns (lax.scan / pallas)
+- ``agent/``     — PPO / DQN trainers, presets, evaluation
+- ``parallel/``  — mesh construction, shard_map data/tensor parallel layers
+- ``scheduler/`` — k8s scheduler-extender server + backends
+- ``utils/``     — checkpointing (orbax), metrics, profiling
+"""
+
+__version__ = "0.1.0"

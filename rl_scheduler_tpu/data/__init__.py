@@ -1,0 +1,22 @@
+"""Data pipeline: synthetic trace generation, normalization, device loading."""
+
+from rl_scheduler_tpu.data.generate import generate_prices, generate_latencies, generate_all
+from rl_scheduler_tpu.data.normalize import normalize, build_normalized_table
+from rl_scheduler_tpu.data.loader import (
+    CloudTable,
+    load_table,
+    default_data_dir,
+    load_single_cluster_trace,
+)
+
+__all__ = [
+    "generate_prices",
+    "generate_latencies",
+    "generate_all",
+    "normalize",
+    "build_normalized_table",
+    "CloudTable",
+    "load_table",
+    "default_data_dir",
+    "load_single_cluster_trace",
+]

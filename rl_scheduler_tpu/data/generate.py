@@ -1,0 +1,112 @@
+"""Synthetic multi-cloud price/latency trace generation.
+
+Capability parity with the reference data generator
+(``generate_real_pricing.py:1-18`` in the reference repo): 100 steps of
+per-cloud cost drawn uniformly around public on-demand anchors (AWS t3.micro
+$0.0104/hr, Azure B2s $0.0208/hr) and latency around 70ms/60ms. With the
+default seed (42) and NumPy's global-RNG draw order, the output reproduces the
+reference's shipped ``data/real_prices.csv`` / ``data/real_latencies.csv``
+bit-for-bit, which the golden-value tests rely on.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+# Public on-demand pricing anchors (USD/hr) and latency anchors (ms).
+AWS_COST_BASE = 0.0104     # AWS t3.micro
+AZURE_COST_BASE = 0.0208   # Azure B2s
+COST_JITTER = 0.001
+AWS_LATENCY_BASE = 70.0
+AZURE_LATENCY_BASE = 60.0
+LATENCY_JITTER = 10.0
+DEFAULT_STEPS = 100
+DEFAULT_SEED = 42
+
+
+def generate_prices(steps: int = DEFAULT_STEPS, rng: np.random.RandomState | None = None) -> pd.DataFrame:
+    """Generate per-step cost traces for both clouds.
+
+    Draw order matters for bit-parity with the reference: cost_aws first,
+    then cost_azure, each as one vectorized uniform draw.
+    """
+    rng = rng or np.random.RandomState(DEFAULT_SEED)
+    return pd.DataFrame(
+        {
+            "step": range(steps),
+            "cost_aws": AWS_COST_BASE + rng.uniform(-COST_JITTER, COST_JITTER, steps),
+            "cost_azure": AZURE_COST_BASE + rng.uniform(-COST_JITTER, COST_JITTER, steps),
+        }
+    )
+
+
+def generate_latencies(prices: pd.DataFrame, rng: np.random.RandomState) -> pd.DataFrame:
+    """Append latency columns to a price frame (same draw order as reference)."""
+    steps = len(prices)
+    df = prices.copy()
+    df["latency_aws"] = AWS_LATENCY_BASE + rng.uniform(-LATENCY_JITTER, LATENCY_JITTER, steps)
+    df["latency_azure"] = AZURE_LATENCY_BASE + rng.uniform(-LATENCY_JITTER, LATENCY_JITTER, steps)
+    return df
+
+
+def generate_all(
+    out_dir: str | Path,
+    steps: int = DEFAULT_STEPS,
+    seed: int = DEFAULT_SEED,
+) -> pd.DataFrame:
+    """Generate and write ``real_prices.csv`` and ``real_latencies.csv``.
+
+    Returns the combined frame (step, cost_aws, cost_azure, latency_aws,
+    latency_azure).
+    """
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    rng = np.random.RandomState(seed)
+    prices = generate_prices(steps, rng)
+    prices.to_csv(out_dir / "real_prices.csv", index=False)
+    full = generate_latencies(prices, rng)
+    full.to_csv(out_dir / "real_latencies.csv", index=False)
+    return full
+
+
+def generate_load_history(
+    out_path: str | Path,
+    steps: int = 297,
+    max_users: int = 50,
+    seed: int = DEFAULT_SEED,
+) -> pd.DataFrame:
+    """Synthesize a Locust-style load-test history export.
+
+    Capability parity with the reference's load-generator artifacts
+    (``locustfile.py`` + ``data/local_*_load_stats_history.csv``): a user ramp
+    to ``max_users``, per-user request rate ~0.5 req/s (1-3s wait between
+    GETs), and response times that grow with load. Deterministic given seed.
+    """
+    rng = np.random.RandomState(seed)
+    t = np.arange(steps)
+    users = np.minimum(max_users, (t // 3) * 5).astype(np.int64)
+    rps = users * rng.uniform(0.4, 0.6, steps)
+    base_rt = 3.0 + 0.05 * users
+    avg_rt = base_rt + rng.exponential(2.0, steps)
+    df = pd.DataFrame(
+        {
+            "Timestamp": 1_765_110_856 + t,
+            "User Count": users,
+            "Requests/s": rps,
+            "Total Average Response Time": avg_rt,
+        }
+    )
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    df.to_csv(out_path, index=False)
+    return df
+
+
+if __name__ == "__main__":
+    from rl_scheduler_tpu.data.loader import default_data_dir
+
+    df = generate_all(default_data_dir())
+    print(f"Generated {len(df)} steps of price/latency data in {default_data_dir()}")

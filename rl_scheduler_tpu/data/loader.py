@@ -1,0 +1,122 @@
+"""Device loaders: normalized CSV table -> jnp arrays for the functional env.
+
+Replaces the reference's per-env ``pd.read_csv`` + per-step ``.iloc`` row
+access (``rl_scheduler/env/k8s_multi_cloud_env.py:54-66,118`` in the
+reference) with a single host-side load into device arrays; the env core then
+does O(1) gathers inside jit.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+TABLE_COLUMNS = ["cost_aws", "cost_azure", "latency_aws", "latency_azure"]
+
+
+def default_data_dir() -> Path:
+    """<repo root>/data, resolved relative to this file."""
+    return Path(__file__).resolve().parents[2] / "data"
+
+
+class CloudTable(NamedTuple):
+    """Normalized multi-cloud trace as device arrays.
+
+    ``costs``/``latencies``/``cpu`` are ``[T, C]`` float32 in [0, 1], where
+    ``C`` is the number of clouds (2: AWS, Azure).
+    """
+
+    costs: jnp.ndarray
+    latencies: jnp.ndarray
+    cpu: jnp.ndarray
+
+    @property
+    def num_steps(self) -> int:
+        return self.costs.shape[0]
+
+    @property
+    def num_clouds(self) -> int:
+        return self.costs.shape[1]
+
+
+def _validate(df: pd.DataFrame) -> None:
+    missing = [c for c in TABLE_COLUMNS if c not in df.columns]
+    if missing:
+        raise ValueError(f"normalized table missing columns: {missing}")
+    sub = df[TABLE_COLUMNS]
+    if sub.isna().any().any():
+        raise ValueError("normalized table contains NaNs in cost/latency columns")
+    if len(sub) < 2:
+        raise ValueError("normalized table needs at least 2 rows (episode length >= 1)")
+    lo, hi = float(sub.min().min()), float(sub.max().max())
+    if lo < -1e-6 or hi > 1.0 + 1e-6:
+        raise ValueError(f"normalized table out of [0,1] range: [{lo}, {hi}]")
+
+
+def ensure_dataset(data_dir: str | Path | None = None) -> Path:
+    """Regenerate the full dataset from scratch if the processed CSV is absent.
+
+    The pipeline is fully deterministic (seeded), so a fresh checkout
+    bootstraps itself to the exact table the tests and benchmarks expect.
+    """
+    from rl_scheduler_tpu.data.generate import generate_all
+    from rl_scheduler_tpu.data.normalize import build_normalized_table
+
+    data_dir = Path(data_dir) if data_dir is not None else default_data_dir()
+    processed = data_dir / "processed" / "normalized_rl_data.csv"
+    if not processed.exists():
+        if not (data_dir / "real_latencies.csv").exists():
+            generate_all(data_dir)
+        build_normalized_table(data_dir)
+    return processed
+
+
+def load_table(path: str | Path | None = None) -> CloudTable:
+    """Load the normalized table as a :class:`CloudTable` of device arrays."""
+    if path is None:
+        path = ensure_dataset()
+    df = pd.read_csv(path)
+    _validate(df)
+    costs = df[["cost_aws", "cost_azure"]].to_numpy(np.float32)
+    lats = df[["latency_aws", "latency_azure"]].to_numpy(np.float32)
+    if {"cpu_aws", "cpu_azure"}.issubset(df.columns):
+        cpu = df[["cpu_aws", "cpu_azure"]].fillna(0.0).to_numpy(np.float32)
+    else:
+        cpu = np.zeros_like(costs)
+    return CloudTable(jnp.asarray(costs), jnp.asarray(lats), jnp.asarray(cpu))
+
+
+def load_single_cluster_trace(path: str | Path | None = None) -> jnp.ndarray:
+    """Load a Locust-style load-history export as a ``[T, 3]`` feature trace.
+
+    Features (each MinMax-normalized to [0,1]): user count, requests/sec,
+    average response time. Drives the single-cluster env (BASELINE config 1).
+    Synthesizes a deterministic load ramp if no export exists.
+    """
+    if path is None:
+        path = default_data_dir() / "local_aws_load_stats_history.csv"
+    path = Path(path)
+    if not path.exists():
+        from rl_scheduler_tpu.data.generate import generate_load_history
+
+        generate_load_history(path)
+    df = pd.read_csv(path)
+    cols = {}
+    for name, candidates in {
+        "users": ["User Count", "users"],
+        "rps": ["Requests/s", "rps"],
+        "rt": ["Total Average Response Time", "Average Response Time", "avg_response_time"],
+    }.items():
+        col = next((c for c in candidates if c in df.columns), None)
+        if col is None:
+            raise ValueError(f"load history missing any of {candidates}")
+        cols[name] = pd.to_numeric(df[col], errors="coerce").fillna(0.0).to_numpy(np.float32)
+    feats = np.stack([cols["users"], cols["rps"], cols["rt"]], axis=1)
+    lo = feats.min(axis=0, keepdims=True)
+    hi = feats.max(axis=0, keepdims=True)
+    span = np.where(hi - lo == 0, 1.0, hi - lo)
+    return jnp.asarray((feats - lo) / span, dtype=jnp.float32)

@@ -1,0 +1,43 @@
+"""Test configuration: force a virtual 8-device CPU platform.
+
+Must set XLA flags before jax is imported anywhere; pytest imports conftest
+first, so this is the single place that configures the test platform.
+Multi-device sharding tests rely on the 8 virtual CPU devices.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Keep compilation deterministic and quiet in CI.
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def reference_table():
+    """The deterministic normalized table (regenerated, not read from disk)."""
+    from rl_scheduler_tpu.data.generate import generate_all
+    from rl_scheduler_tpu.data.normalize import normalize
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        raw = generate_all(d)
+    return normalize(raw)
+
+
+@pytest.fixture(scope="session")
+def cloud_table():
+    from rl_scheduler_tpu.data.loader import load_table
+
+    return load_table()
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.RandomState(0)

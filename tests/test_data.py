@@ -1,0 +1,110 @@
+"""Data pipeline tests: determinism, golden values, validation, loaders."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from rl_scheduler_tpu.data.generate import (
+    AWS_COST_BASE,
+    AZURE_COST_BASE,
+    generate_all,
+    generate_load_history,
+)
+from rl_scheduler_tpu.data.loader import (
+    default_data_dir,
+    ensure_dataset,
+    load_single_cluster_trace,
+    load_table,
+)
+from rl_scheduler_tpu.data.normalize import normalize
+
+
+def test_generate_deterministic(tmp_path):
+    a = generate_all(tmp_path / "a")
+    b = generate_all(tmp_path / "b")
+    pd.testing.assert_frame_equal(a, b)
+
+
+def test_generate_anchors(tmp_path):
+    df = generate_all(tmp_path)
+    assert len(df) == 100
+    assert np.allclose(df["cost_aws"].mean(), AWS_COST_BASE, atol=2e-4)
+    assert np.allclose(df["cost_azure"].mean(), AZURE_COST_BASE, atol=2e-4)
+    assert (df["cost_aws"] - AWS_COST_BASE).abs().max() <= 0.001
+    assert df["latency_aws"].between(60, 80).all()
+    assert df["latency_azure"].between(50, 70).all()
+
+
+def test_normalize_range_and_no_nan(reference_table):
+    t = reference_table
+    assert len(t) == 100
+    cols = ["cost_aws", "cost_azure", "latency_aws", "latency_azure", "cpu_aws", "cpu_azure"]
+    assert not t[cols].isna().any().any()
+    assert (t[cols].min() >= -1e-9).all()
+    assert (t[cols].max() <= 1 + 1e-9).all()
+    # cost/latency columns hit both ends of the MinMax range
+    for c in cols[:4]:
+        assert t[c].min() == pytest.approx(0.0, abs=1e-12)
+        assert t[c].max() == pytest.approx(1.0, abs=1e-12)
+
+
+def test_normalize_golden_row0(reference_table):
+    """Golden values: row 0 of the normalized table must match the
+    reference's shipped data/processed/normalized_rl_data.csv."""
+    row = reference_table.iloc[0]
+    assert row["cost_aws"] == pytest.approx(0.37602530109083077, rel=1e-9)
+    assert row["cost_azure"] == pytest.approx(0.025009805949220976, rel=1e-9)
+    assert row["latency_aws"] == pytest.approx(0.6466751913980993, rel=1e-9)
+    assert row["latency_azure"] == pytest.approx(0.03820078616014877, rel=1e-9)
+
+
+def test_legacy_nan_cpu_mode(tmp_path):
+    raw = generate_all(tmp_path)
+    legacy = normalize(raw, legacy_nan_cpu=True)
+    assert legacy["cpu_aws"].isna().sum() == 99  # reference bug reproduced
+    fixed = normalize(raw, legacy_nan_cpu=False)
+    assert fixed["cpu_aws"].isna().sum() == 0
+
+
+def test_ensure_dataset_bootstraps(tmp_path):
+    processed = ensure_dataset(tmp_path)
+    assert processed.exists()
+    df = pd.read_csv(processed)
+    assert len(df) == 100
+
+
+def test_load_table_shapes():
+    table = load_table()
+    assert table.costs.shape == (100, 2)
+    assert table.latencies.shape == (100, 2)
+    assert table.num_steps == 100
+    assert table.num_clouds == 2
+    assert table.costs.dtype.name == "float32"
+
+
+def test_load_table_rejects_nan(tmp_path):
+    bad = pd.DataFrame(
+        {
+            "cost_aws": [0.1, np.nan],
+            "cost_azure": [0.2, 0.3],
+            "latency_aws": [0.1, 0.2],
+            "latency_azure": [0.1, 0.2],
+        }
+    )
+    p = tmp_path / "bad.csv"
+    bad.to_csv(p, index=False)
+    with pytest.raises(ValueError, match="NaN"):
+        load_table(p)
+
+
+def test_single_cluster_trace(tmp_path):
+    p = tmp_path / "history.csv"
+    generate_load_history(p)
+    trace = load_single_cluster_trace(p)
+    assert trace.shape == (297, 3)
+    assert float(trace.min()) >= 0.0 and float(trace.max()) <= 1.0
+
+
+def test_default_data_dir_in_repo():
+    assert default_data_dir().name == "data"
+    assert default_data_dir().parent.name == "repo"
