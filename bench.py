@@ -1,0 +1,58 @@
+"""Headline benchmark: env-steps/sec/chip at 4096 parallel simulated clusters.
+
+Runs the fused PPO train step (rollout + GAE + minibatch SGD in one XLA
+program) on 4096 vmapped envs and reports sustained env-steps/sec on one
+chip. Baseline: the reference's Ray RLlib pipeline sustains ~60 env-steps/s
+on its documented hardware (SURVEY.md §6: 640k steps in ~3h).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_STEPS_PER_SEC = 60.0
+
+
+def main() -> None:
+    import jax
+
+    from rl_scheduler_tpu.agent.ppo import make_ppo
+    from rl_scheduler_tpu.agent.presets import PPO_PRESETS
+    from rl_scheduler_tpu.config import EnvConfig
+    from rl_scheduler_tpu.env import core as env_core
+
+    cfg = PPO_PRESETS["tpu4096"]
+    env_params = env_core.make_params(EnvConfig())
+    init_fn, update_fn, _ = make_ppo(env_params, cfg)
+    runner = jax.jit(init_fn)(jax.random.PRNGKey(0))
+    update = jax.jit(update_fn, donate_argnums=0)
+
+    # Warmup: compile + one full update.
+    runner, metrics = update(runner)
+    jax.block_until_ready(metrics)
+
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        runner, metrics = update(runner)
+    jax.block_until_ready(metrics)
+    elapsed = time.perf_counter() - t0
+
+    steps_per_sec = cfg.batch_size * iters / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "env-steps/sec/chip (4096 parallel clusters, fused PPO update)",
+                "value": round(steps_per_sec, 1),
+                "unit": "env-steps/sec/chip",
+                "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

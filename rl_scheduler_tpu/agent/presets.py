@@ -1,0 +1,64 @@
+"""Named hyperparameter presets mirroring the reference training scripts.
+
+- ``quick``: the reference's ``train_ppo.py:14-19`` / ``train_and_compare.py``
+  set — train batch 4000, minibatch 256, 10 SGD epochs, lr 3e-4, γ 0.99.
+- ``final``: the reference's ``train_final.py:11-17`` Tune run — batch 8000,
+  minibatch 512, 15 epochs, lr 5e-4, γ 0.995 (24 parallel envs there; the
+  env-batch axis replaces Ray workers here).
+- ``tpu4096`` / ``tpu8192``: the BASELINE.json scale configs — thousands of
+  vmapped envs on TPU; batch sizes scale with the env count so each update
+  still sees ~2 episodes per env.
+"""
+
+from __future__ import annotations
+
+from rl_scheduler_tpu.agent.ppo import PPOTrainConfig
+
+PPO_PRESETS: dict[str, PPOTrainConfig] = {
+    # 40 envs x 100 steps = 4000 = reference train_batch_size (train_ppo.py)
+    "quick": PPOTrainConfig(
+        num_envs=40,
+        rollout_steps=100,
+        minibatch_size=256,
+        num_epochs=10,
+        lr=3e-4,
+        gamma=0.99,
+    ),
+    # 80 envs x 100 steps = 8000 = reference train_batch_size (train_final.py)
+    "final": PPOTrainConfig(
+        num_envs=80,
+        rollout_steps=100,
+        minibatch_size=512,
+        num_epochs=15,
+        lr=5e-4,
+        gamma=0.995,
+    ),
+    # BASELINE config 2: 64 vmapped envs on one TPU core
+    "tpu64": PPOTrainConfig(
+        num_envs=64,
+        rollout_steps=100,
+        minibatch_size=512,
+        num_epochs=10,
+        lr=3e-4,
+        gamma=0.99,
+    ),
+    # BASELINE config 3: 4096 vmapped envs (pmap/shard_map data-parallel on
+    # a v4-8). Large batch -> larger minibatch + fewer epochs + higher lr.
+    "tpu4096": PPOTrainConfig(
+        num_envs=4096,
+        rollout_steps=100,
+        minibatch_size=32768,
+        num_epochs=6,
+        lr=1e-3,
+        gamma=0.99,
+    ),
+    # BASELINE config 5 scale: 8192 envs.
+    "tpu8192": PPOTrainConfig(
+        num_envs=8192,
+        rollout_steps=100,
+        minibatch_size=65536,
+        num_epochs=6,
+        lr=1e-3,
+        gamma=0.99,
+    ),
+}

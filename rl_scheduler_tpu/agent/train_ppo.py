@@ -1,0 +1,85 @@
+"""PPO training entry point (reference ``train_ppo.py`` / ``train_final.py``).
+
+Usage::
+
+    python -m rl_scheduler_tpu.agent.train_ppo --preset quick --iterations 5
+    python -m rl_scheduler_tpu.agent.train_ppo --preset final --iterations 80 \
+        --run-name FINAL_PPO_AWS_AZURE
+
+Prints per-iteration ``episode_reward_mean`` like the reference, checkpoints
+periodically (keep-N + at-end, reference ``train_final.py:27-31``), and
+writes metrics to a JSONL file in the run directory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from pathlib import Path
+
+import jax
+
+from rl_scheduler_tpu.agent.ppo import ppo_train
+from rl_scheduler_tpu.agent.presets import PPO_PRESETS
+from rl_scheduler_tpu.config import EnvConfig, RuntimeConfig
+from rl_scheduler_tpu.env import core as env_core
+
+
+def main(argv: list[str] | None = None) -> Path:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--preset", default="quick", choices=sorted(PPO_PRESETS))
+    p.add_argument("--iterations", type=int, default=5)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--run-name", default=None)
+    p.add_argument("--run-root", default=RuntimeConfig().checkpoint_dir)
+    p.add_argument("--checkpoint-every", type=int, default=10)
+    p.add_argument("--keep", type=int, default=5)
+    p.add_argument("--legacy-reward-sign", action="store_true",
+                   help="reproduce the reference's positive reward (SURVEY.md §7.0.1)")
+    args = p.parse_args(argv)
+
+    cfg = PPO_PRESETS[args.preset]
+    env_params = env_core.make_params(EnvConfig(legacy_reward_sign=args.legacy_reward_sign))
+
+    run_name = args.run_name or f"PPO_{args.preset}_{time.strftime('%Y%m%d_%H%M%S')}"
+    run_dir = Path(args.run_root) / run_name
+    run_dir.mkdir(parents=True, exist_ok=True)
+    metrics_file = (run_dir / "metrics.jsonl").open("a")
+
+    from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+    ckpt = CheckpointManager(run_dir, keep=args.keep)
+
+    t_start = time.time()
+    steps_per_iter = cfg.batch_size
+
+    def log_fn(i: int, metrics: dict) -> None:
+        elapsed = time.time() - t_start
+        sps = steps_per_iter * (i + 1) / elapsed
+        line = {"iteration": i + 1, "env_steps_per_sec": round(sps, 1), **metrics}
+        metrics_file.write(json.dumps(line) + "\n")
+        metrics_file.flush()
+        print(
+            f"Iteration {i + 1}: reward_mean={metrics['episode_reward_mean']:.2f} "
+            f"| {sps:,.0f} env-steps/s",
+            flush=True,
+        )
+
+    def checkpoint_fn(i: int, runner) -> None:
+        if (i + 1) % args.checkpoint_every == 0 or (i + 1) == args.iterations:
+            ckpt.save(i + 1, {"params": runner.params, "opt_state": runner.opt_state},
+                      extras={"preset": args.preset,
+                              "legacy_reward_sign": args.legacy_reward_sign})
+
+    print(f"Training PPO preset={args.preset} on {jax.devices()[0].platform} "
+          f"({cfg.num_envs} envs x {cfg.rollout_steps} steps/iter)")
+    ppo_train(env_params, cfg, args.iterations, seed=args.seed,
+              log_fn=log_fn, checkpoint_fn=checkpoint_fn)
+    metrics_file.close()
+    print(f"Training finished! Checkpoints in {run_dir}")
+    return run_dir
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Configuration layer: every constant the reference hardcodes, made explicit.
+
+The reference scatters its knobs across files (reward weights and scale inline
+at ``k8s_multi_cloud_env.py:122``, data path at ``:22-27``, baseline cost at
+``final_evaluation.py:73``, run hyperparameters in each training script) and
+accepts-but-ignores ``env_config`` (``:46``). Here a single dataclass layer
+owns them; training presets live in ``agent/presets.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvConfig:
+    """Multi-cloud simulator configuration.
+
+    ``legacy_reward_sign`` reproduces the reference's reward exactly
+    (``+scale*(w_c*cost + w_l*latency)`` — a *positive* function of
+    normalized cost/latency, contradicting its own "negative weighted sum"
+    docstring, see SURVEY.md §7.0.1). The corrected default negates it so
+    reward-maximization prefers the cheaper/faster cloud.
+    """
+
+    data_path: str | None = None
+    cost_weight: float = 0.6
+    latency_weight: float = 0.4
+    reward_scale: float = 100.0
+    legacy_reward_sign: bool = False
+    cpu_low: float = 0.1
+    cpu_high: float = 0.8
+    max_steps: int | None = None  # default: table rows - 1 (99)
+
+    # Fault injection (SURVEY.md §5.3): probability per step that a cloud is
+    # unavailable; drawn from the Locust failure data's spirit, off by default.
+    fault_prob: float = 0.0
+    fault_latency_penalty: float = 1.0  # normalized latency when faulted
+
+
+@dataclasses.dataclass(frozen=True)
+class SingleClusterConfig:
+    """Single-cluster autoscaling simulator (BASELINE config 1)."""
+
+    trace_path: str | None = None
+    max_replicas: int = 10
+    replica_cost_weight: float = 0.3
+    latency_weight: float = 0.7
+    max_steps: int | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class RuntimeConfig:
+    """Execution backend selection (BASELINE.json: --backend=jax with CPU
+    fallback)."""
+
+    backend: str = "jax"  # "jax" | "cpu"  ("cpu" = numpy fallback path)
+    num_envs: int = 4096
+    checkpoint_dir: str = str(Path.home() / "rl_scheduler_tpu_runs")
+
+
+DEFAULT_ENV_CONFIG = EnvConfig()
+LEGACY_ENV_CONFIG = EnvConfig(legacy_reward_sign=True)

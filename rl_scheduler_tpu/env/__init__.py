@@ -1,0 +1,39 @@
+"""Environments: functional core, vectorized stepping, Gymnasium adapter."""
+
+from rl_scheduler_tpu.env.core import (
+    EnvParams,
+    EnvState,
+    TimeStep,
+    OBS_DIM,
+    NUM_ACTIONS,
+    make_params,
+    reset,
+    step,
+)
+from rl_scheduler_tpu.env.vector import (
+    reset_batch,
+    step_autoreset,
+    step_autoreset_batch,
+)
+from rl_scheduler_tpu.env.baselines import (
+    cost_greedy_policy,
+    round_robin_policy,
+    random_policy,
+)
+
+__all__ = [
+    "EnvParams",
+    "EnvState",
+    "TimeStep",
+    "OBS_DIM",
+    "NUM_ACTIONS",
+    "make_params",
+    "reset",
+    "step",
+    "reset_batch",
+    "step_autoreset",
+    "step_autoreset_batch",
+    "cost_greedy_policy",
+    "round_robin_policy",
+    "random_policy",
+]

@@ -1,0 +1,30 @@
+"""Baseline scheduling policies (reference parity).
+
+- Cost-greedy: pick the cloud with the lower observed cost — the reference's
+  ``normal_scheduler_step`` (``k8s_multi_cloud_env.py:156-157``).
+- Round-robin: alternate clouds by step parity — the inline baseline in the
+  reference's comparison harness (``train_and_compare.py:63-69``).
+- Random: uniform action (the reference env's ``__main__`` smoke test).
+
+All are jit/vmap-friendly: arrays in, arrays out.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cost_greedy_policy(obs: jnp.ndarray) -> jnp.ndarray:
+    """0 (AWS) if obs cost_aws <= cost_azure else 1 (Azure). Works on [6] or
+    [N, 6]."""
+    return jnp.where(obs[..., 0] <= obs[..., 1], 0, 1).astype(jnp.int32)
+
+
+def round_robin_policy(step_idx: jnp.ndarray) -> jnp.ndarray:
+    """AWS on even steps, Azure on odd (reference parity)."""
+    return (step_idx % 2).astype(jnp.int32)
+
+
+def random_policy(key: jnp.ndarray, shape: tuple = ()) -> jnp.ndarray:
+    return jax.random.randint(key, shape, 0, 2, jnp.int32)

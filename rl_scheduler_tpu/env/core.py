@@ -1,0 +1,158 @@
+"""Pure-functional multi-cloud scheduling environment.
+
+TPU-first re-design of the reference simulator
+(``rl_scheduler/env/k8s_multi_cloud_env.py:36-157``): the mutable Gymnasium
+class holding a pandas DataFrame becomes a pair of pure functions over
+explicit state, so ``jax.vmap`` steps thousands of simulated clusters in one
+fused XLA program and ``lax.scan`` fuses whole rollouts into the training
+step. The per-step ``DataFrame.iloc`` row access becomes an O(1) device
+gather; the process-global ``random.seed`` in ``reset`` (reference ``:109-111``,
+racy and irreproducible across parallel envs) becomes a per-env
+``jax.random`` key threaded through the state pytree.
+
+Semantics parity (golden-tested against the reference formulas):
+- observation: ``[cost_aws, cost_azure, lat_aws, lat_azure, cpu_aws,
+  cpu_azure]`` — table row at the current step plus two uniform(0.1, 0.8)
+  CPU draws (the reference's ``_get_live_cpu``, ``:84-88``, is random noise in
+  all modes).
+- action: 0 = AWS, 1 = Azure.
+- reward: ``sign * scale * (w_c*cost_chosen + w_l*lat_chosen)``; the
+  reference uses sign=+1 (its documented intent is -1, SURVEY.md §7.0.1);
+  both are supported via config.
+- episode: done when step reaches ``max_steps = T - 1`` (reference ``:66,140``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from rl_scheduler_tpu.config import EnvConfig
+from rl_scheduler_tpu.data.loader import CloudTable, load_table
+
+OBS_DIM = 6
+NUM_ACTIONS = 2
+
+
+class EnvParams(NamedTuple):
+    """Static environment parameters (shared across all vmapped envs)."""
+
+    costs: jnp.ndarray       # [T, C] normalized cost per cloud
+    latencies: jnp.ndarray   # [T, C] normalized latency per cloud
+    cost_weight: jnp.ndarray
+    latency_weight: jnp.ndarray
+    reward_scale: jnp.ndarray
+    reward_sign: jnp.ndarray  # +1 legacy (reference parity), -1 corrected
+    cpu_low: jnp.ndarray
+    cpu_high: jnp.ndarray
+    max_steps: jnp.ndarray    # scalar int32, == T - 1 by default
+    fault_prob: jnp.ndarray
+    fault_latency_penalty: jnp.ndarray
+
+    @property
+    def num_table_steps(self) -> int:
+        return self.costs.shape[0]
+
+
+class EnvState(NamedTuple):
+    """Per-env mutable state: a step index and an RNG key."""
+
+    step_idx: jnp.ndarray  # scalar int32 in [0, max_steps]
+    key: jnp.ndarray       # jax PRNG key
+
+
+class TimeStep(NamedTuple):
+    """Result of one env transition (arrays, vmap-friendly)."""
+
+    obs: jnp.ndarray      # [OBS_DIM]
+    reward: jnp.ndarray   # scalar f32
+    done: jnp.ndarray     # scalar bool
+    chosen_cloud: jnp.ndarray  # scalar int32 (the action taken)
+    step: jnp.ndarray     # scalar int32 (post-increment, reference info["step"])
+
+
+def make_params(
+    config: EnvConfig | None = None,
+    table: CloudTable | None = None,
+) -> EnvParams:
+    """Build :class:`EnvParams` from a config and a (possibly custom) table."""
+    config = config or EnvConfig()
+    if table is None:
+        table = load_table(config.data_path)
+    t = table.costs.shape[0]
+    max_steps = config.max_steps if config.max_steps is not None else t - 1
+    if not 0 < max_steps <= t - 1:
+        raise ValueError(f"max_steps must be in (0, {t - 1}], got {max_steps}")
+    f32 = lambda x: jnp.asarray(x, jnp.float32)
+    return EnvParams(
+        costs=table.costs,
+        latencies=table.latencies,
+        cost_weight=f32(config.cost_weight),
+        latency_weight=f32(config.latency_weight),
+        reward_scale=f32(config.reward_scale),
+        reward_sign=f32(1.0 if config.legacy_reward_sign else -1.0),
+        cpu_low=f32(config.cpu_low),
+        cpu_high=f32(config.cpu_high),
+        max_steps=jnp.asarray(max_steps, jnp.int32),
+        fault_prob=f32(config.fault_prob),
+        fault_latency_penalty=f32(config.fault_latency_penalty),
+    )
+
+
+def _observe(params: EnvParams, step_idx: jnp.ndarray, key: jnp.ndarray) -> jnp.ndarray:
+    """Observation at ``step_idx``: table row gather + fresh CPU noise."""
+    row_costs = jax.lax.dynamic_index_in_dim(params.costs, step_idx, keepdims=False)
+    row_lats = jax.lax.dynamic_index_in_dim(params.latencies, step_idx, keepdims=False)
+    cpu = jax.random.uniform(
+        key, (2,), jnp.float32, minval=params.cpu_low, maxval=params.cpu_high
+    )
+    return jnp.concatenate([row_costs, row_lats, cpu]).astype(jnp.float32)
+
+
+def reset(params: EnvParams, key: jnp.ndarray) -> tuple[EnvState, jnp.ndarray]:
+    """Start a new episode at table row 0."""
+    carry_key, obs_key = jax.random.split(key)
+    step_idx = jnp.zeros((), jnp.int32)
+    state = EnvState(step_idx=step_idx, key=carry_key)
+    return state, _observe(params, step_idx, obs_key)
+
+
+def step(
+    params: EnvParams, state: EnvState, action: jnp.ndarray
+) -> tuple[EnvState, TimeStep]:
+    """One transition. Pure; jit/vmap/scan-safe.
+
+    Reward is computed from the row the agent *observed* (the pre-increment
+    index), exactly like the reference (``k8s_multi_cloud_env.py:118-122``).
+    """
+    action = jnp.asarray(action, jnp.int32)
+    carry_key, obs_key, fault_key = jax.random.split(state.key, 3)
+
+    row_costs = jax.lax.dynamic_index_in_dim(params.costs, state.step_idx, keepdims=False)
+    row_lats = jax.lax.dynamic_index_in_dim(params.latencies, state.step_idx, keepdims=False)
+    cost = row_costs[action]
+    latency = row_lats[action]
+
+    # Optional fault injection: with prob fault_prob the chosen cloud is
+    # unavailable this step and serves at the penalty latency.
+    faulted = jax.random.bernoulli(fault_key, params.fault_prob)
+    latency = jnp.where(faulted, params.fault_latency_penalty, latency)
+
+    reward = params.reward_sign * params.reward_scale * (
+        params.cost_weight * cost + params.latency_weight * latency
+    )
+
+    new_step = state.step_idx + 1
+    done = new_step >= params.max_steps
+    new_state = EnvState(step_idx=new_step, key=carry_key)
+    obs = _observe(params, new_step, obs_key)
+    ts = TimeStep(
+        obs=obs,
+        reward=reward.astype(jnp.float32),
+        done=done,
+        chosen_cloud=action,
+        step=new_step,
+    )
+    return new_state, ts

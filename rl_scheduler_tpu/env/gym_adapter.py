@@ -1,0 +1,120 @@
+"""Gymnasium adapter: the reference's public env API over the functional core.
+
+Drop-in surface parity with the reference ``K8sMultiCloudEnv``
+(``rl_scheduler/env/k8s_multi_cloud_env.py:36-157``): same spaces, same
+5-tuple ``step`` return, same ``info`` dict (``chosen_cloud`` as a string,
+``step``), same ``normal_scheduler_step`` baseline, same
+``fast_mode=False`` hook that dry-runs a pod placement against a real
+cluster. Internally it is a thin host-side shell: all math happens in the
+jitted functional core, so this class stays a convenience for single-env
+use and parity tests — training uses the vmapped core directly.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+try:
+    import gymnasium as gym
+    from gymnasium import spaces
+
+    _GYM_BASE = gym.Env
+except ImportError:  # pragma: no cover - gymnasium is a soft dependency
+    gym = None
+    spaces = None
+    _GYM_BASE = object
+
+from rl_scheduler_tpu.config import EnvConfig
+from rl_scheduler_tpu.env import core
+
+_JIT_RESET = jax.jit(core.reset)
+_JIT_STEP = jax.jit(core.step)
+
+
+class K8sMultiCloudEnv(_GYM_BASE):
+    """Single multi-cloud scheduling env with the Gymnasium 5-tuple API."""
+
+    metadata = {"render_modes": []}
+
+    def __init__(
+        self,
+        env_config: dict | None = None,
+        fast_mode: bool = True,
+        config: EnvConfig | None = None,
+    ):
+        if gym is None:
+            raise ImportError("gymnasium is required for the adapter; use env.core directly")
+        super().__init__()
+        # Unlike the reference (which accepts env_config and ignores it,
+        # k8s_multi_cloud_env.py:46), dict entries override EnvConfig fields.
+        if config is None:
+            config = EnvConfig(**(env_config or {}))
+        self.config = config
+        self.fast_mode = fast_mode
+        self.params = core.make_params(config)
+        self.action_space = spaces.Discrete(core.NUM_ACTIONS)
+        self.observation_space = spaces.Box(0.0, 1.0, (core.OBS_DIM,), np.float32)
+        self.max_steps = int(self.params.max_steps)
+        self.current_step = 0
+        # Module-level jits: all adapter instances share one compiled program.
+        self._jit_reset = _JIT_RESET
+        self._jit_step = _JIT_STEP
+        self._state = None
+        self._placer = None
+        if not fast_mode:
+            from rl_scheduler_tpu.scheduler.k8s_client import DryRunPodPlacer
+
+            self._placer = DryRunPodPlacer()
+
+    def reset(self, seed: int | None = None, options: dict | None = None):
+        if gym is not None:
+            super().reset(seed=seed)
+        if seed is None:
+            # Gymnasium semantics: unseeded resets are nondeterministic and
+            # independent across instances/processes.
+            seed = int.from_bytes(os.urandom(4), "little")
+        self._state, obs = self._jit_reset(self.params, jax.random.PRNGKey(seed))
+        self.current_step = 0
+        return np.asarray(obs), {}
+
+    def step(self, action):
+        action = int(action)
+        assert action in (0, 1), f"Invalid action {action}"
+        self._state, ts = self._jit_step(self.params, self._state, action)
+        if self._placer is not None:
+            # Host-side, outside jit: dry-run a pod placement on the chosen
+            # cluster (reference slow mode, k8s_multi_cloud_env.py:125-137).
+            self._placer.place(cloud="aws" if action == 0 else "azure")
+        self.current_step = int(ts.step)
+        info = {"chosen_cloud": "aws" if action == 0 else "azure", "step": self.current_step}
+        return np.asarray(ts.obs), float(ts.reward), bool(ts.done), False, info
+
+    def render(self):
+        pass
+
+    def close(self):
+        pass
+
+    def normal_scheduler_step(self, obs) -> int:
+        """Cost-greedy baseline (reference parity)."""
+        return 0 if obs[0] <= obs[1] else 1
+
+
+if __name__ == "__main__":
+    env = K8sMultiCloudEnv(fast_mode=True)
+    obs, _ = env.reset(seed=42)
+    print("Initial observation:", obs.round(3))
+    for i in range(5):
+        action = env.action_space.sample()
+        obs, reward, done, truncated, info = env.step(action)
+        print(
+            f"Step {i + 1} | Action: {info['chosen_cloud']:5} | "
+            f"Reward: {reward:8.2f} | Next obs: {obs.round(3)}"
+        )
+        if done:
+            break
+    print("Environment test completed")

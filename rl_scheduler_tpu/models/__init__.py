@@ -1,0 +1,5 @@
+"""Policy zoo: MLP actor-critic, Q-network, set transformer, cluster GNN."""
+
+from rl_scheduler_tpu.models.mlp import ActorCritic, QNetwork
+
+__all__ = ["ActorCritic", "QNetwork"]

@@ -1,0 +1,60 @@
+"""MLP policies (BASELINE configs 1-3).
+
+The reference uses RLlib's default torch MLP (2x256 tanh, separate value
+branch) over the 6-dim observation. These are the flax equivalents; at this
+scale the matmuls are tiny, so everything fuses into one XLA program with the
+env step — the win is structural (no Ray worker boundary), not per-matmul.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLPTorso(nn.Module):
+    hidden: Sequence[int] = (256, 256)
+    activation: str = "tanh"
+
+    @nn.compact
+    def __call__(self, x):
+        act = getattr(nn, self.activation)
+        for h in self.hidden:
+            x = act(nn.Dense(h, kernel_init=nn.initializers.orthogonal(jnp.sqrt(2)))(x))
+        return x
+
+
+class ActorCritic(nn.Module):
+    """Separate actor/critic torsos (RLlib PPO default: vf_share_layers=False).
+
+    Returns ``(logits [..., num_actions], value [...])``.
+    """
+
+    num_actions: int = 2
+    hidden: Sequence[int] = (256, 256)
+    activation: str = "tanh"
+
+    @nn.compact
+    def __call__(self, obs):
+        pi = MLPTorso(self.hidden, self.activation, name="actor_torso")(obs)
+        logits = nn.Dense(
+            self.num_actions, kernel_init=nn.initializers.orthogonal(0.01), name="actor_head"
+        )(pi)
+        v = MLPTorso(self.hidden, self.activation, name="critic_torso")(obs)
+        value = nn.Dense(1, kernel_init=nn.initializers.orthogonal(1.0), name="critic_head")(v)
+        return logits, jnp.squeeze(value, -1)
+
+
+class QNetwork(nn.Module):
+    """Q-value MLP for DQN (BASELINE config 1: 2-layer MLP)."""
+
+    num_actions: int = 2
+    hidden: Sequence[int] = (64, 64)
+    activation: str = "relu"
+
+    @nn.compact
+    def __call__(self, obs):
+        x = MLPTorso(self.hidden, self.activation)(obs)
+        return nn.Dense(self.num_actions, kernel_init=nn.initializers.orthogonal(1.0))(x)

@@ -1,0 +1,6 @@
+"""Core RL ops: advantage estimation, returns, losses. All scan/jit-native."""
+
+from rl_scheduler_tpu.ops.gae import gae, discounted_returns
+from rl_scheduler_tpu.ops.losses import ppo_loss, dqn_loss, PPOLossConfig
+
+__all__ = ["gae", "discounted_returns", "ppo_loss", "dqn_loss", "PPOLossConfig"]

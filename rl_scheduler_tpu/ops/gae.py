@@ -1,0 +1,56 @@
+"""Generalized Advantage Estimation as a reverse ``lax.scan``.
+
+The reference delegates GAE to RLlib's numpy postprocessing on the driver
+process; here it runs on-device inside the jitted update, over the whole
+``[T, N]`` rollout at once. ``done`` marks episode boundaries from
+auto-reset, cutting the bootstrap across episodes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def gae(
+    rewards: jnp.ndarray,     # [T, N]
+    values: jnp.ndarray,      # [T, N] V(s_t)
+    dones: jnp.ndarray,       # [T, N] episode ended at t
+    last_value: jnp.ndarray,  # [N] V(s_{T}) bootstrap
+    gamma: float,
+    lam: float,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns ``(advantages [T, N], targets [T, N])`` with
+    ``targets = advantages + values`` (the value-function regression target).
+    """
+    not_done = 1.0 - dones.astype(jnp.float32)
+
+    def body(carry, xs):
+        next_adv, next_value = carry
+        reward, value, nd = xs
+        delta = reward + gamma * next_value * nd - value
+        adv = delta + gamma * lam * nd * next_adv
+        return (adv, value), adv
+
+    (_, _), advs = jax.lax.scan(
+        body,
+        (jnp.zeros_like(last_value), last_value),
+        (rewards, values, not_done),
+        reverse=True,
+    )
+    return advs, advs + values
+
+
+def discounted_returns(
+    rewards: jnp.ndarray, dones: jnp.ndarray, last_value: jnp.ndarray, gamma: float
+) -> jnp.ndarray:
+    """Discounted return-to-go per step (GAE with lam=1 target)."""
+    not_done = 1.0 - dones.astype(jnp.float32)
+
+    def body(next_ret, xs):
+        reward, nd = xs
+        ret = reward + gamma * nd * next_ret
+        return ret, ret
+
+    _, rets = jax.lax.scan(body, last_value, (rewards, not_done), reverse=True)
+    return rets

@@ -1,0 +1,100 @@
+"""PPO and DQN losses, matching RLlib's torch implementations in behavior.
+
+PPO: clipped surrogate + clipped value loss + entropy bonus with RLlib's
+default coefficients (vf_loss_coeff=1.0, entropy_coeff=0.0, clip 0.3,
+vf_clip 10.0), so the reference's named hyperparameter presets behave
+comparably (SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class PPOLossConfig(NamedTuple):
+    clip_eps: float = 0.3        # RLlib PPO default clip_param
+    vf_clip: float = 10.0        # RLlib default vf_clip_param
+    vf_coeff: float = 1.0
+    entropy_coeff: float = 0.0
+    normalize_advantages: bool = True
+
+
+def categorical_log_prob(logits: jnp.ndarray, actions: jnp.ndarray) -> jnp.ndarray:
+    logp = jax.nn.log_softmax(logits)
+    return jnp.take_along_axis(logp, actions[..., None], axis=-1)[..., 0]
+
+
+def categorical_entropy(logits: jnp.ndarray) -> jnp.ndarray:
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+def ppo_loss(
+    logits: jnp.ndarray,        # [B, A] current policy logits
+    values: jnp.ndarray,        # [B] current value predictions
+    actions: jnp.ndarray,       # [B]
+    old_log_probs: jnp.ndarray, # [B] behavior-policy log probs
+    old_values: jnp.ndarray,    # [B] behavior-policy values (for value clip)
+    advantages: jnp.ndarray,    # [B]
+    targets: jnp.ndarray,       # [B] value regression targets
+    cfg: PPOLossConfig = PPOLossConfig(),
+):
+    """Returns ``(loss, metrics dict)``."""
+    if cfg.normalize_advantages:
+        advantages = (advantages - advantages.mean()) / (advantages.std() + 1e-8)
+
+    log_probs = categorical_log_prob(logits, actions)
+    ratio = jnp.exp(log_probs - old_log_probs)
+    surr1 = ratio * advantages
+    surr2 = jnp.clip(ratio, 1.0 - cfg.clip_eps, 1.0 + cfg.clip_eps) * advantages
+    policy_loss = -jnp.mean(jnp.minimum(surr1, surr2))
+
+    # RLlib-style clipped value loss.
+    vf_err = jnp.square(values - targets)
+    v_clipped = old_values + jnp.clip(values - old_values, -cfg.vf_clip, cfg.vf_clip)
+    vf_err_clipped = jnp.square(v_clipped - targets)
+    value_loss = 0.5 * jnp.mean(jnp.maximum(vf_err, vf_err_clipped))
+
+    entropy = jnp.mean(categorical_entropy(logits))
+    total = policy_loss + cfg.vf_coeff * value_loss - cfg.entropy_coeff * entropy
+
+    approx_kl = jnp.mean(old_log_probs - log_probs)
+    clip_frac = jnp.mean((jnp.abs(ratio - 1.0) > cfg.clip_eps).astype(jnp.float32))
+    metrics = {
+        "policy_loss": policy_loss,
+        "value_loss": value_loss,
+        "entropy": entropy,
+        "approx_kl": approx_kl,
+        "clip_fraction": clip_frac,
+    }
+    return total, metrics
+
+
+def dqn_loss(
+    q_values: jnp.ndarray,        # [B, A] online network Q(s, .)
+    target_q_next: jnp.ndarray,   # [B, A] target network Q(s', .)
+    online_q_next: jnp.ndarray,   # [B, A] online network Q(s', .) for double-DQN
+    actions: jnp.ndarray,         # [B]
+    rewards: jnp.ndarray,         # [B]
+    dones: jnp.ndarray,           # [B]
+    gamma: float,
+    huber_delta: float = 1.0,
+):
+    """Double-DQN TD error with Huber loss. Returns ``(loss, metrics)``."""
+    q_sa = jnp.take_along_axis(q_values, actions[..., None], axis=-1)[..., 0]
+    next_actions = jnp.argmax(online_q_next, axis=-1)
+    q_next = jnp.take_along_axis(target_q_next, next_actions[..., None], axis=-1)[..., 0]
+    target = rewards + gamma * (1.0 - dones.astype(jnp.float32)) * q_next
+    td = q_sa - jax.lax.stop_gradient(target)
+    abs_td = jnp.abs(td)
+    loss = jnp.mean(
+        jnp.where(
+            abs_td <= huber_delta,
+            0.5 * jnp.square(td),
+            huber_delta * (abs_td - 0.5 * huber_delta),
+        )
+    )
+    return loss, {"td_abs_mean": jnp.mean(abs_td), "q_mean": jnp.mean(q_sa)}

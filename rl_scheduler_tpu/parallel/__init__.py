@@ -1,0 +1,14 @@
+"""Device-mesh parallelism: mesh construction, sharded training, multi-host.
+
+The reference's only scale-out is Ray rollout-worker actors over gRPC
+(SURVEY.md §2 #17-18). Here scale-out is SPMD over a ``jax.sharding.Mesh``:
+the env batch shards over the ``dp`` axis, gradients all-reduce over ICI via
+``pmean`` inside ``shard_map``, and larger policies shard their weights over
+a ``tp`` axis. Multi-host (DCN) growth goes through ``jax.distributed``
+(``distributed.py``).
+"""
+
+from rl_scheduler_tpu.parallel.mesh import make_mesh, device_count
+from rl_scheduler_tpu.parallel.sharding import make_data_parallel_ppo
+
+__all__ = ["make_mesh", "device_count", "make_data_parallel_ppo"]

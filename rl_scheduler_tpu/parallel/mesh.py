@@ -1,0 +1,42 @@
+"""Mesh construction helpers.
+
+A v4-8 exposes 4 chips over ICI; tests simulate 8 CPU devices via
+``--xla_force_host_platform_device_count=8``. Axis convention:
+``dp`` = data parallel (env batch), ``tp`` = tensor parallel (policy
+weights, used by the transformer/GNN configs).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def make_mesh(axes: dict[str, int] | None = None) -> Mesh:
+    """Build a mesh from ``{axis_name: size}``; -1 means "all remaining".
+
+    Default: all devices on one ``dp`` axis.
+    """
+    devices = jax.devices()
+    if axes is None:
+        axes = {"dp": len(devices)}
+    names = list(axes)
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis size may be -1")
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if -1 in sizes:
+        if len(devices) % known:
+            raise ValueError(f"{len(devices)} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(f"mesh needs {total} devices, have {len(devices)}")
+    mesh_devices = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(mesh_devices, tuple(names))

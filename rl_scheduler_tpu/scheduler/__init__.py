@@ -1,0 +1,1 @@
+"""Kubernetes scheduler integration: extender server, backends, cluster hooks."""

@@ -1,0 +1,86 @@
+"""Host-side Kubernetes cluster hooks (never inside jit).
+
+Completes the reference's "slow mode" (``k8s_multi_cloud_env.py:69-82,
+125-137``) with two of its bugs fixed:
+
+- The reference hardcodes kubeconfig contexts ``kind-aws``/``kind-azure``,
+  but ``kind create cluster --config aws-cluster-config.yaml`` registers the
+  context as ``kind-kind-aws`` (kind prefixes cluster names with ``kind-``).
+  The lookup always failed and the bare ``except: pass`` hid it. We try both
+  spellings and log what we find.
+- Failures are logged (once per failure kind) instead of silently swallowed,
+  and ``place`` reports success, so callers can fall back.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+logger = logging.getLogger(__name__)
+
+# Candidate kubeconfig context names per simulated cloud.
+CLOUD_CONTEXTS = {
+    "aws": ("kind-kind-aws", "kind-aws"),
+    "azure": ("kind-kind-azure", "kind-azure"),
+}
+
+
+class DryRunPodPlacer:
+    """Dry-run pod creation against per-cloud kind clusters."""
+
+    def __init__(self, namespace: str = "default", image: str = "nginx:alpine"):
+        self.namespace = namespace
+        self.image = image
+        self._clients: dict[str, object] = {}
+        self._warned: set[str] = set()
+        self._load_clients()
+
+    def _load_clients(self) -> None:
+        try:
+            from kubernetes import client, config
+        except ImportError:
+            logger.warning("kubernetes client not installed; slow mode is a no-op")
+            return
+        for cloud, contexts in CLOUD_CONTEXTS.items():
+            for ctx in contexts:
+                try:
+                    api_client = config.new_client_from_config(context=ctx)
+                    self._clients[cloud] = client.CoreV1Api(api_client=api_client)
+                    logger.info("loaded kube context %s for cloud %s", ctx, cloud)
+                    break
+                except Exception as e:  # noqa: BLE001 - any config error means "not available"
+                    logger.debug("kube context %s unavailable: %s", ctx, e)
+        missing = set(CLOUD_CONTEXTS) - set(self._clients)
+        if missing:
+            logger.warning("no kube context found for clouds: %s", sorted(missing))
+
+    def place(self, cloud: str, dry_run: bool = True) -> bool:
+        """Dry-run create an nginx pod on the chosen cloud. Returns success."""
+        v1 = self._clients.get(cloud)
+        if v1 is None:
+            self._warn_once(f"no-client-{cloud}", f"no kube client for cloud {cloud}")
+            return False
+        from kubernetes import client
+
+        pod = client.V1Pod(
+            metadata=client.V1ObjectMeta(name=f"rl-pod-{int(time.time() * 1000)}"),
+            spec=client.V1PodSpec(
+                containers=[client.V1Container(name="nginx", image=self.image)]
+            ),
+        )
+        try:
+            v1.create_namespaced_pod(
+                namespace=self.namespace,
+                body=pod,
+                dry_run="All" if dry_run else None,
+            )
+            return True
+        except Exception as e:  # noqa: BLE001 - surface, don't crash the env loop
+            self._warn_once(f"place-{cloud}", f"pod placement on {cloud} failed: {e}")
+            return False
+
+    def _warn_once(self, key: str, msg: str) -> None:
+        if key not in self._warned:
+            self._warned.add(key)
+            logger.warning(msg)

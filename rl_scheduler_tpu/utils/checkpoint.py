@@ -1,0 +1,98 @@
+"""Orbax checkpointing with the reference's lifecycle semantics.
+
+Capability parity with the reference's Ray-delegated checkpointing
+(SURVEY.md §5.4): periodic save, keep-N, save-at-end (the caller's loop
+decides when), latest-checkpoint auto-discovery across runs
+(``final_evaluation.py:13-27`` does this with ``rglob`` + max numeric
+suffix), and a ``from_checkpoint``-style restore shared by evaluation and
+the scheduler-extender server.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Thin wrapper over ``ocp.CheckpointManager`` for one training run."""
+
+    def __init__(self, run_dir: str | Path, keep: int = 5):
+        self.run_dir = Path(run_dir)
+        options = ocp.CheckpointManagerOptions(max_to_keep=keep, create=True)
+        self._mgr = ocp.CheckpointManager(
+            (self.run_dir / "checkpoints").absolute(), options=options
+        )
+
+    def save(self, step: int, tree: Any, extras: dict | None = None) -> None:
+        self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(tree),
+                meta=ocp.args.JsonSave(extras or {}),
+            ),
+        )
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, step: int | None = None, target: Any | None = None):
+        """Restore ``(tree, extras)``. With ``target`` given, the tree is
+        restored with the target's exact pytree structure (needed for
+        opt_state); otherwise as nested dicts/lists (fine for params)."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.run_dir}")
+        state_args = (
+            ocp.args.StandardRestore(target) if target is not None else ocp.args.StandardRestore()
+        )
+        out = self._mgr.restore(
+            step, args=ocp.args.Composite(state=state_args, meta=ocp.args.JsonRestore())
+        )
+        return out["state"], dict(out["meta"] or {})
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def find_latest_run(root: str | Path, prefix: str = "") -> Path:
+    """Latest run directory under ``root`` that contains checkpoints.
+
+    Mirrors the reference's auto-discovery (newest checkpoint wins), keyed on
+    checkpoint step number then mtime.
+    """
+    root = Path(root)
+    if not root.exists():
+        raise FileNotFoundError(f"run root {root} does not exist")
+    candidates = []
+    for run in sorted(root.iterdir()):
+        if not run.is_dir() or not run.name.startswith(prefix):
+            continue
+        steps = [
+            (int(d.name), d)
+            for d in (run / "checkpoints").glob("*")
+            if d.is_dir() and d.name.isdigit()
+        ]
+        if steps:
+            step, step_dir = max(steps)
+            # Newest checkpoint write wins (promotes resumed runs); step
+            # number breaks ties.
+            candidates.append((step_dir.stat().st_mtime, step, run))
+    if not candidates:
+        raise FileNotFoundError(
+            f"No checkpoints found under {root}. Did training actually finish?"
+        )
+    return max(candidates)[2]
+
+
+def load_policy_params(run_dir: str | Path, step: int | None = None):
+    """Restore just the policy params (+meta) from a run directory."""
+    mgr = CheckpointManager(run_dir)
+    tree, meta = mgr.restore(step)
+    mgr.close()
+    return tree["params"], meta

@@ -1,0 +1,81 @@
+"""Gymnasium adapter: reference API surface parity."""
+
+import numpy as np
+import pytest
+
+gym = pytest.importorskip("gymnasium")
+
+from rl_scheduler_tpu.config import EnvConfig
+from rl_scheduler_tpu.env.gym_adapter import K8sMultiCloudEnv
+
+
+@pytest.fixture(scope="module")
+def env():
+    return K8sMultiCloudEnv(config=EnvConfig(legacy_reward_sign=True))
+
+
+def test_spaces(env):
+    assert env.action_space.n == 2
+    assert env.observation_space.shape == (6,)
+    assert env.observation_space.dtype == np.float32
+
+
+def test_reset_step_api(env):
+    obs, info = env.reset(seed=42)
+    assert obs.shape == (6,) and isinstance(info, dict)
+    obs, reward, done, truncated, info = env.step(0)
+    assert isinstance(reward, float)
+    assert info["chosen_cloud"] == "aws" and info["step"] == 1
+    assert truncated is False and done is False
+    obs, reward, done, truncated, info = env.step(1)
+    assert info["chosen_cloud"] == "azure" and info["step"] == 2
+
+
+def test_full_episode(env):
+    env.reset(seed=0)
+    steps = 0
+    done = False
+    while not done:
+        _, _, done, _, _ = env.step(0)
+        steps += 1
+    assert steps == 99  # reference episode length
+
+
+def test_reward_matches_reference_row0(env, reference_table):
+    env.reset(seed=1)
+    _, reward, _, _, _ = env.step(0)
+    row = reference_table.iloc[0]
+    assert reward == pytest.approx(100 * (0.6 * row["cost_aws"] + 0.4 * row["latency_aws"]), rel=1e-5)
+
+
+def test_normal_scheduler_step(env):
+    obs, _ = env.reset(seed=2)
+    a = env.normal_scheduler_step(obs)
+    assert a == (0 if obs[0] <= obs[1] else 1)
+
+
+def test_env_config_dict_respected():
+    e = K8sMultiCloudEnv(env_config={"reward_scale": 1.0, "legacy_reward_sign": True})
+    e.reset(seed=3)
+    _, reward, _, _, _ = e.step(0)
+    assert 0 < reward < 1.1  # scale 1 keeps reward within ~[0, 1]
+
+
+def test_invalid_action_rejected(env):
+    env.reset(seed=4)
+    with pytest.raises(AssertionError):
+        env.step(2)
+
+
+def test_time_limit_wrapper_compat():
+    """The reference's train_and_compare wraps the env in TimeLimit(100)."""
+    from gymnasium.wrappers import TimeLimit
+
+    e = TimeLimit(K8sMultiCloudEnv(), max_episode_steps=100)
+    obs, _ = e.reset(seed=5)
+    done = truncated = False
+    steps = 0
+    while not (done or truncated):
+        _, _, done, truncated, _ = e.step(steps % 2)
+        steps += 1
+    assert steps == 99  # natural done fires before the 100-step truncation

@@ -1,0 +1,86 @@
+"""Multi-device tests on the virtual 8-CPU mesh: mesh building, dp-PPO."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_scheduler_tpu.agent.ppo import PPOTrainConfig
+from rl_scheduler_tpu.config import EnvConfig
+from rl_scheduler_tpu.env import core as env_core
+from rl_scheduler_tpu.parallel import make_mesh, make_data_parallel_ppo
+from rl_scheduler_tpu.parallel.sharding import dp_ppo_train
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+DP_CFG = PPOTrainConfig(
+    num_envs=64,
+    rollout_steps=32,
+    minibatch_size=256,
+    num_epochs=2,
+    lr=1e-3,
+    hidden=(32, 32),
+)
+
+
+@pytest.fixture(scope="module")
+def env_params():
+    return env_core.make_params(EnvConfig())
+
+
+def test_make_mesh_shapes():
+    m = make_mesh()
+    assert m.shape == {"dp": 8}
+    m2 = make_mesh({"dp": 4, "tp": 2})
+    assert m2.shape == {"dp": 4, "tp": 2}
+    m3 = make_mesh({"dp": -1})
+    assert m3.shape == {"dp": 8}
+    with pytest.raises(ValueError):
+        make_mesh({"dp": 16})
+
+
+def test_dp_ppo_runs_and_syncs(env_params):
+    mesh = make_mesh({"dp": 8})
+    init_fn, update_fn, _ = make_data_parallel_ppo(env_params, DP_CFG, mesh)
+    runner = jax.jit(init_fn)(jax.random.PRNGKey(0))
+    # batch leaves sharded over dp, params replicated
+    assert runner.obs.shape == (DP_CFG.num_envs, env_core.OBS_DIM)
+    assert runner.key.shape[0] == 8  # one key row per device
+
+    update = jax.jit(update_fn)
+    runner, metrics = update(runner)
+    runner, metrics = update(runner)
+    for k in ("episode_reward_mean", "policy_loss", "value_loss"):
+        assert np.isfinite(float(metrics[k])), k
+    assert int(runner.update_idx) == 2
+    # params replicated: every leaf finite, single logical copy
+    for leaf in jax.tree.leaves(runner.params):
+        assert bool(jnp.all(jnp.isfinite(leaf)))
+
+
+def test_dp_keys_differ_per_device(env_params):
+    mesh = make_mesh({"dp": 8})
+    init_fn, _, _ = make_data_parallel_ppo(env_params, DP_CFG, mesh)
+    runner = jax.jit(init_fn)(jax.random.PRNGKey(0))
+    keys = np.asarray(runner.key)
+    assert len({tuple(k) for k in keys}) == 8  # all distinct
+
+
+def test_dp_validation_errors(env_params):
+    mesh = make_mesh({"dp": 8})
+    with pytest.raises(ValueError, match="not divisible"):
+        make_data_parallel_ppo(
+            env_params, dataclasses.replace(DP_CFG, num_envs=63), mesh
+        )
+
+
+def test_dp_learning_progress(env_params):
+    """The dp path must actually learn (reward improves over iterations)."""
+    _, history = dp_ppo_train(env_params, DP_CFG, 12, seed=1)
+    first = np.mean([h["reward_mean"] for h in history[:3]])
+    last = np.mean([h["reward_mean"] for h in history[-3:]])
+    assert last > first
