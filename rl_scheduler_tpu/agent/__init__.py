@@ -1,6 +1,15 @@
 """Agents: PPO and DQN trainers, hyperparameter presets, evaluation."""
 
 from rl_scheduler_tpu.agent.ppo import PPOTrainConfig, make_ppo, ppo_train
+from rl_scheduler_tpu.agent.dqn import DQNConfig, make_dqn, dqn_train
 from rl_scheduler_tpu.agent.presets import PPO_PRESETS
 
-__all__ = ["PPOTrainConfig", "make_ppo", "ppo_train", "PPO_PRESETS"]
+__all__ = [
+    "PPOTrainConfig",
+    "make_ppo",
+    "ppo_train",
+    "DQNConfig",
+    "make_dqn",
+    "dqn_train",
+    "PPO_PRESETS",
+]

@@ -47,6 +47,7 @@ class SingleClusterConfig:
     max_replicas: int = 10
     replica_cost_weight: float = 0.3
     latency_weight: float = 0.7
+    overload_penalty: float = 2.0
     max_steps: int | None = None
 
 

@@ -20,6 +20,13 @@ from rl_scheduler_tpu.env.baselines import (
     round_robin_policy,
     random_policy,
 )
+from rl_scheduler_tpu.env.bundle import (
+    EnvBundle,
+    make_autoreset,
+    bundle_from_single,
+    multi_cloud_bundle,
+    single_cluster_bundle,
+)
 
 __all__ = [
     "EnvParams",
@@ -36,4 +43,9 @@ __all__ = [
     "cost_greedy_policy",
     "round_robin_policy",
     "random_policy",
+    "EnvBundle",
+    "make_autoreset",
+    "bundle_from_single",
+    "multi_cloud_bundle",
+    "single_cluster_bundle",
 ]

@@ -1,0 +1,117 @@
+"""Uniform env interface so agents are generic over simulators.
+
+The reference binds its agents to one Gymnasium class by construction
+(``agent/train_ppo.py:11`` instantiates ``K8sMultiCloudEnv`` directly).
+Here every simulator — multi-cloud table replay, single-cluster
+autoscaler, pod/node set, cluster graph — exports the same two batched
+pure functions, so PPO/DQN compose with any of them inside one jitted
+program:
+
+    reset_batch(key, num_envs)      -> (state, obs)
+    step_batch(state, action)       -> (state, TimeStep)   # auto-resetting
+
+Auto-reset is implemented once, generically, for any env whose state
+pytree carries a ``key`` field (every env here does — per-env PRNG keys
+replace the reference's process-global ``random.seed``, SURVEY.md §5.2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class EnvBundle(NamedTuple):
+    """Batched env API plus the static facts agents need to build networks.
+
+    ``obs_shape`` is the per-env observation shape (``(6,)`` for the
+    multi-cloud env; structured envs may use higher-rank shapes).
+    """
+
+    reset_batch: Callable[[jnp.ndarray, int], tuple[Any, jnp.ndarray]]
+    step_batch: Callable[[Any, jnp.ndarray], tuple[Any, Any]]
+    obs_shape: tuple
+    num_actions: int
+    name: str = "env"
+
+
+def make_autoreset(reset_fn: Callable, step_fn: Callable) -> Callable:
+    """Lift single-env ``(reset, step)`` into an auto-resetting step.
+
+    The returned TimeStep carries the terminal reward/done of the finishing
+    episode while obs/state roll into the next episode — the contract
+    scan-collected rollouts need (Gymnasium episode semantics, reference
+    ``k8s_multi_cloud_env.py:139-141``, without host round-trips).
+    """
+
+    def step_autoreset(state, action):
+        new_state, ts = step_fn(state, action)
+        reset_key, carry_key = jax.random.split(new_state.key)
+        reset_state, reset_obs = reset_fn(reset_key)
+        reset_state = reset_state._replace(key=carry_key)
+        out_state = jax.tree.map(
+            lambda r, n: jnp.where(ts.done, r, n), reset_state, new_state
+        )
+        out_obs = jnp.where(ts.done, reset_obs, ts.obs)
+        return out_state, ts._replace(obs=out_obs)
+
+    return step_autoreset
+
+
+def bundle_from_single(
+    reset_fn: Callable,
+    step_fn: Callable,
+    obs_shape: tuple,
+    num_actions: int,
+    name: str = "env",
+) -> EnvBundle:
+    """Build an :class:`EnvBundle` from single-env pure functions."""
+    step_autoreset = make_autoreset(reset_fn, step_fn)
+    step_batch = jax.vmap(step_autoreset, in_axes=(0, 0))
+
+    def reset_batch(key, num_envs):
+        keys = jax.random.split(key, num_envs)
+        return jax.vmap(reset_fn)(keys)
+
+    return EnvBundle(
+        reset_batch=reset_batch,
+        step_batch=step_batch,
+        obs_shape=obs_shape,
+        num_actions=num_actions,
+        name=name,
+    )
+
+
+def multi_cloud_bundle(params=None) -> EnvBundle:
+    """The flagship multi-cloud placement env as a bundle (reuses the
+    batched steppers from :mod:`rl_scheduler_tpu.env.vector`)."""
+    from rl_scheduler_tpu.env import core, vector
+
+    if params is None:
+        params = core.make_params()
+    return EnvBundle(
+        reset_batch=lambda key, n: vector.reset_batch(params, key, n),
+        step_batch=lambda state, action: vector.step_autoreset_batch(
+            params, state, action
+        ),
+        obs_shape=(core.OBS_DIM,),
+        num_actions=core.NUM_ACTIONS,
+        name="multi_cloud",
+    )
+
+
+def single_cluster_bundle(params=None) -> EnvBundle:
+    """The single-cluster autoscaling env (BASELINE config 1) as a bundle."""
+    from rl_scheduler_tpu.env import single_cluster as sc
+
+    if params is None:
+        params = sc.make_params()
+    return bundle_from_single(
+        lambda key: sc.reset(params, key),
+        lambda state, action: sc.step(params, state, action),
+        obs_shape=(sc.OBS_DIM,),
+        num_actions=sc.NUM_ACTIONS,
+        name="single_cluster",
+    )

@@ -1,0 +1,136 @@
+"""Single-cluster autoscaling simulator (BASELINE config 1).
+
+The reference's BASELINE.json names a first config driven by the Locust
+load-test export ``data/local_aws_load_stats.csv``: a single simulated
+cluster under a replayed load trace. The reference repo itself only ships
+the raw CSVs (its env ignores them; see SURVEY.md §2 #11-12) — this module
+makes the config real, in the same pure-functional style as
+:mod:`rl_scheduler_tpu.env.core` so it jit/vmap/scan-composes with the same
+agents.
+
+Dynamics: the agent controls the replica count of a deployment serving the
+replayed load (users, req/s, response time per step — the columns of a
+Locust ``*_stats_history.csv``). Observation is ``[users, rps,
+resp_time, replicas/max_replicas]`` (all in [0,1]); actions are
+``{0: scale down, 1: hold, 2: scale up}``. Reward penalizes replica cost
+plus effective latency, where latency inflates when offered load exceeds
+provisioned capacity — the standard autoscaling trade-off:
+
+    capacity   = replicas / max_replicas
+    overload   = relu(load - capacity)
+    eff_lat    = resp_time + overload_penalty * overload
+    reward     = -(w_cost * capacity + w_lat * eff_lat)
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from rl_scheduler_tpu.config import SingleClusterConfig
+from rl_scheduler_tpu.data.loader import load_single_cluster_trace
+
+OBS_DIM = 4
+NUM_ACTIONS = 3  # scale down / hold / scale up
+
+
+class SingleClusterParams(NamedTuple):
+    trace: jnp.ndarray        # [T, 3] normalized (users, rps, resp_time)
+    max_replicas: jnp.ndarray  # scalar int32
+    cost_weight: jnp.ndarray
+    latency_weight: jnp.ndarray
+    overload_penalty: jnp.ndarray
+    max_steps: jnp.ndarray    # scalar int32
+
+    @property
+    def num_table_steps(self) -> int:
+        return self.trace.shape[0]
+
+
+class SingleClusterState(NamedTuple):
+    step_idx: jnp.ndarray  # scalar int32
+    replicas: jnp.ndarray  # scalar int32 in [1, max_replicas]
+    key: jnp.ndarray
+
+
+class TimeStep(NamedTuple):
+    obs: jnp.ndarray
+    reward: jnp.ndarray
+    done: jnp.ndarray
+    chosen_cloud: jnp.ndarray  # here: post-action replica count (kept for API symmetry)
+    step: jnp.ndarray
+
+
+def make_params(
+    config: SingleClusterConfig | None = None,
+    trace: jnp.ndarray | None = None,
+) -> SingleClusterParams:
+    config = config or SingleClusterConfig()
+    if trace is None:
+        trace = load_single_cluster_trace(config.trace_path)
+    t = trace.shape[0]
+    max_steps = config.max_steps if config.max_steps is not None else t - 1
+    if not 0 < max_steps <= t - 1:
+        raise ValueError(f"max_steps must be in (0, {t - 1}], got {max_steps}")
+    f32 = lambda x: jnp.asarray(x, jnp.float32)
+    return SingleClusterParams(
+        trace=jnp.asarray(trace, jnp.float32),
+        max_replicas=jnp.asarray(config.max_replicas, jnp.int32),
+        cost_weight=f32(config.replica_cost_weight),
+        latency_weight=f32(config.latency_weight),
+        overload_penalty=f32(config.overload_penalty),
+        max_steps=jnp.asarray(max_steps, jnp.int32),
+    )
+
+
+def _observe(
+    params: SingleClusterParams, step_idx: jnp.ndarray, replicas: jnp.ndarray
+) -> jnp.ndarray:
+    row = jax.lax.dynamic_index_in_dim(params.trace, step_idx, keepdims=False)
+    frac = replicas.astype(jnp.float32) / params.max_replicas.astype(jnp.float32)
+    return jnp.concatenate([row, frac[None]]).astype(jnp.float32)
+
+
+def reset(
+    params: SingleClusterParams, key: jnp.ndarray
+) -> tuple[SingleClusterState, jnp.ndarray]:
+    """Start at trace row 0 with half the replica budget provisioned."""
+    step_idx = jnp.zeros((), jnp.int32)
+    replicas = jnp.maximum(params.max_replicas // 2, 1)
+    state = SingleClusterState(step_idx=step_idx, replicas=replicas, key=key)
+    return state, _observe(params, step_idx, replicas)
+
+
+def step(
+    params: SingleClusterParams, state: SingleClusterState, action: jnp.ndarray
+) -> tuple[SingleClusterState, TimeStep]:
+    """One autoscaling decision. Pure; jit/vmap/scan-safe.
+
+    Like the multi-cloud core, reward is computed against the row the agent
+    *observed* (pre-increment index).
+    """
+    action = jnp.asarray(action, jnp.int32)
+    delta = action - 1  # {0,1,2} -> {-1,0,+1}
+    replicas = jnp.clip(state.replicas + delta, 1, params.max_replicas)
+
+    row = jax.lax.dynamic_index_in_dim(params.trace, state.step_idx, keepdims=False)
+    load = row[0]          # normalized user count
+    resp_time = row[2]     # normalized response time
+    capacity = replicas.astype(jnp.float32) / params.max_replicas.astype(jnp.float32)
+    overload = jnp.maximum(load - capacity, 0.0)
+    eff_latency = resp_time + params.overload_penalty * overload
+    reward = -(params.cost_weight * capacity + params.latency_weight * eff_latency)
+
+    new_step = state.step_idx + 1
+    done = new_step >= params.max_steps
+    new_state = SingleClusterState(step_idx=new_step, replicas=replicas, key=state.key)
+    ts = TimeStep(
+        obs=_observe(params, new_step, replicas),
+        reward=reward.astype(jnp.float32),
+        done=done,
+        chosen_cloud=replicas,
+        step=new_step,
+    )
+    return new_state, ts

@@ -13,6 +13,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from rl_scheduler_tpu.env.bundle import make_autoreset
 from rl_scheduler_tpu.env.core import EnvParams, EnvState, TimeStep, reset, step
 
 
@@ -29,18 +30,13 @@ def step_autoreset(
 
     The returned ``TimeStep`` carries the terminal reward/done of the
     finishing episode, while ``obs``/state roll into the next episode when
-    done — the standard auto-reset contract for scan-collected rollouts.
+    done — the auto-reset contract (implemented once in
+    :func:`rl_scheduler_tpu.env.bundle.make_autoreset`).
     """
-    new_state, ts = step(params, state, action)
-    reset_key, carry_key = jax.random.split(new_state.key)
-    reset_state, reset_obs = reset(params, reset_key)
-    # Thread the carry key through so reset envs keep fresh randomness.
-    reset_state = EnvState(step_idx=reset_state.step_idx, key=carry_key)
-    out_state = jax.tree.map(
-        lambda r, n: jnp.where(ts.done, r, n), reset_state, new_state
+    fn = make_autoreset(
+        lambda key: reset(params, key), lambda st, a: step(params, st, a)
     )
-    out_obs = jnp.where(ts.done, reset_obs, ts.obs)
-    return out_state, ts._replace(obs=out_obs)
+    return fn(state, action)
 
 
 step_autoreset_batch = jax.vmap(step_autoreset, in_axes=(None, 0, 0))

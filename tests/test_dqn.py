@@ -1,0 +1,144 @@
+"""DQN trainer: replay buffer semantics, update mechanics, learning smoke."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_scheduler_tpu.agent.dqn import (
+    DQNConfig,
+    buffer_add,
+    buffer_init,
+    buffer_sample,
+    dqn_train,
+    epsilon_by_step,
+    make_dqn,
+)
+from rl_scheduler_tpu.config import EnvConfig
+from rl_scheduler_tpu.env import core as env_core
+from rl_scheduler_tpu.env.bundle import multi_cloud_bundle, single_cluster_bundle
+
+
+def _batch(n, obs_dim=3, base=0.0):
+    return {
+        "obs": jnp.full((n, obs_dim), base, jnp.float32),
+        "action": jnp.arange(n, dtype=jnp.int32) % 2,
+        "reward": base + jnp.arange(n, dtype=jnp.float32),
+        "done": jnp.zeros(n, jnp.float32),
+        "next_obs": jnp.full((n, obs_dim), base + 1.0, jnp.float32),
+    }
+
+
+class TestReplayBuffer:
+    def test_add_and_size(self):
+        buf = buffer_init(8, (3,))
+        buf = buffer_add(buf, _batch(4))
+        assert int(buf.size) == 4 and int(buf.pos) == 4
+        buf = buffer_add(buf, _batch(4, base=10.0))
+        assert int(buf.size) == 8 and int(buf.pos) == 0
+
+    def test_circular_overwrite(self):
+        buf = buffer_init(4, (3,))
+        buf = buffer_add(buf, _batch(4, base=0.0))
+        buf = buffer_add(buf, _batch(2, base=100.0))
+        # Oldest two entries overwritten; size capped at capacity.
+        assert int(buf.size) == 4 and int(buf.pos) == 2
+        np.testing.assert_allclose(np.asarray(buf.reward), [100.0, 101.0, 2.0, 3.0])
+
+    def test_sample_within_valid_range(self):
+        buf = buffer_init(100, (3,))
+        buf = buffer_add(buf, _batch(10, base=5.0))
+        s = buffer_sample(buf, jax.random.PRNGKey(0), 64)
+        # All sampled rewards must come from the 10 valid entries [5, 15).
+        r = np.asarray(s["reward"])
+        assert r.min() >= 5.0 and r.max() < 15.0
+
+
+def test_epsilon_schedule():
+    cfg = DQNConfig(epsilon_start=1.0, epsilon_end=0.1, epsilon_decay_steps=100)
+    assert float(epsilon_by_step(cfg, jnp.asarray(0))) == pytest.approx(1.0)
+    assert float(epsilon_by_step(cfg, jnp.asarray(50))) == pytest.approx(0.55)
+    assert float(epsilon_by_step(cfg, jnp.asarray(1000))) == pytest.approx(0.1)
+
+
+def test_update_runs_and_counts(cloud_table):
+    bundle = multi_cloud_bundle(env_core.make_params(EnvConfig(), cloud_table))
+    cfg = DQNConfig(num_envs=4, collect_steps=3, buffer_size=64, batch_size=8,
+                    learning_starts=8, hidden=(16,))
+    init_fn, update_fn, _ = make_dqn(bundle, cfg)
+    runner = jax.jit(init_fn)(jax.random.PRNGKey(0))
+    update = jax.jit(update_fn)
+    runner, m1 = update(runner)
+    assert int(runner.env_steps) == 12
+    assert int(runner.buffer.size) == 12
+    runner, m2 = update(runner)
+    assert int(runner.env_steps) == 24
+    # Past learning_starts the loss must be live (finite, generally nonzero).
+    assert np.isfinite(float(m2["loss"]))
+    assert float(m2["epsilon"]) < float(m1["epsilon"]) or cfg.epsilon_decay_steps == 0
+
+
+def test_target_network_soft_update(cloud_table):
+    bundle = multi_cloud_bundle(env_core.make_params(EnvConfig(), cloud_table))
+    cfg = DQNConfig(num_envs=2, collect_steps=2, buffer_size=32, batch_size=4,
+                    learning_starts=4, target_tau=0.5, hidden=(8,))
+    init_fn, update_fn, _ = make_dqn(bundle, cfg)
+    runner = jax.jit(init_fn)(jax.random.PRNGKey(1))
+    leaves0 = jax.tree.leaves(runner.target_params)
+    update = jax.jit(update_fn)
+    runner, _ = update(runner)
+    runner, _ = update(runner)
+    leaves1 = jax.tree.leaves(runner.target_params)
+    # After learning begins, the target must have moved toward the online net.
+    assert any(not np.allclose(a, b) for a, b in zip(leaves0, leaves1))
+
+
+def test_dqn_learns_cheaper_cloud(cloud_table):
+    """Convergence smoke: on the corrected-reward multi-cloud env the greedy
+    Q-policy should clearly beat the worst-case policy after a short run.
+
+    Placement here is myopic (the chosen cloud only affects this step's
+    reward), so a low gamma converges sharply in a smoke-test budget where
+    gamma=0.99's huge value targets would need far more iterations.
+    """
+    params = env_core.make_params(EnvConfig(), cloud_table)
+    bundle = multi_cloud_bundle(params)
+    cfg = DQNConfig(
+        num_envs=16, collect_steps=8, buffer_size=4096, batch_size=128,
+        learning_starts=256, epsilon_decay_steps=2000, lr=3e-3, gamma=0.3,
+        hidden=(32, 32),
+    )
+    runner, history = dqn_train(bundle, cfg, num_iterations=60, seed=0)
+
+    net_apply = make_dqn(bundle, cfg)[2].apply
+
+    def eval_policy(policy_fn):
+        st, obs = bundle.reset_batch(jax.random.PRNGKey(99), 32)
+        total = jnp.zeros(32)
+        for _ in range(int(params.max_steps)):
+            a = policy_fn(obs)
+            st, ts = bundle.step_batch(st, a)
+            total = total + ts.reward
+            obs = ts.obs
+        return float(jnp.mean(total))
+
+    greedy = eval_policy(
+        jax.jit(lambda o: jnp.argmax(net_apply(runner.params, o), -1).astype(jnp.int32))
+    )
+    # Always-worst policy: pick the higher-cost cloud every step.
+    worst = eval_policy(
+        jax.jit(lambda o: jnp.where(o[..., 0] > o[..., 1], 0, 1).astype(jnp.int32))
+    )
+    # Robust margin: the trained policy recovers a large part of the
+    # worst-to-best gap (~2350 on this table), not a seed-lucky epsilon.
+    assert greedy > worst + 500.0
+
+
+def test_dqn_on_single_cluster_env():
+    """BASELINE config 1 wiring: 1 env, 2-layer MLP, CPU."""
+    bundle = single_cluster_bundle()
+    cfg = DQNConfig(num_envs=1, collect_steps=4, buffer_size=512, batch_size=16,
+                    learning_starts=32, hidden=(64, 64))
+    runner, history = dqn_train(bundle, cfg, num_iterations=12, seed=3)
+    assert int(runner.env_steps) == 12 * 4
+    assert all(np.isfinite(h["loss"]) for h in history)

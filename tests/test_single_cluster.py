@@ -1,0 +1,104 @@
+"""Single-cluster autoscaling env (BASELINE config 1)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from rl_scheduler_tpu.config import SingleClusterConfig
+from rl_scheduler_tpu.env import single_cluster as sc
+from rl_scheduler_tpu.env.bundle import single_cluster_bundle
+
+
+@pytest.fixture(scope="module")
+def params():
+    return sc.make_params(SingleClusterConfig())
+
+
+def test_reset_shape_and_determinism(params):
+    key = jax.random.PRNGKey(0)
+    state, obs = sc.reset(params, key)
+    assert obs.shape == (sc.OBS_DIM,)
+    assert int(state.step_idx) == 0
+    assert 1 <= int(state.replicas) <= int(params.max_replicas)
+    state2, obs2 = sc.reset(params, key)
+    assert jnp.array_equal(obs, obs2)
+
+
+def test_step_replica_dynamics(params):
+    state, _ = sc.reset(params, jax.random.PRNGKey(0))
+    r0 = int(state.replicas)
+    state_up, _ = sc.step(params, state, jnp.asarray(2))
+    assert int(state_up.replicas) == r0 + 1
+    state_dn, _ = sc.step(params, state, jnp.asarray(0))
+    assert int(state_dn.replicas) == r0 - 1
+    state_hold, _ = sc.step(params, state, jnp.asarray(1))
+    assert int(state_hold.replicas) == r0
+
+
+def test_replicas_clipped_to_bounds(params):
+    state, _ = sc.reset(params, jax.random.PRNGKey(0))
+    # Scale down far past the floor.
+    for _ in range(int(params.max_replicas) + 3):
+        state, _ = sc.step(params, state, jnp.asarray(0))
+    assert int(state.replicas) == 1
+    for _ in range(2 * int(params.max_replicas)):
+        state, ts = sc.step(params, state, jnp.asarray(2))
+    assert int(state.replicas) == int(params.max_replicas)
+
+
+def test_reward_negative_and_overload_penalized(params):
+    """More replicas under high load -> less latency penalty."""
+    state, _ = sc.reset(params, jax.random.PRNGKey(0))
+    # Find the trace row with max load (users), step to just before it.
+    load = params.trace[:, 0]
+    hot = int(jnp.argmax(load))
+    if hot == 0:
+        hot = 1
+    state = state._replace(step_idx=jnp.asarray(hot, jnp.int32))
+
+    lo = state._replace(replicas=jnp.asarray(1, jnp.int32))
+    hi = state._replace(replicas=params.max_replicas - 1)
+    _, ts_lo = sc.step(params, lo, jnp.asarray(1))
+    _, ts_hi = sc.step(params, hi, jnp.asarray(1))
+    assert float(ts_lo.reward) <= 0.0
+    assert float(ts_hi.reward) <= 0.0
+    # At max load, underprovisioning must hurt more than the replica cost
+    # of (near-)full provisioning.
+    assert float(ts_hi.reward) > float(ts_lo.reward)
+
+
+def test_done_at_max_steps(params):
+    state, _ = sc.reset(params, jax.random.PRNGKey(0))
+    t = int(params.max_steps)
+    for i in range(t):
+        state, ts = sc.step(params, state, jnp.asarray(1))
+    assert bool(ts.done)
+
+
+def test_bundle_vmap_matches_single(params):
+    bundle = single_cluster_bundle(params)
+    key = jax.random.PRNGKey(7)
+    n = 5
+    state, obs = bundle.reset_batch(key, n)
+    assert obs.shape == (n, sc.OBS_DIM)
+    actions = jnp.asarray([0, 1, 2, 1, 0], jnp.int32)
+    state2, ts = bundle.step_batch(state, actions)
+    # Env 2 scaled up, env 0 scaled down, relative to the shared initial count.
+    r0 = int(jnp.maximum(params.max_replicas // 2, 1))
+    assert int(state2.replicas[0]) == r0 - 1
+    assert int(state2.replicas[2]) == r0 + 1
+    # Single-env step from the same per-env state gives identical results.
+    single_state = jax.tree.map(lambda x: x[3], state)
+    _, ts_single = sc.step(params, single_state, actions[3])
+    assert jnp.allclose(ts_single.reward, ts.reward[3])
+
+
+def test_autoreset_restarts_episode(params):
+    bundle = single_cluster_bundle(params)
+    state, obs = bundle.reset_batch(jax.random.PRNGKey(0), 2)
+    t = int(params.max_steps)
+    for _ in range(t):
+        state, ts = bundle.step_batch(state, jnp.ones(2, jnp.int32))
+    assert bool(ts.done[0])
+    # After the terminal step the carried state restarted at row 0.
+    assert int(state.step_idx[0]) == 0
