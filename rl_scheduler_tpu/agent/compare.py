@@ -1,0 +1,148 @@
+"""Train-vs-baseline comparison harness (reference ``train_and_compare.py``).
+
+The reference trains PPO for 5 iterations, runs a round-robin baseline for 5
+episodes, prints a side-by-side table, and saves a matplotlib reward plot
+(``train_and_compare.py:43-90``). Same deliverables here, with the baselines
+evaluated exactly (they are deterministic functions of the data table) and
+the trained policy evaluated greedily over a vmapped episode batch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+import jax
+
+from rl_scheduler_tpu.agent.evaluate import (
+    BASELINE_POLICIES,
+    baseline_episode_cost,
+    evaluate,
+    greedy_policy_fn,
+)
+from rl_scheduler_tpu.agent.ppo import ppo_train
+from rl_scheduler_tpu.agent.presets import PPO_PRESETS
+from rl_scheduler_tpu.config import EnvConfig
+from rl_scheduler_tpu.env import core as env_core
+from rl_scheduler_tpu.models import ActorCritic
+
+
+def compare(
+    env_config: EnvConfig | None = None,
+    preset: str = "quick",
+    iterations: int = 5,
+    episodes: int = 100,
+    seed: int = 0,
+    log_fn=print,
+):
+    """Train PPO, evaluate against baselines; returns a results dict."""
+    env_config = env_config or EnvConfig()
+    env_params = env_core.make_params(env_config)
+    cfg = PPO_PRESETS[preset]
+
+    history: list[dict] = []
+
+    def train_log(i, metrics):
+        history.append(metrics)
+        log_fn(
+            f"Iteration {i + 1}/{iterations}: "
+            f"reward_mean={metrics['episode_reward_mean']:.2f}"
+        )
+
+    runner, _ = ppo_train(env_params, cfg, iterations, seed=seed, log_fn=train_log)
+
+    net = ActorCritic(num_actions=env_core.NUM_ACTIONS, hidden=cfg.hidden)
+    ppo_report = evaluate(
+        env_params, greedy_policy_fn(net, runner.params), episodes, seed
+    )
+    random_report = evaluate(
+        env_params, BASELINE_POLICIES["random"], episodes, seed
+    )
+
+    results = {
+        "ppo": {
+            "episode_cost": ppo_report.avg_episode_cost,
+            "episode_reward": ppo_report.avg_episode_reward,
+            "choice_fractions": list(ppo_report.choice_fractions),
+        },
+        "cost_greedy": {"episode_cost": baseline_episode_cost(env_params, "greedy")},
+        "round_robin": {"episode_cost": baseline_episode_cost(env_params, "round_robin")},
+        "random": {"episode_cost": random_report.avg_episode_cost},
+        "reward_curve": [m["episode_reward_mean"] for m in history],
+    }
+    return results, runner
+
+
+def format_table(results: dict) -> str:
+    rows = [
+        ("PPO (trained, greedy)", results["ppo"]["episode_cost"]),
+        ("Cost-greedy baseline", results["cost_greedy"]["episode_cost"]),
+        ("Round-robin baseline", results["round_robin"]["episode_cost"]),
+        ("Random baseline", results["random"]["episode_cost"]),
+    ]
+    best = min(cost for _, cost in rows)
+    lines = [
+        f"{'Policy':<24} {'Episode cost':>14} {'vs best':>10}",
+        "-" * 50,
+    ]
+    for name, cost in rows:
+        delta = (cost - best) / best * 100.0 if best else 0.0
+        marker = "  <-- best" if cost == best else f"  +{delta:.1f}%"
+        lines.append(f"{name:<24} {cost:>14.3f}{marker}")
+    return "\n".join(lines)
+
+
+def save_plot(results: dict, path: str | Path) -> bool:
+    """Reward-curve plot (reference ``train_and_compare.py:82-90``); returns
+    False when matplotlib is unavailable (headless-safe)."""
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        return False
+    curve = results["reward_curve"]
+    fig, ax = plt.subplots(figsize=(8, 5))
+    ax.plot(range(1, len(curve) + 1), curve, marker="o", label="PPO reward mean")
+    ax.set_xlabel("Training iteration")
+    ax.set_ylabel("Episode reward mean")
+    ax.set_title("PPO training vs baselines (multi-cloud scheduling)")
+    ax.legend()
+    ax.grid(alpha=0.3)
+    fig.savefig(path, dpi=120, bbox_inches="tight")
+    plt.close(fig)
+    return True
+
+
+def main(argv: list[str] | None = None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--preset", default="quick", choices=sorted(PPO_PRESETS))
+    p.add_argument("--iterations", type=int, default=5)
+    p.add_argument("--episodes", type=int, default=100)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--results-dir", default="results")
+    p.add_argument("--legacy-reward-sign", action="store_true")
+    args = p.parse_args(argv)
+
+    print(f"Training PPO ({args.preset}, {args.iterations} iterations) on "
+          f"{jax.devices()[0].platform}...")
+    results, _ = compare(
+        EnvConfig(legacy_reward_sign=args.legacy_reward_sign),
+        args.preset, args.iterations, args.episodes, args.seed,
+    )
+    print()
+    print(format_table(results))
+
+    out = Path(args.results_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    (out / "comparison.json").write_text(json.dumps(results, indent=2) + "\n")
+    if save_plot(results, out / "reward_comparison.png"):
+        print(f"\nPlot saved to {out}/reward_comparison.png")
+    print(f"Results saved to {out}/comparison.json")
+    return results
+
+
+if __name__ == "__main__":
+    main()

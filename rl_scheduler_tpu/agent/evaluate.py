@@ -1,0 +1,273 @@
+"""Policy evaluation + final report (reference parity, vectorized).
+
+Re-designs the reference's evaluation stack for TPU:
+
+- ``final_evaluation.py:13-27`` walks ``~/ray_results`` for the newest
+  checkpoint; here :func:`rl_scheduler_tpu.utils.checkpoint.find_latest_run`
+  does the same over our run root.
+- ``final_evaluation.py:42-55`` runs 100 greedy episodes one
+  ``compute_single_action`` at a time (~10k sequential host round-trips);
+  here the 100 episodes are a vmapped batch — one ``lax.scan`` over 99 steps
+  evaluates all episodes in a single XLA program.
+- ``final_evaluation.py:60-84`` aggregates cost (= |reward|), AWS/Azure
+  choice percentages, and improvement vs the cost-greedy baseline, writing
+  ``results/final_evaluation_summary.txt``. Same artifacts here, except the
+  baseline cost is *computed* from the table rather than hardcoded ($4.765,
+  ``final_evaluation.py:73``) — the constant is kept for cross-checking.
+- ``eval_ppo.py:17-31`` (20-step per-step printout) is :func:`quick_eval`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from pathlib import Path
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from rl_scheduler_tpu.config import EnvConfig, RuntimeConfig
+from rl_scheduler_tpu.env import core as env_core
+from rl_scheduler_tpu.env.baselines import (
+    cost_greedy_policy,
+    random_policy,
+    round_robin_policy,
+)
+from rl_scheduler_tpu.env.vector import reset_batch, rollout_from
+from rl_scheduler_tpu.models import ActorCritic
+
+# The reference's hardcoded eval anchor (final_evaluation.py:73), kept only
+# to report alongside the computed baseline.
+REFERENCE_BASELINE_COST = 4.765
+
+CLOUD_NAMES = ("AWS", "Azure")
+
+
+@dataclasses.dataclass(frozen=True)
+class EvalReport:
+    """Aggregate results of a greedy evaluation run."""
+
+    num_episodes: int
+    avg_episode_reward: float
+    avg_episode_cost: float        # |weighted cost+latency| per episode, >= 0
+    choice_fractions: tuple        # fraction of decisions per cloud
+    avg_episode_length: float
+    baseline_cost: float           # cost-greedy baseline on the same table
+    improvement_pct: float         # vs computed baseline (positive = better)
+
+    def summary(self) -> str:
+        lines = [
+            "=" * 60,
+            "FINAL EVALUATION SUMMARY",
+            "=" * 60,
+            f"Episodes evaluated:       {self.num_episodes}",
+            f"Average episode reward:   {self.avg_episode_reward:.3f}",
+            f"Average episode cost:     ${self.avg_episode_cost:.3f}",
+            f"Cost-greedy baseline:     ${self.baseline_cost:.3f}"
+            f" (reference constant: ${REFERENCE_BASELINE_COST})",
+            f"Improvement vs baseline:  {self.improvement_pct:+.2f}%",
+            "Cloud choice split:       "
+            + ", ".join(
+                f"{name} {frac * 100:.1f}%"
+                for name, frac in zip(CLOUD_NAMES, self.choice_fractions)
+            ),
+            f"Average episode length:   {self.avg_episode_length:.1f}",
+            "=" * 60,
+        ]
+        return "\n".join(lines)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def greedy_policy_fn(net, params) -> Callable:
+    """Deterministic (explore=False) policy: argmax over logits."""
+
+    def policy(obs, key):
+        logits, _ = net.apply(params, obs)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    return policy
+
+
+def _episode_cost(params: env_core.EnvParams, ep_reward: jnp.ndarray) -> jnp.ndarray:
+    """Positive weighted cost+latency total, independent of the reward sign
+    convention (the reference conflates the two: ``cost = -reward`` at
+    ``final_evaluation.py:60`` on a *positive* reward)."""
+    return ep_reward * params.reward_sign
+
+
+def run_episodes(
+    env_params: env_core.EnvParams,
+    policy_fn: Callable,
+    num_episodes: int,
+    seed: int = 0,
+):
+    """Run ``num_episodes`` full episodes in parallel (one scan, no resets).
+
+    Returns ``(episode_rewards [E], action_counts [E, C], lengths [E])``.
+    Episodes are fixed-length (``max_steps``), matching the reference's CSV
+    replay semantics, so a single scan of ``max_steps`` covers exactly one
+    episode per batch lane.
+    """
+    max_steps = int(env_params.max_steps)
+
+    @jax.jit
+    def _run(key):
+        reset_key, rollout_key = jax.random.split(key)
+        state, obs = reset_batch(env_params, reset_key, num_episodes)
+        _, _, _, traj = rollout_from(
+            env_params, state, obs, rollout_key, policy_fn, max_steps
+        )
+        ep_rewards = traj["reward"].sum(axis=0)          # [E]
+        actions = traj["action"]                          # [T, E]
+        counts = jnp.stack(
+            [(actions == c).sum(axis=0) for c in range(env_core.NUM_ACTIONS)],
+            axis=-1,
+        )                                                 # [E, C]
+        lengths = jnp.full((num_episodes,), max_steps, jnp.int32)
+        return ep_rewards, counts, lengths
+
+    return _run(jax.random.PRNGKey(seed))
+
+
+def baseline_episode_cost(env_params: env_core.EnvParams, policy: str = "greedy") -> float:
+    """Exact episode cost of a deterministic baseline on the table (no RNG
+    needed: cost-greedy and round-robin depend only on the table rows)."""
+    steps = jnp.arange(int(env_params.max_steps))
+    costs = env_params.costs[steps]
+    lats = env_params.latencies[steps]
+    if policy == "greedy":
+        acts = cost_greedy_policy(costs)
+    elif policy == "round_robin":
+        acts = round_robin_policy(steps)
+    else:
+        raise ValueError(policy)
+    chosen_cost = jnp.take_along_axis(costs, acts[:, None], axis=1)[:, 0]
+    chosen_lat = jnp.take_along_axis(lats, acts[:, None], axis=1)[:, 0]
+    per_step = env_params.reward_scale * (
+        env_params.cost_weight * chosen_cost + env_params.latency_weight * chosen_lat
+    )
+    return float(per_step.sum())
+
+
+def evaluate(
+    env_params: env_core.EnvParams,
+    policy_fn: Callable,
+    num_episodes: int = 100,
+    seed: int = 0,
+) -> EvalReport:
+    """Greedy evaluation + aggregate report (final_evaluation.py parity)."""
+    ep_rewards, counts, lengths = run_episodes(
+        env_params, policy_fn, num_episodes, seed
+    )
+    avg_reward = float(ep_rewards.mean())
+    avg_cost = float(_episode_cost(env_params, ep_rewards).mean())
+    total = counts.sum()
+    fractions = tuple(float(c) for c in counts.sum(axis=0) / jnp.maximum(total, 1))
+    if float(env_params.fault_prob) > 0.0:
+        # Fault injection perturbs rewards stochastically; the closed-form
+        # table baseline would not be comparable. Run the greedy baseline
+        # through the same faulted env instead (different key stream).
+        base_rewards, _, _ = run_episodes(
+            env_params, BASELINE_POLICIES["greedy"], num_episodes, seed + 1
+        )
+        baseline = float(_episode_cost(env_params, base_rewards).mean())
+    else:
+        baseline = baseline_episode_cost(env_params, "greedy")
+    improvement = (baseline - avg_cost) / baseline * 100.0 if baseline else 0.0
+    return EvalReport(
+        num_episodes=num_episodes,
+        avg_episode_reward=avg_reward,
+        avg_episode_cost=avg_cost,
+        choice_fractions=fractions,
+        avg_episode_length=float(lengths.mean()),
+        baseline_cost=baseline,
+        improvement_pct=improvement,
+    )
+
+
+def quick_eval(
+    env_params: env_core.EnvParams,
+    net,
+    params,
+    num_steps: int = 20,
+    seed: int = 0,
+    print_fn: Callable = print,
+) -> float:
+    """Per-step sanity rollout (reference ``eval_ppo.py:17-31``): greedy
+    actions, printed cloud choice / reward / CPU observation per step."""
+    policy = greedy_policy_fn(net, params)
+    key = jax.random.PRNGKey(seed)
+    state, obs = env_core.reset(env_params, key)
+    total = 0.0
+    for t in range(num_steps):
+        action = int(policy(obs[None, :], None)[0])
+        state, ts = env_core.step(env_params, state, jnp.asarray(action))
+        total += float(ts.reward)
+        print_fn(
+            f"Step {t + 1:2d}: cloud={CLOUD_NAMES[action]:5s} "
+            f"reward={float(ts.reward):8.3f} cpu={obs[4]:.2f}/{obs[5]:.2f}"
+        )
+        obs = ts.obs
+        if bool(ts.done):
+            break
+    print_fn(f"Total reward over {t + 1} steps: {total:.3f}")
+    return total
+
+
+BASELINE_POLICIES = {
+    "greedy": lambda obs, key: cost_greedy_policy(obs),
+    "random": lambda obs, key: random_policy(key, obs.shape[:-1]),
+}
+
+
+def main(argv: list[str] | None = None) -> EvalReport:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--run", default=None,
+                   help="run directory (default: auto-discover newest)")
+    p.add_argument("--run-root", default=RuntimeConfig().checkpoint_dir)
+    p.add_argument("--episodes", type=int, default=100)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--quick", action="store_true",
+                   help="20-step per-step printout (eval_ppo.py parity)")
+    p.add_argument("--baseline", choices=sorted(BASELINE_POLICIES), default=None,
+                   help="evaluate a built-in baseline instead of a checkpoint")
+    p.add_argument("--results-dir", default="results")
+    args = p.parse_args(argv)
+
+    if args.baseline is not None:
+        env_params = env_core.make_params(EnvConfig())
+        policy = BASELINE_POLICIES[args.baseline]
+    else:
+        from rl_scheduler_tpu.utils.checkpoint import find_latest_run, load_policy_params
+
+        run_dir = Path(args.run) if args.run else find_latest_run(args.run_root)
+        print(f"Using checkpoint run: {run_dir}")
+        params, meta = load_policy_params(run_dir)
+        env_params = env_core.make_params(
+            EnvConfig(legacy_reward_sign=bool(meta.get("legacy_reward_sign", False)))
+        )
+        hidden = tuple(meta.get("hidden", (256, 256)))
+        net = ActorCritic(num_actions=env_core.NUM_ACTIONS, hidden=hidden)
+        if args.quick:
+            quick_eval(env_params, net, params)
+        policy = greedy_policy_fn(net, params)
+
+    report = evaluate(env_params, policy, args.episodes, args.seed)
+    print(report.summary())
+
+    results_dir = Path(args.results_dir)
+    results_dir.mkdir(parents=True, exist_ok=True)
+    (results_dir / "final_evaluation_summary.txt").write_text(report.summary() + "\n")
+    (results_dir / "final_evaluation_summary.json").write_text(
+        json.dumps(report.to_json(), indent=2) + "\n"
+    )
+    print(f"Report written to {results_dir}/final_evaluation_summary.txt")
+    return report
+
+
+if __name__ == "__main__":
+    main()

@@ -70,6 +70,7 @@ def main(argv: list[str] | None = None) -> Path:
         if (i + 1) % args.checkpoint_every == 0 or (i + 1) == args.iterations:
             ckpt.save(i + 1, {"params": runner.params, "opt_state": runner.opt_state},
                       extras={"preset": args.preset,
+                              "hidden": list(cfg.hidden),
                               "legacy_reward_sign": args.legacy_reward_sign})
 
     print(f"Training PPO preset={args.preset} on {jax.devices()[0].platform} "

@@ -1,0 +1,124 @@
+"""Evaluation + comparison harness (reference final_evaluation / compare parity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_scheduler_tpu.agent.evaluate import (
+    BASELINE_POLICIES,
+    EvalReport,
+    baseline_episode_cost,
+    evaluate,
+    greedy_policy_fn,
+    quick_eval,
+    run_episodes,
+)
+from rl_scheduler_tpu.config import EnvConfig
+from rl_scheduler_tpu.env import core as env_core
+from rl_scheduler_tpu.models import ActorCritic
+
+
+@pytest.fixture(scope="module")
+def env_params():
+    return env_core.make_params(EnvConfig())
+
+
+def test_baseline_cost_matches_manual_computation(env_params):
+    """Cost-greedy baseline cost equals a hand-rolled numpy computation."""
+    costs = np.asarray(env_params.costs)[:99]
+    lats = np.asarray(env_params.latencies)[:99]
+    acts = np.where(costs[:, 0] <= costs[:, 1], 0, 1)
+    expected = (
+        100.0
+        * (0.6 * costs[np.arange(99), acts] + 0.4 * lats[np.arange(99), acts])
+    ).sum()
+    assert baseline_episode_cost(env_params, "greedy") == pytest.approx(
+        expected, rel=1e-5
+    )
+
+
+def test_round_robin_cost_differs_from_greedy(env_params):
+    rr = baseline_episode_cost(env_params, "round_robin")
+    g = baseline_episode_cost(env_params, "greedy")
+    assert rr != pytest.approx(g, rel=1e-3)
+
+
+def test_run_episodes_shapes_and_determinism(env_params):
+    policy = BASELINE_POLICIES["greedy"]
+    r1, c1, l1 = run_episodes(env_params, policy, num_episodes=8, seed=0)
+    r2, c2, l2 = run_episodes(env_params, policy, num_episodes=8, seed=0)
+    assert r1.shape == (8,)
+    assert c1.shape == (8, env_core.NUM_ACTIONS)
+    np.testing.assert_allclose(np.asarray(r1), np.asarray(r2))
+    # greedy baseline is data-deterministic: all episodes identical reward
+    assert float(r1.std()) == pytest.approx(0.0, abs=1e-2)
+    # every step takes exactly one action
+    assert int(c1.sum()) == 8 * int(env_params.max_steps)
+    assert int(l1[0]) == int(env_params.max_steps)
+
+
+def test_evaluate_greedy_baseline_zero_improvement(env_params):
+    """Evaluating the cost-greedy policy must report ~0% improvement over the
+    cost-greedy baseline (self-comparison sanity)."""
+    report = evaluate(env_params, BASELINE_POLICIES["greedy"], num_episodes=4)
+    assert isinstance(report, EvalReport)
+    assert report.improvement_pct == pytest.approx(0.0, abs=0.1)
+    assert sum(report.choice_fractions) == pytest.approx(1.0)
+    # corrected reward sign: reward = -cost
+    assert report.avg_episode_reward == pytest.approx(-report.avg_episode_cost, rel=1e-5)
+
+
+def test_evaluate_legacy_sign_cost_still_positive():
+    params = env_core.make_params(EnvConfig(legacy_reward_sign=True))
+    report = evaluate(params, BASELINE_POLICIES["greedy"], num_episodes=4)
+    assert report.avg_episode_cost > 0
+    assert report.avg_episode_reward == pytest.approx(report.avg_episode_cost, rel=1e-5)
+
+
+def test_evaluate_with_fault_injection_uses_matched_baseline():
+    """With fault_prob>0 the baseline must come from the same faulted env, so
+    greedy-vs-greedy improvement stays near zero (not wildly skewed)."""
+    params = env_core.make_params(EnvConfig(fault_prob=0.2))
+    report = evaluate(params, BASELINE_POLICIES["greedy"], num_episodes=16)
+    assert abs(report.improvement_pct) < 5.0
+
+
+def test_evaluate_untrained_policy_and_quick_eval(env_params):
+    net = ActorCritic(num_actions=env_core.NUM_ACTIONS, hidden=(32, 32))
+    params = net.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, env_core.OBS_DIM), jnp.float32)
+    )
+    report = evaluate(env_params, greedy_policy_fn(net, params), num_episodes=4)
+    assert np.isfinite(report.avg_episode_cost)
+    lines = []
+    total = quick_eval(env_params, net, params, num_steps=5, print_fn=lines.append)
+    assert len(lines) == 6  # 5 steps + total line
+    assert "Total reward" in lines[-1]
+    assert np.isfinite(total)
+
+
+def test_report_summary_contains_key_fields(env_params):
+    report = evaluate(env_params, BASELINE_POLICIES["greedy"], num_episodes=2)
+    text = report.summary()
+    assert "FINAL EVALUATION SUMMARY" in text
+    assert "Improvement vs baseline" in text
+    assert "AWS" in text and "Azure" in text
+    js = report.to_json()
+    assert js["num_episodes"] == 2
+
+
+def test_compare_harness_end_to_end(env_params, tmp_path):
+    """Short compare run: table formats, results serialize, PPO entry present."""
+    from rl_scheduler_tpu.agent.compare import compare, format_table, save_plot
+
+    results, _ = compare(
+        EnvConfig(), preset="quick", iterations=1, episodes=2, log_fn=lambda *_: None
+    )
+    for k in ("ppo", "cost_greedy", "round_robin", "random", "reward_curve"):
+        assert k in results
+    table = format_table(results)
+    assert "PPO (trained, greedy)" in table and "best" in table
+    assert len(results["reward_curve"]) == 1
+    # plot is optional (matplotlib may be absent); must not raise either way
+    save_plot(results, tmp_path / "plot.png")
