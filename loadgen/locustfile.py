@@ -1,0 +1,30 @@
+"""Locust load generator against the nginx workload.
+
+Reference parity: rl-k8s-scheduler ``locustfile.py:1-9`` — each simulated
+user GETs ``/`` every 1-3 s. Its CSV exports feed the data pipeline
+(``rl_scheduler_tpu/data/normalize.py`` consumes the stats files the same
+way the reference's ``normalize_data.py:9-15`` does).
+
+Run (against the aws cluster's NodePort):
+    locust -f loadgen/locustfile.py --host http://localhost:30000 \
+        --headless -u 20 -r 5 --run-time 2m --csv data/local_aws_load
+"""
+
+try:
+    from locust import HttpUser, between, task
+except ImportError:  # locust is optional; the pipeline falls back to
+    HttpUser = object  # synthetic load history (data/loader.py).
+
+    def task(f):
+        return f
+
+    def between(a, b):
+        return None
+
+
+class NginxUser(HttpUser):
+    wait_time = between(1, 3)
+
+    @task
+    def fetch_root(self):
+        self.client.get("/")

@@ -1,0 +1,338 @@
+"""Kubernetes scheduler-extender HTTP server serving the trained policy.
+
+Completes the reference's planned-but-empty L4 layer
+(``rl_scheduler/scheduler/extender.py`` — 0 bytes, ``scheduler-config.yaml``
+— 0 bytes): an HTTP webhook the default kube-scheduler calls per pod via
+the extender protocol, answering
+
+- ``POST /filter``     — ``ExtenderArgs`` -> ``ExtenderFilterResult``:
+  keeps only nodes on the cloud the policy picked (greedy argmax, the
+  reference's ``explore=False`` serving intent).
+- ``POST /prioritize`` — ``ExtenderArgs`` -> ``HostPriorityList``: scores
+  every candidate node 0-100 from the policy's softmax probabilities, so
+  the extender also works in soft (prioritize-only) deployments.
+- ``GET /healthz``     — liveness + backend name.
+- ``GET /stats``       — decision count, per-cloud split, latency
+  p50/p90/p99 in ms (the <1 ms p50 target is measured here).
+
+Node -> cloud mapping uses the ``cloud: aws|azure`` node labels that the
+kind cluster configs apply (reference ``aws-cluster-config.yaml:12-14``),
+falling back to substring matching on node names. Unknown-cloud nodes pass
+the filter untouched (fail-open: the extender must never wedge scheduling
+— SURVEY.md §5.3).
+
+The heavy lifting happens once at startup (checkpoint restore + AOT
+compile); per-request work is one telemetry read + one ``decide`` on a
+warm backend, so p50 stays well under 1 ms even for the ``jax`` backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from rl_scheduler_tpu.scheduler.policy_backend import make_backend
+from rl_scheduler_tpu.scheduler.telemetry import (
+    PrometheusCpu,
+    RandomCpu,
+    TableTelemetry,
+)
+
+logger = logging.getLogger(__name__)
+
+CLOUDS = ("aws", "azure")
+MAX_EXTENDER_SCORE = 100
+
+
+def node_cloud(node: dict | str) -> str | None:
+    """Cloud of a node from its ``cloud`` label, else name tokens.
+
+    The name fallback matches whole '-'/'.'-separated tokens only, so a
+    node named ``gateways-1`` is NOT classified as aws — unknown-cloud
+    nodes must pass the filter untouched.
+    """
+    if isinstance(node, dict):
+        labels = (node.get("metadata") or {}).get("labels") or {}
+        cloud = labels.get("cloud")
+        if cloud in CLOUDS:
+            return cloud
+        name = (node.get("metadata") or {}).get("name", "")
+    else:
+        name = node
+    tokens = re.split(r"[-._]", name.lower())
+    for cloud in CLOUDS:
+        if cloud in tokens:
+            return cloud
+    return None
+
+
+class LatencyStats:
+    """Thread-safe ring buffer of per-decision latencies."""
+
+    def __init__(self, capacity: int = 4096):
+        self._lat = np.zeros(capacity, np.float64)
+        self._n = 0
+        self._capacity = capacity
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._lat[self._n % self._capacity] = seconds
+            self._n += 1
+
+    def percentiles_ms(self) -> dict:
+        with self._lock:
+            n = min(self._n, self._capacity)
+            data = self._lat[:n].copy()
+        if n == 0:
+            return {"count": 0}
+        p50, p90, p99 = np.percentile(data, [50, 90, 99]) * 1e3
+        return {
+            "count": int(self._n),
+            "p50_ms": round(float(p50), 4),
+            "p90_ms": round(float(p90), 4),
+            "p99_ms": round(float(p99), 4),
+        }
+
+
+class ExtenderPolicy:
+    """Pure decision logic, independent of HTTP (unit-testable directly)."""
+
+    def __init__(self, backend, telemetry: TableTelemetry, placer=None):
+        self.backend = backend
+        self.telemetry = telemetry
+        self.placer = placer  # optional DryRunPodPlacer (slow-mode parity)
+        self.stats = LatencyStats()
+        self._decisions = {c: 0 for c in CLOUDS}
+        self._lock = threading.Lock()
+
+    def decide(self) -> tuple[int, np.ndarray, np.ndarray]:
+        """One placement decision: ``(action, probs, obs)``; timed."""
+        t0 = time.perf_counter()
+        obs = self.telemetry.observe()
+        action, logits = self.backend.decide(obs)
+        self.stats.record(time.perf_counter() - t0)
+        z = logits - logits.max()
+        probs = np.exp(z) / np.exp(z).sum()
+        with self._lock:
+            self._decisions[CLOUDS[action]] += 1
+        return action, probs, obs
+
+    def filter(self, args: dict) -> dict:
+        """ExtenderFilterResult: keep nodes on the chosen cloud; fail open."""
+        nodes = ((args.get("nodes") or {}).get("items")) or []
+        node_names = args.get("nodenames")
+        try:
+            action, _, _ = self.decide()
+        except Exception:  # never wedge scheduling: pass all nodes through.
+            # error stays "" — kube-scheduler treats a non-empty Error as a
+            # hard extender failure unless ignorable=true is configured.
+            logger.exception("policy decision failed; passing all nodes")
+            return self._passthrough(args)
+        chosen = CLOUDS[action]
+        if self.placer is not None:
+            self.placer.place(chosen)
+
+        failed: dict[str, str] = {}
+        if node_names is not None:
+            kept_names = []
+            for name in node_names:
+                cloud = node_cloud(name)
+                if cloud is None or cloud == chosen:
+                    kept_names.append(name)
+                else:
+                    failed[name] = f"policy selected {chosen}"
+            return {"nodenames": kept_names, "failedNodes": failed, "error": ""}
+        kept = []
+        for node in nodes:
+            cloud = node_cloud(node)
+            if cloud is None or cloud == chosen:
+                kept.append(node)
+            else:
+                name = (node.get("metadata") or {}).get("name", "?")
+                failed[name] = f"policy selected {chosen}"
+        return {
+            "nodes": {"items": kept},
+            "failedNodes": failed,
+            "error": "",
+        }
+
+    def prioritize(self, args: dict) -> list[dict]:
+        """HostPriorityList: score = policy probability of the node's cloud."""
+        nodes = ((args.get("nodes") or {}).get("items")) or []
+        names = args.get("nodenames") or [
+            (n.get("metadata") or {}).get("name", "?") for n in nodes
+        ]
+        clouds = (
+            [node_cloud(n) for n in names]
+            if not nodes
+            else [node_cloud(n) for n in nodes]
+        )
+        try:
+            _, probs, _ = self.decide()
+        except Exception:
+            logger.exception("policy decision failed; uniform priorities")
+            probs = np.full(len(CLOUDS), 1.0 / len(CLOUDS))
+        out = []
+        for name, cloud in zip(names, clouds):
+            if cloud is None:
+                score = MAX_EXTENDER_SCORE // 2
+            else:
+                score = int(round(float(probs[CLOUDS.index(cloud)]) * MAX_EXTENDER_SCORE))
+            out.append({"host": name, "score": score})
+        return out
+
+    @staticmethod
+    def _passthrough(args: dict) -> dict:
+        if args.get("nodenames") is not None:
+            return {"nodenames": args["nodenames"], "failedNodes": {}, "error": ""}
+        return {
+            "nodes": args.get("nodes") or {"items": []},
+            "failedNodes": {},
+            "error": "",
+        }
+
+    def health(self) -> dict:
+        return {"status": "ok", "backend": self.backend.name}
+
+    def statistics(self) -> dict:
+        with self._lock:
+            decisions = dict(self._decisions)
+        total = sum(decisions.values())
+        return {
+            "backend": self.backend.name,
+            "decisions": decisions,
+            "choice_fractions": {
+                c: (n / total if total else 0.0) for c, n in decisions.items()
+            },
+            "latency": self.stats.percentiles_ms(),
+        }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    policy: ExtenderPolicy  # set by make_server
+
+    def _send(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        if self.path == "/healthz":
+            self._send(200, self.policy.health())
+        elif self.path == "/stats":
+            self._send(200, self.policy.statistics())
+        else:
+            self._send(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):  # noqa: N802
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            args = json.loads(self.rfile.read(length) or b"{}")
+        except json.JSONDecodeError as exc:
+            self._send(400, {"error": f"bad json: {exc}"})
+            return
+        # Normalize extender-protocol field capitalization (Go marshals
+        # Nodes/NodeNames/Pod; be liberal in what we accept).
+        args = {k.lower(): v for k, v in args.items()}
+        if self.path == "/filter":
+            self._send(200, self.policy.filter(args))
+        elif self.path == "/prioritize":
+            self._send(200, self.policy.prioritize(args))
+        else:
+            self._send(404, {"error": f"unknown path {self.path}"})
+
+    def log_message(self, fmt, *log_args):  # quiet by default
+        logger.debug("%s " + fmt, self.address_string(), *log_args)
+
+
+def make_server(policy: ExtenderPolicy, host: str = "0.0.0.0", port: int = 8787):
+    handler = type("BoundHandler", (_Handler,), {"policy": policy})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def build_policy(
+    backend: str = "jax",
+    run: str | None = None,
+    run_root: str | None = None,
+    data_path: str | None = None,
+    prometheus: bool = False,
+    dry_run_place: bool = False,
+    cpu_seed: int | None = None,
+    serve_device: str = "cpu",
+) -> ExtenderPolicy:
+    """Assemble the serving stack: checkpoint -> backend -> telemetry."""
+    params_tree = None
+    hidden = (256, 256)
+    if backend != "greedy":
+        try:
+            from rl_scheduler_tpu.config import RuntimeConfig
+            from rl_scheduler_tpu.utils.checkpoint import (
+                find_latest_run,
+                load_policy_params,
+            )
+            from pathlib import Path
+
+            run_dir = (
+                Path(run) if run else find_latest_run(run_root or RuntimeConfig().checkpoint_dir)
+            )
+            params_tree, meta = load_policy_params(run_dir)
+            hidden = tuple(meta.get("hidden", hidden))
+            logger.info("serving checkpoint from %s", run_dir)
+        except Exception:  # corrupt/missing checkpoint must not keep the
+            # extender down — greedy fallback absorbs it (SURVEY.md §5.3).
+            logger.exception("checkpoint load failed; serving cost-greedy fallback")
+    backend_obj, _ = make_backend(backend, params_tree, hidden, serve_device)
+    cpu_source = PrometheusCpu() if prometheus else RandomCpu(seed=cpu_seed)
+    telemetry = TableTelemetry.from_table(data_path, cpu_source)
+    placer = None
+    if dry_run_place:
+        from rl_scheduler_tpu.scheduler.k8s_client import DryRunPodPlacer
+
+        placer = DryRunPodPlacer()
+    return ExtenderPolicy(backend_obj, telemetry, placer)
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--backend", default="jax", choices=("jax", "cpu", "torch", "greedy"))
+    p.add_argument("--run", default=None, help="checkpoint run dir")
+    p.add_argument("--run-root", default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8787)
+    p.add_argument("--serve-device", default="cpu",
+                   help="XLA device for the jax backend: cpu (default; "
+                        "single-obs serving is dispatch-bound) or tpu")
+    p.add_argument("--prometheus", action="store_true",
+                   help="query Prometheus for CPU telemetry (else random parity)")
+    p.add_argument("--dry-run-place", action="store_true",
+                   help="dry-run pod creation on the chosen kind cluster")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    policy = build_policy(
+        args.backend, args.run, args.run_root,
+        prometheus=args.prometheus, dry_run_place=args.dry_run_place,
+        serve_device=args.serve_device,
+    )
+    server = make_server(policy, args.host, args.port)
+    print(f"Scheduler extender serving on {args.host}:{args.port} "
+          f"(backend={policy.backend.name})", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""Host-side telemetry for serving-time observations.
+
+The env's observation is ``[cost_aws, cost_azure, lat_aws, lat_azure,
+cpu_aws, cpu_azure]``. At serving time the cost/latency half comes from the
+normalized pricing table (replayed just like training data), and the CPU
+half from a pluggable source:
+
+- ``RandomCpu``: uniform(0.1, 0.8) — exact parity with the reference's
+  ``_get_live_cpu`` placeholder (``k8s_multi_cloud_env.py:84-88``).
+- ``PrometheusCpu``: actually queries Prometheus for cluster CPU, which the
+  reference only stubbed (URLs at ``k8s_multi_cloud_env.py:32-33``, never
+  used). Falls back to ``RandomCpu`` per-request on any error.
+
+All of this is ordinary impure Python that stays outside jit; the policy
+backend only ever sees a finished numpy observation.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+PROMETHEUS_URLS = {  # reference parity defaults (k8s_multi_cloud_env.py:32-33)
+    "aws": "http://localhost:39090",
+    "azure": "http://localhost:39091",
+}
+
+
+class RandomCpu:
+    def __init__(self, low: float = 0.1, high: float = 0.8, seed: int | None = None):
+        self.low, self.high = low, high
+        self._rng = random.Random(seed)
+
+    def sample(self) -> tuple[float, float]:
+        return (
+            self._rng.uniform(self.low, self.high),
+            self._rng.uniform(self.low, self.high),
+        )
+
+
+class PrometheusCpu:
+    """Real cluster CPU via the Prometheus HTTP API (instant query).
+
+    Query: 1 - average idle fraction over all nodes of the cluster.
+    """
+
+    QUERY = '1 - avg(rate(node_cpu_seconds_total{mode="idle"}[1m]))'
+
+    def __init__(self, urls: dict | None = None, timeout_s: float = 0.2):
+        self.urls = dict(urls or PROMETHEUS_URLS)
+        self.timeout_s = timeout_s
+        self._fallback = RandomCpu()
+
+    def _query_one(self, base_url: str) -> float:
+        import json
+        import urllib.parse
+        import urllib.request
+
+        url = (
+            f"{base_url}/api/v1/query?"
+            + urllib.parse.urlencode({"query": self.QUERY})
+        )
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+            payload = json.load(resp)
+        return float(payload["data"]["result"][0]["value"][1])
+
+    def sample(self) -> tuple[float, float]:
+        out = []
+        for cloud in ("aws", "azure"):
+            try:
+                out.append(self._query_one(self.urls[cloud]))
+            except Exception:
+                logger.debug("prometheus query failed for %s; using random", cloud)
+                out.append(self._fallback.sample()[0])
+        return tuple(out)
+
+
+class TableTelemetry:
+    """Builds full observations by replaying the normalized table.
+
+    A monotonically increasing decision counter indexes the table (mod its
+    length) — the serving-side analogue of the env's ``step_idx``.
+    Thread-safe: the extender server handles requests concurrently.
+    """
+
+    def __init__(self, costs: np.ndarray, latencies: np.ndarray, cpu_source=None):
+        self.costs = np.asarray(costs, np.float32)
+        self.latencies = np.asarray(latencies, np.float32)
+        self.cpu = cpu_source or RandomCpu()
+        self._step = 0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_table(cls, data_path: str | None = None, cpu_source=None):
+        from rl_scheduler_tpu.data.loader import load_table
+
+        table = load_table(data_path)
+        return cls(np.asarray(table.costs), np.asarray(table.latencies), cpu_source)
+
+    def observe(self) -> np.ndarray:
+        with self._lock:
+            idx = self._step % len(self.costs)
+            self._step += 1
+        cpu_aws, cpu_azure = self.cpu.sample()
+        return np.concatenate(
+            [self.costs[idx], self.latencies[idx], [cpu_aws, cpu_azure]]
+        ).astype(np.float32)

@@ -1,0 +1,240 @@
+"""Scheduler extender: backends, protocol handlers, HTTP server, latency."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_scheduler_tpu.env import core as env_core
+from rl_scheduler_tpu.models import ActorCritic
+from rl_scheduler_tpu.scheduler.extender import (
+    ExtenderPolicy,
+    build_policy,
+    make_server,
+    node_cloud,
+)
+from rl_scheduler_tpu.scheduler.policy_backend import (
+    GreedyBackend,
+    JaxAOTBackend,
+    NumpyMLPBackend,
+    TorchMLPBackend,
+    make_backend,
+)
+from rl_scheduler_tpu.scheduler.telemetry import RandomCpu, TableTelemetry
+
+HIDDEN = (32, 32)
+
+
+@pytest.fixture(scope="module")
+def params_tree():
+    net = ActorCritic(num_actions=env_core.NUM_ACTIONS, hidden=HIDDEN)
+    return net.init(
+        jax.random.PRNGKey(7), jnp.zeros((1, env_core.OBS_DIM), jnp.float32)
+    )
+
+
+@pytest.fixture()
+def telemetry():
+    return TableTelemetry.from_table(cpu_source=RandomCpu(seed=0))
+
+
+def _node(name, cloud=None):
+    labels = {"cloud": cloud} if cloud else {}
+    return {"metadata": {"name": name, "labels": labels}}
+
+
+# ---------------------------------------------------------------- backends
+
+
+def test_backends_agree_on_decisions(params_tree):
+    """numpy, torch, and jax AOT backends are the same function."""
+    numpy_b = NumpyMLPBackend(params_tree)
+    torch_b = TorchMLPBackend(params_tree)
+    jax_b = JaxAOTBackend(params_tree, hidden=HIDDEN)
+    rng = np.random.RandomState(0)
+    for _ in range(20):
+        obs = rng.uniform(0, 1, env_core.OBS_DIM).astype(np.float32)
+        a_np, l_np = numpy_b.decide(obs)
+        a_t, l_t = torch_b.decide(obs)
+        a_j, l_j = jax_b.decide(obs)
+        assert a_np == a_t == a_j
+        np.testing.assert_allclose(l_np, l_t, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(l_np, l_j, rtol=1e-4, atol=1e-5)
+
+
+def test_greedy_backend_matches_reference_rule():
+    b = GreedyBackend()
+    # cheaper aws -> 0; cheaper azure -> 1; tie -> aws (obs[0] <= obs[1])
+    assert b.decide(np.array([0.1, 0.9, 0, 0, 0, 0], np.float32))[0] == 0
+    assert b.decide(np.array([0.9, 0.1, 0, 0, 0, 0], np.float32))[0] == 1
+    assert b.decide(np.array([0.5, 0.5, 0, 0, 0, 0], np.float32))[0] == 0
+
+
+def test_make_backend_falls_back_to_greedy_without_params():
+    backend, fell_back = make_backend("jax", params_tree=None)
+    assert isinstance(backend, GreedyBackend)
+    assert fell_back
+
+
+def test_make_backend_falls_back_on_garbage_params():
+    backend, fell_back = make_backend("cpu", params_tree={"params": {"bogus": {}}})
+    assert isinstance(backend, GreedyBackend)
+    assert fell_back
+
+
+# ---------------------------------------------------------------- protocol
+
+
+def test_filter_keeps_only_chosen_cloud(telemetry, params_tree):
+    policy = ExtenderPolicy(NumpyMLPBackend(params_tree), telemetry)
+    nodes = [_node("n-aws", "aws"), _node("n-azure", "azure"), _node("mystery")]
+    result = policy.filter({"nodes": {"items": nodes}, "pod": {}})
+    kept_names = [n["metadata"]["name"] for n in result["nodes"]["items"]]
+    # exactly one cloud filtered out; unknown-cloud node passes (fail-open)
+    assert "mystery" in kept_names
+    assert len(kept_names) == 2
+    assert len(result["failedNodes"]) == 1
+    assert result["error"] == ""
+
+
+def test_filter_nodenames_variant(telemetry):
+    policy = ExtenderPolicy(GreedyBackend(), telemetry)
+    result = policy.filter({"nodenames": ["aws-worker", "azure-worker"], "pod": {}})
+    assert len(result["nodenames"]) == 1
+    assert len(result["failedNodes"]) == 1
+
+
+def test_filter_fails_open_when_backend_raises(telemetry):
+    class Exploding:
+        name = "boom"
+
+        def decide(self, obs):
+            raise RuntimeError("kaboom")
+
+    policy = ExtenderPolicy(Exploding(), telemetry)
+    nodes = {"items": [_node("a", "aws"), _node("b", "azure")]}
+    result = policy.filter({"nodes": nodes, "pod": {}})
+    assert len(result["nodes"]["items"]) == 2  # nothing filtered
+    # error must stay empty: kube-scheduler hard-fails the scheduling cycle
+    # on a non-empty Error unless ignorable=true
+    assert result["error"] == ""
+
+
+def test_prioritize_scores_follow_policy_probs(telemetry, params_tree):
+    policy = ExtenderPolicy(NumpyMLPBackend(params_tree), telemetry)
+    nodes = [_node("n-aws", "aws"), _node("n-azure", "azure"), _node("mystery")]
+    scores = policy.prioritize({"nodes": {"items": nodes}})
+    by_host = {s["host"]: s["score"] for s in scores}
+    assert set(by_host) == {"n-aws", "n-azure", "mystery"}
+    assert all(0 <= s <= 100 for s in by_host.values())
+    # probs sum to 1 -> cloud scores sum to ~100; unknown node gets midpoint
+    assert by_host["n-aws"] + by_host["n-azure"] == pytest.approx(100, abs=1)
+    assert by_host["mystery"] == 50
+
+
+def test_node_cloud_label_beats_name():
+    assert node_cloud(_node("azure-ish-name", "aws")) == "aws"
+    assert node_cloud(_node("worker-azure")) == "azure"
+    assert node_cloud("kind-aws-worker") == "aws"
+    assert node_cloud(_node("plain")) is None
+    # whole-token matching: names merely containing 'aws' are NOT classified
+    assert node_cloud(_node("gateways-1")) is None
+    assert node_cloud("k8s-gateways-worker") is None
+
+
+def test_make_backend_unknown_name_raises():
+    with pytest.raises(ValueError):
+        make_backend("cuda")
+
+
+def test_build_policy_survives_corrupt_checkpoint(tmp_path):
+    run = tmp_path / "run"
+    (run / "checkpoints" / "5").mkdir(parents=True)
+    (run / "checkpoints" / "5" / "garbage").write_text("not a checkpoint")
+    policy = build_policy("cpu", run=str(run))
+    assert policy.backend.name == "greedy"
+
+
+def test_stats_accumulate(telemetry):
+    policy = ExtenderPolicy(GreedyBackend(), telemetry)
+    for _ in range(10):
+        policy.filter({"nodenames": ["aws-w", "azure-w"], "pod": {}})
+    stats = policy.statistics()
+    assert stats["latency"]["count"] == 10
+    assert sum(stats["decisions"].values()) == 10
+    assert stats["backend"] == "greedy"
+
+
+def test_build_policy_greedy_without_checkpoint(tmp_path):
+    policy = build_policy("jax", run_root=str(tmp_path / "empty"))
+    assert policy.backend.name == "greedy"
+
+
+# ---------------------------------------------------------------- HTTP
+
+
+@pytest.fixture()
+def server(telemetry, params_tree):
+    policy = ExtenderPolicy(NumpyMLPBackend(params_tree), telemetry)
+    srv = make_server(policy, host="127.0.0.1", port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv, policy
+    srv.shutdown()
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.load(resp)
+
+
+def test_http_filter_prioritize_health_stats(server):
+    srv, _ = server
+    port = srv.server_address[1]
+    # Go-style capitalized field names must be accepted
+    args = {
+        "Pod": {"metadata": {"name": "p"}},
+        "Nodes": {"items": [_node("n-aws", "aws"), _node("n-azure", "azure")]},
+    }
+    filt = _post(port, "/filter", args)
+    assert len(filt["nodes"]["items"]) == 1
+    prio = _post(port, "/prioritize", args)
+    assert len(prio) == 2
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+        assert json.load(r)["status"] == "ok"
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/stats", timeout=5) as r:
+        assert json.load(r)["latency"]["count"] >= 2
+
+
+def test_http_bad_json_is_400(server):
+    srv, _ = server
+    port = srv.server_address[1]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/filter", data=b"{not json",
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(req, timeout=5)
+    assert exc_info.value.code == 400
+
+
+def test_decision_latency_under_1ms_p50(server):
+    """The serving target: <1 ms p50 per decision (SURVEY.md §6)."""
+    srv, policy = server
+    port = srv.server_address[1]
+    args = {"nodenames": ["aws-w", "azure-w"], "pod": {}}
+    for _ in range(200):
+        _post(port, "/filter", args)
+    lat = policy.statistics()["latency"]
+    assert lat["count"] >= 200
+    assert lat["p50_ms"] < 1.0, f"decision p50 {lat['p50_ms']}ms exceeds 1ms"
