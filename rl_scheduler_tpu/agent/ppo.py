@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import optax
 
 from rl_scheduler_tpu.env import core as env_core
-from rl_scheduler_tpu.env.vector import reset_batch, step_autoreset_batch
+from rl_scheduler_tpu.env.bundle import EnvBundle, multi_cloud_bundle
 from rl_scheduler_tpu.models import ActorCritic
 from rl_scheduler_tpu.ops import gae as gae_op
 from rl_scheduler_tpu.ops.losses import PPOLossConfig, ppo_loss, categorical_log_prob
@@ -83,13 +83,13 @@ def make_optimizer(cfg: PPOTrainConfig) -> optax.GradientTransformation:
     return tx
 
 
-def make_ppo(
-    env_params: env_core.EnvParams,
+def make_ppo_bundle(
+    bundle: EnvBundle,
     cfg: PPOTrainConfig,
     net: Any | None = None,
     axis_name: str | None = None,
 ) -> tuple[Callable, Callable, Any]:
-    """Build ``(init_fn, update_fn, net)``.
+    """Build ``(init_fn, update_fn, net)`` for ANY :class:`EnvBundle`.
 
     ``init_fn(key) -> RunnerState``; ``update_fn(runner) -> (runner, metrics)``
     is pure and jit/shard_map-safe — it performs one full PPO iteration:
@@ -97,16 +97,21 @@ def make_ppo(
     minibatched SGD. With ``axis_name`` set, gradients (and reported metrics)
     are pmean-reduced over that mesh axis — the data-parallel path used by
     ``parallel/sharding.py``; ``cfg.num_envs`` is then the per-device count.
+
+    The policy ``net`` must map an observation batch ``[B, *obs_shape]`` to
+    ``(logits [B, num_actions], value [B])`` — MLPs over flat obs and
+    set-transformer / GNN policies over structured obs all fit.
     """
-    net = net or ActorCritic(num_actions=env_core.NUM_ACTIONS, hidden=cfg.hidden)
+    net = net or ActorCritic(num_actions=bundle.num_actions, hidden=cfg.hidden)
     tx = make_optimizer(cfg)
+    obs_shape = tuple(bundle.obs_shape)
 
     def init_fn(key: jnp.ndarray) -> RunnerState:
         pkey, ekey, rkey = jax.random.split(key, 3)
-        dummy = jnp.zeros((1, env_core.OBS_DIM), jnp.float32)
+        dummy = jnp.zeros((1, *obs_shape), jnp.float32)
         params = net.init(pkey, dummy)
         opt_state = tx.init(params)
-        env_state, obs = reset_batch(env_params, ekey, cfg.num_envs)
+        env_state, obs = bundle.reset_batch(ekey, cfg.num_envs)
         return RunnerState(
             params=params,
             opt_state=opt_state,
@@ -126,7 +131,7 @@ def make_ppo(
             logits, value = net.apply(runner.params, obs)
             action = jax.random.categorical(akey, logits)
             log_prob = categorical_log_prob(logits, action)
-            env_state, ts = step_autoreset_batch(env_params, env_state, action)
+            env_state, ts = bundle.step_batch(env_state, action)
             new_ep_ret = ep_ret + ts.reward
             done_f = ts.done.astype(jnp.float32)
             transition = {
@@ -160,7 +165,7 @@ def make_ppo(
         )
 
         batch = {
-            "obs": traj["obs"].reshape(-1, env_core.OBS_DIM),
+            "obs": traj["obs"].reshape(-1, *obs_shape),
             "action": traj["action"].reshape(-1),
             "log_prob": traj["log_prob"].reshape(-1),
             "value": traj["value"].reshape(-1),
@@ -237,19 +242,33 @@ def make_ppo(
     return init_fn, update_fn, net
 
 
-def ppo_train(
+def make_ppo(
     env_params: env_core.EnvParams,
+    cfg: PPOTrainConfig,
+    net: Any | None = None,
+    axis_name: str | None = None,
+) -> tuple[Callable, Callable, Any]:
+    """:func:`make_ppo_bundle` specialized to the flagship multi-cloud env."""
+    return make_ppo_bundle(multi_cloud_bundle(env_params), cfg, net, axis_name)
+
+
+def ppo_train(
+    env: env_core.EnvParams | EnvBundle,
     cfg: PPOTrainConfig,
     num_iterations: int,
     seed: int = 0,
     log_fn: Callable[[int, dict], None] | None = None,
     checkpoint_fn: Callable[[int, RunnerState], None] | None = None,
+    net: Any | None = None,
 ):
     """Host-side training loop: jitted update per iteration + logging hooks.
 
-    Returns ``(runner, history)`` where history is a list of metric dicts.
+    ``env`` is either multi-cloud :class:`EnvParams` or any
+    :class:`EnvBundle`. Returns ``(runner, history)`` where history is a
+    list of metric dicts.
     """
-    init_fn, update_fn, _ = make_ppo(env_params, cfg)
+    bundle = env if isinstance(env, EnvBundle) else multi_cloud_bundle(env)
+    init_fn, update_fn, _ = make_ppo_bundle(bundle, cfg, net=net)
     runner = init_fn(jax.random.PRNGKey(seed))
     update = jax.jit(update_fn, donate_argnums=0)
     history = []

@@ -90,6 +90,23 @@ def load_table(path: str | Path | None = None) -> CloudTable:
     return CloudTable(jnp.asarray(costs), jnp.asarray(lats), jnp.asarray(cpu))
 
 
+def load_raw_prices(path: str | Path | None = None) -> jnp.ndarray:
+    """Load UNnormalized dollar prices as ``[T, 2]`` ($/hr for aws, azure).
+
+    The cluster-graph env (BASELINE config 5) rewards in real dollars from
+    ``real_prices.csv`` (the reference synthesizes these around AWS t3.micro
+    $0.0104/hr and Azure B2s $0.0208/hr, ``generate_real_pricing.py:5-12``).
+    """
+    if path is None:
+        ensure_dataset()
+        path = default_data_dir() / "real_prices.csv"
+    df = pd.read_csv(path)
+    prices = df[["cost_aws", "cost_azure"]].to_numpy(np.float32)
+    if np.isnan(prices).any() or (prices <= 0).any():
+        raise ValueError(f"raw price table at {path} has NaN/non-positive entries")
+    return jnp.asarray(prices)
+
+
 def load_single_cluster_trace(path: str | Path | None = None) -> jnp.ndarray:
     """Load a Locust-style load-history export as a ``[T, 3]`` feature trace.
 

@@ -26,6 +26,8 @@ from rl_scheduler_tpu.env.bundle import (
     bundle_from_single,
     multi_cloud_bundle,
     single_cluster_bundle,
+    cluster_set_bundle,
+    cluster_graph_bundle,
 )
 
 __all__ = [
@@ -48,4 +50,6 @@ __all__ = [
     "bundle_from_single",
     "multi_cloud_bundle",
     "single_cluster_bundle",
+    "cluster_set_bundle",
+    "cluster_graph_bundle",
 ]

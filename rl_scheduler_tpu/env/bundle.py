@@ -115,3 +115,37 @@ def single_cluster_bundle(params=None) -> EnvBundle:
         num_actions=sc.NUM_ACTIONS,
         name="single_cluster",
     )
+
+
+def cluster_set_bundle(params=None) -> EnvBundle:
+    """The pod/node-set placement env (BASELINE config 4) as a bundle.
+
+    ``obs_shape`` is rank-2: ``(num_nodes, NODE_FEAT)`` — consumed by the
+    permutation-invariant set transformer.
+    """
+    from rl_scheduler_tpu.env import cluster_set as cs
+
+    if params is None:
+        params = cs.make_params()
+    return bundle_from_single(
+        lambda key: cs.reset(params, key),
+        lambda state, action: cs.step(params, state, action),
+        obs_shape=(params.num_nodes, cs.NODE_FEAT),
+        num_actions=params.num_nodes,
+        name="cluster_set",
+    )
+
+
+def cluster_graph_bundle(params=None) -> EnvBundle:
+    """The cluster-topology graph env (BASELINE config 5) as a bundle."""
+    from rl_scheduler_tpu.env import cluster_graph as cg
+
+    if params is None:
+        params = cg.make_params()
+    return bundle_from_single(
+        lambda key: cg.reset(params, key),
+        lambda state, action: cg.step(params, state, action),
+        obs_shape=(params.num_nodes, cg.NODE_FEAT),
+        num_actions=params.num_nodes,
+        name="cluster_graph",
+    )

@@ -1,0 +1,232 @@
+"""Cluster-topology graph simulator with real-dollar cost reward
+(BASELINE config 5).
+
+The set env (:mod:`cluster_set`) treats nodes as an unordered pool; here
+the cluster has *topology*: nodes are vertices of a two-cloud network
+graph and placement quality depends on where a pod lands **relative to the
+service it talks to**. Built for the GNN policy (``models/gnn.py``), whose
+message passing runs over the same adjacency the env scores with.
+
+Topology (static, built host-side at ``make_params``):
+- ``num_nodes`` vertices, first half aws, second half azure (parity with
+  the two kind clusters, reference ``aws/azure-cluster-config.yaml``).
+- Intra-cloud: ring + chords (each node links to its cloud's gateway) —
+  1-hop cost is low.
+- Cross-cloud: a single gateway-to-gateway link — inter-cloud traffic
+  pays extra hops, like NodePort hairpins between kind clusters.
+- ``hops[i, j]`` = shortest-path hop count (BFS at build time).
+
+Each step, a pod arrives with a cpu request and an *affinity* to a random
+existing node (the service it calls). Placing it on node ``a`` costs real
+dollars plus a locality penalty:
+
+    price_$    = raw hourly price of a's cloud (real_prices.csv replay)
+    locality   = hop_latency * hops[a, affinity]
+    overload   = relu(cpu_used'[a] - 1)
+    reward     = -(price_scale * price_$ + latency_weight * locality
+                   + overload_penalty * overload)
+
+The optimal policy must read the *graph* (place near the affinity node
+unless its neighborhood is saturated or its cloud is expensive) — exactly
+the inductive bias message passing provides.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from rl_scheduler_tpu.data.loader import load_raw_prices
+
+NODE_FEAT = 7
+
+
+class ClusterGraphParams(NamedTuple):
+    prices: jnp.ndarray        # [T, 2] raw $/hr per cloud
+    cloud_of_node: jnp.ndarray  # [N] int32
+    adjacency: jnp.ndarray     # [N, N] f32 (0/1, no self loops)
+    hops: jnp.ndarray          # [N, N] f32 shortest-path hop counts
+    price_scale: jnp.ndarray   # dollars -> reward units
+    latency_weight: jnp.ndarray
+    hop_latency: jnp.ndarray
+    overload_penalty: jnp.ndarray
+    pod_cpu_low: jnp.ndarray
+    pod_cpu_high: jnp.ndarray
+    drain_rate: jnp.ndarray
+    max_steps: jnp.ndarray
+
+    @property
+    def num_nodes(self) -> int:
+        return self.cloud_of_node.shape[0]
+
+
+class ClusterGraphState(NamedTuple):
+    step_idx: jnp.ndarray
+    cpu_used: jnp.ndarray      # [N]
+    affinity: jnp.ndarray      # scalar int32: node the pod talks to
+    pod_cpu: jnp.ndarray       # scalar f32
+    key: jnp.ndarray
+
+
+class TimeStep(NamedTuple):
+    obs: jnp.ndarray           # [N, NODE_FEAT]
+    reward: jnp.ndarray
+    done: jnp.ndarray
+    chosen_cloud: jnp.ndarray
+    step: jnp.ndarray
+
+
+def build_topology(num_nodes: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(cloud_of_node, adjacency, hops) for the two-cloud gateway graph."""
+    if num_nodes < 4:
+        raise ValueError("graph env needs >= 4 nodes (2 per cloud)")
+    half = num_nodes // 2
+    cloud = (np.arange(num_nodes) >= half).astype(np.int32)
+    adj = np.zeros((num_nodes, num_nodes), np.float32)
+    for lo, hi in ((0, half), (half, num_nodes)):
+        members = list(range(lo, hi))
+        gateway = members[0]
+        for i, u in enumerate(members):
+            v = members[(i + 1) % len(members)]  # ring
+            if u != v:
+                adj[u, v] = adj[v, u] = 1.0
+            if u != gateway:                      # chord to gateway
+                adj[u, gateway] = adj[gateway, u] = 1.0
+    adj[0, half] = adj[half, 0] = 1.0             # gateway <-> gateway
+    # BFS all-pairs hop counts (tiny N; host-side, once).
+    hops = np.full((num_nodes, num_nodes), np.inf, np.float32)
+    for s in range(num_nodes):
+        hops[s, s] = 0.0
+        frontier = [s]
+        d = 0
+        while frontier:
+            d += 1
+            nxt = []
+            for u in frontier:
+                for v in np.nonzero(adj[u])[0]:
+                    if hops[s, v] == np.inf:
+                        hops[s, v] = d
+                        nxt.append(v)
+            frontier = nxt
+    if np.isinf(hops).any():
+        raise AssertionError("topology is disconnected")
+    return cloud, adj, hops
+
+
+def make_params(
+    num_nodes: int = 8,
+    price_scale: float = 1000.0,   # $0.01/hr -> ~10 reward units
+    latency_weight: float = 1.0,
+    hop_latency: float = 2.0,
+    overload_penalty: float = 50.0,
+    pod_cpu_low: float = 0.1,
+    pod_cpu_high: float = 0.4,
+    drain_rate: float = 0.85,
+    prices_path: str | None = None,
+    max_steps: int | None = None,
+) -> ClusterGraphParams:
+    prices = load_raw_prices(prices_path)
+    cloud, adj, hops = build_topology(num_nodes)
+    f32 = lambda x: jnp.asarray(x, jnp.float32)
+    t = prices.shape[0]
+    return ClusterGraphParams(
+        prices=prices,
+        cloud_of_node=jnp.asarray(cloud),
+        adjacency=jnp.asarray(adj),
+        hops=jnp.asarray(hops),
+        price_scale=f32(price_scale),
+        latency_weight=f32(latency_weight),
+        hop_latency=f32(hop_latency),
+        overload_penalty=f32(overload_penalty),
+        pod_cpu_low=f32(pod_cpu_low),
+        pod_cpu_high=f32(pod_cpu_high),
+        drain_rate=f32(drain_rate),
+        max_steps=jnp.asarray(max_steps if max_steps is not None else t - 1, jnp.int32),
+    )
+
+
+def _observe(params: ClusterGraphParams, state: ClusterGraphState) -> jnp.ndarray:
+    n = params.num_nodes
+    row_prices = jax.lax.dynamic_index_in_dim(
+        params.prices, state.step_idx, keepdims=False
+    )
+    # scale raw $ into a ~[0,1] feature so the net doesn't see 1e-2 values
+    price_feat = row_prices[params.cloud_of_node] * 30.0
+    hops_to_affinity = jax.lax.dynamic_index_in_dim(
+        params.hops, state.affinity, axis=1, keepdims=False
+    )
+    degree = params.adjacency.sum(axis=1)
+    step_frac = state.step_idx.astype(jnp.float32) / params.max_steps.astype(jnp.float32)
+    return jnp.stack(
+        [
+            price_feat,
+            state.cpu_used,
+            params.cloud_of_node.astype(jnp.float32),
+            hops_to_affinity / jnp.maximum(params.hops.max(), 1.0),
+            degree / n,
+            jnp.full((n,), state.pod_cpu),
+            jnp.full((n,), step_frac),
+        ],
+        axis=-1,
+    ).astype(jnp.float32)
+
+
+def reset(
+    params: ClusterGraphParams, key: jnp.ndarray
+) -> tuple[ClusterGraphState, jnp.ndarray]:
+    carry_key, aff_key, pod_key = jax.random.split(key, 3)
+    state = ClusterGraphState(
+        step_idx=jnp.zeros((), jnp.int32),
+        cpu_used=jnp.zeros(params.num_nodes, jnp.float32),
+        affinity=jax.random.randint(aff_key, (), 0, params.num_nodes, jnp.int32),
+        pod_cpu=jax.random.uniform(
+            pod_key, (), jnp.float32,
+            minval=params.pod_cpu_low, maxval=params.pod_cpu_high,
+        ),
+        key=carry_key,
+    )
+    return state, _observe(params, state)
+
+
+def step(
+    params: ClusterGraphParams, state: ClusterGraphState, action: jnp.ndarray
+) -> tuple[ClusterGraphState, TimeStep]:
+    action = jnp.asarray(action, jnp.int32)
+    carry_key, aff_key, pod_key = jax.random.split(state.key, 3)
+
+    row_prices = jax.lax.dynamic_index_in_dim(
+        params.prices, state.step_idx, keepdims=False
+    )
+    price = row_prices[params.cloud_of_node[action]]
+    locality = params.hop_latency * params.hops[action, state.affinity]
+    new_cpu = state.cpu_used.at[action].add(state.pod_cpu)
+    overload = jnp.maximum(new_cpu[action] - 1.0, 0.0)
+    reward = -(
+        params.price_scale * price
+        + params.latency_weight * locality
+        + params.overload_penalty * overload
+    )
+
+    new_step = state.step_idx + 1
+    done = new_step >= params.max_steps
+    new_state = ClusterGraphState(
+        step_idx=new_step,
+        cpu_used=new_cpu * params.drain_rate,
+        affinity=jax.random.randint(aff_key, (), 0, params.num_nodes, jnp.int32),
+        pod_cpu=jax.random.uniform(
+            pod_key, (), jnp.float32,
+            minval=params.pod_cpu_low, maxval=params.pod_cpu_high,
+        ),
+        key=carry_key,
+    )
+    ts = TimeStep(
+        obs=_observe(params, new_state),
+        reward=reward.astype(jnp.float32),
+        done=done,
+        chosen_cloud=params.cloud_of_node[action],
+        step=new_step,
+    )
+    return new_state, ts

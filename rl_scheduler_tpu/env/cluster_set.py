@@ -1,0 +1,198 @@
+"""Pod/node-set placement simulator (BASELINE config 4).
+
+The flagship multi-cloud env chooses between two *clouds*
+(``k8s_multi_cloud_env.py:51``: ``Discrete(2)``); this env generalizes the
+decision to a *set of nodes* — the shape a real kube-scheduler faces: one
+pod arrives per step and the agent picks which of ``num_nodes`` nodes
+hosts it. Built for the permutation-invariant transformer policy
+(``models/transformer.py``): the observation is a ``[num_nodes, FEAT]``
+set, node order carries no meaning, and the optimal policy is equivariant
+under node permutation (golden-tested).
+
+Per-node features (all in [0, 1], fixed column order):
+  0 cost        — the node's cloud cost from the replayed pricing table,
+                  plus a static per-node premium drawn at reset
+  1 latency     — same construction from the latency table
+  2 cpu_used    — current utilization; placements add load, completions
+                  drain it geometrically each step
+  3 cloud_id    — 0 = aws, 1 = azure (first half of nodes are aws)
+  4 pod_cpu     — the arriving pod's cpu request (broadcast to all rows)
+  5 step_frac   — episode progress (broadcast), so policies can anticipate
+                  table drift
+
+Reward for placing on node ``a``:
+    -(w_c * cost[a] + w_l * latency[a]
+      + overload_penalty * relu(cpu_used'[a] - 1))
+i.e. the multi-cloud cost/latency trade-off (reference
+``k8s_multi_cloud_env.py:122``) plus a capacity term that makes *set*
+state matter: a greedy cheapest-node policy overloads it and loses to
+load-aware placement.
+
+Episode length follows the pricing table (99 steps), like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from rl_scheduler_tpu.data.loader import load_table
+
+NODE_FEAT = 6
+
+
+class ClusterSetParams(NamedTuple):
+    costs: jnp.ndarray       # [T, 2] normalized cloud costs (table replay)
+    latencies: jnp.ndarray   # [T, 2]
+    cloud_of_node: jnp.ndarray  # [N] int32, 0=aws 1=azure
+    cost_weight: jnp.ndarray
+    latency_weight: jnp.ndarray
+    reward_scale: jnp.ndarray
+    overload_penalty: jnp.ndarray
+    node_jitter: jnp.ndarray    # scalar: scale of static per-node premiums
+    pod_cpu_low: jnp.ndarray
+    pod_cpu_high: jnp.ndarray
+    drain_rate: jnp.ndarray     # per-step utilization retention in (0,1)
+    max_steps: jnp.ndarray      # scalar int32
+
+    @property
+    def num_nodes(self) -> int:
+        return self.cloud_of_node.shape[0]
+
+
+class ClusterSetState(NamedTuple):
+    step_idx: jnp.ndarray   # scalar int32
+    cpu_used: jnp.ndarray   # [N] f32
+    node_premium: jnp.ndarray  # [N, 2] static per-episode (cost, lat) offsets
+    pod_cpu: jnp.ndarray    # scalar f32: the pod awaiting placement
+    key: jnp.ndarray
+
+
+class TimeStep(NamedTuple):
+    obs: jnp.ndarray        # [N, NODE_FEAT]
+    reward: jnp.ndarray
+    done: jnp.ndarray
+    chosen_cloud: jnp.ndarray  # cloud of the chosen node (stats parity)
+    step: jnp.ndarray
+
+
+def make_params(
+    num_nodes: int = 8,
+    cost_weight: float = 0.6,
+    latency_weight: float = 0.4,
+    reward_scale: float = 100.0,
+    overload_penalty: float = 2.0,
+    node_jitter: float = 0.1,
+    pod_cpu_low: float = 0.1,
+    pod_cpu_high: float = 0.4,
+    drain_rate: float = 0.85,
+    data_path: str | None = None,
+    max_steps: int | None = None,
+) -> ClusterSetParams:
+    table = load_table(data_path)
+    t = table.costs.shape[0]
+    f32 = lambda x: jnp.asarray(x, jnp.float32)
+    # First half aws, second half azure (node order is irrelevant to the
+    # permutation-invariant policy; tests shuffle it).
+    cloud = (jnp.arange(num_nodes) >= num_nodes // 2).astype(jnp.int32)
+    return ClusterSetParams(
+        costs=table.costs,
+        latencies=table.latencies,
+        cloud_of_node=cloud,
+        cost_weight=f32(cost_weight),
+        latency_weight=f32(latency_weight),
+        reward_scale=f32(reward_scale),
+        overload_penalty=f32(overload_penalty),
+        node_jitter=f32(node_jitter),
+        pod_cpu_low=f32(pod_cpu_low),
+        pod_cpu_high=f32(pod_cpu_high),
+        drain_rate=f32(drain_rate),
+        max_steps=jnp.asarray(max_steps if max_steps is not None else t - 1, jnp.int32),
+    )
+
+
+def node_costs_latencies(
+    params: ClusterSetParams, state: ClusterSetState
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-node (cost, latency) at the current table row: cloud value +
+    static node premium, clipped to [0, 1]."""
+    row_costs = jax.lax.dynamic_index_in_dim(params.costs, state.step_idx, keepdims=False)
+    row_lats = jax.lax.dynamic_index_in_dim(params.latencies, state.step_idx, keepdims=False)
+    cost = row_costs[params.cloud_of_node] + state.node_premium[:, 0]
+    lat = row_lats[params.cloud_of_node] + state.node_premium[:, 1]
+    return jnp.clip(cost, 0.0, 1.0), jnp.clip(lat, 0.0, 1.0)
+
+
+def _observe(params: ClusterSetParams, state: ClusterSetState) -> jnp.ndarray:
+    cost, lat = node_costs_latencies(params, state)
+    n = params.num_nodes
+    step_frac = state.step_idx.astype(jnp.float32) / params.max_steps.astype(jnp.float32)
+    return jnp.stack(
+        [
+            cost,
+            lat,
+            state.cpu_used,
+            params.cloud_of_node.astype(jnp.float32),
+            jnp.full((n,), state.pod_cpu),
+            jnp.full((n,), step_frac),
+        ],
+        axis=-1,
+    ).astype(jnp.float32)
+
+
+def _draw_pod(params: ClusterSetParams, key: jnp.ndarray) -> jnp.ndarray:
+    return jax.random.uniform(
+        key, (), jnp.float32, minval=params.pod_cpu_low, maxval=params.pod_cpu_high
+    )
+
+
+def reset(params: ClusterSetParams, key: jnp.ndarray) -> tuple[ClusterSetState, jnp.ndarray]:
+    carry_key, prem_key, pod_key = jax.random.split(key, 3)
+    premium = params.node_jitter * jax.random.uniform(
+        prem_key, (params.num_nodes, 2), jnp.float32
+    )
+    state = ClusterSetState(
+        step_idx=jnp.zeros((), jnp.int32),
+        cpu_used=jnp.zeros(params.num_nodes, jnp.float32),
+        node_premium=premium,
+        pod_cpu=_draw_pod(params, pod_key),
+        key=carry_key,
+    )
+    return state, _observe(params, state)
+
+
+def step(
+    params: ClusterSetParams, state: ClusterSetState, action: jnp.ndarray
+) -> tuple[ClusterSetState, TimeStep]:
+    """Place the pending pod on node ``action``; pure, jit/vmap/scan-safe."""
+    action = jnp.asarray(action, jnp.int32)
+    carry_key, pod_key = jax.random.split(state.key)
+
+    cost, lat = node_costs_latencies(params, state)
+    new_cpu = state.cpu_used.at[action].add(state.pod_cpu)
+    overload = jnp.maximum(new_cpu[action] - 1.0, 0.0)
+    reward = -params.reward_scale * (
+        params.cost_weight * cost[action]
+        + params.latency_weight * lat[action]
+        + params.overload_penalty * overload
+    )
+
+    new_step = state.step_idx + 1
+    done = new_step >= params.max_steps
+    new_state = ClusterSetState(
+        step_idx=new_step,
+        cpu_used=new_cpu * params.drain_rate,  # completions drain load
+        node_premium=state.node_premium,
+        pod_cpu=_draw_pod(params, pod_key),
+        key=carry_key,
+    )
+    ts = TimeStep(
+        obs=_observe(params, new_state),
+        reward=reward.astype(jnp.float32),
+        done=done,
+        chosen_cloud=params.cloud_of_node[action],
+        step=new_step,
+    )
+    return new_state, ts

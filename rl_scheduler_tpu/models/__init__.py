@@ -1,5 +1,7 @@
 """Policy zoo: MLP actor-critic, Q-network, set transformer, cluster GNN."""
 
 from rl_scheduler_tpu.models.mlp import ActorCritic, QNetwork
+from rl_scheduler_tpu.models.transformer import SetTransformerPolicy
+from rl_scheduler_tpu.models.gnn import GNNPolicy
 
-__all__ = ["ActorCritic", "QNetwork"]
+__all__ = ["ActorCritic", "QNetwork", "SetTransformerPolicy", "GNNPolicy"]

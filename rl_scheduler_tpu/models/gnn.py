@@ -1,0 +1,77 @@
+"""Graph neural network policy over cluster topology (BASELINE config 5).
+
+Message passing over the cluster graph's (static) adjacency: each layer
+mixes a node's own embedding with a degree-normalized aggregate of its
+neighbors — the GCN rule ``H' = act(H W_self + Â H W_nbr)`` with
+``Â = D^-1 A``. The adjacency is a dense ``[N, N]`` matrix (cluster graphs
+are small and dense-ish), so aggregation is a plain matmul: MXU-shaped,
+fuses with everything else under jit, and vmaps over thousands of envs.
+
+The env's per-node features already include relational signals
+(hops-to-affinity, degree), but the *policy* still needs message passing
+to reason about neighborhood load ("the affinity node's neighbors are
+saturated — place two hops out"), which pure per-node MLPs cannot see.
+
+Heads mirror the set transformer: per-node pointer logits (permutation-
+equivariant w.r.t. graph isomorphism) + mean-pooled value.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from rl_scheduler_tpu.models.heads import (
+    PointerActorCriticHead,
+    apply_with_optional_batch,
+)
+
+
+class GraphConvLayer(nn.Module):
+    dim: int
+
+    @nn.compact
+    def __call__(self, h, norm_adj):  # h: [..., N, dim_in], norm_adj: [N, N]
+        self_msg = nn.Dense(self.dim, name="w_self")(h)
+        nbr = jnp.einsum("ij,...jd->...id", norm_adj, h)
+        nbr_msg = nn.Dense(self.dim, name="w_nbr")(nbr)
+        return nn.relu(self_msg + nbr_msg)
+
+
+class GNNPolicy(nn.Module):
+    """Actor-critic GNN. The adjacency is a static module attribute (one
+    topology per trained policy, like a CNN's geometry), passed as a plain
+    numpy array so the module hashes/compares cleanly under jit.
+
+    Input ``[B, N, feat]`` or ``[N, feat]``; returns
+    ``(logits [B, N], value [B])``.
+    """
+
+    adjacency: tuple  # nested tuple form of the [N, N] 0/1 matrix
+    dim: int = 64
+    depth: int = 3
+
+    @staticmethod
+    def from_adjacency(adj, dim: int = 64, depth: int = 3) -> "GNNPolicy":
+        adj = np.asarray(adj, np.float32)
+        return GNNPolicy(
+            adjacency=tuple(tuple(float(x) for x in row) for row in adj),
+            dim=dim,
+            depth=depth,
+        )
+
+    @nn.compact
+    def __call__(self, obs):
+        adj = jnp.asarray(self.adjacency, jnp.float32)
+        degree = jnp.maximum(adj.sum(axis=1, keepdims=True), 1.0)
+        norm_adj = adj / degree  # D^-1 A
+        head = PointerActorCriticHead(self.dim, name="head")
+
+        def forward(batched_obs):
+            h = nn.relu(nn.Dense(self.dim, name="embed")(batched_obs))
+            for i in range(self.depth):
+                h = GraphConvLayer(self.dim, name=f"conv_{i}")(h, norm_adj)
+            return head(h)
+
+        return apply_with_optional_batch(forward, obs)

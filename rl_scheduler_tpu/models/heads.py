@@ -1,0 +1,44 @@
+"""Shared actor-critic output head for set/graph policies.
+
+Both the set transformer and the GNN end the same way: a per-node pointer
+logit (permutation-equivariant) and a pooled value (invariant). One module
+owns that contract so the two policies cannot silently diverge.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class PointerActorCriticHead(nn.Module):
+    """``[B, N, dim] -> (logits [B, N], value [B])``.
+
+    Per-node scalar score from a shared Dense (pointer head, small init so
+    initial policy is near-uniform); value from a tanh MLP over the
+    mean-pooled node embeddings.
+    """
+
+    dim: int = 64
+
+    @nn.compact
+    def __call__(self, h):
+        logits = nn.Dense(1, kernel_init=nn.initializers.orthogonal(0.01),
+                          name="score_head")(h)[..., 0]
+        pooled = h.mean(axis=-2)
+        v = nn.tanh(nn.Dense(self.dim, name="value_hidden")(pooled))
+        value = nn.Dense(1, kernel_init=nn.initializers.orthogonal(1.0),
+                         name="value_head")(v)[..., 0]
+        return logits, value
+
+
+def apply_with_optional_batch(module_fn, obs):
+    """Run ``module_fn`` on ``[B, N, F]`` obs, squeezing an unbatched
+    ``[N, F]`` input back to unbatched outputs."""
+    squeeze = obs.ndim == 2
+    if squeeze:
+        obs = obs[None]
+    logits, value = module_fn(obs)
+    if squeeze:
+        return logits[0], value[0]
+    return logits, value

@@ -1,0 +1,74 @@
+"""Permutation-invariant set-transformer policy (BASELINE config 4).
+
+Policy over a *set* of candidate nodes: the observation is
+``[num_nodes, feat]`` with no meaningful node order, so the network uses
+self-attention with NO positional encoding — outputs are permutation-
+*equivariant* in the logits (per-node scores move with their node) and
+permutation-*invariant* in the value (mean-pooled), which the tests assert
+exactly.
+
+TPU notes: attention over a handful of nodes is tiny; the win is that the
+whole thing is dense matmul + softmax, fusing into the same XLA program as
+the vmapped env and PPO update. ``dot_product_attention`` batches over
+``[B, heads, N, d]`` — MXU-shaped, bfloat16-friendly. For large sets the
+same module shards over the mesh via the sequence-parallel attention in
+``parallel/ring_attention.py``.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from rl_scheduler_tpu.models.heads import (
+    PointerActorCriticHead,
+    apply_with_optional_batch,
+)
+
+
+class SelfAttentionBlock(nn.Module):
+    """Pre-LN multi-head self-attention + MLP (standard transformer block,
+    no positional anything)."""
+
+    dim: int
+    num_heads: int = 4
+    mlp_ratio: int = 2
+
+    @nn.compact
+    def __call__(self, x):  # [..., N, dim]
+        h = nn.LayerNorm()(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads, qkv_features=self.dim
+        )(h, h)
+        x = x + h
+        h = nn.LayerNorm()(x)
+        h = nn.Dense(self.dim * self.mlp_ratio)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.dim)(h)
+        return x + h
+
+
+class SetTransformerPolicy(nn.Module):
+    """Actor-critic over node sets.
+
+    Input ``[B, N, feat]`` (or unbatched ``[N, feat]``); returns
+    ``(logits [B, N], value [B])`` — one logit per candidate node
+    (pointer-style head), value from the mean-pooled set embedding.
+    """
+
+    dim: int = 64
+    depth: int = 2
+    num_heads: int = 4
+
+    @nn.compact
+    def __call__(self, obs):
+        head = PointerActorCriticHead(self.dim, name="head")
+
+        def forward(batched_obs):
+            x = nn.Dense(self.dim, name="embed")(batched_obs)  # [B, N, dim]
+            for i in range(self.depth):
+                x = SelfAttentionBlock(self.dim, self.num_heads, name=f"block_{i}")(x)
+            x = nn.LayerNorm(name="final_norm")(x)
+            return head(x)
+
+        return apply_with_optional_batch(forward, obs)

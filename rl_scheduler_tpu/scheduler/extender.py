@@ -138,7 +138,11 @@ class ExtenderPolicy:
             return self._passthrough(args)
         chosen = CLOUDS[action]
         if self.placer is not None:
-            self.placer.place(chosen)
+            # Kube API calls (unbounded read timeout) must not block the
+            # scheduling response; fire-and-forget on a worker thread.
+            threading.Thread(
+                target=self.placer.place, args=(chosen,), daemon=True
+            ).start()
 
         failed: dict[str, str] = {}
         if node_names is not None:

@@ -20,6 +20,7 @@ from __future__ import annotations
 import logging
 import random
 import threading
+import time
 
 import numpy as np
 
@@ -47,14 +48,27 @@ class PrometheusCpu:
     """Real cluster CPU via the Prometheus HTTP API (instant query).
 
     Query: 1 - average idle fraction over all nodes of the cluster.
+
+    Serving-latency contract: ``sample()`` NEVER blocks on HTTP — it
+    returns the cached reading and, when that reading is older than
+    ``ttl_s``, kicks one background refresh thread. Until the first
+    refresh lands (or when Prometheus is down) it serves the random
+    fallback, so the extender's <1 ms p50 holds regardless of Prometheus
+    health.
     """
 
     QUERY = '1 - avg(rate(node_cpu_seconds_total{mode="idle"}[1m]))'
 
-    def __init__(self, urls: dict | None = None, timeout_s: float = 0.2):
+    def __init__(self, urls: dict | None = None, timeout_s: float = 0.2,
+                 ttl_s: float = 1.0):
         self.urls = dict(urls or PROMETHEUS_URLS)
         self.timeout_s = timeout_s
+        self.ttl_s = ttl_s
         self._fallback = RandomCpu()
+        self._cached: tuple[float, float] | None = None
+        self._cached_at = 0.0
+        self._refreshing = False
+        self._lock = threading.Lock()
 
     def _query_one(self, base_url: str) -> float:
         import json
@@ -69,7 +83,7 @@ class PrometheusCpu:
             payload = json.load(resp)
         return float(payload["data"]["result"][0]["value"][1])
 
-    def sample(self) -> tuple[float, float]:
+    def _refresh(self) -> None:
         out = []
         for cloud in ("aws", "azure"):
             try:
@@ -77,7 +91,21 @@ class PrometheusCpu:
             except Exception:
                 logger.debug("prometheus query failed for %s; using random", cloud)
                 out.append(self._fallback.sample()[0])
-        return tuple(out)
+        with self._lock:
+            self._cached = tuple(out)
+            self._cached_at = time.monotonic()
+            self._refreshing = False
+
+    def sample(self) -> tuple[float, float]:
+        with self._lock:
+            cached = self._cached
+            stale = time.monotonic() - self._cached_at > self.ttl_s
+            kick = stale and not self._refreshing
+            if kick:
+                self._refreshing = True
+        if kick:
+            threading.Thread(target=self._refresh, daemon=True).start()
+        return cached if cached is not None else self._fallback.sample()
 
 
 class TableTelemetry:
