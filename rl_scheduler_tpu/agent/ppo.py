@@ -46,6 +46,7 @@ class PPOTrainConfig:
     entropy_coeff: float = 0.0
     max_grad_norm: float | None = None  # RLlib default: no grad clip
     hidden: tuple = (256, 256)
+    gae_impl: str = "auto"           # scan | pallas | auto (pallas on TPU)
 
     @property
     def batch_size(self) -> int:
@@ -161,7 +162,7 @@ def make_ppo_bundle(
         _, last_value = net.apply(runner.params, obs)
         advantages, targets = gae_op(
             traj["reward"], traj["value"], traj["done"], last_value,
-            cfg.gamma, cfg.gae_lambda,
+            cfg.gamma, cfg.gae_lambda, impl=cfg.gae_impl,
         )
 
         batch = {

@@ -100,6 +100,12 @@ def load_raw_prices(path: str | Path | None = None) -> jnp.ndarray:
     if path is None:
         ensure_dataset()
         path = default_data_dir() / "real_prices.csv"
+        if not path.exists():
+            # ensure_dataset only guarantees the processed table; a checkout
+            # that kept it but pruned the raw CSVs still needs the generator.
+            from rl_scheduler_tpu.data.generate import generate_all
+
+            generate_all(default_data_dir())
     df = pd.read_csv(path)
     prices = df[["cost_aws", "cost_azure"]].to_numpy(np.float32)
     if np.isnan(prices).any() or (prices <= 0).any():

@@ -19,10 +19,29 @@ def gae(
     last_value: jnp.ndarray,  # [N] V(s_{T}) bootstrap
     gamma: float,
     lam: float,
+    impl: str = "auto",
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Returns ``(advantages [T, N], targets [T, N])`` with
     ``targets = advantages + values`` (the value-function regression target).
+
+    ``impl``: ``"scan"`` (reverse ``lax.scan``), ``"pallas"`` (one-launch
+    VMEM-resident kernel, :mod:`rl_scheduler_tpu.ops.pallas_gae`), or
+    ``"auto"`` — pallas when the computation lands on TPU, scan elsewhere.
+    Both are numerically identical (equivalence-tested). ``auto`` resolves
+    from ``jax.default_device`` when pinned, else the default backend; code
+    that jit-compiles for a non-default device should pass ``impl``
+    explicitly.
     """
+    if impl == "auto":
+        pinned = jax.config.jax_default_device
+        platform = pinned.platform if pinned is not None else jax.default_backend()
+        impl = "pallas" if platform == "tpu" else "scan"
+    if impl == "pallas":
+        from rl_scheduler_tpu.ops.pallas_gae import gae_pallas
+
+        return gae_pallas(rewards, values, dones, last_value, gamma, lam)
+    if impl != "scan":
+        raise ValueError(f"unknown GAE impl {impl!r}; choose scan|pallas|auto")
     not_done = 1.0 - dones.astype(jnp.float32)
 
     def body(carry, xs):

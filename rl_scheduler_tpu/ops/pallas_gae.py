@@ -1,0 +1,123 @@
+"""Pallas TPU kernel for Generalized Advantage Estimation.
+
+GAE is the one hot op in the PPO update that XLA cannot tile well: a
+length-``T`` *sequential* recurrence over a ``[T, N]`` rollout. As a
+``lax.scan`` it compiles to ``T`` tiny fused loop bodies with loop-carried
+dependencies and per-iteration dynamic-slice traffic; as a Pallas kernel the
+whole recurrence runs in one launch — each grid program pins a ``[T, BN]``
+column block in VMEM and walks the time axis backwards with the two
+recurrence carries (advantage, next value) held in VMEM scratch, so HBM is
+touched exactly once per element in and once out.
+
+The reference computes GAE in numpy on the Ray driver after experience is
+shipped across the object store (RLlib postprocessing, SURVEY.md §3.1); here
+it stays on-chip inside the jitted update.
+
+The kernel is numerically identical to :func:`rl_scheduler_tpu.ops.gae.gae`
+(equivalence-tested) and runs in interpret mode on CPU so the same code path
+is testable without a TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Column-block width: multiple of the 128-lane VPU width; 512 keeps each
+# (1, BN) row op at 4 vector registers while the [T, BN] block (T=100
+# rollouts => ~200 KB x 4 buffers) sits comfortably in ~16 MB VMEM.
+DEFAULT_BLOCK_N = 512
+
+
+def _gae_kernel(rew_ref, val_ref, nd_ref, lastv_ref, adv_ref, adv_c, val_c, *,
+                gamma: float, lam: float, num_steps: int):
+    """One column block: reverse-time GAE recurrence held in VMEM.
+
+    Refs are ``[T, BN]`` blocks except ``lastv_ref`` ``[1, BN]``;
+    ``adv_c``/``val_c`` are ``[1, BN]`` VMEM scratch carrying the recurrence.
+    """
+    adv_c[:] = jnp.zeros_like(adv_c)
+    val_c[:] = lastv_ref[:]
+
+    def body(i, _):
+        t = num_steps - 1 - i
+        reward = rew_ref[pl.ds(t, 1), :]
+        value = val_ref[pl.ds(t, 1), :]
+        nd = nd_ref[pl.ds(t, 1), :]
+        delta = reward + gamma * val_c[:] * nd - value
+        adv = delta + gamma * lam * nd * adv_c[:]
+        adv_ref[pl.ds(t, 1), :] = adv
+        adv_c[:] = adv
+        val_c[:] = value
+        return 0
+
+    jax.lax.fori_loop(0, num_steps, body, 0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("gamma", "lam", "block_n", "interpret")
+)
+def gae_pallas(
+    rewards: jnp.ndarray,     # [T, N]
+    values: jnp.ndarray,      # [T, N] V(s_t)
+    dones: jnp.ndarray,       # [T, N] episode ended at t (any dtype)
+    last_value: jnp.ndarray,  # [N] V(s_T) bootstrap
+    gamma: float,
+    lam: float,
+    block_n: int = DEFAULT_BLOCK_N,
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Pallas GAE: ``(advantages [T, N], targets [T, N])``.
+
+    Matches :func:`rl_scheduler_tpu.ops.gae.gae` bit-for-bit in f32. ``N``
+    is zero-padded up to a multiple of ``block_n`` (columns are independent,
+    so padding never leaks into real outputs). ``interpret=None`` auto-picks
+    interpreter mode off-TPU so tests run on CPU.
+    """
+    if interpret is None:
+        pinned = jax.config.jax_default_device
+        platform = pinned.platform if pinned is not None else jax.default_backend()
+        interpret = platform != "tpu"
+    num_steps, n = rewards.shape
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    not_done = 1.0 - dones.astype(jnp.float32)
+    lastv = last_value.astype(jnp.float32).reshape(1, n)
+
+    n_pad = pl.cdiv(n, block_n) * block_n
+    if n_pad != n:
+        pad = ((0, 0), (0, n_pad - n))
+        rewards = jnp.pad(rewards, pad)
+        values = jnp.pad(values, pad)
+        not_done = jnp.pad(not_done, pad)
+        lastv = jnp.pad(lastv, pad)
+
+    col_spec = pl.BlockSpec(
+        (num_steps, block_n), lambda j: (0, j), memory_space=pltpu.VMEM
+    )
+    advs = pl.pallas_call(
+        functools.partial(
+            _gae_kernel, gamma=gamma, lam=lam, num_steps=num_steps
+        ),
+        grid=(n_pad // block_n,),
+        in_specs=[
+            col_spec,
+            col_spec,
+            col_spec,
+            pl.BlockSpec((1, block_n), lambda j: (0, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=col_spec,
+        out_shape=jax.ShapeDtypeStruct((num_steps, n_pad), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((1, block_n), jnp.float32),
+            pltpu.VMEM((1, block_n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(rewards, values, not_done, lastv)
+
+    advs = advs[:, :n]
+    return advs, advs + values[:, :n]

@@ -31,6 +31,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import queue
 import re
 import threading
 import time
@@ -102,13 +103,58 @@ class LatencyStats:
         }
 
 
+class AsyncPlacer:
+    """Bounded async wrapper around a pod placer.
+
+    One worker thread drains a bounded queue, so a hung kube API (the client
+    has an unbounded read timeout) never blocks a scheduling response and a
+    scheduling burst cannot accumulate threads without limit — the oldest
+    queued placement drops on overflow instead.
+    """
+
+    def __init__(self, placer, maxsize: int = 64):
+        self._placer = placer
+        self._queue: queue.Queue = queue.Queue(maxsize=maxsize)
+        self._dropped = 0
+        self._lock = threading.Lock()
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def submit(self, cloud: str) -> None:
+        while True:
+            try:
+                self._queue.put_nowait(cloud)
+                return
+            except queue.Full:
+                try:
+                    self._queue.get_nowait()
+                    with self._lock:
+                        self._dropped += 1
+                except queue.Empty:
+                    pass
+
+    def _drain(self) -> None:
+        while True:
+            cloud = self._queue.get()
+            try:
+                self._placer.place(cloud)
+            except Exception:
+                logger.exception("pod placement on %s failed", cloud)
+
+
 class ExtenderPolicy:
     """Pure decision logic, independent of HTTP (unit-testable directly)."""
 
     def __init__(self, backend, telemetry: TableTelemetry, placer=None):
         self.backend = backend
         self.telemetry = telemetry
-        self.placer = placer  # optional DryRunPodPlacer (slow-mode parity)
+        # Optional DryRunPodPlacer (slow-mode parity), wrapped so kube API
+        # stalls can neither block responses nor exhaust threads.
+        self.placer = AsyncPlacer(placer) if placer is not None else None
         self.stats = LatencyStats()
         self._decisions = {c: 0 for c in CLOUDS}
         self._lock = threading.Lock()
@@ -138,11 +184,7 @@ class ExtenderPolicy:
             return self._passthrough(args)
         chosen = CLOUDS[action]
         if self.placer is not None:
-            # Kube API calls (unbounded read timeout) must not block the
-            # scheduling response; fire-and-forget on a worker thread.
-            threading.Thread(
-                target=self.placer.place, args=(chosen,), daemon=True
-            ).start()
+            self.placer.submit(chosen)
 
         failed: dict[str, str] = {}
         if node_names is not None:
@@ -210,7 +252,7 @@ class ExtenderPolicy:
         with self._lock:
             decisions = dict(self._decisions)
         total = sum(decisions.values())
-        return {
+        out = {
             "backend": self.backend.name,
             "decisions": decisions,
             "choice_fractions": {
@@ -218,6 +260,9 @@ class ExtenderPolicy:
             },
             "latency": self.stats.percentiles_ms(),
         }
+        if self.placer is not None:
+            out["placements_dropped"] = self.placer.dropped
+        return out
 
 
 class _Handler(BaseHTTPRequestHandler):

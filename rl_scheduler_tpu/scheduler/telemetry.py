@@ -84,17 +84,22 @@ class PrometheusCpu:
         return float(payload["data"]["result"][0]["value"][1])
 
     def _refresh(self) -> None:
-        out = []
-        for cloud in ("aws", "azure"):
-            try:
-                out.append(self._query_one(self.urls[cloud]))
-            except Exception:
-                logger.debug("prometheus query failed for %s; using random", cloud)
-                out.append(self._fallback.sample()[0])
-        with self._lock:
-            self._cached = tuple(out)
-            self._cached_at = time.monotonic()
-            self._refreshing = False
+        try:
+            out = []
+            for cloud in ("aws", "azure"):
+                try:
+                    out.append(self._query_one(self.urls[cloud]))
+                except Exception:
+                    logger.debug("prometheus query failed for %s; using random", cloud)
+                    out.append(self._fallback.sample()[0])
+            with self._lock:
+                self._cached = tuple(out)
+                self._cached_at = time.monotonic()
+        finally:
+            # Never latch _refreshing=True: that would permanently disable
+            # refreshes and freeze telemetry on the last (or fallback) value.
+            with self._lock:
+                self._refreshing = False
 
     def sample(self) -> tuple[float, float]:
         with self._lock:
@@ -104,7 +109,11 @@ class PrometheusCpu:
             if kick:
                 self._refreshing = True
         if kick:
-            threading.Thread(target=self._refresh, daemon=True).start()
+            try:
+                threading.Thread(target=self._refresh, daemon=True).start()
+            except RuntimeError:  # thread exhaustion: retry on a later sample
+                with self._lock:
+                    self._refreshing = False
         return cached if cached is not None else self._fallback.sample()
 
 

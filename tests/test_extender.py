@@ -238,3 +238,32 @@ def test_decision_latency_under_1ms_p50(server):
     lat = policy.statistics()["latency"]
     assert lat["count"] >= 200
     assert lat["p50_ms"] < 1.0, f"decision p50 {lat['p50_ms']}ms exceeds 1ms"
+
+
+def test_async_placer_never_blocks_and_bounds_queue():
+    """A hung kube API must not block filter responses or grow unbounded
+    state: placements drain through one worker over a bounded queue."""
+    import threading
+    import time
+
+    from rl_scheduler_tpu.scheduler.extender import AsyncPlacer
+
+    release = threading.Event()
+    placed = []
+
+    class StuckPlacer:
+        def place(self, cloud):
+            release.wait(timeout=10)
+            placed.append(cloud)
+
+    ap = AsyncPlacer(StuckPlacer(), maxsize=4)
+    t0 = time.perf_counter()
+    for i in range(100):  # far more than maxsize while the worker is stuck
+        ap.submit("aws" if i % 2 else "azure")
+    assert time.perf_counter() - t0 < 1.0, "submit must never block"
+    assert ap.dropped >= 100 - 4 - 1  # all but queue capacity (+in-flight) drop
+    release.set()
+    deadline = time.time() + 5
+    while len(placed) < 4 and time.time() < deadline:
+        time.sleep(0.01)
+    assert placed, "worker must drain queued placements once unblocked"
