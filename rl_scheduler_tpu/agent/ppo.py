@@ -261,19 +261,38 @@ def ppo_train(
     log_fn: Callable[[int, dict], None] | None = None,
     checkpoint_fn: Callable[[int, RunnerState], None] | None = None,
     net: Any | None = None,
+    restore: tuple[dict, int] | None = None,
 ):
     """Host-side training loop: jitted update per iteration + logging hooks.
 
     ``env`` is either multi-cloud :class:`EnvParams` or any
     :class:`EnvBundle`. Returns ``(runner, history)`` where history is a
     list of metric dicts.
+
+    ``restore=({"params": ..., "opt_state": ...}, completed_iterations)``
+    resumes a checkpointed run mid-way (the reference never resumes —
+    SURVEY.md §5.4 — this build does): optimizer state and iteration count
+    carry over; env state and rollout RNG restart from ``seed`` folded with
+    the resume point, so the continued run sees fresh randomness rather
+    than replaying the stream the original run already consumed.
     """
     bundle = env if isinstance(env, EnvBundle) else multi_cloud_bundle(env)
     init_fn, update_fn, _ = make_ppo_bundle(bundle, cfg, net=net)
-    runner = init_fn(jax.random.PRNGKey(seed))
+    start_iteration = 0
+    key = jax.random.PRNGKey(seed)
+    if restore is not None:
+        key = jax.random.fold_in(key, restore[1])
+    runner = init_fn(key)
+    if restore is not None:
+        tree, start_iteration = restore
+        runner = runner._replace(
+            params=tree["params"],
+            opt_state=tree["opt_state"],
+            update_idx=jnp.asarray(start_iteration, jnp.int32),
+        )
     update = jax.jit(update_fn, donate_argnums=0)
     history = []
-    for i in range(num_iterations):
+    for i in range(start_iteration, num_iterations):
         runner, metrics = update(runner)
         metrics = {k: float(v) for k, v in metrics.items()}
         history.append(metrics)

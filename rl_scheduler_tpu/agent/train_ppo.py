@@ -37,9 +37,34 @@ def main(argv: list[str] | None = None) -> Path:
     p.add_argument("--keep", type=int, default=5)
     p.add_argument("--legacy-reward-sign", action="store_true",
                    help="reproduce the reference's positive reward (SURVEY.md §7.0.1)")
+    p.add_argument("--resume", action="store_true",
+                   help="continue from the latest checkpoint in the run dir "
+                        "(requires --run-name of an existing run)")
+    p.add_argument("--num-envs", type=int, default=None,
+                   help="override the preset's parallel env count")
+    p.add_argument("--rollout-steps", type=int, default=None,
+                   help="override the preset's rollout length per iteration")
+    p.add_argument("--minibatch-size", type=int, default=None)
+    p.add_argument("--hidden", default=None,
+                   help="comma-separated MLP widths, e.g. 64,64")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of the whole run into "
+                        "this directory (keep --iterations small; view in "
+                        "TensorBoard/Perfetto)")
     args = p.parse_args(argv)
 
+    import dataclasses
+
     cfg = PPO_PRESETS[args.preset]
+    overrides = {
+        k: getattr(args, k)
+        for k in ("num_envs", "rollout_steps", "minibatch_size")
+        if getattr(args, k) is not None
+    }
+    if args.hidden is not None:
+        overrides["hidden"] = tuple(int(w) for w in args.hidden.split(","))
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
     env_params = env_core.make_params(EnvConfig(legacy_reward_sign=args.legacy_reward_sign))
 
     run_name = args.run_name or f"PPO_{args.preset}_{time.strftime('%Y%m%d_%H%M%S')}"
@@ -51,12 +76,59 @@ def main(argv: list[str] | None = None) -> Path:
 
     ckpt = CheckpointManager(run_dir, keep=args.keep)
 
+    restore = None
+    if args.resume:
+        latest = ckpt.latest_step()
+        if latest is None:
+            raise SystemExit(
+                f"--resume: no checkpoints under {run_dir} — pass --run-name "
+                "of an existing run (drop --resume to start fresh)"
+            )
+        if latest >= args.iterations:
+            raise SystemExit(
+                f"--resume: run already has {latest} iterations; --iterations "
+                f"is a TOTAL, so pass a value > {latest} to train further"
+            )
+        # Validate architecture from the cheap meta record BEFORE the
+        # state restore — a hidden-size mismatch would otherwise surface
+        # as a raw Orbax structure error.
+        meta = ckpt.restore_meta(latest)
+        if meta.get("hidden") is not None and tuple(meta["hidden"]) != tuple(cfg.hidden):
+            raise SystemExit(
+                f"--resume: checkpoint hidden={meta['hidden']} does not match "
+                f"configured hidden={list(cfg.hidden)} (pass --hidden "
+                f"{','.join(str(w) for w in meta['hidden'])})"
+            )
+        ckpt_legacy = meta.get("legacy_reward_sign")
+        if ckpt_legacy is not None and ckpt_legacy != args.legacy_reward_sign:
+            raise SystemExit(
+                f"--resume: checkpoint was trained with "
+                f"legacy_reward_sign={ckpt_legacy}; resuming with the "
+                f"opposite sign would silently negate rewards mid-run "
+                f"({'add' if ckpt_legacy else 'drop'} --legacy-reward-sign)"
+            )
+        from rl_scheduler_tpu.agent.ppo import make_ppo
+
+        init_fn, _, _ = make_ppo(env_params, cfg)
+        abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(args.seed))
+        tree, _ = ckpt.restore(
+            latest,
+            target={"params": abstract.params, "opt_state": abstract.opt_state},
+        )
+        restore = (tree, latest)
+        # Mark the resume point in the metrics log so post-crash duplicate
+        # iteration entries are separable by downstream analysis.
+        metrics_file.write(json.dumps({"resumed_from_iteration": latest}) + "\n")
+        metrics_file.flush()
+        print(f"Resuming from iteration {latest} (checkpoints in {run_dir})")
+
     t_start = time.time()
     steps_per_iter = cfg.batch_size
+    start_iteration = restore[1] if restore is not None else 0
 
     def log_fn(i: int, metrics: dict) -> None:
         elapsed = time.time() - t_start
-        sps = steps_per_iter * (i + 1) / elapsed
+        sps = steps_per_iter * (i + 1 - start_iteration) / elapsed
         line = {"iteration": i + 1, "env_steps_per_sec": round(sps, 1), **metrics}
         metrics_file.write(json.dumps(line) + "\n")
         metrics_file.flush()
@@ -75,8 +147,17 @@ def main(argv: list[str] | None = None) -> Path:
 
     print(f"Training PPO preset={args.preset} on {jax.devices()[0].platform} "
           f"({cfg.num_envs} envs x {cfg.rollout_steps} steps/iter)")
-    ppo_train(env_params, cfg, args.iterations, seed=args.seed,
-              log_fn=log_fn, checkpoint_fn=checkpoint_fn)
+    if args.profile_dir is not None:
+        from rl_scheduler_tpu.utils.profiling import trace_iterations
+
+        ctx = trace_iterations(args.profile_dir)
+    else:
+        import contextlib
+
+        ctx = contextlib.nullcontext()
+    with ctx:
+        ppo_train(env_params, cfg, args.iterations, seed=args.seed,
+                  log_fn=log_fn, checkpoint_fn=checkpoint_fn, restore=restore)
     metrics_file.close()
     print(f"Training finished! Checkpoints in {run_dir}")
     return run_dir

@@ -12,6 +12,18 @@ import jax
 import jax.numpy as jnp
 
 
+def default_platform() -> str:
+    """Platform the default device lives on.
+
+    ``jax_default_device`` may hold a ``Device`` or (since JAX accepts
+    platform strings) a plain ``str`` like ``"cpu"`` — handle both.
+    """
+    pinned = jax.config.jax_default_device
+    if pinned is None:
+        return jax.default_backend()
+    return getattr(pinned, "platform", str(pinned))
+
+
 def gae(
     rewards: jnp.ndarray,     # [T, N]
     values: jnp.ndarray,      # [T, N] V(s_t)
@@ -33,9 +45,7 @@ def gae(
     explicitly.
     """
     if impl == "auto":
-        pinned = jax.config.jax_default_device
-        platform = pinned.platform if pinned is not None else jax.default_backend()
-        impl = "pallas" if platform == "tpu" else "scan"
+        impl = "pallas" if default_platform() == "tpu" else "scan"
     if impl == "pallas":
         from rl_scheduler_tpu.ops.pallas_gae import gae_pallas
 

@@ -79,9 +79,9 @@ def gae_pallas(
     interpreter mode off-TPU so tests run on CPU.
     """
     if interpret is None:
-        pinned = jax.config.jax_default_device
-        platform = pinned.platform if pinned is not None else jax.default_backend()
-        interpret = platform != "tpu"
+        from rl_scheduler_tpu.ops.gae import default_platform
+
+        interpret = default_platform() != "tpu"
     num_steps, n = rewards.shape
     rewards = rewards.astype(jnp.float32)
     values = values.astype(jnp.float32)

@@ -29,9 +29,17 @@ CLOUD_CONTEXTS = {
 class DryRunPodPlacer:
     """Dry-run pod creation against per-cloud kind clusters."""
 
-    def __init__(self, namespace: str = "default", image: str = "nginx:alpine"):
+    def __init__(
+        self,
+        namespace: str = "default",
+        image: str = "nginx:alpine",
+        request_timeout: float = 10.0,
+    ):
         self.namespace = namespace
         self.image = image
+        # Bounded (connect, read) timeout: without it one stalled kube API
+        # connection wedges AsyncPlacer's single drain thread forever.
+        self.request_timeout = request_timeout
         self._clients: dict[str, object] = {}
         self._warned: set[str] = set()
         self._load_clients()
@@ -74,6 +82,7 @@ class DryRunPodPlacer:
                 namespace=self.namespace,
                 body=pod,
                 dry_run="All" if dry_run else None,
+                _request_timeout=(5.0, self.request_timeout),
             )
             return True
         except Exception as e:  # noqa: BLE001 - surface, don't crash the env loop

@@ -40,6 +40,17 @@ class CheckpointManager:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def restore_meta(self, step: int | None = None) -> dict:
+        """Restore only the extras dict (cheap; no state tree involved)."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.run_dir}")
+        out = self._mgr.restore(
+            step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+        )
+        return dict(out["meta"] or {})
+
     def restore(self, step: int | None = None, target: Any | None = None):
         """Restore ``(tree, extras)``. With ``target`` given, the tree is
         restored with the target's exact pytree structure (needed for

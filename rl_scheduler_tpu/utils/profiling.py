@@ -1,0 +1,84 @@
+"""Tracing / profiling harness (SURVEY.md §5.1 — the reference has none).
+
+Two tools:
+
+- :func:`trace_iterations` — a ``jax.profiler`` trace context writing a
+  TensorBoard/Perfetto-compatible trace (XLA ops, fusion boundaries, HBM
+  transfers) for everything run inside it. View with
+  ``tensorboard --logdir <dir>`` (Profile tab) or upload the
+  ``.trace.json.gz`` to ``ui.perfetto.dev``.
+- :class:`StepTimer` — wall-clock timing of a jitted step function with
+  proper device synchronization (``block_until_ready`` per sample), giving
+  p50/mean step latency and env-steps/sec/chip — the BASELINE.json metric.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace_iterations(log_dir: str | Path):
+    """Capture a ``jax.profiler`` trace of the enclosed block into ``log_dir``."""
+    log_dir = str(log_dir)
+    Path(log_dir).mkdir(parents=True, exist_ok=True)
+    with jax.profiler.trace(log_dir):
+        yield log_dir
+
+
+@dataclasses.dataclass
+class StepReport:
+    iters: int
+    mean_s: float
+    p50_s: float
+    p90_s: float
+    env_steps_per_sec: float
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class StepTimer:
+    """Time a jitted step over N iterations, excluding compile.
+
+    ``fn`` must take and return the carried state: ``fn(state) -> state``
+    by default, or ``fn(state) -> (state, aux)`` with ``returns_aux=True``
+    (an explicit flag — a tuple-valued *state* would be indistinguishable
+    from a ``(state, aux)`` pair by inspection). One warmup call triggers
+    compilation before timing starts.
+    """
+
+    def __init__(self, fn, env_steps_per_iter: int = 1, returns_aux: bool = False):
+        self._fn = fn
+        self._steps_per_iter = env_steps_per_iter
+        self._returns_aux = returns_aux
+
+    def _step(self, state):
+        out = self._fn(state)
+        return out[0] if self._returns_aux else out
+
+    def run(self, state, iters: int = 10) -> tuple:
+        state = self._step(state)
+        jax.block_until_ready(state)
+
+        samples = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            state = self._step(state)
+            jax.block_until_ready(state)
+            samples.append(time.perf_counter() - t0)
+        arr = np.asarray(samples)
+        report = StepReport(
+            iters=iters,
+            mean_s=float(arr.mean()),
+            p50_s=float(np.percentile(arr, 50)),
+            p90_s=float(np.percentile(arr, 90)),
+            env_steps_per_sec=float(self._steps_per_iter / arr.mean()),
+        )
+        return state, report

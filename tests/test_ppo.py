@@ -85,3 +85,43 @@ def test_ppo_converges_to_optimal_policy(env_params):
     learned_cost = weighted[np.arange(99), greedy[:99]].sum()
     assert learned_cost <= baseline_cost + 1e-3
     assert learned_cost <= greedy_cost * 1.05
+
+
+def test_ppo_resume_continues_training(env_params, tmp_path):
+    """restore=(tree, step) resumes learning state; CLI --resume round-trips
+    through Orbax checkpoints (SURVEY.md §5.4 — capability the reference lacks)."""
+    cfg = PPOTrainConfig(
+        num_envs=8, rollout_steps=20, minibatch_size=64, num_epochs=2,
+        hidden=(16, 16),
+    )
+    runner_a, _ = ppo_train(env_params, cfg, 2, seed=7)
+    tree = {"params": runner_a.params, "opt_state": runner_a.opt_state}
+    runner_b, history_b = ppo_train(env_params, cfg, 4, seed=7, restore=(tree, 2))
+    assert len(history_b) == 2  # only iterations 3 and 4 ran
+    assert int(runner_b.update_idx) == 4
+
+    # Resumed run matches an uninterrupted one's learning trajectory in
+    # param space (same seed => same rollout randomness after restore point
+    # is NOT guaranteed, so compare against loss finiteness + progression).
+    assert np.isfinite(history_b[-1]["policy_loss"])
+
+
+def test_train_cli_resume_roundtrip(tmp_path):
+    from rl_scheduler_tpu.agent import train_ppo as cli
+    from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+    common = [
+        "--preset", "quick", "--num-envs", "8", "--rollout-steps", "20",
+        "--minibatch-size", "64", "--hidden", "16,16",
+        "--run-root", str(tmp_path), "--run-name", "resume_test",
+        "--checkpoint-every", "1",
+    ]
+    cli.main(common + ["--iterations", "2"])
+    mgr = CheckpointManager(tmp_path / "resume_test")
+    assert mgr.latest_step() == 2
+    mgr.close()
+
+    cli.main(common + ["--iterations", "4", "--resume"])
+    mgr = CheckpointManager(tmp_path / "resume_test")
+    assert mgr.latest_step() == 4
+    mgr.close()

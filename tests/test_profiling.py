@@ -1,0 +1,34 @@
+"""Profiling harness: trace capture writes artifacts; StepTimer reports."""
+
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+from rl_scheduler_tpu.utils.profiling import StepTimer, trace_iterations
+
+
+def test_trace_iterations_writes_trace(tmp_path):
+    @jax.jit
+    def f(x):
+        return x * 2.0 + 1.0
+
+    with trace_iterations(tmp_path / "trace") as d:
+        jax.block_until_ready(f(jnp.ones((128,))))
+    files = list(Path(d).rglob("*"))
+    assert any(p.is_file() for p in files), "profiler trace produced no files"
+
+
+def test_step_timer_reports_throughput():
+    @jax.jit
+    def step(x):
+        return x + 1.0
+
+    timer = StepTimer(step, env_steps_per_iter=4096)
+    state, report = timer.run(jnp.zeros((16,)), iters=5)
+    assert report.iters == 5
+    assert report.mean_s > 0
+    assert report.env_steps_per_sec > 0
+    assert float(state[0]) == 6.0  # warmup + 5 timed iterations
+    d = report.as_dict()
+    assert set(d) == {"iters", "mean_s", "p50_s", "p90_s", "env_steps_per_sec"}
