@@ -285,6 +285,10 @@ def ppo_train(
     runner = init_fn(key)
     if restore is not None:
         tree, start_iteration = restore
+        # Copy the restored leaves: the jitted update donates the runner's
+        # buffers, which would otherwise delete the caller's checkpoint
+        # tree out from under it on accelerator backends.
+        tree = jax.tree.map(lambda x: jnp.array(x, copy=True), tree)
         runner = runner._replace(
             params=tree["params"],
             opt_state=tree["opt_state"],

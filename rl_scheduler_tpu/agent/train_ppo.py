@@ -93,6 +93,13 @@ def main(argv: list[str] | None = None) -> Path:
         # state restore — a hidden-size mismatch would otherwise surface
         # as a raw Orbax structure error.
         meta = ckpt.restore_meta(latest)
+        ckpt_preset = meta.get("preset")
+        if ckpt_preset is not None and ckpt_preset != args.preset:
+            raise SystemExit(
+                f"--resume: run was trained with --preset {ckpt_preset}; "
+                f"resuming as {args.preset!r} would silently switch optimizer "
+                f"hyperparameters mid-run (pass --preset {ckpt_preset})"
+            )
         if meta.get("hidden") is not None and tuple(meta["hidden"]) != tuple(cfg.hidden):
             raise SystemExit(
                 f"--resume: checkpoint hidden={meta['hidden']} does not match "

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
 
 
 class PointerActorCriticHead(nn.Module):
@@ -17,15 +18,24 @@ class PointerActorCriticHead(nn.Module):
     Per-node scalar score from a shared Dense (pointer head, small init so
     initial policy is near-uniform); value from a tanh MLP over the
     mean-pooled node embeddings.
+
+    ``pool_axis_name``: when the node axis is sharded over a mesh axis
+    (sequence parallelism, ``parallel/ring_attention.py``), the value
+    pool must average over the GLOBAL set — equal shards mean a ``pmean``
+    of local means is exactly the global mean. Logits stay local (one
+    score per local node; the caller's out-spec reassembles them).
     """
 
     dim: int = 64
+    pool_axis_name: str | None = None
 
     @nn.compact
     def __call__(self, h):
         logits = nn.Dense(1, kernel_init=nn.initializers.orthogonal(0.01),
                           name="score_head")(h)[..., 0]
         pooled = h.mean(axis=-2)
+        if self.pool_axis_name is not None:
+            pooled = lax.pmean(pooled, self.pool_axis_name)
         v = nn.tanh(nn.Dense(self.dim, name="value_hidden")(pooled))
         value = nn.Dense(1, kernel_init=nn.initializers.orthogonal(1.0),
                          name="value_head")(v)[..., 0]

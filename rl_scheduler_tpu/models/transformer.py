@@ -17,6 +17,8 @@ same module shards over the mesh via the sequence-parallel attention in
 
 from __future__ import annotations
 
+from typing import Callable
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -28,17 +30,26 @@ from rl_scheduler_tpu.models.heads import (
 
 class SelfAttentionBlock(nn.Module):
     """Pre-LN multi-head self-attention + MLP (standard transformer block,
-    no positional anything)."""
+    no positional anything).
+
+    ``attention_fn``: optional override for the attention inner — the
+    sequence-parallel path injects ring attention here; ``None`` keeps
+    flax's dense ``dot_product_attention``.
+    """
 
     dim: int
     num_heads: int = 4
     mlp_ratio: int = 2
+    attention_fn: Callable | None = None
 
     @nn.compact
     def __call__(self, x):  # [..., N, dim]
         h = nn.LayerNorm()(x)
+        attn_kwargs = {}
+        if self.attention_fn is not None:
+            attn_kwargs["attention_fn"] = self.attention_fn
         h = nn.MultiHeadDotProductAttention(
-            num_heads=self.num_heads, qkv_features=self.dim
+            num_heads=self.num_heads, qkv_features=self.dim, **attn_kwargs
         )(h, h)
         x = x + h
         h = nn.LayerNorm()(x)
@@ -54,20 +65,42 @@ class SetTransformerPolicy(nn.Module):
     Input ``[B, N, feat]`` (or unbatched ``[N, feat]``); returns
     ``(logits [B, N], value [B])`` — one logit per candidate node
     (pointer-style head), value from the mean-pooled set embedding.
+
+    ``axis_name``: set to a mesh axis name to run SEQUENCE-PARALLEL under
+    ``shard_map`` — the node axis of ``obs`` sharded over that axis,
+    params replicated. Attention goes through ring attention
+    (``parallel/ring_attention.py``: K/V rotate over ICI with online
+    softmax, exact result) and the value pool ``pmean``s over the axis;
+    everything else (embed, LayerNorm, MLP, scores) is per-node and needs
+    no communication. Parameter shapes are identical with/without
+    ``axis_name``, so a single-chip checkpoint serves sharded and back.
     """
 
     dim: int = 64
     depth: int = 2
     num_heads: int = 4
+    axis_name: str | None = None
 
     @nn.compact
     def __call__(self, obs):
-        head = PointerActorCriticHead(self.dim, name="head")
+        head = PointerActorCriticHead(
+            self.dim, pool_axis_name=self.axis_name, name="head"
+        )
+        attention_fn = None
+        if self.axis_name is not None:
+            from rl_scheduler_tpu.parallel.ring_attention import (
+                make_flax_attention_fn,
+            )
+
+            attention_fn = make_flax_attention_fn(self.axis_name)
 
         def forward(batched_obs):
             x = nn.Dense(self.dim, name="embed")(batched_obs)  # [B, N, dim]
             for i in range(self.depth):
-                x = SelfAttentionBlock(self.dim, self.num_heads, name=f"block_{i}")(x)
+                x = SelfAttentionBlock(
+                    self.dim, self.num_heads,
+                    attention_fn=attention_fn, name=f"block_{i}",
+                )(x)
             x = nn.LayerNorm(name="final_norm")(x)
             return head(x)
 

@@ -1,0 +1,69 @@
+"""Multi-host (DCN) initialization for ``jax.distributed``.
+
+The reference's only distribution story is Ray actors on one machine
+(SURVEY.md §5.8). Here single-host multi-chip needs nothing (XLA sees all
+local chips over ICI); spanning hosts — a v4 pod slice, or CPU fleets —
+goes through ``jax.distributed.initialize`` so every host contributes its
+local devices to one global mesh and collectives route ICI-first,
+DCN-across-hosts. Meshes built with :func:`~rl_scheduler_tpu.parallel.mesh.make_mesh`
+then transparently span hosts (``jax.devices()`` becomes global).
+
+Call :func:`maybe_initialize_distributed` once at process start. It is a
+no-op (returns ``False``) unless multi-host coordinates are provided
+explicitly or via environment — safe to call unconditionally from every
+entry point.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+_ENV_COORDINATOR = "RL_SCHED_COORDINATOR"   # host:port of process 0
+_ENV_NUM_PROCS = "RL_SCHED_NUM_PROCESSES"
+_ENV_PROC_ID = "RL_SCHED_PROCESS_ID"
+
+
+def maybe_initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialize ``jax.distributed`` when multi-host coordinates exist.
+
+    Resolution order: explicit arguments, then ``RL_SCHED_COORDINATOR`` /
+    ``RL_SCHED_NUM_PROCESSES`` / ``RL_SCHED_PROCESS_ID`` env vars, then
+    JAX's own auto-detection on managed TPU pods (where
+    ``jax.distributed.initialize()`` needs no arguments — detected via
+    the standard TPU pod metadata envs). Returns ``True`` iff
+    initialization ran.
+    """
+    coordinator_address = coordinator_address or os.environ.get(_ENV_COORDINATOR)
+    if num_processes is None and os.environ.get(_ENV_NUM_PROCS):
+        num_processes = int(os.environ[_ENV_NUM_PROCS])
+    if process_id is None and os.environ.get(_ENV_PROC_ID):
+        process_id = int(os.environ[_ENV_PROC_ID])
+
+    if coordinator_address is None:
+        # Managed TPU pods export their own topology envs and need no
+        # explicit coordinates; anything else stays single-process.
+        if os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+            jax.distributed.initialize()
+            logger.info("jax.distributed initialized from TPU pod metadata")
+            return True
+        return False
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "jax.distributed initialized: process %s/%s via %s",
+        process_id, num_processes, coordinator_address,
+    )
+    return True

@@ -1,0 +1,111 @@
+"""Ring attention: sequence-parallel self-attention over a mesh axis.
+
+The reference has no sequence models at all (SURVEY.md §5.7), but this
+framework's set-transformer policy (BASELINE config 4) attends over
+pod/node sets, and at datacenter scale a "set" is tens of thousands of
+nodes — too large for one chip's VMEM-friendly attention. The TPU-native
+answer is ring attention: shard the node/sequence axis over a mesh axis,
+keep Q local, and rotate K/V blocks around the ring with
+``lax.ppermute`` (ICI neighbor exchange) while accumulating the softmax
+online (flash-attention style running max/sum), so the full quadratic
+attention is computed exactly — never materializing the global
+``[N, N]`` score matrix on any chip — with communication overlapping
+compute around the ring.
+
+Layouts follow flax: ``[..., seq, heads, head_dim]``. All math runs in
+f32 accumulation regardless of input dtype (bf16-safe).
+
+Use :func:`make_flax_attention_fn` to drop this into
+``nn.MultiHeadDotProductAttention(attention_fn=...)`` — the set
+transformer threads it through via its ``axis_name`` field.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _dense_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                     scale: float) -> jnp.ndarray:
+    scores = jnp.einsum("...qhd,...khd->...hqk", q, k) * scale
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    return jnp.einsum("...hqk,...khd->...qhd", probs.astype(v.dtype), v)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str | None = None,
+) -> jnp.ndarray:
+    """Exact global softmax attention with the sequence axis sharded.
+
+    ``q``/``k``/``v``: local shards ``[..., n_local, H, D]`` inside a
+    ``shard_map`` whose mesh has ``axis_name``; every device ends with the
+    attention output for ITS queries against the GLOBAL key/value set.
+    With ``axis_name=None`` (or ring size 1) this is plain dense attention
+    — the single-chip fallback, numerically identical.
+    """
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    if axis_name is None:
+        return _dense_attention(q, k, v, scale)
+    ring = lax.axis_size(axis_name)
+    if ring == 1:
+        return _dense_attention(q, k, v, scale)
+
+    f32 = jnp.float32
+    # Running accumulators (flash-attention online softmax), f32:
+    #   m [..., H, n_q]      running row max
+    #   l [..., H, n_q]      running sum of exp(scores - m)
+    #   acc [..., n_q, H, D] running weighted values
+    batch_hq = (*q.shape[:-3], q.shape[-2], q.shape[-3])
+    m = jnp.full(batch_hq, -jnp.inf, f32)
+    l = jnp.zeros(batch_hq, f32)
+    acc = jnp.zeros(q.shape, f32)
+    qf = q.astype(f32)
+
+    perm = [(i, (i + 1) % ring) for i in range(ring)]
+    for step in range(ring):
+        scores = jnp.einsum("...qhd,...khd->...hqk", qf, k.astype(f32)) * scale
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        correction = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        l = l * correction + p.sum(axis=-1)
+        weighted = jnp.einsum("...hqk,...khd->...qhd", p, v.astype(f32))
+        corr_qh = jnp.swapaxes(correction, -2, -1)[..., None]  # [..., n_q, H, 1]
+        acc = acc * corr_qh + weighted
+        m = m_new
+        if step != ring - 1:
+            # Rotate K/V one hop around the ring (ICI neighbor exchange).
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+
+    out = acc / jnp.swapaxes(l, -2, -1)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_flax_attention_fn(axis_name: str | None) -> Callable:
+    """An ``attention_fn`` for ``nn.MultiHeadDotProductAttention``.
+
+    Supports the set-policy use case: no bias/mask (sets are unpadded
+    here), no attention dropout. Anything else is a loud error rather
+    than silently-wrong attention.
+    """
+
+    def attention_fn(query, key, value, bias=None, mask=None,
+                     dropout_rate: float = 0.0, **_ignored):
+        if bias is not None or mask is not None:
+            raise NotImplementedError(
+                "ring attention_fn does not support bias/mask"
+            )
+        if dropout_rate:
+            raise NotImplementedError(
+                "ring attention_fn does not support attention dropout"
+            )
+        return ring_attention(query, key, value, axis_name=axis_name)
+
+    return attention_fn

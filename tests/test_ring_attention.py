@@ -1,0 +1,106 @@
+"""Ring attention: exact equivalence with dense attention, and the full
+sequence-parallel set-transformer forward matching the single-chip one.
+
+Runs on the 8 virtual CPU devices from conftest; the same code rides ICI
+on a real TPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from rl_scheduler_tpu.models.transformer import SetTransformerPolicy
+from rl_scheduler_tpu.parallel import make_mesh, ring_attention
+from rl_scheduler_tpu.parallel.ring_attention import make_flax_attention_fn
+
+B, N, H, D = 2, 32, 4, 16
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    return tuple(
+        jax.random.normal(k, (B, N, H, D), jnp.float32) for k in keys
+    )
+
+
+def test_ring_matches_dense_on_mesh(qkv):
+    q, k, v = qkv
+    dense = ring_attention(q, k, v, axis_name=None)
+    mesh = make_mesh({"sp": 8})
+    spec = P(None, "sp", None, None)
+    ringed = jax.jit(
+        shard_map(
+            lambda a, b, c: ring_attention(a, b, c, axis_name="sp"),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(ringed), np.asarray(dense),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_size_one_is_dense(qkv):
+    q, k, v = qkv
+    mesh = make_mesh({"sp": 1})
+    out = jax.jit(
+        shard_map(
+            lambda a, b, c: ring_attention(a, b, c, axis_name="sp"),
+            mesh=mesh,
+            in_specs=(P(), P(), P()),
+            out_specs=P(),
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ring_attention(q, k, v)), rtol=1e-6
+    )
+
+
+def test_flax_attention_fn_rejects_mask(qkv):
+    q, k, v = qkv
+    fn = make_flax_attention_fn(None)
+    with pytest.raises(NotImplementedError):
+        fn(q, k, v, mask=jnp.ones((B, 1, N, N), bool))
+    with pytest.raises(NotImplementedError):
+        fn(q, k, v, dropout_rate=0.1)
+    np.testing.assert_allclose(
+        np.asarray(fn(q, k, v)), np.asarray(ring_attention(q, k, v)), rtol=1e-6
+    )
+
+
+def test_sequence_parallel_policy_matches_single_chip():
+    """Full forward: params from the single-chip module drive the sharded
+    module bit-compatibly (identical param shapes by construction)."""
+    feat, nodes = 6, 16
+    obs = jax.random.normal(jax.random.PRNGKey(1), (B, nodes, feat))
+    single = SetTransformerPolicy(dim=32, depth=2, num_heads=4)
+    params = single.init(jax.random.PRNGKey(2), obs)
+    logits_ref, value_ref = single.apply(params, obs)
+
+    mesh = make_mesh({"sp": 8})
+    sharded = SetTransformerPolicy(dim=32, depth=2, num_heads=4, axis_name="sp")
+
+    logits_sp, value_sp = jax.jit(
+        shard_map(
+            lambda p, o: sharded.apply(p, o),
+            mesh=mesh,
+            in_specs=(P(), P(None, "sp", None)),
+            out_specs=(P(None, "sp"), P()),
+        )
+    )(params, obs)
+
+    np.testing.assert_allclose(np.asarray(logits_sp), np.asarray(logits_ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(value_sp), np.asarray(value_ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_distributed_noop_without_coordinates(monkeypatch):
+    from rl_scheduler_tpu.parallel import maybe_initialize_distributed
+
+    for var in ("RL_SCHED_COORDINATOR", "TPU_WORKER_HOSTNAMES",
+                "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(var, raising=False)
+    assert maybe_initialize_distributed() is False
