@@ -53,6 +53,10 @@ def main(argv: list[str] | None = None) -> Path:
                         "TensorBoard/Perfetto)")
     args = p.parse_args(argv)
 
+    from rl_scheduler_tpu.parallel import maybe_initialize_distributed
+
+    maybe_initialize_distributed()  # no-op unless multi-host coords are set
+
     import dataclasses
 
     cfg = PPO_PRESETS[args.preset]

@@ -1,0 +1,132 @@
+"""Build + load the native inference core (``mlp_infer.cpp``) via ctypes.
+
+Build-on-first-use: ``g++ -O3 -shared -fPIC`` into the user cache dir,
+keyed on the source hash so edits rebuild automatically. Everything
+degrades gracefully — no compiler, no ``.so``, or a load error just means
+the caller falls back to the numpy path (``ensure_built`` returns
+``None``). ``make`` in this directory does the same build explicitly.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SRC = Path(__file__).with_name("mlp_infer.cpp")
+ABI_VERSION = 1
+
+
+def _cache_dir() -> Path:
+    root = os.environ.get("XDG_CACHE_HOME") or (Path.home() / ".cache")
+    return Path(root) / "rl_scheduler_tpu"
+
+
+def ensure_built(force: bool = False) -> Path | None:
+    """Compile the shared library if needed; returns its path or ``None``."""
+    if not _SRC.exists():
+        return None
+    digest = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+    out = _cache_dir() / f"libmlp_infer_{digest}.so"
+    if out.exists() and not force:
+        return out
+    out.parent.mkdir(parents=True, exist_ok=True)
+    # Compile to a temp name + atomic rename: concurrent builders race safely.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=out.parent)
+    os.close(fd)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           str(_SRC), "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
+        return out
+    except (subprocess.SubprocessError, OSError) as e:
+        logger.warning("native build failed (%s); using numpy fallback", e)
+        Path(tmp).unlink(missing_ok=True)
+        return None
+
+
+def pack_mlp(layers: list[tuple[np.ndarray, np.ndarray]]) -> tuple[np.ndarray, np.ndarray]:
+    """Pack ``[(kernel [in,out], bias [out]), ...]`` into the flat
+    ``(weights, dims)`` buffers ``mlp_create`` expects."""
+    dims = [layers[0][0].shape[0]]
+    chunks = []
+    for kernel, bias in layers:
+        if kernel.shape[0] != dims[-1] or kernel.shape[1] != bias.shape[0]:
+            raise ValueError(
+                f"inconsistent layer shapes: {kernel.shape} after width {dims[-1]}"
+            )
+        dims.append(kernel.shape[1])
+        chunks.append(np.ascontiguousarray(kernel, np.float32).ravel())
+        chunks.append(np.ascontiguousarray(bias, np.float32).ravel())
+    return np.concatenate(chunks), np.asarray(dims, np.int32)
+
+
+class NativeMLP:
+    """ctypes wrapper over one packed MLP; ``decide`` is thread-safe."""
+
+    def __init__(self, layers: list[tuple[np.ndarray, np.ndarray]],
+                 lib_path: Path | None = None):
+        lib_path = lib_path or ensure_built()
+        if lib_path is None:
+            raise RuntimeError("native library unavailable")
+        lib = ctypes.CDLL(str(lib_path))
+        lib.mlp_create.restype = ctypes.c_void_p
+        lib.mlp_create.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        lib.mlp_decide.restype = ctypes.c_int32
+        lib.mlp_decide.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.mlp_destroy.argtypes = [ctypes.c_void_p]
+        lib.mlp_abi_version.restype = ctypes.c_int32
+        if lib.mlp_abi_version() != ABI_VERSION:
+            raise RuntimeError("native library ABI mismatch; rebuild")
+        self._lib = lib
+
+        weights, dims = pack_mlp(layers)
+        self._obs_dim = int(dims[0])
+        self._out_dim = int(dims[-1])
+        handle = lib.mlp_create(
+            weights.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            dims.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(dims),
+        )
+        if not handle:
+            raise RuntimeError("mlp_create rejected the packed weights")
+        self._handle = handle
+
+    @property
+    def obs_dim(self) -> int:
+        return self._obs_dim
+
+    def decide(self, obs: np.ndarray) -> tuple[int, np.ndarray]:
+        obs = np.ascontiguousarray(obs, np.float32)
+        if obs.shape != (self._obs_dim,):
+            raise ValueError(f"expected obs shape ({self._obs_dim},), got {obs.shape}")
+        logits = np.empty(self._out_dim, np.float32)
+        action = self._lib.mlp_decide(
+            self._handle,
+            obs.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            logits.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        return int(action), logits
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.mlp_destroy(handle)
+            self._handle = None

@@ -1,0 +1,104 @@
+// Native MLP inference core for the scheduler extender's CPU serving path.
+//
+// The serving contract (<1 ms p50 per placement decision, SURVEY.md §6 /
+// BASELINE.json) is easily met by the numpy fallback, but every layer of
+// Python dispatch costs tens of microseconds under load; this core runs the
+// whole tanh-MLP actor forward in one C call so the extender's hot path is
+// a single ctypes hop. Weights are packed once at load time; decide() uses
+// only stack/scratch-free per-call state, so it is safe to call from many
+// server threads concurrently on one handle.
+//
+// Layout contract (must match rl_scheduler_tpu/native/build.py pack_mlp):
+//   dims   = [d_0, d_1, ..., d_L]   layer widths, d_0 = obs dim
+//   weights = for each layer i: kernel (d_i x d_{i+1}, row-major, numpy
+//             [in, out] order) followed by bias (d_{i+1})
+// Hidden layers apply tanh; the final layer is linear (logits).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Layer {
+  std::vector<float> kernel;  // [in * out], row-major [in][out]
+  std::vector<float> bias;    // [out]
+  int in = 0;
+  int out = 0;
+};
+
+struct MLP {
+  std::vector<Layer> layers;
+  int max_width = 0;
+};
+
+void forward_layer(const Layer& l, const float* x, float* y, bool activate) {
+  for (int j = 0; j < l.out; ++j) y[j] = l.bias[j];
+  for (int i = 0; i < l.in; ++i) {
+    const float xi = x[i];
+    const float* row = l.kernel.data() + static_cast<size_t>(i) * l.out;
+    for (int j = 0; j < l.out; ++j) y[j] += xi * row[j];
+  }
+  if (activate) {
+    for (int j = 0; j < l.out; ++j) y[j] = std::tanh(y[j]);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle, or nullptr on invalid arguments.
+void* mlp_create(const float* weights, const int32_t* dims, int32_t n_dims) {
+  if (weights == nullptr || dims == nullptr || n_dims < 2) return nullptr;
+  auto* mlp = new MLP();
+  size_t off = 0;
+  for (int32_t i = 0; i + 1 < n_dims; ++i) {
+    if (dims[i] <= 0 || dims[i + 1] <= 0) {
+      delete mlp;
+      return nullptr;
+    }
+    Layer l;
+    l.in = dims[i];
+    l.out = dims[i + 1];
+    l.kernel.assign(weights + off, weights + off + static_cast<size_t>(l.in) * l.out);
+    off += static_cast<size_t>(l.in) * l.out;
+    l.bias.assign(weights + off, weights + off + l.out);
+    off += l.out;
+    if (l.out > mlp->max_width) mlp->max_width = l.out;
+    if (l.in > mlp->max_width) mlp->max_width = l.in;
+    mlp->layers.push_back(std::move(l));
+  }
+  return mlp;
+}
+
+// Full forward pass; writes final-layer outputs into logits_out (size =
+// last dim). Returns argmax index, or -1 on null handle. Thread-safe.
+int32_t mlp_decide(const void* handle, const float* obs, float* logits_out) {
+  const auto* mlp = static_cast<const MLP*>(handle);
+  if (mlp == nullptr || mlp->layers.empty()) return -1;
+  std::vector<float> a(mlp->max_width), b(mlp->max_width);
+  const size_t n = mlp->layers.size();
+  std::memcpy(a.data(), obs, sizeof(float) * mlp->layers[0].in);
+  float* x = a.data();
+  float* y = b.data();
+  for (size_t i = 0; i < n; ++i) {
+    forward_layer(mlp->layers[i], x, y, /*activate=*/i + 1 < n);
+    std::swap(x, y);
+  }
+  // Result lives in x after the final swap.
+  const int out_dim = mlp->layers.back().out;
+  int best = 0;
+  for (int j = 0; j < out_dim; ++j) {
+    logits_out[j] = x[j];
+    if (x[j] > x[best]) best = j;
+  }
+  return best;
+}
+
+void mlp_destroy(void* handle) { delete static_cast<MLP*>(handle); }
+
+int32_t mlp_abi_version() { return 1; }
+
+}  // extern "C"

@@ -50,13 +50,29 @@ def maybe_initialize_distributed(
 
     if coordinator_address is None:
         # Managed TPU pods export their own topology envs and need no
-        # explicit coordinates; anything else stays single-process.
-        if os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
-            jax.distributed.initialize()
+        # explicit coordinates. Require MORE THAN ONE worker hostname:
+        # single-chip runtimes (e.g. a tunneled dev chip) also export
+        # TPU_WORKER_HOSTNAMES, and initialize() would fail there.
+        hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        multihost_pod = len([h for h in hostnames.split(",") if h.strip()]) > 1
+        if multihost_pod or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+            try:
+                jax.distributed.initialize()
+            except (ValueError, RuntimeError) as e:
+                # Auto-detection is best-effort; a single-host run must
+                # never die on it.
+                logger.warning("jax.distributed auto-init skipped: %s", e)
+                return False
             logger.info("jax.distributed initialized from TPU pod metadata")
             return True
         return False
 
+    if num_processes is None or process_id is None:
+        raise ValueError(
+            f"{_ENV_COORDINATOR} is set but the coordinate triple is "
+            f"incomplete: also set {_ENV_NUM_PROCS} and {_ENV_PROC_ID} "
+            "(or pass num_processes/process_id explicitly)"
+        )
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

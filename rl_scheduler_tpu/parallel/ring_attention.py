@@ -63,13 +63,18 @@ def ring_attention(
     #   l [..., H, n_q]      running sum of exp(scores - m)
     #   acc [..., n_q, H, D] running weighted values
     batch_hq = (*q.shape[:-3], q.shape[-2], q.shape[-3])
-    m = jnp.full(batch_hq, -jnp.inf, f32)
-    l = jnp.zeros(batch_hq, f32)
-    acc = jnp.zeros(q.shape, f32)
+    # pvary: the accumulators are constant-initialized but become
+    # device-varying inside the ring loop; shard_map's varying-axis check
+    # requires the fori_loop carry to be varying from the start.
+    m = lax.pvary(jnp.full(batch_hq, -jnp.inf, f32), axis_name)
+    l = lax.pvary(jnp.zeros(batch_hq, f32), axis_name)
+    acc = lax.pvary(jnp.zeros(q.shape, f32), axis_name)
     qf = q.astype(f32)
 
     perm = [(i, (i + 1) % ring) for i in range(ring)]
-    for step in range(ring):
+
+    def ring_step(_, carry):
+        k, v, m, l, acc = carry
         scores = jnp.einsum("...qhd,...khd->...hqk", qf, k.astype(f32)) * scale
         m_new = jnp.maximum(m, scores.max(axis=-1))
         correction = jnp.exp(m - m_new)
@@ -78,11 +83,16 @@ def ring_attention(
         weighted = jnp.einsum("...hqk,...khd->...qhd", p, v.astype(f32))
         corr_qh = jnp.swapaxes(correction, -2, -1)[..., None]  # [..., n_q, H, 1]
         acc = acc * corr_qh + weighted
-        m = m_new
-        if step != ring - 1:
-            # Rotate K/V one hop around the ring (ICI neighbor exchange).
-            k = lax.ppermute(k, axis_name, perm)
-            v = lax.ppermute(v, axis_name, perm)
+        # Rotate K/V one hop around the ring (ICI neighbor exchange). The
+        # final rotation returns each block to its owner — one redundant
+        # hop in exchange for an O(1)-size program: fori_loop keeps the
+        # HLO constant in ring size (a pod-scale ring would otherwise
+        # unroll hundreds of step bodies per attention call).
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        return k, v, m_new, l, acc
+
+    _, _, _, l, acc = lax.fori_loop(0, ring, ring_step, (k, v, m, l, acc))
 
     out = acc / jnp.swapaxes(l, -2, -1)[..., None]
     return out.astype(q.dtype)

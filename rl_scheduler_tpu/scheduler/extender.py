@@ -354,7 +354,8 @@ def build_policy(
 
 def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--backend", default="jax", choices=("jax", "cpu", "torch", "greedy"))
+    p.add_argument("--backend", default="jax",
+                   choices=("jax", "cpu", "native", "torch", "greedy"))
     p.add_argument("--run", default=None, help="checkpoint run dir")
     p.add_argument("--run-root", default=None)
     p.add_argument("--host", default="0.0.0.0")

@@ -11,6 +11,10 @@ backends here are:
 - ``cpu``: the MLP forward extracted into plain numpy matmuls — zero
   framework dispatch overhead, microseconds per decision (the required
   CPU fallback).
+- ``native``: the same forward in the C++ core
+  (``native/mlp_infer.cpp``), one ctypes hop per decision — the fastest
+  host path under concurrent serving load; degrades to ``cpu`` when the
+  toolchain/library is unavailable.
 - ``torch``: the same parameters mirrored into a torch CPU module (the
   reference stack's framework, kept as a serving fallback for users
   migrating from the RLlib/torch checkpoint world).
@@ -63,6 +67,20 @@ class NumpyMLPBackend:
         kernel, bias = self._layers[-1]
         logits = x @ kernel + bias
         return int(np.argmax(logits)), logits
+
+
+class NativeMLPBackend:
+    """Actor forward in the C++ core (one ctypes call per decision)."""
+
+    name = "native"
+
+    def __init__(self, params_tree: dict):
+        from rl_scheduler_tpu.native import NativeMLP
+
+        self._mlp = NativeMLP(_flatten_mlp(params_tree, "actor_torso", "actor_head"))
+
+    def decide(self, obs: np.ndarray) -> tuple[int, np.ndarray]:
+        return self._mlp.decide(obs)
 
 
 class TorchMLPBackend:
@@ -149,6 +167,7 @@ class GreedyBackend:
 BACKENDS: dict[str, Callable] = {
     "jax": JaxAOTBackend,
     "cpu": NumpyMLPBackend,
+    "native": NativeMLPBackend,
     "torch": TorchMLPBackend,
     "greedy": GreedyBackend,
 }
@@ -170,6 +189,14 @@ def make_backend(
         if backend != "greedy":
             logger.warning("no checkpoint params; serving cost-greedy fallback")
         return GreedyBackend(), backend != "greedy"
+    if backend == "native":
+        # Native degrades to the numerically-identical numpy path first
+        # (missing compiler / .so), and only then to greedy.
+        try:
+            return NativeMLPBackend(params_tree), False
+        except Exception as e:  # noqa: BLE001 - any build/load failure
+            logger.warning("native backend unavailable (%s); using cpu", e)
+            backend = "cpu"
     try:
         if backend == "jax":
             return JaxAOTBackend(params_tree, hidden, device), False

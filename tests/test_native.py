@@ -1,0 +1,117 @@
+"""Native C++ inference core: build, numerical parity with numpy,
+thread-safety under concurrent decide(), and graceful degradation."""
+
+import concurrent.futures
+import shutil
+
+import numpy as np
+import pytest
+
+from rl_scheduler_tpu.native import NativeMLP, ensure_built, pack_mlp
+from rl_scheduler_tpu.scheduler.policy_backend import (
+    NumpyMLPBackend,
+    make_backend,
+)
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="no C++ toolchain"
+)
+
+
+def random_layers(rng, dims=(6, 32, 16, 2)):
+    return [
+        (rng.standard_normal((i, o)).astype(np.float32),
+         rng.standard_normal(o).astype(np.float32))
+        for i, o in zip(dims[:-1], dims[1:])
+    ]
+
+
+def numpy_forward(layers, obs):
+    x = obs.astype(np.float32)
+    for kernel, bias in layers[:-1]:
+        x = np.tanh(x @ kernel + bias)
+    kernel, bias = layers[-1]
+    return x @ kernel + bias
+
+
+@pytest.fixture(scope="module")
+def lib_path():
+    path = ensure_built()
+    assert path is not None and path.exists()
+    return path
+
+
+def test_native_matches_numpy(lib_path):
+    rng = np.random.default_rng(0)
+    layers = random_layers(rng)
+    mlp = NativeMLP(layers, lib_path)
+    for _ in range(50):
+        obs = rng.standard_normal(6).astype(np.float32)
+        ref = numpy_forward(layers, obs)
+        action, logits = mlp.decide(obs)
+        np.testing.assert_allclose(logits, ref, rtol=1e-4, atol=1e-5)
+        assert action == int(np.argmax(ref))
+
+
+def test_native_thread_safe_on_shared_handle(lib_path):
+    rng = np.random.default_rng(1)
+    layers = random_layers(rng)
+    mlp = NativeMLP(layers, lib_path)
+    observations = rng.standard_normal((256, 6)).astype(np.float32)
+    expected = [int(np.argmax(numpy_forward(layers, o))) for o in observations]
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+        got = list(pool.map(lambda o: mlp.decide(o)[0], observations))
+    assert got == expected
+
+
+def test_pack_mlp_rejects_inconsistent_shapes():
+    rng = np.random.default_rng(2)
+    layers = random_layers(rng)
+    layers[1] = (rng.standard_normal((99, 16)).astype(np.float32),
+                 layers[1][1])
+    with pytest.raises(ValueError):
+        pack_mlp(layers)
+
+
+def test_native_rejects_bad_obs_shape(lib_path):
+    rng = np.random.default_rng(3)
+    mlp = NativeMLP(random_layers(rng), lib_path)
+    with pytest.raises(ValueError):
+        mlp.decide(np.zeros(5, np.float32))
+
+
+@pytest.fixture(scope="module")
+def params_tree():
+    import jax
+    import jax.numpy as jnp
+
+    from rl_scheduler_tpu.env import core as env_core
+    from rl_scheduler_tpu.models import ActorCritic
+
+    net = ActorCritic(num_actions=env_core.NUM_ACTIONS, hidden=(32, 32))
+    return net.init(
+        jax.random.PRNGKey(7), jnp.zeros((1, env_core.OBS_DIM), jnp.float32)
+    )
+
+
+def test_native_backend_parity_with_cpu_backend(params_tree):
+    native, fell_back = make_backend("native", params_tree)
+    assert not fell_back
+    cpu = NumpyMLPBackend(params_tree)
+    rng = np.random.default_rng(4)
+    for _ in range(20):
+        obs = rng.uniform(0, 1, 6).astype(np.float32)
+        a_n, l_n = native.decide(obs)
+        a_c, l_c = cpu.decide(obs)
+        assert a_n == a_c
+        np.testing.assert_allclose(l_n, l_c, rtol=1e-4, atol=1e-5)
+
+
+def test_native_degrades_to_cpu_when_lib_missing(monkeypatch, params_tree):
+    import rl_scheduler_tpu.native.build as build_mod
+
+    monkeypatch.setattr(build_mod, "ensure_built", lambda force=False: None)
+    backend, fell_back = make_backend("native", params_tree)
+    assert backend.name == "cpu"
+    assert not fell_back

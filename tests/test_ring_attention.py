@@ -104,3 +104,13 @@ def test_distributed_noop_without_coordinates(monkeypatch):
                 "MEGASCALE_COORDINATOR_ADDRESS"):
         monkeypatch.delenv(var, raising=False)
     assert maybe_initialize_distributed() is False
+
+
+def test_distributed_incomplete_triple_raises(monkeypatch):
+    from rl_scheduler_tpu.parallel import maybe_initialize_distributed
+
+    monkeypatch.setenv("RL_SCHED_COORDINATOR", "localhost:9999")
+    monkeypatch.delenv("RL_SCHED_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("RL_SCHED_PROCESS_ID", raising=False)
+    with pytest.raises(ValueError, match="RL_SCHED_NUM_PROCESSES"):
+        maybe_initialize_distributed()
