@@ -47,6 +47,7 @@ class PPOTrainConfig:
     max_grad_norm: float | None = None  # RLlib default: no grad clip
     hidden: tuple = (256, 256)
     gae_impl: str = "auto"           # scan | pallas | auto (pallas on TPU)
+    compute_dtype: str = "float32"   # float32 | bfloat16 (torso matmuls)
 
     @property
     def batch_size(self) -> int:
@@ -103,7 +104,27 @@ def make_ppo_bundle(
     ``(logits [B, num_actions], value [B])`` — MLPs over flat obs and
     set-transformer / GNN policies over structured obs all fit.
     """
-    net = net or ActorCritic(num_actions=bundle.num_actions, hidden=cfg.hidden)
+    compute_dtypes = {"float32": None, "bfloat16": jnp.bfloat16}
+    if cfg.compute_dtype not in compute_dtypes:
+        raise ValueError(
+            f"unknown compute_dtype {cfg.compute_dtype!r}; "
+            f"choose from {sorted(compute_dtypes)}"
+        )
+    if net is not None and cfg.compute_dtype != "float32":
+        # A custom net owns its own precision (SetTransformerPolicy/
+        # GNNPolicy take a dtype field); the config knob only shapes the
+        # default ActorCritic — warn rather than silently ignore.
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "compute_dtype=%s has no effect on a custom net=%s; set the "
+            "net's own dtype field instead", cfg.compute_dtype, type(net).__name__
+        )
+    net = net or ActorCritic(
+        num_actions=bundle.num_actions,
+        hidden=cfg.hidden,
+        dtype=compute_dtypes[cfg.compute_dtype],
+    )
     tx = make_optimizer(cfg)
     obs_shape = tuple(bundle.obs_shape)
 

@@ -18,6 +18,8 @@ equivariant w.r.t. graph isomorphism) + mean-pooled value.
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
@@ -30,12 +32,13 @@ from rl_scheduler_tpu.models.heads import (
 
 class GraphConvLayer(nn.Module):
     dim: int
+    dtype: Any = None  # compute dtype; params stay f32
 
     @nn.compact
     def __call__(self, h, norm_adj):  # h: [..., N, dim_in], norm_adj: [N, N]
-        self_msg = nn.Dense(self.dim, name="w_self")(h)
-        nbr = jnp.einsum("ij,...jd->...id", norm_adj, h)
-        nbr_msg = nn.Dense(self.dim, name="w_nbr")(nbr)
+        self_msg = nn.Dense(self.dim, dtype=self.dtype, name="w_self")(h)
+        nbr = jnp.einsum("ij,...jd->...id", norm_adj.astype(h.dtype), h)
+        nbr_msg = nn.Dense(self.dim, dtype=self.dtype, name="w_nbr")(nbr)
         return nn.relu(self_msg + nbr_msg)
 
 
@@ -51,14 +54,17 @@ class GNNPolicy(nn.Module):
     adjacency: tuple  # nested tuple form of the [N, N] 0/1 matrix
     dim: int = 64
     depth: int = 3
+    dtype: Any = None  # compute dtype for embed/conv layers (heads stay f32)
 
     @staticmethod
-    def from_adjacency(adj, dim: int = 64, depth: int = 3) -> "GNNPolicy":
+    def from_adjacency(adj, dim: int = 64, depth: int = 3,
+                       dtype: Any = None) -> "GNNPolicy":
         adj = np.asarray(adj, np.float32)
         return GNNPolicy(
             adjacency=tuple(tuple(float(x) for x in row) for row in adj),
             dim=dim,
             depth=depth,
+            dtype=dtype,
         )
 
     @nn.compact
@@ -69,9 +75,11 @@ class GNNPolicy(nn.Module):
         head = PointerActorCriticHead(self.dim, name="head")
 
         def forward(batched_obs):
-            h = nn.relu(nn.Dense(self.dim, name="embed")(batched_obs))
+            h = nn.relu(nn.Dense(self.dim, dtype=self.dtype,
+                                 name="embed")(batched_obs))
             for i in range(self.depth):
-                h = GraphConvLayer(self.dim, name=f"conv_{i}")(h, norm_adj)
-            return head(h)
+                h = GraphConvLayer(self.dim, self.dtype,
+                                   name=f"conv_{i}")(h, norm_adj)
+            return head(h.astype(jnp.float32))
 
         return apply_with_optional_batch(forward, obs)

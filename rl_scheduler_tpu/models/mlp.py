@@ -8,42 +8,53 @@ env step — the win is structural (no Ray worker boundary), not per-matmul.
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Any, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
 
 
 class MLPTorso(nn.Module):
+    """``dtype`` is the COMPUTE dtype (params stay f32): ``jnp.bfloat16``
+    runs the torso matmuls on the MXU's native precision — the throughput
+    lever for the big TPU presets; ``None`` keeps full f32."""
+
     hidden: Sequence[int] = (256, 256)
     activation: str = "tanh"
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x):
         act = getattr(nn, self.activation)
         for h in self.hidden:
-            x = act(nn.Dense(h, kernel_init=nn.initializers.orthogonal(jnp.sqrt(2)))(x))
+            x = act(nn.Dense(h, kernel_init=nn.initializers.orthogonal(jnp.sqrt(2)),
+                             dtype=self.dtype)(x))
         return x
 
 
 class ActorCritic(nn.Module):
     """Separate actor/critic torsos (RLlib PPO default: vf_share_layers=False).
 
-    Returns ``(logits [..., num_actions], value [...])``.
+    Returns ``(logits [..., num_actions], value [...])``. With ``dtype=
+    jnp.bfloat16`` the torsos compute in bf16 while the output heads (and
+    therefore log-probs and values, which feed the PPO ratios) stay f32.
     """
 
     num_actions: int = 2
     hidden: Sequence[int] = (256, 256)
     activation: str = "tanh"
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, obs):
-        pi = MLPTorso(self.hidden, self.activation, name="actor_torso")(obs)
+        pi = MLPTorso(self.hidden, self.activation, self.dtype, name="actor_torso")(obs)
         logits = nn.Dense(
             self.num_actions, kernel_init=nn.initializers.orthogonal(0.01), name="actor_head"
-        )(pi)
-        v = MLPTorso(self.hidden, self.activation, name="critic_torso")(obs)
-        value = nn.Dense(1, kernel_init=nn.initializers.orthogonal(1.0), name="critic_head")(v)
+        )(pi.astype(jnp.float32))
+        v = MLPTorso(self.hidden, self.activation, self.dtype, name="critic_torso")(obs)
+        value = nn.Dense(1, kernel_init=nn.initializers.orthogonal(1.0), name="critic_head")(
+            v.astype(jnp.float32)
+        )
         return logits, jnp.squeeze(value, -1)
 
 

@@ -17,7 +17,7 @@ same module shards over the mesh via the sequence-parallel attention in
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Any, Callable
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -41,6 +41,7 @@ class SelfAttentionBlock(nn.Module):
     num_heads: int = 4
     mlp_ratio: int = 2
     attention_fn: Callable | None = None
+    dtype: Any = None  # compute dtype; params stay f32
 
     @nn.compact
     def __call__(self, x):  # [..., N, dim]
@@ -49,13 +50,14 @@ class SelfAttentionBlock(nn.Module):
         if self.attention_fn is not None:
             attn_kwargs["attention_fn"] = self.attention_fn
         h = nn.MultiHeadDotProductAttention(
-            num_heads=self.num_heads, qkv_features=self.dim, **attn_kwargs
+            num_heads=self.num_heads, qkv_features=self.dim,
+            dtype=self.dtype, **attn_kwargs
         )(h, h)
         x = x + h
         h = nn.LayerNorm()(x)
-        h = nn.Dense(self.dim * self.mlp_ratio)(h)
+        h = nn.Dense(self.dim * self.mlp_ratio, dtype=self.dtype)(h)
         h = nn.gelu(h)
-        h = nn.Dense(self.dim)(h)
+        h = nn.Dense(self.dim, dtype=self.dtype)(h)
         return x + h
 
 
@@ -80,6 +82,7 @@ class SetTransformerPolicy(nn.Module):
     depth: int = 2
     num_heads: int = 4
     axis_name: str | None = None
+    dtype: Any = None  # compute dtype for blocks (pointer/value heads stay f32)
 
     @nn.compact
     def __call__(self, obs):
@@ -95,13 +98,15 @@ class SetTransformerPolicy(nn.Module):
             attention_fn = make_flax_attention_fn(self.axis_name)
 
         def forward(batched_obs):
-            x = nn.Dense(self.dim, name="embed")(batched_obs)  # [B, N, dim]
+            x = nn.Dense(self.dim, dtype=self.dtype,
+                         name="embed")(batched_obs)  # [B, N, dim]
             for i in range(self.depth):
                 x = SelfAttentionBlock(
                     self.dim, self.num_heads,
-                    attention_fn=attention_fn, name=f"block_{i}",
+                    attention_fn=attention_fn, dtype=self.dtype,
+                    name=f"block_{i}",
                 )(x)
             x = nn.LayerNorm(name="final_norm")(x)
-            return head(x)
+            return head(x.astype(jnp.float32))
 
         return apply_with_optional_batch(forward, obs)

@@ -191,3 +191,34 @@ def test_ppo_trains_gnn_and_improves(graph_params):
     first = history[0]["reward_mean"]
     last = history[-1]["reward_mean"]
     assert last > first, f"GNN PPO failed to improve: {first} -> {last}"
+
+
+def test_set_and_graph_policies_support_bf16_compute():
+    """dtype=bfloat16 keeps params f32 and tracks the f32 forward (the
+    compute_dtype knob's documented use for the wide policies)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rl_scheduler_tpu.models.gnn import GNNPolicy
+    from rl_scheduler_tpu.models.transformer import SetTransformerPolicy
+
+    obs = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 5))
+    tr32 = SetTransformerPolicy(dim=32, depth=1)
+    tr16 = SetTransformerPolicy(dim=32, depth=1, dtype=jnp.bfloat16)
+    params = tr32.init(jax.random.PRNGKey(1), obs)
+    l32, v32 = tr32.apply(params, obs)
+    l16, v16 = tr16.apply(params, obs)
+    assert l16.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(l16), np.asarray(l32), atol=0.1)
+    np.testing.assert_allclose(np.asarray(v16), np.asarray(v32), atol=0.1)
+
+    adj = np.eye(8, dtype=np.float32)
+    g32 = GNNPolicy.from_adjacency(adj, dim=16, depth=2)
+    g16 = GNNPolicy.from_adjacency(adj, dim=16, depth=2, dtype=jnp.bfloat16)
+    params = g32.init(jax.random.PRNGKey(2), obs)
+    l32, v32 = g32.apply(params, obs)
+    l16, v16 = g16.apply(params, obs)
+    assert l16.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(l16), np.asarray(l32), atol=0.1)
+    np.testing.assert_allclose(np.asarray(v16), np.asarray(v32), atol=0.1)

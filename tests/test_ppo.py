@@ -125,3 +125,39 @@ def test_train_cli_resume_roundtrip(tmp_path):
     mgr = CheckpointManager(tmp_path / "resume_test")
     assert mgr.latest_step() == 4
     mgr.close()
+
+
+def test_bfloat16_compute_dtype_close_to_f32(env_params):
+    """compute_dtype='bfloat16' keeps params and heads f32; outputs track
+    the f32 network within bf16 tolerance."""
+    import jax.numpy as jnp
+
+    from rl_scheduler_tpu.models import ActorCritic
+
+    obs = jax.random.uniform(jax.random.PRNGKey(0), (64, 6))
+    f32_net = ActorCritic(num_actions=2, hidden=(32, 32))
+    bf_net = ActorCritic(num_actions=2, hidden=(32, 32), dtype=jnp.bfloat16)
+    params = f32_net.init(jax.random.PRNGKey(1), obs)
+
+    logits32, value32 = f32_net.apply(params, obs)
+    logits16, value16 = bf_net.apply(params, obs)
+    assert logits16.dtype == jnp.float32  # heads stay f32
+    np.testing.assert_allclose(
+        np.asarray(logits16), np.asarray(logits32), atol=0.05
+    )
+    np.testing.assert_allclose(
+        np.asarray(value16), np.asarray(value32), atol=0.05
+    )
+
+    cfg = PPOTrainConfig(num_envs=8, rollout_steps=20, minibatch_size=64,
+                         num_epochs=2, hidden=(16, 16),
+                         compute_dtype="bfloat16")
+    _, history = ppo_train(env_params, cfg, 2, seed=0)
+    assert np.isfinite(history[-1]["policy_loss"])
+
+
+def test_unknown_compute_dtype_raises(env_params):
+    cfg = PPOTrainConfig(num_envs=4, rollout_steps=4, minibatch_size=16,
+                         compute_dtype="bf16")
+    with pytest.raises(ValueError, match="compute_dtype"):
+        make_ppo(env_params, cfg)
