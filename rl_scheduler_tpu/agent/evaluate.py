@@ -247,6 +247,14 @@ def main(argv: list[str] | None = None) -> EvalReport:
         run_dir = Path(args.run) if args.run else find_latest_run(args.run_root)
         print(f"Using checkpoint run: {run_dir}")
         params, meta = load_policy_params(run_dir)
+        ckpt_env = meta.get("env", "multi_cloud")
+        if ckpt_env != "multi_cloud":
+            raise SystemExit(
+                f"checkpoint {run_dir} is for env {ckpt_env!r}; this "
+                "evaluation harness covers the multi-cloud env — pass --run "
+                "pointing at a multi_cloud run (set/graph policies are "
+                "evaluated by their convergence tests)"
+            )
         env_params = env_core.make_params(
             EnvConfig(legacy_reward_sign=bool(meta.get("legacy_reward_sign", False)))
         )

@@ -110,10 +110,12 @@ def make_ppo_bundle(
             f"unknown compute_dtype {cfg.compute_dtype!r}; "
             f"choose from {sorted(compute_dtypes)}"
         )
-    if net is not None and cfg.compute_dtype != "float32":
+    if (net is not None and cfg.compute_dtype != "float32"
+            and getattr(net, "dtype", None) is None):
         # A custom net owns its own precision (SetTransformerPolicy/
         # GNNPolicy take a dtype field); the config knob only shapes the
-        # default ActorCritic — warn rather than silently ignore.
+        # default ActorCritic — warn when the custom net did NOT get a
+        # dtype of its own rather than silently ignore the config.
         import logging
 
         logging.getLogger(__name__).warning(

@@ -26,9 +26,56 @@ from rl_scheduler_tpu.config import EnvConfig, RuntimeConfig
 from rl_scheduler_tpu.env import core as env_core
 
 
+ENVS = ("multi_cloud", "single_cluster", "cluster_set", "cluster_graph")
+
+
+def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False):
+    """``(bundle, net)`` for each BASELINE env family.
+
+    ``net=None`` means the default flat-obs ActorCritic; the set/graph envs
+    pair with their structured policies (configs 4-5).
+    """
+    dtype = None
+    if cfg.compute_dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        dtype = jnp.bfloat16
+    if env_name == "multi_cloud":
+        from rl_scheduler_tpu.env.bundle import multi_cloud_bundle
+
+        params = env_core.make_params(EnvConfig(legacy_reward_sign=legacy_reward_sign))
+        return multi_cloud_bundle(params), None
+    if env_name == "single_cluster":
+        from rl_scheduler_tpu.env.bundle import single_cluster_bundle
+
+        return single_cluster_bundle(), None
+    if env_name == "cluster_set":
+        from rl_scheduler_tpu.env.bundle import cluster_set_bundle
+        from rl_scheduler_tpu.models import SetTransformerPolicy
+
+        return cluster_set_bundle(), SetTransformerPolicy(dim=64, depth=2, dtype=dtype)
+    if env_name == "cluster_graph":
+        import numpy as np
+
+        from rl_scheduler_tpu.env import cluster_graph
+        from rl_scheduler_tpu.env.bundle import cluster_graph_bundle
+        from rl_scheduler_tpu.models import GNNPolicy
+
+        params = cluster_graph.make_params()
+        net = GNNPolicy.from_adjacency(
+            np.asarray(params.adjacency), dim=64, depth=3, dtype=dtype
+        )
+        return cluster_graph_bundle(params), net
+    raise ValueError(f"unknown env {env_name!r}; choose from {ENVS}")
+
+
 def main(argv: list[str] | None = None) -> Path:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--preset", default="quick", choices=sorted(PPO_PRESETS))
+    p.add_argument("--env", default="multi_cloud", choices=ENVS,
+                   help="env family: multi_cloud (flagship), single_cluster "
+                        "(config 1), cluster_set + set transformer (config "
+                        "4), cluster_graph + GNN (config 5)")
     p.add_argument("--iterations", type=int, default=5)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--run-name", default=None)
@@ -47,6 +94,9 @@ def main(argv: list[str] | None = None) -> Path:
     p.add_argument("--minibatch-size", type=int, default=None)
     p.add_argument("--hidden", default=None,
                    help="comma-separated MLP widths, e.g. 64,64")
+    p.add_argument("--compute-dtype", default=None,
+                   choices=("float32", "bfloat16"),
+                   help="torso/block compute precision (params stay f32)")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the whole run into "
                         "this directory (keep --iterations small; view in "
@@ -62,14 +112,24 @@ def main(argv: list[str] | None = None) -> Path:
     cfg = PPO_PRESETS[args.preset]
     overrides = {
         k: getattr(args, k)
-        for k in ("num_envs", "rollout_steps", "minibatch_size")
+        for k in ("num_envs", "rollout_steps", "minibatch_size", "compute_dtype")
         if getattr(args, k) is not None
     }
     if args.hidden is not None:
         overrides["hidden"] = tuple(int(w) for w in args.hidden.split(","))
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
-    env_params = env_core.make_params(EnvConfig(legacy_reward_sign=args.legacy_reward_sign))
+    if args.legacy_reward_sign and args.env != "multi_cloud":
+        raise SystemExit(
+            "--legacy-reward-sign reproduces the multi-cloud reference "
+            f"reward bug and has no meaning for --env {args.env}"
+        )
+    if args.hidden is not None and args.env in ("cluster_set", "cluster_graph"):
+        raise SystemExit(
+            f"--hidden configures the MLP policy; --env {args.env} uses a "
+            "structured policy with its own dimensions"
+        )
+    bundle, net = make_bundle_and_net(args.env, cfg, args.legacy_reward_sign)
 
     run_name = args.run_name or f"PPO_{args.preset}_{time.strftime('%Y%m%d_%H%M%S')}"
     run_dir = Path(args.run_root) / run_name
@@ -97,6 +157,13 @@ def main(argv: list[str] | None = None) -> Path:
         # state restore — a hidden-size mismatch would otherwise surface
         # as a raw Orbax structure error.
         meta = ckpt.restore_meta(latest)
+        ckpt_env = meta.get("env")
+        if ckpt_env is not None and ckpt_env != args.env:
+            raise SystemExit(
+                f"--resume: run was trained on --env {ckpt_env}; "
+                f"resuming on {args.env!r} would restore an incompatible "
+                f"policy (pass --env {ckpt_env})"
+            )
         ckpt_preset = meta.get("preset")
         if ckpt_preset is not None and ckpt_preset != args.preset:
             raise SystemExit(
@@ -118,9 +185,9 @@ def main(argv: list[str] | None = None) -> Path:
                 f"opposite sign would silently negate rewards mid-run "
                 f"({'add' if ckpt_legacy else 'drop'} --legacy-reward-sign)"
             )
-        from rl_scheduler_tpu.agent.ppo import make_ppo
+        from rl_scheduler_tpu.agent.ppo import make_ppo_bundle
 
-        init_fn, _, _ = make_ppo(env_params, cfg)
+        init_fn, _, _ = make_ppo_bundle(bundle, cfg, net=net)
         abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(args.seed))
         tree, _ = ckpt.restore(
             latest,
@@ -143,20 +210,28 @@ def main(argv: list[str] | None = None) -> Path:
         line = {"iteration": i + 1, "env_steps_per_sec": round(sps, 1), **metrics}
         metrics_file.write(json.dumps(line) + "\n")
         metrics_file.flush()
-        print(
-            f"Iteration {i + 1}: reward_mean={metrics['episode_reward_mean']:.2f} "
-            f"| {sps:,.0f} env-steps/s",
-            flush=True,
-        )
+        if metrics.get("episodes_completed", 1) > 0:
+            reward_str = f"reward_mean={metrics['episode_reward_mean']:.2f}"
+        else:
+            # No episode finished inside this rollout (short rollouts /
+            # long episodes): the episode mean is undefined, show the
+            # per-step mean instead of a misleading 0.00.
+            reward_str = f"step_reward_mean={metrics['reward_mean']:.4f}"
+        print(f"Iteration {i + 1}: {reward_str} | {sps:,.0f} env-steps/s",
+              flush=True)
 
     def checkpoint_fn(i: int, runner) -> None:
         if (i + 1) % args.checkpoint_every == 0 or (i + 1) == args.iterations:
             ckpt.save(i + 1, {"params": runner.params, "opt_state": runner.opt_state},
                       extras={"preset": args.preset,
-                              "hidden": list(cfg.hidden),
+                              "env": args.env,
+                              # hidden describes the default MLP only; the
+                              # set/graph policies own their dimensions.
+                              "hidden": list(cfg.hidden) if net is None else None,
                               "legacy_reward_sign": args.legacy_reward_sign})
 
-    print(f"Training PPO preset={args.preset} on {jax.devices()[0].platform} "
+    print(f"Training PPO preset={args.preset} env={args.env} on "
+          f"{jax.devices()[0].platform} "
           f"({cfg.num_envs} envs x {cfg.rollout_steps} steps/iter)")
     if args.profile_dir is not None:
         from rl_scheduler_tpu.utils.profiling import trace_iterations
@@ -167,7 +242,7 @@ def main(argv: list[str] | None = None) -> Path:
 
         ctx = contextlib.nullcontext()
     with ctx:
-        ppo_train(env_params, cfg, args.iterations, seed=args.seed,
+        ppo_train(bundle, cfg, args.iterations, seed=args.seed, net=net,
                   log_fn=log_fn, checkpoint_fn=checkpoint_fn, restore=restore)
     metrics_file.close()
     print(f"Training finished! Checkpoints in {run_dir}")

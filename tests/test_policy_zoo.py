@@ -222,3 +222,44 @@ def test_set_and_graph_policies_support_bf16_compute():
     assert l16.dtype == jnp.float32
     np.testing.assert_allclose(np.asarray(l16), np.asarray(l32), atol=0.1)
     np.testing.assert_allclose(np.asarray(v16), np.asarray(v32), atol=0.1)
+
+
+@pytest.mark.parametrize("env_name", ["single_cluster", "cluster_set", "cluster_graph"])
+def test_train_cli_covers_all_env_families(env_name, tmp_path):
+    """--env trains configs 1/4/5 end-to-end through the CLI, checkpoint
+    included (multi_cloud is covered by the resume round-trip test)."""
+    from rl_scheduler_tpu.agent import train_ppo as cli
+    from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+    cli.main([
+        "--env", env_name, "--preset", "quick", "--num-envs", "4",
+        "--rollout-steps", "8", "--minibatch-size", "16",
+        "--iterations", "1", "--checkpoint-every", "1",
+        "--run-root", str(tmp_path), "--run-name", env_name,
+    ])
+    mgr = CheckpointManager(tmp_path / env_name)
+    assert mgr.latest_step() == 1
+    assert mgr.restore_meta(1)["env"] == env_name
+    mgr.close()
+
+
+def test_train_cli_resume_rejects_env_mismatch(tmp_path):
+    from rl_scheduler_tpu.agent import train_ppo as cli
+
+    common = ["--preset", "quick", "--num-envs", "4", "--rollout-steps", "8",
+              "--minibatch-size", "16", "--checkpoint-every", "1",
+              "--run-root", str(tmp_path), "--run-name", "envmix"]
+    cli.main(common + ["--env", "single_cluster", "--iterations", "1"])
+    with pytest.raises(SystemExit, match="single_cluster"):
+        cli.main(common + ["--env", "cluster_set", "--iterations", "2", "--resume"])
+
+
+def test_train_cli_rejects_inert_flags_for_structured_envs(tmp_path):
+    from rl_scheduler_tpu.agent import train_ppo as cli
+
+    with pytest.raises(SystemExit, match="structured policy"):
+        cli.main(["--env", "cluster_set", "--hidden", "512,512",
+                  "--run-root", str(tmp_path)])
+    with pytest.raises(SystemExit, match="legacy-reward-sign"):
+        cli.main(["--env", "single_cluster", "--legacy-reward-sign",
+                  "--run-root", str(tmp_path)])
