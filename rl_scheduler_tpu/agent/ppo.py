@@ -18,6 +18,7 @@ per minibatch, epoch-wise reshuffling.
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -28,6 +29,7 @@ from rl_scheduler_tpu.env import core as env_core
 from rl_scheduler_tpu.env.bundle import EnvBundle, multi_cloud_bundle
 from rl_scheduler_tpu.models import ActorCritic
 from rl_scheduler_tpu.ops import gae as gae_op
+from rl_scheduler_tpu.ops.gae import resolve_impl as resolve_gae_impl
 from rl_scheduler_tpu.ops.losses import PPOLossConfig, ppo_loss, categorical_log_prob
 
 
@@ -285,8 +287,14 @@ def ppo_train(
     checkpoint_fn: Callable[[int, RunnerState], None] | None = None,
     net: Any | None = None,
     restore: tuple[dict, int] | None = None,
+    debug_checks: bool = False,
 ):
     """Host-side training loop: jitted update per iteration + logging hooks.
+
+    ``debug_checks=True`` checkifies the update (``utils/debug.py``): the
+    first NaN/zero-division raises with the failing op named, instead
+    of silently corrupting training. Forces the scan GAE (checkify cannot
+    instrument inside a Pallas kernel). Slower; for debugging.
 
     ``env`` is either multi-cloud :class:`EnvParams` or any
     :class:`EnvBundle`. Returns ``(runner, history)`` where history is a
@@ -300,6 +308,13 @@ def ppo_train(
     than replaying the stream the original run already consumed.
     """
     bundle = env if isinstance(env, EnvBundle) else multi_cloud_bundle(env)
+    if debug_checks and cfg.gae_impl != "scan":
+        if resolve_gae_impl(cfg.gae_impl) == "pallas":
+            warnings.warn(
+                "debug_checks forces gae_impl='scan': checkify cannot "
+                "instrument the Pallas GAE kernel, so it is not the code "
+                "under test in this run", stacklevel=2)
+        cfg = dataclasses.replace(cfg, gae_impl="scan")
     init_fn, update_fn, _ = make_ppo_bundle(bundle, cfg, net=net)
     start_iteration = 0
     key = jax.random.PRNGKey(seed)
@@ -317,7 +332,12 @@ def ppo_train(
             opt_state=tree["opt_state"],
             update_idx=jnp.asarray(start_iteration, jnp.int32),
         )
-    update = jax.jit(update_fn, donate_argnums=0)
+    if debug_checks:
+        from rl_scheduler_tpu.utils.debug import checkified_update
+
+        update = checkified_update(update_fn)
+    else:
+        update = jax.jit(update_fn, donate_argnums=0)
     history = []
     for i in range(start_iteration, num_iterations):
         runner, metrics = update(runner)

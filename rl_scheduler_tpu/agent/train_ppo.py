@@ -97,6 +97,10 @@ def main(argv: list[str] | None = None) -> Path:
     p.add_argument("--compute-dtype", default=None,
                    choices=("float32", "bfloat16"),
                    help="torso/block compute precision (params stay f32)")
+    p.add_argument("--debug-checks", action="store_true",
+                   help="checkify the update: raise on the first NaN/"
+                        "zero-division instead of silently corrupting "
+                        "training (slower; for debugging)")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the whole run into "
                         "this directory (keep --iterations small; view in "
@@ -243,7 +247,8 @@ def main(argv: list[str] | None = None) -> Path:
         ctx = contextlib.nullcontext()
     with ctx:
         ppo_train(bundle, cfg, args.iterations, seed=args.seed, net=net,
-                  log_fn=log_fn, checkpoint_fn=checkpoint_fn, restore=restore)
+                  log_fn=log_fn, checkpoint_fn=checkpoint_fn, restore=restore,
+                  debug_checks=args.debug_checks)
     metrics_file.close()
     print(f"Training finished! Checkpoints in {run_dir}")
     return run_dir

@@ -24,6 +24,15 @@ def default_platform() -> str:
     return getattr(pinned, "platform", str(pinned))
 
 
+def resolve_impl(impl: str) -> str:
+    """Resolve ``"auto"`` to the concrete GAE impl for the default device."""
+    if impl == "auto":
+        return "pallas" if default_platform() == "tpu" else "scan"
+    if impl not in ("scan", "pallas"):
+        raise ValueError(f"unknown GAE impl {impl!r}; choose scan|pallas|auto")
+    return impl
+
+
 def gae(
     rewards: jnp.ndarray,     # [T, N]
     values: jnp.ndarray,      # [T, N] V(s_t)
@@ -44,8 +53,7 @@ def gae(
     that jit-compiles for a non-default device should pass ``impl``
     explicitly.
     """
-    if impl == "auto":
-        impl = "pallas" if default_platform() == "tpu" else "scan"
+    impl = resolve_impl(impl)
     if impl == "pallas":
         from rl_scheduler_tpu.ops.pallas_gae import gae_pallas
 
