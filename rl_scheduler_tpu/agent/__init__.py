@@ -2,7 +2,7 @@
 
 from rl_scheduler_tpu.agent.ppo import PPOTrainConfig, make_ppo, ppo_train
 from rl_scheduler_tpu.agent.dqn import DQNConfig, make_dqn, dqn_train
-from rl_scheduler_tpu.agent.presets import PPO_PRESETS
+from rl_scheduler_tpu.agent.presets import DQN_PRESETS, PPO_PRESETS
 
 __all__ = [
     "PPOTrainConfig",
@@ -12,4 +12,5 @@ __all__ = [
     "make_dqn",
     "dqn_train",
     "PPO_PRESETS",
+    "DQN_PRESETS",
 ]

@@ -262,18 +262,21 @@ def dqn_train(
     seed: int = 0,
     log_fn: Callable[[int, dict], None] | None = None,
     checkpoint_fn: Callable[[int, DQNRunnerState], None] | None = None,
+    sync_every: int = 1,
 ):
-    """Host-side training loop mirroring :func:`rl_scheduler_tpu.agent.ppo.ppo_train`."""
+    """Host-side training loop mirroring :func:`rl_scheduler_tpu.agent.ppo.ppo_train`.
+
+    ``sync_every`` batches device->host metric fetches exactly as in
+    ``ppo_train`` — essential here, since a DQN iteration is tiny and a
+    per-iteration sync round-trip (~100 ms on a remote/tunneled
+    accelerator) would dwarf the update itself.
+    """
+    from rl_scheduler_tpu.agent.loop import run_train_loop
+
     init_fn, update_fn, _ = make_dqn(bundle, cfg)
     runner = jax.jit(init_fn)(jax.random.PRNGKey(seed))
     update = jax.jit(update_fn, donate_argnums=0)
-    history = []
-    for i in range(num_iterations):
-        runner, metrics = update(runner)
-        metrics = {k: float(v) for k, v in metrics.items()}
-        history.append(metrics)
-        if log_fn is not None:
-            log_fn(i, metrics)
-        if checkpoint_fn is not None:
-            checkpoint_fn(i, runner)
-    return runner, history
+    return run_train_loop(
+        update, runner, 0, num_iterations,
+        sync_every=sync_every, log_fn=log_fn, checkpoint_fn=checkpoint_fn,
+    )

@@ -12,6 +12,7 @@
 
 from __future__ import annotations
 
+from rl_scheduler_tpu.agent.dqn import DQNConfig
 from rl_scheduler_tpu.agent.ppo import PPOTrainConfig
 
 PPO_PRESETS: dict[str, PPOTrainConfig] = {
@@ -64,5 +65,29 @@ PPO_PRESETS: dict[str, PPOTrainConfig] = {
         num_epochs=6,
         lr=1e-3,
         gamma=0.99,
+    ),
+}
+
+DQN_PRESETS: dict[str, DQNConfig] = {
+    # BASELINE config 1: 2-layer MLP DQN, 1 env — small enough for CPU.
+    "config1": DQNConfig(
+        num_envs=1,
+        collect_steps=4,
+        buffer_size=20_000,
+        batch_size=64,
+        hidden=(64, 64),
+    ),
+    # Vectorized variant: the env axis widened to 256. Batch/buffer grow
+    # with it but NOT proportionally: the replay ratio intentionally drops
+    # (4096 samples per 1024 env-steps = 4, vs config1's 64/4 = 16) because
+    # 256 decorrelated envs need less sample reuse per step of data.
+    "vector256": DQNConfig(
+        num_envs=256,
+        collect_steps=4,
+        buffer_size=262_144,
+        batch_size=4096,
+        learning_starts=8_192,
+        epsilon_decay_steps=200_000,
+        hidden=(64, 64),
     ),
 }

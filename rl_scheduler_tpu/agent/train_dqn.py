@@ -1,0 +1,137 @@
+"""DQN training entry point (BASELINE config 1).
+
+The reference has no DQN; BASELINE.json's first config asks for a 2-layer
+MLP DQN on the single-cluster env, 1 env, CPU. This CLI mirrors
+``train_ppo``'s conventions — presets, run directory with JSONL metrics,
+periodic keep-N checkpoints — on top of :func:`rl_scheduler_tpu.agent.dqn.dqn_train`.
+
+Usage::
+
+    python -m rl_scheduler_tpu.agent.train_dqn --preset config1 --iterations 2000
+    python -m rl_scheduler_tpu.agent.train_dqn --env multi_cloud \
+        --preset vector256 --iterations 500
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import time
+from pathlib import Path
+
+import jax
+
+from rl_scheduler_tpu.agent.dqn import dqn_train
+from rl_scheduler_tpu.agent.presets import DQN_PRESETS
+from rl_scheduler_tpu.config import EnvConfig, RuntimeConfig
+from rl_scheduler_tpu.env import core as env_core
+
+# DQN pairs with the flat-obs envs; the set/graph envs use actor-critic
+# policies trained by train_ppo (BASELINE configs 4-5).
+ENVS = ("single_cluster", "multi_cloud")
+
+
+def make_bundle(env_name: str):
+    if env_name == "single_cluster":
+        from rl_scheduler_tpu.env.bundle import single_cluster_bundle
+
+        return single_cluster_bundle()
+    if env_name == "multi_cloud":
+        from rl_scheduler_tpu.env.bundle import multi_cloud_bundle
+
+        return multi_cloud_bundle(env_core.make_params(EnvConfig()))
+    raise ValueError(f"unknown env {env_name!r}; choose from {ENVS}")
+
+
+def main(argv: list[str] | None = None) -> Path:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--preset", default="config1", choices=sorted(DQN_PRESETS))
+    p.add_argument("--env", default="single_cluster", choices=ENVS,
+                   help="env family: single_cluster (BASELINE config 1) or "
+                        "multi_cloud")
+    p.add_argument("--iterations", type=int, default=2000,
+                   help="learner iterations (each = collect_steps x num_envs "
+                        "env steps + one learner step)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--run-name", default=None)
+    p.add_argument("--run-root", default=RuntimeConfig().checkpoint_dir)
+    p.add_argument("--checkpoint-every", type=int, default=500)
+    p.add_argument("--keep", type=int, default=5)
+    p.add_argument("--num-envs", type=int, default=None,
+                   help="override the preset's parallel env count")
+    p.add_argument("--hidden", default=None,
+                   help="comma-separated Q-network widths, e.g. 64,64")
+    p.add_argument("--log-every", type=int, default=100,
+                   help="print one progress line every N iterations (all "
+                        "iterations always go to metrics.jsonl)")
+    p.add_argument("--sync-every", type=int, default=100,
+                   help="fetch metrics for N iterations in one device->host "
+                        "transfer; a DQN iteration is tiny, so per-iteration "
+                        "syncing (~100 ms round-trip on a tunneled "
+                        "accelerator) would dominate the run")
+    args = p.parse_args(argv)
+
+    cfg = DQN_PRESETS[args.preset]
+    overrides = {}
+    if args.num_envs is not None:
+        overrides["num_envs"] = args.num_envs
+    if args.hidden is not None:
+        overrides["hidden"] = tuple(int(w) for w in args.hidden.split(","))
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    bundle = make_bundle(args.env)
+
+    run_name = args.run_name or f"DQN_{args.preset}_{time.strftime('%Y%m%d_%H%M%S')}"
+    run_dir = Path(args.run_root) / run_name
+    run_dir.mkdir(parents=True, exist_ok=True)
+    metrics_file = (run_dir / "metrics.jsonl").open("a")
+
+    from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+    ckpt = CheckpointManager(run_dir, keep=args.keep)
+
+    from rl_scheduler_tpu.agent.loop import (
+        make_jsonl_log_fn,
+        make_periodic_checkpoint_fn,
+    )
+
+    def print_line(i: int, sps: float, metrics: dict) -> None:
+        if (i + 1) % args.log_every == 0 or (i + 1) == args.iterations:
+            print(
+                f"Iteration {i + 1}: "
+                f"reward_mean={metrics['episode_reward_mean']:.2f} "
+                f"loss={metrics['loss']:.4f} eps={metrics['epsilon']:.3f} "
+                f"buffer={int(metrics['buffer_size'])} | {sps:,.0f} env-steps/s",
+                flush=True,
+            )
+
+    log_fn = make_jsonl_log_fn(metrics_file, cfg.collect_steps * cfg.num_envs,
+                               print_line=print_line)
+    checkpoint_fn = make_periodic_checkpoint_fn(
+        ckpt, args.checkpoint_every, args.iterations,
+        lambda runner: {
+            "params": runner.params,
+            "target_params": runner.target_params,
+            "opt_state": runner.opt_state,
+        },
+        extras={
+            "algo": "dqn",
+            "preset": args.preset,
+            "env": args.env,
+            "hidden": list(cfg.hidden),
+        },
+    )
+
+    print(f"Training DQN preset={args.preset} env={args.env} on "
+          f"{jax.devices()[0].platform} "
+          f"({cfg.num_envs} envs x {cfg.collect_steps} steps/iter)")
+    dqn_train(bundle, cfg, args.iterations, seed=args.seed,
+              log_fn=log_fn, checkpoint_fn=checkpoint_fn,
+              sync_every=args.sync_every)
+    metrics_file.close()
+    print(f"Training finished! Checkpoints in {run_dir}")
+    return run_dir
+
+
+if __name__ == "__main__":
+    main()

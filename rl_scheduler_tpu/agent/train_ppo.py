@@ -97,6 +97,11 @@ def main(argv: list[str] | None = None) -> Path:
     p.add_argument("--compute-dtype", default=None,
                    choices=("float32", "bfloat16"),
                    help="torso/block compute precision (params stay f32)")
+    p.add_argument("--sync-every", type=int, default=1,
+                   help="fetch metrics for N iterations in one device->host "
+                        "transfer (prints then arrive in bursts of N); raise "
+                        "on remote/tunneled accelerators where every sync "
+                        "costs a network round-trip")
     p.add_argument("--debug-checks", action="store_true",
                    help="checkify the update: raise on the first NaN/"
                         "zero-division instead of silently corrupting "
@@ -204,16 +209,14 @@ def main(argv: list[str] | None = None) -> Path:
         metrics_file.flush()
         print(f"Resuming from iteration {latest} (checkpoints in {run_dir})")
 
-    t_start = time.time()
-    steps_per_iter = cfg.batch_size
+    from rl_scheduler_tpu.agent.loop import (
+        make_jsonl_log_fn,
+        make_periodic_checkpoint_fn,
+    )
+
     start_iteration = restore[1] if restore is not None else 0
 
-    def log_fn(i: int, metrics: dict) -> None:
-        elapsed = time.time() - t_start
-        sps = steps_per_iter * (i + 1 - start_iteration) / elapsed
-        line = {"iteration": i + 1, "env_steps_per_sec": round(sps, 1), **metrics}
-        metrics_file.write(json.dumps(line) + "\n")
-        metrics_file.flush()
+    def print_line(i: int, sps: float, metrics: dict) -> None:
         if metrics.get("episodes_completed", 1) > 0:
             reward_str = f"reward_mean={metrics['episode_reward_mean']:.2f}"
         else:
@@ -224,15 +227,17 @@ def main(argv: list[str] | None = None) -> Path:
         print(f"Iteration {i + 1}: {reward_str} | {sps:,.0f} env-steps/s",
               flush=True)
 
-    def checkpoint_fn(i: int, runner) -> None:
-        if (i + 1) % args.checkpoint_every == 0 or (i + 1) == args.iterations:
-            ckpt.save(i + 1, {"params": runner.params, "opt_state": runner.opt_state},
-                      extras={"preset": args.preset,
-                              "env": args.env,
-                              # hidden describes the default MLP only; the
-                              # set/graph policies own their dimensions.
-                              "hidden": list(cfg.hidden) if net is None else None,
-                              "legacy_reward_sign": args.legacy_reward_sign})
+    log_fn = make_jsonl_log_fn(metrics_file, cfg.batch_size,
+                               start_iteration, print_line)
+    checkpoint_fn = make_periodic_checkpoint_fn(
+        ckpt, args.checkpoint_every, args.iterations,
+        lambda runner: {"params": runner.params, "opt_state": runner.opt_state},
+        extras={"preset": args.preset,
+                "env": args.env,
+                # hidden describes the default MLP only; the set/graph
+                # policies own their dimensions.
+                "hidden": list(cfg.hidden) if net is None else None,
+                "legacy_reward_sign": args.legacy_reward_sign})
 
     print(f"Training PPO preset={args.preset} env={args.env} on "
           f"{jax.devices()[0].platform} "
@@ -248,7 +253,7 @@ def main(argv: list[str] | None = None) -> Path:
     with ctx:
         ppo_train(bundle, cfg, args.iterations, seed=args.seed, net=net,
                   log_fn=log_fn, checkpoint_fn=checkpoint_fn, restore=restore,
-                  debug_checks=args.debug_checks)
+                  debug_checks=args.debug_checks, sync_every=args.sync_every)
     metrics_file.close()
     print(f"Training finished! Checkpoints in {run_dir}")
     return run_dir

@@ -142,3 +142,44 @@ def test_dqn_on_single_cluster_env():
     runner, history = dqn_train(bundle, cfg, num_iterations=12, seed=3)
     assert int(runner.env_steps) == 12 * 4
     assert all(np.isfinite(h["loss"]) for h in history)
+
+
+def test_train_dqn_cli_writes_checkpoints_and_metrics(tmp_path):
+    import json
+
+    from rl_scheduler_tpu.agent import train_dqn as cli
+    from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+    run_dir = cli.main([
+        "--preset", "config1", "--iterations", "6",
+        "--run-root", str(tmp_path), "--run-name", "dqn_cli_test",
+        "--checkpoint-every", "3", "--hidden", "16,16", "--log-every", "2",
+    ])
+    assert run_dir == tmp_path / "dqn_cli_test"
+    mgr = CheckpointManager(run_dir)
+    assert mgr.latest_step() == 6
+    meta = mgr.restore_meta(6)
+    assert meta["algo"] == "dqn" and meta["hidden"] == [16, 16]
+    tree, _ = mgr.restore(6)
+    assert "params" in tree and "target_params" in tree
+    mgr.close()
+    lines = [json.loads(l) for l in (run_dir / "metrics.jsonl").open()]
+    assert len(lines) == 6 and lines[-1]["iteration"] == 6
+
+
+def test_run_train_loop_wall_time_and_crash_flush():
+    from rl_scheduler_tpu.agent.loop import run_train_loop
+
+    def update(state):
+        if int(state) == 3:
+            raise RuntimeError("boom")
+        return state + 1, {"v": jnp.asarray(float(state))}
+
+    seen = []
+    with pytest.raises(RuntimeError):
+        run_train_loop(update, jnp.asarray(0.0), 0, 10, sync_every=100,
+                       log_fn=lambda i, m: seen.append((i, m)))
+    # iterations 0..2 completed before the crash; the finally-flush wrote them
+    assert [i for i, _ in seen] == [0, 1, 2]
+    walls = [m["wall_time"] for _, m in seen]
+    assert walls == sorted(walls) and walls[-1] > 0
