@@ -50,6 +50,12 @@ class PPOTrainConfig:
     hidden: tuple = (256, 256)
     gae_impl: str = "auto"           # scan | pallas | auto (pallas on TPU)
     compute_dtype: str = "float32"   # float32 | bfloat16 (torso matmuls)
+    # scan: sequential lax.scan rollout (works for every env).
+    # open_loop: vectorize the whole horizon — obs + rewards batched over
+    #   [T, N], policy applied as ONE forward (only for envs exporting a
+    #   bundle horizon_fn; ~2x faster rollout on TPU).
+    # auto: open_loop when the bundle supports it, scan otherwise.
+    rollout_impl: str = "auto"       # scan | open_loop | auto
 
     @property
     def batch_size(self) -> int:
@@ -179,12 +185,78 @@ def make_ppo_bundle(
             None,
             length=cfg.rollout_steps,
         )
-        return env_state, obs, key, ep_ret, traj
+        _, last_value = net.apply(runner.params, obs)
+        return env_state, obs, key, ep_ret, traj, last_value
+
+    def rollout_open_loop(runner: RunnerState):
+        """Whole-horizon rollout without a scan (open-loop envs only).
+
+        Obs for all T+1 steps come from one ``horizon_fn`` call; the policy
+        runs as ONE ``(T+1)*N`` forward (which also yields the bootstrap
+        value for free); actions, log-probs, and rewards are batched over
+        ``[T, N]``. Only the O(T·N)-add episode-return bookkeeping scans.
+        """
+        t = cfg.rollout_steps
+        key, hkey, akey = jax.random.split(runner.key, 3)
+        obs_all, aux, env_state = bundle.horizon_fn(
+            runner.env_state, runner.obs, hkey, t
+        )
+        n = obs_all.shape[1]
+        logits, values = net.apply(
+            runner.params, obs_all.reshape((t + 1) * n, *obs_shape)
+        )
+        logits = logits.reshape(t + 1, n, -1)
+        values = values.reshape(t + 1, n)
+        action = jax.random.categorical(akey, logits[:t])
+        log_prob = categorical_log_prob(logits[:t], action)
+        reward = bundle.horizon_reward_fn(aux, action)
+        done = aux["dones"]
+
+        def book(ep_ret, xs):
+            r, d = xs
+            new_ret = ep_ret + r
+            return new_ret * (1.0 - d), new_ret * d
+
+        ep_ret, final_return = jax.lax.scan(
+            book, runner.ep_return, (reward, done)
+        )
+        traj = {
+            "obs": obs_all[:t],
+            "action": action,
+            "log_prob": log_prob,
+            "value": values[:t],
+            "reward": reward,
+            "done": done,
+            "final_return": final_return,
+        }
+        return env_state, obs_all[t], key, ep_ret, traj, values[t]
+
+    has_horizon = (
+        bundle.horizon_fn is not None and bundle.horizon_reward_fn is not None
+    )
+    if bundle.horizon_fn is not None and bundle.horizon_reward_fn is None:
+        raise ValueError(
+            f"bundle {bundle.name!r} sets horizon_fn without "
+            "horizon_reward_fn; the open-loop contract needs both"
+        )
+    if cfg.rollout_impl == "open_loop" and not has_horizon:
+        raise ValueError(
+            f"rollout_impl='open_loop' needs an env with a horizon_fn; "
+            f"bundle {bundle.name!r} has none (use 'scan' or 'auto')"
+        )
+    if cfg.rollout_impl not in ("scan", "open_loop", "auto"):
+        raise ValueError(
+            f"unknown rollout_impl {cfg.rollout_impl!r}; "
+            "choose scan|open_loop|auto"
+        )
+    use_open_loop = cfg.rollout_impl == "open_loop" or (
+        cfg.rollout_impl == "auto" and has_horizon
+    )
+    collect = rollout_open_loop if use_open_loop else rollout
 
     def update_fn(runner: RunnerState):
-        env_state, obs, key, ep_ret, traj = rollout(runner)
+        env_state, obs, key, ep_ret, traj, last_value = collect(runner)
 
-        _, last_value = net.apply(runner.params, obs)
         advantages, targets = gae_op(
             traj["reward"], traj["value"], traj["done"], last_value,
             cfg.gamma, cfg.gae_lambda, impl=cfg.gae_impl,

@@ -35,6 +35,17 @@ class EnvBundle(NamedTuple):
     obs_shape: tuple
     num_actions: int
     name: str = "env"
+    # Optional open-loop fast path (envs whose transitions are
+    # action-independent, e.g. table replay): ``horizon_fn(state, cur_obs,
+    # key, T) -> (obs [T+1, N, ...], aux, new_state)`` and
+    # ``horizon_reward_fn(aux, actions [T, N]) -> rewards [T, N]``. Lets
+    # trainers replace the sequential rollout scan with a few large batched
+    # ops (see ``env/core.py::open_loop_horizon``). Contract: ``aux`` is
+    # otherwise opaque to trainers EXCEPT that it MUST carry
+    # ``aux["dones"]`` as a float32 ``[T, N]`` array (1.0 at episode-end
+    # steps); set BOTH fns or neither.
+    horizon_fn: Callable | None = None
+    horizon_reward_fn: Callable | None = None
 
 
 def make_autoreset(reset_fn: Callable, step_fn: Callable) -> Callable:
@@ -99,6 +110,12 @@ def multi_cloud_bundle(params=None) -> EnvBundle:
         obs_shape=(core.OBS_DIM,),
         num_actions=core.NUM_ACTIONS,
         name="multi_cloud",
+        horizon_fn=lambda state, cur_obs, key, t: core.open_loop_horizon(
+            params, state, cur_obs, key, t
+        ),
+        horizon_reward_fn=lambda aux, actions: core.open_loop_rewards(
+            params, aux, actions
+        ),
     )
 
 

@@ -1,0 +1,171 @@
+"""Open-loop rollout fast path: horizon parity with the scan semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_scheduler_tpu.agent.ppo import PPOTrainConfig, make_ppo, ppo_train
+from rl_scheduler_tpu.config import EnvConfig
+from rl_scheduler_tpu.env import core as env_core
+from rl_scheduler_tpu.env import vector
+from rl_scheduler_tpu.env.bundle import multi_cloud_bundle, single_cluster_bundle
+
+N, T = 8, 25
+
+
+@pytest.fixture(scope="module")
+def env_params():
+    return env_core.make_params(EnvConfig())
+
+
+@pytest.fixture(scope="module")
+def horizon(env_params):
+    state, obs = vector.reset_batch(env_params, jax.random.PRNGKey(0), N)
+    obs_all, aux, new_state = env_core.open_loop_horizon(
+        env_params, state, obs, jax.random.PRNGKey(1), T
+    )
+    return state, obs, obs_all, aux, new_state
+
+
+def test_horizon_obs_match_table_and_carry(env_params, horizon):
+    state, obs, obs_all, aux, new_state = horizon
+    assert obs_all.shape == (T + 1, N, env_core.OBS_DIM)
+    # t=0 is the caller's current obs, carried exactly (not re-drawn)
+    np.testing.assert_array_equal(np.asarray(obs_all[0]), np.asarray(obs))
+    ms = int(env_params.max_steps)
+    for t in (1, 7, T):
+        idx = (np.asarray(state.step_idx) + t) % ms
+        np.testing.assert_allclose(
+            np.asarray(obs_all[t, :, 0:2]), np.asarray(env_params.costs)[idx]
+        )
+        np.testing.assert_allclose(
+            np.asarray(obs_all[t, :, 2:4]), np.asarray(env_params.latencies)[idx]
+        )
+    # CPU noise dims respect the configured range
+    cpu = np.asarray(obs_all[1:, :, 4:6])
+    assert cpu.min() >= float(env_params.cpu_low)
+    assert cpu.max() <= float(env_params.cpu_high)
+
+
+def test_horizon_dones_and_state_advance(env_params, horizon):
+    state, _, _, aux, new_state = horizon
+    ms = int(env_params.max_steps)
+    s0 = np.asarray(state.step_idx)
+    expect_done = ((s0[None, :] + np.arange(T)[:, None]) % ms) == ms - 1
+    np.testing.assert_array_equal(np.asarray(aux["dones"]), expect_done.astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(new_state.step_idx), (s0 + T) % ms
+    )
+    # per-env keys advanced (fresh streams for any later scan-path step)
+    assert not np.array_equal(np.asarray(new_state.key), np.asarray(state.key))
+
+
+def test_horizon_rewards_match_step_formula(env_params, horizon):
+    state, _, _, aux, _ = horizon
+    actions = jnp.asarray(np.random.default_rng(0).integers(0, 2, (T, N)), jnp.int32)
+    rewards = env_core.open_loop_rewards(env_params, aux, actions)
+    ms = int(env_params.max_steps)
+    idx = (np.asarray(state.step_idx)[None, :] + np.arange(T)[:, None]) % ms
+    a = np.asarray(actions)
+    cost = np.asarray(env_params.costs)[idx, a]
+    lat = np.asarray(env_params.latencies)[idx, a]
+    expect = -100.0 * (0.6 * cost + 0.4 * lat)  # fault_prob=0 by default
+    np.testing.assert_allclose(np.asarray(rewards), expect, rtol=1e-6)
+
+
+def test_fault_injection_parity():
+    """fault_prob=1 makes faults deterministic: every step serves at the
+    penalty latency in BOTH paths, so rewards must match step() exactly."""
+    params = env_core.make_params(
+        EnvConfig(fault_prob=1.0, fault_latency_penalty=0.9)
+    )
+    state, obs = vector.reset_batch(params, jax.random.PRNGKey(0), N)
+    _, aux, _ = env_core.open_loop_horizon(
+        params, state, obs, jax.random.PRNGKey(1), T
+    )
+    actions = jnp.asarray(np.random.default_rng(1).integers(0, 2, (T, N)), jnp.int32)
+    rewards = env_core.open_loop_rewards(params, aux, actions)
+    ms = int(params.max_steps)
+    idx = (np.asarray(state.step_idx)[None, :] + np.arange(T)[:, None]) % ms
+    cost = np.asarray(params.costs)[idx, np.asarray(actions)]
+    expect = -100.0 * (0.6 * cost + 0.4 * 0.9)
+    np.testing.assert_allclose(np.asarray(rewards), expect, rtol=1e-6)
+
+
+def test_horizon_without_reward_fn_rejected(env_params):
+    from rl_scheduler_tpu.agent.ppo import make_ppo_bundle
+
+    bad = multi_cloud_bundle(env_params)._replace(horizon_reward_fn=None)
+    cfg = PPOTrainConfig(num_envs=4, rollout_steps=8, minibatch_size=16,
+                         num_epochs=1, hidden=(8, 8))
+    with pytest.raises(ValueError, match="horizon_reward_fn"):
+        make_ppo_bundle(bad, cfg)
+
+
+def test_rewards_statistically_match_scan_path(env_params):
+    """Same policy (uniform-random), both rollout paths: per-step reward
+    mean over a long horizon must agree (different RNG streams, same
+    distribution)."""
+    cfg = PPOTrainConfig(num_envs=64, rollout_steps=99, minibatch_size=512,
+                         num_epochs=1, hidden=(16, 16))
+    means = {}
+    for impl in ("scan", "open_loop"):
+        import dataclasses
+
+        c = dataclasses.replace(cfg, rollout_impl=impl)
+        init_fn, update_fn, _ = make_ppo(env_params, c)
+        runner = jax.jit(init_fn)(jax.random.PRNGKey(0))
+        _, metrics = jax.jit(update_fn)(runner)
+        means[impl] = float(metrics["reward_mean"])
+    assert means["scan"] == pytest.approx(means["open_loop"], rel=0.05)
+
+
+def test_open_loop_training_converges(env_params):
+    """End-to-end: open-loop rollout trains to the optimal policy exactly
+    like the scan path (mirrors test_ppo_converges_to_optimal_policy)."""
+    cfg = PPOTrainConfig(num_envs=16, rollout_steps=99, minibatch_size=512,
+                         num_epochs=4, lr=3e-3, hidden=(64, 64),
+                         entropy_coeff=0.01, rollout_impl="open_loop")
+    runner, history = ppo_train(env_params, cfg, 45, seed=0)
+    from rl_scheduler_tpu.models import ActorCritic
+
+    net = ActorCritic(num_actions=env_core.NUM_ACTIONS, hidden=cfg.hidden)
+    costs = np.asarray(env_params.costs)
+    lats = np.asarray(env_params.latencies)
+    obs = np.concatenate(
+        [costs, lats, np.full((costs.shape[0], 2), 0.45, np.float32)], axis=1
+    )
+    logits, _ = net.apply(runner.params, jnp.asarray(obs, jnp.float32))
+    learned = np.argmax(np.asarray(logits), axis=1)
+    optimal = np.argmin(0.6 * costs + 0.4 * lats, axis=1)
+    agreement = float(np.mean(learned == optimal))
+    assert agreement >= 0.95, f"only {agreement:.0%} of rows optimal"
+
+
+def test_rollout_impl_validation(env_params):
+    import dataclasses
+
+    from rl_scheduler_tpu.agent.ppo import make_ppo_bundle
+
+    cfg = PPOTrainConfig(num_envs=4, rollout_steps=8, minibatch_size=16,
+                         num_epochs=1, hidden=(8, 8))
+    with pytest.raises(ValueError, match="horizon_fn"):
+        make_ppo_bundle(single_cluster_bundle(),
+                        dataclasses.replace(cfg, rollout_impl="open_loop"))
+    with pytest.raises(ValueError, match="rollout_impl"):
+        make_ppo_bundle(multi_cloud_bundle(env_params),
+                        dataclasses.replace(cfg, rollout_impl="bogus"))
+
+
+def test_auto_uses_scan_for_envs_without_horizon():
+    """single_cluster has no horizon_fn: auto must fall back to scan and
+    still train."""
+    from rl_scheduler_tpu.agent.ppo import make_ppo_bundle
+
+    cfg = PPOTrainConfig(num_envs=4, rollout_steps=16, minibatch_size=32,
+                         num_epochs=1, hidden=(8, 8))
+    init_fn, update_fn, _ = make_ppo_bundle(single_cluster_bundle(), cfg)
+    runner = jax.jit(init_fn)(jax.random.PRNGKey(0))
+    _, metrics = jax.jit(update_fn)(runner)
+    assert np.isfinite(float(metrics["policy_loss"]))

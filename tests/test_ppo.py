@@ -52,8 +52,15 @@ def test_ppo_converges_to_optimal_policy(env_params):
 
     This is the reference's end-to-end claim (train_and_compare.py) as a
     test: the env is exactly learnable from the observation.
+
+    Pinned to the scan rollout — this test predates (and now anchors) the
+    sequential path; tests/test_open_loop.py covers the open-loop path
+    with its own convergence run.
     """
-    runner, history = ppo_train(env_params, SMOKE_CFG, 30, seed=0)
+    import dataclasses
+
+    cfg = dataclasses.replace(SMOKE_CFG, rollout_impl="scan")
+    runner, history = ppo_train(env_params, cfg, 30, seed=0)
 
     # learned greedy actions per table row
     net_cfg = SMOKE_CFG
