@@ -83,11 +83,17 @@ class EvalReport:
 
 
 def greedy_policy_fn(net, params) -> Callable:
-    """Deterministic (explore=False) policy: argmax over logits."""
+    """Deterministic (explore=False) policy: argmax over action scores.
+
+    Works for both policy families: actor-critic nets returning
+    ``(logits, value)`` and Q-networks returning plain ``q`` values —
+    greedy argmax is the same operation either way.
+    """
 
     def policy(obs, key):
-        logits, _ = net.apply(params, obs)
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out = net.apply(params, obs)
+        scores = out[0] if isinstance(out, tuple) else out
+        return jnp.argmax(scores, axis=-1).astype(jnp.int32)
 
     return policy
 
@@ -252,14 +258,20 @@ def main(argv: list[str] | None = None) -> EvalReport:
             raise SystemExit(
                 f"checkpoint {run_dir} is for env {ckpt_env!r}; this "
                 "evaluation harness covers the multi-cloud env — pass --run "
-                "pointing at a multi_cloud run (set/graph policies are "
+                "pointing at a multi_cloud run (other env families are "
                 "evaluated by their convergence tests)"
             )
         env_params = env_core.make_params(
             EnvConfig(legacy_reward_sign=bool(meta.get("legacy_reward_sign", False)))
         )
-        hidden = tuple(meta.get("hidden", (256, 256)))
-        net = ActorCritic(num_actions=env_core.NUM_ACTIONS, hidden=hidden)
+        algo = meta.get("algo", "ppo")
+        hidden = tuple(meta.get("hidden") or (256, 256))
+        if algo == "dqn":
+            from rl_scheduler_tpu.models import QNetwork
+
+            net = QNetwork(num_actions=env_core.NUM_ACTIONS, hidden=hidden)
+        else:
+            net = ActorCritic(num_actions=env_core.NUM_ACTIONS, hidden=hidden)
         if args.quick:
             quick_eval(env_params, net, params)
         policy = greedy_policy_fn(net, params)

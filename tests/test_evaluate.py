@@ -122,3 +122,33 @@ def test_compare_harness_end_to_end(env_params, tmp_path):
     assert len(results["reward_curve"]) == 1
     # plot is optional (matplotlib may be absent); must not raise either way
     save_plot(results, tmp_path / "plot.png")
+
+
+def test_evaluate_dqn_checkpoint_end_to_end(tmp_path):
+    """A multi-cloud DQN run's checkpoint is discovered and evaluated with
+    a greedy-Q policy (the algo meta key selects QNetwork)."""
+    from rl_scheduler_tpu.agent import evaluate as eval_cli
+    from rl_scheduler_tpu.agent import train_dqn as dqn_cli
+
+    run_dir = dqn_cli.main([
+        "--env", "multi_cloud", "--preset", "config1", "--iterations", "8",
+        "--run-root", str(tmp_path), "--run-name", "dqn_eval_test",
+        "--checkpoint-every", "8", "--hidden", "16,16",
+    ])
+    report = eval_cli.main([
+        "--run", str(run_dir), "--episodes", "4",
+        "--results-dir", str(tmp_path / "results"),
+    ])
+    assert np.isfinite(report.avg_episode_cost)
+    assert (tmp_path / "results" / "final_evaluation_summary.txt").exists()
+
+
+def test_evaluate_greedy_q_policy_via_qnetwork(env_params):
+    from rl_scheduler_tpu.models import QNetwork
+
+    net = QNetwork(num_actions=env_core.NUM_ACTIONS, hidden=(16, 16))
+    params = net.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, env_core.OBS_DIM), jnp.float32)
+    )
+    report = evaluate(env_params, greedy_policy_fn(net, params), num_episodes=4)
+    assert np.isfinite(report.avg_episode_cost)
