@@ -36,7 +36,6 @@ from rl_scheduler_tpu.env.baselines import (
     round_robin_policy,
 )
 from rl_scheduler_tpu.env.vector import reset_batch, rollout_from
-from rl_scheduler_tpu.models import ActorCritic
 
 # The reference's hardcoded eval anchor (final_evaluation.py:73), kept only
 # to report alongside the computed baseline.
@@ -264,14 +263,11 @@ def main(argv: list[str] | None = None) -> EvalReport:
         env_params = env_core.make_params(
             EnvConfig(legacy_reward_sign=bool(meta.get("legacy_reward_sign", False)))
         )
+        from rl_scheduler_tpu.models import build_flat_policy_net
+
         algo = meta.get("algo", "ppo")
         hidden = tuple(meta.get("hidden") or (256, 256))
-        if algo == "dqn":
-            from rl_scheduler_tpu.models import QNetwork
-
-            net = QNetwork(num_actions=env_core.NUM_ACTIONS, hidden=hidden)
-        else:
-            net = ActorCritic(num_actions=env_core.NUM_ACTIONS, hidden=hidden)
+        net = build_flat_policy_net(algo, env_core.NUM_ACTIONS, hidden)
         if args.quick:
             quick_eval(env_params, net, params)
         policy = greedy_policy_fn(net, params)

@@ -4,4 +4,22 @@ from rl_scheduler_tpu.models.mlp import ActorCritic, QNetwork
 from rl_scheduler_tpu.models.transformer import SetTransformerPolicy
 from rl_scheduler_tpu.models.gnn import GNNPolicy
 
-__all__ = ["ActorCritic", "QNetwork", "SetTransformerPolicy", "GNNPolicy"]
+
+def build_flat_policy_net(algo: str, num_actions: int, hidden: tuple):
+    """The flat-obs network family for a checkpoint's ``algo`` meta key —
+    the single source of truth shared by evaluation and serving (greedy
+    argmax over the net's action scores is the decision either way)."""
+    if algo == "dqn":
+        return QNetwork(num_actions=num_actions, hidden=hidden)
+    if algo == "ppo":
+        return ActorCritic(num_actions=num_actions, hidden=hidden)
+    raise ValueError(f"unknown algo {algo!r}; choose ppo|dqn")
+
+
+__all__ = [
+    "ActorCritic",
+    "QNetwork",
+    "SetTransformerPolicy",
+    "GNNPolicy",
+    "build_flat_policy_net",
+]

@@ -22,7 +22,8 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 _SRC = Path(__file__).with_name("mlp_infer.cpp")
-ABI_VERSION = 1
+ABI_VERSION = 2
+ACTIVATIONS = {"tanh": 0, "relu": 1}
 
 
 def _cache_dir() -> Path:
@@ -74,7 +75,11 @@ class NativeMLP:
     """ctypes wrapper over one packed MLP; ``decide`` is thread-safe."""
 
     def __init__(self, layers: list[tuple[np.ndarray, np.ndarray]],
-                 lib_path: Path | None = None):
+                 lib_path: Path | None = None, activation: str = "tanh"):
+        if activation not in ACTIVATIONS:
+            raise ValueError(
+                f"unknown activation {activation!r}; choose from {sorted(ACTIVATIONS)}"
+            )
         lib_path = lib_path or ensure_built()
         if lib_path is None:
             raise RuntimeError("native library unavailable")
@@ -83,6 +88,7 @@ class NativeMLP:
         lib.mlp_create.argtypes = [
             ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
             ctypes.c_int32,
         ]
         lib.mlp_decide.restype = ctypes.c_int32
@@ -104,6 +110,7 @@ class NativeMLP:
             weights.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             dims.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             len(dims),
+            ACTIVATIONS[activation],
         )
         if not handle:
             raise RuntimeError("mlp_create rejected the packed weights")

@@ -12,7 +12,9 @@
 //   dims   = [d_0, d_1, ..., d_L]   layer widths, d_0 = obs dim
 //   weights = for each layer i: kernel (d_i x d_{i+1}, row-major, numpy
 //             [in, out] order) followed by bias (d_{i+1})
-// Hidden layers apply tanh; the final layer is linear (logits).
+// Hidden layers apply the configured activation (0 = tanh for the PPO
+// actor, 1 = relu for the DQN Q-network); the final layer is linear
+// (logits / Q-values).
 
 #include <cmath>
 #include <cstdint>
@@ -28,12 +30,16 @@ struct Layer {
   int out = 0;
 };
 
+enum Activation : int32_t { kTanh = 0, kRelu = 1 };
+
 struct MLP {
   std::vector<Layer> layers;
   int max_width = 0;
+  Activation act = kTanh;
 };
 
-void forward_layer(const Layer& l, const float* x, float* y, bool activate) {
+void forward_layer(const Layer& l, const float* x, float* y, bool activate,
+                   Activation act) {
   for (int j = 0; j < l.out; ++j) y[j] = l.bias[j];
   for (int i = 0; i < l.in; ++i) {
     const float xi = x[i];
@@ -41,7 +47,11 @@ void forward_layer(const Layer& l, const float* x, float* y, bool activate) {
     for (int j = 0; j < l.out; ++j) y[j] += xi * row[j];
   }
   if (activate) {
-    for (int j = 0; j < l.out; ++j) y[j] = std::tanh(y[j]);
+    if (act == kRelu) {
+      for (int j = 0; j < l.out; ++j) y[j] = y[j] > 0.0f ? y[j] : 0.0f;
+    } else {
+      for (int j = 0; j < l.out; ++j) y[j] = std::tanh(y[j]);
+    }
   }
 }
 
@@ -50,9 +60,13 @@ void forward_layer(const Layer& l, const float* x, float* y, bool activate) {
 extern "C" {
 
 // Returns an opaque handle, or nullptr on invalid arguments.
-void* mlp_create(const float* weights, const int32_t* dims, int32_t n_dims) {
+// activation: 0 = tanh, 1 = relu (hidden layers only).
+void* mlp_create(const float* weights, const int32_t* dims, int32_t n_dims,
+                 int32_t activation) {
   if (weights == nullptr || dims == nullptr || n_dims < 2) return nullptr;
+  if (activation != kTanh && activation != kRelu) return nullptr;
   auto* mlp = new MLP();
+  mlp->act = static_cast<Activation>(activation);
   size_t off = 0;
   for (int32_t i = 0; i + 1 < n_dims; ++i) {
     if (dims[i] <= 0 || dims[i + 1] <= 0) {
@@ -84,7 +98,7 @@ int32_t mlp_decide(const void* handle, const float* obs, float* logits_out) {
   float* x = a.data();
   float* y = b.data();
   for (size_t i = 0; i < n; ++i) {
-    forward_layer(mlp->layers[i], x, y, /*activate=*/i + 1 < n);
+    forward_layer(mlp->layers[i], x, y, /*activate=*/i + 1 < n, mlp->act);
     std::swap(x, y);
   }
   // Result lives in x after the final swap.
@@ -99,6 +113,6 @@ int32_t mlp_decide(const void* handle, const float* obs, float* logits_out) {
 
 void mlp_destroy(void* handle) { delete static_cast<MLP*>(handle); }
 
-int32_t mlp_abi_version() { return 1; }
+int32_t mlp_abi_version() { return 2; }
 
 }  // extern "C"

@@ -323,6 +323,7 @@ def build_policy(
     """Assemble the serving stack: checkpoint -> backend -> telemetry."""
     params_tree = None
     hidden = (256, 256)
+    algo = "ppo"
     if backend != "greedy":
         try:
             from rl_scheduler_tpu.config import RuntimeConfig
@@ -335,13 +336,29 @@ def build_policy(
             run_dir = (
                 Path(run) if run else find_latest_run(run_root or RuntimeConfig().checkpoint_dir)
             )
-            params_tree, meta = load_policy_params(run_dir)
-            hidden = tuple(meta.get("hidden", hidden))
-            logger.info("serving checkpoint from %s", run_dir)
+            tree, meta = load_policy_params(run_dir)
+            ckpt_env = meta.get("env", "multi_cloud")
+            if ckpt_env != "multi_cloud":
+                # A different env family means a different observation
+                # space: the net would load fine but raise (fail-open) on
+                # every 6-dim request. Refuse at startup (params_tree stays
+                # None -> greedy fallback) instead.
+                raise ValueError(
+                    f"checkpoint {run_dir} is for env {ckpt_env!r}; the "
+                    "extender serves multi-cloud observations — pass --run "
+                    "pointing at a multi_cloud run"
+                )
+            params_tree = tree
+            hidden = tuple(meta.get("hidden") or hidden)
+            # The meta's algo key selects the network family — a DQN run
+            # being the newest must serve a Q-network, not be misread as
+            # an actor-critic tree.
+            algo = meta.get("algo", "ppo")
+            logger.info("serving %s checkpoint from %s", algo, run_dir)
         except Exception:  # corrupt/missing checkpoint must not keep the
             # extender down — greedy fallback absorbs it (SURVEY.md §5.3).
             logger.exception("checkpoint load failed; serving cost-greedy fallback")
-    backend_obj, _ = make_backend(backend, params_tree, hidden, serve_device)
+    backend_obj, _ = make_backend(backend, params_tree, hidden, serve_device, algo)
     cpu_source = PrometheusCpu() if prometheus else RandomCpu(seed=cpu_seed)
     telemetry = TableTelemetry.from_table(data_path, cpu_source)
     placer = None

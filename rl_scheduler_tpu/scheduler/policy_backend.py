@@ -38,9 +38,19 @@ from rl_scheduler_tpu.env import core as env_core
 logger = logging.getLogger(__name__)
 
 
+# Per-algo network layout: (torso subtree, head subtree, hidden activation).
+# ppo = flax ActorCritic (tanh torso, named submodules); dqn = QNetwork
+# (relu torso, flax auto-names). Greedy argmax over the head output is the
+# serving decision either way.
+ALGO_LAYOUTS = {
+    "ppo": ("actor_torso", "actor_head", "tanh"),
+    "dqn": ("MLPTorso_0", "Dense_0", "relu"),
+}
+
+
 def _flatten_mlp(tree: dict, torso: str, head: str) -> list[tuple[np.ndarray, np.ndarray]]:
     """Extract ``[(kernel, bias), ...]`` for a torso+head stack from a flax
-    ActorCritic param tree (nested dicts, as restored by orbax)."""
+    MLP param tree (nested dicts, as restored by orbax)."""
     params = tree["params"] if "params" in tree else tree
     layers = []
     torso_tree = params[torso]
@@ -52,49 +62,60 @@ def _flatten_mlp(tree: dict, torso: str, head: str) -> list[tuple[np.ndarray, np
     return layers
 
 
+def _layout(algo: str) -> tuple[str, str, str]:
+    if algo not in ALGO_LAYOUTS:
+        raise ValueError(f"unknown algo {algo!r}; choose from {sorted(ALGO_LAYOUTS)}")
+    return ALGO_LAYOUTS[algo]
+
+
 class NumpyMLPBackend:
-    """Actor forward pass in plain numpy (tanh MLP -> logits)."""
+    """Policy forward pass in plain numpy (MLP -> action scores)."""
 
     name = "cpu"
 
-    def __init__(self, params_tree: dict):
-        self._layers = _flatten_mlp(params_tree, "actor_torso", "actor_head")
+    def __init__(self, params_tree: dict, algo: str = "ppo"):
+        torso, head, act = _layout(algo)
+        self._layers = _flatten_mlp(params_tree, torso, head)
+        self._act = np.tanh if act == "tanh" else lambda x: np.maximum(x, 0.0)
 
     def decide(self, obs: np.ndarray) -> tuple[int, np.ndarray]:
         x = obs.astype(np.float32)
         for kernel, bias in self._layers[:-1]:
-            x = np.tanh(x @ kernel + bias)
+            x = self._act(x @ kernel + bias)
         kernel, bias = self._layers[-1]
         logits = x @ kernel + bias
         return int(np.argmax(logits)), logits
 
 
 class NativeMLPBackend:
-    """Actor forward in the C++ core (one ctypes call per decision)."""
+    """Policy forward in the C++ core (one ctypes call per decision)."""
 
     name = "native"
 
-    def __init__(self, params_tree: dict):
+    def __init__(self, params_tree: dict, algo: str = "ppo"):
         from rl_scheduler_tpu.native import NativeMLP
 
-        self._mlp = NativeMLP(_flatten_mlp(params_tree, "actor_torso", "actor_head"))
+        torso, head, act = _layout(algo)
+        self._mlp = NativeMLP(_flatten_mlp(params_tree, torso, head), activation=act)
 
     def decide(self, obs: np.ndarray) -> tuple[int, np.ndarray]:
         return self._mlp.decide(obs)
 
 
 class TorchMLPBackend:
-    """Same actor forward mirrored into torch CPU tensors."""
+    """Same policy forward mirrored into torch CPU tensors."""
 
     name = "torch"
 
-    def __init__(self, params_tree: dict):
+    def __init__(self, params_tree: dict, algo: str = "ppo"):
         import torch
 
         self._torch = torch
+        torso, head, act = _layout(algo)
+        self._act = torch.tanh if act == "tanh" else torch.relu
         self._layers = [
             (torch.from_numpy(np.array(k)), torch.from_numpy(np.array(b)))
-            for k, b in _flatten_mlp(params_tree, "actor_torso", "actor_head")
+            for k, b in _flatten_mlp(params_tree, torso, head)
         ]
 
     def decide(self, obs: np.ndarray) -> tuple[int, np.ndarray]:
@@ -102,7 +123,7 @@ class TorchMLPBackend:
         with torch.no_grad():
             x = torch.from_numpy(obs.astype(np.float32))
             for kernel, bias in self._layers[:-1]:
-                x = torch.tanh(x @ kernel + bias)
+                x = self._act(x @ kernel + bias)
             kernel, bias = self._layers[-1]
             logits = (x @ kernel + bias).numpy()
         return int(np.argmax(logits)), logits
@@ -120,13 +141,15 @@ class JaxAOTBackend:
 
     name = "jax"
 
-    def __init__(self, params_tree: dict, hidden: tuple = (256, 256), device: str = "cpu"):
+    def __init__(self, params_tree: dict, hidden: tuple = (256, 256),
+                 device: str = "cpu", algo: str = "ppo"):
         import jax
         import jax.numpy as jnp
 
-        from rl_scheduler_tpu.models import ActorCritic
+        from rl_scheduler_tpu.models import build_flat_policy_net
 
-        net = ActorCritic(num_actions=env_core.NUM_ACTIONS, hidden=hidden)
+        _layout(algo)  # validate algo up front
+        net = build_flat_policy_net(algo, env_core.NUM_ACTIONS, hidden)
         try:
             dev = jax.devices(device)[0]
         except RuntimeError:
@@ -134,8 +157,8 @@ class JaxAOTBackend:
         self._params = jax.device_put(params_tree, dev)
 
         def apply(params, obs):
-            logits, _ = net.apply(params, obs)
-            return logits
+            out = net.apply(params, obs)
+            return out[0] if isinstance(out, tuple) else out
 
         obs_spec = jax.ShapeDtypeStruct((env_core.OBS_DIM,), jnp.float32)
         params_spec = jax.tree.map(
@@ -178,13 +201,17 @@ def make_backend(
     params_tree: dict | None = None,
     hidden: tuple = (256, 256),
     device: str = "cpu",
+    algo: str = "ppo",
 ):
     """Build a serving backend; degrade to ``greedy`` if construction fails.
 
-    Returns ``(backend_obj, fallback_used: bool)``.
+    ``algo`` selects the checkpoint's network family (``ppo`` actor-critic
+    or ``dqn`` Q-network — the eval/serving decision is greedy argmax either
+    way). Returns ``(backend_obj, fallback_used: bool)``.
     """
     if backend not in BACKENDS:
         raise ValueError(f"unknown backend {backend!r}; choose from {sorted(BACKENDS)}")
+    _layout(algo)
     if backend == "greedy" or params_tree is None:
         if backend != "greedy":
             logger.warning("no checkpoint params; serving cost-greedy fallback")
@@ -193,16 +220,16 @@ def make_backend(
         # Native degrades to the numerically-identical numpy path first
         # (missing compiler / .so), and only then to greedy.
         try:
-            return NativeMLPBackend(params_tree), False
+            return NativeMLPBackend(params_tree, algo), False
         except Exception as e:  # noqa: BLE001 - any build/load failure
             logger.warning("native backend unavailable (%s); using cpu", e)
             backend = "cpu"
     try:
         if backend == "jax":
-            return JaxAOTBackend(params_tree, hidden, device), False
+            return JaxAOTBackend(params_tree, hidden, device, algo), False
         if backend == "cpu":
-            return NumpyMLPBackend(params_tree), False
-        return TorchMLPBackend(params_tree), False
+            return NumpyMLPBackend(params_tree, algo), False
+        return TorchMLPBackend(params_tree, algo), False
     except Exception:  # any init failure (bad param tree, device error, ...)
         logger.exception("backend %r failed to initialize; falling back to greedy", backend)
         return GreedyBackend(), True

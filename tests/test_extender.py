@@ -267,3 +267,78 @@ def test_async_placer_never_blocks_and_bounds_queue():
     while len(placed) < 4 and time.time() < deadline:
         time.sleep(0.01)
     assert placed, "worker must drain queued placements once unblocked"
+
+
+# ------------------------------------------------------------ DQN serving
+
+
+@pytest.fixture(scope="module")
+def dqn_params_tree():
+    from rl_scheduler_tpu.models import QNetwork
+
+    net = QNetwork(num_actions=env_core.NUM_ACTIONS, hidden=HIDDEN)
+    return net.init(
+        jax.random.PRNGKey(9), jnp.zeros((1, env_core.OBS_DIM), jnp.float32)
+    )
+
+
+def test_dqn_backends_agree_on_decisions(dqn_params_tree):
+    """All host backends serve the same greedy-Q function for a DQN tree."""
+    numpy_b = NumpyMLPBackend(dqn_params_tree, algo="dqn")
+    torch_b = TorchMLPBackend(dqn_params_tree, algo="dqn")
+    jax_b = JaxAOTBackend(dqn_params_tree, hidden=HIDDEN, algo="dqn")
+    rng = np.random.RandomState(3)
+    for _ in range(20):
+        obs = rng.uniform(0, 1, env_core.OBS_DIM).astype(np.float32)
+        a_np, q_np = numpy_b.decide(obs)
+        a_t, q_t = torch_b.decide(obs)
+        a_j, q_j = jax_b.decide(obs)
+        assert a_np == a_t == a_j
+        np.testing.assert_allclose(q_np, q_t, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(q_np, q_j, rtol=1e-4, atol=1e-5)
+
+
+def test_ppo_tree_with_dqn_layout_falls_back(params_tree):
+    """Mismatched algo layout (PPO tree read as DQN) must degrade to greedy,
+    not crash the server."""
+    backend, fell_back = make_backend("cpu", params_tree, algo="dqn")
+    assert fell_back and backend.name == "greedy"
+
+
+def test_make_backend_unknown_algo_raises(params_tree):
+    with pytest.raises(ValueError, match="algo"):
+        make_backend("cpu", params_tree, algo="sarsa")
+
+
+def test_build_policy_serves_dqn_checkpoint(tmp_path):
+    """End-to-end: the newest run being a DQN one serves its Q-network."""
+    from rl_scheduler_tpu.agent import train_dqn as dqn_cli
+    from rl_scheduler_tpu.scheduler.extender import build_policy
+
+    run_dir = dqn_cli.main([
+        "--env", "multi_cloud", "--preset", "config1", "--iterations", "4",
+        "--run-root", str(tmp_path), "--run-name", "dqn_serve_test",
+        "--checkpoint-every", "4", "--hidden", "32,32",
+    ])
+    policy = build_policy(backend="cpu", run=str(run_dir))
+    assert policy.backend.name == "cpu"  # not the greedy fallback
+    result = policy.filter({
+        "pod": {"metadata": {"name": "p"}},
+        "nodes": {"items": [_node("n1", "aws"), _node("n2", "azure")]},
+    })
+    assert len(result["nodes"]["items"]) == 1
+
+
+def test_build_policy_rejects_wrong_env_checkpoint(tmp_path):
+    """A newest run from a different env family (different obs dim) must
+    degrade to greedy at startup, not fail-open on every request."""
+    from rl_scheduler_tpu.agent import train_dqn as dqn_cli
+    from rl_scheduler_tpu.scheduler.extender import build_policy
+
+    dqn_cli.main([
+        "--env", "single_cluster", "--preset", "config1", "--iterations", "4",
+        "--run-root", str(tmp_path), "--run-name", "sc_run",
+        "--checkpoint-every", "4", "--hidden", "16,16",
+    ])
+    policy = build_policy(backend="cpu", run_root=str(tmp_path))
+    assert policy.backend.name == "greedy"

@@ -115,3 +115,28 @@ def test_native_degrades_to_cpu_when_lib_missing(monkeypatch, params_tree):
     backend, fell_back = make_backend("native", params_tree)
     assert backend.name == "cpu"
     assert not fell_back
+
+
+def test_native_relu_matches_numpy(lib_path):
+    """ABI v2 activation selector: relu hidden layers match numpy exactly."""
+    rng = np.random.default_rng(5)
+    layers = random_layers(rng)
+    mlp = NativeMLP(layers, lib_path=lib_path, activation="relu")
+    for _ in range(10):
+        obs = rng.uniform(-1, 1, 6).astype(np.float32)
+        x = obs.copy()
+        for kernel, bias in layers[:-1]:
+            x = np.maximum(x @ kernel + bias, 0.0)
+        kernel, bias = layers[-1]
+        expect = x @ kernel + bias
+        action, logits = mlp.decide(obs)
+        # C++ accumulates in a different order than numpy's BLAS; tolerance
+        # matches the tanh parity tests.
+        np.testing.assert_allclose(logits, expect, rtol=1e-4, atol=1e-5)
+        assert action == int(np.argmax(expect))
+
+
+def test_native_unknown_activation_rejected(lib_path):
+    rng = np.random.default_rng(6)
+    with pytest.raises(ValueError, match="activation"):
+        NativeMLP(random_layers(rng), lib_path=lib_path, activation="gelu")
