@@ -79,16 +79,48 @@ def run_train_loop(
     return runner, history
 
 
+class TensorBoardLogger:
+    """Optional TensorBoard sink for training metrics (SURVEY.md §5.5).
+
+    Uses torch's ``SummaryWriter`` (CPU torch ships with this framework's
+    environment); raises ImportError with a clear message if the
+    ``tensorboard`` package is absent. Scalars land under ``<run_dir>/tb``
+    — point ``tensorboard --logdir`` at the run root.
+    """
+
+    def __init__(self, run_dir: Any):
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except ImportError as e:
+            raise ImportError(
+                "tensorboard logging needs BOTH torch and the tensorboard "
+                f"package (torch.utils.tensorboard import failed: {e})"
+            ) from e
+        self._writer = SummaryWriter(str(run_dir) + "/tb")
+
+    def add(self, step: int, metrics: dict) -> None:
+        for k, v in metrics.items():
+            self._writer.add_scalar(k, v, step)
+        # Flush per burst so a killed run's event file matches the JSONL
+        # sink's durability (SummaryWriter otherwise buffers ~120 s).
+        self._writer.flush()
+
+    def close(self) -> None:
+        self._writer.close()
+
+
 def make_jsonl_log_fn(
     metrics_file: Any,
     steps_per_iter: int,
     start_iteration: int = 0,
     print_line: Callable[[int, float, dict], None] | None = None,
+    tb: TensorBoardLogger | None = None,
 ) -> Callable[[int, dict], None]:
     """Standard CLI ``log_fn``: one JSONL line per iteration with a
     cumulative ``env_steps_per_sec`` computed from the loop's ``wall_time``
     (the local clock would lump a sync burst into one instant), then an
-    optional ``print_line(i, sps, metrics)`` for console output.
+    optional ``print_line(i, sps, metrics)`` for console output and an
+    optional TensorBoard sink.
     """
 
     def log_fn(i: int, metrics: dict) -> None:
@@ -96,6 +128,8 @@ def make_jsonl_log_fn(
         line = {"iteration": i + 1, "env_steps_per_sec": round(sps, 1), **metrics}
         metrics_file.write(json.dumps(line) + "\n")
         metrics_file.flush()
+        if tb is not None:
+            tb.add(i + 1, {"env_steps_per_sec": sps, **metrics})
         if print_line is not None:
             print_line(i, sps, metrics)
 

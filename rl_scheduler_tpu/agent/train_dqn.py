@@ -64,6 +64,8 @@ def main(argv: list[str] | None = None) -> Path:
     p.add_argument("--log-every", type=int, default=100,
                    help="print one progress line every N iterations (all "
                         "iterations always go to metrics.jsonl)")
+    p.add_argument("--tensorboard", action="store_true",
+                   help="also log metrics to TensorBoard under <run>/tb")
     p.add_argument("--sync-every", type=int, default=100,
                    help="fetch metrics for N iterations in one device->host "
                         "transfer; a DQN iteration is tiny, so per-iteration "
@@ -91,6 +93,7 @@ def main(argv: list[str] | None = None) -> Path:
     ckpt = CheckpointManager(run_dir, keep=args.keep)
 
     from rl_scheduler_tpu.agent.loop import (
+        TensorBoardLogger,
         make_jsonl_log_fn,
         make_periodic_checkpoint_fn,
     )
@@ -105,8 +108,9 @@ def main(argv: list[str] | None = None) -> Path:
                 flush=True,
             )
 
+    tb = TensorBoardLogger(run_dir) if args.tensorboard else None
     log_fn = make_jsonl_log_fn(metrics_file, cfg.collect_steps * cfg.num_envs,
-                               print_line=print_line)
+                               print_line=print_line, tb=tb)
     checkpoint_fn = make_periodic_checkpoint_fn(
         ckpt, args.checkpoint_every, args.iterations,
         lambda runner: {
@@ -129,6 +133,8 @@ def main(argv: list[str] | None = None) -> Path:
               log_fn=log_fn, checkpoint_fn=checkpoint_fn,
               sync_every=args.sync_every)
     metrics_file.close()
+    if tb is not None:
+        tb.close()
     print(f"Training finished! Checkpoints in {run_dir}")
     return run_dir
 

@@ -106,6 +106,8 @@ def main(argv: list[str] | None = None) -> Path:
                    help="checkify the update: raise on the first NaN/"
                         "zero-division instead of silently corrupting "
                         "training (slower; for debugging)")
+    p.add_argument("--tensorboard", action="store_true",
+                   help="also log metrics to TensorBoard under <run>/tb")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the whole run into "
                         "this directory (keep --iterations small; view in "
@@ -210,6 +212,7 @@ def main(argv: list[str] | None = None) -> Path:
         print(f"Resuming from iteration {latest} (checkpoints in {run_dir})")
 
     from rl_scheduler_tpu.agent.loop import (
+        TensorBoardLogger,
         make_jsonl_log_fn,
         make_periodic_checkpoint_fn,
     )
@@ -227,8 +230,9 @@ def main(argv: list[str] | None = None) -> Path:
         print(f"Iteration {i + 1}: {reward_str} | {sps:,.0f} env-steps/s",
               flush=True)
 
+    tb = TensorBoardLogger(run_dir) if args.tensorboard else None
     log_fn = make_jsonl_log_fn(metrics_file, cfg.batch_size,
-                               start_iteration, print_line)
+                               start_iteration, print_line, tb=tb)
     checkpoint_fn = make_periodic_checkpoint_fn(
         ckpt, args.checkpoint_every, args.iterations,
         lambda runner: {"params": runner.params, "opt_state": runner.opt_state},
@@ -255,6 +259,8 @@ def main(argv: list[str] | None = None) -> Path:
                   log_fn=log_fn, checkpoint_fn=checkpoint_fn, restore=restore,
                   debug_checks=args.debug_checks, sync_every=args.sync_every)
     metrics_file.close()
+    if tb is not None:
+        tb.close()
     print(f"Training finished! Checkpoints in {run_dir}")
     return run_dir
 

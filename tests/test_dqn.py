@@ -183,3 +183,16 @@ def test_run_train_loop_wall_time_and_crash_flush():
     assert [i for i, _ in seen] == [0, 1, 2]
     walls = [m["wall_time"] for _, m in seen]
     assert walls == sorted(walls) and walls[-1] > 0
+
+
+def test_tensorboard_flag_writes_event_files(tmp_path):
+    pytest.importorskip("torch.utils.tensorboard")
+    from rl_scheduler_tpu.agent import train_dqn as cli
+
+    run_dir = cli.main([
+        "--preset", "config1", "--iterations", "3",
+        "--run-root", str(tmp_path), "--run-name", "tb_test",
+        "--checkpoint-every", "3", "--hidden", "8,8", "--tensorboard",
+    ])
+    events = list((run_dir / "tb").glob("events.out.tfevents.*"))
+    assert events and events[0].stat().st_size > 0
