@@ -48,13 +48,20 @@ class EnvBundle(NamedTuple):
     horizon_reward_fn: Callable | None = None
 
 
-def make_autoreset(reset_fn: Callable, step_fn: Callable) -> Callable:
+def make_autoreset(
+    reset_fn: Callable, step_fn: Callable, with_final_obs: bool = False
+) -> Callable:
     """Lift single-env ``(reset, step)`` into an auto-resetting step.
 
     The returned TimeStep carries the terminal reward/done of the finishing
     episode while obs/state roll into the next episode — the contract
     scan-collected rollouts need (Gymnasium episode semantics, reference
     ``k8s_multi_cloud_env.py:139-141``, without host round-trips).
+
+    With ``with_final_obs=True`` the step returns ``(state, out_obs,
+    raw_timestep)`` instead, where ``raw_timestep.obs`` is the finishing
+    episode's terminal observation (discarded otherwise) — the Gymnasium
+    vector same-step convention needs it for ``infos["final_obs"]``.
     """
 
     def step_autoreset(state, action):
@@ -66,6 +73,8 @@ def make_autoreset(reset_fn: Callable, step_fn: Callable) -> Callable:
             lambda r, n: jnp.where(ts.done, r, n), reset_state, new_state
         )
         out_obs = jnp.where(ts.done, reset_obs, ts.obs)
+        if with_final_obs:
+            return out_state, out_obs, ts
         return out_state, ts._replace(obs=out_obs)
 
     return step_autoreset

@@ -104,6 +104,91 @@ class K8sMultiCloudEnv(_GYM_BASE):
         return 0 if obs[0] <= obs[1] else 1
 
 
+def _step_with_final_obs(params, state, action):
+    """Same-step autoreset that ALSO returns the terminal observation
+    (shared autoreset logic from ``bundle.make_autoreset``)."""
+    from rl_scheduler_tpu.env.bundle import make_autoreset
+
+    fn = make_autoreset(
+        lambda key: core.reset(params, key),
+        lambda st, a: core.step(params, st, a),
+        with_final_obs=True,
+    )
+    return fn(state, action)
+
+
+_JIT_VEC_STEP = jax.jit(jax.vmap(_step_with_final_obs, in_axes=(None, 0, 0)))
+
+
+_VEC_BASE = object if gym is None else gym.vector.VectorEnv
+
+
+class K8sMultiCloudVectorEnv(_VEC_BASE):
+    """Gymnasium ``VectorEnv``-style adapter over the vmapped core.
+
+    N simulated clusters step as ONE jitted XLA program per ``step`` call —
+    the Gym-ecosystem face of the same vectorization training uses
+    (``env/vector.py``). Follows the same-step autoreset convention: when
+    env i terminates, ``obs[i]`` is already the next episode's first
+    observation and the finishing observation is in
+    ``infos["final_obs"][i]`` (with ``infos["_final_obs"]`` as the validity
+    mask — the Gymnasium 1.x ``AutoresetMode.SAME_STEP`` convention).
+
+    Host-driven stepping pays one device round-trip per call, so this is
+    for external Gym tooling (wrappers, eval harnesses) — training should
+    use the functional core, which fuses whole rollouts into one program.
+    """
+
+    def __init__(self, num_envs: int, config: EnvConfig | None = None):
+        if gym is None:
+            raise ImportError("gymnasium is required for the adapter; use env.core directly")
+        from gymnasium.vector.utils import batch_space
+
+        # Declared so Gymnasium wrappers account episodes correctly
+        # (without it they assume NEXT_STEP and mis-handle the reset obs).
+        self.metadata = {"autoreset_mode": gym.vector.AutoresetMode.SAME_STEP}
+        self.num_envs = num_envs
+        self.params = core.make_params(config or EnvConfig())
+        self.single_action_space = spaces.Discrete(core.NUM_ACTIONS)
+        self.single_observation_space = spaces.Box(0.0, 1.0, (core.OBS_DIM,), np.float32)
+        self.action_space = batch_space(self.single_action_space, num_envs)
+        self.observation_space = batch_space(self.single_observation_space, num_envs)
+        self._state = None
+
+    def reset(self, seed: int | None = None, options: dict | None = None):
+        from rl_scheduler_tpu.env.vector import reset_batch
+
+        if seed is None:
+            seed = int.from_bytes(os.urandom(4), "little")
+        self._state, obs = reset_batch(
+            self.params, jax.random.PRNGKey(seed), self.num_envs
+        )
+        return np.asarray(obs), {}
+
+    def step(self, actions):
+        actions = np.asarray(actions, np.int32)
+        self._state, obs, ts = _JIT_VEC_STEP(self.params, self._state, actions)
+        done = np.asarray(ts.done)
+        infos: dict[str, Any] = {}
+        if done.any():
+            final = np.empty(self.num_envs, dtype=object)
+            raw = np.asarray(ts.obs)
+            for i in np.nonzero(done)[0]:
+                final[i] = raw[i]
+            infos["final_obs"] = final
+            infos["_final_obs"] = done.copy()
+        return (
+            np.asarray(obs),
+            np.asarray(ts.reward),
+            done,
+            np.zeros(self.num_envs, bool),
+            infos,
+        )
+
+    def close(self):
+        pass
+
+
 if __name__ == "__main__":
     env = K8sMultiCloudEnv(fast_mode=True)
     obs, _ = env.reset(seed=42)

@@ -79,3 +79,60 @@ def test_time_limit_wrapper_compat():
         _, _, done, truncated, _ = e.step(steps % 2)
         steps += 1
     assert steps == 99  # natural done fires before the 100-step truncation
+
+
+class TestVectorEnv:
+    def test_spaces_and_shapes(self):
+        from rl_scheduler_tpu.env.gym_adapter import K8sMultiCloudVectorEnv
+
+        env = K8sMultiCloudVectorEnv(num_envs=5)
+        obs, info = env.reset(seed=0)
+        assert obs.shape == (5, 6) and obs.dtype == np.float32
+        assert env.observation_space.shape == (5, 6)
+        obs, rewards, terms, truncs, infos = env.step(np.zeros(5, np.int32))
+        assert rewards.shape == (5,) and terms.shape == (5,)
+        assert not terms.any() and not truncs.any() and infos == {}
+
+    def test_isinstance_of_gym_vector_env(self):
+        import gymnasium as gym
+
+        from rl_scheduler_tpu.env.gym_adapter import K8sMultiCloudVectorEnv
+
+        assert isinstance(K8sMultiCloudVectorEnv(num_envs=2), gym.vector.VectorEnv)
+
+    def test_same_step_autoreset_and_final_observation(self):
+        from rl_scheduler_tpu.env import core
+        from rl_scheduler_tpu.env.gym_adapter import K8sMultiCloudVectorEnv
+
+        env = K8sMultiCloudVectorEnv(num_envs=3)
+        env.reset(seed=1)
+        ms = int(env.params.max_steps)
+        for t in range(ms):
+            obs, rewards, terms, truncs, infos = env.step(np.zeros(3, np.int32))
+        assert terms.all()
+        # terminal obs = table row at index max_steps; next obs = row 0
+        costs = np.asarray(env.params.costs)
+        assert infos["_final_obs"].all()
+        for i in range(3):
+            np.testing.assert_allclose(infos["final_obs"][i][:2], costs[ms])
+        np.testing.assert_allclose(obs[:, :2], np.tile(costs[0], (3, 1)))
+        # episode continues seamlessly after the same-step reset
+        obs2, _, terms2, _, _ = env.step(np.ones(3, np.int32))
+        assert not terms2.any()
+        np.testing.assert_allclose(obs2[:, :2], np.tile(costs[1], (3, 1)))
+
+    def test_reward_matches_single_env(self):
+        from rl_scheduler_tpu.env.gym_adapter import (
+            K8sMultiCloudEnv,
+            K8sMultiCloudVectorEnv,
+        )
+
+        single = K8sMultiCloudEnv()
+        single.reset(seed=3)
+        vec = K8sMultiCloudVectorEnv(num_envs=4)
+        vec.reset(seed=3)
+        for action in (0, 1, 0, 1):
+            _, r1, *_ = single.step(action)
+            _, rv, *_ = vec.step(np.full(4, action, np.int32))
+            # rewards are table-deterministic (noise only touches obs dims)
+            np.testing.assert_allclose(rv, np.full(4, r1), rtol=1e-6)
