@@ -29,7 +29,8 @@ from rl_scheduler_tpu.env import core as env_core
 ENVS = ("multi_cloud", "single_cluster", "cluster_set", "cluster_graph")
 
 
-def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False):
+def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
+                        fault_prob: float | None = None):
     """``(bundle, net)`` for each BASELINE env family.
 
     ``net=None`` means the default flat-obs ActorCritic; the set/graph envs
@@ -43,7 +44,10 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False):
     if env_name == "multi_cloud":
         from rl_scheduler_tpu.env.bundle import multi_cloud_bundle
 
-        params = env_core.make_params(EnvConfig(legacy_reward_sign=legacy_reward_sign))
+        kwargs = {} if fault_prob is None else {"fault_prob": fault_prob}
+        params = env_core.make_params(
+            EnvConfig(legacy_reward_sign=legacy_reward_sign, **kwargs)
+        )
         return multi_cloud_bundle(params), None
     if env_name == "single_cluster":
         from rl_scheduler_tpu.env.bundle import single_cluster_bundle
@@ -84,6 +88,10 @@ def main(argv: list[str] | None = None) -> Path:
     p.add_argument("--keep", type=int, default=5)
     p.add_argument("--legacy-reward-sign", action="store_true",
                    help="reproduce the reference's positive reward (SURVEY.md §7.0.1)")
+    p.add_argument("--fault-from-loadtest", action="store_true",
+                   help="calibrate the simulator's fault_prob from the "
+                        "Locust stats exports in data/ (failure fraction "
+                        "across clouds; SURVEY.md §5.3)")
     p.add_argument("--resume", action="store_true",
                    help="continue from the latest checkpoint in the run dir "
                         "(requires --run-name of an existing run)")
@@ -140,7 +148,36 @@ def main(argv: list[str] | None = None) -> Path:
             f"--hidden configures the MLP policy; --env {args.env} uses a "
             "structured policy with its own dimensions"
         )
-    bundle, net = make_bundle_and_net(args.env, cfg, args.legacy_reward_sign)
+    fault_prob = None
+    if args.fault_from_loadtest:
+        if args.env != "multi_cloud":
+            raise SystemExit(
+                "--fault-from-loadtest calibrates the multi-cloud simulator; "
+                f"it has no meaning for --env {args.env}"
+            )
+        from rl_scheduler_tpu.data.loadtest import failure_rate
+
+        fault_prob = failure_rate()
+        if fault_prob is None:
+            raise SystemExit(
+                "--fault-from-loadtest: no local_*_load_stats.csv exports in "
+                "data/ — run `python -m rl_scheduler_tpu.data.generate` or "
+                "drop in real Locust exports"
+            )
+        if fault_prob >= 0.99:
+            # The reference's own recorded exports measure 100% failures
+            # (its kind clusters were unreachable) — training against
+            # always-down clusters is faithful to that data but useless.
+            raise SystemExit(
+                f"--fault-from-loadtest: measured failure rate "
+                f"{fault_prob:.2%} means the load test never reached the "
+                "clusters; calibrating from it would fault every step. "
+                "Fix the exports or set EnvConfig.fault_prob explicitly."
+            )
+        print(f"Fault injection calibrated from load test: "
+              f"fault_prob={fault_prob:.4f}")
+    bundle, net = make_bundle_and_net(args.env, cfg, args.legacy_reward_sign,
+                                      fault_prob)
 
     run_name = args.run_name or f"PPO_{args.preset}_{time.strftime('%Y%m%d_%H%M%S')}"
     run_dir = Path(args.run_root) / run_name

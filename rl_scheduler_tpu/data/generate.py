@@ -107,6 +107,9 @@ def generate_load_history(
 
 if __name__ == "__main__":
     from rl_scheduler_tpu.data.loader import default_data_dir
+    from rl_scheduler_tpu.data.loadtest import generate_load_stats
 
     df = generate_all(default_data_dir())
+    counts = generate_load_stats(default_data_dir())
     print(f"Generated {len(df)} steps of price/latency data in {default_data_dir()}")
+    print(f"Synthesized Locust exports (failures: {counts})")

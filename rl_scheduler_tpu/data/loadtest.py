@@ -1,0 +1,115 @@
+"""Load-test failure telemetry → fault-injection calibration.
+
+SURVEY.md §5.3: the reference records rich failure data from its Locust
+runs (2980 connection-refused on AWS, 2955 remote-disconnects on Azure —
+``data/local_*_load_failures.csv``) and then never reads it. Here the same
+exports calibrate the simulator's fault injection: ``failure_rate`` reads
+the standard Locust stats schema ("Request Count" / "Failure Count") and
+the train CLI's ``--fault-from-loadtest`` maps it onto
+``EnvConfig.fault_prob``.
+
+Note the reference's own recorded run measured a **100% failure rate**
+(its kind clusters were unreachable; ``local_aws_load_stats.csv`` shows
+2980/2980 failures) — calibrating from that data trains against
+always-down clusters, which is faithful but useless. The synthetic
+generator therefore emits partial failure fractions by default; real
+Locust exports dropped into ``data/`` take precedence.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+CLOUDS = ("aws", "azure")
+# Plausible defaults for the synthetic exports (per-cloud failure fraction).
+SYNTH_FAILURE_FRACTIONS = {"aws": 0.032, "azure": 0.027}
+SYNTH_REQUESTS = 2980  # request volume matching the reference's recorded run
+
+
+def failure_rate(data_dir: str | Path | None = None) -> float | None:
+    """Aggregate failure fraction across all ``local_*_load_stats.csv``.
+
+    Sums "Failure Count" / "Request Count" over each cloud's Aggregated row.
+    Returns ``None`` when no stats exports exist (callers decide whether
+    that is an error or a fall-back to the configured ``fault_prob``).
+    """
+    if data_dir is None:
+        from rl_scheduler_tpu.data.loader import default_data_dir
+
+        data_dir = default_data_dir()
+    data_dir = Path(data_dir)
+    requests = failures = 0
+    for cloud in CLOUDS:
+        path = data_dir / f"local_{cloud}_load_stats.csv"
+        if not path.exists():
+            continue
+        df = pd.read_csv(path)
+        if df.empty:  # header-only export (run killed before first flush)
+            continue
+        agg = df[df["Name"] == "Aggregated"]
+        row = agg.iloc[0] if len(agg) else df.iloc[-1]
+        requests += int(row["Request Count"])
+        failures += int(row["Failure Count"])
+    if requests == 0:
+        return None
+    return failures / requests
+
+
+def generate_load_stats(
+    out_dir: str | Path,
+    requests: int = SYNTH_REQUESTS,
+    failure_fractions: dict | None = None,
+    seed: int = 42,
+    overwrite: bool = False,
+) -> dict:
+    """Synthesize Locust-schema stats + failures exports for both clouds.
+
+    Writes ``local_{cloud}_load_stats.csv`` (GET + Aggregated rows, the
+    column layout Locust's ``--csv`` emits) and
+    ``local_{cloud}_load_failures.csv``. Deterministic given ``seed``.
+    Returns ``{cloud: failure_count}`` for the clouds written.
+
+    Existing exports are NOT clobbered unless ``overwrite=True`` — real
+    Locust telemetry dropped into ``data/`` takes precedence over synthetic
+    data (the RNG still draws per cloud, so which clouds already exist does
+    not change what the others get).
+    """
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    fractions = failure_fractions or SYNTH_FAILURE_FRACTIONS
+    rng = np.random.RandomState(seed)
+    counts = {}
+    for cloud in CLOUDS:
+        fails = int(rng.binomial(requests, fractions[cloud]))
+        if (out_dir / f"local_{cloud}_load_stats.csv").exists() and not overwrite:
+            continue
+        counts[cloud] = fails
+        avg_rt = float(rng.uniform(2.5, 4.5))
+        row = {
+            "Type": "GET", "Name": "/",
+            "Request Count": requests, "Failure Count": fails,
+            "Median Response Time": round(avg_rt), "Average Response Time": avg_rt,
+            "Min Response Time": avg_rt / 5, "Max Response Time": avg_rt * 150,
+            "Average Content Size": 0.0,
+            "Requests/s": 9.94, "Failures/s": 9.94 * fails / requests,
+        }
+        pcts = {p: round(avg_rt * (1 + i)) for i, p in enumerate(
+            ("50%", "66%", "75%", "80%", "90%", "95%", "98%", "99%", "99.9%",
+             "99.99%", "100%"))}
+        stats = pd.DataFrame([
+            {**row, **pcts},
+            {**row, **pcts, "Type": "", "Name": "Aggregated"},
+        ])
+        stats.to_csv(out_dir / f"local_{cloud}_load_stats.csv", index=False)
+        failures = pd.DataFrame([
+            {
+                "Method": "GET", "Name": "/",
+                "Error": "ConnectionRefusedError(61, 'Connection refused')",
+                "Occurrences": fails,
+            }
+        ])
+        failures.to_csv(out_dir / f"local_{cloud}_load_failures.csv", index=False)
+    return counts

@@ -108,3 +108,62 @@ def test_single_cluster_trace(tmp_path):
 def test_default_data_dir_in_repo():
     assert default_data_dir().name == "data"
     assert default_data_dir().parent.name == "repo"
+
+
+class TestLoadtestCalibration:
+    def test_generate_and_failure_rate_roundtrip(self, tmp_path):
+        from rl_scheduler_tpu.data.loadtest import (
+            SYNTH_REQUESTS,
+            failure_rate,
+            generate_load_stats,
+        )
+
+        counts = generate_load_stats(tmp_path, seed=7)
+        rate = failure_rate(tmp_path)
+        expect = sum(counts.values()) / (2 * SYNTH_REQUESTS)
+        assert rate == pytest.approx(expect)
+        assert 0.0 < rate < 0.1
+        # deterministic given seed (overwrite needed: existing exports
+        # are never clobbered by default)
+        assert generate_load_stats(tmp_path, seed=7, overwrite=True) == counts
+        # without overwrite, existing exports are preserved untouched
+        before = (tmp_path / "local_aws_load_stats.csv").read_text()
+        assert generate_load_stats(tmp_path, seed=99) == {}
+        assert (tmp_path / "local_aws_load_stats.csv").read_text() == before
+
+    def test_failure_rate_none_without_exports(self, tmp_path):
+        from rl_scheduler_tpu.data.loadtest import failure_rate
+
+        assert failure_rate(tmp_path) is None
+
+    def test_failure_rate_skips_header_only_export(self, tmp_path):
+        from rl_scheduler_tpu.data.loadtest import failure_rate
+
+        (tmp_path / "local_aws_load_stats.csv").write_text(
+            "Type,Name,Request Count,Failure Count\n"
+        )
+        assert failure_rate(tmp_path) is None
+
+    def test_reference_schema_parses(self, tmp_path):
+        """The reference's recorded run (100% failures) parses to rate 1.0."""
+        from rl_scheduler_tpu.data.loadtest import failure_rate
+
+        header = ("Type,Name,Request Count,Failure Count,Median Response Time,"
+                  "Average Response Time,Min Response Time,Max Response Time,"
+                  "Average Content Size,Requests/s,Failures/s,50%,66%,75%,80%,"
+                  "90%,95%,98%,99%,99.9%,99.99%,100%")
+        row = "GET,/,2980,2980,2,2.82,0.55,595.8,0.0,9.94,9.94," + ",".join(["3"] * 11)
+        agg = ",Aggregated,2980,2980,2,2.82,0.55,595.8,0.0,9.94,9.94," + ",".join(["3"] * 11)
+        (tmp_path / "local_aws_load_stats.csv").write_text(f"{header}\n{row}\n{agg}\n")
+        assert failure_rate(tmp_path) == pytest.approx(1.0)
+
+    def test_train_cli_fault_from_loadtest(self, tmp_path):
+        from rl_scheduler_tpu.agent import train_ppo as cli
+
+        run_dir = cli.main([
+            "--preset", "quick", "--num-envs", "4", "--rollout-steps", "8",
+            "--minibatch-size", "16", "--hidden", "8,8", "--iterations", "1",
+            "--run-root", str(tmp_path), "--run-name", "fault_test",
+            "--fault-from-loadtest",
+        ])
+        assert run_dir.exists()
