@@ -1,0 +1,154 @@
+"""Property-based invariants (hypothesis) for the simulator and ops.
+
+Example-based tests pin specific seeds and shapes; these sweep randomized
+configs, actions, and shapes, checking the invariants that every
+configuration must satisfy — the SURVEY.md §4 test-pyramid tier the
+reference has nothing of.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from rl_scheduler_tpu.config import EnvConfig
+from rl_scheduler_tpu.env import core as env_core
+from rl_scheduler_tpu.ops.gae import gae
+
+from test_ops import numpy_gae  # the single numpy GAE reference
+
+SETTINGS = dict(deadline=None, max_examples=25)
+
+# Module-level jit + table: a fresh jax.jit wrapper (or a make_params CSV
+# re-read) per hypothesis example would repeat compile/IO every time.
+_JIT_STEP = jax.jit(env_core.step)
+_TABLE = None
+
+
+def _make_params(cfg: EnvConfig | None = None) -> env_core.EnvParams:
+    global _TABLE
+    if _TABLE is None:
+        from rl_scheduler_tpu.data.loader import load_table
+
+        _TABLE = load_table()
+    return env_core.make_params(cfg or EnvConfig(), table=_TABLE)
+
+
+@settings(**SETTINGS)
+@given(
+    t=st.integers(1, 12),
+    n=st.integers(1, 5),
+    gamma=st.floats(0.5, 1.0),
+    lam=st.floats(0.0, 1.0),
+    seed=st.integers(0, 2**31 - 1),
+)
+def test_gae_scan_matches_reference_formula(t, n, gamma, lam, seed):
+    rng = np.random.default_rng(seed)
+    rewards = rng.normal(size=(t, n)).astype(np.float32)
+    values = rng.normal(size=(t, n)).astype(np.float32)
+    dones = (rng.random((t, n)) < 0.2).astype(np.float32)
+    last_value = rng.normal(size=n).astype(np.float32)
+    adv, targets = gae(
+        jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(dones),
+        jnp.asarray(last_value), gamma, lam, impl="scan",
+    )
+    expect_adv, expect_targets = numpy_gae(
+        rewards, values, dones, last_value, gamma, lam
+    )
+    np.testing.assert_allclose(np.asarray(adv), expect_adv, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(targets), expect_targets, rtol=1e-4, atol=1e-4
+    )
+
+
+@settings(deadline=None, max_examples=10)
+@given(
+    seed=st.integers(0, 2**31 - 1),
+    cost_weight=st.floats(0.0, 1.0),
+    fault_prob=st.sampled_from([0.0, 0.3, 1.0]),
+    num_steps=st.integers(1, 120),
+)
+def test_env_step_invariants(seed, cost_weight, fault_prob, num_steps):
+    """For ANY config: obs bounds, reward formula sign, episode wrap."""
+    params = _make_params(EnvConfig(
+        cost_weight=cost_weight,
+        latency_weight=1.0 - cost_weight,
+        fault_prob=fault_prob,
+    ))
+    ms = int(params.max_steps)
+    key = jax.random.PRNGKey(seed)
+    state, obs = env_core.reset(params, key)
+    for t in range(num_steps):
+        action = jnp.asarray((seed + t) % 2, jnp.int32)
+        state, ts = _JIT_STEP(params, state, action)
+        o = np.asarray(ts.obs)
+        assert o.shape == (env_core.OBS_DIM,)
+        assert (o >= 0.0).all() and (o <= 1.0).all(), o
+        # corrected sign: reward is never positive (costs are non-negative)
+        assert float(ts.reward) <= 0.0
+        assert int(ts.step) == t + 1
+        assert bool(ts.done) == (t + 1 >= ms)
+        if bool(ts.done):
+            break
+    # state always stays inside the table
+    assert 0 <= int(state.step_idx) <= ms
+
+
+@settings(**SETTINGS)
+@given(
+    seed=st.integers(0, 2**31 - 1),
+    n=st.integers(1, 6),
+    t=st.integers(1, 30),
+)
+def test_open_loop_rewards_match_step_for_any_actions(seed, n, t):
+    """Property form of the open-loop parity tests: for any env batch and
+    action sequence, open_loop_rewards equals the step() formula exactly
+    (fault_prob=0 so rewards are table-deterministic)."""
+    from rl_scheduler_tpu.env import vector
+
+    params = _make_params()
+    state, obs = vector.reset_batch(params, jax.random.PRNGKey(seed), n)
+    _, aux, new_state = env_core.open_loop_horizon(
+        params, state, obs, jax.random.PRNGKey(seed + 1), t
+    )
+    rng = np.random.default_rng(seed)
+    actions = jnp.asarray(rng.integers(0, 2, (t, n)), jnp.int32)
+    rewards = np.asarray(env_core.open_loop_rewards(params, aux, actions))
+    ms = int(params.max_steps)
+    idx = (np.asarray(state.step_idx)[None, :] + np.arange(t)[:, None]) % ms
+    a = np.asarray(actions)
+    cost = np.asarray(params.costs)[idx, a]
+    lat = np.asarray(params.latencies)[idx, a]
+    expect = -100.0 * (0.6 * cost + 0.4 * lat)
+    np.testing.assert_allclose(rewards, expect, rtol=1e-5)
+    np.testing.assert_array_equal(
+        np.asarray(new_state.step_idx), (np.asarray(state.step_idx) + t) % ms
+    )
+
+
+@settings(**SETTINGS)
+@given(
+    cap=st.integers(4, 64),
+    adds=st.lists(st.integers(1, 16), min_size=1, max_size=8),
+)
+def test_replay_buffer_circular_invariants(cap, adds):
+    """Size never exceeds capacity; pos always in range; newest data wins."""
+    from rl_scheduler_tpu.agent.dqn import buffer_add, buffer_init
+
+    buf = buffer_init(cap, (3,))
+    total = 0
+    for k, n in enumerate(adds):
+        batch = {
+            "obs": jnp.full((n, 3), float(k), jnp.float32),
+            "action": jnp.zeros(n, jnp.int32),
+            "reward": jnp.full(n, float(k), jnp.float32),
+            "done": jnp.zeros(n, jnp.float32),
+            "next_obs": jnp.zeros((n, 3), jnp.float32),
+        }
+        buf = buffer_add(buf, batch)
+        total += n
+        assert int(buf.size) == min(total, cap)
+        assert 0 <= int(buf.pos) < cap
+    # the most recent element is always retrievable at pos-1
+    last = (int(buf.pos) - 1) % cap
+    assert float(buf.reward[last]) == float(len(adds) - 1)
