@@ -1,0 +1,94 @@
+"""Concurrent latency benchmark against a running scheduler extender.
+
+Measures the serving contract (<1 ms p50, BASELINE.json) under load the
+way a kube-scheduler would exercise it: many concurrent ``/filter`` +
+``/prioritize`` POSTs with realistic node lists, client-side latency
+percentiles, then the server's own ``/stats`` for cross-checking.
+
+Usage::
+
+    python -m rl_scheduler_tpu.scheduler.extender --backend native --port 8787 &
+    python loadgen/extender_bench.py --port 8787 --requests 2000 --threads 8
+
+Prints one JSON line with client p50/p90/p99 (ms) and achieved req/s.
+Stdlib-only (no locust dependency) so it runs anywhere the extender does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import time
+import urllib.request
+
+
+def make_payload(i: int) -> bytes:
+    return json.dumps(
+        {
+            "pod": {"metadata": {"name": f"bench-pod-{i}"}},
+            "nodes": {
+                "items": [
+                    {"metadata": {"name": "node-a", "labels": {"cloud": "aws"}}},
+                    {"metadata": {"name": "node-b", "labels": {"cloud": "azure"}}},
+                ]
+            },
+        }
+    ).encode()
+
+
+def one_request(base: str, i: int) -> float:
+    path = "/filter" if i % 2 == 0 else "/prioritize"
+    req = urllib.request.Request(
+        base + path, data=make_payload(i),
+        headers={"Content-Type": "application/json"},
+    )
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        resp.read()
+    return (time.perf_counter() - t0) * 1000.0
+
+
+def main(argv: list[str] | None = None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8787)
+    p.add_argument("--requests", type=int, default=2000)
+    p.add_argument("--threads", type=int, default=8)
+    p.add_argument("--warmup", type=int, default=50)
+    args = p.parse_args(argv)
+    if args.requests < 1:
+        p.error("--requests must be >= 1")
+    base = f"http://{args.host}:{args.port}"
+
+    for i in range(args.warmup):
+        one_request(base, i)
+
+    t_start = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(args.threads) as pool:
+        latencies = sorted(pool.map(lambda i: one_request(base, i), range(args.requests)))
+    wall = time.perf_counter() - t_start
+
+    def pct(p_):
+        return latencies[min(len(latencies) - 1, int(p_ * len(latencies)))]
+
+    with urllib.request.urlopen(base + "/stats", timeout=10) as resp:
+        server_stats = json.loads(resp.read())
+
+    out = {
+        "requests": args.requests,
+        "threads": args.threads,
+        "client_p50_ms": round(pct(0.50), 3),
+        "client_p90_ms": round(pct(0.90), 3),
+        "client_p99_ms": round(pct(0.99), 3),
+        "req_per_sec": round(args.requests / wall, 1),
+        "server_p50_ms": server_stats["latency"]["p50_ms"],
+        "server_p99_ms": server_stats["latency"]["p99_ms"],
+        "backend": server_stats["backend"],
+    }
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    main()

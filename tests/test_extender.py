@@ -342,3 +342,24 @@ def test_build_policy_rejects_wrong_env_checkpoint(tmp_path):
     ])
     policy = build_policy(backend="cpu", run_root=str(tmp_path))
     assert policy.backend.name == "greedy"
+
+
+def test_extender_bench_tool(server):
+    """The loadgen benchmark drives a live server and reports percentiles."""
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "extender_bench",
+        Path(__file__).resolve().parents[1] / "loadgen" / "extender_bench.py",
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    srv, _ = server
+    port = srv.server_address[1]
+    out = mod.main(["--port", str(port), "--requests", "40",
+                    "--threads", "4", "--warmup", "5"])
+    assert out["requests"] == 40
+    assert out["client_p50_ms"] > 0 and out["server_p50_ms"] > 0
+    assert out["backend"] == "cpu"
