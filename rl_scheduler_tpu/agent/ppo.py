@@ -18,6 +18,7 @@ per minibatch, epoch-wise reshuffling.
 from __future__ import annotations
 
 import dataclasses
+import math
 import warnings
 from typing import Any, Callable, NamedTuple
 
@@ -262,14 +263,36 @@ def make_ppo_bundle(
             cfg.gamma, cfg.gae_lambda, impl=cfg.gae_impl,
         )
 
-        batch = {
-            "obs": traj["obs"].reshape(-1, *obs_shape),
-            "action": traj["action"].reshape(-1),
-            "log_prob": traj["log_prob"].reshape(-1),
-            "value": traj["value"].reshape(-1),
-            "advantage": advantages.reshape(-1),
-            "target": targets.reshape(-1),
-        }
+        # Pack every per-sample field into ONE [B, K] f32 matrix. The epoch
+        # shuffle then needs a single 2-D row gather instead of six 1-D
+        # gathers — TPUs execute long 1-D random gathers element-wise, and
+        # a profile showed them costing ~60% of the whole update at 4096
+        # envs (6 fields x ~3 ms per epoch); the packed row gather is
+        # tile-efficient. The action column round-trips through f32
+        # exactly (action indices are tiny integers).
+        flat_obs_dim = math.prod(obs_shape)
+        packed = jnp.concatenate(
+            [
+                traj["obs"].reshape(-1, flat_obs_dim).astype(jnp.float32),
+                traj["action"].reshape(-1, 1).astype(jnp.float32),
+                traj["log_prob"].reshape(-1, 1),
+                traj["value"].reshape(-1, 1),
+                advantages.reshape(-1, 1),
+                targets.reshape(-1, 1),
+            ],
+            axis=1,
+        )
+
+        def unpack(rows):
+            return {
+                "obs": rows[:, :flat_obs_dim].reshape(-1, *obs_shape),
+                "action": rows[:, flat_obs_dim].astype(jnp.int32),
+                "log_prob": rows[:, flat_obs_dim + 1],
+                "value": rows[:, flat_obs_dim + 2],
+                "advantage": rows[:, flat_obs_dim + 3],
+                "target": rows[:, flat_obs_dim + 4],
+            }
+
         loss_cfg = cfg.loss_config()
         # Minibatches keep the exact configured size (static shapes for XLA);
         # when minibatch_size does not divide the batch, each epoch trains on
@@ -284,8 +307,9 @@ def make_ppo_bundle(
                 mb["advantage"], mb["target"], loss_cfg,
             )
 
-        def sgd_minibatch(carry, mb):
+        def sgd_minibatch(carry, mb_rows):
             params, opt_state = carry
+            mb = unpack(mb_rows)
             (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, mb)
             if axis_name is not None:
                 # Data-parallel gradient sync over the mesh axis (ICI
@@ -298,12 +322,9 @@ def make_ppo_bundle(
         def sgd_epoch(carry, epoch_key):
             params, opt_state = carry
             perm = jax.random.permutation(epoch_key, cfg.batch_size)
-            shuffled = jax.tree.map(lambda x: x[perm], batch)
-            minibatches = jax.tree.map(
-                lambda x: x[: cfg.num_minibatches * mb_size].reshape(
-                    cfg.num_minibatches, mb_size, *x.shape[1:]
-                ),
-                shuffled,
+            shuffled = packed[perm]
+            minibatches = shuffled[: cfg.num_minibatches * mb_size].reshape(
+                cfg.num_minibatches, mb_size, packed.shape[1]
             )
             (params, opt_state), metrics = jax.lax.scan(
                 sgd_minibatch, (params, opt_state), minibatches
