@@ -386,9 +386,10 @@ def ppo_train(
     """Host-side training loop: jitted update per iteration + logging hooks.
 
     ``debug_checks=True`` checkifies the update (``utils/debug.py``): the
-    first NaN/zero-division raises with the failing op named, instead
-    of silently corrupting training. Forces the scan GAE (checkify cannot
-    instrument inside a Pallas kernel). Slower; for debugging.
+    first NaN/zero-division/out-of-bounds index raises with the failing
+    op named, instead of silently corrupting training. Forces the scan
+    GAE (checkify cannot instrument inside a Pallas kernel). Slower; for
+    debugging.
 
     ``sync_every`` batches device->host metric fetches: updates are
     dispatched asynchronously and metrics for ``sync_every`` iterations are

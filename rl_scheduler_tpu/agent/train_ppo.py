@@ -112,8 +112,9 @@ def main(argv: list[str] | None = None) -> Path:
                         "costs a network round-trip")
     p.add_argument("--debug-checks", action="store_true",
                    help="checkify the update: raise on the first NaN/"
-                        "zero-division instead of silently corrupting "
-                        "training (slower; for debugging)")
+                        "zero-division/out-of-bounds index instead of "
+                        "silently corrupting training (slower; for "
+                        "debugging)")
     p.add_argument("--tensorboard", action="store_true",
                    help="also log metrics to TensorBoard under <run>/tb")
     p.add_argument("--profile-dir", default=None,

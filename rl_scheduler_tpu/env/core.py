@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from rl_scheduler_tpu.config import EnvConfig
 from rl_scheduler_tpu.data.loader import CloudTable, load_table
+from rl_scheduler_tpu.ops.indexing import select_along_last
 
 OBS_DIM = 6
 NUM_ACTIONS = 2
@@ -227,10 +228,13 @@ def open_loop_horizon(
 
 def open_loop_rewards(params: EnvParams, aux: dict, actions: jnp.ndarray) -> jnp.ndarray:
     """Rewards for a horizon once actions are chosen (same formula as
-    :func:`step`, vectorized over ``[T, N]``)."""
-    a = actions[..., None].astype(jnp.int32)
-    cost = jnp.take_along_axis(aux["rows_costs"], a, axis=-1)[..., 0]
-    latency = jnp.take_along_axis(aux["rows_lats"], a, axis=-1)[..., 0]
+    :func:`step`, vectorized over ``[T, N]``).
+
+    Picks the chosen cloud's column via a one-hot contraction rather than
+    ``take_along_axis`` (see :mod:`rl_scheduler_tpu.ops.indexing`).
+    """
+    cost = select_along_last(aux["rows_costs"], actions)
+    latency = select_along_last(aux["rows_lats"], actions)
     latency = jnp.where(aux["faulted"], params.fault_latency_penalty, latency)
     reward = params.reward_sign * params.reward_scale * (
         params.cost_weight * cost + params.latency_weight * latency

@@ -1,0 +1,29 @@
+"""TPU-friendly indexing primitives shared by losses and env code.
+
+``jnp.take_along_axis`` over a small trailing axis compiles to a random
+gather, which TPUs execute element-wise through the scalar unit; profiled
+at 4096 envs x 100 steps these gathers were most of the fused PPO update
+(the action-column selects in the loss ~0.35 ms per 32768-row minibatch,
+the reward-column selects ~6 ms per horizon). A one-hot multiply-reduce is
+a fully vectorized elementwise op and profiles as ~free at these shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def select_along_last(values: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
+    """``take_along_axis(values, indices[..., None], -1)[..., 0]`` as a
+    one-hot contraction over the (small) trailing axis.
+
+    Contract: ``indices`` must be in ``[0, values.shape[-1])`` (out-of-range
+    yields 0.0 rather than take_along_axis's fill value) and unselected
+    columns must be finite (``0 * inf`` would poison the sum). Every caller
+    selects by an action/argmax index over finite tables or log-probs, so
+    both hold by construction; prefer ``take_along_axis`` for wide or
+    untrusted index spaces.
+    """
+    one_hot = jax.nn.one_hot(indices, values.shape[-1], dtype=values.dtype)
+    return jnp.sum(values * one_hot, axis=-1)

@@ -13,6 +13,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from rl_scheduler_tpu.ops.indexing import select_along_last
+
 
 class PPOLossConfig(NamedTuple):
     clip_eps: float = 0.3        # RLlib PPO default clip_param
@@ -24,7 +26,7 @@ class PPOLossConfig(NamedTuple):
 
 def categorical_log_prob(logits: jnp.ndarray, actions: jnp.ndarray) -> jnp.ndarray:
     logp = jax.nn.log_softmax(logits)
-    return jnp.take_along_axis(logp, actions[..., None], axis=-1)[..., 0]
+    return select_along_last(logp, actions)
 
 
 def categorical_entropy(logits: jnp.ndarray) -> jnp.ndarray:
@@ -84,9 +86,9 @@ def dqn_loss(
     huber_delta: float = 1.0,
 ):
     """Double-DQN TD error with Huber loss. Returns ``(loss, metrics)``."""
-    q_sa = jnp.take_along_axis(q_values, actions[..., None], axis=-1)[..., 0]
+    q_sa = select_along_last(q_values, actions)
     next_actions = jnp.argmax(online_q_next, axis=-1)
-    q_next = jnp.take_along_axis(target_q_next, next_actions[..., None], axis=-1)[..., 0]
+    q_next = select_along_last(target_q_next, next_actions)
     target = rewards + gamma * (1.0 - dones.astype(jnp.float32)) * q_next
     td = q_sa - jax.lax.stop_gradient(target)
     abs_td = jnp.abs(td)

@@ -5,15 +5,15 @@ jitted code normally surface as silent NaN propagation. In debug mode the
 training update is checkified — every float op is instrumented via
 ``float_checks`` (NaN production and division by zero; note checkify has
 no inf check, so overflow to inf only raises once it later produces a
-NaN, e.g. via ``inf - inf`` or ``inf * 0``) — and the first violation
-raises a host-side :class:`jax.experimental.checkify.JaxRuntimeError`
-naming the failing op instead of corrupting the run.
+NaN, e.g. via ``inf - inf`` or ``inf * 0``) plus ``index_checks`` for
+out-of-bounds gathers/dynamic-slices — and the first violation raises a
+host-side :class:`jax.experimental.checkify.JaxRuntimeError` naming the
+failing op instead of corrupting the run.
 
-``index_checks`` is deliberately excluded: in the installed JAX it fails
-at trace time on ``take_along_axis``'s fill-mode gather (the categorical
-log-prob path), raising an internal IndexError while instrumenting.
-Bounds on the env's table gathers are enforced by construction
-(``step_idx`` wraps at ``max_steps``).
+(Historical note: ``index_checks`` used to fail at trace time on the
+categorical log-prob path's fill-mode ``take_along_axis``; that gather was
+replaced by a one-hot contraction — ``ops/indexing.py`` — so the checks
+instrument cleanly now.)
 
 Cost: instrumentation blocks some XLA fusions, so expect a slower update;
 this is a debugging tool (``train_ppo --debug-checks``), not a production
@@ -27,15 +27,15 @@ from typing import Callable
 import jax
 from jax.experimental import checkify
 
-ALL_CHECKS = checkify.float_checks  # = {NaN, division-by-zero}; div_checks ⊂ this
+ALL_CHECKS = checkify.float_checks | checkify.index_checks
 
 
 def checkified_update(update_fn: Callable, donate: bool = True) -> Callable:
     """Wrap ``update_fn(state) -> (state, out)`` with numerical checks.
 
     Returns a jitted callable with the same signature that raises
-    ``JaxRuntimeError`` on the first NaN/zero-division instead of
-    propagating it (index bounds and bare inf overflow are not
+    ``JaxRuntimeError`` on the first NaN/zero-division/out-of-bounds
+    index instead of propagating it (bare inf overflow is not
     instrumented; see module doc).
     """
     checked = checkify.checkify(update_fn, errors=ALL_CHECKS)

@@ -39,3 +39,14 @@ def test_division_by_zero_raises():
     update = checkified_update(bad_div, donate=False)
     with pytest.raises(checkify.JaxRuntimeError):
         update({"a": jnp.asarray(4), "b": jnp.asarray(0)})
+
+
+def test_out_of_bounds_gather_raises():
+    def bad_gather(state):
+        table = state["t"]
+        # index 10 is out of bounds for a length-4 table
+        return state, {"v": table[jnp.asarray(10)]}
+
+    update = checkified_update(bad_gather, donate=False)
+    with pytest.raises(checkify.JaxRuntimeError):
+        update({"t": jnp.arange(4.0)})
