@@ -57,6 +57,17 @@ class PPOTrainConfig:
     #   bundle horizon_fn; ~2x faster rollout on TPU).
     # auto: open_loop when the bundle supports it, scan otherwise.
     rollout_impl: str = "auto"       # scan | open_loop | auto
+    # Epoch-shuffle granularity: permute contiguous blocks of this many
+    # samples instead of single rows. Blocks are adjacent envs at one
+    # timestep (iid rollouts), so statistics are indistinguishable for
+    # minibatches thousands of blocks wide, while the gather moves
+    # tile-aligned chunks — profiled ~100x faster than the row-granular
+    # gather at 4096x100. Applied only when each minibatch still spans
+    # >= 1024 blocks (small configs keep the exact per-sample shuffle:
+    # their gathers are cheap anyway and coarse mixing measurably slows
+    # small-batch convergence); also falls back to exact when the block
+    # does not divide the batch/minibatch sizes. Set 1 to force exact.
+    shuffle_block_size: int = 8
 
     @property
     def batch_size(self) -> int:
@@ -73,6 +84,28 @@ class PPOTrainConfig:
             vf_coeff=self.vf_coeff,
             entropy_coeff=self.entropy_coeff,
         )
+
+
+def effective_shuffle_block(cfg: PPOTrainConfig) -> int:
+    """The epoch-shuffle block size that will actually be used.
+
+    Falls back to 1 (exact per-sample shuffle) unless the block divides the
+    batch, the minibatch, AND ``num_envs`` (the flat batch is timestep-major,
+    so env-divisibility is what keeps a block inside one timestep — blocks
+    straddling timesteps would weld consecutive correlated transitions of
+    the same trajectories together), and each minibatch still spans >= 1024
+    blocks (see ``PPOTrainConfig.shuffle_block_size``).
+    """
+    blk = max(1, cfg.shuffle_block_size)
+    mb_size = min(cfg.minibatch_size, cfg.batch_size)
+    if (
+        cfg.batch_size % blk
+        or mb_size % blk
+        or cfg.num_envs % blk
+        or mb_size // blk < 1024
+    ):
+        return 1
+    return blk
 
 
 class RunnerState(NamedTuple):
@@ -319,12 +352,17 @@ def make_ppo_bundle(
             params = optax.apply_updates(params, updates)
             return (params, opt_state), metrics
 
+        blk = effective_shuffle_block(cfg)
+        num_blocks = cfg.batch_size // blk
+        k_cols = packed.shape[1]
+        packed_blocks = packed.reshape(num_blocks, blk * k_cols)
+
         def sgd_epoch(carry, epoch_key):
             params, opt_state = carry
-            perm = jax.random.permutation(epoch_key, cfg.batch_size)
-            shuffled = packed[perm]
+            perm = jax.random.permutation(epoch_key, num_blocks)
+            shuffled = packed_blocks[perm].reshape(cfg.batch_size, k_cols)
             minibatches = shuffled[: cfg.num_minibatches * mb_size].reshape(
-                cfg.num_minibatches, mb_size, packed.shape[1]
+                cfg.num_minibatches, mb_size, k_cols
             )
             (params, opt_state), metrics = jax.lax.scan(
                 sgd_minibatch, (params, opt_state), minibatches

@@ -46,6 +46,22 @@ def test_train_deterministic(env_params):
     )
 
 
+def greedy_row_accuracy(runner, env_params, hidden) -> float:
+    """Fraction of table rows where the learned greedy action matches the
+    per-row optimum (argmin of 0.6*cost + 0.4*latency)."""
+    from rl_scheduler_tpu.models import ActorCritic
+
+    net = ActorCritic(num_actions=env_core.NUM_ACTIONS, hidden=hidden)
+    table = np.asarray(
+        jnp.concatenate([env_params.costs, env_params.latencies], axis=1)
+    )
+    obs = np.concatenate([table, np.full((len(table), 2), 0.45, np.float32)], axis=1)
+    logits, _ = net.apply(runner.params, jnp.asarray(obs))
+    greedy = np.asarray(jnp.argmax(logits, axis=-1))
+    weighted = 0.6 * table[:, :2] + 0.4 * table[:, 2:4]
+    return float((greedy == np.argmin(weighted, axis=1)).mean())
+
+
 def test_ppo_converges_to_optimal_policy(env_params):
     """After a short run the greedy policy must pick the per-row optimal cloud
     (argmin of 0.6*cost + 0.4*latency) on ~all rows, beating both baselines.
@@ -62,21 +78,18 @@ def test_ppo_converges_to_optimal_policy(env_params):
     cfg = dataclasses.replace(SMOKE_CFG, rollout_impl="scan")
     runner, history = ppo_train(env_params, cfg, 30, seed=0)
 
-    # learned greedy actions per table row
-    net_cfg = SMOKE_CFG
     from rl_scheduler_tpu.models import ActorCritic
 
-    net = ActorCritic(num_actions=2, hidden=net_cfg.hidden)
+    net = ActorCritic(num_actions=2, hidden=SMOKE_CFG.hidden)
     table = np.asarray(
         jnp.concatenate([env_params.costs, env_params.latencies], axis=1)
     )
     obs = np.concatenate([table, np.full((len(table), 2), 0.45, np.float32)], axis=1)
     logits, _ = net.apply(runner.params, jnp.asarray(obs))
     greedy = np.asarray(jnp.argmax(logits, axis=-1))
-
     weighted = 0.6 * table[:, :2] + 0.4 * table[:, 2:4]
     optimal = np.argmin(weighted, axis=1)
-    accuracy = float((greedy == optimal).mean())
+    accuracy = greedy_row_accuracy(runner, env_params, SMOKE_CFG.hidden)
     assert accuracy >= 0.95, f"greedy policy only matches optimum on {accuracy:.0%} of rows"
 
     # episode reward improved substantially over training
@@ -168,3 +181,28 @@ def test_unknown_compute_dtype_raises(env_params):
                          compute_dtype="bf16")
     with pytest.raises(ValueError, match="compute_dtype"):
         make_ppo(env_params, cfg)
+
+
+def test_block_shuffle_active_convergence(env_params):
+    """At scales where the tile-aligned block shuffle engages
+    (minibatch >= 1024 blocks), training must converge exactly like the
+    per-sample shuffle. 128 envs x 99 steps, minibatch 8192 -> 1024 blocks."""
+    from rl_scheduler_tpu.agent.ppo import effective_shuffle_block
+
+    cfg = PPOTrainConfig(num_envs=128, rollout_steps=99, minibatch_size=8192,
+                         num_epochs=4, lr=3e-3, hidden=(64, 64),
+                         entropy_coeff=0.01)
+    # The exact runtime gate, not a proxy: the block path must be ON here.
+    assert effective_shuffle_block(cfg) == cfg.shuffle_block_size > 1
+    runner, _ = ppo_train(env_params, cfg, 25, seed=0)
+    agreement = greedy_row_accuracy(runner, env_params, cfg.hidden)
+    assert agreement >= 0.95, f"only {agreement:.0%} of rows optimal"
+
+
+def test_block_shuffle_gate_requires_env_divisibility():
+    """Blocks must not straddle timesteps: few envs -> exact shuffle."""
+    from rl_scheduler_tpu.agent.ppo import effective_shuffle_block
+
+    cfg = PPOTrainConfig(num_envs=4, rollout_steps=2048, minibatch_size=8192,
+                         num_epochs=1, hidden=(8, 8))
+    assert effective_shuffle_block(cfg) == 1
