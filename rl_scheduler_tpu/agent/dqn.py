@@ -41,6 +41,11 @@ class DQNConfig:
     target_tau: float = 0.01            # soft target update rate
     double_dqn: bool = True
     hidden: tuple = (64, 64)
+    # scan: sequential epsilon-greedy steps; open_loop: batch the whole
+    # collect horizon for table-replay envs (one Q forward over all steps,
+    # mirrors PPOTrainConfig.rollout_impl); auto picks open_loop when the
+    # bundle exports a horizon.
+    collect_impl: str = "auto"    # scan | open_loop | auto
 
 
 class ReplayBuffer(NamedTuple):
@@ -76,9 +81,30 @@ def buffer_add(buf: ReplayBuffer, batch: dict) -> ReplayBuffer:
 
     ``n`` (the env batch) is static, so the scatter indices are a cheap
     ``pos + iota mod cap`` — one fused scatter per field, no host sync.
+
+    A batch larger than the whole buffer (possible via the open-loop
+    collect: ``collect_steps * num_envs`` arrives as ONE add) keeps only
+    its newest ``capacity`` rows — the older ones would be immediately
+    overwritten under circular semantics anyway, and letting them through
+    would make the modular scatter indices collide with undefined winners.
     """
     n = batch["action"].shape[0]
     cap = buf.capacity
+    if n > cap:
+        batch = {k: v[n - cap:] for k, v in batch.items()}
+        # The head still advances by the FULL n (as if each row had been
+        # written in turn), matching what n sequential adds would leave.
+        pos_after = (buf.pos + n) % cap
+        idx = (pos_after - cap + jnp.arange(cap, dtype=jnp.int32)) % cap
+        return ReplayBuffer(
+            obs=buf.obs.at[idx].set(batch["obs"]),
+            action=buf.action.at[idx].set(batch["action"]),
+            reward=buf.reward.at[idx].set(batch["reward"]),
+            done=buf.done.at[idx].set(batch["done"]),
+            next_obs=buf.next_obs.at[idx].set(batch["next_obs"]),
+            pos=pos_after,
+            size=jnp.asarray(cap, buf.size.dtype),
+        )
     idx = (buf.pos + jnp.arange(n, dtype=jnp.int32)) % cap
     return ReplayBuffer(
         obs=buf.obs.at[idx].set(batch["obs"]),
@@ -192,6 +218,74 @@ def make_dqn(
         carry, _ = jax.lax.scan(env_step, carry, None, length=cfg.collect_steps)
         return carry, eps
 
+    def collect_open_loop(runner: DQNRunnerState):
+        """Whole-horizon epsilon-greedy collection without a scan.
+
+        Same contract as :func:`collect` (the Q-network is frozen at
+        ``runner.params`` across the horizon there too, so batching all
+        ``collect_steps`` observations into ONE forward is exact, not an
+        approximation); only the RNG stream differs.
+        """
+        s = cfg.collect_steps
+        eps = epsilon_by_step(cfg, runner.env_steps)
+        key, hkey, akey, ekey = jax.random.split(runner.key, 4)
+        obs_all, aux, env_state = bundle.horizon_fn(
+            runner.env_state, runner.obs, hkey, s
+        )
+        n = obs_all.shape[1]
+        q = net.apply(runner.params, obs_all[:s].reshape(s * n, *bundle.obs_shape))
+        greedy = jnp.argmax(q.reshape(s, n, -1), axis=-1).astype(jnp.int32)
+        random_a = jax.random.randint(akey, (s, n), 0, bundle.num_actions, jnp.int32)
+        explore = jax.random.uniform(ekey, (s, n)) < eps
+        action = jnp.where(explore, random_a, greedy)
+        reward = bundle.horizon_reward_fn(aux, action)
+        done = aux["dones"]
+        flat = lambda x: x.reshape(s * n, *x.shape[2:])
+        buf = buffer_add(
+            runner.buffer,
+            {
+                "obs": flat(obs_all[:s]),
+                "action": flat(action),
+                "reward": flat(reward),
+                "done": flat(done),
+                "next_obs": flat(obs_all[1:]),
+            },
+        )
+
+        def book(carry, xs):
+            ep_ret, ep_stat = carry
+            r, d = xs
+            new_ret = ep_ret + r
+            finished = jnp.sum(d)
+            ep_stat = jnp.where(
+                finished > 0,
+                jnp.sum(new_ret * d) / jnp.maximum(finished, 1.0),
+                ep_stat,
+            )
+            return (new_ret * (1.0 - d), ep_stat), None
+
+        (ep_ret, ep_stat), _ = jax.lax.scan(
+            book, (runner.ep_return, runner.last_episode_return), (reward, done)
+        )
+        return (buf, env_state, obs_all[s], key, ep_ret, ep_stat), eps
+
+    has_horizon = (
+        bundle.horizon_fn is not None and bundle.horizon_reward_fn is not None
+    )
+    if cfg.collect_impl == "open_loop" and not has_horizon:
+        raise ValueError(
+            f"collect_impl='open_loop' needs an env with a horizon_fn; "
+            f"bundle {bundle.name!r} has none (use 'scan' or 'auto')"
+        )
+    if cfg.collect_impl not in ("scan", "open_loop", "auto"):
+        raise ValueError(
+            f"unknown collect_impl {cfg.collect_impl!r}; choose scan|open_loop|auto"
+        )
+    use_open_loop = cfg.collect_impl == "open_loop" or (
+        cfg.collect_impl == "auto" and has_horizon
+    )
+    collect_fn = collect_open_loop if use_open_loop else collect
+
     def learner_step(params, target_params, opt_state, batch):
         def loss_fn(p):
             q = net.apply(p, batch["obs"])
@@ -214,7 +308,7 @@ def make_dqn(
 
     def update_fn(runner: DQNRunnerState):
         """One iteration: collect transitions, then learn (once warm)."""
-        (buf, env_state, obs, key, ep_ret, ep_stat), eps = collect(runner)
+        (buf, env_state, obs, key, ep_ret, ep_stat), eps = collect_fn(runner)
         key, skey = jax.random.split(key)
         batch = buffer_sample(buf, skey, cfg.batch_size)
 
