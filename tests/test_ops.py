@@ -158,3 +158,30 @@ def test_models_forward_shapes(rng):
     # single-obs (unbatched) path used by the serving backend
     logits1, v1 = ac.apply(params, obs[0])
     assert logits1.shape == (2,) and v1.shape == ()
+
+
+class TestSelectAlongLast:
+    def test_matches_take_along_axis(self):
+        from rl_scheduler_tpu.ops.indexing import select_along_last
+
+        rng = np.random.default_rng(0)
+        vals = jnp.asarray(rng.normal(size=(5, 7, 3)).astype(np.float32))
+        idx = jnp.asarray(rng.integers(0, 3, (5, 7)), jnp.int32)
+        got = select_along_last(vals, idx)
+        expect = jnp.take_along_axis(vals, idx[..., None], axis=-1)[..., 0]
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(expect))
+
+    def test_gradient_flows_only_to_selected(self):
+        from rl_scheduler_tpu.ops.indexing import select_along_last
+
+        vals = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        idx = jnp.asarray([1, 0], jnp.int32)
+        g = jax.grad(lambda v: select_along_last(v, idx).sum())(vals)
+        np.testing.assert_array_equal(np.asarray(g), [[0.0, 1.0], [1.0, 0.0]])
+
+    def test_preserves_dtype(self):
+        from rl_scheduler_tpu.ops.indexing import select_along_last
+
+        vals = jnp.ones((4, 2), jnp.bfloat16)
+        out = select_along_last(vals, jnp.zeros(4, jnp.int32))
+        assert out.dtype == jnp.bfloat16
