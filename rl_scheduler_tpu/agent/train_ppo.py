@@ -30,7 +30,8 @@ ENVS = ("multi_cloud", "single_cluster", "cluster_set", "cluster_graph")
 
 
 def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
-                        fault_prob: float | None = None):
+                        fault_prob: float | None = None,
+                        num_heads: int | None = None):
     """``(bundle, net)`` for each BASELINE env family.
 
     ``net=None`` means the default flat-obs ActorCritic; the set/graph envs
@@ -57,7 +58,10 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
         from rl_scheduler_tpu.env.bundle import cluster_set_bundle
         from rl_scheduler_tpu.models import SetTransformerPolicy
 
-        return cluster_set_bundle(), SetTransformerPolicy(dim=64, depth=2, dtype=dtype)
+        kwargs = {} if num_heads is None else {"num_heads": num_heads}
+        return cluster_set_bundle(), SetTransformerPolicy(
+            dim=64, depth=2, dtype=dtype, **kwargs
+        )
     if env_name == "cluster_graph":
         import numpy as np
 
@@ -102,6 +106,11 @@ def main(argv: list[str] | None = None) -> Path:
     p.add_argument("--minibatch-size", type=int, default=None)
     p.add_argument("--hidden", default=None,
                    help="comma-separated MLP widths, e.g. 64,64")
+    p.add_argument("--num-heads", type=int, default=None,
+                   help="set-transformer attention heads (cluster_set only; "
+                        "default 1 — multi-head measured 3x slower at small "
+                        "node sets; needed to resume runs trained with an "
+                        "older multi-head default)")
     p.add_argument("--compute-dtype", default=None,
                    choices=("float32", "bfloat16"),
                    help="torso/block compute precision (params stay f32)")
@@ -149,6 +158,16 @@ def main(argv: list[str] | None = None) -> Path:
             f"--hidden configures the MLP policy; --env {args.env} uses a "
             "structured policy with its own dimensions"
         )
+    if args.num_heads is not None and args.env != "cluster_set":
+        raise SystemExit(
+            f"--num-heads configures the set transformer; --env {args.env} "
+            "has no attention heads"
+        )
+    if args.num_heads is not None and (args.num_heads < 1 or 64 % args.num_heads):
+        raise SystemExit(
+            f"--num-heads {args.num_heads}: must be a positive divisor of "
+            "the set transformer's dim (64)"
+        )
     fault_prob = None
     if args.fault_from_loadtest:
         if args.env != "multi_cloud":
@@ -178,7 +197,7 @@ def main(argv: list[str] | None = None) -> Path:
         print(f"Fault injection calibrated from load test: "
               f"fault_prob={fault_prob:.4f}")
     bundle, net = make_bundle_and_net(args.env, cfg, args.legacy_reward_sign,
-                                      fault_prob)
+                                      fault_prob, args.num_heads)
 
     run_name = args.run_name or f"PPO_{args.preset}_{time.strftime('%Y%m%d_%H%M%S')}"
     run_dir = Path(args.run_root) / run_name
@@ -225,6 +244,18 @@ def main(argv: list[str] | None = None) -> Path:
                 f"--resume: checkpoint hidden={meta['hidden']} does not match "
                 f"configured hidden={list(cfg.hidden)} (pass --hidden "
                 f"{','.join(str(w) for w in meta['hidden'])})"
+            )
+        ckpt_heads = meta.get("num_heads")
+        if ckpt_heads is None and meta.get("env") == "cluster_set":
+            # Checkpoints from before num_heads was recorded were always
+            # built with the then-default of 4 heads.
+            ckpt_heads = 4
+        net_heads = getattr(net, "num_heads", None)
+        if ckpt_heads is not None and net_heads is not None and ckpt_heads != net_heads:
+            raise SystemExit(
+                f"--resume: checkpoint attention uses num_heads={ckpt_heads} "
+                f"but this run would build {net_heads} (the default changed "
+                f"from 4 to 1); pass --num-heads {ckpt_heads}"
             )
         ckpt_legacy = meta.get("legacy_reward_sign")
         if ckpt_legacy is not None and ckpt_legacy != args.legacy_reward_sign:
@@ -279,6 +310,8 @@ def main(argv: list[str] | None = None) -> Path:
                 # hidden describes the default MLP only; the set/graph
                 # policies own their dimensions.
                 "hidden": list(cfg.hidden) if net is None else None,
+                # attention head count for the set policy (resume guard)
+                "num_heads": getattr(net, "num_heads", None),
                 "legacy_reward_sign": args.legacy_reward_sign})
 
     print(f"Training PPO preset={args.preset} env={args.env} on "

@@ -35,10 +35,18 @@ class SelfAttentionBlock(nn.Module):
     ``attention_fn``: optional override for the attention inner — the
     sequence-parallel path injects ring attention here; ``None`` keeps
     flax's dense ``dot_product_attention``.
+
+    ``num_heads`` defaults to 1: for the small node sets this policy
+    targets, multi-head adds no measurable quality at dim 64 but its
+    head-split tensors dominate the fused PPO update on TPU — a profile
+    at 4096 envs x 8 nodes measured the 4-head variant 3x slower end to
+    end (162k vs 495k env-steps/s) purely from [B, H, N, N]-shaped
+    elementwise/layout traffic. Raise it for large sets where per-head
+    subspaces earn their cost.
     """
 
     dim: int
-    num_heads: int = 4
+    num_heads: int = 1
     mlp_ratio: int = 2
     attention_fn: Callable | None = None
     dtype: Any = None  # compute dtype; params stay f32
@@ -80,7 +88,7 @@ class SetTransformerPolicy(nn.Module):
 
     dim: int = 64
     depth: int = 2
-    num_heads: int = 4
+    num_heads: int = 1  # see SelfAttentionBlock: multi-head is a 3x slowdown
     axis_name: str | None = None
     dtype: Any = None  # compute dtype for blocks (pointer/value heads stay f32)
 

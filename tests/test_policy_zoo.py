@@ -263,3 +263,37 @@ def test_train_cli_rejects_inert_flags_for_structured_envs(tmp_path):
     with pytest.raises(SystemExit, match="legacy-reward-sign"):
         cli.main(["--env", "single_cluster", "--legacy-reward-sign",
                   "--run-root", str(tmp_path)])
+
+
+def test_set_cli_num_heads_resume_guard(tmp_path):
+    """A run checkpointed with one head count refuses to resume under a
+    different one with a friendly message (the default changed 4 -> 1)."""
+    from rl_scheduler_tpu.agent import train_ppo as cli
+
+    common = [
+        "--env", "cluster_set", "--preset", "quick", "--num-envs", "8",
+        "--rollout-steps", "16", "--minibatch-size", "64",
+        "--run-root", str(tmp_path), "--run-name", "heads_test",
+        "--checkpoint-every", "1",
+    ]
+    cli.main(common + ["--iterations", "1", "--num-heads", "4"])
+    with pytest.raises(SystemExit, match="num_heads"):
+        cli.main(common + ["--iterations", "2", "--resume"])
+    # matching head count resumes fine
+    cli.main(common + ["--iterations", "2", "--resume", "--num-heads", "4"])
+
+
+def test_num_heads_rejected_for_non_set_envs():
+    from rl_scheduler_tpu.agent import train_ppo as cli
+
+    with pytest.raises(SystemExit, match="num-heads"):
+        cli.main(["--env", "multi_cloud", "--num-heads", "2",
+                  "--iterations", "1"])
+
+
+def test_num_heads_must_divide_dim():
+    from rl_scheduler_tpu.agent import train_ppo as cli
+
+    with pytest.raises(SystemExit, match="divisor"):
+        cli.main(["--env", "cluster_set", "--num-heads", "3",
+                  "--iterations", "1"])
