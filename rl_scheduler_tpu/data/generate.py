@@ -72,18 +72,32 @@ def generate_all(
     return full
 
 
+# Column order of a Locust --csv stats_history export (verified against the
+# reference's data/local_*_load_stats_history.csv header).
+LOCUST_HISTORY_COLUMNS = (
+    "Timestamp", "User Count", "Type", "Name", "Requests/s", "Failures/s",
+    "50%", "66%", "75%", "80%", "90%", "95%", "98%", "99%", "99.9%",
+    "99.99%", "100%", "Total Request Count", "Total Failure Count",
+    "Total Median Response Time", "Total Average Response Time",
+    "Total Min Response Time", "Total Max Response Time",
+    "Total Average Content Size",
+)
+
+
 def generate_load_history(
     out_path: str | Path,
     steps: int = 297,
     max_users: int = 50,
     seed: int = DEFAULT_SEED,
 ) -> pd.DataFrame:
-    """Synthesize a Locust-style load-test history export.
+    """Synthesize a Locust-style ``stats_history`` export (full schema).
 
     Capability parity with the reference's load-generator artifacts
     (``locustfile.py`` + ``data/local_*_load_stats_history.csv``): a user ramp
     to ``max_users``, per-user request rate ~0.5 req/s (1-3s wait between
-    GETs), and response times that grow with load. Deterministic given seed.
+    GETs), and response times that grow with load. Emits every column of
+    Locust's ``--csv`` history export in the reference's order so the full
+    data schema round-trips; deterministic given seed.
     """
     rng = np.random.RandomState(seed)
     t = np.arange(steps)
@@ -91,25 +105,75 @@ def generate_load_history(
     rps = users * rng.uniform(0.4, 0.6, steps)
     base_rt = 3.0 + 0.05 * users
     avg_rt = base_rt + rng.exponential(2.0, steps)
+    fail_frac = rng.uniform(0.0, 0.06, steps)
+    total_requests = np.cumsum(rps).astype(np.int64)
+    total_failures = np.cumsum(rps * fail_frac).astype(np.int64)
+    max_rt = np.round(avg_rt * 10)
     df = pd.DataFrame(
         {
             "Timestamp": 1_765_110_856 + t,
             "User Count": users,
+            "Type": "",
+            "Name": "Aggregated",
             "Requests/s": rps,
+            "Failures/s": rps * fail_frac,
+            "Total Request Count": total_requests,
+            "Total Failure Count": total_failures,
+            "Total Median Response Time": np.round(avg_rt),
             "Total Average Response Time": avg_rt,
+            "Total Min Response Time": avg_rt / 5,
+            "Total Max Response Time": max_rt,
+            "Total Average Content Size": 0.0,
         }
     )
+    # Response-time percentiles fan out above the average (crudely, but the
+    # monotone ordering a real export has holds), capped so the 100% column
+    # IS the max — the Locust invariant consumers may check.
+    pct_names = LOCUST_HISTORY_COLUMNS[6:17]
+    for i, pct in enumerate(pct_names):
+        df[pct] = np.minimum(np.round(avg_rt * (1 + 0.4 * i)), max_rt)
+    df["100%"] = max_rt
+    df = df[list(LOCUST_HISTORY_COLUMNS)]
     out_path = Path(out_path)
     out_path.parent.mkdir(parents=True, exist_ok=True)
     df.to_csv(out_path, index=False)
     return df
 
 
+def generate_load_histories(
+    out_dir: str | Path,
+    overwrite: bool = False,
+    seed: int = DEFAULT_SEED,
+) -> list[Path]:
+    """Write ``local_{aws,azure}_load_stats_history.csv`` for both clouds.
+
+    Completes the reference's data-directory schema
+    (``/root/reference/data/`` ships a history per cloud). Per-cloud seeds
+    differ so the two clouds' load shapes are not identical copies. Real
+    Locust exports already present are not clobbered unless ``overwrite``.
+    """
+    out_dir = Path(out_dir)
+    written = []
+    for i, cloud in enumerate(("aws", "azure")):
+        path = out_dir / f"local_{cloud}_load_stats_history.csv"
+        if path.exists() and not overwrite:
+            continue
+        generate_load_history(path, seed=seed + i)
+        written.append(path)
+    return written
+
+
 if __name__ == "__main__":
     from rl_scheduler_tpu.data.loader import default_data_dir
-    from rl_scheduler_tpu.data.loadtest import generate_load_stats
+    from rl_scheduler_tpu.data.loadtest import (
+        generate_load_exceptions,
+        generate_load_stats,
+    )
 
     df = generate_all(default_data_dir())
     counts = generate_load_stats(default_data_dir())
+    histories = generate_load_histories(default_data_dir())
+    exceptions = generate_load_exceptions(default_data_dir())
     print(f"Generated {len(df)} steps of price/latency data in {default_data_dir()}")
-    print(f"Synthesized Locust exports (failures: {counts})")
+    print(f"Synthesized Locust exports (failures: {counts}, "
+          f"histories: {len(histories)}, exceptions: {len(exceptions)})")

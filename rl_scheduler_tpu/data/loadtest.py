@@ -113,3 +113,33 @@ def generate_load_stats(
         ])
         failures.to_csv(out_dir / f"local_{cloud}_load_failures.csv", index=False)
     return counts
+
+
+# Header of a Locust --csv exceptions export (matches the reference's
+# data/local_*_load_exceptions.csv, which are header-only: its recorded run
+# raised no Python-level exceptions, only HTTP failures).
+LOCUST_EXCEPTIONS_COLUMNS = ("Count", "Message", "Traceback", "Nodes")
+
+
+def generate_load_exceptions(
+    out_dir: str | Path,
+    overwrite: bool = False,
+) -> list[Path]:
+    """Write header-only ``local_{cloud}_load_exceptions.csv`` per cloud.
+
+    Locust's exceptions export records *client-side Python exceptions*
+    (distinct from HTTP failures); a clean run produces just the header,
+    which is exactly what the reference shipped. Emitting the empty schema
+    keeps the data directory a faithful round-trip of a Locust ``--csv``
+    session.
+    """
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    written = []
+    for cloud in CLOUDS:
+        path = out_dir / f"local_{cloud}_load_exceptions.csv"
+        if path.exists() and not overwrite:
+            continue
+        pd.DataFrame(columns=list(LOCUST_EXCEPTIONS_COLUMNS)).to_csv(path, index=False)
+        written.append(path)
+    return written

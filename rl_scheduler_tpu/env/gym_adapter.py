@@ -36,7 +36,18 @@ _JIT_STEP = jax.jit(core.step)
 
 
 class K8sMultiCloudEnv(_GYM_BASE):
-    """Single multi-cloud scheduling env with the Gymnasium 5-tuple API."""
+    """Single multi-cloud scheduling env with the Gymnasium 5-tuple API.
+
+    Episode-end semantics: reaching the end of the replay table is reported
+    as a TERMINATION (``done=True``, ``truncated=False``), deliberately
+    matching both the reference env (which sets ``done`` at step 99,
+    ``k8s_multi_cloud_env.py:139-141``) and this framework's training-side
+    GAE, which treats the horizon end as a true terminal state (no value
+    bootstrap). Wrap in ``gymnasium.wrappers.TimeLimit`` if an external
+    consumer needs truncation-style bootstrapping instead — that mirrors
+    the reference's own ``TimeLimit(100)`` variant
+    (``train_and_compare.py:18``).
+    """
 
     metadata = {"render_modes": []}
 
@@ -137,6 +148,13 @@ class K8sMultiCloudVectorEnv(_VEC_BASE):
     Host-driven stepping pays one device round-trip per call, so this is
     for external Gym tooling (wrappers, eval harnesses) — training should
     use the functional core, which fuses whole rollouts into one program.
+
+    Episode-end semantics: like the single-env adapter, the replay-horizon
+    end is a TERMINATION (``terminations[i]=True``; ``truncations`` is
+    always all-False), matching the reference env's ``done`` at step 99 and
+    the training-side GAE's no-bootstrap treatment of the horizon. External
+    value-bootstrapping wrappers that want Gymnasium time-limit semantics
+    should wrap with a TimeLimit-style truncation instead.
     """
 
     def __init__(self, num_envs: int, config: EnvConfig | None = None):

@@ -19,11 +19,11 @@ def select_along_last(values: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
     one-hot contraction over the (small) trailing axis.
 
     Contract: ``indices`` must be in ``[0, values.shape[-1])`` (out-of-range
-    yields 0.0 rather than take_along_axis's fill value) and unselected
-    columns must be finite (``0 * inf`` would poison the sum). Every caller
-    selects by an action/argmax index over finite tables or log-probs, so
-    both hold by construction; prefer ``take_along_axis`` for wide or
+    yields 0.0 rather than take_along_axis's fill value). Unselected columns
+    may be non-finite: the select masks with ``where`` rather than a
+    multiply, so ``-inf`` padding logits (action masking) cannot poison the
+    sum with ``0 * inf = NaN``. Prefer ``take_along_axis`` for wide or
     untrusted index spaces.
     """
-    one_hot = jax.nn.one_hot(indices, values.shape[-1], dtype=values.dtype)
-    return jnp.sum(values * one_hot, axis=-1)
+    one_hot = jax.nn.one_hot(indices, values.shape[-1], dtype=jnp.bool_)
+    return jnp.sum(jnp.where(one_hot, values, 0), axis=-1)

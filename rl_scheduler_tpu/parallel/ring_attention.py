@@ -28,6 +28,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+if hasattr(lax, "pcast"):
+    def _to_varying(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+        return lax.pcast(x, axis_name, to="varying")
+else:  # JAX < 0.9: pcast does not exist yet, pvary is the only spelling
+    def _to_varying(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+        return lax.pvary(x, axis_name)
+
 
 def _dense_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      scale: float) -> jnp.ndarray:
@@ -63,12 +70,12 @@ def ring_attention(
     #   l [..., H, n_q]      running sum of exp(scores - m)
     #   acc [..., n_q, H, D] running weighted values
     batch_hq = (*q.shape[:-3], q.shape[-2], q.shape[-3])
-    # pvary: the accumulators are constant-initialized but become
-    # device-varying inside the ring loop; shard_map's varying-axis check
-    # requires the fori_loop carry to be varying from the start.
-    m = lax.pvary(jnp.full(batch_hq, -jnp.inf, f32), axis_name)
-    l = lax.pvary(jnp.zeros(batch_hq, f32), axis_name)
-    acc = lax.pvary(jnp.zeros(q.shape, f32), axis_name)
+    # The accumulators are constant-initialized but become device-varying
+    # inside the ring loop; shard_map's varying-axis check requires the
+    # fori_loop carry to be varying from the start.
+    m = _to_varying(jnp.full(batch_hq, -jnp.inf, f32), axis_name)
+    l = _to_varying(jnp.zeros(batch_hq, f32), axis_name)
+    acc = _to_varying(jnp.zeros(q.shape, f32), axis_name)
     qf = q.astype(f32)
 
     perm = [(i, (i + 1) % ring) for i in range(ring)]

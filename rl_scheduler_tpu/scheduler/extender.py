@@ -325,6 +325,7 @@ def build_policy(
     hidden = (256, 256)
     algo = "ppo"
     if backend != "greedy":
+        tree = meta = run_dir = None
         try:
             from rl_scheduler_tpu.config import RuntimeConfig
             from rl_scheduler_tpu.utils.checkpoint import (
@@ -337,27 +338,35 @@ def build_policy(
                 Path(run) if run else find_latest_run(run_root or RuntimeConfig().checkpoint_dir)
             )
             tree, meta = load_policy_params(run_dir)
+        except Exception:  # corrupt/missing checkpoint must not keep the
+            # extender down — greedy fallback absorbs it (SURVEY.md §5.3).
+            logger.exception("checkpoint load failed; serving cost-greedy fallback")
+        if meta is not None:
             ckpt_env = meta.get("env", "multi_cloud")
             if ckpt_env != "multi_cloud":
                 # A different env family means a different observation
                 # space: the net would load fine but raise (fail-open) on
-                # every 6-dim request. Refuse at startup (params_tree stays
-                # None -> greedy fallback) instead.
-                raise ValueError(
+                # every 6-dim request.
+                msg = (
                     f"checkpoint {run_dir} is for env {ckpt_env!r}; the "
                     "extender serves multi-cloud observations — pass --run "
                     "pointing at a multi_cloud run"
                 )
-            params_tree = tree
-            hidden = tuple(meta.get("hidden") or hidden)
-            # The meta's algo key selects the network family — a DQN run
-            # being the newest must serve a Q-network, not be misread as
-            # an actor-critic tree.
-            algo = meta.get("algo", "ppo")
-            logger.info("serving %s checkpoint from %s", algo, run_dir)
-        except Exception:  # corrupt/missing checkpoint must not keep the
-            # extender down — greedy fallback absorbs it (SURVEY.md §5.3).
-            logger.exception("checkpoint load failed; serving cost-greedy fallback")
+                if run:  # same truthiness as the discovery branch above
+                    # Operator named this checkpoint explicitly: refuse to
+                    # start rather than silently serve something else.
+                    raise ValueError(msg)
+                # Auto-discovered newest run happens to be the wrong family:
+                # stay up (fail-open), but say exactly what is being served.
+                logger.error("%s; serving cost-greedy fallback", msg)
+            else:
+                params_tree = tree
+                hidden = tuple(meta.get("hidden") or hidden)
+                # The meta's algo key selects the network family — a DQN run
+                # being the newest must serve a Q-network, not be misread as
+                # an actor-critic tree.
+                algo = meta.get("algo", "ppo")
+                logger.info("serving %s checkpoint from %s", algo, run_dir)
     backend_obj, _ = make_backend(backend, params_tree, hidden, serve_device, algo)
     cpu_source = PrometheusCpu() if prometheus else RandomCpu(seed=cpu_seed)
     telemetry = TableTelemetry.from_table(data_path, cpu_source)

@@ -110,6 +110,65 @@ def test_default_data_dir_in_repo():
     assert default_data_dir().parent.name == "repo"
 
 
+# Every loose data file the reference ships (reference data/ listing); the
+# repo's data dir must round-trip the full schema family.
+REFERENCE_DATA_FILES = (
+    "real_prices.csv",
+    "real_latencies.csv",
+    "local_aws_load_stats.csv",
+    "local_azure_load_stats.csv",
+    "local_aws_load_failures.csv",
+    "local_azure_load_failures.csv",
+    "local_aws_load_stats_history.csv",
+    "local_azure_load_stats_history.csv",
+    "local_aws_load_exceptions.csv",
+    "local_azure_load_exceptions.csv",
+)
+
+
+def test_data_dir_has_full_reference_schema():
+    missing = [f for f in REFERENCE_DATA_FILES if not (default_data_dir() / f).exists()]
+    assert not missing, f"data/ lacks reference files: {missing}"
+
+
+def test_generate_load_histories_full_locust_schema(tmp_path):
+    from rl_scheduler_tpu.data.generate import (
+        LOCUST_HISTORY_COLUMNS,
+        generate_load_histories,
+    )
+
+    written = generate_load_histories(tmp_path)
+    assert len(written) == 2
+    aws = pd.read_csv(tmp_path / "local_aws_load_stats_history.csv")
+    azure = pd.read_csv(tmp_path / "local_azure_load_stats_history.csv")
+    for df in (aws, azure):
+        assert tuple(df.columns) == LOCUST_HISTORY_COLUMNS
+        assert len(df) == 297  # reference history length
+        assert (df["Total Request Count"].diff().dropna() >= 0).all()
+    # per-cloud seeds differ: the two clouds are not identical copies
+    assert not aws["Requests/s"].equals(azure["Requests/s"])
+    # loader accepts the full-schema export
+    trace = load_single_cluster_trace(tmp_path / "local_azure_load_stats_history.csv")
+    assert trace.shape == (297, 3)
+    # existing exports are preserved without overwrite
+    assert generate_load_histories(tmp_path) == []
+
+
+def test_generate_load_exceptions_header_only(tmp_path):
+    from rl_scheduler_tpu.data.loadtest import (
+        LOCUST_EXCEPTIONS_COLUMNS,
+        generate_load_exceptions,
+    )
+
+    written = generate_load_exceptions(tmp_path)
+    assert len(written) == 2
+    for cloud in ("aws", "azure"):
+        df = pd.read_csv(tmp_path / f"local_{cloud}_load_exceptions.csv")
+        assert tuple(df.columns) == LOCUST_EXCEPTIONS_COLUMNS
+        assert df.empty  # clean run: header only, like the reference's
+    assert generate_load_exceptions(tmp_path) == []
+
+
 class TestLoadtestCalibration:
     def test_generate_and_failure_rate_roundtrip(self, tmp_path):
         from rl_scheduler_tpu.data.loadtest import (

@@ -185,3 +185,16 @@ class TestSelectAlongLast:
         vals = jnp.ones((4, 2), jnp.bfloat16)
         out = select_along_last(vals, jnp.zeros(4, jnp.int32))
         assert out.dtype == jnp.bfloat16
+
+    def test_inf_in_unselected_columns_is_safe(self):
+        """Action-masked logits pad with -inf; the select must not turn
+        those into NaN via 0 * inf (ADVICE r1)."""
+        from rl_scheduler_tpu.ops.indexing import select_along_last
+
+        vals = jnp.asarray([[1.0, -jnp.inf, jnp.inf], [-jnp.inf, 2.0, -jnp.inf]])
+        idx = jnp.asarray([0, 1], jnp.int32)
+        got = np.asarray(select_along_last(vals, idx))
+        np.testing.assert_array_equal(got, [1.0, 2.0])
+
+        g = jax.grad(lambda v: select_along_last(v, idx).sum())(vals)
+        assert np.isfinite(np.asarray(g)).all()
