@@ -46,6 +46,11 @@ class DQNConfig:
     # mirrors PPOTrainConfig.rollout_impl); auto picks open_loop when the
     # bundle exports a horizon.
     collect_impl: str = "auto"    # scan | open_loop | auto
+    # In-training periodic greedy evaluation, mirroring
+    # PPOTrainConfig.eval_every/eval_episodes (reference train_final.py:19).
+    # 0 disables.
+    eval_every: int = 0
+    eval_episodes: int = 20
 
 
 class ReplayBuffer(NamedTuple):
@@ -357,6 +362,7 @@ def dqn_train(
     log_fn: Callable[[int, dict], None] | None = None,
     checkpoint_fn: Callable[[int, DQNRunnerState], None] | None = None,
     sync_every: int = 1,
+    eval_log_fn: Callable[[int, dict], None] | None = None,
 ):
     """Host-side training loop mirroring :func:`rl_scheduler_tpu.agent.ppo.ppo_train`.
 
@@ -364,13 +370,22 @@ def dqn_train(
     ``ppo_train`` — essential here, since a DQN iteration is tiny and a
     per-iteration sync round-trip (~100 ms on a remote/tunneled
     accelerator) would dwarf the update itself.
+
+    With ``cfg.eval_every > 0``, a greedy (epsilon=0) evaluation of
+    ``cfg.eval_episodes`` episodes runs every ``cfg.eval_every`` iterations
+    and reports through ``eval_log_fn`` (see ``ppo_train``).
     """
     from rl_scheduler_tpu.agent.loop import run_train_loop
+    from rl_scheduler_tpu.agent.ppo import make_greedy_eval_hook
 
-    init_fn, update_fn, _ = make_dqn(bundle, cfg)
+    init_fn, update_fn, net = make_dqn(bundle, cfg)
     runner = jax.jit(init_fn)(jax.random.PRNGKey(seed))
     update = jax.jit(update_fn, donate_argnums=0)
+    eval_hook = make_greedy_eval_hook(
+        bundle, net, cfg.eval_every, cfg.eval_episodes, seed, eval_log_fn
+    )
     return run_train_loop(
         update, runner, 0, num_iterations,
         sync_every=sync_every, log_fn=log_fn, checkpoint_fn=checkpoint_fn,
+        eval_every=cfg.eval_every, eval_hook=eval_hook,
     )

@@ -97,6 +97,59 @@ def greedy_policy_fn(net, params) -> Callable:
     return policy
 
 
+def make_greedy_eval_fn(
+    bundle,
+    net,
+    num_episodes: int = 20,
+    num_steps: int | None = None,
+) -> Callable:
+    """Jitted in-training evaluation over ANY :class:`EnvBundle`.
+
+    Returns ``eval_fn(params, key) -> metrics`` running ``num_episodes``
+    batch lanes of greedy (explore=False) rollout for one episode each —
+    the TPU-shaped counterpart of the reference's periodic evaluation
+    (``train_final.py:19``, ``evaluation_interval=5,
+    evaluation_duration=20``, which steps 20 sequential episodes through
+    RLlib eval workers). Every env family here has fixed-length episodes
+    (``bundle.episode_steps``), so one scan of that length completes
+    exactly one episode per lane.
+
+    Metrics (device scalars; ``jax.device_get`` to read):
+    ``eval_episode_reward_mean`` and ``eval_episodes_completed``.
+    """
+    steps = num_steps if num_steps is not None else bundle.episode_steps
+    if steps is None:
+        raise ValueError(
+            f"bundle {bundle.name!r} does not declare episode_steps; pass "
+            "num_steps explicitly"
+        )
+
+    @jax.jit
+    def eval_fn(params, key):
+        state, obs = bundle.reset_batch(key, num_episodes)
+
+        def step(carry, _):
+            state, obs, ep_ret = carry
+            out = net.apply(params, obs)
+            scores = out[0] if isinstance(out, tuple) else out
+            action = jnp.argmax(scores, axis=-1).astype(jnp.int32)
+            state, ts = bundle.step_batch(state, action)
+            done_f = ts.done.astype(jnp.float32)
+            new_ret = ep_ret + ts.reward
+            final = new_ret * done_f
+            return (state, ts.obs, new_ret * (1.0 - done_f)), (final, done_f)
+
+        init = (state, obs, jnp.zeros(num_episodes, jnp.float32))
+        _, (finals, dones) = jax.lax.scan(step, init, None, length=steps)
+        completed = dones.sum()
+        return {
+            "eval_episode_reward_mean": finals.sum() / jnp.maximum(completed, 1.0),
+            "eval_episodes_completed": completed,
+        }
+
+    return eval_fn
+
+
 def _episode_cost(params: env_core.EnvParams, ep_reward: jnp.ndarray) -> jnp.ndarray:
     """Positive weighted cost+latency total, independent of the reward sign
     convention (the reference conflates the two: ``cost = -reward`` at

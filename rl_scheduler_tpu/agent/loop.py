@@ -35,8 +35,16 @@ def run_train_loop(
     sync_every: int = 1,
     log_fn: Callable[[int, dict], None] | None = None,
     checkpoint_fn: Callable[[int, Any], None] | None = None,
+    eval_every: int = 0,
+    eval_hook: Callable[[int, Any], None] | None = None,
 ) -> tuple[Any, list[dict]]:
     """Run ``update`` for iterations ``[start_iteration, num_iterations)``.
+
+    With ``eval_every > 0`` and an ``eval_hook``, the hook fires after
+    every ``eval_every``-th iteration (reference semantics:
+    ``evaluation_interval``, ``train_final.py:19``). Pending training
+    metrics are flushed first so the hook's own log records land after the
+    iterations they evaluate.
 
     Returns ``(final_runner, history)`` where history holds one float dict
     per iteration (plus the synthetic ``wall_time`` key described above).
@@ -74,6 +82,10 @@ def run_train_loop(
                 flush()
             if checkpoint_fn is not None:
                 checkpoint_fn(i, runner)
+            if (eval_hook is not None and eval_every > 0
+                    and (i + 1) % eval_every == 0):
+                flush()
+                eval_hook(i, runner)
     finally:
         flush()
     return runner, history
@@ -134,6 +146,36 @@ def make_jsonl_log_fn(
             print_line(i, sps, metrics)
 
     return log_fn
+
+
+def print_eval_line(i: int, metrics: dict) -> None:
+    """The one console format for in-training eval metrics (shared by the
+    CLI sink below and the no-sink fallback in ``agent.ppo``)."""
+    print(
+        f"  eval@{i + 1}: "
+        f"reward_mean={metrics['eval_episode_reward_mean']:.2f} "
+        f"({metrics['eval_episodes_completed']:.0f} episodes)",
+        flush=True,
+    )
+
+
+def make_eval_log_fn(
+    metrics_file: Any,
+    tb: TensorBoardLogger | None = None,
+) -> Callable[[int, dict], None]:
+    """Standard CLI sink for in-training evaluation metrics: one JSONL line
+    (tagged ``"eval": true`` so analysis can split the streams), the same
+    scalars to TensorBoard, and a console line."""
+
+    def eval_log_fn(i: int, metrics: dict) -> None:
+        line = {"iteration": i + 1, "eval": True, **metrics}
+        metrics_file.write(json.dumps(line) + "\n")
+        metrics_file.flush()
+        if tb is not None:
+            tb.add(i + 1, metrics)
+        print_eval_line(i, metrics)
+
+    return eval_log_fn
 
 
 def make_periodic_checkpoint_fn(

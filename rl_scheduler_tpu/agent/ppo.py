@@ -57,6 +57,12 @@ class PPOTrainConfig:
     #   bundle horizon_fn; ~2x faster rollout on TPU).
     # auto: open_loop when the bundle supports it, scan otherwise.
     rollout_impl: str = "auto"       # scan | open_loop | auto
+    # In-training periodic evaluation (reference train_final.py:19:
+    # evaluation_interval=5, evaluation_duration=20): every eval_every
+    # iterations, run eval_episodes greedy episodes and report
+    # eval_episode_reward_mean. 0 disables.
+    eval_every: int = 0
+    eval_episodes: int = 20
     # Epoch-shuffle granularity: permute contiguous blocks of this many
     # samples instead of single rows. Blocks are adjacent envs at one
     # timestep (iid rollouts), so statistics are indistinguishable for
@@ -420,8 +426,16 @@ def ppo_train(
     restore: tuple[dict, int] | None = None,
     debug_checks: bool = False,
     sync_every: int = 1,
+    eval_log_fn: Callable[[int, dict], None] | None = None,
 ):
     """Host-side training loop: jitted update per iteration + logging hooks.
+
+    With ``cfg.eval_every > 0``, a greedy ``cfg.eval_episodes``-episode
+    evaluation runs every ``cfg.eval_every`` iterations (reference
+    ``train_final.py:19`` semantics) and its metrics
+    (``eval_episode_reward_mean``, ``eval_episodes_completed``) go to
+    ``eval_log_fn(iteration, metrics)`` — or are printed if no sink is
+    given.
 
     ``debug_checks=True`` checkifies the update (``utils/debug.py``): the
     first NaN/zero-division/out-of-bounds index raises with the failing
@@ -455,7 +469,7 @@ def ppo_train(
                 "instrument the Pallas GAE kernel, so it is not the code "
                 "under test in this run", stacklevel=2)
         cfg = dataclasses.replace(cfg, gae_impl="scan")
-    init_fn, update_fn, _ = make_ppo_bundle(bundle, cfg, net=net)
+    init_fn, update_fn, net = make_ppo_bundle(bundle, cfg, net=net)
     start_iteration = 0
     key = jax.random.PRNGKey(seed)
     if restore is not None:
@@ -478,9 +492,48 @@ def ppo_train(
         update = checkified_update(update_fn)
     else:
         update = jax.jit(update_fn, donate_argnums=0)
+    eval_hook = make_greedy_eval_hook(
+        bundle, net, cfg.eval_every, cfg.eval_episodes, seed, eval_log_fn
+    )
     from rl_scheduler_tpu.agent.loop import run_train_loop
 
     return run_train_loop(
         update, runner, start_iteration, num_iterations,
         sync_every=sync_every, log_fn=log_fn, checkpoint_fn=checkpoint_fn,
+        eval_every=cfg.eval_every, eval_hook=eval_hook,
     )
+
+
+def make_greedy_eval_hook(
+    bundle: EnvBundle,
+    net: Any,
+    eval_every: int,
+    eval_episodes: int,
+    seed: int,
+    eval_log_fn: Callable[[int, dict], None] | None,
+) -> Callable[[int, Any], None] | None:
+    """Shared PPO/DQN in-training eval hook: ``hook(i, runner)`` runs the
+    jitted greedy evaluation on ``runner.params`` (distinct key per firing)
+    and hands the fetched metrics to ``eval_log_fn`` — or prints them.
+    Returns ``None`` when disabled."""
+    if eval_every <= 0:
+        return None
+    from rl_scheduler_tpu.agent.evaluate import make_greedy_eval_fn
+
+    eval_metrics_fn = make_greedy_eval_fn(bundle, net, eval_episodes)
+    # A dedicated key stream, decorrelated from the training stream.
+    eval_key = jax.random.fold_in(jax.random.PRNGKey(seed), 0x0E7A1)
+
+    def eval_hook(i: int, runner: Any) -> None:
+        metrics = jax.device_get(
+            eval_metrics_fn(runner.params, jax.random.fold_in(eval_key, i))
+        )
+        metrics = {k: float(v) for k, v in metrics.items()}
+        if eval_log_fn is not None:
+            eval_log_fn(i, metrics)
+        else:
+            from rl_scheduler_tpu.agent.loop import print_eval_line
+
+            print_eval_line(i, metrics)
+
+    return eval_hook

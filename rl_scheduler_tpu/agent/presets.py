@@ -26,6 +26,8 @@ PPO_PRESETS: dict[str, PPOTrainConfig] = {
         gamma=0.99,
     ),
     # 80 envs x 100 steps = 8000 = reference train_batch_size (train_final.py)
+    # eval every 5 iters for 20 episodes = reference train_final.py:19
+    # (evaluation_interval=5, evaluation_duration=20)
     "final": PPOTrainConfig(
         num_envs=80,
         rollout_steps=100,
@@ -33,6 +35,8 @@ PPO_PRESETS: dict[str, PPOTrainConfig] = {
         num_epochs=15,
         lr=5e-4,
         gamma=0.995,
+        eval_every=5,
+        eval_episodes=20,
     ),
     # BASELINE config 2: 64 vmapped envs on one TPU core
     "tpu64": PPOTrainConfig(

@@ -57,6 +57,12 @@ def main(argv: list[str] | None = None) -> Path:
     p.add_argument("--run-root", default=RuntimeConfig().checkpoint_dir)
     p.add_argument("--checkpoint-every", type=int, default=500)
     p.add_argument("--keep", type=int, default=5)
+    p.add_argument("--eval-every", type=int, default=None,
+                   help="run a greedy (epsilon=0) evaluation every N "
+                        "iterations during training (reference "
+                        "train_final.py:19 semantics); 0 disables")
+    p.add_argument("--eval-episodes", type=int, default=None,
+                   help="episodes per in-training evaluation (default 20)")
     p.add_argument("--num-envs", type=int, default=None,
                    help="override the preset's parallel env count")
     p.add_argument("--hidden", default=None,
@@ -79,6 +85,10 @@ def main(argv: list[str] | None = None) -> Path:
         overrides["num_envs"] = args.num_envs
     if args.hidden is not None:
         overrides["hidden"] = tuple(int(w) for w in args.hidden.split(","))
+    if args.eval_every is not None:
+        overrides["eval_every"] = args.eval_every
+    if args.eval_episodes is not None:
+        overrides["eval_episodes"] = args.eval_episodes
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
     bundle = make_bundle(args.env)
@@ -94,6 +104,7 @@ def main(argv: list[str] | None = None) -> Path:
 
     from rl_scheduler_tpu.agent.loop import (
         TensorBoardLogger,
+        make_eval_log_fn,
         make_jsonl_log_fn,
         make_periodic_checkpoint_fn,
     )
@@ -131,7 +142,8 @@ def main(argv: list[str] | None = None) -> Path:
           f"({cfg.num_envs} envs x {cfg.collect_steps} steps/iter)")
     dqn_train(bundle, cfg, args.iterations, seed=args.seed,
               log_fn=log_fn, checkpoint_fn=checkpoint_fn,
-              sync_every=args.sync_every)
+              sync_every=args.sync_every,
+              eval_log_fn=make_eval_log_fn(metrics_file, tb))
     metrics_file.close()
     if tb is not None:
         tb.close()

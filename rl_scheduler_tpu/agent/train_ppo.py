@@ -90,6 +90,15 @@ def main(argv: list[str] | None = None) -> Path:
     p.add_argument("--run-root", default=RuntimeConfig().checkpoint_dir)
     p.add_argument("--checkpoint-every", type=int, default=10)
     p.add_argument("--keep", type=int, default=5)
+    p.add_argument("--eval-every", type=int, default=None,
+                   help="run a greedy evaluation every N iterations during "
+                        "training (reference train_final.py:19 evaluates "
+                        "every 5; the 'final' preset defaults to that). "
+                        "0 disables; eval metrics go to the console, "
+                        "metrics.jsonl, and TensorBoard")
+    p.add_argument("--eval-episodes", type=int, default=None,
+                   help="episodes per in-training evaluation (default 20, "
+                        "the reference's evaluation_duration)")
     p.add_argument("--legacy-reward-sign", action="store_true",
                    help="reproduce the reference's positive reward (SURVEY.md §7.0.1)")
     p.add_argument("--fault-from-loadtest", action="store_true",
@@ -141,7 +150,8 @@ def main(argv: list[str] | None = None) -> Path:
     cfg = PPO_PRESETS[args.preset]
     overrides = {
         k: getattr(args, k)
-        for k in ("num_envs", "rollout_steps", "minibatch_size", "compute_dtype")
+        for k in ("num_envs", "rollout_steps", "minibatch_size", "compute_dtype",
+                  "eval_every", "eval_episodes")
         if getattr(args, k) is not None
     }
     if args.hidden is not None:
@@ -282,6 +292,7 @@ def main(argv: list[str] | None = None) -> Path:
 
     from rl_scheduler_tpu.agent.loop import (
         TensorBoardLogger,
+        make_eval_log_fn,
         make_jsonl_log_fn,
         make_periodic_checkpoint_fn,
     )
@@ -328,7 +339,8 @@ def main(argv: list[str] | None = None) -> Path:
     with ctx:
         ppo_train(bundle, cfg, args.iterations, seed=args.seed, net=net,
                   log_fn=log_fn, checkpoint_fn=checkpoint_fn, restore=restore,
-                  debug_checks=args.debug_checks, sync_every=args.sync_every)
+                  debug_checks=args.debug_checks, sync_every=args.sync_every,
+                  eval_log_fn=make_eval_log_fn(metrics_file, tb))
     metrics_file.close()
     if tb is not None:
         tb.close()

@@ -46,6 +46,11 @@ class EnvBundle(NamedTuple):
     # steps); set BOTH fns or neither.
     horizon_fn: Callable | None = None
     horizon_reward_fn: Callable | None = None
+    # Fixed episode length (steps until done), when the env has one — every
+    # env family here replays a finite table/trace, so all do. Lets generic
+    # harnesses (in-training greedy evaluation) size a scan so each batch
+    # lane completes exactly one episode.
+    episode_steps: int | None = None
 
 
 def make_autoreset(
@@ -86,6 +91,7 @@ def bundle_from_single(
     obs_shape: tuple,
     num_actions: int,
     name: str = "env",
+    episode_steps: int | None = None,
 ) -> EnvBundle:
     """Build an :class:`EnvBundle` from single-env pure functions."""
     step_autoreset = make_autoreset(reset_fn, step_fn)
@@ -101,6 +107,7 @@ def bundle_from_single(
         obs_shape=obs_shape,
         num_actions=num_actions,
         name=name,
+        episode_steps=episode_steps,
     )
 
 
@@ -119,6 +126,7 @@ def multi_cloud_bundle(params=None) -> EnvBundle:
         obs_shape=(core.OBS_DIM,),
         num_actions=core.NUM_ACTIONS,
         name="multi_cloud",
+        episode_steps=int(params.max_steps),
         horizon_fn=lambda state, cur_obs, key, t: core.open_loop_horizon(
             params, state, cur_obs, key, t
         ),
@@ -140,6 +148,7 @@ def single_cluster_bundle(params=None) -> EnvBundle:
         obs_shape=(sc.OBS_DIM,),
         num_actions=sc.NUM_ACTIONS,
         name="single_cluster",
+        episode_steps=int(params.max_steps),
     )
 
 
@@ -159,6 +168,7 @@ def cluster_set_bundle(params=None) -> EnvBundle:
         obs_shape=(params.num_nodes, cs.NODE_FEAT),
         num_actions=params.num_nodes,
         name="cluster_set",
+        episode_steps=int(params.max_steps),
     )
 
 
@@ -174,4 +184,5 @@ def cluster_graph_bundle(params=None) -> EnvBundle:
         obs_shape=(params.num_nodes, cg.NODE_FEAT),
         num_actions=params.num_nodes,
         name="cluster_graph",
+        episode_steps=int(params.max_steps),
     )

@@ -9,7 +9,11 @@ a ``tp`` axis. Multi-host (DCN) growth goes through ``jax.distributed``
 """
 
 from rl_scheduler_tpu.parallel.mesh import make_mesh, device_count
-from rl_scheduler_tpu.parallel.sharding import make_data_parallel_ppo
+from rl_scheduler_tpu.parallel.sharding import (
+    make_data_parallel_ppo,
+    make_data_parallel_ppo_bundle,
+    make_seq_parallel_ppo,
+)
 from rl_scheduler_tpu.parallel.ring_attention import (
     ring_attention,
     make_flax_attention_fn,
@@ -20,6 +24,8 @@ __all__ = [
     "make_mesh",
     "device_count",
     "make_data_parallel_ppo",
+    "make_data_parallel_ppo_bundle",
+    "make_seq_parallel_ppo",
     "ring_attention",
     "make_flax_attention_fn",
     "maybe_initialize_distributed",

@@ -21,10 +21,17 @@ import dataclasses
 from functools import partial
 
 import jax
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from rl_scheduler_tpu.agent.ppo import PPOTrainConfig, RunnerState, make_ppo
+from rl_scheduler_tpu.agent.ppo import (
+    PPOTrainConfig,
+    RunnerState,
+    make_ppo,
+    make_ppo_bundle,
+)
 from rl_scheduler_tpu.env import core as env_core
+from rl_scheduler_tpu.env.bundle import EnvBundle, multi_cloud_bundle
 from rl_scheduler_tpu.parallel.mesh import make_mesh
 
 
@@ -41,19 +48,27 @@ def _runner_specs(axis: str) -> RunnerState:
     )
 
 
-def make_data_parallel_ppo(
-    env_params: env_core.EnvParams,
+def make_data_parallel_ppo_bundle(
+    bundle: EnvBundle,
     cfg: PPOTrainConfig,
     mesh: Mesh | None = None,
     axis: str = "dp",
     net=None,
+    sp_axis: str | None = None,
 ):
-    """Build ``(init_fn, update_fn, net)`` sharded over ``mesh[axis]``.
+    """Build ``(init_fn, update_fn, net)`` sharded over ``mesh[axis]`` for
+    ANY :class:`EnvBundle` (the generalization of :func:`make_data_parallel_ppo`
+    that BASELINE configs 4-5 need — set-transformer and GNN policies train
+    data-parallel through this).
 
     ``cfg.num_envs`` is the GLOBAL env count; it must divide evenly over the
     mesh axis. The returned functions take/return a global ``RunnerState``
     whose batch leaves are sharded over ``axis`` — call them under ``jax.jit``
     as usual; XLA lays the collectives on ICI.
+
+    ``sp_axis``: name of an additional SEQUENCE-PARALLEL mesh axis sharding
+    the policy's node axis (see :func:`make_seq_parallel_ppo`, which fills
+    it in). Env batch leaves stay replicated over it.
     """
     mesh = mesh or make_mesh({axis: -1})
     ndev = mesh.shape[axis]
@@ -68,10 +83,19 @@ def make_data_parallel_ppo(
     local_cfg = dataclasses.replace(
         cfg, num_envs=cfg.num_envs // ndev, minibatch_size=local_mb
     )
-    init_fn, update_fn, net = make_ppo(env_params, local_cfg, net=net, axis_name=axis)
+    # Gradient/metric sync spans every parallel axis: dp shards the batch,
+    # sp (when present) shards the policy's node compute — pmean over both
+    # is the exact global gradient (derivation at make_seq_parallel_ppo).
+    axis_name = axis if sp_axis is None else (axis, sp_axis)
+    init_fn, update_fn, net = make_ppo_bundle(
+        bundle, local_cfg, net=net, axis_name=axis_name
+    )
     specs = _runner_specs(axis)
 
     def local_init(key):
+        # Fold by the dp coordinate only: each dp shard gets distinct env
+        # resets/rollout RNG, while sp members (which must step identical
+        # replicated envs) share the stream.
         key = jax.random.fold_in(key, jax.lax.axis_index(axis))
         r = init_fn(key)
         return r._replace(key=r.key[None])  # leading device axis
@@ -92,6 +116,98 @@ def make_data_parallel_ppo(
         check_vma=False,
     )
     return sharded_init, sharded_update, net
+
+
+def make_data_parallel_ppo(
+    env_params: env_core.EnvParams,
+    cfg: PPOTrainConfig,
+    mesh: Mesh | None = None,
+    axis: str = "dp",
+    net=None,
+):
+    """:func:`make_data_parallel_ppo_bundle` specialized to the flagship
+    multi-cloud env."""
+    return make_data_parallel_ppo_bundle(
+        multi_cloud_bundle(env_params), cfg, mesh, axis, net
+    )
+
+
+class SeqParallelNet:
+    """Node-axis-sharded wrapper around a structured policy (duck-typed
+    flax surface: ``init``/``apply``), used INSIDE ``shard_map``.
+
+    The observation arrives replicated over the ``sp`` axis as
+    ``[B, N, feat]``; each sp member slices ITS node block, runs the inner
+    policy (built with ``axis_name=sp``, so attention is ring attention
+    over ICI and the value pool pmeans to the global mean), and
+    all-gathers the per-node logits back to the full ``[B, N]`` — so the
+    trainer around it (action sampling, PPO loss) sees exactly the
+    single-chip interface. Parameter shapes are identical to the unsharded
+    module (ring attention does not change them), so checkpoints are
+    interchangeable.
+    """
+
+    def __init__(self, inner, sp_axis: str, sp_size: int):
+        self.inner = inner
+        self.sp_axis = sp_axis
+        self.sp_size = sp_size
+
+    def _local_nodes(self, obs):
+        n = obs.shape[-2]
+        if n % self.sp_size:
+            raise ValueError(
+                f"node axis {n} not divisible by sp={self.sp_size}"
+            )
+        n_local = n // self.sp_size
+        idx = lax.axis_index(self.sp_axis)
+        return lax.dynamic_slice_in_dim(obs, idx * n_local, n_local, axis=-2)
+
+    def init(self, key, dummy_obs):
+        return self.inner.init(key, self._local_nodes(dummy_obs))
+
+    def apply(self, params, obs):
+        logits_local, value = self.inner.apply(params, self._local_nodes(obs))
+        logits = lax.all_gather(
+            logits_local, self.sp_axis, axis=logits_local.ndim - 1, tiled=True
+        )
+        return logits, value
+
+
+def make_seq_parallel_ppo(
+    bundle: EnvBundle,
+    cfg: PPOTrainConfig,
+    net,
+    mesh: Mesh | None = None,
+    dp_axis: str = "dp",
+    sp_axis: str = "sp",
+):
+    """PPO over a ``dp x sp`` mesh: env batch sharded over ``dp``, the
+    policy's NODE axis sharded over ``sp`` (sequence/context parallelism —
+    ring attention over ICI, ``parallel/ring_attention.py``).
+
+    ``net`` must be the inner structured policy constructed with
+    ``axis_name=sp_axis`` (e.g. ``SetTransformerPolicy(axis_name="sp")``).
+    Envs are replicated over sp (every sp member steps identical copies —
+    RNG folds by the dp coordinate only), so only the policy forward/backward
+    communicates over sp.
+
+    Gradient sync is ``pmean`` over BOTH axes, which is exact:
+
+    - The local loss is replicated over sp (logits all-gathered, value
+      pmean-pooled), so every member's backward starts from the same
+      cotangent.
+    - Params reached through node-sharded compute (embed, attention,
+      pointer scores): the all-gather/pmean transposes hand each member
+      ``sp`` times its shard's true cotangent, and pmean's ``1/sp``
+      cancels that into the exact sum over shards.
+    - Params reached through sp-replicated compute (the value head):
+      every member computes the full true gradient, which pmean preserves.
+    """
+    mesh = mesh or make_mesh({dp_axis: -1, sp_axis: 1})
+    wrapped = SeqParallelNet(net, sp_axis, mesh.shape[sp_axis])
+    return make_data_parallel_ppo_bundle(
+        bundle, cfg, mesh, dp_axis, net=wrapped, sp_axis=sp_axis
+    )
 
 
 def dp_ppo_train(

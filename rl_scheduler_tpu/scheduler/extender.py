@@ -360,13 +360,21 @@ def build_policy(
                 # stay up (fail-open), but say exactly what is being served.
                 logger.error("%s; serving cost-greedy fallback", msg)
             else:
-                params_tree = tree
-                hidden = tuple(meta.get("hidden") or hidden)
-                # The meta's algo key selects the network family — a DQN run
-                # being the newest must serve a Q-network, not be misread as
-                # an actor-critic tree.
-                algo = meta.get("algo", "ppo")
-                logger.info("serving %s checkpoint from %s", algo, run_dir)
+                try:
+                    hidden = tuple(meta.get("hidden") or hidden)
+                    # The meta's algo key selects the network family — a DQN
+                    # run being the newest must serve a Q-network, not be
+                    # misread as an actor-critic tree.
+                    algo = meta.get("algo", "ppo")
+                    params_tree = tree
+                    logger.info("serving %s checkpoint from %s", algo, run_dir)
+                except Exception:  # malformed meta (e.g. hand-edited
+                    # non-iterable "hidden") is a corrupt checkpoint too:
+                    # stay up on the greedy fallback (SURVEY.md §5.3).
+                    logger.exception(
+                        "malformed checkpoint meta at %s; serving cost-greedy "
+                        "fallback", run_dir,
+                    )
     backend_obj, _ = make_backend(backend, params_tree, hidden, serve_device, algo)
     cpu_source = PrometheusCpu() if prometheus else RandomCpu(seed=cpu_seed)
     telemetry = TableTelemetry.from_table(data_path, cpu_source)

@@ -1,0 +1,118 @@
+"""In-training periodic evaluation (reference train_final.py:19 parity:
+evaluation_interval=5, evaluation_duration=20 — here --eval-every /
+--eval-episodes on both train CLIs)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from rl_scheduler_tpu.agent.evaluate import make_greedy_eval_fn
+from rl_scheduler_tpu.env.bundle import (
+    cluster_set_bundle,
+    multi_cloud_bundle,
+    single_cluster_bundle,
+)
+
+
+def _read_jsonl(path):
+    return [json.loads(line) for line in path.read_text().splitlines()]
+
+
+class TestGreedyEvalFn:
+    def test_multi_cloud_counts_and_determinism(self):
+        from rl_scheduler_tpu.models import ActorCritic
+
+        bundle = multi_cloud_bundle()
+        net = ActorCritic(num_actions=bundle.num_actions, hidden=(8, 8))
+        params = net.init(jax.random.PRNGKey(0),
+                          jnp.zeros((1, *bundle.obs_shape)))
+        eval_fn = make_greedy_eval_fn(bundle, net, num_episodes=5)
+        m = jax.device_get(eval_fn(params, jax.random.PRNGKey(1)))
+        # fixed-length episodes: every lane completes exactly one episode
+        assert m["eval_episodes_completed"] == 5
+        assert jnp.isfinite(m["eval_episode_reward_mean"])
+        # greedy policy + same key => identical metrics
+        m2 = jax.device_get(eval_fn(params, jax.random.PRNGKey(1)))
+        assert m2["eval_episode_reward_mean"] == m["eval_episode_reward_mean"]
+
+    def test_works_for_q_networks(self):
+        """The greedy argmax serves actor-critic AND Q-net outputs."""
+        from rl_scheduler_tpu.models import QNetwork
+
+        bundle = single_cluster_bundle()
+        net = QNetwork(num_actions=bundle.num_actions, hidden=(8, 8))
+        params = net.init(jax.random.PRNGKey(0),
+                          jnp.zeros((1, *bundle.obs_shape)))
+        m = jax.device_get(
+            make_greedy_eval_fn(bundle, net, num_episodes=3)(
+                params, jax.random.PRNGKey(2)
+            )
+        )
+        assert m["eval_episodes_completed"] == 3
+
+    def test_structured_policy_bundle(self):
+        from rl_scheduler_tpu.models import SetTransformerPolicy
+
+        bundle = cluster_set_bundle()
+        net = SetTransformerPolicy(dim=16, depth=1)
+        params = net.init(jax.random.PRNGKey(0),
+                          jnp.zeros((1, *bundle.obs_shape)))
+        m = jax.device_get(
+            make_greedy_eval_fn(bundle, net, num_episodes=2)(
+                params, jax.random.PRNGKey(3)
+            )
+        )
+        assert m["eval_episodes_completed"] == 2
+
+    def test_rejects_bundle_without_episode_steps(self):
+        bundle = multi_cloud_bundle()._replace(episode_steps=None)
+        with pytest.raises(ValueError, match="episode_steps"):
+            make_greedy_eval_fn(bundle, net=None)
+
+
+class TestTrainCLIEval:
+    def test_ppo_cli_emits_eval_records(self, tmp_path):
+        from rl_scheduler_tpu.agent import train_ppo as cli
+
+        run_dir = cli.main([
+            "--preset", "quick", "--num-envs", "4", "--rollout-steps", "100",
+            "--minibatch-size", "64", "--hidden", "8,8", "--iterations", "4",
+            "--run-root", str(tmp_path), "--run-name", "eval_test",
+            "--eval-every", "2", "--eval-episodes", "4",
+        ])
+        records = _read_jsonl(run_dir / "metrics.jsonl")
+        evals = [r for r in records if r.get("eval")]
+        assert [r["iteration"] for r in evals] == [2, 4]
+        for r in evals:
+            assert r["eval_episodes_completed"] == 4.0
+            assert "eval_episode_reward_mean" in r
+        # ordering: the eval record lands after the training record of the
+        # iteration it evaluated (the loop flushes pending metrics first)
+        idx_train2 = next(i for i, r in enumerate(records)
+                          if not r.get("eval") and r["iteration"] == 2)
+        idx_eval2 = next(i for i, r in enumerate(records)
+                         if r.get("eval") and r["iteration"] == 2)
+        assert idx_eval2 > idx_train2
+
+    def test_dqn_cli_emits_eval_records(self, tmp_path):
+        from rl_scheduler_tpu.agent import train_dqn as cli
+
+        run_dir = cli.main([
+            "--env", "multi_cloud", "--preset", "config1",
+            "--iterations", "6", "--hidden", "8,8",
+            "--run-root", str(tmp_path), "--run-name", "dqn_eval_test",
+            "--checkpoint-every", "6", "--sync-every", "2",
+            "--eval-every", "3", "--eval-episodes", "2",
+        ])
+        evals = [r for r in _read_jsonl(run_dir / "metrics.jsonl")
+                 if r.get("eval")]
+        assert [r["iteration"] for r in evals] == [3, 6]
+        assert all(r["eval_episodes_completed"] == 2.0 for r in evals)
+
+    def test_final_preset_defaults_to_reference_eval_schedule(self):
+        from rl_scheduler_tpu.agent.presets import PPO_PRESETS
+
+        assert PPO_PRESETS["final"].eval_every == 5
+        assert PPO_PRESETS["final"].eval_episodes == 20
