@@ -18,6 +18,10 @@ from rl_scheduler_tpu.parallel.ring_attention import (
     ring_attention,
     make_flax_attention_fn,
 )
+from rl_scheduler_tpu.parallel.tensor_parallel import (
+    TPActorCritic,
+    make_tensor_parallel_ppo,
+)
 from rl_scheduler_tpu.parallel.distributed import maybe_initialize_distributed
 
 __all__ = [
@@ -26,6 +30,8 @@ __all__ = [
     "make_data_parallel_ppo",
     "make_data_parallel_ppo_bundle",
     "make_seq_parallel_ppo",
+    "make_tensor_parallel_ppo",
+    "TPActorCritic",
     "ring_attention",
     "make_flax_attention_fn",
     "maybe_initialize_distributed",

@@ -2,8 +2,10 @@
 
 A v4-8 exposes 4 chips over ICI; tests simulate 8 CPU devices via
 ``--xla_force_host_platform_device_count=8``. Axis convention:
-``dp`` = data parallel (env batch), ``tp`` = tensor parallel (policy
-weights, used by the transformer/GNN configs).
+``dp`` = data parallel (env batch, ``parallel/sharding.py``),
+``sp`` = sequence parallel (the structured policies' node axis via ring
+attention, ``make_seq_parallel_ppo``), ``tp`` = tensor parallel (wide
+MLP policy weights column/row-sharded, ``parallel/tensor_parallel.py``).
 """
 
 from __future__ import annotations

@@ -1,0 +1,352 @@
+"""Tensor parallelism: policy weights sharded over the ``tp`` mesh axis.
+
+The reference has no model parallelism of any kind (SURVEY.md §2 #17: its
+policy is RLlib's default MLP and its only scale-out is Ray rollout
+actors). This module supplies the TPU-native ``tp`` axis the mesh
+convention reserves (``parallel/mesh.py``): Megatron-style column/row
+sharding of wide MLP torsos under ``shard_map`` —
+
+- **column-parallel** layer: kernel ``[in, H/tp]`` per device, each member
+  computing its slice of the hidden activation (activation fn is
+  elementwise, so it applies locally);
+- **row-parallel** layer: kernel ``[H/tp, out]`` per device, partial
+  products summed with an ICI all-reduce into the replicated output.
+
+The two collective boundary ops are the classic Megatron ``f``/``g``
+functions, expressed as ``jax.custom_vjp`` so LOCAL autodiff inside
+``shard_map`` produces the EXACT global gradient with no post-hoc scaling:
+
+- :func:`copy_to_tp`: forward identity, backward ``psum`` — entering a
+  column-parallel region, the replicated input's cotangent must sum each
+  member's path contribution.
+- :func:`reduce_from_tp`: forward ``psum``, backward identity — leaving a
+  row-parallel region, the replicated output's cotangent passes straight
+  to each member's partial (a raw ``psum``'s transpose is ``psum``, which
+  would overcount by ``tp``).
+
+Gradients of tp-sharded leaves are therefore exact locally (no ``tp``
+collective in the optimizer), and replicated leaves (output heads) get
+identical gradients on every member — so the data-parallel ``pmean`` over
+``dp`` alone is the correct full sync, see :func:`make_tensor_parallel_ppo`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from rl_scheduler_tpu.agent.ppo import (
+    PPOTrainConfig,
+    RunnerState,
+    make_optimizer,
+    make_ppo_bundle,
+)
+from rl_scheduler_tpu.env.bundle import EnvBundle
+from rl_scheduler_tpu.parallel.mesh import make_mesh
+
+
+# ----------------------------------------------------------------- f / g ops
+
+
+def copy_to_tp(x: jnp.ndarray, axis_name: str | None) -> jnp.ndarray:
+    """Forward identity / backward ``psum`` over ``axis_name`` (Megatron
+    ``f``): marks replicated activations entering a column-parallel region."""
+    if axis_name is None:
+        return x
+    return _copy_to_tp(x, axis_name)
+
+
+def reduce_from_tp(x: jnp.ndarray, axis_name: str | None) -> jnp.ndarray:
+    """Forward ``psum`` / backward identity (Megatron ``g``): reassembles a
+    row-parallel region's partial sums into the replicated output."""
+    if axis_name is None:
+        return x
+    return _reduce_from_tp(x, axis_name)
+
+
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _copy_to_tp(x, axis_name):
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (lax.psum(g, axis_name),)
+
+
+_copy_to_tp.defvjp(_copy_fwd, _copy_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _reduce_from_tp(x, axis_name):
+    return lax.psum(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    return (g,)
+
+
+_reduce_from_tp.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+# ------------------------------------------------------------------ modules
+
+
+class TPMLPTorso(nn.Module):
+    """MLP torso with hidden widths sharded over ``tp_axis``.
+
+    ``hidden`` must have even length: consecutive entries form
+    (column-parallel, row-parallel) pairs — the classic Megatron MLP block
+    — so activations re-replicate after every pair. Layer names ``col{i}``
+    / ``row{i}`` / ``row_bias{i}`` are the contract
+    :func:`tp_param_spec_fn` keys off. With ``tp_axis=None`` (and
+    ``tp_size=1``) this is an ordinary full-width MLP computing the exact
+    same function as the sharded one given concatenated weights.
+    """
+
+    hidden: Sequence[int] = (256, 256)
+    activation: str = "tanh"
+    tp_axis: str | None = None
+    tp_size: int = 1
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        if len(self.hidden) % 2:
+            raise ValueError(
+                f"TPMLPTorso needs col/row layer pairs; got odd "
+                f"len(hidden)={len(self.hidden)}"
+            )
+        act = getattr(nn, self.activation)
+        for i in range(0, len(self.hidden), 2):
+            h_col, h_row = self.hidden[i], self.hidden[i + 1]
+            if h_col % self.tp_size:
+                raise ValueError(
+                    f"hidden[{i}]={h_col} not divisible by tp={self.tp_size}"
+                )
+            x = copy_to_tp(x, self.tp_axis)
+            x = act(
+                nn.Dense(
+                    h_col // self.tp_size,
+                    kernel_init=nn.initializers.orthogonal(jnp.sqrt(2)),
+                    dtype=self.dtype,
+                    name=f"col{i // 2}",
+                )(x)
+            )
+            partial = nn.Dense(
+                h_row,
+                use_bias=False,  # bias once, after the reduce — adding it
+                # per member before psum would scale it by tp
+                kernel_init=nn.initializers.orthogonal(jnp.sqrt(2)),
+                dtype=self.dtype,
+                name=f"row{i // 2}",
+            )(x)
+            out = reduce_from_tp(partial, self.tp_axis)
+            bias = self.param(
+                f"row_bias{i // 2}", nn.initializers.zeros, (h_row,), jnp.float32
+            )
+            x = act(out + bias.astype(out.dtype))
+        return x
+
+
+class TPActorCritic(nn.Module):
+    """Actor-critic with tensor-parallel torsos and replicated f32 heads.
+
+    The tp counterpart of ``models.mlp.ActorCritic`` (same separate
+    actor/critic torsos, same head inits); for wide ``hidden`` the torso
+    matmuls dominate, and those are what shard.
+    """
+
+    num_actions: int = 2
+    hidden: Sequence[int] = (256, 256)
+    activation: str = "tanh"
+    tp_axis: str | None = None
+    tp_size: int = 1
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, obs):
+        def torso(name):
+            return TPMLPTorso(
+                self.hidden, self.activation, self.tp_axis, self.tp_size,
+                self.dtype, name=name,
+            )
+
+        pi = torso("actor_torso")(obs)
+        logits = nn.Dense(
+            self.num_actions, kernel_init=nn.initializers.orthogonal(0.01),
+            name="actor_head",
+        )(pi.astype(jnp.float32))
+        v = torso("critic_torso")(obs)
+        value = nn.Dense(
+            1, kernel_init=nn.initializers.orthogonal(1.0), name="critic_head"
+        )(v.astype(jnp.float32))
+        return logits, jnp.squeeze(value, -1)
+
+
+# ---------------------------------------------------------------- sharding
+
+
+def tp_param_spec_fn(tp_axis: str) -> Callable:
+    """Per-leaf PartitionSpec rule for trees carrying TPMLPTorso params
+    (works on the params tree AND on optimizer states mirroring it, since
+    Adam's mu/nu subtrees keep the flax dict paths)."""
+
+    def spec_for(path, leaf) -> P:
+        keys = [getattr(k, "key", getattr(k, "name", "")) for k in path]
+        names = [k for k in keys if isinstance(k, str)]
+        layer = next((n for n in names if n.startswith(("col", "row"))), None)
+        param_name = names[-1] if names else ""
+        if layer is None or getattr(leaf, "ndim", 0) == 0:
+            return P()
+        if layer.startswith("row_bias"):
+            return P()  # applied after the reduce: replicated
+        if layer.startswith("col"):
+            # kernel [in, H/tp] shards its OUTPUT features; bias [H/tp] too
+            return P(None, tp_axis) if param_name == "kernel" else P(tp_axis)
+        # row kernel [H/tp, out] shards its INPUT features (no bias)
+        return P(tp_axis, None)
+
+    return spec_for
+
+
+def _spec_tree(abstract_tree, tp_axis: str):
+    return jax.tree_util.tree_map_with_path(tp_param_spec_fn(tp_axis), abstract_tree)
+
+
+def make_tensor_parallel_ppo(
+    bundle: EnvBundle,
+    cfg: PPOTrainConfig,
+    mesh: Mesh | None = None,
+    dp_axis: str = "dp",
+    tp_axis: str = "tp",
+    net_kwargs: dict | None = None,
+):
+    """PPO over a ``dp x tp`` mesh: env batch over ``dp``, the
+    :class:`TPActorCritic` torso weights over ``tp``.
+
+    Envs and rollout RNG are replicated over tp (keys fold by the dp
+    coordinate only — every tp member steps identical env copies and
+    samples identical actions from the replicated logits); the parameter
+    initialization key ALSO folds by the tp coordinate so weight shards
+    are distinct slices, with replicated leaves (heads, row biases)
+    re-synced to member 0's values.
+
+    Gradient sync is ``pmean`` over ``dp`` only: the custom-vjp boundary
+    ops (module docstring) make tp-sharded leaf gradients exact locally
+    and replicated-leaf gradients identical across tp.
+    """
+    mesh = mesh or make_mesh({dp_axis: -1, tp_axis: 1})
+    ndp = mesh.shape[dp_axis]
+    ntp = mesh.shape[tp_axis]
+    if cfg.num_envs % ndp:
+        raise ValueError(f"num_envs={cfg.num_envs} not divisible by dp={ndp}")
+    if cfg.minibatch_size % ndp:
+        raise ValueError(
+            f"minibatch_size={cfg.minibatch_size} not divisible by dp={ndp}"
+        )
+    if cfg.max_grad_norm is not None and ntp > 1:
+        # optax.clip_by_global_norm would run per tp member on LOCAL shard
+        # grads: each member computes a different (underestimated) norm and
+        # applies a different clip scale to the replicated head leaves,
+        # silently desyncing them across tp. Needs a tp-aware psum'd norm;
+        # refuse rather than corrupt.
+        raise ValueError(
+            "max_grad_norm is not supported on the tensor-parallel path "
+            f"(tp={ntp}): the clip norm would be computed per-shard, "
+            "desyncing replicated parameters across tp members"
+        )
+    local_cfg = dataclasses.replace(
+        cfg, num_envs=cfg.num_envs // ndp, minibatch_size=cfg.minibatch_size // ndp
+    )
+    net_kwargs = dict(net_kwargs or {})
+    if "dtype" not in net_kwargs and cfg.compute_dtype != "float32":
+        # Honor the config knob the same way make_ppo_bundle's default
+        # ActorCritic does (params stay f32; torso matmuls in bf16).
+        net_kwargs["dtype"] = {"bfloat16": jnp.bfloat16}[cfg.compute_dtype]
+    net = TPActorCritic(
+        num_actions=bundle.num_actions, hidden=cfg.hidden,
+        tp_axis=tp_axis, tp_size=ntp, **net_kwargs,
+    )
+    init_fn, update_fn, net = make_ppo_bundle(
+        bundle, local_cfg, net=net, axis_name=dp_axis
+    )
+    tx = make_optimizer(local_cfg)
+
+    # Spec trees come from a structure probe: the UNSHARDED twin module has
+    # the identical param tree structure (only leaf shapes differ), and
+    # eval_shape needs no mesh because it runs no collectives.
+    probe = TPActorCritic(
+        num_actions=bundle.num_actions, hidden=cfg.hidden,
+        tp_axis=None, tp_size=1, **(net_kwargs or {}),
+    )
+    dummy = jnp.zeros((1, *bundle.obs_shape), jnp.float32)
+    abstract_params = jax.eval_shape(
+        lambda k: probe.init(k, dummy), jax.random.PRNGKey(0)
+    )
+    abstract_opt = jax.eval_shape(tx.init, abstract_params)
+    param_specs = _spec_tree(abstract_params, tp_axis)
+    opt_specs = _spec_tree(abstract_opt, tp_axis)
+    specs = RunnerState(
+        params=param_specs,
+        opt_state=opt_specs,
+        env_state=P(dp_axis),
+        obs=P(dp_axis),
+        key=P(dp_axis),
+        ep_return=P(dp_axis),
+        update_idx=P(),
+    )
+    is_replicated = jax.tree.map(lambda s: s == P(), param_specs)
+
+    def local_init(key):
+        dp_key = jax.random.fold_in(key, lax.axis_index(dp_axis))
+        r = init_fn(dp_key)
+        # Re-init params with a tp-distinct key (shards must be DIFFERENT
+        # slices of the logical matrix, not tp copies of one block), then
+        # broadcast member 0's values back onto the replicated leaves.
+        tp_key = jax.random.fold_in(
+            jax.random.fold_in(key, 7), lax.axis_index(tp_axis)
+        )
+        params = net.init(tp_key, dummy)
+
+        def sync_replicated(leaf, rep):
+            if not rep:
+                return leaf
+            return lax.index_in_dim(
+                lax.all_gather(leaf, tp_axis), 0, keepdims=False
+            )
+
+        params = jax.tree.map(sync_replicated, params, is_replicated)
+        return r._replace(
+            params=params, opt_state=tx.init(params), key=r.key[None]
+        )
+
+    def local_update(runner: RunnerState):
+        r = runner._replace(key=runner.key[0])
+        r, metrics = update_fn(r)
+        return r._replace(key=r.key[None]), metrics
+
+    sharded_init = jax.shard_map(
+        local_init, mesh=mesh, in_specs=P(), out_specs=specs, check_vma=False
+    )
+    sharded_update = jax.shard_map(
+        local_update, mesh=mesh, in_specs=(specs,), out_specs=(specs, P()),
+        check_vma=False,
+    )
+    return sharded_init, sharded_update, net
