@@ -363,24 +363,35 @@ def dqn_train(
     checkpoint_fn: Callable[[int, DQNRunnerState], None] | None = None,
     sync_every: int = 1,
     eval_log_fn: Callable[[int, dict], None] | None = None,
+    debug_checks: bool = False,
+    updates_per_dispatch: int = 1,
 ):
     """Host-side training loop mirroring :func:`rl_scheduler_tpu.agent.ppo.ppo_train`.
 
     ``sync_every`` batches device->host metric fetches exactly as in
-    ``ppo_train`` — essential here, since a DQN iteration is tiny and a
-    per-iteration sync round-trip (~100 ms on a remote/tunneled
-    accelerator) would dwarf the update itself.
+    ``ppo_train``; ``updates_per_dispatch=k`` goes further and fuses ``k``
+    whole iterations into ONE dispatched program (``lax.scan`` over the
+    update), amortizing Python/dispatch overhead — the lever for config 1,
+    whose per-iteration compute is microseconds. Metrics for every fused
+    iteration are still logged individually (stacked in-program, unstacked
+    by the loop).
+
+    ``debug_checks=True`` checkifies the update (``utils/debug.py``): the
+    first NaN/zero-division/out-of-bounds index raises with the failing op
+    named instead of silently corrupting training. Slower; for debugging.
+    Incompatible with ``updates_per_dispatch > 1`` (checkify must observe
+    each iteration's error state before the next dispatches).
 
     With ``cfg.eval_every > 0``, a greedy (epsilon=0) evaluation of
     ``cfg.eval_episodes`` episodes runs every ``cfg.eval_every`` iterations
     and reports through ``eval_log_fn`` (see ``ppo_train``).
     """
-    from rl_scheduler_tpu.agent.loop import run_train_loop
+    from rl_scheduler_tpu.agent.loop import make_update, run_train_loop
     from rl_scheduler_tpu.agent.ppo import make_greedy_eval_hook
 
     init_fn, update_fn, net = make_dqn(bundle, cfg)
     runner = jax.jit(init_fn)(jax.random.PRNGKey(seed))
-    update = jax.jit(update_fn, donate_argnums=0)
+    update = make_update(update_fn, debug_checks, updates_per_dispatch)
     eval_hook = make_greedy_eval_hook(
         bundle, net, cfg.eval_every, cfg.eval_episodes, seed, eval_log_fn
     )
@@ -388,4 +399,5 @@ def dqn_train(
         update, runner, 0, num_iterations,
         sync_every=sync_every, log_fn=log_fn, checkpoint_fn=checkpoint_fn,
         eval_every=cfg.eval_every, eval_hook=eval_hook,
+        updates_per_dispatch=updates_per_dispatch,
     )

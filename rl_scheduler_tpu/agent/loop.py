@@ -37,6 +37,7 @@ def run_train_loop(
     checkpoint_fn: Callable[[int, Any], None] | None = None,
     eval_every: int = 0,
     eval_hook: Callable[[int, Any], None] | None = None,
+    updates_per_dispatch: int = 1,
 ) -> tuple[Any, list[dict]]:
     """Run ``update`` for iterations ``[start_iteration, num_iterations)``.
 
@@ -46,11 +47,25 @@ def run_train_loop(
     metrics are flushed first so the hook's own log records land after the
     iterations they evaluate.
 
+    ``updates_per_dispatch=k > 1`` declares that ``update`` fuses ``k``
+    training iterations into ONE dispatched program (``lax.scan`` inside
+    jit, see ``dqn_train``) and returns metrics with a leading ``[k]``
+    stack axis; the loop advances ``k`` iterations per call and unstacks
+    per-iteration metrics. This amortizes the per-dispatch host/tunnel
+    round-trip that dominates tiny updates. The iteration span must
+    divide by ``k``; checkpoint/eval hooks fire at dispatch boundaries
+    (pass every-values that are multiples of ``k``).
+
     Returns ``(final_runner, history)`` where history holds one float dict
     per iteration (plus the synthetic ``wall_time`` key described above).
     """
     history: list[dict] = []
-    pending: list[tuple[int, dict]] = []
+    # Each pending entry is (first_iteration, metrics, k): with k > 1 the
+    # metrics leaves carry a leading [k] stack axis covering iterations
+    # [first, first + k). Unstacking happens AFTER device_get, in numpy —
+    # slicing device arrays per iteration would issue thousands of tiny
+    # device ops and eat the fused dispatch's win.
+    pending: list[tuple[int, dict, int]] = []
     t0 = time.perf_counter()
     last_flush_elapsed = 0.0
 
@@ -62,23 +77,43 @@ def run_train_loop(
         # (or device_get) raises mid-burst, the finally-flush must not
         # re-fetch and re-emit iterations that were already logged.
         burst_items, pending[:] = list(pending), []
-        fetched = jax.device_get([m for _, m in burst_items])
+        fetched = jax.device_get([m for _, m, _ in burst_items])
         now = time.perf_counter() - t0
         prev = last_flush_elapsed
         last_flush_elapsed = now
-        burst = len(burst_items)
-        for n, ((j, _), vals) in enumerate(zip(burst_items, fetched), 1):
-            vals = {k: float(v) for k, v in vals.items()}
-            vals["wall_time"] = prev + (now - prev) * n / burst
-            history.append(vals)
-            if log_fn is not None:
-                log_fn(j, vals)
+        total = sum(kk for _, _, kk in burst_items)
+        n = 0
+        for (j0, _, kk), vals in zip(burst_items, fetched):
+            for j in range(kk):
+                n += 1
+                row = {
+                    k: float(v[j] if kk > 1 else v) for k, v in vals.items()
+                }
+                row["wall_time"] = prev + (now - prev) * n / total
+                history.append(row)
+                if log_fn is not None:
+                    log_fn(j0 + j, row)
 
+    k = max(1, updates_per_dispatch)
+    if (num_iterations - start_iteration) % k:
+        raise ValueError(
+            f"iteration span {num_iterations - start_iteration} not "
+            f"divisible by updates_per_dispatch={k}"
+        )
+    if eval_every > 0 and eval_hook is not None and eval_every % k:
+        # The loop only observes iteration boundaries at dispatch ends;
+        # a non-multiple interval would silently skip evals.
+        raise ValueError(
+            f"eval_every={eval_every} not divisible by "
+            f"updates_per_dispatch={k}; evals would be silently dropped"
+        )
     try:
-        for i in range(start_iteration, num_iterations):
+        for i0 in range(start_iteration, num_iterations, k):
             runner, metrics = update(runner)
-            pending.append((i, metrics))
-            if len(pending) >= max(1, sync_every) or i + 1 == num_iterations:
+            pending.append((i0, metrics, k))
+            i = i0 + k - 1
+            covered = sum(kk for _, _, kk in pending)
+            if covered >= max(1, sync_every) or i + 1 == num_iterations:
                 flush()
             if checkpoint_fn is not None:
                 checkpoint_fn(i, runner)
@@ -146,6 +181,41 @@ def make_jsonl_log_fn(
             print_line(i, sps, metrics)
 
     return log_fn
+
+
+def make_update(
+    update_fn: Callable[[Any], tuple[Any, dict]],
+    debug_checks: bool = False,
+    updates_per_dispatch: int = 1,
+) -> Callable[[Any], tuple[Any, dict]]:
+    """Compile a trainer's pure ``update_fn`` for the host loop — shared by
+    PPO and DQN so the checkify/fusion rules live once.
+
+    ``debug_checks`` checkifies (``utils/debug.py``); ``updates_per_dispatch
+    = k > 1`` wraps ``k`` iterations in ``lax.scan`` inside one jit (metrics
+    stacked, see ``run_train_loop``). The two are incompatible: checkify
+    raises per dispatch, so fused iterations would report a stale/merged
+    error state.
+    """
+    if debug_checks and updates_per_dispatch > 1:
+        raise ValueError(
+            "debug_checks is incompatible with updates_per_dispatch > 1: "
+            "checkify raises per dispatch, so fused iterations would "
+            "report a stale/merged error state"
+        )
+    if debug_checks:
+        from rl_scheduler_tpu.utils.debug import checkified_update
+
+        return checkified_update(update_fn)
+    if updates_per_dispatch > 1:
+        def fused(runner):
+            return jax.lax.scan(
+                lambda r, _: update_fn(r), runner, None,
+                length=updates_per_dispatch,
+            )
+
+        return jax.jit(fused, donate_argnums=0)
+    return jax.jit(update_fn, donate_argnums=0)
 
 
 def print_eval_line(i: int, metrics: dict) -> None:

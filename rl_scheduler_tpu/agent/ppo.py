@@ -427,8 +427,18 @@ def ppo_train(
     debug_checks: bool = False,
     sync_every: int = 1,
     eval_log_fn: Callable[[int, dict], None] | None = None,
+    updates_per_dispatch: int = 1,
 ):
     """Host-side training loop: jitted update per iteration + logging hooks.
+
+    ``updates_per_dispatch=k`` fuses ``k`` whole PPO iterations into ONE
+    dispatched program (``lax.scan`` over the update; metrics stacked and
+    unstacked by the loop). This removes the per-iteration Python dispatch
+    and device round-trip — the dominant cost for small configs like
+    tpu64, where the update's compute is far below the ~10 ms fixed
+    dispatch overhead measured through a tunneled TPU. The iteration span
+    must divide by ``k``; checkpoint/eval intervals should be multiples
+    of ``k``. Incompatible with ``debug_checks``.
 
     With ``cfg.eval_every > 0``, a greedy ``cfg.eval_episodes``-episode
     evaluation runs every ``cfg.eval_every`` iterations (reference
@@ -486,21 +496,18 @@ def ppo_train(
             opt_state=tree["opt_state"],
             update_idx=jnp.asarray(start_iteration, jnp.int32),
         )
-    if debug_checks:
-        from rl_scheduler_tpu.utils.debug import checkified_update
+    from rl_scheduler_tpu.agent.loop import make_update, run_train_loop
 
-        update = checkified_update(update_fn)
-    else:
-        update = jax.jit(update_fn, donate_argnums=0)
+    update = make_update(update_fn, debug_checks, updates_per_dispatch)
     eval_hook = make_greedy_eval_hook(
         bundle, net, cfg.eval_every, cfg.eval_episodes, seed, eval_log_fn
     )
-    from rl_scheduler_tpu.agent.loop import run_train_loop
 
     return run_train_loop(
         update, runner, start_iteration, num_iterations,
         sync_every=sync_every, log_fn=log_fn, checkpoint_fn=checkpoint_fn,
         eval_every=cfg.eval_every, eval_hook=eval_hook,
+        updates_per_dispatch=updates_per_dispatch,
     )
 
 

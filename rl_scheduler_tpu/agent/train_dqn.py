@@ -77,6 +77,19 @@ def main(argv: list[str] | None = None) -> Path:
                         "transfer; a DQN iteration is tiny, so per-iteration "
                         "syncing (~100 ms round-trip on a tunneled "
                         "accelerator) would dominate the run")
+    p.add_argument("--updates-per-dispatch", type=int, default=1,
+                   help="fuse K whole iterations into one jitted dispatch "
+                        "(lax.scan over the update). sync-every only batches "
+                        "metric FETCHES; this also removes the per-iteration "
+                        "Python dispatch, the config-1 bottleneck. iterations "
+                        "and checkpoint/eval intervals should be multiples "
+                        "of K")
+    p.add_argument("--debug-checks", action="store_true",
+                   help="checkify the update: raise on the first NaN/"
+                        "zero-division/out-of-bounds index instead of "
+                        "silently corrupting training (slower; for "
+                        "debugging; incompatible with "
+                        "--updates-per-dispatch > 1)")
     args = p.parse_args(argv)
 
     cfg = DQN_PRESETS[args.preset]
@@ -143,7 +156,9 @@ def main(argv: list[str] | None = None) -> Path:
     dqn_train(bundle, cfg, args.iterations, seed=args.seed,
               log_fn=log_fn, checkpoint_fn=checkpoint_fn,
               sync_every=args.sync_every,
-              eval_log_fn=make_eval_log_fn(metrics_file, tb))
+              eval_log_fn=make_eval_log_fn(metrics_file, tb),
+              debug_checks=args.debug_checks,
+              updates_per_dispatch=args.updates_per_dispatch)
     metrics_file.close()
     if tb is not None:
         tb.close()

@@ -128,6 +128,13 @@ def main(argv: list[str] | None = None) -> Path:
                         "transfer (prints then arrive in bursts of N); raise "
                         "on remote/tunneled accelerators where every sync "
                         "costs a network round-trip")
+    p.add_argument("--updates-per-dispatch", type=int, default=1,
+                   help="fuse K whole PPO iterations into one jitted "
+                        "dispatch (lax.scan over the update); removes the "
+                        "per-iteration dispatch round-trip that dominates "
+                        "small configs (tpu64). iterations and checkpoint/"
+                        "eval intervals should be multiples of K; "
+                        "incompatible with --debug-checks")
     p.add_argument("--debug-checks", action="store_true",
                    help="checkify the update: raise on the first NaN/"
                         "zero-division/out-of-bounds index instead of "
@@ -340,7 +347,8 @@ def main(argv: list[str] | None = None) -> Path:
         ppo_train(bundle, cfg, args.iterations, seed=args.seed, net=net,
                   log_fn=log_fn, checkpoint_fn=checkpoint_fn, restore=restore,
                   debug_checks=args.debug_checks, sync_every=args.sync_every,
-                  eval_log_fn=make_eval_log_fn(metrics_file, tb))
+                  eval_log_fn=make_eval_log_fn(metrics_file, tb),
+                  updates_per_dispatch=args.updates_per_dispatch)
     metrics_file.close()
     if tb is not None:
         tb.close()

@@ -33,6 +33,7 @@ identical gradients on every member — so the data-parallel ``pmean`` over
 from __future__ import annotations
 
 import dataclasses
+from functools import partial as _partial
 from typing import Any, Callable, Sequence
 
 import flax.linen as nn
@@ -70,10 +71,7 @@ def reduce_from_tp(x: jnp.ndarray, axis_name: str | None) -> jnp.ndarray:
     return _reduce_from_tp(x, axis_name)
 
 
-from functools import partial
-
-
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
+@_partial(jax.custom_vjp, nondiff_argnums=(1,))
 def _copy_to_tp(x, axis_name):
     return x
 
@@ -89,7 +87,7 @@ def _copy_bwd(axis_name, _, g):
 _copy_to_tp.defvjp(_copy_fwd, _copy_bwd)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
+@_partial(jax.custom_vjp, nondiff_argnums=(1,))
 def _reduce_from_tp(x, axis_name):
     return lax.psum(x, axis_name)
 
@@ -149,7 +147,7 @@ class TPMLPTorso(nn.Module):
                     name=f"col{i // 2}",
                 )(x)
             )
-            partial = nn.Dense(
+            partial_out = nn.Dense(
                 h_row,
                 use_bias=False,  # bias once, after the reduce — adding it
                 # per member before psum would scale it by tp
@@ -157,7 +155,7 @@ class TPMLPTorso(nn.Module):
                 dtype=self.dtype,
                 name=f"row{i // 2}",
             )(x)
-            out = reduce_from_tp(partial, self.tp_axis)
+            out = reduce_from_tp(partial_out, self.tp_axis)
             bias = self.param(
                 f"row_bias{i // 2}", nn.initializers.zeros, (h_row,), jnp.float32
             )

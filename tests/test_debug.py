@@ -50,3 +50,25 @@ def test_out_of_bounds_gather_raises():
     update = checkified_update(bad_gather, donate=False)
     with pytest.raises(checkify.JaxRuntimeError):
         update({"t": jnp.arange(4.0)})
+
+
+def test_dqn_clean_training_passes_checks():
+    """--debug-checks parity for the DQN path (VERDICT r1 weak #4)."""
+    from rl_scheduler_tpu.agent.dqn import DQNConfig, dqn_train
+    from rl_scheduler_tpu.env.bundle import multi_cloud_bundle
+
+    cfg = DQNConfig(num_envs=4, collect_steps=4, buffer_size=256,
+                    batch_size=16, learning_starts=16, hidden=(8, 8))
+    _, history = dqn_train(multi_cloud_bundle(), cfg, 8, seed=0,
+                           debug_checks=True)
+    assert len(history) == 8
+    assert np.isfinite(history[-1]["loss"])
+
+
+def test_dqn_debug_checks_reject_fused_dispatch():
+    from rl_scheduler_tpu.agent.dqn import DQNConfig, dqn_train
+    from rl_scheduler_tpu.env.bundle import multi_cloud_bundle
+
+    with pytest.raises(ValueError, match="updates_per_dispatch"):
+        dqn_train(multi_cloud_bundle(), DQNConfig(), 4,
+                  debug_checks=True, updates_per_dispatch=2)

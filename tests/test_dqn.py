@@ -144,6 +144,44 @@ def test_dqn_on_single_cluster_env():
     assert all(np.isfinite(h["loss"]) for h in history)
 
 
+def test_fused_dispatch_matches_sequential():
+    """lax.scan-fused iterations are the SAME math as one-by-one dispatch
+    (RNG and buffer state carry in the runner), so metrics must match."""
+    bundle = single_cluster_bundle()
+    cfg = DQNConfig(num_envs=2, collect_steps=4, buffer_size=256,
+                    batch_size=16, learning_starts=16, hidden=(8, 8))
+    _, h_seq = dqn_train(bundle, cfg, num_iterations=8, seed=5)
+    _, h_fused = dqn_train(bundle, cfg, num_iterations=8, seed=5,
+                           updates_per_dispatch=4)
+    assert len(h_fused) == 8
+    for a, b in zip(h_seq, h_fused):
+        assert a["loss"] == pytest.approx(b["loss"], rel=1e-5)
+        assert a["epsilon"] == pytest.approx(b["epsilon"], rel=1e-6)
+        assert a["buffer_size"] == b["buffer_size"]
+
+
+def test_fused_dispatch_rejects_indivisible_span():
+    bundle = single_cluster_bundle()
+    cfg = DQNConfig(num_envs=1, collect_steps=2, buffer_size=64, batch_size=8)
+    with pytest.raises(ValueError, match="not"):
+        dqn_train(bundle, cfg, num_iterations=7, updates_per_dispatch=4)
+
+
+def test_train_dqn_cli_fused_dispatch(tmp_path):
+    import json
+
+    from rl_scheduler_tpu.agent import train_dqn as cli
+
+    run_dir = cli.main([
+        "--preset", "config1", "--iterations", "8",
+        "--run-root", str(tmp_path), "--run-name", "dqn_fused",
+        "--checkpoint-every", "8", "--hidden", "8,8",
+        "--updates-per-dispatch", "4", "--sync-every", "4",
+    ])
+    lines = [json.loads(l) for l in (run_dir / "metrics.jsonl").open()]
+    assert len(lines) == 8 and lines[-1]["iteration"] == 8
+
+
 def test_train_dqn_cli_writes_checkpoints_and_metrics(tmp_path):
     import json
 

@@ -46,6 +46,21 @@ def test_train_deterministic(env_params):
     )
 
 
+def test_fused_dispatch_matches_sequential(env_params):
+    """updates_per_dispatch is pure dispatch plumbing: the scanned
+    iterations must reproduce the one-by-one metrics exactly."""
+    _, h_seq = ppo_train(env_params, SMOKE_CFG, 4, seed=7)
+    _, h_fused = ppo_train(env_params, SMOKE_CFG, 4, seed=7,
+                           updates_per_dispatch=2)
+    assert len(h_fused) == 4
+    for a, b in zip(h_seq, h_fused):
+        assert a["policy_loss"] == pytest.approx(b["policy_loss"], rel=1e-5)
+        assert a["reward_mean"] == pytest.approx(b["reward_mean"], rel=1e-6)
+    with pytest.raises(ValueError, match="updates_per_dispatch"):
+        ppo_train(env_params, SMOKE_CFG, 4, debug_checks=True,
+                  updates_per_dispatch=2)
+
+
 def greedy_row_accuracy(runner, env_params, hidden) -> float:
     """Fraction of table rows where the learned greedy action matches the
     per-row optimum (argmin of 0.6*cost + 0.4*latency)."""
