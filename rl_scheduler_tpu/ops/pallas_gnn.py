@@ -1,0 +1,440 @@
+"""Fused Pallas TPU kernel for the whole GNN policy forward AND backward.
+
+WHY: the config-5 profile (docs/status.md) showed the GNN PPO update is
+bandwidth-bound — per-minibatch cost is linear in batch and width because
+XLA materializes every ``[B, N, dim]`` activation in HBM between layers
+(~0.8 GB of activation traffic per 65536-row minibatch; fused-matmul,
+remat, and minibatch-size variants all measured neutral or worse). The
+TPU-native fix is to keep the activations in VMEM across ALL layers: one
+kernel computes embed -> GCN convs -> pointer/value heads per row block,
+touching HBM once for the observations in and once for logits/value out.
+
+HOW: flattening the node axis into features turns the GCN into a plain
+MLP with Kronecker-structured weights, so the kernel is pure 2D matmuls
+(MXU-shaped, no batched/3D ops):
+
+    h'_i = relu(h_i W_self + sum_j A_hat[i,j] h_j W_nbr)      (per node i)
+    <=>  H' = relu(H_flat @ W_big + b_big)                    (flat [B, N*dim])
+    with W_big = kron(I_N, W_self) + kron(A_hat^T, W_nbr)
+
+The big matrices are rebuilt from the small checkpoint parameters by XLA
+on every call (microseconds: N*dim = 512 wide), and the backward kernel
+recomputes the forward from the obs block in VMEM (in-kernel remat) then
+accumulates the BIG weight gradients across the sequential TPU grid;
+plain einsum contractions outside the kernel map them back to the small
+parameters (the transpose of the kron construction). Wrapped in
+``jax.custom_vjp``, so the PPO loss differentiates straight through.
+
+Parity: numerically equivalent (f32) to ``models.gnn.GNNPolicy`` — same
+parameter tree, tested for forward and gradient agreement. Runs in
+interpret mode on CPU so tests cover the same code path without a TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Rows per grid step. VMEM: ~10 live [blk, N*dim] f32 activations plus the
+# weights and grad accumulators; 256 rows x 512 features keeps the backward
+# kernel around 10 MB of the ~16 MB budget.
+DEFAULT_BLOCK_B = 256
+
+def _make_mm(compute_dtype):
+    """Matmul helpers with f32 accumulation; ``compute_dtype=bfloat16``
+    feeds the MXU its native precision (the kron-flattened weights are 4x
+    the structural FLOPs, so matmul rate — not bandwidth — bounds the
+    fused kernel; bf16 params/grads still live in f32)."""
+
+    def mm(a, b):
+        return jnp.dot(a.astype(compute_dtype), b.astype(compute_dtype),
+                       preferred_element_type=jnp.float32)
+
+    def mm_t_left(a, b):
+        # ``a^T @ b`` contracting the leading (row/batch) axis — MXU-shaped
+        # without materializing a transpose.
+        return jax.lax.dot_general(
+            a.astype(compute_dtype), b.astype(compute_dtype),
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+
+    return mm, mm_t_left
+
+
+# --------------------------------------------------------------- kernels
+
+
+def _fwd_kernel(obs_ref, we_ref, be_ref, w1_ref, b1_ref, w2_ref, b2_ref,
+                w3_ref, b3_ref, wsc_ref, bsc_ref, pool_ref, wv1_ref, bv1_ref,
+                wv2_ref, bv2_ref, logits_ref, value_ref, *, depth: int,
+                compute_dtype):
+    _MM, _ = _make_mm(compute_dtype)
+    # Heads stay f32 regardless of compute_dtype, mirroring GNNPolicy's
+    # "heads stay f32" contract (models/gnn.py casts h to f32 before the
+    # head) — the near-zero-init pointer logits and value targets are
+    # precision-sensitive.
+    _MMH, _ = _make_mm(jnp.float32)
+    x = obs_ref[:]
+    h = jnp.maximum(_MM(x, we_ref[:]) + be_ref[:], 0.0)
+    conv_w = (w1_ref, w2_ref, w3_ref)[:depth]
+    conv_b = (b1_ref, b2_ref, b3_ref)[:depth]
+    for w, b in zip(conv_w, conv_b):
+        h = jnp.maximum(_MM(h, w[:]) + b[:], 0.0)
+    logits_ref[:] = _MMH(h, wsc_ref[:]) + bsc_ref[:]
+    pooled = _MMH(h, pool_ref[:])
+    v1 = jnp.tanh(_MMH(pooled, wv1_ref[:]) + bv1_ref[:])
+    value_ref[:] = _MMH(v1, wv2_ref[:]) + bv2_ref[:]
+
+
+def _bwd_kernel(obs_ref, we_ref, be_ref, w1_ref, b1_ref, w2_ref, b2_ref,
+                w3_ref, b3_ref, wsc_ref, bsc_ref, pool_ref, wv1_ref, bv1_ref,
+                wv2_ref, bv2_ref, dlogits_ref, dvalue_ref,
+                dwe_ref, dbe_ref, dw1_ref, db1_ref, dw2_ref, db2_ref,
+                dw3_ref, db3_ref, dwsc_ref, dbsc_ref, dwv1_ref, dbv1_ref,
+                dwv2_ref, dbv2_ref, *, depth: int, compute_dtype):
+    _MM, _dotT_left = _make_mm(compute_dtype)
+    # Head math stays f32 (see _fwd_kernel).
+    _MMH, _dotT_leftH = _make_mm(jnp.float32)
+    # Zero the accumulators on the first grid step; TPU grid steps run
+    # sequentially on the core, so plain += accumulation is race-free.
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        for ref in (dwe_ref, dbe_ref, dw1_ref, db1_ref, dw2_ref, db2_ref,
+                    dw3_ref, db3_ref, dwsc_ref, dbsc_ref, dwv1_ref, dbv1_ref,
+                    dwv2_ref, dbv2_ref):
+            ref[:] = jnp.zeros_like(ref)
+
+    # Recompute the forward for this block entirely in VMEM (in-kernel
+    # remat: re-reading stored activations from HBM is what made the XLA
+    # path bandwidth-bound in the first place).
+    x = obs_ref[:]
+    h0 = jnp.maximum(_MM(x, we_ref[:]) + be_ref[:], 0.0)
+    conv_w = (w1_ref, w2_ref, w3_ref)[:depth]
+    conv_b = (b1_ref, b2_ref, b3_ref)[:depth]
+    hs = [h0]
+    for w, b in zip(conv_w, conv_b):
+        hs.append(jnp.maximum(_MM(hs[-1], w[:]) + b[:], 0.0))
+    h_last = hs[-1]
+    pooled = _MMH(h_last, pool_ref[:])
+    v1 = jnp.tanh(_MMH(pooled, wv1_ref[:]) + bv1_ref[:])
+
+    dlogits = dlogits_ref[:]
+    dvalue = dvalue_ref[:]
+
+    # Value head.
+    dwv2_ref[:] += _dotT_leftH(v1, dvalue)
+    dbv2_ref[:] += jnp.sum(dvalue, axis=0, keepdims=True)
+    dv1 = _MMH(dvalue, wv2_ref[:].T)
+    dzv1 = dv1 * (1.0 - v1 * v1)
+    dwv1_ref[:] += _dotT_leftH(pooled, dzv1)
+    dbv1_ref[:] += jnp.sum(dzv1, axis=0, keepdims=True)
+    dpooled = _MMH(dzv1, wv1_ref[:].T)
+
+    # Pointer head + pool both feed the last hidden state.
+    dwsc_ref[:] += _dotT_leftH(h_last, dlogits)
+    dbsc_ref[:] += jnp.sum(dlogits, axis=0, keepdims=True)
+    dh = _MMH(dlogits, wsc_ref[:].T) + _MMH(dpooled, pool_ref[:].T)
+
+    # Conv stack, walked backwards.
+    dw_refs = (dw1_ref, dw2_ref, dw3_ref)[:depth]
+    db_refs = (db1_ref, db2_ref, db3_ref)[:depth]
+    for i in range(depth - 1, -1, -1):
+        dz = dh * (hs[i + 1] > 0.0)
+        dw_refs[i][:] += _dotT_left(hs[i], dz)
+        db_refs[i][:] += jnp.sum(dz, axis=0, keepdims=True)
+        dh = _MM(dz, conv_w[i][:].T)
+
+    dz0 = dh * (h0 > 0.0)
+    dwe_ref[:] += _dotT_left(x, dz0)
+    dbe_ref[:] += jnp.sum(dz0, axis=0, keepdims=True)
+
+
+# ------------------------------------------------- weight (de)flattening
+
+
+def _big_weights(p: dict, norm_adj: jnp.ndarray, num_nodes: int, depth: int):
+    """Small checkpoint params -> the flat-MLP weight list (f32)."""
+    eye = jnp.eye(num_nodes, dtype=jnp.float32)
+    ones = jnp.ones((num_nodes, 1), jnp.float32)
+
+    def kron(m, w):
+        return jnp.kron(m, w.astype(jnp.float32))
+
+    we = kron(eye, p["embed"]["kernel"])
+    be = jnp.tile(p["embed"]["bias"].astype(jnp.float32), num_nodes)[None, :]
+    convs = []
+    for i in range(depth):
+        c = p[f"conv_{i}"]
+        w_big = kron(eye, c["w_self"]["kernel"]) + kron(
+            norm_adj.T, c["w_nbr"]["kernel"]
+        )
+        b_big = jnp.tile(
+            (c["w_self"]["bias"] + c["w_nbr"]["bias"]).astype(jnp.float32),
+            num_nodes,
+        )[None, :]
+        convs.append((w_big, b_big))
+    head = p["head"]
+    wsc = kron(eye, head["score_head"]["kernel"])          # [N*dim, N]
+    bsc = jnp.tile(head["score_head"]["bias"].astype(jnp.float32),
+                   num_nodes)[None, :]
+    dim = p["embed"]["kernel"].shape[1]
+    pool = kron(ones, jnp.eye(dim, dtype=jnp.float32)) / num_nodes  # [N*dim, dim]
+    wv1 = head["value_hidden"]["kernel"].astype(jnp.float32)
+    bv1 = head["value_hidden"]["bias"].astype(jnp.float32)[None, :]
+    wv2 = head["value_head"]["kernel"].astype(jnp.float32)
+    bv2 = head["value_head"]["bias"].astype(jnp.float32)[None, :]
+    return we, be, convs, wsc, bsc, pool, wv1, bv1, wv2, bv2
+
+
+def _small_grads(p: dict, big: dict, norm_adj: jnp.ndarray, num_nodes: int,
+                 depth: int) -> dict:
+    """Contract big-matrix cotangents back to the checkpoint param tree
+    (the transpose of the kron construction in :func:`_big_weights`)."""
+    n = num_nodes
+    dim = p["embed"]["kernel"].shape[1]
+    feat = p["embed"]["kernel"].shape[0]
+
+    def like(ref, x):
+        return x.astype(ref.dtype)
+
+    g_embed = big["dwe"].reshape(n, feat, n, dim)
+    out = {
+        "embed": {
+            "kernel": like(p["embed"]["kernel"],
+                           jnp.einsum("iaic->ac", g_embed)),
+            "bias": like(p["embed"]["bias"],
+                         big["dbe"].reshape(n, dim).sum(0)),
+        },
+        "head": {
+            "score_head": {
+                "kernel": like(
+                    p["head"]["score_head"]["kernel"],
+                    jnp.einsum(
+                        "iai->a", big["dwsc"].reshape(n, dim, n)
+                    )[:, None],
+                ),
+                "bias": like(p["head"]["score_head"]["bias"],
+                             big["dbsc"].sum()[None]),
+            },
+            "value_hidden": {
+                "kernel": like(p["head"]["value_hidden"]["kernel"], big["dwv1"]),
+                "bias": like(p["head"]["value_hidden"]["bias"], big["dbv1"][0]),
+            },
+            "value_head": {
+                "kernel": like(p["head"]["value_head"]["kernel"], big["dwv2"]),
+                "bias": like(p["head"]["value_head"]["bias"], big["dbv2"][0]),
+            },
+        },
+    }
+    for i in range(depth):
+        g = big["dconv"][i].reshape(n, dim, n, dim)
+        db = big["dbconv"][i].reshape(n, dim).sum(0)
+        c = p[f"conv_{i}"]
+        out[f"conv_{i}"] = {
+            "w_self": {
+                "kernel": like(c["w_self"]["kernel"],
+                               jnp.einsum("iaic->ac", g)),
+                "bias": like(c["w_self"]["bias"], db),
+            },
+            "w_nbr": {
+                "kernel": like(c["w_nbr"]["kernel"],
+                               jnp.einsum("ij,jaic->ac", norm_adj, g)),
+                "bias": like(c["w_nbr"]["bias"], db),
+            },
+        }
+    return out
+
+
+# ------------------------------------------------------------ entry point
+
+
+def _full_spec():
+    return pl.BlockSpec(memory_space=pltpu.VMEM)
+
+
+def _run_forward(weights, obs_flat, num_nodes, depth, block_b, interpret,
+                 compute_dtype):
+    b, flat_in = obs_flat.shape
+    we, be, convs, wsc, bsc, pool, wv1, bv1, wv2, bv2 = weights
+    width = we.shape[1]
+    # depth < 3 still passes three conv slots (static kernel signature);
+    # pad with unused dummies.
+    cw = [c[0] for c in convs] + [jnp.zeros((width, width), jnp.float32)] * (3 - depth)
+    cb = [c[1] for c in convs] + [jnp.zeros((1, width), jnp.float32)] * (3 - depth)
+    row_spec = pl.BlockSpec((block_b, flat_in), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    logits, value = pl.pallas_call(
+        functools.partial(_fwd_kernel, depth=depth,
+                          compute_dtype=compute_dtype),
+        grid=(b // block_b,),
+        in_specs=[row_spec] + [_full_spec()] * 15,
+        out_specs=[
+            pl.BlockSpec((block_b, num_nodes), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_b, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, num_nodes), jnp.float32),
+            jax.ShapeDtypeStruct((b, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(obs_flat, we, be, cw[0], cb[0], cw[1], cb[1], cw[2], cb[2],
+      wsc, bsc, pool, wv1, bv1, wv2, bv2)
+    return logits, value
+
+
+def _run_backward(weights, obs_flat, dlogits, dvalue, num_nodes, depth,
+                  block_b, interpret, compute_dtype):
+    b, flat_in = obs_flat.shape
+    we, be, convs, wsc, bsc, pool, wv1, bv1, wv2, bv2 = weights
+    width = we.shape[1]
+    dim = wv1.shape[0]
+    cw = [c[0] for c in convs] + [jnp.zeros((width, width), jnp.float32)] * (3 - depth)
+    cb = [c[1] for c in convs] + [jnp.zeros((1, width), jnp.float32)] * (3 - depth)
+    row = lambda cols: pl.BlockSpec((block_b, cols), lambda i: (i, 0),
+                                    memory_space=pltpu.VMEM)
+    # Accumulator outputs: every grid step maps to the same (whole-array)
+    # block; the kernel zero-initializes on step 0 and += thereafter.
+    acc = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0),
+                                     memory_space=pltpu.VMEM)
+    out_shapes = [
+        ((flat_in, width), "dwe"), ((1, width), "dbe"),
+        ((width, width), "dw1"), ((1, width), "db1"),
+        ((width, width), "dw2"), ((1, width), "db2"),
+        ((width, width), "dw3"), ((1, width), "db3"),
+        ((width, num_nodes), "dwsc"), ((1, num_nodes), "dbsc"),
+        ((dim, dim), "dwv1"), ((1, dim), "dbv1"),
+        ((dim, 1), "dwv2"), ((1, 1), "dbv2"),
+    ]
+    outs = pl.pallas_call(
+        functools.partial(_bwd_kernel, depth=depth,
+                          compute_dtype=compute_dtype),
+        grid=(b // block_b,),
+        in_specs=[row(flat_in)] + [_full_spec()] * 15
+        + [row(num_nodes), row(1)],
+        out_specs=[acc(s) for s, _ in out_shapes],
+        out_shape=[jax.ShapeDtypeStruct(s, jnp.float32) for s, _ in out_shapes],
+        interpret=interpret,
+    )(obs_flat, we, be, cw[0], cb[0], cw[1], cb[1], cw[2], cb[2],
+      wsc, bsc, pool, wv1, bv1, wv2, bv2, dlogits, dvalue)
+    named = {name: o for (_, name), o in zip(out_shapes, outs)}
+    named["dconv"] = [named[f"dw{i + 1}"] for i in range(depth)]
+    named["dbconv"] = [named[f"db{i + 1}"] for i in range(depth)]
+    return named
+
+
+def make_fused_gnn_apply(
+    adjacency: np.ndarray,
+    depth: int = 3,
+    block_b: int = DEFAULT_BLOCK_B,
+    interpret: bool | None = None,
+    compute_dtype: Any = jnp.float32,
+):
+    """Build ``apply(params, obs) -> (logits, value)`` running the fused
+    kernels, differentiable via ``jax.custom_vjp``.
+
+    ``params`` is a ``models.gnn.GNNPolicy`` param tree (the ``{"params":
+    ...}`` dict as returned by ``init``); ``obs`` is ``[B, N, feat]`` (or
+    unbatched ``[N, feat]``). ``depth`` must be <= 3 (the kernel's static
+    conv slots; the shipped config uses 3). ``compute_dtype=jnp.bfloat16``
+    runs the in-kernel matmuls at MXU-native precision with f32
+    accumulation (params, biases, activations-out, and gradients stay
+    f32) — the perf setting for the big training configs.
+    """
+    if depth > 3:
+        raise ValueError(f"fused GNN kernel supports depth <= 3, got {depth}")
+    if interpret is None:
+        from rl_scheduler_tpu.ops.gae import default_platform
+
+        interpret = default_platform() != "tpu"
+    adjacency = np.asarray(adjacency, np.float32)
+    num_nodes = adjacency.shape[0]
+    degree = np.maximum(adjacency.sum(axis=1, keepdims=True), 1.0)
+    norm_adj = jnp.asarray(adjacency / degree)
+
+    @jax.custom_vjp
+    def fused(params, obs_flat):
+        weights = _big_weights(params["params"], norm_adj, num_nodes, depth)
+        return _run_forward(weights, obs_flat, num_nodes, depth,
+                            block_b, interpret, compute_dtype)
+
+    def fused_fwd(params, obs_flat):
+        return fused(params, obs_flat), (params, obs_flat)
+
+    def fused_bwd(res, cotangents):
+        params, obs_flat = res
+        dlogits, dvalue = cotangents
+        weights = _big_weights(params["params"], norm_adj, num_nodes, depth)
+        big = _run_backward(
+            weights, obs_flat, dlogits.astype(jnp.float32),
+            dvalue.astype(jnp.float32), num_nodes, depth, block_b, interpret,
+            compute_dtype,
+        )
+        small = _small_grads(params["params"], big, norm_adj, num_nodes, depth)
+        # Observations are env data, never differentiated; returning zeros
+        # keeps custom_vjp's signature contract without wasted compute
+        # (XLA drops the unused cotangent).
+        return {"params": small}, jnp.zeros_like(obs_flat)
+
+    fused.defvjp(fused_fwd, fused_bwd)
+
+    def apply(params, obs):
+        from rl_scheduler_tpu.models.heads import apply_with_optional_batch
+
+        def forward(batched_obs):
+            b = batched_obs.shape[0]
+            flat = batched_obs.reshape(
+                b, num_nodes * batched_obs.shape[-1]
+            ).astype(jnp.float32)
+            pad = (-b) % block_b
+            if pad:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((pad, flat.shape[1]), jnp.float32)],
+                    axis=0,
+                )
+            logits, value = fused(params, flat)
+            return logits[:b], value[:b, 0]
+
+        return apply_with_optional_batch(forward, obs)
+
+    return apply
+
+
+class FusedGNNPolicy:
+    """Drop-in for ``models.gnn.GNNPolicy`` with the fused-kernel forward.
+
+    Duck-typed flax surface (``init``/``apply``): ``init`` delegates to the
+    reference module so the parameter tree (and therefore checkpoints) are
+    IDENTICAL; ``apply`` runs the Pallas kernels. Use on TPU for the big
+    training configs; the reference module remains the source of truth for
+    parity tests and serving.
+    """
+
+    def __init__(self, adjacency, dim: int = 64, depth: int = 3,
+                 block_b: int = DEFAULT_BLOCK_B, interpret: bool | None = None,
+                 dtype: Any = None):
+        from rl_scheduler_tpu.models import GNNPolicy
+
+        self.inner = GNNPolicy.from_adjacency(
+            np.asarray(adjacency), dim=dim, depth=depth
+        )
+        self.dim = dim
+        self.depth = depth
+        self.dtype = dtype  # compute dtype (mirrors GNNPolicy's field)
+        self._apply = make_fused_gnn_apply(
+            np.asarray(adjacency), depth, block_b, interpret,
+            compute_dtype=dtype or jnp.float32,
+        )
+
+    def init(self, key, obs):
+        return self.inner.init(key, obs)
+
+    def apply(self, params, obs):
+        return self._apply(params, obs)
