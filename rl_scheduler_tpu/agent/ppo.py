@@ -428,8 +428,16 @@ def ppo_train(
     sync_every: int = 1,
     eval_log_fn: Callable[[int, dict], None] | None = None,
     updates_per_dispatch: int = 1,
+    mesh=None,
 ):
     """Host-side training loop: jitted update per iteration + logging hooks.
+
+    ``mesh``: a ``jax.sharding.Mesh`` with a ``dp`` axis runs the update
+    data-parallel via ``shard_map`` (``parallel/sharding.py``) — env batch
+    sharded, params replicated, gradients pmean'd over ICI. Everything
+    else (checkpointing, resume, in-training eval, metric logging, fused
+    dispatch) is unchanged: the sharded runner's leaves are ordinary
+    global arrays. ``cfg.num_envs`` is the GLOBAL env count.
 
     ``updates_per_dispatch=k`` fuses ``k`` whole PPO iterations into ONE
     dispatched program (``lax.scan`` over the update; metrics stacked and
@@ -472,6 +480,13 @@ def ppo_train(
     than replaying the stream the original run already consumed.
     """
     bundle = env if isinstance(env, EnvBundle) else multi_cloud_bundle(env)
+    if mesh is not None and debug_checks:
+        # Reject before the gae_impl branch below: its "forces scan GAE"
+        # warning would describe a run that never happens.
+        raise ValueError(
+            "debug_checks cannot instrument the shard_map'd update; "
+            "run the single-device path for checkified debugging"
+        )
     if debug_checks and cfg.gae_impl != "scan":
         if resolve_gae_impl(cfg.gae_impl) == "pallas":
             warnings.warn(
@@ -479,7 +494,16 @@ def ppo_train(
                 "instrument the Pallas GAE kernel, so it is not the code "
                 "under test in this run", stacklevel=2)
         cfg = dataclasses.replace(cfg, gae_impl="scan")
-    init_fn, update_fn, net = make_ppo_bundle(bundle, cfg, net=net)
+    if mesh is not None:
+        from rl_scheduler_tpu.parallel.sharding import (
+            make_data_parallel_ppo_bundle,
+        )
+
+        init_fn, update_fn, net = make_data_parallel_ppo_bundle(
+            bundle, cfg, mesh, net=net
+        )
+    else:
+        init_fn, update_fn, net = make_ppo_bundle(bundle, cfg, net=net)
     start_iteration = 0
     key = jax.random.PRNGKey(seed)
     if restore is not None:

@@ -145,6 +145,12 @@ def main(argv: list[str] | None = None) -> Path:
                         "transfer (prints then arrive in bursts of N); raise "
                         "on remote/tunneled accelerators where every sync "
                         "costs a network round-trip")
+    p.add_argument("--dp", type=int, default=1,
+                   help="data-parallel device count: shard the env batch "
+                        "over a dp mesh axis with pmean gradient sync over "
+                        "ICI (shard_map). -1 = all visible devices; "
+                        "--num-envs stays the GLOBAL count; both num-envs "
+                        "and minibatch-size must divide by dp")
     p.add_argument("--updates-per-dispatch", type=int, default=1,
                    help="fuse K whole PPO iterations into one jitted "
                         "dispatch (lax.scan over the update); removes the "
@@ -359,6 +365,20 @@ def main(argv: list[str] | None = None) -> Path:
                 "fused_gnn": args.fused_gnn,
                 "legacy_reward_sign": args.legacy_reward_sign})
 
+    mesh = None
+    if args.dp != 1:
+        if args.dp == 0 or args.dp < -1:
+            raise SystemExit(
+                f"--dp {args.dp}: pass a device count >= 2, or -1 for all "
+                "visible devices"
+            )
+        from rl_scheduler_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"dp": args.dp})
+        print(f"Data-parallel over {mesh.shape['dp']} devices "
+              f"({cfg.num_envs} global envs -> "
+              f"{cfg.num_envs // mesh.shape['dp']}/device)")
+
     print(f"Training PPO preset={args.preset} env={args.env} on "
           f"{jax.devices()[0].platform} "
           f"({cfg.num_envs} envs x {cfg.rollout_steps} steps/iter)")
@@ -375,7 +395,8 @@ def main(argv: list[str] | None = None) -> Path:
                   log_fn=log_fn, checkpoint_fn=checkpoint_fn, restore=restore,
                   debug_checks=args.debug_checks, sync_every=args.sync_every,
                   eval_log_fn=make_eval_log_fn(metrics_file, tb),
-                  updates_per_dispatch=args.updates_per_dispatch)
+                  updates_per_dispatch=args.updates_per_dispatch,
+                  mesh=mesh)
     metrics_file.close()
     if tb is not None:
         tb.close()

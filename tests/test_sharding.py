@@ -84,3 +84,40 @@ def test_dp_learning_progress(env_params):
     first = np.mean([h["reward_mean"] for h in history[:3]])
     last = np.mean([h["reward_mean"] for h in history[-3:]])
     assert last > first
+
+
+def test_train_cli_dp(tmp_path):
+    """--dp shards the CLI training run over the virtual mesh, composing
+    with in-training eval, fused dispatch, checkpointing, and resume."""
+    import json
+
+    from rl_scheduler_tpu.agent import train_ppo as cli
+    from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+    run_dir = cli.main([
+        "--preset", "quick", "--dp", "4", "--num-envs", "8",
+        "--rollout-steps", "16", "--minibatch-size", "32", "--hidden", "8,8",
+        "--iterations", "4", "--checkpoint-every", "2",
+        "--eval-every", "2", "--eval-episodes", "4",
+        "--updates-per-dispatch", "2", "--sync-every", "2",
+        "--run-root", str(tmp_path), "--run-name", "dp_cli",
+    ])
+    mgr = CheckpointManager(run_dir)
+    assert mgr.latest_step() == 4
+    mgr.close()
+    records = [json.loads(l) for l in (run_dir / "metrics.jsonl").open()]
+    trains = [r for r in records if not r.get("eval")
+              and "resumed_from_iteration" not in r]
+    evals = [r for r in records if r.get("eval")]
+    assert [r["iteration"] for r in trains] == [1, 2, 3, 4]
+    assert [r["iteration"] for r in evals] == [2, 4]
+    # resume continues the sharded run
+    cli.main([
+        "--preset", "quick", "--dp", "4", "--num-envs", "8",
+        "--rollout-steps", "16", "--minibatch-size", "32", "--hidden", "8,8",
+        "--iterations", "6", "--checkpoint-every", "2", "--resume",
+        "--run-root", str(tmp_path), "--run-name", "dp_cli",
+    ])
+    mgr = CheckpointManager(run_dir)
+    assert mgr.latest_step() == 6
+    mgr.close()
