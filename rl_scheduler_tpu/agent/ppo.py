@@ -57,6 +57,16 @@ class PPOTrainConfig:
     #   bundle horizon_fn; ~2x faster rollout on TPU).
     # auto: open_loop when the bundle supports it, scan otherwise.
     rollout_impl: str = "auto"       # scan | open_loop | auto
+    # lax.scan unroll factor for the SGD minibatch loop — an XLA tuning
+    # lever: unrolling lets the compiler fuse/lay out minibatch steps like
+    # straight-line code instead of a conservative while-loop body. In
+    # isolation this recovered a 20x gap for the attention policy, but in
+    # the full fused update it measured near-neutral on every config (the
+    # layout pathology there is driven by the surrounding program, not the
+    # loop structure — see the config-4 note in docs/status.md). Kept as a
+    # knob because the effect is context/compiler-version dependent; costs
+    # compile time roughly linearly.
+    sgd_unroll: int = 1
     # In-training periodic evaluation (reference train_final.py:19:
     # evaluation_interval=5, evaluation_duration=20): every eval_every
     # iterations, run eval_episodes greedy episodes and report
@@ -157,6 +167,11 @@ def make_ppo_bundle(
         raise ValueError(
             f"unknown compute_dtype {cfg.compute_dtype!r}; "
             f"choose from {sorted(compute_dtypes)}"
+        )
+    if cfg.sgd_unroll < 1:
+        raise ValueError(
+            f"sgd_unroll={cfg.sgd_unroll}: must be >= 1 (a silently clamped "
+            "value would make the knob appear engaged when it is not)"
         )
     if (net is not None and cfg.compute_dtype != "float32"
             and getattr(net, "dtype", None) is None):
@@ -371,7 +386,8 @@ def make_ppo_bundle(
                 cfg.num_minibatches, mb_size, k_cols
             )
             (params, opt_state), metrics = jax.lax.scan(
-                sgd_minibatch, (params, opt_state), minibatches
+                sgd_minibatch, (params, opt_state), minibatches,
+                unroll=cfg.sgd_unroll,
             )
             return (params, opt_state), metrics
 

@@ -241,6 +241,27 @@ def main(argv: list[str] | None = None) -> Path:
             f"--fused-gnn selects the Pallas cluster_graph policy; it has "
             f"no meaning for --env {args.env}"
         )
+    if args.dp != 1:
+        # Full validation here, BEFORE the run directory is created: every
+        # bad flag combination in this CLI exits with an actionable message
+        # rather than a mid-setup traceback and an empty run dir.
+        if args.dp == 0 or args.dp < -1:
+            raise SystemExit(
+                f"--dp {args.dp}: pass a device count >= 2, or -1 for all "
+                "visible devices"
+            )
+        if args.debug_checks:
+            raise SystemExit(
+                "--debug-checks cannot instrument the shard_map'd update; "
+                "drop --dp for checkified debugging"
+            )
+        ndev = args.dp if args.dp > 0 else len(jax.devices())
+        if cfg.num_envs % ndev or cfg.minibatch_size % ndev:
+            raise SystemExit(
+                f"--dp {ndev}: num_envs={cfg.num_envs} and "
+                f"minibatch_size={cfg.minibatch_size} must both divide by "
+                "the device count"
+            )
     bundle, net = make_bundle_and_net(args.env, cfg, args.legacy_reward_sign,
                                       fault_prob, args.num_heads,
                                       fused_gnn=args.fused_gnn)
@@ -367,11 +388,6 @@ def main(argv: list[str] | None = None) -> Path:
 
     mesh = None
     if args.dp != 1:
-        if args.dp == 0 or args.dp < -1:
-            raise SystemExit(
-                f"--dp {args.dp}: pass a device count >= 2, or -1 for all "
-                "visible devices"
-            )
         from rl_scheduler_tpu.parallel import make_mesh
 
         mesh = make_mesh({"dp": args.dp})

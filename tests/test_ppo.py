@@ -46,6 +46,18 @@ def test_train_deterministic(env_params):
     )
 
 
+def test_sgd_unroll_matches_scan(env_params):
+    """sgd_unroll only changes compilation, never the math."""
+    import dataclasses
+
+    _, h1 = ppo_train(env_params, SMOKE_CFG, 2, seed=11)
+    cfg_u = dataclasses.replace(SMOKE_CFG, sgd_unroll=4)
+    _, h2 = ppo_train(env_params, cfg_u, 2, seed=11)
+    for a, b in zip(h1, h2):
+        assert a["policy_loss"] == pytest.approx(b["policy_loss"], rel=1e-4)
+        assert a["reward_mean"] == pytest.approx(b["reward_mean"], rel=1e-5)
+
+
 def test_fused_dispatch_matches_sequential(env_params):
     """updates_per_dispatch is pure dispatch plumbing: the scanned
     iterations must reproduce the one-by-one metrics exactly."""
