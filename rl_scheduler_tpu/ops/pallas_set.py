@@ -1,0 +1,446 @@
+"""Fused Pallas TPU kernels for the set-transformer policy (config 4).
+
+WHY: the config-4 scorecard entry (docs/status.md) documents an XLA
+fusion/layout pathology: inside the fused PPO update, each scanned SGD
+minibatch of the attention policy compiles to ~970 ops including ~1.8 ms
+of pure layout copies — ~20x slower than the identical body compiled
+standalone — and no XLA-level knob (scan unroll, shuffle granularity,
+minibatch shape, lean attention) moved it. As with the GNN
+(``ops/pallas_gnn.py``), the escape hatch is to take layout/fusion
+decisions away from XLA: one kernel computes the whole policy per row
+block with every activation VMEM-resident.
+
+HOW, differently from the GNN kernel: no Kronecker weight blowup. The
+node axis lives in the lane dimension as 8 contiguous 64-wide slices of
+a flat ``[blk, 512]`` activation, and every per-node op (Dense with the
+SHARED weight, LayerNorm, the 8x8 attention pairs) is a static Python
+loop over those slices — weights stay at their checkpoint shapes, so
+VMEM holds kilobytes of parameters instead of the kron'd megabytes, and
+gradients come out in checkpoint shape with no contraction step.
+
+The backward kernel does not hand-derive anything: it recomputes the
+forward in VMEM and calls ``jax.vjp`` INSIDE the kernel body (the body
+is ordinary traced JAX, so autodiff composes with Pallas), seeding with
+the ``(dlogits, dvalue)`` cotangents and accumulating parameter
+gradients across the sequential TPU grid. ``jax.custom_vjp`` exposes the
+pair as a drop-in differentiable ``apply``.
+
+Parity: numerically equivalent (f32) to ``models.transformer.
+SetTransformerPolicy`` with ``num_heads=1`` (the measured-fastest
+default) — same parameter tree, forward and gradient agreement tested.
+Interpret mode covers the kernels on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Rows per grid step. The in-kernel vjp keeps every forward intermediate
+# of ONE transformer block in VMEM; the per-block backward kernel peaks at
+# ~17 MB at 128 rows (1 MB over the 16 MB scoped-vmem limit — measured),
+# so 96 is the sweet spot that compiles with headroom.
+DEFAULT_BLOCK_B = 96
+_LN_EPS = 1e-6
+
+
+def _slices(x, n, width):
+    return [x[:, i * width:(i + 1) * width] for i in range(n)]
+
+
+def _layer_norm(x64, scale, bias):
+    """flax nn.LayerNorm semantics (fast variance, eps 1e-6) on a
+    ``[blk, dim]`` per-node slice."""
+    mean = jnp.mean(x64, axis=-1, keepdims=True)
+    var = jnp.maximum(jnp.mean(x64 * x64, axis=-1, keepdims=True) - mean * mean, 0.0)
+    inv = jax.lax.rsqrt(var + _LN_EPS)
+    return (x64 - mean) * inv * scale + bias
+
+
+def _canonical_2d(leaf: jnp.ndarray) -> jnp.ndarray:
+    """Pallas TPU refs want 2-D: squeeze the flax MHDPA head axis
+    ((64,1,64) / (1,64,64) -> (64,64)) and lift 1-D biases to (1, n)."""
+    if leaf.ndim == 3:  # single-head DenseGeneral kernels
+        return leaf.reshape(
+            leaf.shape[0] * leaf.shape[1], leaf.shape[2]
+        ) if leaf.shape[1] == 1 or leaf.shape[0] == 1 else leaf
+    if leaf.ndim <= 1:
+        return leaf.reshape(1, -1)
+    return leaf
+
+
+def _embed(p: dict, x_flat: jnp.ndarray, num_nodes: int, feat: int):
+    """Per-node embed Dense in flat layout (also runs as plain XLA in the
+    backward pipeline — a single cheap matmul per node)."""
+    mm = functools.partial(jnp.dot, preferred_element_type=jnp.float32)
+    w, b = p["embed"]["kernel"], p["embed"]["bias"]
+    return jnp.concatenate(
+        [mm(s, w) + b for s in _slices(x_flat, num_nodes, feat)], axis=1
+    )
+
+
+def _flat_forward(p: dict, x_flat: jnp.ndarray, num_nodes: int, feat: int,
+                  dim: int, depth: int):
+    """The SetTransformerPolicy forward in flat-lane layout: embed, then
+    the block stack, then the heads — composed from the same functions the
+    blockwise backward recomputes, so forward and backward can never
+    diverge. ``p`` leaves are canonical 2-D (:func:`_canonical_2d`);
+    ``x_flat`` is ``[blk, num_nodes * feat]``; all math f32. Returns
+    ``(logits [blk, N], value [blk, 1])``."""
+    h = _embed(p, x_flat, num_nodes, feat)
+    for bi in range(depth):
+        h = _single_block(p[f"block_{bi}"], h, num_nodes, dim)
+    return _head_forward(p, h, num_nodes, dim)
+
+
+def _unflatten(treedef, refs):
+    return jax.tree_util.tree_unflatten(treedef, [r[:] for r in refs])
+
+
+def _fwd_kernel(treedef, num_nodes, feat, dim, depth, obs_ref, *rest):
+    w_refs = rest[:-2]
+    logits_ref, value_ref = rest[-2:]
+    p = _unflatten(treedef, w_refs)
+    logits, value = _flat_forward(p, obs_ref[:], num_nodes, feat, dim, depth)
+    logits_ref[:] = logits
+    value_ref[:] = value
+
+
+# ---- blockwise backward: Mosaic hits an internal limit somewhere past
+# "one transformer block + heads" of reverse-mode chain in a single kernel
+# (empirically bisected: block-only and block+heads backward compile; add
+# the embed in front, or a second block, and tpu_compile_helper dies). So
+# the backward runs as a CHAIN of per-block kernels — classic gradient
+# checkpointing at block granularity, with the activation cotangent ``dh``
+# handed between kernels through HBM (one [B, N*dim] tensor per boundary,
+# still ~10x less traffic than the XLA path's per-op materialization).
+
+
+def _single_block(p_blk: dict, h: jnp.ndarray, num_nodes: int, dim: int):
+    """One pre-LN transformer block in flat layout (weights canonical 2-D)."""
+    n = num_nodes
+    mm = functools.partial(jnp.dot, preferred_element_type=jnp.float32)
+
+    def node_dense(h_flat, w, b, in_w):
+        return jnp.concatenate(
+            [mm(s, w) + b for s in _slices(h_flat, n, in_w)], axis=1
+        )
+
+    def node_ln(h_flat, ln):
+        return jnp.concatenate(
+            [_layer_norm(s, ln["scale"], ln["bias"])
+             for s in _slices(h_flat, n, dim)],
+            axis=1,
+        )
+
+    attn = p_blk["MultiHeadDotProductAttention_0"]
+    hn = node_ln(h, p_blk["LayerNorm_0"])
+    q = node_dense(hn, attn["query"]["kernel"], attn["query"]["bias"], dim)
+    k = node_dense(hn, attn["key"]["kernel"], attn["key"]["bias"], dim)
+    v = node_dense(hn, attn["value"]["kernel"], attn["value"]["bias"], dim)
+    qs, ks, vs = (_slices(t, n, dim) for t in (q, k, v))
+    scale = dim ** -0.5
+    outs = []
+    for i in range(n):
+        scores = jnp.concatenate(
+            [jnp.sum(qs[i] * ks[j], axis=-1, keepdims=True) * scale
+             for j in range(n)],
+            axis=1,
+        )
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = probs[:, 0:1] * vs[0]
+        for j in range(1, n):
+            o = o + probs[:, j:j + 1] * vs[j]
+        outs.append(o)
+    a = node_dense(jnp.concatenate(outs, axis=1),
+                   attn["out"]["kernel"], attn["out"]["bias"], dim)
+    h = h + a
+    m = node_ln(h, p_blk["LayerNorm_1"])
+    m = node_dense(m, p_blk["Dense_0"]["kernel"], p_blk["Dense_0"]["bias"], dim)
+    m = jax.nn.gelu(m)
+    m = jnp.concatenate(
+        [mm(s, p_blk["Dense_1"]["kernel"]) + p_blk["Dense_1"]["bias"]
+         for s in _slices(m, num_nodes, 2 * dim)],
+        axis=1,
+    )
+    return h + m
+
+
+def _head_forward(p: dict, h: jnp.ndarray, num_nodes: int, dim: int):
+    """final_norm + pointer/value heads in flat layout."""
+    n = num_nodes
+    mm = functools.partial(jnp.dot, preferred_element_type=jnp.float32)
+    x = jnp.concatenate(
+        [_layer_norm(s, p["final_norm"]["scale"], p["final_norm"]["bias"])
+         for s in _slices(h, n, dim)],
+        axis=1,
+    )
+    head = p["head"]
+    logits = jnp.concatenate(
+        [mm(s, head["score_head"]["kernel"]) + head["score_head"]["bias"]
+         for s in _slices(x, n, dim)],
+        axis=1,
+    )
+    pooled = sum(_slices(x, n, dim)) / n
+    v1 = jnp.tanh(mm(pooled, head["value_hidden"]["kernel"])
+                  + head["value_hidden"]["bias"])
+    value = mm(v1, head["value_head"]["kernel"]) + head["value_head"]["bias"]
+    return logits, value
+
+
+def _block_fwd_kernel(treedef, num_nodes, dim, h_ref, *rest):
+    w_refs = rest[:-1]
+    out_ref = rest[-1]
+    p_blk = _unflatten(treedef, w_refs)
+    out_ref[:] = _single_block(p_blk, h_ref[:], num_nodes, dim)
+
+
+def _block_bwd_kernel(treedef, num_nodes, dim, h_ref, *rest):
+    # call order: (h, *weights, dh_out) inputs, then (dh_in, *grads) outputs
+    n_w = treedef.num_leaves
+    w_refs = rest[:n_w]
+    dh_out_ref = rest[n_w]
+    dh_in_ref = rest[n_w + 1]
+    grad_refs = rest[n_w + 2:]
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        for g in grad_refs:
+            g[:] = jnp.zeros_like(g)
+
+    p_blk = _unflatten(treedef, w_refs)
+    h = h_ref[:]
+
+    def f(h, p_blk):
+        return _single_block(p_blk, h, num_nodes, dim)
+
+    _, vjp = jax.vjp(f, h, p_blk)
+    dh, gp = vjp(dh_out_ref[:])
+    dh_in_ref[:] = dh
+    for g_ref, g in zip(grad_refs, jax.tree_util.tree_leaves(gp)):
+        g_ref[:] += g
+
+
+def _head_bwd_kernel(treedef, num_nodes, dim, h_ref, dlogits_ref, dvalue_ref,
+                     *rest):
+    # call order: (h, dlogits, dvalue, *weights) inputs, then
+    # (dh, *grads) outputs
+    n_w = treedef.num_leaves
+    w_refs = rest[:n_w]
+    dh_ref = rest[n_w]
+    grad_refs = rest[n_w + 1:]
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        for g in grad_refs:
+            g[:] = jnp.zeros_like(g)
+
+    p = _unflatten(treedef, w_refs)
+    h = h_ref[:]
+
+    def f(h, p):
+        return _head_forward(p, h, num_nodes, dim)
+
+    _, vjp = jax.vjp(f, h, p)
+    dh, gp = vjp((dlogits_ref[:], dvalue_ref[:]))
+    dh_ref[:] = dh
+    for g_ref, g in zip(grad_refs, jax.tree_util.tree_leaves(gp)):
+        g_ref[:] += g
+
+
+def make_fused_set_apply(
+    num_nodes: int = 8,
+    feat: int = 6,
+    dim: int = 64,
+    depth: int = 2,
+    block_b: int = DEFAULT_BLOCK_B,
+    interpret: bool | None = None,
+):
+    """Build a differentiable ``apply(params, obs) -> (logits, value)``
+    running the fused kernels. ``params`` is a ``SetTransformerPolicy``
+    (num_heads=1) tree; ``obs`` is ``[B, N, feat]`` or unbatched."""
+    if interpret is None:
+        from rl_scheduler_tpu.ops.gae import default_platform
+
+        interpret = default_platform() != "tpu"
+
+    def full_spec(_):
+        return pl.BlockSpec(memory_space=pltpu.VMEM)
+
+    width = num_nodes * dim
+    row = lambda cols: pl.BlockSpec((block_b, cols), lambda i: (i, 0),
+                                    memory_space=pltpu.VMEM)
+    acc = lambda l: pl.BlockSpec(l.shape, lambda i: (0, 0),
+                                 memory_space=pltpu.VMEM)
+
+    def _canon_tree(tree):
+        return jax.tree.map(
+            lambda l: _canonical_2d(l.astype(jnp.float32)), tree
+        )
+
+    def _run_block_fwd(blk_tree, h):
+        leaves, treedef = jax.tree_util.tree_flatten(blk_tree)
+        return pl.pallas_call(
+            functools.partial(_block_fwd_kernel, treedef, num_nodes, dim),
+            grid=(h.shape[0] // block_b,),
+            in_specs=[row(width)] + [full_spec(l) for l in leaves],
+            out_specs=row(width),
+            out_shape=jax.ShapeDtypeStruct(h.shape, jnp.float32),
+            interpret=interpret,
+        )(h, *leaves)
+
+    def _run_block_bwd(blk_tree, h, dh_out):
+        leaves, treedef = jax.tree_util.tree_flatten(blk_tree)
+        outs = pl.pallas_call(
+            functools.partial(_block_bwd_kernel, treedef, num_nodes, dim),
+            grid=(h.shape[0] // block_b,),
+            in_specs=[row(width)] + [full_spec(l) for l in leaves]
+            + [row(width)],
+            out_specs=[row(width)] + [acc(l) for l in leaves],
+            out_shape=[jax.ShapeDtypeStruct(h.shape, jnp.float32)]
+            + [jax.ShapeDtypeStruct(l.shape, jnp.float32) for l in leaves],
+            interpret=interpret,
+        )(h, *leaves, dh_out)
+        dh_in = outs[0]
+        g_tree = jax.tree_util.tree_unflatten(treedef, outs[1:])
+        return g_tree, dh_in
+
+    def _run_head_bwd(head_tree, h, dlogits, dvalue):
+        leaves, treedef = jax.tree_util.tree_flatten(head_tree)
+        outs = pl.pallas_call(
+            functools.partial(_head_bwd_kernel, treedef, num_nodes, dim),
+            grid=(h.shape[0] // block_b,),
+            in_specs=[row(width), row(num_nodes), row(1)]
+            + [full_spec(l) for l in leaves],
+            out_specs=[row(width)] + [acc(l) for l in leaves],
+            out_shape=[jax.ShapeDtypeStruct(h.shape, jnp.float32)]
+            + [jax.ShapeDtypeStruct(l.shape, jnp.float32) for l in leaves],
+            interpret=interpret,
+        )(h, dlogits, dvalue, *leaves)
+        dh = outs[0]
+        g_tree = jax.tree_util.tree_unflatten(treedef, outs[1:])
+        return g_tree, dh
+
+    @jax.custom_vjp
+    def fused(params, obs_flat):
+        canon = _canon_tree(params["params"])
+        leaves, treedef = jax.tree_util.tree_flatten(canon)
+        b = obs_flat.shape[0]
+        logits, value = pl.pallas_call(
+            functools.partial(_fwd_kernel, treedef, num_nodes, feat, dim, depth),
+            grid=(b // block_b,),
+            in_specs=[row(num_nodes * feat)] + [full_spec(l) for l in leaves],
+            out_specs=[row(num_nodes), row(1)],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, num_nodes), jnp.float32),
+                jax.ShapeDtypeStruct((b, 1), jnp.float32),
+            ],
+            interpret=interpret,
+        )(obs_flat, *leaves)
+        return logits, value
+
+    def fused_fwd(params, obs_flat):
+        return fused(params, obs_flat), (params, obs_flat)
+
+    def fused_bwd(res, cotangents):
+        params, obs_flat = res
+        dlogits = cotangents[0].astype(jnp.float32)
+        dvalue = cotangents[1].astype(jnp.float32)
+        canon = _canon_tree(params["params"])
+
+        # Prefix recompute: embed in plain XLA (one matmul per node), then
+        # each block as its own fwd kernel — gradient checkpointing at
+        # block granularity, forced by the Mosaic chain-length limit.
+        hs = [_embed(canon, obs_flat, num_nodes, feat)]
+        for bi in range(depth):
+            hs.append(_run_block_fwd(canon[f"block_{bi}"], hs[-1]))
+
+        head_tree = {"final_norm": canon["final_norm"], "head": canon["head"]}
+        g_head, dh = _run_head_bwd(head_tree, hs[depth], dlogits, dvalue)
+        grads = dict(g_head)
+        for bi in reversed(range(depth)):
+            g_blk, dh = _run_block_bwd(canon[f"block_{bi}"], hs[bi], dh)
+            grads[f"block_{bi}"] = g_blk
+
+        # Embed gradients in XLA from the final activation cotangent.
+        def embed_fn(embed_tree):
+            return _embed({"embed": embed_tree}, obs_flat, num_nodes, feat)
+
+        _, evjp = jax.vjp(embed_fn, canon["embed"])
+        (grads["embed"],) = evjp(dh)
+
+        # Un-canonicalize: reshape each 2-D grad back to checkpoint shape.
+        gp = jax.tree.map(
+            lambda g, l: g.reshape(l.shape).astype(l.dtype),
+            grads, params["params"],
+        )
+        return {"params": gp}, jnp.zeros_like(obs_flat)
+
+    fused.defvjp(fused_fwd, fused_bwd)
+
+    def apply(params, obs):
+        from rl_scheduler_tpu.models.heads import apply_with_optional_batch
+
+        def forward(batched_obs):
+            b = batched_obs.shape[0]
+            flat = batched_obs.reshape(b, num_nodes * feat).astype(jnp.float32)
+            pad = (-b) % block_b
+            if pad:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((pad, flat.shape[1]), jnp.float32)],
+                    axis=0,
+                )
+            logits, value = fused(params, flat)
+            return logits[:b], value[:b, 0]
+
+        return apply_with_optional_batch(forward, obs)
+
+    return apply
+
+
+class FusedSetPolicy:
+    """Drop-in for ``SetTransformerPolicy`` (num_heads=1) with the fused
+    Pallas forward/backward on the HOT path. ``init`` delegates to the
+    reference module so parameter trees (and checkpoints) are identical.
+
+    ``apply`` dispatches by batch size: SGD minibatches (>=
+    ``min_fused_batch`` rows, where the XLA path's layout pathology lives)
+    run through the kernels; the rollout's per-step forwards (num_envs
+    rows inside the env scan, where a Pallas call measured far slower than
+    XLA in while-loop context) stay on the reference module. Both paths
+    compute the same function (parity-tested), so this is purely a
+    compilation-strategy switch.
+    """
+
+    num_heads = 1  # the train CLI's resume guard reads this
+
+    def __init__(self, num_nodes: int = 8, feat: int = 6, dim: int = 64,
+                 depth: int = 2, block_b: int = DEFAULT_BLOCK_B,
+                 interpret: bool | None = None,
+                 min_fused_batch: int = 16384):
+        from rl_scheduler_tpu.models import SetTransformerPolicy
+
+        self.inner = SetTransformerPolicy(dim=dim, depth=depth, num_heads=1)
+        self.dim = dim
+        self.depth = depth
+        self.min_fused_batch = min_fused_batch
+        self._apply = make_fused_set_apply(
+            num_nodes, feat, dim, depth, block_b, interpret
+        )
+
+    def init(self, key, obs):
+        return self.inner.init(key, obs)
+
+    def apply(self, params, obs):
+        batched = obs.ndim == 3
+        if (batched and obs.shape[0] >= self.min_fused_batch) or not batched:
+            if not batched:
+                return self.inner.apply(params, obs)
+            return self._apply(params, obs)
+        return self.inner.apply(params, obs)
