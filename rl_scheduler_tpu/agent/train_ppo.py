@@ -32,14 +32,17 @@ ENVS = ("multi_cloud", "single_cluster", "cluster_set", "cluster_graph")
 def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
                         fault_prob: float | None = None,
                         num_heads: int | None = None,
-                        fused_gnn: bool = False):
+                        fused_gnn: bool = False,
+                        fused_set: bool = False):
     """``(bundle, net)`` for each BASELINE env family.
 
     ``net=None`` means the default flat-obs ActorCritic; the set/graph envs
     pair with their structured policies (configs 4-5). ``fused_gnn``
     swaps the cluster_graph policy for the fused Pallas kernel variant
-    (``ops/pallas_gnn.py`` — same checkpoint tree, +25% measured at
-    tpu8192: 2.28M vs 1.83M steps/s).
+    (``ops/pallas_gnn.py`` — same checkpoint tree). ``fused_set`` swaps
+    the cluster_set policy for the batch-minor fast path
+    (``models/set_fast.py`` — same checkpoint tree, ~1.7x the honest
+    end-to-end update throughput at tpu4096, see docs/status.md).
     """
     dtype = None
     if cfg.compute_dtype == "bfloat16":
@@ -60,6 +63,13 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
         return single_cluster_bundle(), None
     if env_name == "cluster_set":
         from rl_scheduler_tpu.env.bundle import cluster_set_bundle
+
+        if fused_set:
+            from rl_scheduler_tpu.models.set_fast import BatchMinorSetPolicy
+
+            return cluster_set_bundle(), BatchMinorSetPolicy(
+                dim=64, depth=2, dtype=dtype
+            )
         from rl_scheduler_tpu.models import SetTransformerPolicy
 
         kwargs = {} if num_heads is None else {"num_heads": num_heads}
@@ -130,8 +140,16 @@ def main(argv: list[str] | None = None) -> Path:
     p.add_argument("--fused-gnn", action="store_true",
                    help="cluster_graph only: run the policy through the "
                         "fused Pallas kernel (whole forward+backward in "
-                        "VMEM per row block; same checkpoint tree, +25%% "
-                        "measured at tpu8192)")
+                        "VMEM per row block; same checkpoint tree — see "
+                        "docs/status.md for measured throughput)")
+    p.add_argument("--fused-set", action="store_true",
+                   help="cluster_set only: run the policy through the "
+                        "batch-minor fast path (models/set_fast.py): "
+                        "identical function and checkpoint tree, "
+                        "activations batch-in-lanes, bf16 block compute "
+                        "by default (override with --compute-dtype "
+                        "float32); ~1.7x honest end-to-end throughput at "
+                        "tpu4096")
     p.add_argument("--num-heads", type=int, default=None,
                    help="set-transformer attention heads (cluster_set only; "
                         "default 1 — multi-head measured 3x slower at small "
@@ -241,6 +259,21 @@ def main(argv: list[str] | None = None) -> Path:
             f"--fused-gnn selects the Pallas cluster_graph policy; it has "
             f"no meaning for --env {args.env}"
         )
+    if args.fused_set:
+        if args.env != "cluster_set":
+            raise SystemExit(
+                f"--fused-set selects the batch-minor cluster_set policy; "
+                f"it has no meaning for --env {args.env}"
+            )
+        if args.num_heads is not None and args.num_heads != 1:
+            raise SystemExit(
+                f"--fused-set is single-head; --num-heads {args.num_heads} "
+                "needs the flax policy (drop --fused-set)"
+            )
+        if args.compute_dtype is None:
+            # The fast path's measured win includes bf16 block compute;
+            # make it the default unless the user pins a dtype.
+            cfg = dataclasses.replace(cfg, compute_dtype="bfloat16")
     if args.dp != 1:
         # Full validation here, BEFORE the run directory is created: every
         # bad flag combination in this CLI exits with an actionable message
@@ -264,7 +297,8 @@ def main(argv: list[str] | None = None) -> Path:
             )
     bundle, net = make_bundle_and_net(args.env, cfg, args.legacy_reward_sign,
                                       fault_prob, args.num_heads,
-                                      fused_gnn=args.fused_gnn)
+                                      fused_gnn=args.fused_gnn,
+                                      fused_set=args.fused_set)
 
     run_name = args.run_name or f"PPO_{args.preset}_{time.strftime('%Y%m%d_%H%M%S')}"
     run_dir = Path(args.run_root) / run_name
@@ -380,10 +414,11 @@ def main(argv: list[str] | None = None) -> Path:
                 "hidden": list(cfg.hidden) if net is None else None,
                 # attention head count for the set policy (resume guard)
                 "num_heads": getattr(net, "num_heads", None),
-                # provenance: the fused Pallas path produces identical
+                # provenance: the fused paths produce identical
                 # checkpoints, but reproductions need to know which path
                 # the run's throughput came from
                 "fused_gnn": args.fused_gnn,
+                "fused_set": args.fused_set,
                 "legacy_reward_sign": args.legacy_reward_sign})
 
     mesh = None
