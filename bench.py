@@ -2,11 +2,16 @@
 
 Runs the fused PPO train step (rollout + GAE + minibatch SGD in one XLA
 program) on 4096 vmapped envs and reports env-steps/sec on one chip over
-the best of three 5-iteration windows (best-of filters out interference
-when the chip sits behind a network tunnel; windows agree within a few
-percent on quiet hardware). Baseline: the reference's Ray RLlib pipeline
-sustains ~60 env-steps/s on its documented hardware (SURVEY.md §6: 640k
-steps in ~3h).
+the best of three 20-iteration windows. Each window is ONE dispatched
+program (``lax.scan`` over the update), so per-dispatch/tunnel overhead is
+amortized 20x, and the window is closed by fetching a metric value to the
+host — ``jax.device_get`` — because ``jax.block_until_ready`` does NOT
+reliably synchronize on tunneled backends (round-3 finding: it returned
+before execution finished, making op-level timings meaningless; fetching
+a value that depends on the computation is the only trustworthy sync).
+
+Baseline: the reference's Ray RLlib pipeline sustains ~60 env-steps/s on
+its documented hardware (SURVEY.md §6: 640k steps in ~3h).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -32,28 +37,30 @@ def main() -> None:
     init_fn, update_fn, _ = make_ppo(env_params, cfg)
     runner = jax.jit(init_fn)(jax.random.PRNGKey(0))
 
-    # The timed window is ONE dispatched program fusing `iters` updates
-    # (lax.scan, the --updates-per-dispatch mechanism): a single host
-    # round-trip per window keeps tunnel latency out of the measurement
-    # entirely, rather than merely amortized over 5 dispatches.
-    iters, repeats = 5, 3
+    iters, repeats = 20, 3
 
     def window(r):
         return jax.lax.scan(lambda rr, _: update_fn(rr), r, None, length=iters)
 
     update = jax.jit(window, donate_argnums=0)
 
+    def sync(r) -> float:
+        # Fetch a parameter value: params depend on EVERY SGD phase of the
+        # window including the last iteration's (a metric like reward_mean
+        # would not cover the final SGD tail), so this provably waits for
+        # the whole window on every backend (see module docstring).
+        leaf = jax.tree.leaves(r.params)[0]
+        return float(jax.device_get(leaf).ravel()[0])
+
     # Warmup: compile + one full window.
     runner, metrics = update(runner)
-    jax.block_until_ready(metrics)
+    sync(runner)
 
-    # Repeat the timed window and take the best: the chip may sit behind a
-    # network tunnel where interference can pollute a single window.
     best_elapsed = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
         runner, metrics = update(runner)
-        jax.block_until_ready(metrics)
+        sync(runner)
         best_elapsed = min(best_elapsed, time.perf_counter() - t0)
 
     steps_per_sec = cfg.batch_size * iters / best_elapsed

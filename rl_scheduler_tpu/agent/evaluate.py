@@ -320,6 +320,14 @@ def main(argv: list[str] | None = None) -> EvalReport:
 
         algo = meta.get("algo", "ppo")
         hidden = tuple(meta.get("hidden") or (256, 256))
+        # tp-trained checkpoints store the full global matrices in
+        # TPActorCritic layout; convert once to the ActorCritic tree
+        # (identical function) so evaluation needs no mesh.
+        from rl_scheduler_tpu.parallel.tensor_parallel import (
+            untp_checkpoint_tree,
+        )
+
+        params = untp_checkpoint_tree(meta, params)
         net = build_flat_policy_net(algo, env_core.NUM_ACTIONS, hidden)
         if args.quick:
             quick_eval(env_params, net, params)

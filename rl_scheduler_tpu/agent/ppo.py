@@ -148,6 +148,7 @@ def make_ppo_bundle(
     cfg: PPOTrainConfig,
     net: Any | None = None,
     axis_name: str | None = None,
+    tx: optax.GradientTransformation | None = None,
 ) -> tuple[Callable, Callable, Any]:
     """Build ``(init_fn, update_fn, net)`` for ANY :class:`EnvBundle`.
 
@@ -157,6 +158,10 @@ def make_ppo_bundle(
     minibatched SGD. With ``axis_name`` set, gradients (and reported metrics)
     are pmean-reduced over that mesh axis — the data-parallel path used by
     ``parallel/sharding.py``; ``cfg.num_envs`` is then the per-device count.
+
+    ``tx`` overrides the optimizer (default :func:`make_optimizer` from the
+    config) — the tensor-parallel path passes a tp-aware clip chain whose
+    global norm psums sharded leaves over the ``tp`` axis.
 
     The policy ``net`` must map an observation batch ``[B, *obs_shape]`` to
     ``(logits [B, num_actions], value [B])`` — MLPs over flat obs and
@@ -190,7 +195,7 @@ def make_ppo_bundle(
         hidden=cfg.hidden,
         dtype=compute_dtypes[cfg.compute_dtype],
     )
-    tx = make_optimizer(cfg)
+    tx = tx if tx is not None else make_optimizer(cfg)
     obs_shape = tuple(bundle.obs_shape)
 
     def init_fn(key: jnp.ndarray) -> RunnerState:
@@ -445,15 +450,25 @@ def ppo_train(
     eval_log_fn: Callable[[int, dict], None] | None = None,
     updates_per_dispatch: int = 1,
     mesh=None,
+    eval_net: Any | None = None,
 ):
     """Host-side training loop: jitted update per iteration + logging hooks.
 
     ``mesh``: a ``jax.sharding.Mesh`` with a ``dp`` axis runs the update
     data-parallel via ``shard_map`` (``parallel/sharding.py``) — env batch
-    sharded, params replicated, gradients pmean'd over ICI. Everything
-    else (checkpointing, resume, in-training eval, metric logging, fused
-    dispatch) is unchanged: the sharded runner's leaves are ordinary
-    global arrays. ``cfg.num_envs`` is the GLOBAL env count.
+    sharded, params replicated, gradients pmean'd over ICI. A mesh with a
+    ``tp`` axis > 1 runs Megatron-style tensor parallelism
+    (``parallel/tensor_parallel.py`` — ``net`` must be None; the path owns
+    its TPActorCritic); an ``sp`` axis > 1 runs sequence parallelism over
+    the policy's node axis (``net`` must be the structured policy built
+    with ``axis_name='sp'``). Everything else (checkpointing, resume,
+    in-training eval, metric logging, fused dispatch) is unchanged: the
+    sharded runner's leaves are ordinary global arrays. ``cfg.num_envs``
+    is the GLOBAL env count.
+
+    ``eval_net``: unsharded twin used by the in-training greedy eval when
+    the training ``net`` only works inside ``shard_map`` (sp's collectives,
+    tp's psum). Defaults to ``net``; the tp path builds its own twin.
 
     ``updates_per_dispatch=k`` fuses ``k`` whole PPO iterations into ONE
     dispatched program (``lax.scan`` over the update; metrics stacked and
@@ -511,13 +526,49 @@ def ppo_train(
                 "under test in this run", stacklevel=2)
         cfg = dataclasses.replace(cfg, gae_impl="scan")
     if mesh is not None:
-        from rl_scheduler_tpu.parallel.sharding import (
-            make_data_parallel_ppo_bundle,
-        )
+        if mesh.shape.get("tp", 1) > 1:
+            from rl_scheduler_tpu.parallel.tensor_parallel import (
+                make_tensor_parallel_ppo,
+            )
 
-        init_fn, update_fn, net = make_data_parallel_ppo_bundle(
-            bundle, cfg, mesh, net=net
-        )
+            if net is not None:
+                raise ValueError(
+                    "the tensor-parallel path builds its own TPActorCritic "
+                    "from cfg.hidden; a custom net cannot be tp-sharded"
+                )
+            init_fn, update_fn, net = make_tensor_parallel_ppo(
+                bundle, cfg, mesh
+            )
+            if eval_net is None and cfg.eval_every > 0:
+                from rl_scheduler_tpu.parallel.tensor_parallel import (
+                    TPActorCritic,
+                )
+
+                # Checkpoint/runner params are the full global matrices;
+                # the unsharded twin computes the identical function.
+                eval_net = TPActorCritic(
+                    num_actions=bundle.num_actions, hidden=cfg.hidden,
+                    tp_axis=None, tp_size=1,
+                )
+        elif mesh.shape.get("sp", 1) > 1:
+            from rl_scheduler_tpu.parallel.sharding import make_seq_parallel_ppo
+
+            if net is None or getattr(net, "axis_name", None) != "sp":
+                raise ValueError(
+                    "the sequence-parallel path needs a structured policy "
+                    "built with axis_name='sp' (e.g. SetTransformerPolicy)"
+                )
+            init_fn, update_fn, net = make_seq_parallel_ppo(
+                bundle, cfg, net, mesh
+            )
+        else:
+            from rl_scheduler_tpu.parallel.sharding import (
+                make_data_parallel_ppo_bundle,
+            )
+
+            init_fn, update_fn, net = make_data_parallel_ppo_bundle(
+                bundle, cfg, mesh, net=net
+            )
     else:
         init_fn, update_fn, net = make_ppo_bundle(bundle, cfg, net=net)
     start_iteration = 0
@@ -540,7 +591,8 @@ def ppo_train(
 
     update = make_update(update_fn, debug_checks, updates_per_dispatch)
     eval_hook = make_greedy_eval_hook(
-        bundle, net, cfg.eval_every, cfg.eval_episodes, seed, eval_log_fn
+        bundle, eval_net if eval_net is not None else net,
+        cfg.eval_every, cfg.eval_episodes, seed, eval_log_fn,
     )
 
     return run_train_loop(

@@ -169,6 +169,21 @@ def main(argv: list[str] | None = None) -> Path:
                         "ICI (shard_map). -1 = all visible devices; "
                         "--num-envs stays the GLOBAL count; both num-envs "
                         "and minibatch-size must divide by dp")
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel device count (cluster_set only): "
+                        "shard the set policy's NODE axis over an sp mesh "
+                        "axis — attention runs as ring attention over ICI "
+                        "(parallel/ring_attention.py). Composes with --dp "
+                        "into one dp x sp mesh; the node count (8) must "
+                        "divide by sp")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel device count (flat-obs envs): "
+                        "Megatron column/row-shard the MLP torso weights "
+                        "over a tp mesh axis (parallel/tensor_parallel.py). "
+                        "Composes with --dp into one dp x tp mesh; the "
+                        "column widths (even indices of --hidden) must "
+                        "divide by tp and --hidden needs an even number "
+                        "of widths (col/row pairs)")
     p.add_argument("--updates-per-dispatch", type=int, default=1,
                    help="fuse K whole PPO iterations into one jitted "
                         "dispatch (lax.scan over the update); removes the "
@@ -274,7 +289,7 @@ def main(argv: list[str] | None = None) -> Path:
             # The fast path's measured win includes bf16 block compute;
             # make it the default unless the user pins a dtype.
             cfg = dataclasses.replace(cfg, compute_dtype="bfloat16")
-    if args.dp != 1:
+    if args.dp != 1 or args.sp != 1 or args.tp != 1:
         # Full validation here, BEFORE the run directory is created: every
         # bad flag combination in this CLI exits with an actionable message
         # rather than a mid-setup traceback and an empty run dir.
@@ -283,12 +298,75 @@ def main(argv: list[str] | None = None) -> Path:
                 f"--dp {args.dp}: pass a device count >= 2, or -1 for all "
                 "visible devices"
             )
+        if args.sp < 1 or args.tp < 1:
+            raise SystemExit(
+                f"--sp {args.sp} / --tp {args.tp}: pass device counts >= 1"
+            )
+        if args.sp > 1 and args.tp > 1:
+            raise SystemExit(
+                "--sp and --tp cannot combine: sp shards the structured "
+                "policies' node axis, tp shards the flat MLP torso — no "
+                "policy has both. Compose --dp with ONE of them."
+            )
         if args.debug_checks:
             raise SystemExit(
                 "--debug-checks cannot instrument the shard_map'd update; "
-                "drop --dp for checkified debugging"
+                "drop --dp/--sp/--tp for checkified debugging"
             )
-        ndev = args.dp if args.dp > 0 else len(jax.devices())
+        if args.sp > 1:
+            if args.env != "cluster_set":
+                raise SystemExit(
+                    f"--sp shards the set policy's node axis; --env "
+                    f"{args.env} has no sequence-parallel policy (use "
+                    "cluster_set)"
+                )
+            if args.fused_set:
+                raise SystemExit(
+                    "--fused-set is the single-chip batch-minor path; "
+                    "sequence parallelism needs the flax policy's ring "
+                    "attention (drop one of the flags)"
+                )
+            if 8 % args.sp:
+                raise SystemExit(
+                    f"--sp {args.sp}: the cluster_set node axis (8) must "
+                    "divide by sp"
+                )
+        if args.tp > 1:
+            if args.env not in ("multi_cloud", "single_cluster"):
+                raise SystemExit(
+                    f"--tp shards the flat MLP policy; --env {args.env} "
+                    "uses a structured policy (tp applies to multi_cloud/"
+                    "single_cluster)"
+                )
+            if len(cfg.hidden) % 2:
+                raise SystemExit(
+                    f"--tp needs col/row layer pairs: --hidden has "
+                    f"{len(cfg.hidden)} widths (pass an even count)"
+                )
+            bad = [h for i, h in enumerate(cfg.hidden) if i % 2 == 0 and h % args.tp]
+            if bad:
+                raise SystemExit(
+                    f"--tp {args.tp}: column widths {bad} must divide by tp"
+                )
+        # Mirror make_mesh's arithmetic exactly so every bad device split
+        # exits here with an actionable message, not as a ValueError after
+        # the run directory exists.
+        n_visible = len(jax.devices())
+        fixed = args.sp * args.tp
+        if args.dp == -1:
+            if n_visible % fixed:
+                raise SystemExit(
+                    f"--dp -1 with sp*tp={fixed}: {n_visible} visible "
+                    "devices do not divide evenly (pass an explicit --dp)"
+                )
+            ndev = n_visible // fixed
+        else:
+            ndev = args.dp
+            if ndev * fixed > n_visible:
+                raise SystemExit(
+                    f"mesh dp={ndev} x sp={args.sp} x tp={args.tp} needs "
+                    f"{ndev * fixed} devices; only {n_visible} visible"
+                )
         if cfg.num_envs % ndev or cfg.minibatch_size % ndev:
             raise SystemExit(
                 f"--dp {ndev}: num_envs={cfg.num_envs} and "
@@ -299,6 +377,14 @@ def main(argv: list[str] | None = None) -> Path:
                                       fault_prob, args.num_heads,
                                       fused_gnn=args.fused_gnn,
                                       fused_set=args.fused_set)
+    eval_net = None
+    if args.sp > 1:
+        # Training net: the bundle's own policy cloned with axis_name="sp"
+        # so its attention rides the ring over ICI inside shard_map; the
+        # plain policy (identical parameter tree) stays as the in-training
+        # eval twin, which runs outside shard_map.
+        eval_net = net
+        net = net.clone(axis_name="sp")
 
     run_name = args.run_name or f"PPO_{args.preset}_{time.strftime('%Y%m%d_%H%M%S')}"
     run_dir = Path(args.run_root) / run_name
@@ -366,14 +452,43 @@ def main(argv: list[str] | None = None) -> Path:
                 f"opposite sign would silently negate rewards mid-run "
                 f"({'add' if ckpt_legacy else 'drop'} --legacy-reward-sign)"
             )
-        from rl_scheduler_tpu.agent.ppo import make_ppo_bundle
+        ckpt_tp = meta.get("tp") or 1
+        if ckpt_tp != args.tp:
+            # The PARAM tree differs (TPActorCritic col/row pairs vs
+            # ActorCritic Dense stack), not just the sharding — a silent
+            # restore would fail deep in Orbax or train the wrong module.
+            raise SystemExit(
+                f"--resume: run was trained with --tp {ckpt_tp}; resuming "
+                f"with --tp {args.tp} would restore a different network "
+                f"layout (pass --tp {ckpt_tp})"
+            )
+        if (meta.get("sp") or 1) != args.sp:
+            raise SystemExit(
+                f"--resume: run was trained with --sp {meta.get('sp') or 1}; "
+                f"pass the same --sp (param shapes match, but the RNG/env "
+                "replication layout does not)"
+            )
+        if args.tp > 1:
+            from rl_scheduler_tpu.parallel.tensor_parallel import (
+                tp_abstract_state,
+            )
 
-        init_fn, _, _ = make_ppo_bundle(bundle, cfg, net=net)
-        abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(args.seed))
-        tree, _ = ckpt.restore(
-            latest,
-            target={"params": abstract.params, "opt_state": abstract.opt_state},
-        )
+            tree, _ = ckpt.restore(latest, target=tp_abstract_state(bundle, cfg))
+        else:
+            from rl_scheduler_tpu.agent.ppo import make_ppo_bundle
+
+            # For sp runs the abstract tree comes from the unsharded twin
+            # (identical param shapes; the sp net's collectives cannot
+            # trace outside shard_map).
+            init_fn, _, _ = make_ppo_bundle(
+                bundle, cfg, net=eval_net if args.sp > 1 else net
+            )
+            abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(args.seed))
+            tree, _ = ckpt.restore(
+                latest,
+                target={"params": abstract.params,
+                        "opt_state": abstract.opt_state},
+            )
         restore = (tree, latest)
         # Mark the resume point in the metrics log so post-crash duplicate
         # iteration entries are separable by downstream analysis.
@@ -419,16 +534,26 @@ def main(argv: list[str] | None = None) -> Path:
                 # the run's throughput came from
                 "fused_gnn": args.fused_gnn,
                 "fused_set": args.fused_set,
+                # mesh axes: tp changes the param-tree layout (serving
+                # converts it, parallel/tensor_parallel.py); sp only
+                # changes the training-time replication layout
+                "tp": args.tp,
+                "sp": args.sp,
                 "legacy_reward_sign": args.legacy_reward_sign})
 
     mesh = None
-    if args.dp != 1:
+    if args.dp != 1 or args.sp > 1 or args.tp > 1:
         from rl_scheduler_tpu.parallel import make_mesh
 
-        mesh = make_mesh({"dp": args.dp})
-        print(f"Data-parallel over {mesh.shape['dp']} devices "
-              f"({cfg.num_envs} global envs -> "
-              f"{cfg.num_envs // mesh.shape['dp']}/device)")
+        axes = {"dp": args.dp}
+        if args.sp > 1:
+            axes["sp"] = args.sp
+        if args.tp > 1:
+            axes["tp"] = args.tp
+        mesh = make_mesh(axes)
+        desc = " x ".join(f"{k}={v}" for k, v in mesh.shape.items())
+        print(f"Mesh {desc} ({cfg.num_envs} global envs -> "
+              f"{cfg.num_envs // mesh.shape['dp']}/dp-member)")
 
     print(f"Training PPO preset={args.preset} env={args.env} on "
           f"{jax.devices()[0].platform} "
@@ -447,7 +572,7 @@ def main(argv: list[str] | None = None) -> Path:
                   debug_checks=args.debug_checks, sync_every=args.sync_every,
                   eval_log_fn=make_eval_log_fn(metrics_file, tb),
                   updates_per_dispatch=args.updates_per_dispatch,
-                  mesh=mesh)
+                  mesh=mesh, eval_net=eval_net)
     metrics_file.close()
     if tb is not None:
         tb.close()

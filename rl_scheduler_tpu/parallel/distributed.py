@@ -35,12 +35,36 @@ def maybe_initialize_distributed(
 ) -> bool:
     """Initialize ``jax.distributed`` when multi-host coordinates exist.
 
-    Resolution order: explicit arguments, then ``RL_SCHED_COORDINATOR`` /
-    ``RL_SCHED_NUM_PROCESSES`` / ``RL_SCHED_PROCESS_ID`` env vars, then
+    Resolution order: explicit arguments, then environment variables, then
     JAX's own auto-detection on managed TPU pods (where
     ``jax.distributed.initialize()`` needs no arguments — detected via
     the standard TPU pod metadata envs). Returns ``True`` iff
     initialization ran.
+
+    The environment contract (set all three on EVERY process):
+
+    - ``RL_SCHED_COORDINATOR`` — ``host:port`` of process 0's coordinator
+      service (any free port on the rank-0 host; the other processes
+      connect to it over DCN).
+    - ``RL_SCHED_NUM_PROCESSES`` — total process (host) count.
+    - ``RL_SCHED_PROCESS_ID`` — this process's rank, ``0 .. N-1``,
+      unique per process.
+
+    Example — a 4-host launch (one line per host)::
+
+        RL_SCHED_COORDINATOR=10.0.0.1:8476 RL_SCHED_NUM_PROCESSES=4 \
+            RL_SCHED_PROCESS_ID=0 python -m rl_scheduler_tpu.agent.train_ppo ...
+        RL_SCHED_COORDINATOR=10.0.0.1:8476 RL_SCHED_NUM_PROCESSES=4 \
+            RL_SCHED_PROCESS_ID=1 python -m rl_scheduler_tpu.agent.train_ppo ...
+        # ... ranks 2 and 3 likewise
+
+    After initialization ``jax.devices()`` is GLOBAL (all hosts' chips),
+    so a ``make_mesh({"dp": -1})`` spans the fleet and collectives route
+    ICI within a host, DCN across. On managed TPU pod slices none of this
+    is needed: the TPU metadata envs (``TPU_WORKER_HOSTNAMES`` with >1
+    worker, or ``MEGASCALE_COORDINATOR_ADDRESS``) trigger argument-less
+    auto-init. ``tests/test_multihost.py`` exercises both 2x4 and 4x2
+    process/device topologies through exactly this contract.
     """
     coordinator_address = coordinator_address or os.environ.get(_ENV_COORDINATOR)
     if num_processes is None and os.environ.get(_ENV_NUM_PROCS):

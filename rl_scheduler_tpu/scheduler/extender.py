@@ -366,7 +366,16 @@ def build_policy(
                     # run being the newest must serve a Q-network, not be
                     # misread as an actor-critic tree.
                     algo = meta.get("algo", "ppo")
-                    params_tree = tree
+                    # tp-trained runs checkpoint full global matrices in
+                    # TPActorCritic layout; converting to the ActorCritic
+                    # tree (identical function) lets every backend —
+                    # numpy, native C++, torch, jax AOT — serve them
+                    # unchanged.
+                    from rl_scheduler_tpu.parallel.tensor_parallel import (
+                        untp_checkpoint_tree,
+                    )
+
+                    params_tree = untp_checkpoint_tree(meta, tree)
                     logger.info("serving %s checkpoint from %s", algo, run_dir)
                 except Exception:  # malformed meta (e.g. hand-edited
                     # non-iterable "hidden") is a corrupt checkpoint too:

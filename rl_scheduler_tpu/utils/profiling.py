@@ -8,8 +8,14 @@ Two tools:
   ``tensorboard --logdir <dir>`` (Profile tab) or upload the
   ``.trace.json.gz`` to ``ui.perfetto.dev``.
 - :class:`StepTimer` — wall-clock timing of a jitted step function with
-  proper device synchronization (``block_until_ready`` per sample), giving
-  p50/mean step latency and env-steps/sec/chip — the BASELINE.json metric.
+  proper device synchronization, giving p50/mean step latency and
+  env-steps/sec/chip — the BASELINE.json metric. Synchronization is a
+  ``jax.device_get`` of the smallest state leaf, NOT
+  ``jax.block_until_ready``: on tunneled backends the latter can return
+  before execution finishes (observed on the round-3 bench chip —
+  "timed" matmuls at physically impossible FLOP rates), silently turning
+  timings into dispatch-overhead measurements. Fetching a value that
+  data-depends on the step is the only sync that provably waits.
 """
 
 from __future__ import annotations
@@ -58,20 +64,39 @@ class StepTimer:
         self._fn = fn
         self._steps_per_iter = env_steps_per_iter
         self._returns_aux = returns_aux
+        self._sync_fn = None
 
     def _step(self, state):
         out = self._fn(state)
         return out[0] if self._returns_aux else out
 
+    def _sync(self, state) -> None:
+        """Force completion by fetching a scalar that data-depends on
+        EVERY state leaf (module docstring: block_until_ready is not a
+        reliable sync, and fetching a compute-independent leaf — e.g. an
+        iteration counter — would not provably wait either)."""
+        if self._sync_fn is None:
+            import jax.numpy as jnp
+
+            def reduce_all(tree):
+                parts = [
+                    jnp.ravel(leaf)[0].astype(jnp.float32)
+                    for leaf in jax.tree.leaves(tree)
+                ]
+                return sum(parts, jnp.float32(0))
+
+            self._sync_fn = jax.jit(reduce_all)
+        jax.device_get(self._sync_fn(state))
+
     def run(self, state, iters: int = 10) -> tuple:
         state = self._step(state)
-        jax.block_until_ready(state)
+        self._sync(state)
 
         samples = []
         for _ in range(iters):
             t0 = time.perf_counter()
             state = self._step(state)
-            jax.block_until_ready(state)
+            self._sync(state)
             samples.append(time.perf_counter() - t0)
         arr = np.asarray(samples)
         report = StepReport(

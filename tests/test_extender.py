@@ -363,3 +363,55 @@ def test_extender_bench_tool(server):
     assert out["requests"] == 40
     assert out["client_p50_ms"] > 0 and out["server_p50_ms"] > 0
     assert out["backend"] == "cpu"
+
+
+def test_load_aware_jax_sheds_overflow_bit_identically(params_tree):
+    """The serving 'jax' flag (LoadAwareJaxBackend): at low concurrency it
+    runs the AOT dispatcher; past max_concurrent_jax it routes to the
+    native/numpy forward — and every routed decision is bit-identical, so
+    shedding is invisible to the scheduler."""
+    import threading
+
+    from rl_scheduler_tpu.scheduler.policy_backend import (
+        LoadAwareJaxBackend,
+    )
+
+    backend = LoadAwareJaxBackend(params_tree, hidden=HIDDEN,
+                                  max_concurrent_jax=1)
+    ref = NumpyMLPBackend(params_tree)
+    rng = np.random.default_rng(5)
+    obs_batch = rng.uniform(0, 1, size=(64, env_core.OBS_DIM)).astype(np.float32)
+
+    # single-stream: all jax, nothing shed
+    for obs in obs_batch[:8]:
+        action, _ = backend.decide(obs)
+        assert action == ref.decide(obs)[0]
+    assert backend.shed_fraction == 0.0
+
+    # 8 threads hammering max_concurrent_jax=1 MUST shed some requests,
+    # and every decision still matches the reference forward.
+    mismatches = []
+    def worker(rows):
+        for obs in rows:
+            action, _ = backend.decide(obs)
+            if action != ref.decide(obs)[0]:
+                mismatches.append(obs)
+
+    threads = [threading.Thread(target=worker, args=(obs_batch,))
+               for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not mismatches
+    assert backend.shed_fraction > 0.0
+    assert backend.name == "jax"
+
+
+def test_make_backend_jax_is_load_aware(params_tree):
+    from rl_scheduler_tpu.scheduler.policy_backend import (
+        LoadAwareJaxBackend,
+    )
+
+    backend, fell_back = make_backend("jax", params_tree, hidden=HIDDEN)
+    assert isinstance(backend, LoadAwareJaxBackend) and not fell_back

@@ -121,3 +121,67 @@ def test_train_cli_dp(tmp_path):
     mgr = CheckpointManager(run_dir)
     assert mgr.latest_step() == 6
     mgr.close()
+
+
+def test_train_cli_dp_sp(tmp_path):
+    """VERDICT r2 item 2: --sp composes with --dp from the command line —
+    cluster_set trains on a dp x sp mesh (ring attention over the node
+    axis) with checkpointing, in-training eval, and resume."""
+    import json
+
+    from rl_scheduler_tpu.agent import train_ppo as cli
+    from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+    argv = [
+        "--preset", "quick", "--env", "cluster_set", "--dp", "2", "--sp", "2",
+        "--num-envs", "8", "--rollout-steps", "16", "--minibatch-size", "32",
+        "--eval-every", "2", "--eval-episodes", "2",
+        "--checkpoint-every", "2", "--run-root", str(tmp_path),
+        "--run-name", "sp_cli",
+    ]
+    run_dir = cli.main(argv + ["--iterations", "2"])
+    mgr = CheckpointManager(run_dir)
+    meta = mgr.restore_meta(2)
+    mgr.close()
+    assert meta["sp"] == 2 and meta["env"] == "cluster_set"
+    records = [json.loads(l) for l in (run_dir / "metrics.jsonl").open()]
+    trains = [r for r in records if not r.get("eval")
+              and "resumed_from_iteration" not in r]
+    evals = [r for r in records if r.get("eval")]
+    assert all(np.isfinite(r["reward_mean"]) for r in trains)
+    assert evals and np.isfinite(evals[0]["eval_episode_reward_mean"])
+
+    # resume continues (param shapes are sp-invariant; the abstract tree
+    # comes from the unsharded twin)
+    cli.main(argv + ["--iterations", "4", "--resume"])
+    mgr = CheckpointManager(run_dir)
+    assert mgr.latest_step() == 4
+    mgr.close()
+
+    # sp mismatch on resume is refused
+    with pytest.raises(SystemExit, match="--sp"):
+        cli.main([
+            "--preset", "quick", "--env", "cluster_set", "--dp", "2",
+            "--num-envs", "8", "--rollout-steps", "16",
+            "--minibatch-size", "32", "--iterations", "6", "--resume",
+            "--run-root", str(tmp_path), "--run-name", "sp_cli",
+        ])
+
+
+def test_sp_tp_flag_validation(tmp_path):
+    from rl_scheduler_tpu.agent import train_ppo as cli
+
+    root = ["--run-root", str(tmp_path)]
+    with pytest.raises(SystemExit, match="cannot combine"):
+        cli.main(["--sp", "2", "--tp", "2", "--env", "cluster_set"] + root)
+    with pytest.raises(SystemExit, match="node axis"):
+        cli.main(["--sp", "2", "--env", "multi_cloud"] + root)
+    with pytest.raises(SystemExit, match="structured policy"):
+        cli.main(["--tp", "2", "--env", "cluster_graph"] + root)
+    with pytest.raises(SystemExit, match="divide by sp"):
+        cli.main(["--sp", "3", "--env", "cluster_set"] + root)
+    with pytest.raises(SystemExit, match="column widths"):
+        cli.main(["--tp", "2", "--hidden", "15,16",
+                  "--env", "multi_cloud"] + root)
+    with pytest.raises(SystemExit, match="ring attention"):
+        cli.main(["--sp", "2", "--fused-set", "--env", "cluster_set"] + root)
