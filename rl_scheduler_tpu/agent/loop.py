@@ -100,12 +100,33 @@ def run_train_loop(
             f"iteration span {num_iterations - start_iteration} not "
             f"divisible by updates_per_dispatch={k}"
         )
+    if start_iteration % k:
+        # Observed iteration boundaries are start + n*k; a misaligned
+        # resume point would shift every boundary off the eval/checkpoint
+        # intervals, silently skipping both even when the intervals
+        # themselves divide by k.
+        raise ValueError(
+            f"start_iteration={start_iteration} not divisible by "
+            f"updates_per_dispatch={k}; resume at a multiple of the "
+            "dispatch factor (or train the stub iterations with k=1)"
+        )
     if eval_every > 0 and eval_hook is not None and eval_every % k:
         # The loop only observes iteration boundaries at dispatch ends;
         # a non-multiple interval would silently skip evals.
         raise ValueError(
             f"eval_every={eval_every} not divisible by "
             f"updates_per_dispatch={k}; evals would be silently dropped"
+        )
+    ckpt_every = getattr(checkpoint_fn, "every", None)
+    if ckpt_every is not None and ckpt_every > 0 and ckpt_every % k:
+        # Same failure mode as eval_every: with k > 1 checkpoint_fn only
+        # ever sees i = i0 + k - 1, so a non-multiple interval silently
+        # skips periodic checkpoints (make_periodic_checkpoint_fn tags
+        # its interval precisely so this check can see it).
+        raise ValueError(
+            f"checkpoint interval {ckpt_every} not divisible by "
+            f"updates_per_dispatch={k}; periodic checkpoints would be "
+            "silently dropped"
         )
     try:
         for i0 in range(start_iteration, num_iterations, k):
@@ -263,4 +284,7 @@ def make_periodic_checkpoint_fn(
         if (i + 1) % every == 0 or (i + 1) == total_iterations:
             ckpt.save(i + 1, tree_fn(runner), extras=extras)
 
+    # run_train_loop validates this against updates_per_dispatch (fused
+    # dispatches only observe every k-th iteration boundary).
+    checkpoint_fn.every = every
     return checkpoint_fn

@@ -558,6 +558,11 @@ def ppo_train(
                     "the sequence-parallel path needs a structured policy "
                     "built with axis_name='sp' (e.g. SetTransformerPolicy)"
                 )
+            if eval_net is None and cfg.eval_every > 0:
+                # The sp net's collectives cannot trace outside shard_map;
+                # the unsharded clone computes the identical function
+                # (ring attention is exact and parameter-shape-preserving).
+                eval_net = net.clone(axis_name=None)
             init_fn, update_fn, net = make_seq_parallel_ppo(
                 bundle, cfg, net, mesh
             )

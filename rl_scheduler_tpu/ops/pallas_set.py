@@ -1,14 +1,23 @@
 """Fused Pallas TPU kernels for the set-transformer policy (config 4).
 
-WHY: the config-4 scorecard entry (docs/status.md) documents an XLA
-fusion/layout pathology: inside the fused PPO update, each scanned SGD
-minibatch of the attention policy compiles to ~970 ops including ~1.8 ms
-of pure layout copies — ~20x slower than the identical body compiled
-standalone — and no XLA-level knob (scan unroll, shuffle granularity,
-minibatch shape, lean attention) moved it. As with the GNN
-(``ops/pallas_gnn.py``), the escape hatch is to take layout/fusion
-decisions away from XLA: one kernel computes the whole policy per row
-block with every activation VMEM-resident.
+STATUS (round 3): parity-tested but NOT the fast path. The round-2
+numbers that motivated these kernels (0.16 ms/minibatch isolated, "55x")
+were taken with ``jax.block_until_ready``, which does not synchronize on
+the bench backend; measured honestly (fetch-based sync, window slope —
+docs/status.md) this kernel suite runs ~48 ms per 32768-row minibatch
+vs ~17 ms for the flax module. The measured config-4 fast path is the
+batch-minor formulation in ``models/set_fast.py`` (``train_ppo
+--fused-set``), which attacks the same layout problem in plain XLA.
+These kernels stay as the in-VMEM reference implementation and for the
+kernel-authoring techniques documented below.
+
+WHY (round-2 analysis, retained): inside the fused PPO update, each
+scanned SGD minibatch of the attention policy compiles to ~970 ops
+including ~1.8 ms of pure layout copies, and no XLA-level knob (scan
+unroll, shuffle granularity, minibatch shape, lean attention) moved it.
+As with the GNN (``ops/pallas_gnn.py``), the escape hatch tried here is
+taking layout/fusion decisions away from XLA: one kernel computes the
+whole policy per row block with every activation VMEM-resident.
 
 HOW, differently from the GNN kernel: no Kronecker weight blowup. The
 node axis lives in the lane dimension as 8 contiguous 64-wide slices of
@@ -280,9 +289,25 @@ def make_fused_set_apply(
                                  memory_space=pltpu.VMEM)
 
     def _canon_tree(tree):
-        return jax.tree.map(
+        canon = jax.tree.map(
             lambda l: _canonical_2d(l.astype(jnp.float32)), tree
         )
+        bad = [
+            jax.tree_util.keystr(path)
+            for path, leaf in jax.tree_util.tree_leaves_with_path(canon)
+            if leaf.ndim > 2
+        ]
+        if bad:
+            # A num_heads>1 tree's q/k/v kernels stay 3-D after
+            # canonicalization; failing here names the real constraint
+            # instead of surfacing as an obscure rank error deep inside
+            # the Pallas trace.
+            raise ValueError(
+                f"fused set kernels are single-head (num_heads=1); these "
+                f"parameter leaves are still 3-D after canonicalization: "
+                f"{bad}. Re-train with num_heads=1 or use the flax policy."
+            )
+        return canon
 
     def _run_block_fwd(blk_tree, h):
         leaves, treedef = jax.tree_util.tree_flatten(blk_tree)
@@ -409,12 +434,18 @@ class FusedSetPolicy:
     Pallas forward/backward on the HOT path. ``init`` delegates to the
     reference module so parameter trees (and checkpoints) are identical.
 
+    NOT WIRED to the train CLI, deliberately: honestly timed (round 3,
+    module docstring) the kernel path LOSES to both the flax module and
+    the batch-minor fast path (``models/set_fast.py``) on the bench
+    backend — the round-2 in-situ regression (3.7 s vs 0.9 s per update)
+    was real, and re-measurement with trustworthy sync shows the isolated
+    "win" was a timing artifact. Anyone considering wiring this in must
+    re-measure with fetch-based sync first.
+
     ``apply`` dispatches by batch size: SGD minibatches (>=
-    ``min_fused_batch`` rows, where the XLA path's layout pathology lives)
-    run through the kernels; the rollout's per-step forwards (num_envs
-    rows inside the env scan, where a Pallas call measured far slower than
-    XLA in while-loop context) stay on the reference module. Both paths
-    compute the same function (parity-tested), so this is purely a
+    ``min_fused_batch`` rows) run through the kernels; the rollout's
+    per-step forwards stay on the reference module. Both paths compute
+    the same function (parity-tested), so this is purely a
     compilation-strategy switch.
     """
 

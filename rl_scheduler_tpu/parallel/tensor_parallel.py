@@ -421,12 +421,12 @@ def make_tensor_parallel_ppo(
         lambda k: probe.init(k, dummy), jax.random.PRNGKey(0)
     )
     param_specs = _spec_tree(abstract_params, tp_axis)
-    is_replicated_probe = jax.tree.map(lambda s: s == P(), param_specs)
+    is_replicated = jax.tree.map(lambda s: s == P(), param_specs)
     # Grad clipping (when configured) must see the GLOBAL norm: sharded
     # leaves psum over tp, replicated leaves count once (round 2 refused
     # this combination; tp_clip_by_global_norm makes it exact).
     tx = (
-        make_tp_optimizer(local_cfg, tp_axis, is_replicated_probe)
+        make_tp_optimizer(local_cfg, tp_axis, is_replicated)
         if ntp > 1
         else make_optimizer(local_cfg)
     )
@@ -444,7 +444,6 @@ def make_tensor_parallel_ppo(
         ep_return=P(dp_axis),
         update_idx=P(),
     )
-    is_replicated = jax.tree.map(lambda s: s == P(), param_specs)
 
     def local_init(key):
         dp_key = jax.random.fold_in(key, lax.axis_index(dp_axis))

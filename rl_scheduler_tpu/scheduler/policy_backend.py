@@ -216,6 +216,17 @@ class LoadAwareJaxBackend:
         except Exception as e:  # noqa: BLE001 - missing toolchain/.so
             logger.info("native overflow path unavailable (%s); numpy", e)
             self._overflow = NumpyMLPBackend(params_tree, algo)
+        if device != "cpu":
+            # Shedding is only bit-identical when the AOT path runs on the
+            # host's XLA-CPU (same f32 matmul semantics as numpy/C++). An
+            # accelerator AOT path could argmax-flip near-ties vs the host
+            # overflow forward, so decisions would depend on arrival
+            # timing — disable shedding rather than serve inconsistently.
+            logger.info(
+                "load-aware shedding disabled for serve device %r "
+                "(host overflow forward is not bit-identical to it)", device
+            )
+            max_concurrent_jax = float("inf")
         self._max = max_concurrent_jax
         self._lock = threading.Lock()
         # Only JAX-PATH calls count against the concurrency cap: a shed

@@ -10,12 +10,14 @@ Two tools:
 - :class:`StepTimer` — wall-clock timing of a jitted step function with
   proper device synchronization, giving p50/mean step latency and
   env-steps/sec/chip — the BASELINE.json metric. Synchronization is a
-  ``jax.device_get`` of the smallest state leaf, NOT
-  ``jax.block_until_ready``: on tunneled backends the latter can return
-  before execution finishes (observed on the round-3 bench chip —
+  ``jax.device_get`` of a jitted scalar reduction over EVERY state leaf,
+  NOT ``jax.block_until_ready``: on tunneled backends the latter can
+  return before execution finishes (observed on the round-3 bench chip —
   "timed" matmuls at physically impossible FLOP rates), silently turning
-  timings into dispatch-overhead measurements. Fetching a value that
-  data-depends on the step is the only sync that provably waits.
+  timings into dispatch-overhead measurements. Only fetching a value
+  that data-depends on the whole step provably waits (a single leaf is
+  not enough — e.g. an iteration counter completes without the step's
+  heavy compute).
 """
 
 from __future__ import annotations

@@ -116,3 +116,26 @@ class TestTrainCLIEval:
 
         assert PPO_PRESETS["final"].eval_every == 5
         assert PPO_PRESETS["final"].eval_episodes == 20
+
+
+def test_fused_dispatch_rejects_misaligned_checkpoint_interval():
+    """ADVICE r2: a checkpoint interval that updates_per_dispatch would
+    silently skip must raise up front, mirroring the eval_every check."""
+    import pytest
+
+    from rl_scheduler_tpu.agent.loop import (
+        make_periodic_checkpoint_fn,
+        run_train_loop,
+    )
+
+    class _Ckpt:
+        def save(self, step, tree, extras=None):
+            pass
+
+    fn = make_periodic_checkpoint_fn(_Ckpt(), 3, 8, lambda r: {}, {})
+    assert fn.every == 3
+    with pytest.raises(ValueError, match="checkpoint interval 3"):
+        run_train_loop(
+            lambda r: (r, {}), runner=None, start_iteration=0,
+            num_iterations=8, checkpoint_fn=fn, updates_per_dispatch=2,
+        )

@@ -131,3 +131,18 @@ def test_fused_policy_trains_ppo():
     runner, metrics = jax.jit(update_fn)(runner)
     for k in ("policy_loss", "value_loss", "entropy"):
         assert np.isfinite(float(metrics[k])), k
+
+
+def test_fused_apply_rejects_multihead_tree():
+    """ADVICE r2: a num_heads>1 checkpoint must fail with the constraint
+    named, not as a rank error deep inside the Pallas trace."""
+    import pytest
+
+    from rl_scheduler_tpu.models import SetTransformerPolicy
+    from rl_scheduler_tpu.ops.pallas_set import make_fused_set_apply
+
+    multi = SetTransformerPolicy(dim=64, depth=2, num_heads=4)
+    params = multi.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 6)))
+    apply = make_fused_set_apply(interpret=True)
+    with pytest.raises(ValueError, match="num_heads=1"):
+        apply(params, jnp.zeros((96, 8, 6)))
