@@ -106,6 +106,18 @@ def main(argv: list[str] | None = None) -> Path:
         cfg = dataclasses.replace(cfg, **overrides)
     bundle = make_bundle(args.env)
 
+    if args.updates_per_dispatch > 1 and args.checkpoint_every % args.updates_per_dispatch:
+        # Align a default cadence with the dispatch factor (see train_ppo;
+        # the loop rejects misaligned intervals as silently-skipping).
+        aligned = (
+            (args.checkpoint_every + args.updates_per_dispatch - 1)
+            // args.updates_per_dispatch * args.updates_per_dispatch
+        )
+        print(f"--checkpoint-every {args.checkpoint_every} rounded up to "
+              f"{aligned} to align with --updates-per-dispatch "
+              f"{args.updates_per_dispatch}")
+        args.checkpoint_every = aligned
+
     run_name = args.run_name or f"DQN_{args.preset}_{time.strftime('%Y%m%d_%H%M%S')}"
     run_dir = Path(args.run_root) / run_name
     run_dir.mkdir(parents=True, exist_ok=True)

@@ -386,6 +386,20 @@ def main(argv: list[str] | None = None) -> Path:
         eval_net = net
         net = net.clone(axis_name="sp")
 
+    if args.updates_per_dispatch > 1 and args.checkpoint_every % args.updates_per_dispatch:
+        # Fused dispatches only observe every K-th iteration boundary; a
+        # misaligned default cadence would either skip checkpoints or (as
+        # of round 3) be rejected by the loop. Users who never chose a
+        # cadence get the nearest aligned one, loudly.
+        aligned = (
+            (args.checkpoint_every + args.updates_per_dispatch - 1)
+            // args.updates_per_dispatch * args.updates_per_dispatch
+        )
+        print(f"--checkpoint-every {args.checkpoint_every} rounded up to "
+              f"{aligned} to align with --updates-per-dispatch "
+              f"{args.updates_per_dispatch}")
+        args.checkpoint_every = aligned
+
     run_name = args.run_name or f"PPO_{args.preset}_{time.strftime('%Y%m%d_%H%M%S')}"
     run_dir = Path(args.run_root) / run_name
     run_dir.mkdir(parents=True, exist_ok=True)

@@ -21,10 +21,13 @@ compute this measures ~2x faster per minibatch than the flax module
 (8.7 ms vs 16.8 ms fwd+bwd+adam, slope-timed on the round-3 bench
 chip).
 
-Numerics: identical function to ``SetTransformerPolicy(num_heads=1)``
-(flax LayerNorm fast-variance semantics, eps 1e-6, approximate gelu) —
-float32 parity is exact in ``tests/test_set_fast.py``; the parameter
-tree is the flax module's own, so checkpoints trained here serve and
+Numerics: the same function as ``SetTransformerPolicy(num_heads=1)``
+(flax LayerNorm fast-variance semantics, eps 1e-6, approximate gelu) up
+to float reassociation — the chunked attention sums reductions in a
+different order, so float32 parity is tolerance-level (~1e-4 max logit
+diff at dim 64; asserted at rtol/atol 1e-5-ish in
+``tests/test_set_fast.py``), not bitwise. The parameter tree is the flax
+module's own, so checkpoints trained here serve and
 evaluate everywhere a ``SetTransformerPolicy`` checkpoint does
 (reference parity anchor: the policy the reference trains/serves is one
 network regardless of backend — ``rl_scheduler/agent/train_ppo.py`` /
@@ -87,9 +90,19 @@ def _block(pb: dict, pb_f32: dict, h: jnp.ndarray, dim: int) -> jnp.ndarray:
     q = _proj(attn["query"], hn)
     k = _proj(attn["key"], hn)
     v = _proj(attn["value"], hn)
-    scores = jnp.einsum("ndb,mdb->nmb", q, k) * (dim ** -0.5)
-    probs = jax.nn.softmax(scores, axis=1)     # over the key axis m
-    h = h + _proj(attn["out"], jnp.einsum("nmb,mdb->ndb", probs, v))
+    # Attention CHUNKED over query nodes: scores as elementwise
+    # multiply + feature-axis reduction instead of
+    # einsum('ndb,mdb->nmb'), which XLA lowers to B tiny batched
+    # [N,dim]x[dim,N] matmuls — measured 3 ms/minibatch slower at
+    # 32768x8x64 than these lane-shaped VPU reductions.
+    scale = dim ** -0.5
+    num_nodes = h.shape[0]
+    outs = []
+    for n in range(num_nodes):
+        s_n = (q[n][None] * k).sum(axis=1) * scale   # [N(keys), B]
+        p_n = jax.nn.softmax(s_n, axis=0)            # over the key axis
+        outs.append((p_n[:, None, :] * v).sum(axis=0))  # [dim, B]
+    h = h + _proj(attn["out"], jnp.stack(outs))
     m = _ln_feature(h, pb_f32["LayerNorm_1"]).astype(h.dtype)
     m = jnp.einsum("dh,ndb->nhb", pb["Dense_0"]["kernel"], m) \
         + pb["Dense_0"]["bias"][None, :, None]

@@ -211,22 +211,25 @@ class LoadAwareJaxBackend:
         import time as _time
 
         self._jax = JaxAOTBackend(params_tree, hidden, device, algo)
-        try:
-            self._overflow = NativeMLPBackend(params_tree, algo)
-        except Exception as e:  # noqa: BLE001 - missing toolchain/.so
-            logger.info("native overflow path unavailable (%s); numpy", e)
-            self._overflow = NumpyMLPBackend(params_tree, algo)
         if device != "cpu":
             # Shedding is only bit-identical when the AOT path runs on the
             # host's XLA-CPU (same f32 matmul semantics as numpy/C++). An
             # accelerator AOT path could argmax-flip near-ties vs the host
             # overflow forward, so decisions would depend on arrival
-            # timing — disable shedding rather than serve inconsistently.
+            # timing — disable shedding (and skip building the dead
+            # overflow backend) rather than serve inconsistently.
             logger.info(
                 "load-aware shedding disabled for serve device %r "
                 "(host overflow forward is not bit-identical to it)", device
             )
             max_concurrent_jax = float("inf")
+            self._overflow = None
+        else:
+            try:
+                self._overflow = NativeMLPBackend(params_tree, algo)
+            except Exception as e:  # noqa: BLE001 - missing toolchain/.so
+                logger.info("native overflow path unavailable (%s); numpy", e)
+                self._overflow = NumpyMLPBackend(params_tree, algo)
         self._max = max_concurrent_jax
         self._lock = threading.Lock()
         # Only JAX-PATH calls count against the concurrency cap: a shed
