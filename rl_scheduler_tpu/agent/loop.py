@@ -269,6 +269,34 @@ def make_eval_log_fn(
     return eval_log_fn
 
 
+def align_checkpoint_interval(requested: int | None, default: int,
+                              updates_per_dispatch: int) -> int:
+    """Resolve a CLI checkpoint cadence against the fused-dispatch factor.
+
+    ``requested is None`` (the user never chose a cadence): the default is
+    rounded UP to the next multiple of ``updates_per_dispatch``, with a
+    printed notice when that changes it. An EXPLICIT misaligned request
+    exits with the actionable message instead — silently rewriting a
+    value the user chose would hide skipped checkpoints behind one log
+    line (``run_train_loop`` would reject it later anyway, less helpfully).
+    """
+    k = max(1, updates_per_dispatch)
+    if requested is None:
+        aligned = (default + k - 1) // k * k
+        if aligned != default:
+            print(f"--checkpoint-every default {default} rounded up to "
+                  f"{aligned} to align with --updates-per-dispatch {k}")
+        return aligned
+    if requested % k:
+        raise SystemExit(
+            f"--checkpoint-every {requested} is not a multiple of "
+            f"--updates-per-dispatch {k}: fused dispatches only observe "
+            f"every {k}-th iteration boundary, so those checkpoints would "
+            "silently be skipped (pick a multiple)"
+        )
+    return requested
+
+
 def make_periodic_checkpoint_fn(
     ckpt: Any,
     every: int,

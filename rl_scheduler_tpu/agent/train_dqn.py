@@ -55,7 +55,10 @@ def main(argv: list[str] | None = None) -> Path:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--run-name", default=None)
     p.add_argument("--run-root", default=RuntimeConfig().checkpoint_dir)
-    p.add_argument("--checkpoint-every", type=int, default=500)
+    p.add_argument("--checkpoint-every", type=int, default=None,
+                   help="checkpoint cadence in iterations (default 500, "
+                        "auto-aligned up to --updates-per-dispatch; an "
+                        "explicit misaligned value errors)")
     p.add_argument("--keep", type=int, default=5)
     p.add_argument("--eval-every", type=int, default=None,
                    help="run a greedy (epsilon=0) evaluation every N "
@@ -106,17 +109,11 @@ def main(argv: list[str] | None = None) -> Path:
         cfg = dataclasses.replace(cfg, **overrides)
     bundle = make_bundle(args.env)
 
-    if args.updates_per_dispatch > 1 and args.checkpoint_every % args.updates_per_dispatch:
-        # Align a default cadence with the dispatch factor (see train_ppo;
-        # the loop rejects misaligned intervals as silently-skipping).
-        aligned = (
-            (args.checkpoint_every + args.updates_per_dispatch - 1)
-            // args.updates_per_dispatch * args.updates_per_dispatch
-        )
-        print(f"--checkpoint-every {args.checkpoint_every} rounded up to "
-              f"{aligned} to align with --updates-per-dispatch "
-              f"{args.updates_per_dispatch}")
-        args.checkpoint_every = aligned
+    from rl_scheduler_tpu.agent.loop import align_checkpoint_interval
+
+    args.checkpoint_every = align_checkpoint_interval(
+        args.checkpoint_every, 500, args.updates_per_dispatch
+    )
 
     run_name = args.run_name or f"DQN_{args.preset}_{time.strftime('%Y%m%d_%H%M%S')}"
     run_dir = Path(args.run_root) / run_name

@@ -110,7 +110,10 @@ def main(argv: list[str] | None = None) -> Path:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--run-name", default=None)
     p.add_argument("--run-root", default=RuntimeConfig().checkpoint_dir)
-    p.add_argument("--checkpoint-every", type=int, default=10)
+    p.add_argument("--checkpoint-every", type=int, default=None,
+                   help="checkpoint cadence in iterations (default 10, "
+                        "auto-aligned up to --updates-per-dispatch; an "
+                        "explicit misaligned value errors)")
     p.add_argument("--keep", type=int, default=5)
     p.add_argument("--eval-every", type=int, default=None,
                    help="run a greedy evaluation every N iterations during "
@@ -135,6 +138,13 @@ def main(argv: list[str] | None = None) -> Path:
     p.add_argument("--rollout-steps", type=int, default=None,
                    help="override the preset's rollout length per iteration")
     p.add_argument("--minibatch-size", type=int, default=None)
+    p.add_argument("--num-epochs", type=int, default=None,
+                   help="SGD epochs per iteration (RLlib num_sgd_iter; "
+                        "presets mirror the reference's 10/15). Fewer "
+                        "epochs trade sample efficiency for env-steps/s "
+                        "at roughly constant wall-clock-to-convergence "
+                        "on the structured-policy configs — see "
+                        "docs/status.md")
     p.add_argument("--hidden", default=None,
                    help="comma-separated MLP widths, e.g. 64,64")
     p.add_argument("--fused-gnn", action="store_true",
@@ -213,8 +223,8 @@ def main(argv: list[str] | None = None) -> Path:
     cfg = PPO_PRESETS[args.preset]
     overrides = {
         k: getattr(args, k)
-        for k in ("num_envs", "rollout_steps", "minibatch_size", "compute_dtype",
-                  "eval_every", "eval_episodes")
+        for k in ("num_envs", "rollout_steps", "minibatch_size", "num_epochs",
+                  "compute_dtype", "eval_every", "eval_episodes")
         if getattr(args, k) is not None
     }
     if args.hidden is not None:
@@ -386,19 +396,11 @@ def main(argv: list[str] | None = None) -> Path:
         eval_net = net
         net = net.clone(axis_name="sp")
 
-    if args.updates_per_dispatch > 1 and args.checkpoint_every % args.updates_per_dispatch:
-        # Fused dispatches only observe every K-th iteration boundary; a
-        # misaligned default cadence would either skip checkpoints or (as
-        # of round 3) be rejected by the loop. Users who never chose a
-        # cadence get the nearest aligned one, loudly.
-        aligned = (
-            (args.checkpoint_every + args.updates_per_dispatch - 1)
-            // args.updates_per_dispatch * args.updates_per_dispatch
-        )
-        print(f"--checkpoint-every {args.checkpoint_every} rounded up to "
-              f"{aligned} to align with --updates-per-dispatch "
-              f"{args.updates_per_dispatch}")
-        args.checkpoint_every = aligned
+    from rl_scheduler_tpu.agent.loop import align_checkpoint_interval
+
+    args.checkpoint_every = align_checkpoint_interval(
+        args.checkpoint_every, 10, args.updates_per_dispatch
+    )
 
     run_name = args.run_name or f"PPO_{args.preset}_{time.strftime('%Y%m%d_%H%M%S')}"
     run_dir = Path(args.run_root) / run_name

@@ -24,9 +24,8 @@ chip).
 Numerics: the same function as ``SetTransformerPolicy(num_heads=1)``
 (flax LayerNorm fast-variance semantics, eps 1e-6, approximate gelu) up
 to float reassociation — the chunked attention sums reductions in a
-different order, so float32 parity is tolerance-level (~1e-4 max logit
-diff at dim 64; asserted at rtol/atol 1e-5-ish in
-``tests/test_set_fast.py``), not bitwise. The parameter tree is the flax
+different order, so float32 parity is tolerance-level (within the
+rtol/atol 1e-5 asserted by ``tests/test_set_fast.py``), not bitwise. The parameter tree is the flax
 module's own, so checkpoints trained here serve and
 evaluate everywhere a ``SetTransformerPolicy`` checkpoint does
 (reference parity anchor: the policy the reference trains/serves is one

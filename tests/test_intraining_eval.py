@@ -139,3 +139,18 @@ def test_fused_dispatch_rejects_misaligned_checkpoint_interval():
             lambda r: (r, {}), runner=None, start_iteration=0,
             num_iterations=8, checkpoint_fn=fn, updates_per_dispatch=2,
         )
+
+
+def test_align_checkpoint_interval():
+    """Defaults auto-align up to the dispatch factor; explicit misaligned
+    values are refused rather than silently rewritten."""
+    import pytest
+
+    from rl_scheduler_tpu.agent.loop import align_checkpoint_interval
+
+    assert align_checkpoint_interval(None, 10, 1) == 10
+    assert align_checkpoint_interval(None, 10, 100) == 100
+    assert align_checkpoint_interval(None, 500, 300) == 600
+    assert align_checkpoint_interval(200, 10, 100) == 200
+    with pytest.raises(SystemExit, match="not a multiple"):
+        align_checkpoint_interval(500, 10, 300)
