@@ -282,11 +282,19 @@ def align_checkpoint_interval(requested: int | None, default: int,
     """
     k = max(1, updates_per_dispatch)
     if requested is None:
-        aligned = (default + k - 1) // k * k
+        aligned = (max(1, default) + k - 1) // k * k
         if aligned != default:
             print(f"--checkpoint-every default {default} rounded up to "
                   f"{aligned} to align with --updates-per-dispatch {k}")
         return aligned
+    if requested <= 0:
+        # A zero/negative cadence would pass this gate and then divide by
+        # zero at the first iteration boundary — AFTER the run dir and
+        # metadata exist, defeating the validate-before-side-effects goal.
+        raise SystemExit(
+            f"--checkpoint-every {requested}: must be a positive iteration "
+            "count"
+        )
     if requested % k:
         raise SystemExit(
             f"--checkpoint-every {requested} is not a multiple of "

@@ -85,6 +85,16 @@ class PPOTrainConfig:
     # does not divide the batch/minibatch sizes. Set 1 to force exact.
     shuffle_block_size: int = 8
 
+    def __post_init__(self):
+        # Zero epochs would scan over zero SGD passes: training "completes"
+        # while never updating parameters. Guard at construction so every
+        # entry point (CLI, tests, notebooks) fails loudly up front.
+        if self.num_epochs < 1:
+            raise ValueError(
+                f"num_epochs={self.num_epochs}: must be >= 1 (each update "
+                "needs at least one SGD pass over the rollout)"
+            )
+
     @property
     def batch_size(self) -> int:
         return self.num_envs * self.rollout_steps

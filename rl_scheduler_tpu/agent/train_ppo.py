@@ -230,7 +230,13 @@ def main(argv: list[str] | None = None) -> Path:
     if args.hidden is not None:
         overrides["hidden"] = tuple(int(w) for w in args.hidden.split(","))
     if overrides:
-        cfg = dataclasses.replace(cfg, **overrides)
+        try:
+            cfg = dataclasses.replace(cfg, **overrides)
+        except ValueError as e:
+            # PPOTrainConfig.__post_init__ validates field ranges (e.g.
+            # --num-epochs 0 would scan over zero SGD passes); surface it
+            # as the CLI's actionable exit, before the run dir exists.
+            raise SystemExit(str(e).replace("num_epochs", "--num-epochs", 1))
     if args.legacy_reward_sign and args.env != "multi_cloud":
         raise SystemExit(
             "--legacy-reward-sign reproduces the multi-cloud reference "

@@ -365,11 +365,12 @@ def test_extender_bench_tool(server):
     assert out["backend"] == "cpu"
 
 
-def test_load_aware_jax_sheds_overflow_bit_identically(params_tree):
+def test_load_aware_jax_sheds_overflow_decisions_agree(params_tree):
     """The serving 'jax' flag (LoadAwareJaxBackend): at low concurrency it
     runs the AOT dispatcher; past max_concurrent_jax it routes to the
-    native/numpy forward — and every routed decision is bit-identical, so
-    shedding is invisible to the scheduler."""
+    native/numpy forward — and every routed decision agrees with the
+    reference forward (argmax level; logits match to ~1e-4, not bitwise),
+    so shedding is invisible to the scheduler."""
     import threading
 
     from rl_scheduler_tpu.scheduler.policy_backend import (

@@ -154,3 +154,26 @@ def test_align_checkpoint_interval():
     assert align_checkpoint_interval(200, 10, 100) == 200
     with pytest.raises(SystemExit, match="not a multiple"):
         align_checkpoint_interval(500, 10, 300)
+    # Explicit <=0 cadences must be refused here, BEFORE the run dir
+    # exists — not surface as ZeroDivisionError at the first boundary.
+    with pytest.raises(SystemExit, match="positive"):
+        align_checkpoint_interval(0, 10, 1)
+    with pytest.raises(SystemExit, match="positive"):
+        align_checkpoint_interval(-5, 10, 2)
+
+
+def test_train_cli_rejects_nonpositive_num_epochs(tmp_path):
+    """--num-epochs 0 would scan over zero SGD passes (training completes
+    without ever updating params); the CLI refuses it up front — and the
+    guard lives in PPOTrainConfig.__post_init__, so programmatic
+    construction fails just as loudly."""
+    from rl_scheduler_tpu.agent import train_ppo
+    from rl_scheduler_tpu.agent.ppo import PPOTrainConfig
+
+    with pytest.raises(ValueError, match="num_epochs"):
+        PPOTrainConfig(num_epochs=0)
+
+    with pytest.raises(SystemExit, match="num-epochs"):
+        train_ppo.main(["--preset", "quick", "--num-epochs", "0",
+                        "--run-root", str(tmp_path)])
+    assert not list(tmp_path.iterdir())  # refused before any side effects
