@@ -23,24 +23,26 @@ import time
 import urllib.request
 
 
-def make_payload(i: int) -> bytes:
+def make_payload(i: int, num_nodes: int = 2) -> bytes:
+    # First half aws, second half azure — mirrors the cluster_set env's
+    # node layout so the same payload exercises both serving families.
+    items = [
+        {"metadata": {"name": f"node-{j}",
+                      "labels": {"cloud": "aws" if j < num_nodes // 2 else "azure"}}}
+        for j in range(num_nodes)
+    ]
     return json.dumps(
         {
             "pod": {"metadata": {"name": f"bench-pod-{i}"}},
-            "nodes": {
-                "items": [
-                    {"metadata": {"name": "node-a", "labels": {"cloud": "aws"}}},
-                    {"metadata": {"name": "node-b", "labels": {"cloud": "azure"}}},
-                ]
-            },
+            "nodes": {"items": items},
         }
     ).encode()
 
 
-def one_request(base: str, i: int) -> float:
+def one_request(base: str, i: int, num_nodes: int = 2) -> float:
     path = "/filter" if i % 2 == 0 else "/prioritize"
     req = urllib.request.Request(
-        base + path, data=make_payload(i),
+        base + path, data=make_payload(i, num_nodes),
         headers={"Content-Type": "application/json"},
     )
     t0 = time.perf_counter()
@@ -56,17 +58,21 @@ def main(argv: list[str] | None = None) -> dict:
     p.add_argument("--requests", type=int, default=2000)
     p.add_argument("--threads", type=int, default=8)
     p.add_argument("--warmup", type=int, default=50)
+    p.add_argument("--nodes", type=int, default=2,
+                   help="candidate nodes per request (set-family serving "
+                        "scores each one; 2 matches the two-cloud MLP)")
     args = p.parse_args(argv)
     if args.requests < 1:
         p.error("--requests must be >= 1")
     base = f"http://{args.host}:{args.port}"
 
     for i in range(args.warmup):
-        one_request(base, i)
+        one_request(base, i, args.nodes)
 
     t_start = time.perf_counter()
     with concurrent.futures.ThreadPoolExecutor(args.threads) as pool:
-        latencies = sorted(pool.map(lambda i: one_request(base, i), range(args.requests)))
+        latencies = sorted(pool.map(
+            lambda i: one_request(base, i, args.nodes), range(args.requests)))
     wall = time.perf_counter() - t_start
 
     def pct(p_):

@@ -21,7 +21,7 @@ from pathlib import Path
 import jax
 
 from rl_scheduler_tpu.agent.ppo import ppo_train
-from rl_scheduler_tpu.agent.presets import PPO_PRESETS
+from rl_scheduler_tpu.agent.presets import PPO_PRESETS, PRESET_IMPLIES
 from rl_scheduler_tpu.config import EnvConfig, RuntimeConfig
 from rl_scheduler_tpu.env import core as env_core
 
@@ -102,10 +102,12 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
 def main(argv: list[str] | None = None) -> Path:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--preset", default="quick", choices=sorted(PPO_PRESETS))
-    p.add_argument("--env", default="multi_cloud", choices=ENVS,
-                   help="env family: multi_cloud (flagship), single_cluster "
-                        "(config 1), cluster_set + set transformer (config "
-                        "4), cluster_graph + GNN (config 5)")
+    p.add_argument("--env", default=None, choices=ENVS,
+                   help="env family: multi_cloud (flagship; the default), "
+                        "single_cluster (config 1), cluster_set + set "
+                        "transformer (config 4), cluster_graph + GNN "
+                        "(config 5). The set_fast/gnn_fast presets imply "
+                        "their env (and fast-path policy)")
     p.add_argument("--iterations", type=int, default=5)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--run-name", default=None)
@@ -213,6 +215,25 @@ def main(argv: list[str] | None = None) -> Path:
                         "this directory (keep --iterations small; view in "
                         "TensorBoard/Perfetto)")
     args = p.parse_args(argv)
+
+    # Recipe presets (set_fast/gnn_fast) name a full measured
+    # configuration: fill their implied env/fast-path flags so
+    # `--preset set_fast` alone reproduces the docs/status.md row, and
+    # refuse contradictions rather than silently ignoring the preset.
+    implied = PRESET_IMPLIES.get(args.preset, {})
+    if implied:
+        if args.env is not None and args.env != implied["env"]:
+            raise SystemExit(
+                f"--preset {args.preset} is the measured --env "
+                f"{implied['env']} recipe; it cannot train --env "
+                f"{args.env} (pick a scale preset like tpu4096/tpu8192 "
+                "instead)"
+            )
+        args.env = implied["env"]
+        args.fused_set = args.fused_set or implied.get("fused_set", False)
+        args.fused_gnn = args.fused_gnn or implied.get("fused_gnn", False)
+    if args.env is None:
+        args.env = "multi_cloud"
 
     from rl_scheduler_tpu.parallel import maybe_initialize_distributed
 

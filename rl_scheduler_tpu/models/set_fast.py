@@ -4,8 +4,11 @@ WHY (round-3 finding, superseding the round-2 diagnosis): on the bench
 TPU the per-XLA-op cost of this policy's many small tensors dominates —
 honest device-time measurement (window-slope, see ``docs/status.md``)
 puts the flax ``SetTransformerPolicy`` minibatch fwd+bwd at ~17 ms
-against a sub-millisecond matmul roofline, and the round-2 Pallas
-lane-slice kernels (``ops/pallas_set.py``) at ~48 ms. The round-2
+against a ~0.5 ms matmul / ~1.6 ms traffic-inclusive roofline
+(arithmetic in ``docs/roofline.md``: the residual ~5x over the achieved
+8.7 ms is the measured per-op overhead floor of XLA on these
+[8, 64, B] shapes), and the round-2 Pallas lane-slice kernels
+(``ops/pallas_set.py``) at ~48 ms. The round-2
 numbers that motivated those kernels were taken with
 ``jax.block_until_ready``, which does NOT synchronize on this backend;
 measured honestly, the win comes from a cheaper *formulation*, not a

@@ -7,10 +7,15 @@ the extender protocol, answering
 
 - ``POST /filter``     — ``ExtenderArgs`` -> ``ExtenderFilterResult``:
   keeps only nodes on the cloud the policy picked (greedy argmax, the
-  reference's ``explore=False`` serving intent).
+  reference's ``explore=False`` serving intent). For ``cluster_set``
+  checkpoints (pointer-over-nodes set transformer, ``set_backend.py``)
+  the policy scores each candidate node directly and the filter keeps
+  the argmax node.
 - ``POST /prioritize`` — ``ExtenderArgs`` -> ``HostPriorityList``: scores
   every candidate node 0-100 from the policy's softmax probabilities, so
-  the extender also works in soft (prioritize-only) deployments.
+  the extender also works in soft (prioritize-only) deployments. Set
+  checkpoints score per node (the pointer head's logits ARE per-node
+  scores).
 - ``GET /healthz``     — liveness + backend name.
 - ``GET /stats``       — decision count, per-cloud split, latency
   p50/p90/p99 in ms (the <1 ms p50 target is measured here).
@@ -50,6 +55,47 @@ logger = logging.getLogger(__name__)
 
 CLOUDS = ("aws", "azure")
 MAX_EXTENDER_SCORE = 100
+# Serving-time default for the arriving pod's cpu request as a fraction of
+# node capacity: the midpoint of the training distribution
+# (env/cluster_set.py pod_cpu ~ U[0.1, 0.4]) when the request carries no
+# parseable resources.requests.cpu.
+DEFAULT_POD_CPU = 0.25
+DEFAULT_NODE_CAPACITY_CORES = 4.0
+
+_CPU_QTY = re.compile(r"^\s*(\d+(?:\.\d+)?)(m?)\s*$")
+
+
+def pod_cpu_fraction(pod: dict | None,
+                     capacity_cores: float = DEFAULT_NODE_CAPACITY_CORES) -> float:
+    """The pod's total cpu request as a fraction of node capacity.
+
+    Sums ``spec.containers[].resources.requests.cpu`` k8s quantities
+    (``"250m"`` = 0.25 cores, ``"2"`` = 2 cores); clips to [0, 1] of
+    ``capacity_cores``. Falls back to :data:`DEFAULT_POD_CPU` when the pod
+    carries no parseable request — serving must never wedge on a weird
+    manifest (fail-open, SURVEY.md §5.3).
+    """
+    try:
+        containers = ((pod or {}).get("spec") or {}).get("containers") or []
+        total = 0.0
+        seen = False
+        for c in containers:
+            qty = (((c.get("resources") or {}).get("requests") or {})
+                   .get("cpu"))
+            if qty is None:
+                continue
+            m = _CPU_QTY.match(str(qty))
+            if m is None:
+                continue
+            cores = float(m.group(1)) * (1e-3 if m.group(2) else 1.0)
+            total += cores
+            seen = True
+        if not seen:
+            return DEFAULT_POD_CPU
+        return min(max(total / capacity_cores, 0.0), 1.0)
+    except Exception:  # noqa: BLE001 - malformed manifest: fail open
+        logger.debug("unparseable pod cpu request; using default", exc_info=True)
+        return DEFAULT_POD_CPU
 
 
 def node_cloud(node: dict | str) -> str | None:
@@ -147,16 +193,34 @@ class AsyncPlacer:
 
 
 class ExtenderPolicy:
-    """Pure decision logic, independent of HTTP (unit-testable directly)."""
+    """Pure decision logic, independent of HTTP (unit-testable directly).
 
-    def __init__(self, backend, telemetry: TableTelemetry, placer=None):
+    Two decision families, selected by the backend's ``family`` attribute:
+
+    - ``cloud`` (flat multi-cloud MLP/DQN checkpoints): one cloud-level
+      decision per request; ``/filter`` keeps the chosen cloud's nodes,
+      ``/prioritize`` scores each node by its cloud's probability.
+    - ``set`` (``cluster_set`` pointer-over-nodes checkpoints,
+      ``set_backend.py``): the policy scores *each candidate node
+      directly* — the pointer head's shape IS the extender protocol's
+      shape. ``/filter`` keeps the argmax node, ``/prioritize`` maps the
+      per-node softmax onto 0-100 scores.
+    """
+
+    def __init__(self, backend, telemetry: TableTelemetry, placer=None,
+                 node_capacity_cores: float = DEFAULT_NODE_CAPACITY_CORES):
         self.backend = backend
+        self.family = getattr(backend, "family", "cloud")
         self.telemetry = telemetry
+        self.node_capacity_cores = node_capacity_cores
         # Optional DryRunPodPlacer (slow-mode parity), wrapped so kube API
         # stalls can neither block responses nor exhaust threads.
         self.placer = AsyncPlacer(placer) if placer is not None else None
         self.stats = LatencyStats()
-        self._decisions = {c: 0 for c in CLOUDS}
+        # Set-family decisions can land on an unknown-cloud node (scored
+        # from neutral features); give those their own stats bucket.
+        keys = CLOUDS + (("unknown",) if self.family == "set" else ())
+        self._decisions = {c: 0 for c in keys}
         self._lock = threading.Lock()
 
     def decide(self) -> tuple[int, np.ndarray, np.ndarray]:
@@ -171,8 +235,79 @@ class ExtenderPolicy:
             self._decisions[CLOUDS[action]] += 1
         return action, probs, obs
 
+    def decide_set(self, clouds: list, pod_cpu: float) -> tuple[int, np.ndarray, np.ndarray]:
+        """One pointer decision over the request's nodes; timed like
+        :meth:`decide`. ``clouds`` has one aws/azure/None entry per node."""
+        t0 = time.perf_counter()
+        obs = self.telemetry.observe_nodes(clouds, pod_cpu)
+        action, logits = self.backend.decide_nodes(obs)
+        self.stats.record(time.perf_counter() - t0)
+        z = logits - logits.max()
+        probs = np.exp(z) / np.exp(z).sum()
+        with self._lock:
+            self._decisions[clouds[action] or "unknown"] += 1
+        return action, probs, obs
+
+    @staticmethod
+    def _request_nodes(args: dict) -> tuple[bool, list, list, list]:
+        """``(use_names, sources, display_names, clouds)`` for a request:
+        the extender protocol carries either full node objects or bare
+        names (``nodecachecapable``)."""
+        names = args.get("nodenames")
+        nodes = ((args.get("nodes") or {}).get("items")) or []
+        use_names = names is not None
+        sources = list(names) if use_names else nodes
+        display = (
+            list(names) if use_names
+            else [(n.get("metadata") or {}).get("name", "?") for n in nodes]
+        )
+        return use_names, sources, display, [node_cloud(s) for s in sources]
+
+    def _filter_set(self, args: dict) -> dict:
+        """Set-family ExtenderFilterResult: keep the argmax node; fail open."""
+        use_names, sources, display, clouds = self._request_nodes(args)
+        if not sources:
+            return self._passthrough(args)
+        try:
+            action, _, _ = self.decide_set(
+                clouds, pod_cpu_fraction(args.get("pod"), self.node_capacity_cores)
+            )
+        except Exception:  # never wedge scheduling: pass all nodes through.
+            logger.exception("set policy decision failed; passing all nodes")
+            return self._passthrough(args)
+        if self.placer is not None and clouds[action] is not None:
+            self.placer.submit(clouds[action])
+        failed = {
+            name: f"set policy ranked {display[action]} first"
+            for i, name in enumerate(display) if i != action
+        }
+        if use_names:
+            return {"nodenames": [sources[action]], "failedNodes": failed,
+                    "error": ""}
+        return {"nodes": {"items": [sources[action]]}, "failedNodes": failed,
+                "error": ""}
+
+    def _prioritize_set(self, args: dict) -> list[dict]:
+        """Set-family HostPriorityList: per-node softmax -> 0-100 scores
+        (rank-preserving; the argmax node always scores 100)."""
+        _, sources, display, clouds = self._request_nodes(args)
+        if not sources:
+            return []
+        try:
+            _, probs, _ = self.decide_set(
+                clouds, pod_cpu_fraction(args.get("pod"), self.node_capacity_cores)
+            )
+            scores = np.round(probs / probs.max() * MAX_EXTENDER_SCORE)
+        except Exception:
+            logger.exception("set policy decision failed; uniform priorities")
+            scores = np.full(len(sources), MAX_EXTENDER_SCORE // 2)
+        return [{"host": name, "score": int(s)}
+                for name, s in zip(display, scores)]
+
     def filter(self, args: dict) -> dict:
         """ExtenderFilterResult: keep nodes on the chosen cloud; fail open."""
+        if self.family == "set":
+            return self._filter_set(args)
         nodes = ((args.get("nodes") or {}).get("items")) or []
         node_names = args.get("nodenames")
         try:
@@ -212,6 +347,8 @@ class ExtenderPolicy:
 
     def prioritize(self, args: dict) -> list[dict]:
         """HostPriorityList: score = policy probability of the node's cloud."""
+        if self.family == "set":
+            return self._prioritize_set(args)
         nodes = ((args.get("nodes") or {}).get("items")) or []
         names = args.get("nodenames") or [
             (n.get("metadata") or {}).get("name", "?") for n in nodes
@@ -246,7 +383,8 @@ class ExtenderPolicy:
         }
 
     def health(self) -> dict:
-        return {"status": "ok", "backend": self.backend.name}
+        return {"status": "ok", "backend": self.backend.name,
+                "family": self.family}
 
     def statistics(self) -> dict:
         with self._lock:
@@ -254,6 +392,7 @@ class ExtenderPolicy:
         total = sum(decisions.values())
         out = {
             "backend": self.backend.name,
+            "family": self.family,
             "decisions": decisions,
             "choice_fractions": {
                 c: (n / total if total else 0.0) for c, n in decisions.items()
@@ -319,11 +458,20 @@ def build_policy(
     dry_run_place: bool = False,
     cpu_seed: int | None = None,
     serve_device: str = "cpu",
+    node_capacity_cores: float = DEFAULT_NODE_CAPACITY_CORES,
 ) -> ExtenderPolicy:
-    """Assemble the serving stack: checkpoint -> backend -> telemetry."""
+    """Assemble the serving stack: checkpoint -> backend -> telemetry.
+
+    Serves two checkpoint families: flat ``multi_cloud`` MLP/DQN runs
+    (cloud-level decision) and ``cluster_set`` set-transformer runs
+    (per-node pointer decision, ``set_backend.py``). Other env families
+    (``single_cluster``, ``cluster_graph``) are refused — their
+    observation spaces don't map onto the extender's telemetry.
+    """
     params_tree = None
     hidden = (256, 256)
     algo = "ppo"
+    backend_obj = None
     if backend != "greedy":
         tree = meta = run_dir = None
         try:
@@ -343,14 +491,29 @@ def build_policy(
             logger.exception("checkpoint load failed; serving cost-greedy fallback")
         if meta is not None:
             ckpt_env = meta.get("env", "multi_cloud")
-            if ckpt_env != "multi_cloud":
+            if ckpt_env == "cluster_set":
+                # The set policy's pointer logits score candidate nodes
+                # directly — exactly the /prioritize contract. Both the
+                # flax and the --fused-set training paths checkpoint the
+                # identical tree (train_ppo.py meta note).
+                from rl_scheduler_tpu.scheduler.set_backend import (
+                    make_set_backend,
+                )
+
+                logger.info("serving cluster_set checkpoint from %s", run_dir)
+                backend_obj, _ = make_set_backend(
+                    backend, tree, num_heads=meta.get("num_heads") or 1,
+                    device=serve_device,
+                )
+            elif ckpt_env != "multi_cloud":
                 # A different env family means a different observation
                 # space: the net would load fine but raise (fail-open) on
                 # every 6-dim request.
                 msg = (
                     f"checkpoint {run_dir} is for env {ckpt_env!r}; the "
-                    "extender serves multi-cloud observations — pass --run "
-                    "pointing at a multi_cloud run"
+                    "extender serves multi_cloud (flat) and cluster_set "
+                    "(per-node) observations — pass --run pointing at one "
+                    "of those"
                 )
                 if run:  # same truthiness as the discovery branch above
                     # Operator named this checkpoint explicitly: refuse to
@@ -384,7 +547,9 @@ def build_policy(
                         "malformed checkpoint meta at %s; serving cost-greedy "
                         "fallback", run_dir,
                     )
-    backend_obj, _ = make_backend(backend, params_tree, hidden, serve_device, algo)
+    if backend_obj is None:
+        backend_obj, _ = make_backend(backend, params_tree, hidden,
+                                      serve_device, algo)
     cpu_source = PrometheusCpu() if prometheus else RandomCpu(seed=cpu_seed)
     telemetry = TableTelemetry.from_table(data_path, cpu_source)
     placer = None
@@ -392,7 +557,8 @@ def build_policy(
         from rl_scheduler_tpu.scheduler.k8s_client import DryRunPodPlacer
 
         placer = DryRunPodPlacer()
-    return ExtenderPolicy(backend_obj, telemetry, placer)
+    return ExtenderPolicy(backend_obj, telemetry, placer,
+                          node_capacity_cores=node_capacity_cores)
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -406,6 +572,11 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--serve-device", default="cpu",
                    help="XLA device for the jax backend: cpu (default; "
                         "single-obs serving is dispatch-bound) or tpu")
+    p.add_argument("--node-capacity-cores", type=float,
+                   default=DEFAULT_NODE_CAPACITY_CORES,
+                   help="cores per node, for normalizing a pod's cpu "
+                        "request into the set policy's [0,1] pod_cpu "
+                        "feature (cluster_set checkpoints only)")
     p.add_argument("--prometheus", action="store_true",
                    help="query Prometheus for CPU telemetry (else random parity)")
     p.add_argument("--dry-run-place", action="store_true",
@@ -417,6 +588,7 @@ def main(argv: list[str] | None = None) -> None:
         args.backend, args.run, args.run_root,
         prometheus=args.prometheus, dry_run_place=args.dry_run_place,
         serve_device=args.serve_device,
+        node_capacity_cores=args.node_capacity_cores,
     )
     server = make_server(policy, args.host, args.port)
     print(f"Scheduler extender serving on {args.host}:{args.port} "

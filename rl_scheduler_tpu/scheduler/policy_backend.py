@@ -29,6 +29,7 @@ per-action logits (greedy returns pseudo-logits from the cost gap).
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Callable
 
 import numpy as np
@@ -183,6 +184,59 @@ class JaxAOTBackend:
         return int(np.argmax(logits)), logits
 
 
+class ShedGate:
+    """Thread-safe admission control for load-aware routing, shared by the
+    MLP (``LoadAwareJaxBackend``) and set (``LoadAwareSetBackend``)
+    families so the accounting/logging mechanics cannot diverge.
+
+    At most ``max_inflight`` callers run the primary path concurrently;
+    the rest are shed (the caller routes them to its overflow forward).
+    ``admit()`` returns ``(take_primary, log_line_or_None)`` — the log
+    line is rate-limited to one per 5 s; ``release()`` must be called
+    after a primary-path call finishes (use try/finally).
+    """
+
+    def __init__(self, max_inflight: float, primary: str = "jax dispatcher",
+                 overflow: str = "overflow"):
+        import time as _time
+
+        self._max = max_inflight
+        self._primary = primary
+        self._overflow = overflow
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._shed = 0
+        self._total = 0
+        self._time = _time
+        self._last_log = 0.0
+
+    @property
+    def shed_fraction(self) -> float:
+        with self._lock:
+            return self._shed / self._total if self._total else 0.0
+
+    def admit(self) -> tuple[bool, str | None]:
+        with self._lock:
+            self._total += 1
+            if self._inflight < self._max:
+                self._inflight += 1
+                return True, None
+            self._shed += 1
+            now = self._time.monotonic()
+            if now - self._last_log > 5.0:
+                self._last_log = now
+                return False, (
+                    f"{self._primary} saturated ({self._inflight} in "
+                    f"flight): routing overflow to {self._overflow} "
+                    f"({self._shed}/{self._total} requests shed so far)"
+                )
+            return False, None
+
+    def release(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+
 class LoadAwareJaxBackend:
     """``jax`` flag backend that holds its latency contract at saturation.
 
@@ -211,9 +265,6 @@ class LoadAwareJaxBackend:
     def __init__(self, params_tree: dict, hidden: tuple = (256, 256),
                  device: str = "cpu", algo: str = "ppo",
                  max_concurrent_jax: int = 2):
-        import threading
-        import time as _time
-
         self._jax = JaxAOTBackend(params_tree, hidden, device, algo)
         if device != "cpu":
             # Shedding only keeps decisions consistent when the AOT path
@@ -236,50 +287,28 @@ class LoadAwareJaxBackend:
             except Exception as e:  # noqa: BLE001 - missing toolchain/.so
                 logger.info("native overflow path unavailable (%s); numpy", e)
                 self._overflow = NumpyMLPBackend(params_tree, algo)
-        self._max = max_concurrent_jax
-        self._lock = threading.Lock()
         # Only JAX-PATH calls count against the concurrency cap: a shed
         # request running the overflow forward must not keep later
         # arrivals away from an idle jax dispatcher.
-        self._jax_inflight = 0
-        self._shed = 0
-        self._total = 0
-        self._time = _time
-        self._last_log = 0.0
+        self._gate = ShedGate(
+            max_concurrent_jax,
+            overflow=self._overflow.name if self._overflow else "-",
+        )
 
     @property
     def shed_fraction(self) -> float:
-        with self._lock:
-            return self._shed / self._total if self._total else 0.0
+        return self._gate.shed_fraction
 
     def decide(self, obs: np.ndarray) -> tuple[int, np.ndarray]:
-        do_log = False
-        with self._lock:
-            self._total += 1
-            take_jax = self._jax_inflight < self._max
-            if take_jax:
-                self._jax_inflight += 1
-            else:
-                self._shed += 1
-                shed, total = self._shed, self._total
-                busy = self._jax_inflight
-                now = self._time.monotonic()
-                if now - self._last_log > 5.0:
-                    self._last_log = now
-                    do_log = True
+        take_jax, log_line = self._gate.admit()
         if not take_jax:
-            if do_log:
-                logger.info(
-                    "jax dispatcher saturated (%d in flight): routing "
-                    "overflow to %s (%d/%d requests shed so far)",
-                    busy, self._overflow.name, shed, total,
-                )
+            if log_line:
+                logger.info("%s", log_line)
             return self._overflow.decide(obs)
         try:
             return self._jax.decide(obs)
         finally:
-            with self._lock:
-                self._jax_inflight -= 1
+            self._gate.release()
 
 
 class GreedyBackend:

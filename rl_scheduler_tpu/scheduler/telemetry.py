@@ -147,3 +147,37 @@ class TableTelemetry:
         return np.concatenate(
             [self.costs[idx], self.latencies[idx], [cpu_aws, cpu_azure]]
         ).astype(np.float32)
+
+    def observe_nodes(self, clouds: list, pod_cpu: float) -> np.ndarray:
+        """Per-node observation for the set policy: ``[N, NODE_FEAT]``.
+
+        ``clouds`` is one ``"aws"``/``"azure"``/``None`` entry per candidate
+        node (from labels/name tokens). Feature columns match training
+        (``env/cluster_set.py``): cost, latency, cpu_used, cloud_id,
+        pod_cpu, step_frac. Cost/latency/CPU come from the node's cloud
+        (cloud-level telemetry is the per-node utilization proxy — real
+        per-node meters slot in here); unknown-cloud nodes get the
+        cross-cloud mean and ``cloud_id = 0.5``, so they score from neutral
+        features instead of being special-cased out of the decision.
+        """
+        with self._lock:
+            idx = self._step % len(self.costs)
+            self._step += 1
+        costs, lats = self.costs[idx], self.latencies[idx]
+        cpus = np.asarray(self.cpu.sample(), np.float32)
+        step_frac = idx / max(len(self.costs) - 1, 1)
+        cloud_idx = np.fromiter(
+            ({"aws": 0, "azure": 1}.get(c, -1) for c in clouds),
+            np.int64, count=len(clouds),
+        )
+        known = cloud_idx >= 0
+        safe = np.where(known, cloud_idx, 0)
+        n = len(clouds)
+        rows = np.empty((n, 6), np.float32)
+        rows[:, 0] = np.where(known, costs[safe], costs.mean())
+        rows[:, 1] = np.where(known, lats[safe], lats.mean())
+        rows[:, 2] = np.where(known, cpus[safe], cpus.mean())
+        rows[:, 3] = np.where(known, cloud_idx, 0.5)
+        rows[:, 4] = pod_cpu
+        rows[:, 5] = step_frac
+        return rows

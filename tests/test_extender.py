@@ -416,3 +416,335 @@ def test_make_backend_jax_is_load_aware(params_tree):
 
     backend, fell_back = make_backend("jax", params_tree, hidden=HIDDEN)
     assert isinstance(backend, LoadAwareJaxBackend) and not fell_back
+
+
+# ------------------------------------------------ set-family (cluster_set)
+
+
+@pytest.fixture(scope="module")
+def set_params_tree():
+    from rl_scheduler_tpu.models.transformer import SetTransformerPolicy
+
+    net = SetTransformerPolicy(dim=64, depth=2)
+    return net.init(jax.random.PRNGKey(3), jnp.zeros((8, 6), jnp.float32))
+
+
+def _set_request(num_nodes=6, pod=None):
+    nodes = [
+        _node(f"n{i}", ("aws", "azure", None)[i % 3]) for i in range(num_nodes)
+    ]
+    args = {"nodes": {"items": nodes}}
+    if pod is not None:
+        args["pod"] = pod
+    return args
+
+
+def test_numpy_set_backend_matches_flax(set_params_tree):
+    """The serving-side numpy set-transformer forward is the training-time
+    flax function (XLA-CPU reference): logits to 1e-5, same argmax, and
+    variable node counts with no per-shape compile."""
+    from rl_scheduler_tpu.models.transformer import SetTransformerPolicy
+    from rl_scheduler_tpu.scheduler.set_backend import NumpySetBackend
+
+    net = SetTransformerPolicy(dim=64, depth=2)
+    backend = NumpySetBackend(set_params_tree)
+    cpu = jax.devices("cpu")[0]
+    params_cpu = jax.device_put(set_params_tree, cpu)
+    rng = np.random.default_rng(0)
+    for n in (3, 8, 40):
+        obs = rng.uniform(0, 1, size=(n, 6)).astype(np.float32)
+        with jax.default_device(cpu):
+            ref_logits, _ = jax.jit(net.apply)(params_cpu, jnp.asarray(obs))
+        ref = np.asarray(ref_logits)
+        action, logits = backend.decide_nodes(obs)
+        np.testing.assert_allclose(logits, ref, atol=1e-5)
+        assert action == int(np.argmax(ref))
+
+
+def test_numpy_set_backend_multihead(set_params_tree):
+    """Multi-head checkpoints (--num-heads 4) serve through the same numpy
+    forward — the head split is shape-driven from the param tree."""
+    from rl_scheduler_tpu.models.transformer import SetTransformerPolicy
+    from rl_scheduler_tpu.scheduler.set_backend import NumpySetBackend
+
+    net = SetTransformerPolicy(dim=64, depth=2, num_heads=4)
+    tree = net.init(jax.random.PRNGKey(5), jnp.zeros((8, 6), jnp.float32))
+    backend = NumpySetBackend(tree, num_heads=4)
+    cpu = jax.devices("cpu")[0]
+    obs = np.random.default_rng(1).uniform(0, 1, (10, 6)).astype(np.float32)
+    with jax.default_device(cpu):
+        ref_logits, _ = jax.jit(net.apply)(jax.device_put(tree, cpu),
+                                           jnp.asarray(obs))
+    _, logits = backend.decide_nodes(obs)
+    np.testing.assert_allclose(logits, np.asarray(ref_logits), atol=1e-5)
+
+
+def test_jax_set_backend_agrees_and_caches_per_n(set_params_tree):
+    """Warm node counts answer from the AOT executable; an unseen N is
+    answered immediately by the numpy forward while the executable
+    compiles in the background (compiles never block a request), then
+    served AOT once it lands."""
+    from rl_scheduler_tpu.scheduler.set_backend import (
+        JaxSetAOTBackend,
+        NumpySetBackend,
+    )
+
+    jax_b = JaxSetAOTBackend(set_params_tree, warm_counts=(4,))
+    np_b = NumpySetBackend(set_params_tree)
+    assert set(jax_b._compiled) == {4}
+    rng = np.random.default_rng(2)
+    for n in (4, 9, 4, 9):
+        obs = rng.uniform(0, 1, size=(n, 6)).astype(np.float32)
+        a_jax, l_jax = jax_b.decide_nodes(obs)  # never blocks on a compile
+        a_np, l_np = np_b.decide_nodes(obs)
+        np.testing.assert_allclose(l_jax, l_np, atol=1e-4)
+        assert a_jax == a_np
+    deadline = time.monotonic() + 60
+    while set(jax_b._compiled) != {4, 9} and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert set(jax_b._compiled) == {4, 9}  # background compile landed
+    obs = rng.uniform(0, 1, size=(9, 6)).astype(np.float32)
+    a_jax, l_jax = jax_b.decide_nodes(obs)  # now AOT-served
+    np.testing.assert_allclose(l_jax, np_b.decide_nodes(obs)[1], atol=1e-4)
+
+
+def test_jax_set_backend_cache_is_bounded(set_params_tree):
+    from rl_scheduler_tpu.scheduler.set_backend import JaxSetAOTBackend
+
+    jax_b = JaxSetAOTBackend(set_params_tree, warm_counts=(3, 4), max_cached=2)
+    rng = np.random.default_rng(3)
+    for n in (5, 6, 7):
+        jax_b.decide_nodes(rng.uniform(0, 1, size=(n, 6)).astype(np.float32))
+    deadline = time.monotonic() + 60
+    while (len(jax_b._compiled) != 2 or jax_b._compiling) and \
+            time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert len(jax_b._compiled) == 2  # LRU evicted down to the cap
+
+
+def test_set_filter_keeps_argmax_node(set_params_tree):
+    """/filter with a set backend keeps exactly the policy's argmax node
+    (including unknown-cloud candidates, which score from neutral
+    features)."""
+    from rl_scheduler_tpu.scheduler.set_backend import NumpySetBackend
+
+    backend = NumpySetBackend(set_params_tree)
+    telemetry = TableTelemetry.from_table(cpu_source=RandomCpu(seed=9))
+    policy = ExtenderPolicy(backend, telemetry)
+    assert policy.family == "set"
+
+    # Twin telemetry (same seed) reproduces the observation the policy
+    # will build, giving the expected decision independently.
+    twin = TableTelemetry.from_table(cpu_source=RandomCpu(seed=9))
+    args = _set_request(num_nodes=6)
+    clouds = [node_cloud(n) for n in args["nodes"]["items"]]
+    from rl_scheduler_tpu.scheduler.extender import DEFAULT_POD_CPU
+
+    expected, _ = backend.decide_nodes(twin.observe_nodes(clouds, DEFAULT_POD_CPU))
+
+    result = policy.filter(args)
+    kept = result["nodes"]["items"]
+    assert len(kept) == 1
+    assert kept[0]["metadata"]["name"] == f"n{expected}"
+    assert len(result["failedNodes"]) == 5
+    assert result["error"] == ""
+
+
+def test_set_prioritize_scores_follow_logits(set_params_tree):
+    from rl_scheduler_tpu.scheduler.extender import DEFAULT_POD_CPU
+    from rl_scheduler_tpu.scheduler.set_backend import NumpySetBackend
+
+    backend = NumpySetBackend(set_params_tree)
+    telemetry = TableTelemetry.from_table(cpu_source=RandomCpu(seed=11))
+    policy = ExtenderPolicy(backend, telemetry)
+    twin = TableTelemetry.from_table(cpu_source=RandomCpu(seed=11))
+
+    args = _set_request(num_nodes=8)
+    clouds = [node_cloud(n) for n in args["nodes"]["items"]]
+    _, logits = backend.decide_nodes(twin.observe_nodes(clouds, DEFAULT_POD_CPU))
+
+    out = policy.prioritize(args)
+    scores = np.array([entry["score"] for entry in out])
+    assert scores.max() == 100  # argmax node always gets the full score
+    assert scores[np.argmax(logits)] == 100
+    # Rank-preserving (monotone in the logits; integer rounding may tie).
+    for i in range(len(logits)):
+        for j in range(len(logits)):
+            if logits[i] > logits[j]:
+                assert scores[i] >= scores[j]
+    assert all(0 <= s <= 100 for s in scores)
+
+
+def test_set_filter_fails_open(set_params_tree):
+    class ExplodingSet:
+        name = "cpu"
+        family = "set"
+
+        def decide_nodes(self, obs):
+            raise RuntimeError("boom")
+
+    telemetry = TableTelemetry.from_table(cpu_source=RandomCpu(seed=0))
+    policy = ExtenderPolicy(ExplodingSet(), telemetry)
+    args = _set_request(num_nodes=4)
+    result = policy.filter(args)
+    assert len(result["nodes"]["items"]) == 4  # all passed through
+    assert result["error"] == ""
+    out = policy.prioritize(args)
+    assert [e["score"] for e in out] == [50, 50, 50, 50]
+
+
+def test_set_stats_track_unknown_cloud(set_params_tree):
+    from rl_scheduler_tpu.scheduler.set_backend import NumpySetBackend
+
+    backend = NumpySetBackend(set_params_tree)
+    telemetry = TableTelemetry.from_table(cpu_source=RandomCpu(seed=1))
+    policy = ExtenderPolicy(backend, telemetry)
+    for _ in range(5):
+        policy.filter(_set_request(num_nodes=6))
+    stats = policy.statistics()
+    assert stats["family"] == "set"
+    assert set(stats["decisions"]) == {"aws", "azure", "unknown"}
+    assert sum(stats["decisions"].values()) == 5
+    assert stats["latency"]["count"] == 5
+
+
+def test_observe_nodes_features():
+    """Node features line up with training columns (env/cluster_set.py):
+    known clouds take their table column, unknown nodes the cross-cloud
+    mean with cloud_id 0.5; pod_cpu/step_frac broadcast."""
+    telemetry = TableTelemetry.from_table(cpu_source=RandomCpu(seed=4))
+    obs = telemetry.observe_nodes(["aws", "azure", None], pod_cpu=0.3)
+    assert obs.shape == (3, 6) and obs.dtype == np.float32
+    costs, lats = telemetry.costs[0], telemetry.latencies[0]
+    np.testing.assert_allclose(obs[0, 0], costs[0])
+    np.testing.assert_allclose(obs[1, 0], costs[1])
+    np.testing.assert_allclose(obs[2, 0], costs.mean())
+    np.testing.assert_allclose(obs[:, 1], [lats[0], lats[1], lats.mean()])
+    assert list(obs[:, 3]) == [0.0, 1.0, 0.5]
+    np.testing.assert_allclose(obs[:, 4], 0.3)
+    np.testing.assert_allclose(obs[:, 5], 0.0)  # step 0
+    # cpu column: unknown = mean of the two cloud readings
+    np.testing.assert_allclose(obs[2, 2], obs[:2, 2].mean())
+
+
+def test_pod_cpu_fraction():
+    from rl_scheduler_tpu.scheduler.extender import (
+        DEFAULT_POD_CPU,
+        pod_cpu_fraction,
+    )
+
+    def pod(*cpus):
+        return {"spec": {"containers": [
+            {"resources": {"requests": {"cpu": c}}} for c in cpus
+        ]}}
+
+    assert pod_cpu_fraction(pod("500m", "500m")) == 0.25  # 1 core / 4
+    assert pod_cpu_fraction(pod("2")) == 0.5
+    assert pod_cpu_fraction(pod("16")) == 1.0  # clipped
+    assert pod_cpu_fraction(pod("1"), capacity_cores=8.0) == 0.125
+    assert pod_cpu_fraction(None) == DEFAULT_POD_CPU
+    assert pod_cpu_fraction({}) == DEFAULT_POD_CPU
+    assert pod_cpu_fraction(pod("weird")) == DEFAULT_POD_CPU
+    assert pod_cpu_fraction({"spec": {"containers": "nonsense"}}) == DEFAULT_POD_CPU
+
+
+def test_build_policy_serves_cluster_set_checkpoint(tmp_path):
+    """End-to-end: train a tiny cluster_set run through the CLI, then serve
+    it — the round-3 refusal (structured policies unservable) is closed."""
+    from rl_scheduler_tpu.agent import train_ppo as ppo_cli
+
+    run_dir = ppo_cli.main([
+        "--env", "cluster_set", "--preset", "quick", "--iterations", "2",
+        "--num-envs", "8", "--rollout-steps", "20", "--minibatch-size", "40",
+        "--num-epochs", "2", "--run-root", str(tmp_path),
+        "--run-name", "set_serve_test", "--checkpoint-every", "2",
+    ])
+    policy = build_policy(backend="cpu", run=str(run_dir))
+    assert policy.family == "set"
+    assert policy.backend.name == "cpu"
+    result = policy.filter(_set_request(num_nodes=5))
+    assert len(result["nodes"]["items"]) == 1
+    out = policy.prioritize(_set_request(num_nodes=5))
+    assert len(out) == 5 and max(e["score"] for e in out) == 100
+
+
+def test_http_set_roundtrip(set_params_tree):
+    """Full HTTP round-trip with a set backend: filter keeps one node,
+    prioritize scores every node, stats report the set family."""
+    from rl_scheduler_tpu.scheduler.set_backend import NumpySetBackend
+
+    backend = NumpySetBackend(set_params_tree)
+    telemetry = TableTelemetry.from_table(cpu_source=RandomCpu(seed=2))
+    policy = ExtenderPolicy(backend, telemetry)
+    srv = make_server(policy, host="127.0.0.1", port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        port = srv.server_address[1]
+        payload = _set_request(num_nodes=7)
+        result = _post(port, "/filter", payload)
+        assert len(result["nodes"]["items"]) == 1
+        out = _post(port, "/prioritize", payload)
+        assert len(out) == 7
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5
+        ) as resp:
+            health = json.loads(resp.read())
+        assert health == {"status": "ok", "backend": "cpu", "family": "set"}
+    finally:
+        srv.shutdown()
+
+
+def test_set_jax_flag_is_load_aware(set_params_tree):
+    """The set family's 'jax' serving flag sheds overflow concurrency to
+    the numpy forward with agreeing decisions (same contract as the MLP
+    family's LoadAwareJaxBackend)."""
+    from rl_scheduler_tpu.scheduler.set_backend import (
+        LoadAwareSetBackend,
+        NumpySetBackend,
+        make_set_backend,
+    )
+
+    backend, fell_back = make_set_backend("jax", set_params_tree)
+    assert isinstance(backend, LoadAwareSetBackend) and not fell_back
+
+    shed = LoadAwareSetBackend(set_params_tree, max_concurrent_jax=1)
+    ref = NumpySetBackend(set_params_tree)
+    rng = np.random.default_rng(7)
+    obs_batch = rng.uniform(0, 1, size=(32, 8, 6)).astype(np.float32)
+    for obs in obs_batch[:4]:
+        assert shed.decide_nodes(obs)[0] == ref.decide_nodes(obs)[0]
+    assert shed.shed_fraction == 0.0
+
+    mismatches = []
+
+    def worker():
+        for obs in obs_batch:
+            if shed.decide_nodes(obs)[0] != ref.decide_nodes(obs)[0]:
+                mismatches.append(obs)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not mismatches
+    assert shed.shed_fraction > 0.0
+
+
+def test_make_set_backend_degrades_native_torch_to_numpy(set_params_tree):
+    from rl_scheduler_tpu.scheduler.set_backend import (
+        NumpySetBackend,
+        make_set_backend,
+    )
+
+    for flag in ("native", "torch"):
+        backend, fell_back = make_set_backend(flag, set_params_tree)
+        assert isinstance(backend, NumpySetBackend) and not fell_back
+
+
+def test_make_set_backend_garbage_params_falls_back_to_greedy():
+    from rl_scheduler_tpu.scheduler.set_backend import make_set_backend
+
+    backend, fell_back = make_set_backend("cpu", {"params": {"bogus": {}}})
+    assert backend.name == "greedy" and fell_back

