@@ -90,7 +90,11 @@ PPO_PRESETS: dict[str, PPOTrainConfig] = {
     # The measured config-5 headline recipe (docs/status.md row 5:
     # 4.51M env-steps/s steady-state, convergence in ~34 s wall):
     # tpu8192 scale, one SGD epoch, Pallas kron GNN kernel (implied
-    # --env cluster_graph --fused-gnn).
+    # --env cluster_graph --fused-gnn). compute_dtype stays the f32
+    # default — faithful to the recorded headline command, and a round-4
+    # same-process check measured bf16 dtype-neutral at this recipe
+    # (~140 ms/update both ways: the 1-epoch update is rollout-bound,
+    # and the kernel's matmuls are not the binding term).
     "gnn_fast": PPOTrainConfig(
         num_envs=8192,
         rollout_steps=100,

@@ -7,8 +7,10 @@ puts the flax ``SetTransformerPolicy`` minibatch fwd+bwd at ~17 ms
 against a ~0.5 ms matmul / ~1.6 ms traffic-inclusive roofline
 (arithmetic in ``docs/roofline.md``: the residual ~5x over the achieved
 8.7 ms is the measured per-op overhead floor of XLA on these
-[8, 64, B] shapes), and the round-2 Pallas lane-slice kernels
-(``ops/pallas_set.py``) at ~48 ms. The round-2
+[8, 64, B] shapes). The round-2 Pallas lane-slice kernel suite measured
+~48 ms on the same body and was deleted in round 4 after a final regime
+search (single-head-only, loses 3.2x at N=8, fails to compile at N=16 —
+negative-result note in docs/status.md row 4; code in git history). The round-2
 numbers that motivated those kernels were taken with
 ``jax.block_until_ready``, which does NOT synchronize on this backend;
 measured honestly, the win comes from a cheaper *formulation*, not a

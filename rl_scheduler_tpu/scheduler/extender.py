@@ -308,8 +308,7 @@ class ExtenderPolicy:
         """ExtenderFilterResult: keep nodes on the chosen cloud; fail open."""
         if self.family == "set":
             return self._filter_set(args)
-        nodes = ((args.get("nodes") or {}).get("items")) or []
-        node_names = args.get("nodenames")
+        use_names, sources, display, clouds = self._request_nodes(args)
         try:
             action, _, _ = self.decide()
         except Exception:  # never wedge scheduling: pass all nodes through.
@@ -321,50 +320,28 @@ class ExtenderPolicy:
         if self.placer is not None:
             self.placer.submit(chosen)
 
-        failed: dict[str, str] = {}
-        if node_names is not None:
-            kept_names = []
-            for name in node_names:
-                cloud = node_cloud(name)
-                if cloud is None or cloud == chosen:
-                    kept_names.append(name)
-                else:
-                    failed[name] = f"policy selected {chosen}"
-            return {"nodenames": kept_names, "failedNodes": failed, "error": ""}
-        kept = []
-        for node in nodes:
-            cloud = node_cloud(node)
+        kept, failed = [], {}
+        for src, name, cloud in zip(sources, display, clouds):
             if cloud is None or cloud == chosen:
-                kept.append(node)
+                kept.append(src)  # unknown-cloud nodes pass (fail-open)
             else:
-                name = (node.get("metadata") or {}).get("name", "?")
                 failed[name] = f"policy selected {chosen}"
-        return {
-            "nodes": {"items": kept},
-            "failedNodes": failed,
-            "error": "",
-        }
+        if use_names:
+            return {"nodenames": kept, "failedNodes": failed, "error": ""}
+        return {"nodes": {"items": kept}, "failedNodes": failed, "error": ""}
 
     def prioritize(self, args: dict) -> list[dict]:
         """HostPriorityList: score = policy probability of the node's cloud."""
         if self.family == "set":
             return self._prioritize_set(args)
-        nodes = ((args.get("nodes") or {}).get("items")) or []
-        names = args.get("nodenames") or [
-            (n.get("metadata") or {}).get("name", "?") for n in nodes
-        ]
-        clouds = (
-            [node_cloud(n) for n in names]
-            if not nodes
-            else [node_cloud(n) for n in nodes]
-        )
+        _, _, display, clouds = self._request_nodes(args)
         try:
             _, probs, _ = self.decide()
         except Exception:
             logger.exception("policy decision failed; uniform priorities")
             probs = np.full(len(CLOUDS), 1.0 / len(CLOUDS))
         out = []
-        for name, cloud in zip(names, clouds):
+        for name, cloud in zip(display, clouds):
             if cloud is None:
                 score = MAX_EXTENDER_SCORE // 2
             else:
