@@ -195,7 +195,8 @@ class AsyncPlacer:
 class ExtenderPolicy:
     """Pure decision logic, independent of HTTP (unit-testable directly).
 
-    Two decision families, selected by the backend's ``family`` attribute:
+    Three decision families, selected by the backend's ``family``
+    attribute:
 
     - ``cloud`` (flat multi-cloud MLP/DQN checkpoints): one cloud-level
       decision per request; ``/filter`` keeps the chosen cloud's nodes,
@@ -205,7 +206,14 @@ class ExtenderPolicy:
       directly* — the pointer head's shape IS the extender protocol's
       shape. ``/filter`` keeps the argmax node, ``/prioritize`` maps the
       per-node softmax onto 0-100 scores.
+    - ``graph`` (``cluster_graph`` GNN checkpoints,
+      ``graph_backend.py``): per-node pointer decision like ``set``, with
+      the message-passing topology built per request from the candidate
+      clouds and the affinity node read from the pod's
+      ``rl-scheduler.io/affinity-node`` annotation.
     """
+
+    STRUCTURED = ("set", "graph")
 
     def __init__(self, backend, telemetry: TableTelemetry, placer=None,
                  node_capacity_cores: float = DEFAULT_NODE_CAPACITY_CORES):
@@ -213,13 +221,19 @@ class ExtenderPolicy:
         self.family = getattr(backend, "family", "cloud")
         self.telemetry = telemetry
         self.node_capacity_cores = node_capacity_cores
+        if self.family == "graph":
+            from rl_scheduler_tpu.scheduler.graph_backend import RawPriceReplay
+
+            # The graph env replays RAW dollar prices, not the normalized
+            # table — its own counter, synchronized to nothing else.
+            self._price_replay = RawPriceReplay()
         # Optional DryRunPodPlacer (slow-mode parity), wrapped so kube API
         # stalls can neither block responses nor exhaust threads.
         self.placer = AsyncPlacer(placer) if placer is not None else None
         self.stats = LatencyStats()
-        # Set-family decisions can land on an unknown-cloud node (scored
-        # from neutral features); give those their own stats bucket.
-        keys = CLOUDS + (("unknown",) if self.family == "set" else ())
+        # Structured-family decisions can land on an unknown-cloud node
+        # (scored from neutral features); give those their own bucket.
+        keys = CLOUDS + (("unknown",) if self.family in self.STRUCTURED else ())
         self._decisions = {c: 0 for c in keys}
         self._lock = threading.Lock()
 
@@ -236,8 +250,8 @@ class ExtenderPolicy:
         return action, probs, obs
 
     def decide_set(self, clouds: list, pod_cpu: float) -> tuple[int, np.ndarray, np.ndarray]:
-        """One pointer decision over the request's nodes; timed like
-        :meth:`decide`. ``clouds`` has one aws/azure/None entry per node."""
+        """One set-family pointer decision over the request's nodes; timed
+        like :meth:`decide`. ``clouds`` has one aws/azure/None per node."""
         t0 = time.perf_counter()
         obs = self.telemetry.observe_nodes(clouds, pod_cpu)
         action, logits = self.backend.decide_nodes(obs)
@@ -247,6 +261,48 @@ class ExtenderPolicy:
         with self._lock:
             self._decisions[clouds[action] or "unknown"] += 1
         return action, probs, obs
+
+    def decide_graph(self, clouds: list, display: list,
+                     pod: dict | None, pod_cpu: float) -> tuple[int, np.ndarray, np.ndarray]:
+        """One graph-family pointer decision: per-request topology from the
+        candidate clouds, affinity from the pod annotation (mean-hops
+        neutral fallback), raw-price replay row; timed like
+        :meth:`decide`."""
+        from rl_scheduler_tpu.scheduler.graph_backend import (
+            AFFINITY_ANNOTATION,
+            build_graph_obs,
+            topology_for_clouds,
+        )
+
+        t0 = time.perf_counter()
+        adj, hops = topology_for_clouds(clouds)
+        price_row, step_frac = self._price_replay.next_row()
+        cpus = np.asarray(self.telemetry.cpu.sample(), np.float32)
+        affinity = None
+        annotations = (((pod or {}).get("metadata") or {})
+                       .get("annotations") or {})
+        aff_name = annotations.get(AFFINITY_ANNOTATION)
+        if aff_name in display:
+            affinity = display.index(aff_name)
+        obs = build_graph_obs(clouds, price_row, cpus, hops, adj,
+                              affinity, pod_cpu, step_frac)
+        action, logits = self.backend.decide_nodes(obs, adj)
+        self.stats.record(time.perf_counter() - t0)
+        z = logits - logits.max()
+        probs = np.exp(z) / np.exp(z).sum()
+        with self._lock:
+            self._decisions[clouds[action] or "unknown"] += 1
+        return action, probs, obs
+
+    def _structured_decide(self, args: dict, display: list,
+                           clouds: list) -> tuple[int, np.ndarray]:
+        pod = args.get("pod")
+        pod_cpu = pod_cpu_fraction(pod, self.node_capacity_cores)
+        if self.family == "set":
+            action, probs, _ = self.decide_set(clouds, pod_cpu)
+        else:
+            action, probs, _ = self.decide_graph(clouds, display, pod, pod_cpu)
+        return action, probs
 
     @staticmethod
     def _request_nodes(args: dict) -> tuple[bool, list, list, list]:
@@ -263,22 +319,22 @@ class ExtenderPolicy:
         )
         return use_names, sources, display, [node_cloud(s) for s in sources]
 
-    def _filter_set(self, args: dict) -> dict:
-        """Set-family ExtenderFilterResult: keep the argmax node; fail open."""
+    def _filter_structured(self, args: dict) -> dict:
+        """Structured-family (set/graph) ExtenderFilterResult: keep the
+        argmax node; fail open."""
         use_names, sources, display, clouds = self._request_nodes(args)
         if not sources:
             return self._passthrough(args)
         try:
-            action, _, _ = self.decide_set(
-                clouds, pod_cpu_fraction(args.get("pod"), self.node_capacity_cores)
-            )
+            action, _ = self._structured_decide(args, display, clouds)
         except Exception:  # never wedge scheduling: pass all nodes through.
-            logger.exception("set policy decision failed; passing all nodes")
+            logger.exception("%s policy decision failed; passing all nodes",
+                             self.family)
             return self._passthrough(args)
         if self.placer is not None and clouds[action] is not None:
             self.placer.submit(clouds[action])
         failed = {
-            name: f"set policy ranked {display[action]} first"
+            name: f"{self.family} policy ranked {display[action]} first"
             for i, name in enumerate(display) if i != action
         }
         if use_names:
@@ -287,27 +343,26 @@ class ExtenderPolicy:
         return {"nodes": {"items": [sources[action]]}, "failedNodes": failed,
                 "error": ""}
 
-    def _prioritize_set(self, args: dict) -> list[dict]:
-        """Set-family HostPriorityList: per-node softmax -> 0-100 scores
-        (rank-preserving; the argmax node always scores 100)."""
+    def _prioritize_structured(self, args: dict) -> list[dict]:
+        """Structured-family HostPriorityList: per-node softmax -> 0-100
+        scores (rank-preserving; the argmax node always scores 100)."""
         _, sources, display, clouds = self._request_nodes(args)
         if not sources:
             return []
         try:
-            _, probs, _ = self.decide_set(
-                clouds, pod_cpu_fraction(args.get("pod"), self.node_capacity_cores)
-            )
+            _, probs = self._structured_decide(args, display, clouds)
             scores = np.round(probs / probs.max() * MAX_EXTENDER_SCORE)
         except Exception:
-            logger.exception("set policy decision failed; uniform priorities")
+            logger.exception("%s policy decision failed; uniform priorities",
+                             self.family)
             scores = np.full(len(sources), MAX_EXTENDER_SCORE // 2)
         return [{"host": name, "score": int(s)}
                 for name, s in zip(display, scores)]
 
     def filter(self, args: dict) -> dict:
         """ExtenderFilterResult: keep nodes on the chosen cloud; fail open."""
-        if self.family == "set":
-            return self._filter_set(args)
+        if self.family in self.STRUCTURED:
+            return self._filter_structured(args)
         use_names, sources, display, clouds = self._request_nodes(args)
         try:
             action, _, _ = self.decide()
@@ -332,8 +387,8 @@ class ExtenderPolicy:
 
     def prioritize(self, args: dict) -> list[dict]:
         """HostPriorityList: score = policy probability of the node's cloud."""
-        if self.family == "set":
-            return self._prioritize_set(args)
+        if self.family in self.STRUCTURED:
+            return self._prioritize_structured(args)
         _, _, display, clouds = self._request_nodes(args)
         try:
             _, probs, _ = self.decide()
@@ -439,11 +494,13 @@ def build_policy(
 ) -> ExtenderPolicy:
     """Assemble the serving stack: checkpoint -> backend -> telemetry.
 
-    Serves two checkpoint families: flat ``multi_cloud`` MLP/DQN runs
-    (cloud-level decision) and ``cluster_set`` set-transformer runs
-    (per-node pointer decision, ``set_backend.py``). Other env families
-    (``single_cluster``, ``cluster_graph``) are refused — their
-    observation spaces don't map onto the extender's telemetry.
+    Serves three checkpoint families: flat ``multi_cloud`` MLP/DQN runs
+    (cloud-level decision), ``cluster_set`` set-transformer runs
+    (per-node pointer decision, ``set_backend.py``), and
+    ``cluster_graph`` GNN runs (per-node pointer decision over a
+    per-request topology, ``graph_backend.py``). ``single_cluster`` is
+    refused — its observation space doesn't map onto the extender's
+    telemetry.
     """
     params_tree = None
     hidden = (256, 256)
@@ -482,15 +539,28 @@ def build_policy(
                     backend, tree, num_heads=meta.get("num_heads") or 1,
                     device=serve_device,
                 )
+            elif ckpt_env == "cluster_graph":
+                # The GNN's pointer head also scores nodes directly; its
+                # GCN weights are node-count-independent, so the per-
+                # request topology slots in at serving time
+                # (graph_backend.py). fused_gnn checkpoints are the same
+                # tree.
+                from rl_scheduler_tpu.scheduler.graph_backend import (
+                    make_graph_backend,
+                )
+
+                logger.info("serving cluster_graph checkpoint from %s",
+                            run_dir)
+                backend_obj, _ = make_graph_backend(backend, tree)
             elif ckpt_env != "multi_cloud":
                 # A different env family means a different observation
                 # space: the net would load fine but raise (fail-open) on
                 # every 6-dim request.
                 msg = (
                     f"checkpoint {run_dir} is for env {ckpt_env!r}; the "
-                    "extender serves multi_cloud (flat) and cluster_set "
-                    "(per-node) observations — pass --run pointing at one "
-                    "of those"
+                    "extender serves multi_cloud (flat), cluster_set and "
+                    "cluster_graph (per-node) observations — pass --run "
+                    "pointing at one of those"
                 )
                 if run:  # same truthiness as the discovery branch above
                     # Operator named this checkpoint explicitly: refuse to

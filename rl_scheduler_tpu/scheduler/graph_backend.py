@@ -1,0 +1,229 @@
+"""Serving backend for the GNN policy over cluster topology (config 5).
+
+Round 4 closed the "trains but can't serve" hole for ``cluster_set``
+(``set_backend.py``); this module closes it for ``cluster_graph``: the
+GNN's pointer head also emits one logit per candidate node — the exact
+``/prioritize`` shape — and its GCN weights are node-count-independent
+(``models/gnn.py``: per-feature ``w_self``/``w_nbr`` matrices + a
+degree-normalized adjacency), so one trained checkpoint scores ANY
+candidate node set once a topology is supplied.
+
+Serving-time topology: the same two-cloud gateway construction the
+training env builds (``env/cluster_graph.py::build_topology``),
+generalized to the request's actual cloud assignment — per cloud group a
+ring + chords to the group's gateway (its first node in request order),
+gateways chained across groups; unknown-cloud nodes form their own
+group. For the canonical first-half-aws ordering this reproduces the
+training topology bit-for-bit (tested). Real cluster topologies can be
+injected by replacing :func:`topology_for_clouds`.
+
+Affinity: the training env scores placement relative to the node the
+pod's service runs on. At serving time the pod names it with the
+``rl-scheduler.io/affinity-node`` annotation (documented contract); when
+absent, the hops-to-affinity feature falls back to each node's MEAN hop
+distance — the marginal expectation under the env's uniform-random
+affinity draw, i.e. the neutral in-distribution value.
+
+Prices: the graph env replays RAW dollar prices (``real_prices.csv``),
+not the normalized table, so this module carries its own replay counter
+(:class:`RawPriceReplay`) alongside the shared CPU source.
+
+Only a numpy forward is provided (``cpu`` semantics): the GCN is three
+BLAS matmuls per layer — microseconds at serving sizes — and, unlike the
+set family, the adjacency varies per request, which would defeat a
+shape-specialized AOT cache. Every ``--backend`` flag maps here with a
+log line.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+GNN_DIM = 64    # GNNPolicy defaults (models/gnn.py, train CLI)
+GNN_DEPTH = 3
+AFFINITY_ANNOTATION = "rl-scheduler.io/affinity-node"
+# Feature-scale constants mirrored from env/cluster_graph.py::_observe.
+PRICE_FEATURE_SCALE = 30.0
+
+
+def _params_subtree(tree: dict) -> dict:
+    return tree["params"] if "params" in tree else tree
+
+
+def _np_tree(tree):
+    if isinstance(tree, dict):
+        return {k: _np_tree(v) for k, v in tree.items()}
+    return np.asarray(tree, np.float32)
+
+
+@functools.lru_cache(maxsize=256)
+def _topology_cached(clouds: tuple) -> tuple[np.ndarray, np.ndarray]:
+    n = len(clouds)
+    adj = np.zeros((n, n), np.float32)
+    groups = [
+        [i for i, c in enumerate(clouds) if c == key]
+        for key in ("aws", "azure", None)
+    ]
+    groups = [g for g in groups if g]
+    for members in groups:
+        gateway = members[0]
+        for i, u in enumerate(members):
+            v = members[(i + 1) % len(members)]  # ring
+            if u != v:
+                adj[u, v] = adj[v, u] = 1.0
+            if u != gateway:                      # chord to gateway
+                adj[u, gateway] = adj[gateway, u] = 1.0
+    for a, b in zip(groups[:-1], groups[1:]):     # gateway <-> gateway
+        adj[a[0], b[0]] = adj[b[0], a[0]] = 1.0
+    # All-pairs hop counts via matrix BFS: one boolean matmul per hop
+    # level (the graph's diameter is small by construction), BLAS-bound
+    # instead of a Python frontier loop per source node.
+    hops = np.where(np.eye(n, dtype=bool), 0.0, np.inf).astype(np.float32)
+    reach = np.eye(n, dtype=bool)
+    d = 0
+    while True:
+        d += 1
+        new_reach = reach | ((reach.astype(np.float32) @ adj) > 0)
+        fresh = new_reach & ~reach
+        if not fresh.any():
+            break
+        hops[fresh] = d
+        reach = new_reach
+    return adj, hops
+
+
+def topology_for_clouds(clouds: list) -> tuple[np.ndarray, np.ndarray]:
+    """``(adjacency, hops)`` for a candidate node list's cloud assignment.
+
+    Mirrors the training env's construction (ring + gateway chords per
+    cloud, gateways chained) on the request's actual clouds. Groups are
+    ordered aws, azure, unknown; each group's gateway is its first node
+    in request order. Single-node groups contribute no intra-group edges;
+    a single-group request is just that group's ring. Results are
+    LRU-cached on the cloud signature (a cluster's candidate lists
+    repeat), so steady-state requests pay a dict lookup, not a BFS —
+    treat the returned arrays as read-only (they are shared).
+    """
+    return _topology_cached(tuple(clouds))
+
+
+class RawPriceReplay:
+    """Replays the raw dollar pricing table (the graph env's price source,
+    ``env/cluster_graph.py::make_params``) with a thread-safe counter —
+    the serving-side analogue of the env's ``step_idx``."""
+
+    def __init__(self, prices: np.ndarray | None = None):
+        if prices is None:
+            from rl_scheduler_tpu.data.loader import load_raw_prices
+
+            prices = np.asarray(load_raw_prices(), np.float32)
+        self.prices = np.asarray(prices, np.float32)  # [T, 2]
+        self._step = 0
+        self._lock = threading.Lock()
+
+    def next_row(self) -> tuple[np.ndarray, float]:
+        """``(row [2], step_frac)`` at the current replay position."""
+        with self._lock:
+            idx = self._step % len(self.prices)
+            self._step += 1
+        return self.prices[idx], idx / max(len(self.prices) - 1, 1)
+
+
+def build_graph_obs(clouds: list, price_row: np.ndarray, cpus: np.ndarray,
+                    hops: np.ndarray, adj: np.ndarray,
+                    affinity: int | None, pod_cpu: float,
+                    step_frac: float) -> np.ndarray:
+    """``[N, 7]`` node features matching training column order
+    (``env/cluster_graph.py::_observe``): price*30, cpu_used, cloud_id,
+    hops_to_affinity/max_hops, degree/n, pod_cpu, step_frac. Unknown-cloud
+    nodes take the cross-cloud mean price/cpu and ``cloud_id = 0.5``;
+    ``affinity=None`` uses each node's mean hop distance (the marginal of
+    the env's uniform affinity draw)."""
+    n = len(clouds)
+    cloud_idx = np.fromiter(
+        ({"aws": 0, "azure": 1}.get(c, -1) for c in clouds),
+        np.int64, count=n,
+    )
+    known = cloud_idx >= 0
+    safe = np.where(known, cloud_idx, 0)
+    price = np.where(known, price_row[safe], price_row.mean())
+    cpu = np.where(known, cpus[safe], cpus.mean())
+    if affinity is None:
+        # E[hops[i, aff]] under the env's uniform draw, which INCLUDES
+        # self (randint(0, num_nodes), env/cluster_graph.py:184): sum/n.
+        hops_to_aff = hops.sum(axis=1) / n
+    else:
+        hops_to_aff = hops[:, affinity]
+    obs = np.empty((n, 7), np.float32)
+    obs[:, 0] = price * PRICE_FEATURE_SCALE
+    obs[:, 1] = cpu
+    obs[:, 2] = np.where(known, cloud_idx, 0.5)
+    obs[:, 3] = hops_to_aff / max(hops.max(), 1.0)
+    obs[:, 4] = adj.sum(axis=1) / n
+    obs[:, 5] = pod_cpu
+    obs[:, 6] = step_frac
+    return obs
+
+
+class NumpyGNNBackend:
+    """GCN pointer forward in plain numpy: ``decide_nodes(obs, adj)``.
+
+    Matches ``models/gnn.py::GNNPolicy`` (relu embed, depth x GCN layers
+    ``relu(h W_self + Â h W_nbr)``, pointer score head) — flax-apply
+    agreement tested to 1e-5 in ``tests/test_extender.py``. The degree
+    normalization ``Â = D^-1 A`` lives HERE (one definition mirroring
+    ``GNNPolicy.__call__``), so callers pass the raw 0/1 adjacency.
+    """
+
+    name = "cpu"
+    family = "graph"
+
+    def __init__(self, params_tree: dict, depth: int = GNN_DEPTH):
+        p = _np_tree(_params_subtree(params_tree))
+        self._embed = p["embed"]
+        self._convs = [p[f"conv_{i}"] for i in range(depth)]
+        self._score = p["head"]["score_head"]
+
+    def decide_nodes(self, node_obs: np.ndarray,
+                     adj: np.ndarray) -> tuple[int, np.ndarray]:
+        # D^-1 A, exactly as GNNPolicy.__call__ (models/gnn.py:73-74).
+        norm_adj = adj / np.maximum(adj.sum(axis=1, keepdims=True), 1.0)
+        h = np.maximum(
+            node_obs.astype(np.float32) @ self._embed["kernel"]
+            + self._embed["bias"], 0.0,
+        )
+        for conv in self._convs:
+            self_msg = h @ conv["w_self"]["kernel"] + conv["w_self"]["bias"]
+            nbr = norm_adj @ h
+            nbr_msg = nbr @ conv["w_nbr"]["kernel"] + conv["w_nbr"]["bias"]
+            h = np.maximum(self_msg + nbr_msg, 0.0)
+        logits = h @ self._score["kernel"][:, 0] + self._score["bias"][0]
+        return int(np.argmax(logits)), logits
+
+
+def make_graph_backend(backend: str, params_tree: dict):
+    """Build the graph-family backend for the ``--backend`` flag. All
+    flags serve the numpy forward (see module docstring for why there is
+    no AOT variant); non-``cpu`` flags log the mapping. Returns
+    ``(backend_obj, fallback_used)`` like ``make_backend``."""
+    if backend != "cpu":
+        logger.info(
+            "backend %r maps to the numpy GCN forward for cluster_graph "
+            "checkpoints (per-request topology defeats shape-specialized "
+            "AOT; the forward is BLAS-bound microseconds)", backend,
+        )
+    try:
+        return NumpyGNNBackend(params_tree), False
+    except Exception:
+        from rl_scheduler_tpu.scheduler.policy_backend import GreedyBackend
+
+        logger.exception(
+            "graph backend failed to initialize; falling back to greedy"
+        )
+        return GreedyBackend(), True
