@@ -282,7 +282,119 @@ BASELINE_POLICIES = {
 }
 
 
-def main(argv: list[str] | None = None) -> EvalReport:
+# ------------------------------------------- structured envs (configs 4-5)
+
+
+@dataclasses.dataclass(frozen=True)
+class StructuredEvalReport:
+    """Greedy evaluation of a structured (per-node) policy vs the
+    hand-coded node baselines — the reproducible form of the
+    status-table convergence comparisons (docs/status.md rows 4-5)."""
+
+    env: str
+    num_episodes: int
+    avg_episode_reward: float
+    baseline_rewards: dict          # name -> mean episode reward
+    improvement_vs_best_baseline_pct: float
+    cloud_fractions: tuple          # decision split over clouds
+
+    def summary(self) -> str:
+        best_name = max(self.baseline_rewards,
+                        key=lambda k: self.baseline_rewards[k])
+        lines = [
+            "=" * 60,
+            f"STRUCTURED EVALUATION SUMMARY ({self.env})",
+            "=" * 60,
+            f"Episodes evaluated:       {self.num_episodes}",
+            f"Policy episode reward:    {self.avg_episode_reward:.1f}",
+        ]
+        for name, r in sorted(self.baseline_rewards.items()):
+            lines.append(f"Baseline {name:<15s} {r:.1f}")
+        lines += [
+            f"Improvement vs best baseline ({best_name}): "
+            f"{self.improvement_vs_best_baseline_pct:+.1f}%",
+            "Cloud choice split:       "
+            + ", ".join(
+                f"{name} {frac * 100:.1f}%"
+                for name, frac in zip(CLOUD_NAMES, self.cloud_fractions)
+            ),
+            "=" * 60,
+        ]
+        return "\n".join(lines)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def run_bundle_episodes(bundle, policy_fn, num_episodes: int, seed: int = 0):
+    """``(episode_rewards [E], chosen_clouds [T, E])`` for one full episode
+    per batch lane on ANY structured bundle (fixed-length episodes, like
+    :func:`run_episodes` for the flat env)."""
+    steps = bundle.episode_steps
+
+    @jax.jit
+    def _run(key):
+        reset_key, policy_key = jax.random.split(key)
+        state, obs = bundle.reset_batch(reset_key, num_episodes)
+
+        def step_fn(carry, k):
+            state, obs = carry
+            action = policy_fn(obs, k)
+            state, ts = bundle.step_batch(state, action)
+            return (state, ts.obs), (ts.reward, ts.chosen_cloud)
+
+        keys = jax.random.split(policy_key, steps)
+        _, (rewards, clouds) = jax.lax.scan(step_fn, (state, obs), keys)
+        return rewards.sum(axis=0), clouds
+
+    return _run(jax.random.PRNGKey(seed))
+
+
+def structured_evaluate(env_name: str, bundle, net, params,
+                        num_episodes: int = 100,
+                        seed: int = 0) -> StructuredEvalReport:
+    """Evaluate a cluster_set/cluster_graph checkpoint greedily against
+    the hand-coded node baselines (random / cheapest-node / load-spread,
+    ``env/baselines.py``) on the same episode batch sizes."""
+    from rl_scheduler_tpu.env.baselines import structured_baselines
+
+    policy = greedy_policy_fn(net, params)
+    ep_rewards, clouds = run_bundle_episodes(bundle, policy,
+                                             num_episodes, seed)
+    base_rewards = {}
+    for name, fn in structured_baselines(env_name).items():
+        # All baselines share ONE seed stream (seed+1, distinct from the
+        # policy's): a paired comparison on identical episode draws, not
+        # independent samples per baseline.
+        r, _ = run_bundle_episodes(bundle, fn, num_episodes, seed + 1)
+        base_rewards[name] = float(r.mean())
+    avg_reward = float(ep_rewards.mean())
+    best = max(base_rewards.values())
+    improvement = ((avg_reward - best) / abs(best) * 100.0) if best else 0.0
+    counts = jnp.stack([(clouds == c).sum() for c in range(len(CLOUD_NAMES))])
+    total = jnp.maximum(counts.sum(), 1)
+    return StructuredEvalReport(
+        env=env_name,
+        num_episodes=num_episodes,
+        avg_episode_reward=avg_reward,
+        baseline_rewards=base_rewards,
+        improvement_vs_best_baseline_pct=float(improvement),
+        cloud_fractions=tuple(float(c) / float(total) for c in counts),
+    )
+
+
+def _write_report(results_dir: Path, stem: str, report) -> None:
+    """Write the ``<stem>.txt`` + ``<stem>.json`` artifact pair (shared by
+    the flat and structured evaluation families)."""
+    results_dir.mkdir(parents=True, exist_ok=True)
+    (results_dir / f"{stem}.txt").write_text(report.summary() + "\n")
+    (results_dir / f"{stem}.json").write_text(
+        json.dumps(report.to_json(), indent=2) + "\n"
+    )
+    print(f"Report written to {results_dir}/{stem}.txt")
+
+
+def main(argv: list[str] | None = None) -> EvalReport | StructuredEvalReport:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--run", default=None,
                    help="run directory (default: auto-discover newest)")
@@ -306,12 +418,39 @@ def main(argv: list[str] | None = None) -> EvalReport:
         print(f"Using checkpoint run: {run_dir}")
         params, meta = load_policy_params(run_dir)
         ckpt_env = meta.get("env", "multi_cloud")
+        if ckpt_env in ("cluster_set", "cluster_graph"):
+            # Structured checkpoints: greedy episodes vs the hand-coded
+            # node baselines (the reproducible form of the status-table
+            # convergence comparisons).
+            from rl_scheduler_tpu.agent.ppo import PPOTrainConfig
+            from rl_scheduler_tpu.agent.train_ppo import make_bundle_and_net
+
+            num_heads = meta.get("num_heads")
+            if num_heads is None and ckpt_env == "cluster_set":
+                # Checkpoints from before num_heads was recorded were
+                # always 4-head (same fallback as the resume guard,
+                # train_ppo.py).
+                num_heads = 4
+            bundle, net = make_bundle_and_net(
+                ckpt_env, PPOTrainConfig(), num_heads=num_heads,
+            )
+            if args.quick:
+                print("--quick is the flat-env per-step printout; the "
+                      "structured report follows instead")
+            report = structured_evaluate(
+                ckpt_env, bundle, net, params,
+                num_episodes=args.episodes, seed=args.seed,
+            )
+            print(report.summary())
+            _write_report(Path(args.results_dir),
+                          f"structured_evaluation_{ckpt_env}", report)
+            return report
         if ckpt_env != "multi_cloud":
             raise SystemExit(
                 f"checkpoint {run_dir} is for env {ckpt_env!r}; this "
-                "evaluation harness covers the multi-cloud env — pass --run "
-                "pointing at a multi_cloud run (other env families are "
-                "evaluated by their convergence tests)"
+                "evaluation harness covers the multi-cloud and structured "
+                "(cluster_set/cluster_graph) envs — single_cluster runs "
+                "are evaluated by their convergence tests"
             )
         env_params = env_core.make_params(
             EnvConfig(legacy_reward_sign=bool(meta.get("legacy_reward_sign", False)))
@@ -335,14 +474,7 @@ def main(argv: list[str] | None = None) -> EvalReport:
 
     report = evaluate(env_params, policy, args.episodes, args.seed)
     print(report.summary())
-
-    results_dir = Path(args.results_dir)
-    results_dir.mkdir(parents=True, exist_ok=True)
-    (results_dir / "final_evaluation_summary.txt").write_text(report.summary() + "\n")
-    (results_dir / "final_evaluation_summary.json").write_text(
-        json.dumps(report.to_json(), indent=2) + "\n"
-    )
-    print(f"Report written to {results_dir}/final_evaluation_summary.txt")
+    _write_report(Path(args.results_dir), "final_evaluation_summary", report)
     return report
 
 

@@ -28,3 +28,49 @@ def round_robin_policy(step_idx: jnp.ndarray) -> jnp.ndarray:
 
 def random_policy(key: jnp.ndarray, shape: tuple = ()) -> jnp.ndarray:
     return jax.random.randint(key, shape, 0, 2, jnp.int32)
+
+
+# ------------------------------------------------- structured (node-set)
+#
+# Hand-coded baselines over per-node observations ``[..., N, FEAT]`` —
+# the comparison points for the structured policies (configs 4-5,
+# docs/status.md convergence rows). Feature columns differ per env
+# family (env/cluster_set.py vs env/cluster_graph.py), so the policies
+# take the column index rather than hardcoding one family's layout.
+
+STRUCTURED_COLUMNS = {
+    # env name -> {feature: column} (see the env modules' _observe)
+    "cluster_set": {"cost": 0, "cpu": 2},
+    "cluster_graph": {"cost": 0, "cpu": 1},
+}
+
+
+def cheapest_node_policy(obs: jnp.ndarray, cost_col: int) -> jnp.ndarray:
+    """Pick the node with the lowest cost feature (ties -> lowest index).
+    Myopic: ignores utilization, so it overloads the cheap node — the
+    failure mode the set env's capacity term exists to punish."""
+    return jnp.argmin(obs[..., cost_col], axis=-1).astype(jnp.int32)
+
+
+def load_spread_policy(obs: jnp.ndarray, cpu_col: int) -> jnp.ndarray:
+    """Pick the least-utilized node (ties -> lowest index). Ignores cost."""
+    return jnp.argmin(obs[..., cpu_col], axis=-1).astype(jnp.int32)
+
+
+def random_node_policy(key: jnp.ndarray, obs: jnp.ndarray) -> jnp.ndarray:
+    """Uniform over the node axis of ``[..., N, FEAT]`` obs."""
+    return jax.random.randint(
+        key, obs.shape[:-2], 0, obs.shape[-2], jnp.int32
+    )
+
+
+def structured_baselines(env_name: str) -> dict:
+    """``{name: policy_fn(obs, key) -> actions}`` for a structured env
+    family — the baselines the status-table convergence rows compare
+    against, reproducible from the evaluation CLI."""
+    cols = STRUCTURED_COLUMNS[env_name]
+    return {
+        "random": lambda obs, key: random_node_policy(key, obs),
+        "cheapest_node": lambda obs, key: cheapest_node_policy(obs, cols["cost"]),
+        "load_spread": lambda obs, key: load_spread_policy(obs, cols["cpu"]),
+    }

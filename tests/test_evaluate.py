@@ -152,3 +152,76 @@ def test_evaluate_greedy_q_policy_via_qnetwork(env_params):
     )
     report = evaluate(env_params, greedy_policy_fn(net, params), num_episodes=4)
     assert np.isfinite(report.avg_episode_cost)
+
+
+def test_structured_baselines_policies():
+    """cheapest-node/load-spread argmin the right feature column per env
+    family; random stays within the node range."""
+    from rl_scheduler_tpu.env.baselines import structured_baselines
+
+    obs = jnp.zeros((3, 4, 6)).at[:, :, 0].set(
+        jnp.asarray([[0.4, 0.1, 0.9, 0.5]] * 3)
+    ).at[:, :, 2].set(jnp.asarray([[0.9, 0.8, 0.1, 0.7]] * 3))
+    set_pols = structured_baselines("cluster_set")
+    key = jax.random.PRNGKey(0)
+    assert list(np.asarray(set_pols["cheapest_node"](obs, key))) == [1, 1, 1]
+    assert list(np.asarray(set_pols["load_spread"](obs, key))) == [2, 2, 2]
+    r = np.asarray(set_pols["random"](obs, key))
+    assert r.shape == (3,) and (0 <= r).all() and (r < 4).all()
+
+    # graph family: cpu lives in column 1
+    gobs = jnp.zeros((2, 4, 7)).at[:, :, 1].set(
+        jnp.asarray([[0.9, 0.2, 0.8, 0.6]] * 2)
+    )
+    graph_pols = structured_baselines("cluster_graph")
+    assert list(np.asarray(graph_pols["load_spread"](gobs, key))) == [1, 1]
+
+
+def test_structured_evaluate_cluster_set(tmp_path):
+    """End-to-end: train a tiny cluster_set run, evaluate it with the CLI —
+    per-baseline rewards reported, artifacts written (the reproducible
+    form of the status-table convergence comparisons)."""
+    from rl_scheduler_tpu.agent import evaluate as eval_cli
+    from rl_scheduler_tpu.agent import train_ppo as ppo_cli
+
+    run_dir = ppo_cli.main([
+        "--env", "cluster_set", "--preset", "quick", "--iterations", "2",
+        "--num-envs", "8", "--rollout-steps", "20", "--minibatch-size", "40",
+        "--num-epochs", "2", "--run-root", str(tmp_path),
+        "--run-name", "set_eval_test", "--checkpoint-every", "2",
+    ])
+    report = eval_cli.main([
+        "--run", str(run_dir), "--episodes", "8",
+        "--results-dir", str(tmp_path / "results"),
+    ])
+    assert report.env == "cluster_set"
+    assert np.isfinite(report.avg_episode_reward)
+    assert set(report.baseline_rewards) == {
+        "random", "cheapest_node", "load_spread"
+    }
+    assert all(np.isfinite(v) for v in report.baseline_rewards.values())
+    assert np.isclose(sum(report.cloud_fractions), 1.0)
+    out = (tmp_path / "results" / "structured_evaluation_cluster_set.txt")
+    assert "Improvement vs best baseline" in out.read_text()
+
+
+def test_structured_evaluate_cluster_graph_from_fused_run(tmp_path):
+    """Graph family: a --fused-gnn-trained checkpoint (same tree) evaluates
+    through the flax GNN with the graph-family baselines."""
+    from rl_scheduler_tpu.agent import evaluate as eval_cli
+    from rl_scheduler_tpu.agent import train_ppo as ppo_cli
+
+    run_dir = ppo_cli.main([
+        "--env", "cluster_graph", "--preset", "quick", "--fused-gnn",
+        "--iterations", "2", "--num-envs", "8", "--rollout-steps", "20",
+        "--minibatch-size", "40", "--num-epochs", "2",
+        "--run-root", str(tmp_path), "--run-name", "graph_eval_test",
+        "--checkpoint-every", "2",
+    ])
+    report = eval_cli.main([
+        "--run", str(run_dir), "--episodes", "8",
+        "--results-dir", str(tmp_path / "results"),
+    ])
+    assert report.env == "cluster_graph"
+    assert np.isfinite(report.avg_episode_reward)
+    assert all(np.isfinite(v) for v in report.baseline_rewards.values())
