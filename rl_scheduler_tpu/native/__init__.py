@@ -3,10 +3,20 @@
 The reference is pure Python (SURVEY.md §2: no native code anywhere), so
 nothing here is a parity port — these are the TPU-framework runtime pieces
 where C++ genuinely beats Python: the scheduler extender's per-request
-inference hot path (``mlp_infer.cpp`` via :mod:`~rl_scheduler_tpu.native.build`).
-The JAX/XLA/Pallas side stays the compute path for training.
+inference hot paths (``mlp_infer.cpp`` for the flat MLP/DQN family,
+``set_infer.cpp`` for the set-transformer pointer family, both via
+:mod:`~rl_scheduler_tpu.native.build`). The JAX/XLA/Pallas side stays the
+compute path for training.
 """
 
-from rl_scheduler_tpu.native.build import NativeMLP, ensure_built, pack_mlp
+from rl_scheduler_tpu.native.build import (
+    NativeMLP,
+    NativeSetTransformer,
+    ensure_built,
+    ensure_built_set,
+    pack_mlp,
+    pack_set,
+)
 
-__all__ = ["NativeMLP", "ensure_built", "pack_mlp"]
+__all__ = ["NativeMLP", "NativeSetTransformer", "ensure_built",
+           "ensure_built_set", "pack_mlp", "pack_set"]

@@ -22,7 +22,9 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 _SRC = Path(__file__).with_name("mlp_infer.cpp")
+_SRC_SET = Path(__file__).with_name("set_infer.cpp")
 ABI_VERSION = 2
+SET_ABI_VERSION = 1
 ACTIVATIONS = {"tanh": 0, "relu": 1}
 
 
@@ -31,12 +33,12 @@ def _cache_dir() -> Path:
     return Path(root) / "rl_scheduler_tpu"
 
 
-def ensure_built(force: bool = False) -> Path | None:
-    """Compile the shared library if needed; returns its path or ``None``."""
-    if not _SRC.exists():
+def _build(src: Path, stem: str, force: bool = False) -> Path | None:
+    """Compile one source into the cache dir, keyed on its hash."""
+    if not src.exists():
         return None
-    digest = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
-    out = _cache_dir() / f"libmlp_infer_{digest}.so"
+    digest = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+    out = _cache_dir() / f"lib{stem}_{digest}.so"
     if out.exists() and not force:
         return out
     out.parent.mkdir(parents=True, exist_ok=True)
@@ -44,7 +46,7 @@ def ensure_built(force: bool = False) -> Path | None:
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=out.parent)
     os.close(fd)
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-           str(_SRC), "-o", tmp]
+           str(src), "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, out)
@@ -53,6 +55,16 @@ def ensure_built(force: bool = False) -> Path | None:
         logger.warning("native build failed (%s); using numpy fallback", e)
         Path(tmp).unlink(missing_ok=True)
         return None
+
+
+def ensure_built(force: bool = False) -> Path | None:
+    """Compile the MLP shared library if needed; its path or ``None``."""
+    return _build(_SRC, "mlp_infer", force)
+
+
+def ensure_built_set(force: bool = False) -> Path | None:
+    """Compile the set-transformer shared library; its path or ``None``."""
+    return _build(_SRC_SET, "set_infer", force)
 
 
 def pack_mlp(layers: list[tuple[np.ndarray, np.ndarray]]) -> tuple[np.ndarray, np.ndarray]:
@@ -136,4 +148,118 @@ class NativeMLP:
         handle = getattr(self, "_handle", None)
         if handle:
             self._lib.mlp_destroy(handle)
+            self._handle = None
+
+
+def pack_set(params: dict, depth: int = 2) -> tuple[np.ndarray, np.ndarray]:
+    """Pack a ``SetTransformerPolicy`` param subtree (nested dicts, the
+    ``{"params": ...}`` wrapper optional) into the flat ``(weights, dims)``
+    buffers ``set_create`` expects (layout contract in set_infer.cpp).
+
+    QKV kernels fold the head axis ([dim, H, hd] -> [dim, dim]); the out
+    kernel folds [H, hd, dim] -> [dim, dim]. ``dims`` carries num_heads so
+    the kernel splits per-head subspaces at the same boundaries."""
+    p = params["params"] if "params" in params else params
+    chunks: list[np.ndarray] = []
+
+    def flat(x):
+        chunks.append(np.ascontiguousarray(np.asarray(x, np.float32)).ravel())
+
+    def dense(leaf, in_dim, out_dim):
+        kernel = np.asarray(leaf["kernel"], np.float32).reshape(in_dim, out_dim)
+        flat(kernel)
+        flat(np.asarray(leaf["bias"], np.float32).reshape(out_dim))
+
+    embed_kernel = np.asarray(p["embed"]["kernel"], np.float32)
+    feat, dim = embed_kernel.shape
+    qk = np.asarray(
+        p["block_0"]["MultiHeadDotProductAttention_0"]["query"]["kernel"]
+    )
+    heads = qk.shape[1] if qk.ndim == 3 else 1
+    dense(p["embed"], feat, dim)
+    for i in range(depth):
+        blk = p[f"block_{i}"]
+        attn = blk["MultiHeadDotProductAttention_0"]
+        flat(blk["LayerNorm_0"]["scale"])
+        flat(blk["LayerNorm_0"]["bias"])
+        for name in ("query", "key", "value"):
+            dense(attn[name], dim, dim)
+        # out kernel is [H, hd, dim] -> contiguous [dim, dim] in-order.
+        out_kernel = np.asarray(attn["out"]["kernel"], np.float32).reshape(dim, dim)
+        flat(out_kernel)
+        flat(np.asarray(attn["out"]["bias"], np.float32).reshape(dim))
+        flat(blk["LayerNorm_1"]["scale"])
+        flat(blk["LayerNorm_1"]["bias"])
+        dense(blk["Dense_0"], dim, 2 * dim)
+        dense(blk["Dense_1"], 2 * dim, dim)
+    flat(p["final_norm"]["scale"])
+    flat(p["final_norm"]["bias"])
+    flat(np.asarray(p["head"]["score_head"]["kernel"], np.float32).reshape(dim))
+    flat(np.asarray(p["head"]["score_head"]["bias"], np.float32).reshape(1))
+    dims = np.asarray([feat, dim, depth, heads], np.int32)
+    return np.concatenate(chunks), dims
+
+
+class NativeSetTransformer:
+    """ctypes wrapper over one packed set transformer; ``decide`` takes
+    ``[N, feat]`` obs with N variable per call, is thread-safe, and runs
+    GIL-free (ctypes releases the GIL for the call's duration)."""
+
+    def __init__(self, params: dict, depth: int = 2,
+                 lib_path: Path | None = None):
+        lib_path = lib_path or ensure_built_set()
+        if lib_path is None:
+            raise RuntimeError("native set library unavailable")
+        lib = ctypes.CDLL(str(lib_path))
+        lib.set_create.restype = ctypes.c_void_p
+        lib.set_create.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        lib.set_decide.restype = ctypes.c_int32
+        lib.set_decide.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.set_destroy.argtypes = [ctypes.c_void_p]
+        lib.set_abi_version.restype = ctypes.c_int32
+        if lib.set_abi_version() != SET_ABI_VERSION:
+            raise RuntimeError("native set library ABI mismatch; rebuild")
+        self._lib = lib
+        weights, dims = pack_set(params, depth)
+        self._feat = int(dims[0])
+        handle = lib.set_create(
+            weights.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            dims.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(dims),
+        )
+        if not handle:
+            raise RuntimeError("set_create rejected the packed weights")
+        self._handle = handle
+
+    def decide(self, obs: np.ndarray) -> tuple[int, np.ndarray]:
+        obs = np.ascontiguousarray(obs, np.float32)
+        if obs.ndim != 2 or obs.shape[1] != self._feat:
+            raise ValueError(
+                f"expected obs shape (N, {self._feat}), got {obs.shape}"
+            )
+        n = obs.shape[0]
+        logits = np.empty(n, np.float32)
+        action = self._lib.set_decide(
+            self._handle,
+            obs.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n,
+            logits.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        if action < 0:
+            raise RuntimeError("set_decide failed")
+        return int(action), logits
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.set_destroy(handle)
             self._handle = None

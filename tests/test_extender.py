@@ -732,15 +732,71 @@ def test_set_jax_flag_is_load_aware(set_params_tree):
     assert shed.shed_fraction > 0.0
 
 
-def test_make_set_backend_degrades_native_torch_to_numpy(set_params_tree):
+def test_make_set_backend_flag_mapping(set_params_tree):
+    """torch degrades to numpy; native serves the C++ set core when the
+    toolchain can build it (else numpy)."""
+    from rl_scheduler_tpu.native import ensure_built_set
     from rl_scheduler_tpu.scheduler.set_backend import (
+        NativeSetBackend,
         NumpySetBackend,
         make_set_backend,
     )
 
-    for flag in ("native", "torch"):
-        backend, fell_back = make_set_backend(flag, set_params_tree)
-        assert isinstance(backend, NumpySetBackend) and not fell_back
+    backend, fell_back = make_set_backend("torch", set_params_tree)
+    assert isinstance(backend, NumpySetBackend) and not fell_back
+
+    backend, fell_back = make_set_backend("native", set_params_tree)
+    expected = NativeSetBackend if ensure_built_set() else NumpySetBackend
+    assert isinstance(backend, expected) and not fell_back
+
+
+def test_native_set_backend_matches_numpy(set_params_tree):
+    """The C++ set-transformer forward (native/set_infer.cpp) is the same
+    function as the numpy/flax forwards — logits to 2e-5 across node and
+    head counts — and agrees under concurrent callers (it is the
+    load-aware overflow path, running GIL-free)."""
+    from rl_scheduler_tpu.models.transformer import SetTransformerPolicy
+    from rl_scheduler_tpu.native import ensure_built_set
+    from rl_scheduler_tpu.scheduler.set_backend import (
+        NativeSetBackend,
+        NumpySetBackend,
+    )
+
+    if ensure_built_set() is None:
+        pytest.skip("no C++ toolchain on this machine")
+
+    rng = np.random.default_rng(8)
+    for heads in (1, 4):
+        net = SetTransformerPolicy(dim=64, depth=2, num_heads=heads)
+        tree = net.init(jax.random.PRNGKey(heads), jnp.zeros((8, 6)))
+        native = NativeSetBackend(tree)
+        ref = NumpySetBackend(tree)
+        for n in (3, 8, 40):
+            obs = rng.uniform(0, 1, size=(n, 6)).astype(np.float32)
+            a_nat, l_nat = native.decide_nodes(obs)
+            a_ref, l_ref = ref.decide_nodes(obs)
+            np.testing.assert_allclose(l_nat, l_ref, atol=2e-5)
+            assert a_nat == a_ref
+
+    # Concurrency: 8 threads, one shared handle, all decisions agree.
+    net = SetTransformerPolicy(dim=64, depth=2)
+    tree = net.init(jax.random.PRNGKey(0), jnp.zeros((8, 6)))
+    native, ref = NativeSetBackend(tree), NumpySetBackend(tree)
+    batch = rng.uniform(0, 1, size=(32, 8, 6)).astype(np.float32)
+    expected = [ref.decide_nodes(o)[0] for o in batch]
+    mismatches = []
+
+    def worker():
+        for o, e in zip(batch, expected):
+            if native.decide_nodes(o)[0] != e:
+                mismatches.append(o)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not mismatches
 
 
 def test_make_set_backend_garbage_params_falls_back_to_greedy():
