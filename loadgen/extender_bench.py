@@ -19,7 +19,9 @@ from __future__ import annotations
 import argparse
 import concurrent.futures
 import json
+import sys
 import time
+import urllib.error
 import urllib.request
 
 
@@ -68,6 +70,19 @@ def main(argv: list[str] | None = None) -> dict:
 
     for i in range(args.warmup):
         one_request(base, i, args.nodes)
+    # Scope the server-side percentiles to THIS run: the latency ring
+    # holds 4096 entries, so without a reset the reported p50/p99 mix in
+    # the preceding run's traffic (a round-4 measurement bug). Older
+    # extender builds lack the endpoint — warn and report un-scoped
+    # stats rather than aborting the bench.
+    reset_req = urllib.request.Request(base + "/stats/reset", data=b"{}",
+                                       headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(reset_req, timeout=10) as resp:
+            resp.read()
+    except urllib.error.HTTPError:
+        print("warning: server has no /stats/reset; server-side "
+              "percentiles may include pre-run traffic", file=sys.stderr)
 
     t_start = time.perf_counter()
     with concurrent.futures.ThreadPoolExecutor(args.threads) as pool:

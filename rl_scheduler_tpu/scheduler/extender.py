@@ -134,15 +134,21 @@ class LatencyStats:
             self._lat[self._n % self._capacity] = seconds
             self._n += 1
 
+    def reset(self) -> None:
+        with self._lock:
+            self._n = 0
+
     def percentiles_ms(self) -> dict:
         with self._lock:
-            n = min(self._n, self._capacity)
+            total = self._n  # snapshot under the lock: a concurrent
+            # reset() must not yield {count: 0, p50: <stale value>}
+            n = min(total, self._capacity)
             data = self._lat[:n].copy()
         if n == 0:
             return {"count": 0}
         p50, p90, p99 = np.percentile(data, [50, 90, 99]) * 1e3
         return {
-            "count": int(self._n),
+            "count": int(total),
             "p50_ms": round(float(p50), 4),
             "p90_ms": round(float(p90), 4),
             "p99_ms": round(float(p99), 4),
@@ -414,6 +420,15 @@ class ExtenderPolicy:
             "error": "",
         }
 
+    def reset_stats(self) -> dict:
+        """Clear the latency ring (decision counters stay): scopes a
+        measurement window so ``/stats`` percentiles cover exactly the
+        requests since the reset. Round-4 finding: the 4096-entry ring
+        spans ~3 consecutive 1500-request bench runs, so per-configuration
+        percentiles were contaminated by the preceding run's traffic."""
+        self.stats.reset()
+        return {"status": "reset"}
+
     def health(self) -> dict:
         return {"status": "ok", "backend": self.backend.name,
                 "family": self.family}
@@ -469,6 +484,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, self.policy.filter(args))
         elif self.path == "/prioritize":
             self._send(200, self.policy.prioritize(args))
+        elif self.path == "/stats/reset":
+            self._send(200, self.policy.reset_stats())
         else:
             self._send(404, {"error": f"unknown path {self.path}"})
 

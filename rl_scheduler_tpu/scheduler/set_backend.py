@@ -277,10 +277,13 @@ class LoadAwareSetBackend:
     The same load-aware routing as the MLP family's
     ``LoadAwareJaxBackend`` (see its docstring for the measured GIL
     mechanics): up to ``max_concurrent_jax`` requests use the AOT
-    executable (fastest single-stream); overflow concurrency runs the
-    C++ set core — GIL-FREE, so overflow decisions execute truly in
-    parallel (soak p50 0.46 ms vs 3.3 ms with the numpy-only overflow) —
-    degrading to the numpy forward when the toolchain is missing.
+    executable (fastest single-stream); overflow concurrency routes by
+    node count at the measured crossover (``NATIVE_OVERFLOW_MAX_N``):
+    the C++ set core below it — GIL-FREE, so overflow decisions execute
+    truly in parallel (soak p50 0.46 ms vs 3.3 ms with the numpy-only
+    overflow) — and numpy/BLAS above it (its large-N matmuls are faster
+    AND release the GIL themselves). Numpy serves all sizes when the
+    toolchain is missing.
     Decisions agree between the paths at the tested tolerance (logits
     ~1e-4/2e-5), so shedding is invisible to the scheduler. Shedding only
     applies when the AOT path serves from host XLA-CPU — for an
@@ -303,24 +306,34 @@ class LoadAwareSetBackend:
                 "decision agreement)", device
             )
             max_concurrent_jax = float("inf")
-            self._overflow = None
+            self._overflow_native = self._overflow_numpy = None
+            overflow_label = "-"
         else:
-            # Native first (GIL-free under concurrency), numpy second —
-            # the same preference order as the MLP family.
+            # Overflow routes by node count at the measured crossover:
+            # the C++ core wins below ~N=20 (0.16 vs 0.38 ms at N=8, and
+            # GIL-free under thread pressure); numpy/BLAS wins above
+            # (0.96 vs 2.93 ms at N=100 — BLAS matmuls dominate there
+            # and release the GIL themselves).
+            self._overflow_numpy = NumpySetBackend(params_tree, num_heads)
             try:
-                self._overflow = NativeSetBackend(params_tree, num_heads)
+                self._overflow_native = NativeSetBackend(params_tree,
+                                                         num_heads)
+                overflow_label = "the native set core / numpy (by N)"
             except Exception as e:  # noqa: BLE001 - missing toolchain/.so
                 logger.info("native set overflow unavailable (%s); numpy", e)
-                self._overflow = NumpySetBackend(params_tree, num_heads)
-        overflow_label = (
-            "-" if self._overflow is None
-            else "the native set core" if isinstance(self._overflow,
-                                                     NativeSetBackend)
-            else "the numpy set forward"
-        )
+                self._overflow_native = None
+                overflow_label = "the numpy set forward"
         self._gate = ShedGate(max_concurrent_jax,
                               primary="set jax dispatcher",
                               overflow=overflow_label)
+
+    NATIVE_OVERFLOW_MAX_N = 20  # measured single-stream crossover
+
+    def _overflow_for(self, n: int):
+        if (self._overflow_native is not None
+                and n <= self.NATIVE_OVERFLOW_MAX_N):
+            return self._overflow_native
+        return self._overflow_numpy
 
     @property
     def shed_fraction(self) -> float:
@@ -331,7 +344,7 @@ class LoadAwareSetBackend:
         if not take_jax:
             if log_line:
                 logger.info("%s", log_line)
-            return self._overflow.decide_nodes(node_obs)
+            return self._overflow_for(len(node_obs)).decide_nodes(node_obs)
         try:
             return self._jax.decide_nodes(node_obs)
         finally:

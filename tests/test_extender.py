@@ -938,3 +938,32 @@ def test_build_policy_serves_cluster_graph_checkpoint(tmp_path):
     assert len(result["nodes"]["items"]) == 1
     out = policy.prioritize(_set_request(num_nodes=5))
     assert len(out) == 5 and max(e["score"] for e in out) == 100
+
+
+def test_stats_reset_scopes_measurement_window(telemetry):
+    """POST /stats/reset clears the latency ring (decision counters stay)
+    so consecutive bench runs don't contaminate each other's percentiles
+    (the ring holds 4096 entries — ~3 bench runs)."""
+    policy = ExtenderPolicy(GreedyBackend(), telemetry)
+    for _ in range(5):
+        policy.filter({"nodenames": ["aws-w", "azure-w"], "pod": {}})
+    assert policy.statistics()["latency"]["count"] == 5
+    out = policy.reset_stats()
+    assert out == {"status": "reset"}
+    stats = policy.statistics()
+    assert stats["latency"] == {"count": 0}
+    assert sum(stats["decisions"].values()) == 5  # counters survive
+
+    srv = make_server(policy, host="127.0.0.1", port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        port = srv.server_address[1]
+        _post(port, "/filter", {"nodenames": ["aws-w"], "pod": {}})
+        assert _post(port, "/stats/reset", {}) == {"status": "reset"}
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=5
+        ) as resp:
+            assert json.loads(resp.read())["latency"]["count"] == 0
+    finally:
+        srv.shutdown()
