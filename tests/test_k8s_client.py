@@ -1,0 +1,134 @@
+"""DryRunPodPlacer against a fake kubernetes API (SURVEY.md §4: "a fake
+k8s API server (or recorded responses) for the extender").
+
+The ``kubernetes`` package is not installed in CI, which is itself the
+first case to cover (slow mode must degrade to a warning no-op, never
+crash the serving path). The remaining cases inject a stub ``kubernetes``
+module into ``sys.modules`` and assert the wire-level facts the reference
+relied on: context-name fallback (the reference's hardcoded ``kind-aws``
+lookup always failed — SURVEY.md §7.0), ``dry_run="All"`` on pod
+creation, bounded request timeouts, and fail-soft error reporting.
+"""
+
+import sys
+import types
+
+import pytest
+
+
+def _purge_placer_modules():
+    for name in list(sys.modules):
+        if name == "kubernetes" or name.startswith("kubernetes."):
+            del sys.modules[name]
+    sys.modules.pop("rl_scheduler_tpu.scheduler.k8s_client", None)
+
+
+@pytest.fixture()
+def fake_kubernetes(monkeypatch):
+    """A minimal stand-in for the kubernetes client package: records every
+    create_namespaced_pod call; only the reference's REAL context names
+    (kind-kind-*) resolve, mirroring the kind-prefix behavior."""
+    calls = []
+
+    class FakeV1Api:
+        def __init__(self, api_client=None):
+            self.api_client = api_client
+
+        def create_namespaced_pod(self, namespace, body, dry_run=None,
+                                  _request_timeout=None):
+            if getattr(body.metadata, "explode", False):
+                raise RuntimeError("simulated API failure")
+            calls.append({
+                "namespace": namespace,
+                "pod_name": body.metadata.name,
+                "dry_run": dry_run,
+                "timeout": _request_timeout,
+                "context": self.api_client,
+            })
+
+    class _Meta:
+        def __init__(self, name):
+            self.name = name
+            self.explode = False
+
+    client_mod = types.SimpleNamespace(
+        CoreV1Api=FakeV1Api,
+        V1Pod=lambda metadata, spec: types.SimpleNamespace(
+            metadata=metadata, spec=spec),
+        V1ObjectMeta=lambda name: _Meta(name),
+        V1PodSpec=lambda containers: types.SimpleNamespace(
+            containers=containers),
+        V1Container=lambda name, image: types.SimpleNamespace(
+            name=name, image=image),
+    )
+
+    def new_client_from_config(context=None):
+        if context not in ("kind-kind-aws", "kind-kind-azure"):
+            raise RuntimeError(f"context {context!r} not in kubeconfig")
+        return context
+
+    config_mod = types.SimpleNamespace(
+        new_client_from_config=new_client_from_config)
+    pkg = types.ModuleType("kubernetes")
+    pkg.client = client_mod
+    pkg.config = config_mod
+    _purge_placer_modules()
+    monkeypatch.setitem(sys.modules, "kubernetes", pkg)
+    monkeypatch.setitem(sys.modules, "kubernetes.client", client_mod)
+    monkeypatch.setitem(sys.modules, "kubernetes.config", config_mod)
+    yield calls
+    _purge_placer_modules()
+
+
+def test_placer_is_noop_without_kubernetes_package(monkeypatch):
+    """No kubernetes package (the CI reality): construction succeeds,
+    place() returns False — slow mode degrades, serving never crashes.
+    The ImportError is forced (sys.modules[name] = None makes the import
+    raise) so the branch under test is deterministic even on machines
+    that DO have the package + a live kubeconfig."""
+    _purge_placer_modules()
+    monkeypatch.setitem(sys.modules, "kubernetes", None)
+    from rl_scheduler_tpu.scheduler.k8s_client import DryRunPodPlacer
+
+    placer = DryRunPodPlacer()
+    assert placer.place("aws") is False
+    assert placer.place("nonsense") is False
+
+
+def test_placer_dry_runs_pods_against_fake_api(fake_kubernetes):
+    from rl_scheduler_tpu.scheduler.k8s_client import DryRunPodPlacer
+
+    placer = DryRunPodPlacer(namespace="default")
+    # Context fallback found the kind-prefixed names for both clouds.
+    assert placer.place("aws") is True
+    assert placer.place("azure") is True
+    assert [c["context"] for c in fake_kubernetes] == [
+        "kind-kind-aws", "kind-kind-azure",
+    ]
+    call = fake_kubernetes[0]
+    assert call["dry_run"] == "All"          # reference parity: never
+    assert call["namespace"] == "default"    # actually schedules anything
+    assert call["pod_name"].startswith("rl-pod-")
+    # Bounded timeouts: a stalled kube API must not wedge AsyncPlacer.
+    assert call["timeout"] is not None and call["timeout"][1] > 0
+
+
+def test_placer_reports_api_failure_fail_soft(fake_kubernetes):
+    from rl_scheduler_tpu.scheduler import k8s_client
+
+    placer = k8s_client.DryRunPodPlacer()
+
+    real_meta = sys.modules["kubernetes"].client.V1ObjectMeta
+
+    def exploding_meta(name):
+        meta = real_meta(name)
+        meta.explode = True
+        return meta
+
+    sys.modules["kubernetes"].client.V1ObjectMeta = exploding_meta
+    try:
+        assert placer.place("aws") is False  # surfaced, not raised
+    finally:
+        sys.modules["kubernetes"].client.V1ObjectMeta = real_meta
+    assert not fake_kubernetes  # nothing recorded for the failed create
+    assert placer.place("unknown-cloud") is False  # no client for cloud
