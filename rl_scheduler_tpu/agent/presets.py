@@ -52,7 +52,11 @@ PPO_PRESETS: dict[str, PPOTrainConfig] = {
     # compute_dtype stays f32: measured on a v5e chip, bf16 torsos give no
     # speedup at these 256-wide shapes (the update is bound by full-batch
     # epoch compute, not MXU precision) — the knob exists for the wider
-    # transformer/GNN policies.
+    # transformer/GNN policies. Re-confirmed round 4 under honest sync,
+    # same-process interleaved: 36.8 (bf16) vs 38.1 (f32) ms/update at 6
+    # epochs and dead-even at 1 epoch — within pool noise, so the
+    # roofline's "halve activation bytes" hypothesis does not cash out
+    # (the f32 optimizer/loss chain keeps the traffic).
     "tpu4096": PPOTrainConfig(
         num_envs=4096,
         rollout_steps=100,
