@@ -314,15 +314,29 @@ class ExtenderPolicy:
     def _request_nodes(args: dict) -> tuple[bool, list, list, list]:
         """``(use_names, sources, display_names, clouds)`` for a request:
         the extender protocol carries either full node objects or bare
-        names (``nodecachecapable``)."""
+        names (``nodecachecapable``). Structurally malformed payloads
+        (non-list ``nodenames``, non-dict ``nodes``, junk items) coerce
+        to empty/unknown instead of raising — a scheduling webhook must
+        answer every request (the HTTP layer additionally backstops with
+        a passthrough)."""
         names = args.get("nodenames")
-        nodes = ((args.get("nodes") or {}).get("items")) or []
-        use_names = names is not None
-        sources = list(names) if use_names else nodes
-        display = (
-            list(names) if use_names
-            else [(n.get("metadata") or {}).get("name", "?") for n in nodes]
-        )
+        raw_nodes = args.get("nodes")
+        nodes = raw_nodes.get("items") if isinstance(raw_nodes, dict) else []
+        if not isinstance(nodes, list):
+            nodes = []
+        use_names = isinstance(names, list)
+        if use_names:
+            # Junk entries are DROPPED, not scored: a non-string "name"
+            # (or non-dict node below) is not a schedulable candidate, and
+            # letting it win the pointer argmax would reject every real
+            # node. An entirely junk request yields empty sources, which
+            # filter() answers with a passthrough.
+            sources = [s for s in names if isinstance(s, str)]
+            display = list(sources)
+        else:
+            sources = [n for n in nodes if isinstance(n, dict)]
+            display = [(n.get("metadata") or {}).get("name", "?")
+                       for n in sources]
         return use_names, sources, display, [node_cloud(s) for s in sources]
 
     def _filter_structured(self, args: dict) -> dict:
@@ -370,6 +384,11 @@ class ExtenderPolicy:
         if self.family in self.STRUCTURED:
             return self._filter_structured(args)
         use_names, sources, display, clouds = self._request_nodes(args)
+        if not sources:
+            # Nothing parseable to score (empty request, or every field/
+            # item was junk): echo the request through rather than answer
+            # "zero feasible nodes" — same guard as the structured path.
+            return self._passthrough(args)
         try:
             action, _, _ = self.decide()
         except Exception:  # never wedge scheduling: pass all nodes through.
@@ -480,10 +499,27 @@ class _Handler(BaseHTTPRequestHandler):
         # Normalize extender-protocol field capitalization (Go marshals
         # Nodes/NodeNames/Pod; be liberal in what we accept).
         args = {k.lower(): v for k, v in args.items()}
+        # Last-line fail-open backstop: whatever a malformed-but-valid-JSON
+        # payload does to the decision path, the scheduler must get a
+        # RESPONSE, not a dropped connection — filter echoes the request's
+        # node fields back (nothing filtered), prioritize returns an empty
+        # HostPriorityList.
         if self.path == "/filter":
-            self._send(200, self.policy.filter(args))
+            try:
+                result = self.policy.filter(args)
+            except Exception:  # noqa: BLE001
+                logger.exception("filter failed on malformed request; "
+                                 "passing nodes through")
+                result = ExtenderPolicy._passthrough(args)
+            self._send(200, result)
         elif self.path == "/prioritize":
-            self._send(200, self.policy.prioritize(args))
+            try:
+                result = self.policy.prioritize(args)
+            except Exception:  # noqa: BLE001
+                logger.exception("prioritize failed on malformed request; "
+                                 "empty priority list")
+                result = []
+            self._send(200, result)
         elif self.path == "/stats/reset":
             self._send(200, self.policy.reset_stats())
         else:

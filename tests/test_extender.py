@@ -967,3 +967,76 @@ def test_stats_reset_scopes_measurement_window(telemetry):
             assert json.loads(resp.read())["latency"]["count"] == 0
     finally:
         srv.shutdown()
+
+
+def test_malformed_payloads_fail_open_not_closed(server):
+    """Structurally malformed (but valid-JSON) payloads must never drop
+    the connection OR silently answer "zero feasible nodes": whole-field
+    junk echoes the request through (passthrough), junk ITEMS are dropped
+    while the real nodes still get scored. Round-4 fix: these shapes
+    previously raised inside the handler and closed the socket with no
+    response."""
+    srv, _ = server
+    port = srv.server_address[1]
+    # Whole-field junk: passthrough — the request's fields echo back.
+    for payload in ({"nodes": "garbage"}, {"nodes": {"items": "nope"}}):
+        result = _post(port, "/filter", payload)
+        assert result["nodes"] == payload["nodes"]  # echoed, not emptied
+        assert result["error"] == ""
+        assert _post(port, "/prioritize", payload) == []
+    result = _post(port, "/filter", {"nodenames": 42})
+    assert result["nodenames"] == 42 and result["error"] == ""
+
+    # Junk items dropped; REAL nodes still scored (never rejected in
+    # favor of a junk candidate): kept + failed must cover exactly n1/n2.
+    payload = {
+        "nodes": {"items": [None, 7,
+                            {"metadata": {"name": "n1",
+                                          "labels": {"cloud": "aws"}}},
+                            {"metadata": {"name": "n2",
+                                          "labels": {"cloud": "azure"}}}]},
+        "pod": "not-a-pod",
+    }
+    result = _post(port, "/filter", payload)
+    kept = {n["metadata"]["name"] for n in result["nodes"]["items"]}
+    assert kept | set(result["failedNodes"]) == {"n1", "n2"}
+    assert len(kept) == 1  # the cloud decision still fired
+    prio = _post(port, "/prioritize", payload)
+    assert {e["host"] for e in prio} == {"n1", "n2"}
+
+
+def test_malformed_payloads_structured_family(set_params_tree):
+    """Same contract for the set family: junk items can never win the
+    pointer argmax (they are dropped before scoring), and whole-field
+    junk passes through."""
+    from rl_scheduler_tpu.scheduler.set_backend import NumpySetBackend
+
+    telemetry = TableTelemetry.from_table(cpu_source=RandomCpu(seed=3))
+    policy = ExtenderPolicy(NumpySetBackend(set_params_tree), telemetry)
+    junk_items = {"nodes": {"items": [7, None, _node("real-1", "aws"),
+                                      _node("real-2", "azure")]}}
+    for _ in range(4):  # across table rows: winner is always a real node
+        result = policy.filter(junk_items)
+        assert len(result["nodes"]["items"]) == 1
+        assert result["nodes"]["items"][0]["metadata"]["name"] in (
+            "real-1", "real-2")
+    out = policy.prioritize(junk_items)
+    assert {e["host"] for e in out} == {"real-1", "real-2"}
+    result = policy.filter({"nodes": "garbage"})
+    assert result["nodes"] == "garbage" and result["error"] == ""
+
+
+def test_request_nodes_drops_junk():
+    """_request_nodes never raises on junk field types; junk items are
+    EXCLUDED from the candidate set (not scored as neutral unknowns)."""
+    fn = ExtenderPolicy._request_nodes
+    assert fn({"nodes": "garbage"}) == (False, [], [], [])
+    assert fn({"nodes": {"items": "nope"}}) == (False, [], [], [])
+    assert fn({"nodenames": 42}) == (False, [], [], [])
+    use_names, sources, display, clouds = fn(
+        {"nodes": {"items": [None, {"metadata": {"name": "aws-1"}}, 7]}}
+    )
+    assert not use_names and len(sources) == 1
+    assert display == ["aws-1"] and clouds == ["aws"]
+    use_names, sources, display, clouds = fn({"nodenames": ["a-aws", 9, None]})
+    assert use_names and sources == ["a-aws"] and clouds == ["aws"]
