@@ -1,0 +1,165 @@
+"""Same-process A/B of config-4 policy paths across node counts.
+
+VERDICT r4 items 1/3/4: every measured structured-policy number was at
+N=8, while the domain's real scaling axis is the node set. This tool
+measures the per-update device time of the cluster_set PPO update at
+fleet node counts (N=64, 256, ...) for the candidate policy paths, in
+ONE process with variants interleaved — the only honest comparison on
+the shared TPU pool (absolute numbers swing 2-50x between processes;
+ratios within a process hold — docs/status.md methodology note).
+
+Timing is window-slope + fetch sync: each sample jits a ``lax.scan``
+window of K updates and fetches a param leaf (``jax.device_get``) to
+close it — ``block_until_ready`` does NOT synchronize on tunneled
+backends. The slope between a K=1 and a K=5 window is the per-update
+device time, net of the fixed dispatch/tunnel overhead.
+
+Usage::
+
+    python loadgen/set_scale_bench.py --nodes 64 --envs 1024 \
+        --minibatch 8192 --variants flax_bf16,fused
+    python loadgen/set_scale_bench.py --nodes 8,16,32,64,128,256 \
+        --scale-envs 65536 --variants flax_bf16   # scaling curve
+
+Prints one JSON line per (nodes, variant): per-update ms, env-steps/s,
+and the window times it derives from.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+# Runnable as `python loadgen/set_scale_bench.py` from the repo root
+# without installing the package (same pattern as extender_bench.py).
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def build_update(nodes: int, envs: int, minibatch: int, epochs: int,
+                 variant: str, rollout_steps: int = 100):
+    import jax
+    import jax.numpy as jnp
+
+    from rl_scheduler_tpu.agent.ppo import PPOTrainConfig, make_ppo_bundle
+    from rl_scheduler_tpu.env import cluster_set as cs
+    from rl_scheduler_tpu.env.bundle import cluster_set_bundle
+
+    cfg = PPOTrainConfig(
+        num_envs=envs, rollout_steps=rollout_steps,
+        minibatch_size=minibatch, num_epochs=epochs, lr=1e-3, gamma=0.99,
+        compute_dtype="bfloat16" if variant.endswith("bf16") else "float32",
+    )
+    bundle = cluster_set_bundle(cs.make_params(num_nodes=nodes))
+    if variant == "fused":
+        from rl_scheduler_tpu.models.set_fast import BatchMinorSetPolicy
+
+        net = BatchMinorSetPolicy(dim=64, depth=2, dtype=jnp.bfloat16)
+    elif variant in ("flax_f32", "flax_bf16"):
+        from rl_scheduler_tpu.models import SetTransformerPolicy
+
+        net = SetTransformerPolicy(
+            dim=64, depth=2,
+            dtype=jnp.bfloat16 if variant == "flax_bf16" else None,
+        )
+    else:
+        raise SystemExit(f"unknown variant {variant!r}")
+    init_fn, update_fn, _ = make_ppo_bundle(bundle, cfg, net=net)
+    runner = jax.jit(init_fn)(jax.random.PRNGKey(0))
+
+    def window(k):
+        def body(r):
+            return jax.lax.scan(lambda rr, _: update_fn(rr), r, None,
+                                length=k)[0]
+        return jax.jit(body, donate_argnums=0)
+
+    return runner, window
+
+
+def sync(runner) -> float:
+    import jax
+
+    leaf = jax.tree.leaves(runner.params)[0]
+    return float(jax.device_get(leaf).ravel()[0])
+
+
+def measure(nodes: int, envs: int, minibatch: int, epochs: int,
+            variants: list[str], k_small: int, k_big: int,
+            repeats: int, rollout_steps: int) -> list[dict]:
+    setups = {}
+    for v in variants:
+        runner, window = build_update(nodes, envs, minibatch, epochs, v,
+                                      rollout_steps)
+        w_small, w_big = window(k_small), window(k_big)
+        # Warm both executables (compile + one run each).
+        runner = w_small(runner)
+        runner = w_big(runner)
+        sync(runner)
+        setups[v] = dict(runner=runner, w_small=w_small, w_big=w_big,
+                         t_small=[], t_big=[])
+
+    # Interleave variants within each repeat round (pool-noise fairness).
+    for _ in range(repeats):
+        for v in variants:
+            s = setups[v]
+            for key, w in (("t_small", s["w_small"]), ("t_big", s["w_big"])):
+                t0 = time.perf_counter()
+                s["runner"] = w(s["runner"])
+                sync(s["runner"])
+                s[key].append(time.perf_counter() - t0)
+
+    rows = []
+    for v in variants:
+        s = setups[v]
+        best_small, best_big = min(s["t_small"]), min(s["t_big"])
+        per_update = (best_big - best_small) / (k_big - k_small)
+        rows.append({
+            "nodes": nodes, "variant": v, "envs": envs,
+            "minibatch": minibatch, "epochs": epochs,
+            "per_update_ms": round(per_update * 1e3, 2),
+            "env_steps_per_sec": round(envs * rollout_steps / per_update, 0)
+            if per_update > 0 else None,
+            "window_s": {f"k{k_small}": round(best_small, 4),
+                         f"k{k_big}": round(best_big, 4)},
+        })
+    return rows
+
+
+def main(argv: list[str] | None = None) -> list[dict]:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--nodes", default="64",
+                   help="comma-separated node counts")
+    p.add_argument("--envs", type=int, default=None,
+                   help="parallel env count (default: --scale-envs / nodes)")
+    p.add_argument("--scale-envs", type=int, default=65536,
+                   help="when --envs is unset, envs = scale_envs / nodes "
+                        "(holds the per-update node-feature volume roughly "
+                        "constant across the curve)")
+    p.add_argument("--minibatch", type=int, default=None,
+                   help="minibatch size (default: envs*rollout/8, the "
+                        "fleet-preset ratio)")
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--rollout-steps", type=int, default=100)
+    p.add_argument("--variants", default="flax_bf16,fused")
+    p.add_argument("--k-small", type=int, default=1)
+    p.add_argument("--k-big", type=int, default=5)
+    p.add_argument("--repeats", type=int, default=3)
+    args = p.parse_args(argv)
+
+    all_rows = []
+    for nodes in (int(n) for n in args.nodes.split(",")):
+        envs = args.envs or max(args.scale_envs // nodes, 64)
+        minibatch = args.minibatch or envs * args.rollout_steps // 8
+        rows = measure(nodes, envs, minibatch, args.epochs,
+                       args.variants.split(","), args.k_small, args.k_big,
+                       args.repeats, args.rollout_steps)
+        for r in rows:
+            print(json.dumps(r), flush=True)
+        all_rows.extend(rows)
+    return all_rows
+
+
+if __name__ == "__main__":
+    main()

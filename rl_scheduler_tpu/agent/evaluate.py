@@ -433,6 +433,9 @@ def main(argv: list[str] | None = None) -> EvalReport | StructuredEvalReport:
                 num_heads = 4
             bundle, net = make_bundle_and_net(
                 ckpt_env, PPOTrainConfig(), num_heads=num_heads,
+                # Rebuild the env at the trained node count (fleet
+                # checkpoints; pre-fleet meta lacks the key -> default 8).
+                num_nodes=meta.get("num_nodes"),
             )
             if args.quick:
                 print("--quick is the flat-env per-step printout; the "

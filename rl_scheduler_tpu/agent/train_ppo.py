@@ -33,7 +33,8 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
                         fault_prob: float | None = None,
                         num_heads: int | None = None,
                         fused_gnn: bool = False,
-                        fused_set: bool = False):
+                        fused_set: bool = False,
+                        num_nodes: int | None = None):
     """``(bundle, net)`` for each BASELINE env family.
 
     ``net=None`` means the default flat-obs ActorCritic; the set/graph envs
@@ -43,6 +44,11 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
     the cluster_set policy for the batch-minor fast path
     (``models/set_fast.py`` — same checkpoint tree, ~1.7x the honest
     end-to-end update throughput at tpu4096, see docs/status.md).
+    ``num_nodes`` sizes the structured envs' node set (default 8, the
+    small-cluster regime). The set/GNN policies share per-node weights,
+    so one checkpoint applies at any N — the env size is a training-
+    distribution choice, not an architecture change (fleet-scale regime:
+    docs/scaling.md).
     """
     dtype = None
     if cfg.compute_dtype == "bfloat16":
@@ -62,18 +68,22 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
 
         return single_cluster_bundle(), None
     if env_name == "cluster_set":
+        from rl_scheduler_tpu.env import cluster_set as cs
         from rl_scheduler_tpu.env.bundle import cluster_set_bundle
 
+        set_params = cs.make_params(
+            **({} if num_nodes is None else {"num_nodes": num_nodes})
+        )
         if fused_set:
             from rl_scheduler_tpu.models.set_fast import BatchMinorSetPolicy
 
-            return cluster_set_bundle(), BatchMinorSetPolicy(
+            return cluster_set_bundle(set_params), BatchMinorSetPolicy(
                 dim=64, depth=2, dtype=dtype
             )
         from rl_scheduler_tpu.models import SetTransformerPolicy
 
         kwargs = {} if num_heads is None else {"num_heads": num_heads}
-        return cluster_set_bundle(), SetTransformerPolicy(
+        return cluster_set_bundle(set_params), SetTransformerPolicy(
             dim=64, depth=2, dtype=dtype, **kwargs
         )
     if env_name == "cluster_graph":
@@ -82,7 +92,9 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
         from rl_scheduler_tpu.env import cluster_graph
         from rl_scheduler_tpu.env.bundle import cluster_graph_bundle
 
-        params = cluster_graph.make_params()
+        params = cluster_graph.make_params(
+            **({} if num_nodes is None else {"num_nodes": num_nodes})
+        )
         if fused_gnn:
             from rl_scheduler_tpu.ops.pallas_gnn import FusedGNNPolicy
 
@@ -162,6 +174,11 @@ def main(argv: list[str] | None = None) -> Path:
                         "by default (override with --compute-dtype "
                         "float32); ~1.7x honest end-to-end throughput at "
                         "tpu4096")
+    p.add_argument("--num-nodes", type=int, default=None,
+                   help="node-set size for the structured envs "
+                        "(cluster_set/cluster_graph; default 8). The "
+                        "policies share per-node weights, so a checkpoint "
+                        "trained at one N evaluates and serves at any N")
     p.add_argument("--num-heads", type=int, default=None,
                    help="set-transformer attention heads (cluster_set only; "
                         "default 1 — multi-head measured 3x slower at small "
@@ -232,6 +249,10 @@ def main(argv: list[str] | None = None) -> Path:
         args.env = implied["env"]
         args.fused_set = args.fused_set or implied.get("fused_set", False)
         args.fused_gnn = args.fused_gnn or implied.get("fused_gnn", False)
+        if args.num_nodes is None:
+            # Node count is a scale knob, not part of the recipe identity:
+            # an explicit --num-nodes overrides a preset's implied default.
+            args.num_nodes = implied.get("num_nodes")
     if args.env is None:
         args.env = "multi_cloud"
 
@@ -268,6 +289,18 @@ def main(argv: list[str] | None = None) -> Path:
             f"--hidden configures the MLP policy; --env {args.env} uses a "
             "structured policy with its own dimensions"
         )
+    if args.num_nodes is not None:
+        if args.env not in ("cluster_set", "cluster_graph"):
+            raise SystemExit(
+                f"--num-nodes sizes the structured envs' node set; --env "
+                f"{args.env} has no node axis (use cluster_set/cluster_graph)"
+            )
+        floor = 4 if args.env == "cluster_graph" else 2
+        if args.num_nodes < floor:
+            raise SystemExit(
+                f"--num-nodes {args.num_nodes}: --env {args.env} needs at "
+                f"least {floor} nodes"
+            )
     if args.num_heads is not None and args.env != "cluster_set":
         raise SystemExit(
             f"--num-heads configures the set transformer; --env {args.env} "
@@ -363,10 +396,11 @@ def main(argv: list[str] | None = None) -> Path:
                     "sequence parallelism needs the flax policy's ring "
                     "attention (drop one of the flags)"
                 )
-            if 8 % args.sp:
+            sp_nodes = args.num_nodes if args.num_nodes is not None else 8
+            if sp_nodes % args.sp:
                 raise SystemExit(
-                    f"--sp {args.sp}: the cluster_set node axis (8) must "
-                    "divide by sp"
+                    f"--sp {args.sp}: the cluster_set node axis "
+                    f"({sp_nodes}) must divide by sp"
                 )
         if args.tp > 1:
             if args.env not in ("multi_cloud", "single_cluster"):
@@ -413,7 +447,8 @@ def main(argv: list[str] | None = None) -> Path:
     bundle, net = make_bundle_and_net(args.env, cfg, args.legacy_reward_sign,
                                       fault_prob, args.num_heads,
                                       fused_gnn=args.fused_gnn,
-                                      fused_set=args.fused_set)
+                                      fused_set=args.fused_set,
+                                      num_nodes=args.num_nodes)
     eval_net = None
     if args.sp > 1:
         # Training net: the bundle's own policy cloned with axis_name="sp"
@@ -487,6 +522,18 @@ def main(argv: list[str] | None = None) -> Path:
                 f"but this run would build {net_heads} (the default changed "
                 f"from 4 to 1); pass --num-heads {ckpt_heads}"
             )
+        if args.env in ("cluster_set", "cluster_graph"):
+            # Pre-fleet checkpoints (no num_nodes key) were always N=8.
+            ckpt_nodes = meta.get("num_nodes") or 8
+            want_nodes = args.num_nodes if args.num_nodes is not None else 8
+            if ckpt_nodes != want_nodes:
+                raise SystemExit(
+                    f"--resume: run was trained at --num-nodes {ckpt_nodes}; "
+                    f"resuming at {want_nodes} would silently change the "
+                    f"training distribution mid-run (pass --num-nodes "
+                    f"{ckpt_nodes}, or start a fresh run to fine-tune at a "
+                    "different node count)"
+                )
         ckpt_legacy = meta.get("legacy_reward_sign")
         if ckpt_legacy is not None and ckpt_legacy != args.legacy_reward_sign:
             raise SystemExit(
@@ -572,6 +619,12 @@ def main(argv: list[str] | None = None) -> Path:
                 "hidden": list(cfg.hidden) if net is None else None,
                 # attention head count for the set policy (resume guard)
                 "num_heads": getattr(net, "num_heads", None),
+                # node-set size for the structured envs (resume guard +
+                # evaluation rebuilds the env at the trained N; serving
+                # is N-agnostic and ignores it)
+                "num_nodes": (bundle.obs_shape[0]
+                              if args.env in ("cluster_set", "cluster_graph")
+                              else None),
                 # provenance: the fused paths produce identical
                 # checkpoints, but reproductions need to know which path
                 # the run's throughput came from

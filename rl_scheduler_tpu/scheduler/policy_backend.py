@@ -236,6 +236,25 @@ class ShedGate:
         with self._lock:
             self._inflight -= 1
 
+    def record_shed(self, reason: str | None = None) -> str | None:
+        """Account a request the CALLER routed off the primary without
+        consulting admission (e.g. the set family's concurrent large-N
+        reroute) so ``shed_fraction`` and the saturation log cover every
+        request served off the primary path. Returns a rate-limited log
+        line or None."""
+        with self._lock:
+            self._total += 1
+            self._shed += 1
+            now = self._time.monotonic()
+            if now - self._last_log > 5.0:
+                self._last_log = now
+                return (
+                    f"{self._primary}: routing {reason or 'request'} to "
+                    f"{self._overflow} ({self._shed}/{self._total} requests "
+                    "shed so far)"
+                )
+            return None
+
 
 class LoadAwareJaxBackend:
     """``jax`` flag backend that holds its latency contract at saturation.

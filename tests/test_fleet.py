@@ -1,0 +1,95 @@
+"""Fleet-scale node counts for the structured envs (--num-nodes).
+
+The domain's real scaling axis is the node set (SURVEY.md §5.7: a
+production cluster has hundreds of nodes). The set/GNN policies share
+per-node weights, so one checkpoint applies at any N; these tests pin
+the plumbing that takes the training distribution to fleet N — env
+construction, CLI validation, checkpoint meta, resume guards, and the
+evaluate-at-trained-N round trip.
+"""
+
+import pytest
+
+from rl_scheduler_tpu.agent.ppo import PPOTrainConfig
+from rl_scheduler_tpu.agent.train_ppo import make_bundle_and_net
+
+
+def test_structured_bundles_scale_node_count():
+    cfg = PPOTrainConfig()
+    bundle, net = make_bundle_and_net("cluster_set", cfg, num_nodes=16)
+    assert bundle.obs_shape == (16, 6)
+    assert bundle.num_actions == 16
+    bundle, net = make_bundle_and_net("cluster_graph", cfg, num_nodes=12)
+    assert bundle.obs_shape[0] == 12
+    assert bundle.num_actions == 12
+
+
+def test_set_policy_one_checkpoint_any_n():
+    """Per-node weight sharing: params init'd at N=8 apply at N=64
+    unchanged — the property that makes fleet serving/eval free."""
+    import jax
+
+    from rl_scheduler_tpu.models import SetTransformerPolicy
+
+    net = SetTransformerPolicy(dim=32, depth=1)
+    obs8 = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 6))
+    params = net.init(jax.random.PRNGKey(1), obs8)
+    obs64 = jax.random.normal(jax.random.PRNGKey(2), (2, 64, 6))
+    logits, value = net.apply(params, obs64)
+    assert logits.shape == (2, 64)
+    assert value.shape == (2,)
+
+
+def test_num_nodes_rejected_for_flat_envs(tmp_path):
+    from rl_scheduler_tpu.agent import train_ppo as cli
+
+    with pytest.raises(SystemExit, match="node axis"):
+        cli.main(["--env", "multi_cloud", "--num-nodes", "64",
+                  "--run-root", str(tmp_path)])
+
+
+def test_num_nodes_floor(tmp_path):
+    from rl_scheduler_tpu.agent import train_ppo as cli
+
+    with pytest.raises(SystemExit, match="at least 2"):
+        cli.main(["--env", "cluster_set", "--num-nodes", "1",
+                  "--run-root", str(tmp_path)])
+    with pytest.raises(SystemExit, match="at least 4"):
+        cli.main(["--env", "cluster_graph", "--num-nodes", "3",
+                  "--run-root", str(tmp_path)])
+
+
+def test_sp_divisibility_uses_actual_node_count(tmp_path):
+    from rl_scheduler_tpu.agent import train_ppo as cli
+
+    with pytest.raises(SystemExit, match=r"node axis \(12\)"):
+        cli.main(["--env", "cluster_set", "--num-nodes", "12", "--sp", "8",
+                  "--dp", "1", "--run-root", str(tmp_path)])
+
+
+def test_fleet_cli_roundtrip_meta_resume_evaluate(tmp_path):
+    """Train at N=12, meta records it, mismatched resume refuses, and
+    evaluation rebuilds the env at the trained N."""
+    from rl_scheduler_tpu.agent import evaluate as eval_cli
+    from rl_scheduler_tpu.agent import train_ppo as cli
+    from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+    common = [
+        "--env", "cluster_set", "--preset", "quick", "--num-envs", "4",
+        "--rollout-steps", "8", "--minibatch-size", "16",
+        "--checkpoint-every", "1", "--run-root", str(tmp_path),
+        "--run-name", "fleet12",
+    ]
+    cli.main(common + ["--iterations", "1", "--num-nodes", "12"])
+    mgr = CheckpointManager(tmp_path / "fleet12")
+    assert mgr.restore_meta(1)["num_nodes"] == 12
+    mgr.close()
+    with pytest.raises(SystemExit, match="num-nodes 12"):
+        cli.main(common + ["--iterations", "2", "--resume"])
+    report = eval_cli.main([
+        "--run", str(tmp_path / "fleet12"), "--episodes", "4",
+        "--results-dir", str(tmp_path / "results"),
+    ])
+    assert report.env == "cluster_set"
+    # 12-node episodes: the cloud split covers both halves of the node set
+    assert len(report.cloud_fractions) == 2
