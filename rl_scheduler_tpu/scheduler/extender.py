@@ -19,6 +19,10 @@ the extender protocol, answering
 - ``GET /healthz``     — liveness + backend name.
 - ``GET /stats``       — decision count, per-cloud split, latency
   p50/p90/p99 in ms (the <1 ms p50 target is measured here).
+- ``GET /metrics``     — the same signals in Prometheus text format
+  (decision counters, lifetime latency histogram, shed fraction), so
+  the serving path is scrapeable by the stack the framework already
+  reads telemetry from (``telemetry.PrometheusCpu``).
 
 Node -> cloud mapping uses the ``cloud: aws|azure`` node labels that the
 kind cluster configs apply (reference ``aws-cluster-config.yaml:12-14``),
@@ -34,6 +38,7 @@ warm backend, so p50 stays well under 1 ms even for the ``jax`` backend.
 from __future__ import annotations
 
 import argparse
+import bisect
 import json
 import logging
 import queue
@@ -121,22 +126,56 @@ def node_cloud(node: dict | str) -> str | None:
 
 
 class LatencyStats:
-    """Thread-safe ring buffer of per-decision latencies."""
+    """Thread-safe ring buffer of per-decision latencies, plus a
+    cumulative Prometheus-style histogram.
+
+    The ring feeds ``/stats`` percentiles (reset-scoped measurement
+    windows); the histogram counters are LIFETIME-monotonic — they
+    survive ``/stats/reset`` because Prometheus counters must never go
+    backwards (``rate()``/``histogram_quantile()`` treat decreases as
+    counter resets). Bucket bounds bracket the measured serving regimes:
+    sub-ms native/numpy decisions through the multi-ms saturated tail.
+    """
+
+    # seconds; +Inf is implicit
+    BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+               0.01, 0.025, 0.05, 0.1, 0.25, 1.0)
 
     def __init__(self, capacity: int = 4096):
         self._lat = np.zeros(capacity, np.float64)
         self._n = 0
         self._capacity = capacity
         self._lock = threading.Lock()
+        self._bucket_counts = [0] * (len(self.BUCKETS) + 1)
+        self._sum = 0.0
+        self._count = 0
 
     def record(self, seconds: float) -> None:
         with self._lock:
             self._lat[self._n % self._capacity] = seconds
             self._n += 1
+            i = bisect.bisect_left(self.BUCKETS, seconds)
+            self._bucket_counts[i] += 1
+            self._sum += seconds
+            self._count += 1
 
     def reset(self) -> None:
         with self._lock:
             self._n = 0
+
+    def histogram(self) -> tuple[list, float, int]:
+        """``(cumulative_bucket_counts, sum_seconds, count)`` — counts are
+        cumulative per Prometheus histogram semantics (each le-bucket
+        includes everything below it; the last entry is +Inf = count)."""
+        with self._lock:
+            counts = list(self._bucket_counts)
+            total_sum, count = self._sum, self._count
+        cumulative = []
+        running = 0
+        for c in counts:
+            running += c
+            cumulative.append(running)
+        return cumulative, total_sum, count
 
     def percentiles_ms(self) -> dict:
         with self._lock:
@@ -222,7 +261,8 @@ class ExtenderPolicy:
     STRUCTURED = ("set", "graph")
 
     def __init__(self, backend, telemetry: TableTelemetry, placer=None,
-                 node_capacity_cores: float = DEFAULT_NODE_CAPACITY_CORES):
+                 node_capacity_cores: float = DEFAULT_NODE_CAPACITY_CORES,
+                 price_replay: str = "counter"):
         self.backend = backend
         self.family = getattr(backend, "family", "cloud")
         self.telemetry = telemetry
@@ -231,8 +271,10 @@ class ExtenderPolicy:
             from rl_scheduler_tpu.scheduler.graph_backend import RawPriceReplay
 
             # The graph env replays RAW dollar prices, not the normalized
-            # table — its own counter, synchronized to nothing else.
-            self._price_replay = RawPriceReplay()
+            # table. "counter" mirrors the env's per-step counter
+            # (process-local); "wallclock" derives the row from wall time
+            # so replicas/restarts agree — see RawPriceReplay.
+            self._price_replay = RawPriceReplay(mode=price_replay)
         # Optional DryRunPodPlacer (slow-mode parity), wrapped so kube API
         # stalls can neither block responses nor exhaust threads.
         self.placer = AsyncPlacer(placer) if placer is not None else None
@@ -469,6 +511,59 @@ class ExtenderPolicy:
             out["placements_dropped"] = self.placer.dropped
         return out
 
+    def metrics_text(self) -> str:
+        """Prometheus text exposition (``GET /metrics``): decision
+        counters by cloud, a lifetime latency histogram, the load-aware
+        shed fraction when the backend tracks one, and an info gauge.
+        The framework already READS Prometheus for telemetry
+        (``telemetry.PrometheusCpu``); this closes the loop so the
+        serving path is scrapeable by the same stack (scrape-config
+        snippet in docs/serving.md)."""
+        with self._lock:
+            decisions = dict(self._decisions)
+        p = "rl_scheduler_extender"
+        lines = [
+            f"# HELP {p}_decisions_total Placement decisions by cloud.",
+            f"# TYPE {p}_decisions_total counter",
+        ]
+        for cloud, n in sorted(decisions.items()):
+            lines.append(f'{p}_decisions_total{{cloud="{cloud}"}} {n}')
+        cumulative, total_sum, count = self.stats.histogram()
+        lines += [
+            f"# HELP {p}_decision_latency_seconds Server-side decision "
+            "latency (lifetime histogram; /stats/reset does not clear it).",
+            f"# TYPE {p}_decision_latency_seconds histogram",
+        ]
+        bounds = [f"{b:g}" for b in LatencyStats.BUCKETS] + ["+Inf"]
+        for bound, c in zip(bounds, cumulative):
+            lines.append(
+                f'{p}_decision_latency_seconds_bucket{{le="{bound}"}} {c}'
+            )
+        lines.append(f"{p}_decision_latency_seconds_sum {total_sum:.9g}")
+        lines.append(f"{p}_decision_latency_seconds_count {count}")
+        shed = getattr(self.backend, "shed_fraction", None)
+        if shed is not None:
+            lines += [
+                f"# HELP {p}_shed_fraction Fraction of requests served "
+                "off the primary path by the load-aware backend.",
+                f"# TYPE {p}_shed_fraction gauge",
+                f"{p}_shed_fraction {shed:.9g}",
+            ]
+        if self.placer is not None:
+            lines += [
+                f"# HELP {p}_placements_dropped_total Dry-run placements "
+                "dropped by the bounded async queue.",
+                f"# TYPE {p}_placements_dropped_total counter",
+                f"{p}_placements_dropped_total {self.placer.dropped}",
+            ]
+        lines += [
+            f"# HELP {p}_info Serving backend and decision family.",
+            f"# TYPE {p}_info gauge",
+            f'{p}_info{{backend="{self.backend.name}",'
+            f'family="{self.family}"}} 1',
+        ]
+        return "\n".join(lines) + "\n"
+
 
 class _Handler(BaseHTTPRequestHandler):
     policy: ExtenderPolicy  # set by make_server
@@ -486,6 +581,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, self.policy.health())
         elif self.path == "/stats":
             self._send(200, self.policy.statistics())
+        elif self.path == "/metrics":
+            body = self.policy.metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self._send(404, {"error": f"unknown path {self.path}"})
 
@@ -544,6 +647,7 @@ def build_policy(
     cpu_seed: int | None = None,
     serve_device: str = "cpu",
     node_capacity_cores: float = DEFAULT_NODE_CAPACITY_CORES,
+    price_replay: str = "counter",
 ) -> ExtenderPolicy:
     """Assemble the serving stack: checkpoint -> backend -> telemetry.
 
@@ -657,8 +761,20 @@ def build_policy(
         from rl_scheduler_tpu.scheduler.k8s_client import DryRunPodPlacer
 
         placer = DryRunPodPlacer()
-    return ExtenderPolicy(backend_obj, telemetry, placer,
-                          node_capacity_cores=node_capacity_cores)
+    policy = ExtenderPolicy(backend_obj, telemetry, placer,
+                            node_capacity_cores=node_capacity_cores,
+                            price_replay=price_replay)
+    if price_replay != "counter" and policy.family != "graph":
+        # Refuse here (not just in the CLI) so every entry point —
+        # embeddings, tests — learns the flag did nothing BEFORE traffic:
+        # price replay drives the graph family's raw-dollar features only.
+        raise ValueError(
+            f"price_replay={price_replay!r}: price replay drives the "
+            f"cluster_graph family; the loaded checkpoint serves family "
+            f"{policy.family!r} (drop the flag or serve a cluster_graph "
+            "checkpoint)"
+        )
+    return policy
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -681,15 +797,30 @@ def main(argv: list[str] | None = None) -> None:
                    help="query Prometheus for CPU telemetry (else random parity)")
     p.add_argument("--dry-run-place", action="store_true",
                    help="dry-run pod creation on the chosen kind cluster")
+    p.add_argument("--price-replay", default="counter",
+                   choices=("counter", "wallclock"),
+                   help="graph-family raw-price replay position: 'counter' "
+                        "advances per request (training parity; process-"
+                        "local — restarts start over and replicas walk "
+                        "independent trajectories), 'wallclock' derives "
+                        "the row from wall time so all replicas and "
+                        "restarts agree with zero coordination")
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
-    policy = build_policy(
-        args.backend, args.run, args.run_root,
-        prometheus=args.prometheus, dry_run_place=args.dry_run_place,
-        serve_device=args.serve_device,
-        node_capacity_cores=args.node_capacity_cores,
-    )
+    try:
+        policy = build_policy(
+            args.backend, args.run, args.run_root,
+            prometheus=args.prometheus, dry_run_place=args.dry_run_place,
+            serve_device=args.serve_device,
+            node_capacity_cores=args.node_capacity_cores,
+            price_replay=args.price_replay,
+        )
+    except ValueError as e:
+        # build_policy refuses misconfigurations (explicitly-named
+        # wrong-family checkpoint; --price-replay on a non-graph family)
+        # with actionable messages — exit cleanly, not with a traceback.
+        raise SystemExit(str(e))
     server = make_server(policy, args.host, args.port)
     print(f"Scheduler extender serving on {args.host}:{args.port} "
           f"(backend={policy.backend.name})", flush=True)

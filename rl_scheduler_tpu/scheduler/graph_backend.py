@@ -47,6 +47,7 @@ from __future__ import annotations
 import functools
 import logging
 import threading
+import time
 
 import numpy as np
 
@@ -122,23 +123,50 @@ def topology_for_clouds(clouds: list) -> tuple[np.ndarray, np.ndarray]:
 
 class RawPriceReplay:
     """Replays the raw dollar pricing table (the graph env's price source,
-    ``env/cluster_graph.py::make_params``) with a thread-safe counter —
-    the serving-side analogue of the env's ``step_idx``."""
+    ``env/cluster_graph.py::make_params``) — the serving-side analogue of
+    the env's ``step_idx``. Two modes:
 
-    def __init__(self, prices: np.ndarray | None = None):
+    - ``"counter"`` (default): advances one row per request, mirroring
+      the env's per-step ``step_idx`` exactly. PROCESS-LOCAL by design: a
+      restart starts over at row 0, and two extender replicas walk
+      independent trajectories for identical request streams (each
+      replica sees a valid in-distribution price path — the rows are the
+      same table — but their score trajectories differ). Pinned by
+      ``tests/test_extender.py``; right for single-replica deployments
+      and training parity.
+    - ``"wallclock"``: the row derives from wall time
+      (``int(now / period_s) % T``), so restarts and ALL replicas agree
+      on the current row with zero coordination. ``period_s`` is the
+      real-world cadence one table row represents (default 300 s — the
+      5-minute cloud-pricing update interval the reference's collector
+      scripts poll at). The extender exposes this as
+      ``--price-replay wallclock``.
+    """
+
+    def __init__(self, prices: np.ndarray | None = None,
+                 mode: str = "counter", period_s: float = 300.0,
+                 now_fn=None):
+        if mode not in ("counter", "wallclock"):
+            raise ValueError(f"unknown price replay mode {mode!r}")
         if prices is None:
             from rl_scheduler_tpu.data.loader import load_raw_prices
 
             prices = np.asarray(load_raw_prices(), np.float32)
         self.prices = np.asarray(prices, np.float32)  # [T, 2]
+        self.mode = mode
+        self._period = float(period_s)
+        self._now = now_fn if now_fn is not None else time.time
         self._step = 0
         self._lock = threading.Lock()
 
     def next_row(self) -> tuple[np.ndarray, float]:
         """``(row [2], step_frac)`` at the current replay position."""
-        with self._lock:
-            idx = self._step % len(self.prices)
-            self._step += 1
+        if self.mode == "wallclock":
+            idx = int(self._now() / self._period) % len(self.prices)
+        else:
+            with self._lock:
+                idx = self._step % len(self.prices)
+                self._step += 1
         return self.prices[idx], idx / max(len(self.prices) - 1, 1)
 
 
