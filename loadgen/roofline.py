@@ -151,6 +151,21 @@ CONFIGS = {
         fwd=lambda s: gnn_kron_matmul_flops(s),
         measured_ms=341.0,
     ),
+    # Fleet-scale node sets (round 5, VERDICT r4 items 1/4): the same
+    # set-transformer update at N=64/256 (flax policy, bf16 — at fleet N
+    # the batch-minor path's advantage vanishes, docs/scaling.md).
+    # measured_ms: round-5 same-process window-slope A/B
+    # (loadgen/set_scale_bench.py).
+    "4 (set_fleet64, N=64, 1 epoch)": dict(
+        envs=1024, steps=100, epochs=1, nodes=64,
+        fwd=lambda s: set_matmul_flops(s, nodes=64),
+        measured_ms=417.0,
+    ),
+    "4 (set fleet, N=256, 1 epoch)": dict(
+        envs=256, steps=100, epochs=1, nodes=256,
+        fwd=lambda s: set_matmul_flops(s, nodes=256),
+        measured_ms=299.0,
+    ),
 }
 
 
@@ -175,7 +190,9 @@ def main(argv: list[str] | None = None) -> list[dict]:
                                                gbs=args.gbs)
         elif name.startswith("4"):
             bw_ms = set_bandwidth_floor_ms(batch, rollout_samples,
-                                           c["epochs"], gbs=args.gbs)
+                                           c["epochs"],
+                                           nodes=c.get("nodes", 8),
+                                           gbs=args.gbs)
         else:  # config 5: VMEM-resident fused kernel, matmul-bound
             bw_ms = 0.0
         floor = max(flop_ms, bw_ms)

@@ -1,0 +1,93 @@
+"""Where single-chip set attention hits the memory wall (sp crossover).
+
+VERDICT r4 item 3: ``parallel/ring_attention.py`` motivates sequence
+parallelism with "tens of thousands of nodes" but no number. This tool
+finds the number on the real chip: for each node count N it runs one
+set-transformer minibatch fwd+bwd at descending minibatch sizes B and
+reports the largest B that fits in HBM (the flax policy materializes
+the ``[B, heads, N, N]`` attention scores; ring attention never
+materializes the N x N matrix, so its per-chip score memory is
+``B x N x N/sp`` — the crossover argument in docs/scaling.md).
+
+Usage::
+
+    python loadgen/set_memory_wall.py --nodes 1024,2048,4096,8192
+
+Prints one JSON line per N: the largest fitting B, the fwd+bwd time at
+that B (window-slope, fetch-synced), and the per-sample device time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def probe(nodes: int, batches: list[int]) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from rl_scheduler_tpu.env.cluster_set import NODE_FEAT
+    from rl_scheduler_tpu.models import SetTransformerPolicy
+
+    net = SetTransformerPolicy(dim=64, depth=2, dtype=jnp.bfloat16)
+    params = net.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, nodes, NODE_FEAT), jnp.float32))
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    def loss_fn(p, obs, act):
+        logits, value = net.apply(p, obs)
+        logp = jax.nn.log_softmax(logits)
+        pick = jnp.take_along_axis(logp, act[:, None], axis=1)
+        return pick.mean() + (value ** 2).mean()
+
+    @jax.jit
+    def sgd_step(p, o, obs, act):
+        grads = jax.grad(loss_fn)(p, obs, act)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o
+
+    for b in batches:
+        obs = jnp.zeros((b, nodes, NODE_FEAT), jnp.float32)
+        act = jnp.zeros((b,), jnp.int32)
+        try:
+            p2, o2 = sgd_step(params, opt_state, obs, act)
+            # fetch-sync (block_until_ready lies on tunneled backends)
+            float(jax.device_get(jax.tree.leaves(p2)[0]).ravel()[0])
+            t0 = time.perf_counter()
+            p2, o2 = sgd_step(params, opt_state, obs, act)
+            float(jax.device_get(jax.tree.leaves(p2)[0]).ravel()[0])
+            dt = time.perf_counter() - t0
+            return {"nodes": nodes, "max_minibatch": b,
+                    "fwd_bwd_adam_ms": round(dt * 1e3, 1),
+                    "us_per_sample": round(dt / b * 1e6, 2),
+                    "score_tensor_mb": round(b * nodes * nodes * 2 / 2**20, 1)}
+        except Exception as e:  # XlaRuntimeError: out of memory, etc.
+            last_err = f"{type(e).__name__}: {str(e)[:120]}"
+            continue
+    return {"nodes": nodes, "max_minibatch": None, "error": last_err}
+
+
+def main(argv: list[str] | None = None) -> list[dict]:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--nodes", default="1024,2048,4096,8192")
+    p.add_argument("--batches", default="4096,2048,1024,512,256,128,64,32,8,1")
+    args = p.parse_args(argv)
+    batches = [int(b) for b in args.batches.split(",")]
+    rows = []
+    for n in (int(x) for x in args.nodes.split(",")):
+        row = probe(n, batches)
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -53,10 +53,15 @@ def build_update(nodes: int, envs: int, minibatch: int, epochs: int,
         compute_dtype="bfloat16" if variant.endswith("bf16") else "float32",
     )
     bundle = cluster_set_bundle(cs.make_params(num_nodes=nodes))
-    if variant == "fused":
+    fused_impls = {"fused": None, "fused_chunked": "chunked",
+                   "fused_matmul": "matmul"}
+    if variant in fused_impls:
         from rl_scheduler_tpu.models.set_fast import BatchMinorSetPolicy
 
-        net = BatchMinorSetPolicy(dim=64, depth=2, dtype=jnp.bfloat16)
+        # "fused" = auto attention formulation (by node count);
+        # "fused_chunked" / "fused_matmul" pin one (A/B the threshold).
+        net = BatchMinorSetPolicy(dim=64, depth=2, dtype=jnp.bfloat16,
+                                  attn_impl=fused_impls[variant])
     elif variant in ("flax_f32", "flax_bf16"):
         from rl_scheduler_tpu.models import SetTransformerPolicy
 
@@ -115,12 +120,21 @@ def measure(nodes: int, envs: int, minibatch: int, epochs: int,
         s = setups[v]
         best_small, best_big = min(s["t_small"]), min(s["t_big"])
         per_update = (best_big - best_small) / (k_big - k_small)
+        if per_update <= 0:
+            # Shared-pool noise inverted the windows: flag loudly rather
+            # than emit a garbage row (raise --repeats / --k-big).
+            rows.append({
+                "nodes": nodes, "variant": v,
+                "unreliable": "non-positive window slope",
+                "window_s": {f"k{k_small}": round(best_small, 4),
+                             f"k{k_big}": round(best_big, 4)},
+            })
+            continue
         rows.append({
             "nodes": nodes, "variant": v, "envs": envs,
             "minibatch": minibatch, "epochs": epochs,
             "per_update_ms": round(per_update * 1e3, 2),
-            "env_steps_per_sec": round(envs * rollout_steps / per_update, 0)
-            if per_update > 0 else None,
+            "env_steps_per_sec": round(envs * rollout_steps / per_update, 0),
             "window_s": {f"k{k_small}": round(best_small, 4),
                          f"k{k_big}": round(best_big, 4)},
         })
@@ -140,7 +154,11 @@ def main(argv: list[str] | None = None) -> list[dict]:
     p.add_argument("--minibatch", type=int, default=None,
                    help="minibatch size (default: envs*rollout/8, the "
                         "fleet-preset ratio)")
-    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--epochs", default="1",
+                   help="comma-separated SGD epoch counts; >1 value turns "
+                        "the run into a same-process epochs sweep (the "
+                        "slope separates SGD cost/epoch from the "
+                        "rollout+fixed intercept)")
     p.add_argument("--rollout-steps", type=int, default=100)
     p.add_argument("--variants", default="flax_bf16,fused")
     p.add_argument("--k-small", type=int, default=1)
@@ -152,12 +170,13 @@ def main(argv: list[str] | None = None) -> list[dict]:
     for nodes in (int(n) for n in args.nodes.split(",")):
         envs = args.envs or max(args.scale_envs // nodes, 64)
         minibatch = args.minibatch or envs * args.rollout_steps // 8
-        rows = measure(nodes, envs, minibatch, args.epochs,
-                       args.variants.split(","), args.k_small, args.k_big,
-                       args.repeats, args.rollout_steps)
-        for r in rows:
-            print(json.dumps(r), flush=True)
-        all_rows.extend(rows)
+        for epochs in (int(e) for e in args.epochs.split(",")):
+            rows = measure(nodes, envs, minibatch, epochs,
+                           args.variants.split(","), args.k_small,
+                           args.k_big, args.repeats, args.rollout_steps)
+            for r in rows:
+                print(json.dumps(r), flush=True)
+            all_rows.extend(rows)
     return all_rows
 
 

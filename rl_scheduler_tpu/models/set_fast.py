@@ -83,30 +83,59 @@ def _proj(tree: dict, x: jnp.ndarray) -> jnp.ndarray:
     return jnp.einsum("de,ndb->neb", w, x) + tree["bias"].reshape(-1)[None, :, None]
 
 
-def _block(pb: dict, pb_f32: dict, h: jnp.ndarray, dim: int) -> jnp.ndarray:
+# Above this node count the attention scores run as batched matmuls
+# (einsum over the feature axis) instead of the per-query-node chunk
+# loop: the loop's VPU mul+reduce wins at tiny N (its [8,64]x[64,8]
+# matmul alternative underfills the MXU and measured 3 ms/minibatch
+# slower at N=8), but it unrolls O(N) chunks per block — at fleet N the
+# [N,dim]x[dim,N] matmuls are MXU-shaped and the unrolled loop is the
+# pathology (compile time and per-op overhead both O(N)).
+CHUNKED_ATTN_MAX_N = 16
+
+
+def _block(pb: dict, pb_f32: dict, h: jnp.ndarray, dim: int,
+           attn_impl: str | None = None) -> jnp.ndarray:
     """One pre-LN transformer block, batch-minor.
 
     ``pb`` holds compute-dtype weights for the matmuls; ``pb_f32`` is the
     same block's float32 tree for the LayerNorms (see :func:`_ln_feature`).
+    ``attn_impl``: ``"chunked"`` / ``"matmul"`` / None (auto by node
+    count at :data:`CHUNKED_ATTN_MAX_N`).
     """
     attn = pb["MultiHeadDotProductAttention_0"]
     hn = _ln_feature(h, pb_f32["LayerNorm_0"]).astype(h.dtype)
     q = _proj(attn["query"], hn)
     k = _proj(attn["key"], hn)
     v = _proj(attn["value"], hn)
-    # Attention CHUNKED over query nodes: scores as elementwise
-    # multiply + feature-axis reduction instead of
-    # einsum('ndb,mdb->nmb'), which XLA lowers to B tiny batched
-    # [N,dim]x[dim,N] matmuls — measured 3 ms/minibatch slower at
-    # 32768x8x64 than these lane-shaped VPU reductions.
     scale = dim ** -0.5
     num_nodes = h.shape[0]
-    outs = []
-    for n in range(num_nodes):
-        s_n = (q[n][None] * k).sum(axis=1) * scale   # [N(keys), B]
-        p_n = jax.nn.softmax(s_n, axis=0)            # over the key axis
-        outs.append((p_n[:, None, :] * v).sum(axis=0))  # [dim, B]
-    h = h + _proj(attn["out"], jnp.stack(outs))
+    if attn_impl is None:
+        attn_impl = "chunked" if num_nodes <= CHUNKED_ATTN_MAX_N else "matmul"
+    if attn_impl not in ("chunked", "matmul"):
+        # A typo must not silently run the chunk loop (the fleet-N
+        # pathology: 709 vs 420 ms/update at N=64).
+        raise ValueError(f"unknown attn_impl {attn_impl!r}; "
+                         "use 'chunked', 'matmul', or None (auto)")
+    if attn_impl == "matmul":
+        # Batched-matmul scores over the batch lanes: [N,N,B] materializes,
+        # but each matmul is [N,dim]x[dim,N] per lane — MXU-shaped at
+        # fleet N. Softmax in f32 over the key axis.
+        s = jnp.einsum("ndb,mdb->nmb", q, k) * scale
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=1).astype(v.dtype)
+        ctx = jnp.einsum("nmb,mdb->ndb", p, v)
+    else:
+        # Attention CHUNKED over query nodes: scores as elementwise
+        # multiply + feature-axis reduction instead of
+        # einsum('ndb,mdb->nmb'), which XLA lowers to B tiny batched
+        # [N,dim]x[dim,N] matmuls — measured 3 ms/minibatch slower at
+        # 32768x8x64 than these lane-shaped VPU reductions.
+        outs = []
+        for n in range(num_nodes):
+            s_n = (q[n][None] * k).sum(axis=1) * scale   # [N(keys), B]
+            p_n = jax.nn.softmax(s_n, axis=0)            # over the key axis
+            outs.append((p_n[:, None, :] * v).sum(axis=0))  # [dim, B]
+        ctx = jnp.stack(outs)
+    h = h + _proj(attn["out"], ctx)
     m = _ln_feature(h, pb_f32["LayerNorm_1"]).astype(h.dtype)
     m = jnp.einsum("dh,ndb->nhb", pb["Dense_0"]["kernel"], m) \
         + pb["Dense_0"]["bias"][None, :, None]
@@ -122,12 +151,15 @@ def batch_minor_forward(
     depth: int = 2,
     dim: int = 64,
     dtype: Any = None,
+    attn_impl: str | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """``obs [B, N, F] -> (logits [B, N], value [B])``; internals batch-minor.
 
     ``dtype`` (e.g. ``jnp.bfloat16``) casts the embed/block compute;
     LayerNorm statistics and the pointer/value heads stay float32, the
-    same contract as ``SetTransformerPolicy.dtype``.
+    same contract as ``SetTransformerPolicy.dtype``. ``attn_impl``
+    selects the attention formulation (see :func:`_block`; default auto
+    by node count).
     """
     p = params["params"]
     x = obs.astype(jnp.float32).transpose(1, 2, 0)      # [N, F, B]
@@ -138,7 +170,7 @@ def batch_minor_forward(
     h = jnp.einsum("fd,nfb->ndb", pc["embed"]["kernel"], x) \
         + pc["embed"]["bias"][None, :, None]
     for i in range(depth):
-        h = _block(pc[f"block_{i}"], p[f"block_{i}"], h, dim)
+        h = _block(pc[f"block_{i}"], p[f"block_{i}"], h, dim, attn_impl)
     h = h.astype(jnp.float32)
     h = _ln_feature(h, p["final_norm"])
     head = p["head"]
@@ -172,13 +204,15 @@ class BatchMinorSetPolicy:
 
     num_heads = 1  # the train CLI's resume guard reads this
 
-    def __init__(self, dim: int = 64, depth: int = 2, dtype: Any = None):
+    def __init__(self, dim: int = 64, depth: int = 2, dtype: Any = None,
+                 attn_impl: str | None = None):
         from rl_scheduler_tpu.models import SetTransformerPolicy
 
         self.inner = SetTransformerPolicy(dim=dim, depth=depth, num_heads=1)
         self.dim = dim
         self.depth = depth
         self.dtype = dtype
+        self.attn_impl = attn_impl
 
     def init(self, key, obs):
         return self.inner.init(key, obs)
@@ -199,6 +233,6 @@ class BatchMinorSetPolicy:
         self._validate(params)
         return apply_with_optional_batch(
             lambda o: batch_minor_forward(params, o, self.depth, self.dim,
-                                          self.dtype),
+                                          self.dtype, self.attn_impl),
             obs,
         )

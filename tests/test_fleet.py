@@ -40,6 +40,42 @@ def test_set_policy_one_checkpoint_any_n():
     assert value.shape == (2,)
 
 
+def test_set_fleet64_preset_implies_fleet_recipe(tmp_path):
+    """--preset set_fleet64 is the measured N=64 recipe: implies
+    cluster_set at 64 nodes (overridable --num-nodes), bf16, 1 epoch,
+    1024 envs; contradictions refused like the other recipe presets."""
+    from rl_scheduler_tpu.agent import train_ppo as cli
+    from rl_scheduler_tpu.agent.presets import PPO_PRESETS, PRESET_IMPLIES
+
+    cfg = PPO_PRESETS["set_fleet64"]
+    assert (cfg.num_envs, cfg.num_epochs, cfg.compute_dtype) == \
+        (1024, 1, "bfloat16")
+    assert PRESET_IMPLIES["set_fleet64"] == {"env": "cluster_set",
+                                            "num_nodes": 64}
+    with pytest.raises(SystemExit, match="cluster_set"):
+        cli.main(["--preset", "set_fleet64", "--env", "cluster_graph",
+                  "--run-root", str(tmp_path)])
+
+
+def test_set_fleet64_preset_trains(tmp_path):
+    """The preset trains end-to-end (tiny overrides) and records the
+    implied node count in checkpoint meta."""
+    from rl_scheduler_tpu.agent import train_ppo as cli
+    from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+    cli.main([
+        "--preset", "set_fleet64", "--num-nodes", "16", "--num-envs", "4",
+        "--rollout-steps", "8", "--minibatch-size", "16",
+        "--iterations", "1", "--checkpoint-every", "1",
+        "--run-root", str(tmp_path), "--run-name", "fleet_preset",
+    ])
+    mgr = CheckpointManager(tmp_path / "fleet_preset")
+    meta = mgr.restore_meta(1)
+    assert meta["num_nodes"] == 16  # explicit flag overrides the implied 64
+    assert meta["env"] == "cluster_set"
+    mgr.close()
+
+
 def test_num_nodes_rejected_for_flat_envs(tmp_path):
     from rl_scheduler_tpu.agent import train_ppo as cli
 
