@@ -48,23 +48,45 @@ def probe(nodes: int, batches: list[int]) -> dict:
         pick = jnp.take_along_axis(logp, act[:, None], axis=1)
         return pick.mean() + (value ** 2).mean()
 
-    @jax.jit
-    def sgd_step(p, o, obs, act):
+    def window(k):
+        def body(p, o, obs, act):
+            def step(carry, _):
+                p, o = carry
+                return sgd_body(p, o, obs, act), None
+            return jax.lax.scan(step, (p, o), None, length=k)[0]
+        return jax.jit(body)
+
+    def sgd_body(p, o, obs, act):
         grads = jax.grad(loss_fn)(p, obs, act)
         updates, o = tx.update(grads, o, p)
         return optax.apply_updates(p, updates), o
 
+    def timed(fn, obs, act) -> float:
+        t0 = time.perf_counter()
+        p2, _ = fn(params, opt_state, obs, act)
+        # fetch-sync (block_until_ready lies on tunneled backends)
+        float(jax.device_get(jax.tree.leaves(p2)[0]).ravel()[0])
+        return time.perf_counter() - t0
+
+    k_small, k_big = 1, 5
+    last_err = "no batch size attempted"
     for b in batches:
-        obs = jnp.zeros((b, nodes, NODE_FEAT), jnp.float32)
-        act = jnp.zeros((b,), jnp.int32)
         try:
-            p2, o2 = sgd_step(params, opt_state, obs, act)
-            # fetch-sync (block_until_ready lies on tunneled backends)
-            float(jax.device_get(jax.tree.leaves(p2)[0]).ravel()[0])
-            t0 = time.perf_counter()
-            p2, o2 = sgd_step(params, opt_state, obs, act)
-            float(jax.device_get(jax.tree.leaves(p2)[0]).ravel()[0])
-            dt = time.perf_counter() - t0
+            obs = jnp.zeros((b, nodes, NODE_FEAT), jnp.float32)
+            act = jnp.zeros((b,), jnp.int32)
+            w1, w5 = window(k_small), window(k_big)
+            timed(w1, obs, act)  # warm both executables
+            timed(w5, obs, act)
+            # Window slope nets out the fixed dispatch/tunnel overhead
+            # (~70-110 ms on this backend) — the same methodology as
+            # set_scale_bench.py; best of 2 per window.
+            t1 = min(timed(w1, obs, act) for _ in range(2))
+            t5 = min(timed(w5, obs, act) for _ in range(2))
+            dt = (t5 - t1) / (k_big - k_small)
+            if dt <= 0:
+                return {"nodes": nodes, "max_minibatch": b,
+                        "unreliable": "non-positive window slope",
+                        "window_s": {"k1": round(t1, 4), "k5": round(t5, 4)}}
             return {"nodes": nodes, "max_minibatch": b,
                     "fwd_bwd_adam_ms": round(dt * 1e3, 1),
                     "us_per_sample": round(dt / b * 1e6, 2),

@@ -124,7 +124,8 @@ def measure(nodes: int, envs: int, minibatch: int, epochs: int,
             # Shared-pool noise inverted the windows: flag loudly rather
             # than emit a garbage row (raise --repeats / --k-big).
             rows.append({
-                "nodes": nodes, "variant": v,
+                "nodes": nodes, "variant": v, "envs": envs,
+                "minibatch": minibatch, "epochs": epochs,
                 "unreliable": "non-positive window slope",
                 "window_s": {f"k{k_small}": round(best_small, 4),
                              f"k{k_big}": round(best_big, 4)},

@@ -70,6 +70,20 @@ elif mode == "dp_sp":
     init_fn, update_fn, _ = make_seq_parallel_ppo(
         cluster_set_bundle(), cfg, net, mesh
     )
+elif mode == "dp_sp_fleet":
+    # Fleet node count (round 5): cluster_set at N=64 with the node
+    # axis sharded sp=4 (16 nodes per device), sp outermost so every
+    # ring hop's ppermute partner lives across a process boundary for
+    # half the devices.
+    from rl_scheduler_tpu.env import cluster_set as cs
+    from rl_scheduler_tpu.env.bundle import cluster_set_bundle
+    from rl_scheduler_tpu.models import SetTransformerPolicy
+
+    mesh = make_mesh({"sp": 4, "dp": 2})
+    net = SetTransformerPolicy(dim=32, depth=1, axis_name="sp")
+    init_fn, update_fn, _ = make_seq_parallel_ppo(
+        cluster_set_bundle(cs.make_params(num_nodes=64)), cfg, net, mesh
+    )
 elif mode == "dp_tp":
     # tp first for the same reason: the column/row-parallel psums (and
     # the tp-aware global-norm clip) cross processes.
@@ -189,6 +203,16 @@ def test_two_process_seq_parallel_training(tmp_path):
     losses must stay finite and bit-identical on both ranks."""
     _run_distributed(tmp_path, num_procs=2, local_devices=4, iterations=2,
                      mode="dp_sp")
+
+
+@pytest.mark.slow
+def test_two_process_fleet_seq_parallel_training(tmp_path):
+    """Round 5: the fleet node count (N=64, set_fleet64's env) trains
+    dp x sp across OS processes — sp=4 puts 16 nodes on each device
+    and the ring's ppermute hops cross the process boundary; losses
+    must stay finite and bit-identical on both ranks."""
+    _run_distributed(tmp_path, num_procs=2, local_devices=4, iterations=2,
+                     mode="dp_sp_fleet")
 
 
 @pytest.mark.slow
