@@ -262,7 +262,8 @@ class ExtenderPolicy:
 
     def __init__(self, backend, telemetry: TableTelemetry, placer=None,
                  node_capacity_cores: float = DEFAULT_NODE_CAPACITY_CORES,
-                 price_replay: str = "counter"):
+                 price_replay: str = "counter",
+                 price_replay_period_s: float = 300.0):
         self.backend = backend
         self.family = getattr(backend, "family", "cloud")
         self.telemetry = telemetry
@@ -274,7 +275,9 @@ class ExtenderPolicy:
             # table. "counter" mirrors the env's per-step counter
             # (process-local); "wallclock" derives the row from wall time
             # so replicas/restarts agree — see RawPriceReplay.
-            self._price_replay = RawPriceReplay(mode=price_replay)
+            self._price_replay = RawPriceReplay(
+                mode=price_replay, period_s=price_replay_period_s
+            )
         # Optional DryRunPodPlacer (slow-mode parity), wrapped so kube API
         # stalls can neither block responses nor exhaust threads.
         self.placer = AsyncPlacer(placer) if placer is not None else None
@@ -507,6 +510,12 @@ class ExtenderPolicy:
             },
             "latency": self.stats.percentiles_ms(),
         }
+        shed = getattr(self.backend, "shed_fraction", None)
+        if shed is not None:
+            # The load-aware backends' off-primary fraction (admission
+            # overflow + the large-N reroute) — same signal /metrics
+            # exports as a gauge.
+            out["shed_fraction"] = round(float(shed), 4)
         if self.placer is not None:
             out["placements_dropped"] = self.placer.dropped
         return out
@@ -648,6 +657,7 @@ def build_policy(
     serve_device: str = "cpu",
     node_capacity_cores: float = DEFAULT_NODE_CAPACITY_CORES,
     price_replay: str = "counter",
+    price_replay_period_s: float = 300.0,
 ) -> ExtenderPolicy:
     """Assemble the serving stack: checkpoint -> backend -> telemetry.
 
@@ -763,7 +773,8 @@ def build_policy(
         placer = DryRunPodPlacer()
     policy = ExtenderPolicy(backend_obj, telemetry, placer,
                             node_capacity_cores=node_capacity_cores,
-                            price_replay=price_replay)
+                            price_replay=price_replay,
+                            price_replay_period_s=price_replay_period_s)
     if price_replay != "counter" and policy.family != "graph":
         # Refuse here (not just in the CLI) so every entry point —
         # embeddings, tests — learns the flag did nothing BEFORE traffic:
@@ -805,7 +816,18 @@ def main(argv: list[str] | None = None) -> None:
                         "independent trajectories), 'wallclock' derives "
                         "the row from wall time so all replicas and "
                         "restarts agree with zero coordination")
+    p.add_argument("--price-replay-period", type=float, default=300.0,
+                   help="wallclock replay only: real-world seconds one "
+                        "pricing-table row represents (default 300 — the "
+                        "5-minute cloud-pricing update cadence)")
     args = p.parse_args(argv)
+    if args.price_replay_period <= 0:
+        # RawPriceReplay validates too (for programmatic entry points);
+        # refusing here keeps the CLI's exit clean and pre-startup.
+        raise SystemExit(
+            f"--price-replay-period {args.price_replay_period}: must be "
+            "a positive number of seconds"
+        )
 
     logging.basicConfig(level=logging.INFO)
     try:
@@ -815,6 +837,7 @@ def main(argv: list[str] | None = None) -> None:
             serve_device=args.serve_device,
             node_capacity_cores=args.node_capacity_cores,
             price_replay=args.price_replay,
+            price_replay_period_s=args.price_replay_period,
         )
     except ValueError as e:
         # build_policy refuses misconfigurations (explicitly-named

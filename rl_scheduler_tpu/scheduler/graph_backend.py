@@ -148,6 +148,14 @@ class RawPriceReplay:
                  now_fn=None):
         if mode not in ("counter", "wallclock"):
             raise ValueError(f"unknown price replay mode {mode!r}")
+        if period_s <= 0:
+            # Validate at construction for EVERY entry point: wallclock
+            # divides by the period per request (0 -> ZeroDivisionError
+            # at request time; negative -> silent backwards replay).
+            raise ValueError(
+                f"price replay period_s={period_s}: must be a positive "
+                "number of seconds"
+            )
         if prices is None:
             from rl_scheduler_tpu.data.loader import load_raw_prices
 

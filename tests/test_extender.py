@@ -1054,6 +1054,49 @@ def test_graph_filter_fails_open(gnn_fixture):
     assert [e["score"] for e in policy.prioritize(args)] == [50] * 4
 
 
+def test_stats_exposes_shed_fraction(set_params_tree, telemetry):
+    """/stats carries the load-aware backends' off-primary fraction —
+    the same signal /metrics exports — so operators see routing without
+    a Prometheus stack."""
+    from rl_scheduler_tpu.scheduler.set_backend import LoadAwareSetBackend
+
+    policy = ExtenderPolicy(LoadAwareSetBackend(set_params_tree), telemetry)
+    assert policy.statistics()["shed_fraction"] == 0.0
+    # Greedy has no shed_fraction: the key is absent, not zero.
+    assert "shed_fraction" not in ExtenderPolicy(
+        GreedyBackend(), telemetry).statistics()
+
+
+def test_price_replay_period_flag_validation():
+    from rl_scheduler_tpu.scheduler import extender as ext
+
+    with pytest.raises(SystemExit, match="positive"):
+        ext.main(["--price-replay-period", "0"])
+
+
+def test_price_replay_period_reaches_replay(monkeypatch):
+    """--price-replay-period threads through build_policy into the
+    wallclock RawPriceReplay."""
+    from rl_scheduler_tpu.scheduler import extender as ext
+
+    captured = {}
+
+    class StubGraphPolicy:
+        family = "graph"
+        backend = GreedyBackend()
+
+        def __init__(self, backend, telemetry, placer=None,
+                     node_capacity_cores=4.0, price_replay="counter",
+                     price_replay_period_s=300.0):
+            captured["mode"] = price_replay
+            captured["period"] = price_replay_period_s
+
+    monkeypatch.setattr(ext, "ExtenderPolicy", StubGraphPolicy)
+    ext.build_policy(backend="greedy", price_replay="wallclock",
+                     price_replay_period_s=60.0)
+    assert captured == {"mode": "wallclock", "period": 60.0}
+
+
 def test_price_replay_refused_for_non_graph_family(monkeypatch):
     """price_replay='wallclock' on a non-graph policy refuses loudly at
     EVERY entry point — build_policy raises ValueError (embeddings,
@@ -1117,6 +1160,8 @@ def test_raw_price_replay_semantics():
 
     with pytest.raises(ValueError, match="replay mode"):
         RawPriceReplay(prices, mode="bogus")
+    with pytest.raises(ValueError, match="positive"):
+        RawPriceReplay(prices, mode="wallclock", period_s=0.0)
 
 
 def test_build_policy_serves_cluster_graph_checkpoint(tmp_path):
