@@ -34,7 +34,8 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
                         num_heads: int | None = None,
                         fused_gnn: bool = False,
                         fused_set: bool = False,
-                        num_nodes: int | None = None):
+                        num_nodes: int | None = None,
+                        flash_attn: bool = False):
     """``(bundle, net)`` for each BASELINE env family.
 
     ``net=None`` means the default flat-obs ActorCritic; the set/graph envs
@@ -83,6 +84,8 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
         from rl_scheduler_tpu.models import SetTransformerPolicy
 
         kwargs = {} if num_heads is None else {"num_heads": num_heads}
+        if flash_attn:
+            kwargs["attn_impl"] = "flash"
         return cluster_set_bundle(set_params), SetTransformerPolicy(
             dim=64, depth=2, dtype=dtype, **kwargs
         )
@@ -174,6 +177,14 @@ def main(argv: list[str] | None = None) -> Path:
                         "by default (override with --compute-dtype "
                         "float32); ~1.7x honest end-to-end throughput at "
                         "tpu4096")
+    p.add_argument("--flash-attn", action="store_true",
+                   help="cluster_set only: run the set policy's attention "
+                        "through the Pallas TPU flash kernel "
+                        "(ops/flash_attention.py). For node sets >= 1024 "
+                        "where the dense [B, N, N] score tensor is the "
+                        "memory wall — measured ~5x SLOWER below it, so "
+                        "dense stays the default; --num-nodes must be a "
+                        "multiple of 128")
     p.add_argument("--num-nodes", type=int, default=None,
                    help="node-set size for the structured envs "
                         "(cluster_set/cluster_graph; default 8). The "
@@ -301,6 +312,27 @@ def main(argv: list[str] | None = None) -> Path:
                 f"--num-nodes {args.num_nodes}: --env {args.env} needs at "
                 f"least {floor} nodes"
             )
+    if args.flash_attn:
+        if args.env != "cluster_set":
+            raise SystemExit(
+                f"--flash-attn selects the set policy's attention kernel; "
+                f"it has no meaning for --env {args.env}"
+            )
+        if args.fused_set:
+            raise SystemExit(
+                "--flash-attn needs the flax policy's attention seam; "
+                "--fused-set is the batch-minor path (drop one)"
+            )
+        from rl_scheduler_tpu.ops.flash_attention import FLASH_MIN_NODES
+
+        flash_nodes = args.num_nodes if args.num_nodes is not None else 8
+        if flash_nodes % FLASH_MIN_NODES:
+            raise SystemExit(
+                f"--flash-attn: --num-nodes {flash_nodes} must be a "
+                f"multiple of {FLASH_MIN_NODES} (the kernel's block "
+                "size); the dense default is also the measured faster "
+                "choice below the N~1k memory wall"
+            )
     if args.num_heads is not None and args.env != "cluster_set":
         raise SystemExit(
             f"--num-heads configures the set transformer; --env {args.env} "
@@ -396,6 +428,12 @@ def main(argv: list[str] | None = None) -> Path:
                     "sequence parallelism needs the flax policy's ring "
                     "attention (drop one of the flags)"
                 )
+            if args.flash_attn:
+                raise SystemExit(
+                    "--flash-attn is the single-chip flash kernel; ring "
+                    "attention owns the sharded node axis under --sp "
+                    "(drop one of the flags)"
+                )
             sp_nodes = args.num_nodes if args.num_nodes is not None else 8
             if sp_nodes % args.sp:
                 raise SystemExit(
@@ -448,7 +486,8 @@ def main(argv: list[str] | None = None) -> Path:
                                       fault_prob, args.num_heads,
                                       fused_gnn=args.fused_gnn,
                                       fused_set=args.fused_set,
-                                      num_nodes=args.num_nodes)
+                                      num_nodes=args.num_nodes,
+                                      flash_attn=args.flash_attn)
     eval_net = None
     if args.sp > 1:
         # Training net: the bundle's own policy cloned with axis_name="sp"

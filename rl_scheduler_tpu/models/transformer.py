@@ -90,6 +90,11 @@ class SetTransformerPolicy(nn.Module):
     depth: int = 2
     num_heads: int = 1  # see SelfAttentionBlock: multi-head is a 3x slowdown
     axis_name: str | None = None
+    # "flash": single-chip Pallas flash attention (ops/flash_attention.py)
+    # — for N >= 1024 node sets where the dense [B, N, N] score tensor is
+    # the memory wall; measured 5x SLOWER below it, so None (dense) is
+    # the right default through fleet N (docs/scaling.md §3).
+    attn_impl: str | None = None
     dtype: Any = None  # compute dtype for blocks (pointer/value heads stay f32)
 
     @nn.compact
@@ -97,13 +102,29 @@ class SetTransformerPolicy(nn.Module):
         head = PointerActorCriticHead(
             self.dim, pool_axis_name=self.axis_name, name="head"
         )
+        if self.attn_impl not in (None, "flash"):
+            raise ValueError(
+                f"unknown attn_impl {self.attn_impl!r}; use 'flash' or "
+                "None (dense)"
+            )
         attention_fn = None
         if self.axis_name is not None:
+            if self.attn_impl is not None:
+                raise ValueError(
+                    "attn_impl and axis_name cannot combine: ring "
+                    "attention owns the sharded node axis (drop one)"
+                )
             from rl_scheduler_tpu.parallel.ring_attention import (
                 make_flax_attention_fn,
             )
 
             attention_fn = make_flax_attention_fn(self.axis_name)
+        elif self.attn_impl == "flash":
+            from rl_scheduler_tpu.ops.flash_attention import (
+                make_flax_flash_attention_fn,
+            )
+
+            attention_fn = make_flax_flash_attention_fn()
 
         def forward(batched_obs):
             x = nn.Dense(self.dim, dtype=self.dtype,

@@ -1,0 +1,81 @@
+"""Single-chip flash attention for large node sets (Pallas TPU kernel).
+
+The set policy's dense attention materializes the ``[B, N, N]`` score
+tensor, which sets the single-chip memory wall at fleet-giant N
+(docs/scaling.md §3: max minibatch collapses as ``B*N^2 ~ 4 GB``). The
+Pallas TPU flash kernel (``jax.experimental.pallas.ops.tpu``) computes
+exact attention blockwise with an online softmax — the global score
+matrix never materializes — trading arithmetic speed for feasibility:
+
+- **Speed, measured (round 5, chip A/B)**: at N=256 (B=1250, 1 head,
+  head_dim 64) flash runs the fwd+bwd **5.2x slower** than XLA's dense
+  attention (13.1 vs 2.5 ms) — at sizes where the score tensor fits,
+  dense wins outright, consistent with this framework's other
+  hand-kernel negative results. Do NOT use flash below the memory wall.
+- **Memory, measured**: dense attention fails to compile at
+  (B=1024, N=2048) and (B=512, N=8192) on the bench chip; flash runs
+  both (and fails at B=4096, N=2048) — roughly a 2-4x extension of the
+  feasible single-chip minibatch in the N >= 1k regime, the middle
+  ground before sequence parallelism (`--sp`) becomes structural.
+
+Kernel constraints (default block sizes): ``N`` must be a multiple of
+128; bf16/f32 inputs. The wrapper enforces the shape constraint with an
+actionable error at trace time.
+
+Reference parity anchor: the reference has no attention anywhere
+(``rl_scheduler/agent/*.py`` are flat MLPs); this is TPU-native
+capability beyond it, composing with ``SetTransformerPolicy``'s
+``attention_fn`` seam exactly like ring attention does.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+FLASH_MIN_NODES = 128  # default pallas block size; N must divide by it
+
+
+def make_flax_flash_attention_fn():
+    """An ``attention_fn`` for ``nn.MultiHeadDotProductAttention`` that
+    runs the Pallas TPU flash kernel.
+
+    flax hands ``query/key/value`` as ``[batch..., seq, heads, head_dim]``
+    and expects the same layout back; the kernel wants
+    ``[batch, heads, seq, head_dim]``.
+    """
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention,
+    )
+
+    # bias/mask/dropout_rate are DECLARED (not **kwargs): flax only
+    # delivers kwargs whose names appear in the fn's signature, so a
+    # catch-all would silently swallow a future mask instead of refusing.
+    def attention_fn(query, key, value, bias=None, mask=None,
+                     dropout_rate=0.0, **kwargs):
+        if bias is not None or mask is not None or dropout_rate:
+            raise ValueError(
+                "flash attention: bias/mask/dropout are not supported "
+                "(the set policy attends all-to-all with no masking)"
+            )
+        n = query.shape[-3]
+        if n % FLASH_MIN_NODES:
+            raise ValueError(
+                f"flash attention needs the node axis ({n}) to be a "
+                f"multiple of {FLASH_MIN_NODES} (the kernel's block "
+                "size); use the dense default below that"
+            )
+        # [B..., S, H, D] -> [B, H, S, D] (flatten leading batch dims)
+        batch_shape = query.shape[:-3]
+        fold = lambda x: jnp.moveaxis(
+            x.reshape((-1,) + x.shape[-3:]), -2, -3
+        )
+        scale = 1.0 / math.sqrt(query.shape[-1])
+        out = flash_attention(
+            fold(query), fold(key), fold(value), sm_scale=scale
+        )
+        out = jnp.moveaxis(out, -3, -2)
+        return out.reshape(batch_shape + out.shape[-3:])
+
+    return attention_fn

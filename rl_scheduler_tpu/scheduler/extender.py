@@ -828,6 +828,14 @@ def main(argv: list[str] | None = None) -> None:
             f"--price-replay-period {args.price_replay_period}: must be "
             "a positive number of seconds"
         )
+    if args.price_replay != "wallclock" and args.price_replay_period != 300.0:
+        # counter mode never reads the period: refuse the no-op flag
+        # rather than let the operator believe prices advance per-60s.
+        raise SystemExit(
+            f"--price-replay-period {args.price_replay_period} only "
+            "applies to --price-replay wallclock (counter mode advances "
+            "per request)"
+        )
 
     logging.basicConfig(level=logging.INFO)
     try:

@@ -1072,6 +1072,9 @@ def test_price_replay_period_flag_validation():
 
     with pytest.raises(SystemExit, match="positive"):
         ext.main(["--price-replay-period", "0"])
+    # a non-default period with counter mode is a no-op: refuse loudly
+    with pytest.raises(SystemExit, match="wallclock"):
+        ext.main(["--price-replay-period", "60"])
 
 
 def test_price_replay_period_reaches_replay(monkeypatch):

@@ -76,6 +76,75 @@ def test_set_fleet64_preset_trains(tmp_path):
     mgr.close()
 
 
+def test_flash_attn_validation(tmp_path):
+    """--flash-attn guards: cluster_set only, flax policy only, no --sp,
+    N a multiple of the kernel block (128) — each refused with an
+    actionable message BEFORE any device work."""
+    from rl_scheduler_tpu.agent import train_ppo as cli
+
+    with pytest.raises(SystemExit, match="no meaning"):
+        cli.main(["--env", "multi_cloud", "--flash-attn",
+                  "--run-root", str(tmp_path)])
+    with pytest.raises(SystemExit, match="batch-minor"):
+        cli.main(["--env", "cluster_set", "--flash-attn", "--fused-set",
+                  "--run-root", str(tmp_path)])
+    with pytest.raises(SystemExit, match="multiple of 128"):
+        cli.main(["--env", "cluster_set", "--flash-attn",
+                  "--num-nodes", "64", "--run-root", str(tmp_path)])
+    with pytest.raises(SystemExit, match="ring attention"):
+        cli.main(["--env", "cluster_set", "--flash-attn",
+                  "--num-nodes", "256", "--sp", "2", "--dp", "1",
+                  "--run-root", str(tmp_path)])
+
+
+def test_flash_attn_policy_field_validation():
+    """The policy itself refuses bad attn_impl combinations and node
+    counts at trace time (covers programmatic construction, not just
+    the CLI)."""
+    import jax
+    import jax.numpy as jnp
+
+    from rl_scheduler_tpu.models import SetTransformerPolicy
+
+    with pytest.raises(ValueError, match="unknown attn_impl"):
+        SetTransformerPolicy(dim=32, depth=1, attn_impl="fhash").init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 128, 6)))
+    with pytest.raises(ValueError, match="cannot combine"):
+        SetTransformerPolicy(dim=32, depth=1, attn_impl="flash",
+                             axis_name="sp").init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 128, 6)))
+    with pytest.raises(ValueError, match="multiple of 128"):
+        SetTransformerPolicy(dim=32, depth=1, attn_impl="flash").init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 64, 6)))
+
+
+def test_flash_attn_parity_on_tpu():
+    """On a real TPU: the flash policy computes the same function as the
+    dense policy on the same parameter tree (chip-verified at 1.1e-5
+    logits). Platform is checked INSIDE the body — a skipif decorator
+    would initialize the JAX backend at collection time for every
+    pytest invocation touching this file."""
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        pytest.skip("Pallas TPU flash kernel has no CPU lowering")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rl_scheduler_tpu.models import SetTransformerPolicy
+
+    dense_net = SetTransformerPolicy(dim=64, depth=2)
+    flash_net = SetTransformerPolicy(dim=64, depth=2, attn_impl="flash")
+    obs = jax.random.uniform(jax.random.PRNGKey(1), (4, 128, 6))
+    params = dense_net.init(jax.random.PRNGKey(2), obs)
+    l0, v0 = jax.jit(dense_net.apply)(params, obs)
+    l1, v1 = jax.jit(flash_net.apply)(params, obs)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l0),
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v0),
+                               rtol=2e-2, atol=2e-2)
+
+
 def test_num_nodes_rejected_for_flat_envs(tmp_path):
     from rl_scheduler_tpu.agent import train_ppo as cli
 
