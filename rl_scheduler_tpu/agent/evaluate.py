@@ -434,8 +434,12 @@ def main(argv: list[str] | None = None) -> EvalReport | StructuredEvalReport:
             bundle, net = make_bundle_and_net(
                 ckpt_env, PPOTrainConfig(), num_heads=num_heads,
                 # Rebuild the env at the trained node count (fleet
-                # checkpoints; pre-fleet meta lacks the key -> default 8).
+                # checkpoints; pre-fleet meta lacks the key -> default 8)
+                # and keep flash attention for flash-trained runs — at
+                # fleet-giant N the dense [B, N, N] scores cannot
+                # materialize (docs/scaling.md §3).
                 num_nodes=meta.get("num_nodes"),
+                flash_attn=bool(meta.get("flash_attn")),
             )
             if args.quick:
                 print("--quick is the flat-env per-step printout; the "

@@ -664,11 +664,14 @@ def main(argv: list[str] | None = None) -> Path:
                 "num_nodes": (bundle.obs_shape[0]
                               if args.env in ("cluster_set", "cluster_graph")
                               else None),
-                # provenance: the fused paths produce identical
+                # provenance: the fused/flash paths produce identical
                 # checkpoints, but reproductions need to know which path
-                # the run's throughput came from
+                # the run's throughput came from — and evaluation rebuilds
+                # flash-trained fleet-giant checkpoints with flash so the
+                # dense [B, N, N] scores never materialize there
                 "fused_gnn": args.fused_gnn,
                 "fused_set": args.fused_set,
+                "flash_attn": args.flash_attn,
                 # mesh axes: tp changes the param-tree layout (serving
                 # converts it, parallel/tensor_parallel.py); sp only
                 # changes the training-time replication layout

@@ -658,6 +658,7 @@ def build_policy(
     node_capacity_cores: float = DEFAULT_NODE_CAPACITY_CORES,
     price_replay: str = "counter",
     price_replay_period_s: float = 300.0,
+    warm_nodes: tuple | None = None,
 ) -> ExtenderPolicy:
     """Assemble the serving stack: checkpoint -> backend -> telemetry.
 
@@ -702,9 +703,14 @@ def build_policy(
                 )
 
                 logger.info("serving cluster_set checkpoint from %s", run_dir)
+                if warm_nodes is None:
+                    # Default: warm the checkpoint's own training N (fleet
+                    # checkpoints AOT-compile their fleet size up front;
+                    # pre-fleet meta lacks the key -> 8).
+                    warm_nodes = (meta.get("num_nodes") or 8,)
                 backend_obj, _ = make_set_backend(
                     backend, tree, num_heads=meta.get("num_heads") or 1,
-                    device=serve_device,
+                    device=serve_device, warm_counts=tuple(warm_nodes),
                 )
             elif ckpt_env == "cluster_graph":
                 # The GNN's pointer head also scores nodes directly; its
@@ -816,6 +822,13 @@ def main(argv: list[str] | None = None) -> None:
                         "independent trajectories), 'wallclock' derives "
                         "the row from wall time so all replicas and "
                         "restarts agree with zero coordination")
+    p.add_argument("--warm-nodes", default=None,
+                   help="cluster_set + --backend jax only: comma-separated "
+                        "node counts to AOT-compile at startup (default: "
+                        "the checkpoint's own training N). Warm your "
+                        "fleet's actual candidate-list sizes so no first "
+                        "request is served by the overflow forward while "
+                        "a background compile runs")
     p.add_argument("--price-replay-period", type=float, default=300.0,
                    help="wallclock replay only: real-world seconds one "
                         "pricing-table row represents (default 300 — the "
@@ -836,6 +849,20 @@ def main(argv: list[str] | None = None) -> None:
             "applies to --price-replay wallclock (counter mode advances "
             "per request)"
         )
+    warm_nodes = None
+    if args.warm_nodes is not None:
+        try:
+            warm_nodes = tuple(int(n) for n in args.warm_nodes.split(","))
+        except ValueError:
+            raise SystemExit(
+                f"--warm-nodes {args.warm_nodes!r}: pass comma-separated "
+                "integers, e.g. 8,64,100"
+            )
+        if not warm_nodes or any(n < 1 for n in warm_nodes):
+            raise SystemExit(
+                f"--warm-nodes {args.warm_nodes!r}: node counts must be "
+                "positive"
+            )
 
     logging.basicConfig(level=logging.INFO)
     try:
@@ -846,12 +873,26 @@ def main(argv: list[str] | None = None) -> None:
             node_capacity_cores=args.node_capacity_cores,
             price_replay=args.price_replay,
             price_replay_period_s=args.price_replay_period,
+            warm_nodes=warm_nodes,
         )
     except ValueError as e:
         # build_policy refuses misconfigurations (explicitly-named
         # wrong-family checkpoint; --price-replay on a non-graph family)
         # with actionable messages — exit cleanly, not with a traceback.
         raise SystemExit(str(e))
+    if warm_nodes is not None and (
+            policy.family != "set" or policy.backend.name != "jax"):
+        # Refuse the no-op (wrong checkpoint family / non-jax backend)
+        # AND the silently-degraded case (a failed warm compile falls
+        # back to greedy, family "cloud") — the operator asked for
+        # pre-compiled executables and must not boot without them.
+        raise SystemExit(
+            f"--warm-nodes applies to cluster_set checkpoints on "
+            f"--backend jax; the loaded policy serves family "
+            f"{policy.family!r} via backend {policy.backend.name!r} "
+            "(if you passed a set checkpoint with --backend jax, a warm "
+            "AOT compile failed — see the log above)"
+        )
     server = make_server(policy, args.host, args.port)
     print(f"Scheduler extender serving on {args.host}:{args.port} "
           f"(backend={policy.backend.name})", flush=True)

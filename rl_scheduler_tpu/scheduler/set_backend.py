@@ -383,10 +383,12 @@ class LoadAwareSetBackend:
     family = "set"
 
     def __init__(self, params_tree: dict, num_heads: int = 1,
-                 device: str = "cpu", max_concurrent_jax: int = 2):
+                 device: str = "cpu", max_concurrent_jax: int = 2,
+                 warm_counts: tuple = (8,)):
         from rl_scheduler_tpu.scheduler.policy_backend import ShedGate
 
-        self._jax = JaxSetAOTBackend(params_tree, num_heads, device=device)
+        self._jax = JaxSetAOTBackend(params_tree, num_heads, device=device,
+                                     warm_counts=warm_counts)
         if device != "cpu":
             logger.info(
                 "load-aware shedding disabled for serve device %r (the host "
@@ -477,7 +479,7 @@ class LoadAwareSetBackend:
 
 
 def make_set_backend(backend: str, params_tree: dict, num_heads: int = 1,
-                     device: str = "cpu"):
+                     device: str = "cpu", warm_counts: tuple = (8,)):
     """Build a set-family backend for the extender's ``--backend`` flag.
 
     ``jax`` -> load-aware AOT (per-N executable cache, native/numpy
@@ -485,7 +487,11 @@ def make_set_backend(backend: str, params_tree: dict, num_heads: int = 1,
     GIL-free, degrades to numpy when the toolchain/.so is missing);
     ``cpu`` -> numpy; ``torch`` -> the torch CPU mirror (degrades to
     numpy if torch is unavailable). ``greedy`` is handled by the caller.
-    Returns ``(backend_obj, fallback_used: bool)`` like ``make_backend``.
+    ``warm_counts`` pre-compiles the jax flag's AOT executables for
+    those node counts at startup (``--warm-nodes``; fleet deployments
+    warm their actual N so the first request is never answered by the
+    overflow forward while a background compile runs). Returns
+    ``(backend_obj, fallback_used: bool)`` like ``make_backend``.
     """
     if backend == "torch":
         try:
@@ -501,7 +507,8 @@ def make_set_backend(backend: str, params_tree: dict, num_heads: int = 1,
             backend = "cpu"
     try:
         if backend == "jax":
-            return LoadAwareSetBackend(params_tree, num_heads, device=device), False
+            return LoadAwareSetBackend(params_tree, num_heads, device=device,
+                                       warm_counts=warm_counts), False
         return NumpySetBackend(params_tree, num_heads), False
     except Exception:
         from rl_scheduler_tpu.scheduler.policy_backend import GreedyBackend

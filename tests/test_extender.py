@@ -802,6 +802,15 @@ def test_build_policy_serves_cluster_set_checkpoint(tmp_path):
     out = policy.prioritize(_set_request(num_nodes=5))
     assert len(out) == 5 and max(e["score"] for e in out) == 100
 
+    # jax flag: the AOT warm list defaults to the checkpoint's own
+    # training N (this run trained at the default 8), and --warm-nodes
+    # overrides it (round 5: fleet checkpoints warm their fleet size).
+    policy = build_policy(backend="jax", run=str(run_dir))
+    assert set(policy.backend._jax._compiled) == {8}
+    policy = build_policy(backend="jax", run=str(run_dir),
+                          warm_nodes=(5, 12))
+    assert set(policy.backend._jax._compiled) == {5, 12}
+
 
 def test_http_set_roundtrip(set_params_tree):
     """Full HTTP round-trip with a set backend: filter keeps one node,
@@ -1065,6 +1074,25 @@ def test_stats_exposes_shed_fraction(set_params_tree, telemetry):
     # Greedy has no shed_fraction: the key is absent, not zero.
     assert "shed_fraction" not in ExtenderPolicy(
         GreedyBackend(), telemetry).statistics()
+
+
+def test_warm_nodes_flag_validation(monkeypatch):
+    from rl_scheduler_tpu.scheduler import extender as ext
+
+    with pytest.raises(SystemExit, match="comma-separated"):
+        ext.main(["--warm-nodes", "8,x"])
+    with pytest.raises(SystemExit, match="positive"):
+        ext.main(["--warm-nodes", "0"])
+
+    # No-op refusal: a non-set family (or a warm-compile failure that
+    # degraded to greedy) must not boot as if the fleet sizes were warm.
+    class StubGraphPolicy:
+        family = "graph"
+        backend = GreedyBackend()
+
+    monkeypatch.setattr(ext, "build_policy", lambda *a, **k: StubGraphPolicy())
+    with pytest.raises(SystemExit, match="warm-nodes applies"):
+        ext.main(["--warm-nodes", "64", "--port", "0"])
 
 
 def test_price_replay_period_flag_validation():

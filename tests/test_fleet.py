@@ -97,6 +97,17 @@ def test_flash_attn_validation(tmp_path):
                   "--run-root", str(tmp_path)])
 
 
+def test_flash_attn_wires_through_bundle_and_meta():
+    """make_bundle_and_net(flash_attn=True) builds the flash policy (the
+    path evaluate.py takes for flash-trained checkpoint meta)."""
+    from rl_scheduler_tpu.agent.ppo import PPOTrainConfig
+    from rl_scheduler_tpu.agent.train_ppo import make_bundle_and_net
+
+    _, net = make_bundle_and_net("cluster_set", PPOTrainConfig(),
+                                 num_nodes=128, flash_attn=True)
+    assert net.attn_impl == "flash"
+
+
 def test_flash_attn_policy_field_validation():
     """The policy itself refuses bad attn_impl combinations and node
     counts at trace time (covers programmatic construction, not just
