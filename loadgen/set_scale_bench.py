@@ -47,10 +47,14 @@ def build_update(nodes: int, envs: int, minibatch: int, epochs: int,
     from rl_scheduler_tpu.env import cluster_set as cs
     from rl_scheduler_tpu.env.bundle import cluster_set_bundle
 
+    # NOTE: every variant below passes an explicit net, so
+    # cfg.compute_dtype is inert (it only shapes the default ActorCritic
+    # — agent/ppo.py:191-206); the net's own dtype field carries the
+    # precision. Kept in sync anyway so the printed config is honest.
     cfg = PPOTrainConfig(
         num_envs=envs, rollout_steps=rollout_steps,
         minibatch_size=minibatch, num_epochs=epochs, lr=1e-3, gamma=0.99,
-        compute_dtype="bfloat16" if variant.endswith("bf16") else "float32",
+        compute_dtype="float32" if variant == "flax_f32" else "bfloat16",
     )
     bundle = cluster_set_bundle(cs.make_params(num_nodes=nodes))
     fused_impls = {"fused": None, "fused_chunked": "chunked",
@@ -62,12 +66,13 @@ def build_update(nodes: int, envs: int, minibatch: int, epochs: int,
         # "fused_chunked" / "fused_matmul" pin one (A/B the threshold).
         net = BatchMinorSetPolicy(dim=64, depth=2, dtype=jnp.bfloat16,
                                   attn_impl=fused_impls[variant])
-    elif variant in ("flax_f32", "flax_bf16"):
+    elif variant in ("flax_f32", "flax_bf16", "flax_bf16_h4"):
         from rl_scheduler_tpu.models import SetTransformerPolicy
 
         net = SetTransformerPolicy(
             dim=64, depth=2,
-            dtype=jnp.bfloat16 if variant == "flax_bf16" else None,
+            num_heads=4 if variant.endswith("_h4") else 1,
+            dtype=None if variant == "flax_f32" else jnp.bfloat16,
         )
     else:
         raise SystemExit(f"unknown variant {variant!r}")

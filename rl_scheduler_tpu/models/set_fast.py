@@ -111,11 +111,6 @@ def _block(pb: dict, pb_f32: dict, h: jnp.ndarray, dim: int,
     num_nodes = h.shape[0]
     if attn_impl is None:
         attn_impl = "chunked" if num_nodes <= CHUNKED_ATTN_MAX_N else "matmul"
-    if attn_impl not in ("chunked", "matmul"):
-        # A typo must not silently run the chunk loop (the fleet-N
-        # pathology: 709 vs 420 ms/update at N=64).
-        raise ValueError(f"unknown attn_impl {attn_impl!r}; "
-                         "use 'chunked', 'matmul', or None (auto)")
     if attn_impl == "matmul":
         # Batched-matmul scores over the batch lanes: [N,N,B] materializes,
         # but each matmul is [N,dim]x[dim,N] per lane — MXU-shaped at
@@ -161,6 +156,12 @@ def batch_minor_forward(
     selects the attention formulation (see :func:`_block`; default auto
     by node count).
     """
+    if attn_impl not in (None, "chunked", "matmul"):
+        # Validate once at the entry point: a typo must not silently run
+        # the chunk loop (the fleet-N pathology: 709 vs 420 ms/update
+        # at N=64).
+        raise ValueError(f"unknown attn_impl {attn_impl!r}; "
+                         "use 'chunked', 'matmul', or None (auto)")
     p = params["params"]
     x = obs.astype(jnp.float32).transpose(1, 2, 0)      # [N, F, B]
     pc = p

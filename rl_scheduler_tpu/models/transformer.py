@@ -36,13 +36,13 @@ class SelfAttentionBlock(nn.Module):
     sequence-parallel path injects ring attention here; ``None`` keeps
     flax's dense ``dot_product_attention``.
 
-    ``num_heads`` defaults to 1: for the small node sets this policy
-    targets, multi-head adds no measurable quality at dim 64 but its
-    head-split tensors dominate the fused PPO update on TPU — a profile
-    at 4096 envs x 8 nodes measured the 4-head variant 3x slower end to
-    end (162k vs 495k env-steps/s) purely from [B, H, N, N]-shaped
-    elementwise/layout traffic. Raise it for large sets where per-head
-    subspaces earn their cost.
+    ``num_heads`` defaults to 1: multi-head adds no measurable quality
+    at dim 64 but its head-split tensors tax the fused PPO update on
+    TPU — measured 3x slower end to end at 4096 envs x 8 nodes (162k vs
+    495k env-steps/s) and still 1.7x slower at fleet N=64 (147k vs 252k,
+    round-5 same-process A/B: head_dim-16 tensors stay layout-hostile
+    even when the node axis fills the tiles). Raising it only makes
+    sense with a wider dim where per-head subspaces earn their cost.
     """
 
     dim: int
