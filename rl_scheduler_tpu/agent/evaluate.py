@@ -350,6 +350,22 @@ def run_bundle_episodes(bundle, policy_fn, num_episodes: int, seed: int = 0):
     return _run(jax.random.PRNGKey(seed))
 
 
+def best_node_baseline_reward(env_name: str, bundle,
+                              num_episodes: int = 64,
+                              seed: int = 0) -> float:
+    """Mean episode reward of the BEST hand-coded node baseline on this
+    bundle — the stall-guard threshold for ``train_ppo
+    --reseed-on-stall``: a healthy seed's in-training greedy eval crosses
+    this within ~16 iterations at fleet N, a fragile seed never does
+    (measured, docs/scaling.md §1b)."""
+    from rl_scheduler_tpu.env.baselines import structured_baselines
+
+    return max(
+        float(run_bundle_episodes(bundle, fn, num_episodes, seed)[0].mean())
+        for fn in structured_baselines(env_name).values()
+    )
+
+
 def structured_evaluate(env_name: str, bundle, net, params,
                         num_episodes: int = 100,
                         seed: int = 0) -> StructuredEvalReport:

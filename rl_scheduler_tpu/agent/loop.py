@@ -173,6 +173,12 @@ class TensorBoardLogger:
         # sink's durability (SummaryWriter otherwise buffers ~120 s).
         self._writer.flush()
 
+    def add_text(self, tag: str, text: str, step: int = 0) -> None:
+        """Event-style marker (e.g. a reseed boundary) so the scalar
+        streams' repeated step numbers are attributable in the TB UI."""
+        self._writer.add_text(tag, text, step)
+        self._writer.flush()
+
     def close(self) -> None:
         self._writer.close()
 

@@ -29,6 +29,51 @@ from rl_scheduler_tpu.env import core as env_core
 ENVS = ("multi_cloud", "single_cluster", "cluster_set", "cluster_graph")
 
 
+class EvalStall(RuntimeError):
+    """Raised by the --reseed-on-stall guard when the in-training greedy
+    eval has not crossed the node-baseline threshold by the deadline —
+    the measured signature of a fragile seed (docs/scaling.md §1b)."""
+
+    def __init__(self, iteration: int, best_eval: float, threshold: float):
+        self.iteration = iteration
+        self.best_eval = best_eval
+        self.threshold = threshold
+        super().__init__(
+            f"in-training eval {best_eval:.1f} has not crossed the "
+            f"node-baseline threshold {threshold:.1f} by iteration "
+            f"{iteration}"
+        )
+
+
+def make_stall_guard(eval_log_fn, decision_iter: int, threshold: float,
+                     raise_on_stall: bool = True):
+    """Wrap an eval-log sink with the bad-seed detector: track the best
+    in-training eval through ``decision_iter``; if it never crosses
+    ``threshold``, raise :class:`EvalStall` at the decision point (or
+    just warn when the reseed budget is spent)."""
+    best = float("-inf")
+
+    def guarded(i: int, metrics: dict) -> None:
+        nonlocal best
+        eval_log_fn(i, metrics)
+        iteration = i + 1
+        if iteration > decision_iter:
+            return
+        best = max(best, metrics["eval_episode_reward_mean"])
+        if iteration == decision_iter and best < threshold:
+            if raise_on_stall:
+                raise EvalStall(iteration, best, threshold)
+            print(
+                f"  WARNING: eval {best:.1f} below the node-baseline "
+                f"threshold {threshold:.1f} at iteration {iteration} and "
+                "the reseed budget is spent — this seed's greedy eval is "
+                "likely to stay below baseline (docs/scaling.md §1b)",
+                flush=True,
+            )
+
+    return guarded
+
+
 def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
                         fault_prob: float | None = None,
                         num_heads: int | None = None,
@@ -125,6 +170,18 @@ def main(argv: list[str] | None = None) -> Path:
                         "their env (and fast-path policy)")
     p.add_argument("--iterations", type=int, default=5)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--reseed-on-stall", type=int, default=0, metavar="N",
+                   help="structured envs: if the in-training greedy eval "
+                        "has not crossed the best hand-coded node "
+                        "baseline by --stall-deadline, abandon the "
+                        "attempt and restart with the next seed (up to N "
+                        "times). Automates the measured bad-seed "
+                        "detection recipe of docs/scaling.md §1b; "
+                        "requires --eval-every")
+    p.add_argument("--stall-deadline", type=int, default=16, metavar="ITER",
+                   help="iteration by which the in-training eval must "
+                        "beat the node-baseline threshold (default 16 — "
+                        "the measured separation point at fleet N)")
     p.add_argument("--run-name", default=None)
     p.add_argument("--run-root", default=RuntimeConfig().checkpoint_dir)
     p.add_argument("--checkpoint-every", type=int, default=None,
@@ -482,6 +539,44 @@ def main(argv: list[str] | None = None) -> Path:
                 f"minibatch_size={cfg.minibatch_size} must both divide by "
                 "the device count"
             )
+    if args.reseed_on_stall < 0:
+        raise SystemExit(
+            f"--reseed-on-stall {args.reseed_on_stall}: pass a maximum "
+            "reseed count >= 1 (0 disables the guard)"
+        )
+    if args.reseed_on_stall:
+        # The guard compares the in-training greedy eval against the
+        # hand-coded NODE baselines, which only the structured envs have;
+        # the flat families have no measured seed fragility to guard.
+        if args.env not in ("cluster_set", "cluster_graph"):
+            raise SystemExit(
+                f"--reseed-on-stall guards the structured envs' measured "
+                f"greedy-eval seed fragility (docs/scaling.md §1b); --env "
+                f"{args.env} has no node baselines to threshold against"
+            )
+        if cfg.eval_every <= 0:
+            raise SystemExit(
+                "--reseed-on-stall needs the in-training eval signal: "
+                "pass --eval-every (e.g. 8 — the measured recipe)"
+            )
+        if cfg.eval_every > args.stall_deadline:
+            raise SystemExit(
+                f"--reseed-on-stall: --eval-every {cfg.eval_every} fires "
+                f"no eval at or before --stall-deadline "
+                f"{args.stall_deadline}; the guard could never trigger"
+            )
+        if args.stall_deadline >= args.iterations:
+            raise SystemExit(
+                f"--stall-deadline {args.stall_deadline} >= --iterations "
+                f"{args.iterations}: the guard would fire at or after the "
+                "end of training (raise --iterations or lower the "
+                "deadline)"
+            )
+        if args.resume:
+            raise SystemExit(
+                "--reseed-on-stall restarts training from scratch on a "
+                "stalled eval; that contradicts --resume (drop one)"
+            )
     bundle, net = make_bundle_and_net(args.env, cfg, args.legacy_reward_sign,
                                       fault_prob, args.num_heads,
                                       fused_gnn=args.fused_gnn,
@@ -513,6 +608,7 @@ def main(argv: list[str] | None = None) -> Path:
     ckpt = CheckpointManager(run_dir, keep=args.keep)
 
     restore = None
+    restored_seed = None
     if args.resume:
         latest = ckpt.latest_step()
         if latest is None:
@@ -529,6 +625,13 @@ def main(argv: list[str] | None = None) -> Path:
         # state restore — a hidden-size mismatch would otherwise surface
         # as a raw Orbax structure error.
         meta = ckpt.restore_meta(latest)
+        # The seed that INITIALIZED the weights: carried forward into the
+        # resumed run's checkpoint meta so attribution survives a resume
+        # under a different --seed (which only changes the continuation's
+        # RNG stream, not the weights' provenance). Pre-seed-key
+        # checkpoints resume with an explicit None — unknown provenance
+        # must not be misattributed to this invocation's --seed.
+        restored_seed = meta.get("seed", "unknown")
         ckpt_env = meta.get("env")
         if ckpt_env is not None and ckpt_env != args.env:
             raise SystemExit(
@@ -648,10 +751,7 @@ def main(argv: list[str] | None = None) -> Path:
     tb = TensorBoardLogger(run_dir) if args.tensorboard else None
     log_fn = make_jsonl_log_fn(metrics_file, cfg.batch_size,
                                start_iteration, print_line, tb=tb)
-    checkpoint_fn = make_periodic_checkpoint_fn(
-        ckpt, args.checkpoint_every, args.iterations,
-        lambda runner: {"params": runner.params, "opt_state": runner.opt_state},
-        extras={"preset": args.preset,
+    checkpoint_extras = {"preset": args.preset,
                 "env": args.env,
                 # hidden describes the default MLP only; the set/graph
                 # policies own their dimensions.
@@ -677,7 +777,24 @@ def main(argv: list[str] | None = None) -> Path:
                 # changes the training-time replication layout
                 "tp": args.tp,
                 "sp": args.sp,
-                "legacy_reward_sign": args.legacy_reward_sign})
+                "legacy_reward_sign": args.legacy_reward_sign}
+
+    def make_checkpoint_fn(attempt_seed: int):
+        # The seed lands in checkpoint meta so reproductions (and the
+        # reseed-on-stall guard's final attempt) are attributable to the
+        # exact seed that INITIALIZED the weights — on resume the
+        # original run's seed is carried forward, not this invocation's
+        # (an explicit null for pre-seed-key checkpoints: unknown
+        # provenance, not this invocation's --seed).
+        if restored_seed is not None:
+            attempt_seed = (None if restored_seed == "unknown"
+                            else restored_seed)
+        return make_periodic_checkpoint_fn(
+            ckpt, args.checkpoint_every, args.iterations,
+            lambda runner: {"params": runner.params,
+                            "opt_state": runner.opt_state},
+            extras={**checkpoint_extras, "seed": attempt_seed},
+        )
 
     mesh = None
     if args.dp != 1 or args.sp > 1 or args.tp > 1:
@@ -693,6 +810,19 @@ def main(argv: list[str] | None = None) -> Path:
         print(f"Mesh {desc} ({cfg.num_envs} global envs -> "
               f"{cfg.num_envs // mesh.shape['dp']}/dp-member)")
 
+    stall_threshold = decision_iter = None
+    if args.reseed_on_stall:
+        from rl_scheduler_tpu.agent.evaluate import best_node_baseline_reward
+
+        stall_threshold = best_node_baseline_reward(
+            args.env, bundle, cfg.eval_episodes, seed=args.seed)
+        # Last eval firing at or before the deadline (eval_every divides
+        # it into the schedule; validated > 0 above).
+        decision_iter = (args.stall_deadline // cfg.eval_every) * cfg.eval_every
+        print(f"Stall guard: in-training eval must beat the best node "
+              f"baseline ({stall_threshold:.1f}) by iteration "
+              f"{decision_iter}; up to {args.reseed_on_stall} reseed(s)")
+
     print(f"Training PPO preset={args.preset} env={args.env} on "
           f"{jax.devices()[0].platform} "
           f"({cfg.num_envs} envs x {cfg.rollout_steps} steps/iter)")
@@ -705,12 +835,54 @@ def main(argv: list[str] | None = None) -> Path:
 
         ctx = contextlib.nullcontext()
     with ctx:
-        ppo_train(bundle, cfg, args.iterations, seed=args.seed, net=net,
-                  log_fn=log_fn, checkpoint_fn=checkpoint_fn, restore=restore,
-                  debug_checks=args.debug_checks, sync_every=args.sync_every,
-                  eval_log_fn=make_eval_log_fn(metrics_file, tb),
-                  updates_per_dispatch=args.updates_per_dispatch,
-                  mesh=mesh, eval_net=eval_net)
+        attempt = 0
+        while True:
+            attempt_seed = args.seed + attempt
+            eval_log = make_eval_log_fn(metrics_file, tb)
+            if stall_threshold is not None:
+                eval_log = make_stall_guard(
+                    eval_log, decision_iter, stall_threshold,
+                    raise_on_stall=attempt < args.reseed_on_stall)
+            try:
+                ppo_train(bundle, cfg, args.iterations, seed=attempt_seed,
+                          net=net, log_fn=log_fn,
+                          checkpoint_fn=make_checkpoint_fn(attempt_seed),
+                          restore=restore, debug_checks=args.debug_checks,
+                          sync_every=args.sync_every, eval_log_fn=eval_log,
+                          updates_per_dispatch=args.updates_per_dispatch,
+                          mesh=mesh, eval_net=eval_net)
+                break
+            except EvalStall as stall:
+                attempt += 1
+                print(f"Reseed {attempt}/{args.reseed_on_stall}: {stall} — "
+                      f"restarting with seed {args.seed + attempt} "
+                      "(fragile-seed signature, docs/scaling.md §1b)",
+                      flush=True)
+                # Marker line in the metrics log (same convention as the
+                # resume marker): downstream analysis can split the
+                # abandoned attempt's duplicate iteration numbers.
+                metrics_file.write(json.dumps({
+                    "reseed": attempt, "from_seed": attempt_seed,
+                    "to_seed": args.seed + attempt,
+                    "stall_iteration": stall.iteration,
+                    "best_eval": stall.best_eval,
+                    "threshold": stall.threshold}) + "\n")
+                metrics_file.flush()
+                if tb is not None:
+                    # The replacement attempt re-writes the same step
+                    # numbers; this marker is what makes the zig-zag
+                    # attributable in the TB UI.
+                    tb.add_text(
+                        "reseed",
+                        f"attempt {attempt}: seed {attempt_seed} -> "
+                        f"{args.seed + attempt} (eval {stall.best_eval:.1f}"
+                        f" < threshold {stall.threshold:.1f} at iteration "
+                        f"{stall.iteration})",
+                        step=attempt)
+                # The abandoned attempt's checkpoints must not shadow its
+                # replacement (same step numbers — Orbax would refuse the
+                # overwrite and the evaluator would read stale weights).
+                ckpt.clear()
     metrics_file.close()
     if tb is not None:
         tb.close()

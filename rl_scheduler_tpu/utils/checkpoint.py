@@ -67,6 +67,14 @@ class CheckpointManager:
         )
         return out["state"], dict(out["meta"] or {})
 
+    def clear(self) -> None:
+        """Delete every checkpoint step in this run (used when an
+        abandoned training attempt's checkpoints must not shadow its
+        replacement — e.g. ``train_ppo --reseed-on-stall``)."""
+        for step in list(self._mgr.all_steps()):
+            self._mgr.delete(step)
+        self._mgr.wait_until_finished()
+
     def close(self) -> None:
         self._mgr.close()
 

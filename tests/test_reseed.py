@@ -1,0 +1,193 @@
+"""The --reseed-on-stall bad-seed guard (docs/scaling.md §1b).
+
+At fleet N the structured policies' greedy eval is seed-fragile: a bad
+seed's in-training eval never crosses the node-baseline threshold while
+its stochastic training reward looks healthy. The guard automates the
+measured detection recipe — eval by iteration ~16, reseed if below the
+best hand-coded node baseline. These tests pin the CLI contract and the
+restart mechanics on tiny CPU configs (the threshold is monkeypatched;
+the measured thresholds themselves live in the docs).
+"""
+
+import json
+
+import pytest
+
+from rl_scheduler_tpu.agent import train_ppo as cli
+
+
+TINY = [
+    "--env", "cluster_set", "--num-nodes", "4", "--num-envs", "4",
+    "--rollout-steps", "8", "--minibatch-size", "16", "--num-epochs", "1",
+]
+
+
+def _run(tmp_path, name, extra, monkeypatch=None, threshold=None):
+    if monkeypatch is not None:
+        monkeypatch.setattr(
+            "rl_scheduler_tpu.agent.evaluate.best_node_baseline_reward",
+            lambda *a, **k: threshold,
+        )
+    return cli.main(TINY + ["--run-root", str(tmp_path),
+                            "--run-name", name] + extra)
+
+
+def _metrics_lines(tmp_path, name):
+    path = tmp_path / name / "metrics.jsonl"
+    return [json.loads(l) for l in path.read_text().splitlines()]
+
+
+class TestValidation:
+    def test_flat_env_refused(self, tmp_path):
+        with pytest.raises(SystemExit, match="node baselines"):
+            cli.main(["--env", "multi_cloud", "--reseed-on-stall", "1",
+                      "--eval-every", "1", "--run-root", str(tmp_path)])
+
+    def test_needs_eval_signal(self, tmp_path):
+        with pytest.raises(SystemExit, match="--eval-every"):
+            _run(tmp_path, "x", ["--reseed-on-stall", "1",
+                                 "--iterations", "30"])
+
+    def test_eval_after_deadline_refused(self, tmp_path):
+        with pytest.raises(SystemExit, match="never trigger"):
+            _run(tmp_path, "x", ["--reseed-on-stall", "1",
+                                 "--eval-every", "20",
+                                 "--stall-deadline", "16",
+                                 "--iterations", "30"])
+
+    def test_deadline_past_end_refused(self, tmp_path):
+        with pytest.raises(SystemExit, match="end of training"):
+            _run(tmp_path, "x", ["--reseed-on-stall", "1",
+                                 "--eval-every", "1",
+                                 "--stall-deadline", "16",
+                                 "--iterations", "10"])
+
+    def test_negative_count_refused(self, tmp_path):
+        with pytest.raises(SystemExit, match="reseed count"):
+            _run(tmp_path, "x", ["--reseed-on-stall", "-1"])
+
+    def test_resume_contradiction_refused(self, tmp_path):
+        with pytest.raises(SystemExit, match="--resume"):
+            _run(tmp_path, "x", ["--reseed-on-stall", "1",
+                                 "--eval-every", "1",
+                                 "--stall-deadline", "1",
+                                 "--iterations", "3", "--resume"])
+
+
+class TestReseedMechanics:
+    def test_stall_reseeds_then_finishes(self, tmp_path, monkeypatch):
+        """An unreachable threshold exhausts the reseed budget: each
+        abandoned attempt leaves a marker line + cleared checkpoints,
+        and the FINAL attempt still runs to completion (warn, don't
+        abort: the run must always produce a usable checkpoint)."""
+        _run(tmp_path, "stall", ["--reseed-on-stall", "2",
+                                 "--eval-every", "1",
+                                 "--stall-deadline", "1",
+                                 "--iterations", "3",
+                                 "--checkpoint-every", "1",
+                                 "--seed", "7"],
+             monkeypatch=monkeypatch, threshold=float("inf"))
+        markers = [l for l in _metrics_lines(tmp_path, "stall")
+                   if "reseed" in l]
+        assert [m["reseed"] for m in markers] == [1, 2]
+        assert markers[0]["from_seed"] == 7
+        assert markers[1]["to_seed"] == 9
+        assert all(m["threshold"] == float("inf") for m in markers)
+
+        from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(tmp_path / "stall")
+        # Only the final attempt's checkpoints survive; its meta carries
+        # the seed that actually trained the surviving weights.
+        assert mgr.latest_step() == 3
+        assert mgr.restore_meta(3)["seed"] == 9
+        mgr.close()
+
+    def test_healthy_run_never_reseeds(self, tmp_path, monkeypatch):
+        """A crossable threshold (-inf) leaves the run untouched: no
+        marker lines, original seed in meta."""
+        _run(tmp_path, "ok", ["--reseed-on-stall", "2",
+                              "--eval-every", "1",
+                              "--stall-deadline", "1",
+                              "--iterations", "2",
+                              "--checkpoint-every", "1",
+                              "--seed", "5"],
+             monkeypatch=monkeypatch, threshold=float("-inf"))
+        assert not [l for l in _metrics_lines(tmp_path, "ok")
+                    if "reseed" in l]
+
+        from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(tmp_path / "ok")
+        assert mgr.restore_meta(mgr.latest_step())["seed"] == 5
+        mgr.close()
+
+    def test_resume_preserves_init_seed(self, tmp_path):
+        """--resume under a different --seed must not overwrite the meta
+        seed: the recorded seed attributes the weights' INITIALIZATION,
+        not the latest invocation's RNG stream."""
+        _run(tmp_path, "res", ["--iterations", "1",
+                               "--checkpoint-every", "1", "--seed", "7"])
+        _run(tmp_path, "res", ["--iterations", "2",
+                               "--checkpoint-every", "1", "--resume"])
+        from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(tmp_path / "res")
+        assert mgr.restore_meta(2)["seed"] == 7
+        mgr.close()
+
+    def test_resume_legacy_checkpoint_records_null_seed(self, tmp_path):
+        """Resuming a pre-seed-key checkpoint must record an explicit
+        null, not misattribute the weights to this invocation's --seed."""
+        _run(tmp_path, "leg", ["--iterations", "1",
+                               "--checkpoint-every", "1", "--seed", "7"])
+        # Strip the seed key in place: the on-disk shape of a checkpoint
+        # written before the key existed.
+        meta_file = (tmp_path / "leg" / "checkpoints" / "1" / "meta"
+                     / "metadata")
+        meta = json.loads(meta_file.read_text())
+        del meta["seed"]
+        meta_file.write_text(json.dumps(meta))
+
+        _run(tmp_path, "leg", ["--iterations", "2",
+                               "--checkpoint-every", "1", "--resume"])
+        from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(tmp_path / "leg")
+        meta2 = mgr.restore_meta(2)
+        assert "seed" in meta2 and meta2["seed"] is None
+        mgr.close()
+
+    def test_guard_off_by_default(self, tmp_path):
+        """Without the flag nothing changes: no threshold computation,
+        no seed key surprises for old meta consumers (seed is recorded
+        regardless — additive, never breaking)."""
+        _run(tmp_path, "plain", ["--iterations", "1",
+                                 "--checkpoint-every", "1"])
+        from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(tmp_path / "plain")
+        assert mgr.restore_meta(1)["seed"] == 0
+        mgr.close()
+
+
+def test_best_node_baseline_reward_is_best():
+    """The threshold helper returns the max over the three node
+    baselines (the value the guard compares evals against)."""
+    from rl_scheduler_tpu.agent.evaluate import (
+        best_node_baseline_reward,
+        run_bundle_episodes,
+    )
+    from rl_scheduler_tpu.agent.train_ppo import make_bundle_and_net
+    from rl_scheduler_tpu.agent.ppo import PPOTrainConfig
+    from rl_scheduler_tpu.env.baselines import structured_baselines
+
+    bundle, _ = make_bundle_and_net("cluster_set", PPOTrainConfig(),
+                                    num_nodes=4)
+    best = best_node_baseline_reward("cluster_set", bundle,
+                                     num_episodes=8, seed=3)
+    singles = [
+        float(run_bundle_episodes(bundle, fn, 8, 3)[0].mean())
+        for fn in structured_baselines("cluster_set").values()
+    ]
+    assert best == pytest.approx(max(singles))
