@@ -617,6 +617,11 @@ def test_load_aware_set_routes_large_n_under_concurrency(set_params_tree):
     b._jax.decide_nodes = lambda o: (calls.append("jax"), real_jax(o))[1]
     b._overflow_numpy.decide_nodes = (
         lambda o: (calls.append("numpy"), real_np(o))[1])
+    # Pre-seed the adaptive-latency EWMAs so this test isolates the
+    # concurrency routing (the one-time host seed per N is covered by
+    # test_load_aware_set_adaptive_demotion).
+    b._lat["host"][40] = (0.5, 1)
+    b._lat["host"][8] = (0.5, 1)
     rng = np.random.default_rng(4)
     big = rng.uniform(0, 1, (40, 6)).astype(np.float32)
 
@@ -655,6 +660,104 @@ def test_load_aware_set_routes_large_n_under_concurrency(set_params_tree):
         with b._active_lock:
             b._active -= 1
     assert calls == ["jax"]
+
+
+def test_load_aware_set_routes_fleet_giant_n_to_torch(set_params_tree):
+    """The host path routes by node count at the measured three-way
+    crossover: native C++ to N=20, numpy through the mid range, torch's
+    fused CPU kernels from TORCH_OVERFLOW_MIN_N up (3.6x numpy at
+    N >= 1024)."""
+    from rl_scheduler_tpu.scheduler.set_backend import LoadAwareSetBackend
+
+    b = LoadAwareSetBackend(set_params_tree)
+    mid = b._overflow_for(100)
+    giant = b._overflow_for(LoadAwareSetBackend.TORCH_OVERFLOW_MIN_N)
+    assert mid is b._overflow_numpy
+    if b._overflow_torch is not None:
+        assert giant is b._overflow_torch
+    if b._overflow_native is not None:
+        assert b._overflow_for(8) is b._overflow_native
+
+    # Decisions agree across the three host paths (same function).
+    rng = np.random.default_rng(11)
+    obs = rng.uniform(0, 1, (256, 6)).astype(np.float32)
+    actions = {b._overflow_numpy.decide_nodes(obs)[0]}
+    if b._overflow_torch is not None:
+        actions.add(b._overflow_torch.decide_nodes(obs)[0])
+    assert len(actions) == 1
+
+
+def test_load_aware_set_adaptive_demotion(set_params_tree):
+    """Latency-aware routing: once the AOT dispatch measures
+    ADAPTIVE_MARGIN x worse than the host path at a node count (a
+    degraded tunnel/pool), single-stream traffic at that N serves
+    host-side, with 1-in-ADAPTIVE_PROBE_EVERY recovery probes that
+    promote AOT back when it recovers."""
+    import time as _time
+
+    from rl_scheduler_tpu.scheduler.set_backend import LoadAwareSetBackend
+
+    # N=40 must be warm: timings only attribute to the AOT path when the
+    # executable actually serves (the compiling-window numpy fallback
+    # must not read as tunnel degradation).
+    b = LoadAwareSetBackend(set_params_tree, warm_counts=(40,))
+    calls = []
+    real_jax = b._jax.decide_nodes
+    slow = [True]
+
+    def jax_decide(o):
+        calls.append("jax")
+        if slow[0]:
+            _time.sleep(0.01)           # a degraded 10 ms dispatch
+        return real_jax(o)
+
+    b._jax.decide_nodes = jax_decide
+    rng = np.random.default_rng(5)
+    obs = rng.uniform(0, 1, (40, 6)).astype(np.float32)
+
+    # First request seeds the host EWMA (one extra host forward, once).
+    b.decide_nodes(obs)
+    assert b._lat["host"].get(40) is not None
+
+    # Degraded phase: AOT keeps serving until it has MIN_SAMPLES, then
+    # the EWMA comparison demotes it.
+    for _ in range(LoadAwareSetBackend.ADAPTIVE_MIN_SAMPLES + 2):
+        b.decide_nodes(obs)
+    calls.clear()
+    b.decide_nodes(obs)
+    assert calls == []                  # served host-side, AOT demoted
+    assert b.shed_fraction > 0.0        # demotion counts as shed traffic
+
+    # Recovery: force the next probe, serve fast, and let the EWMA pull
+    # the AOT estimate back under the margin.
+    slow[0] = False
+    promoted = False
+    for _ in range(40 * LoadAwareSetBackend.ADAPTIVE_PROBE_EVERY):
+        calls.clear()
+        b.decide_nodes(obs)
+        if (calls == ["jax"]
+                and b._aot_route(40) == (True, False)
+                and b._aot_route(40) == (True, False)):
+            promoted = True
+            break
+    assert promoted, "recovered AOT path was never promoted back"
+
+
+def test_adaptive_ignores_compiling_fallback(set_params_tree):
+    """While an uncached N compiles in the background, decisions are
+    served by the numpy fallback — those timings must NOT feed the AOT
+    latency EWMA (they would false-demote a healthy AOT path at exactly
+    the Ns that compile on demand, re-triggering on every LRU evict)."""
+    from rl_scheduler_tpu.scheduler.set_backend import LoadAwareSetBackend
+
+    b = LoadAwareSetBackend(set_params_tree)
+    b._jax.has_executable = lambda n: False   # pin the compiling window
+    rng = np.random.default_rng(6)
+    obs = rng.uniform(0, 1, (24, 6)).astype(np.float32)
+    for _ in range(LoadAwareSetBackend.ADAPTIVE_MIN_SAMPLES + 4):
+        b.decide_nodes(obs)
+    assert b._lat["aot"].get(24) is None      # nothing attributed to AOT
+    assert b._aot_route(24) == (True, False)  # and no demotion possible
 
 
 def test_set_filter_keeps_argmax_node(set_params_tree):
