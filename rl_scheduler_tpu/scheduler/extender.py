@@ -516,6 +516,13 @@ class ExtenderPolicy:
             # overflow + the large-N reroute) — same signal /metrics
             # exports as a gauge.
             out["shed_fraction"] = round(float(shed), 4)
+        reroute = getattr(self.backend, "reroute_fraction", None)
+        if reroute is not None:
+            # Latency-based routing decisions that chose the host path
+            # (AdaptiveLatencyRouter) — deliberately separate from
+            # shed_fraction so overload stays distinguishable from
+            # the-host-path-is-simply-faster steady states.
+            out["reroute_fraction"] = round(float(reroute), 4)
         if self.placer is not None:
             out["placements_dropped"] = self.placer.dropped
         return out
@@ -557,6 +564,15 @@ class ExtenderPolicy:
                 "off the primary path by the load-aware backend.",
                 f"# TYPE {p}_shed_fraction gauge",
                 f"{p}_shed_fraction {shed:.9g}",
+            ]
+        reroute = getattr(self.backend, "reroute_fraction", None)
+        if reroute is not None:
+            lines += [
+                f"# HELP {p}_reroute_fraction Fraction of latency-router "
+                "decisions served by the host path (distinct from "
+                "overload shedding).",
+                f"# TYPE {p}_reroute_fraction gauge",
+                f"{p}_reroute_fraction {reroute:.9g}",
             ]
         if self.placer is not None:
             lines += [

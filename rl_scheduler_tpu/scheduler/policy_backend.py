@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable
 
 import numpy as np
@@ -184,6 +185,169 @@ class JaxAOTBackend:
         return int(np.argmax(logits)), logits
 
 
+class ConcurrencyTracker:
+    """In-flight request tracking shared by the load-aware families (one
+    implementation, like :class:`ShedGate` / :class:`AdaptiveLatencyRouter`):
+    who was concurrent at entry, and whether a timing window stayed
+    single-stream — a mid-call join inflates wall times with GIL-wakeup
+    penalties, so such samples must not feed the latency EWMAs."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active = 0
+        self._last_concurrent = float("-inf")   # monotonic seconds
+
+    def enter(self) -> bool:
+        """Register an in-flight request; True when others are already
+        in flight (concurrency observed — also stamps the clock)."""
+        with self._lock:
+            self._active += 1
+            if self._active > 1:
+                self._last_concurrent = time.monotonic()
+                return True
+            return False
+
+    def exit(self) -> None:
+        with self._lock:
+            self._active -= 1
+
+    def clean_since(self, t0_monotonic: float) -> bool:
+        """True when no concurrency has been observed since ``t0`` — the
+        whole window was single-stream, so its timing is a clean sample."""
+        with self._lock:
+            return self._last_concurrent < t0_monotonic
+
+    @property
+    def last_concurrent(self) -> float:
+        with self._lock:
+            return self._last_concurrent
+
+    def force_quiet(self) -> None:
+        """Reset the concurrency clock (tests: deterministically end a
+        cooldown window)."""
+        with self._lock:
+            self._last_concurrent = float("-inf")
+
+
+class AdaptiveLatencyRouter:
+    """Latency-aware AOT-vs-host routing state, shared by the MLP and
+    set serving families (same rationale as :class:`ShedGate`: one
+    implementation so the accounting cannot diverge).
+
+    The AOT dispatch rides a backend whose round-trip is pool-dependent
+    — measured sub-ms in quiet windows and 100+ ms when the tunnel/pool
+    degrades — while the host forwards are deterministic. This tracks a
+    latency EWMA per ``key`` (the set family keys on node count; the
+    MLP family's obs shape is fixed, one key) for each path and demotes
+    the AOT path once its EWMA exceeds ``margin`` x the host path's,
+    with 1-in-``probe_every`` recovery probes so a recovered pool
+    promotes it back without operator action.
+
+    Callers must feed ``observe()`` only single-stream samples
+    (contended wall times would corrupt both baselines) and only for
+    calls the attributed path actually served. Thread-safe.
+
+    Latency-based rerouting is accounted separately from overload
+    shedding: ``reroute_fraction`` is the fraction of routing decisions
+    that chose the host path — in a steady state where the host forward
+    simply IS faster (a legitimate live condition), the overload
+    ``shed_fraction`` metric must stay meaningful, not saturate at 1.
+    """
+
+    # The tuning constants, defined ONCE for both serving families (the
+    # set family re-exports them as its ADAPTIVE_* attributes).
+    ALPHA = 0.2
+    MARGIN = 1.5
+    PROBE_EVERY = 32
+    MIN_SAMPLES = 8
+    MAX_TRACKED = 64
+
+    def __init__(self, label: str = "AOT dispatch",
+                 alpha: float | None = None, margin: float | None = None,
+                 probe_every: int | None = None,
+                 min_samples: int | None = None,
+                 max_tracked: int | None = None):
+        self._label = label
+        self._alpha = self.ALPHA if alpha is None else alpha
+        self._margin = self.MARGIN if margin is None else margin
+        self._probe_every = (self.PROBE_EVERY if probe_every is None
+                             else probe_every)
+        self._min_samples = (self.MIN_SAMPLES if min_samples is None
+                             else min_samples)
+        self._max_tracked = (self.MAX_TRACKED if max_tracked is None
+                             else max_tracked)
+        self._lock = threading.Lock()
+        self.lat = {"aot": {}, "host": {}}     # key -> (ewma_ms, samples)
+        self._probe_countdown = {}             # key -> requests to probe
+        self._demotion_logged = set()          # keys already warned
+        self._decisions = 0                    # route_aot() calls
+        self._rerouted = 0                     # ... that chose host
+
+    @property
+    def min_samples(self) -> int:
+        return self._min_samples
+
+    @property
+    def reroute_fraction(self) -> float:
+        with self._lock:
+            return self._rerouted / self._decisions if self._decisions else 0.0
+
+    def observe(self, path: str, key, ms: float) -> None:
+        with self._lock:
+            table = self.lat[path]
+            prev = table.get(key)
+            if prev is None:
+                # Bounded per-key state (a kube-scheduler's candidate
+                # list size varies per pod): oldest-tracked evicts.
+                while len(table) >= self._max_tracked:
+                    evicted = next(iter(table))
+                    del table[evicted]
+                    self._probe_countdown.pop(evicted, None)
+                    self._demotion_logged.discard(evicted)
+                table[key] = (ms, 1)
+            else:
+                ewma, count = prev
+                table[key] = (ewma + self._alpha * (ms - ewma), count + 1)
+
+    def host_known(self, key) -> bool:
+        with self._lock:
+            return self.lat["host"].get(key) is not None
+
+    def route_aot(self, key) -> tuple[bool, bool]:
+        """``(route_aot, is_probe)`` for single-stream traffic at this
+        key: AOT while healthy/unmeasured/probing, host once demoted."""
+        with self._lock:
+            self._decisions += 1
+            aot = self.lat["aot"].get(key)
+            host = self.lat["host"].get(key)
+            if (aot is None or host is None
+                    or aot[1] < self._min_samples
+                    or aot[0] <= self._margin * host[0]):
+                self._demotion_logged.discard(key)
+                return True, False
+            if key not in self._demotion_logged:
+                self._demotion_logged.add(key)
+                logger.warning(
+                    "%s demoted at key=%s: EWMA %.2f ms vs host %.2f ms — "
+                    "serving host-side, probing every %d requests",
+                    self._label, key, aot[0], host[0], self._probe_every)
+            left = self._probe_countdown.get(key, self._probe_every)
+            if left <= 1:
+                self._probe_countdown[key] = self._probe_every
+                return True, True
+            self._probe_countdown[key] = left - 1
+            self._rerouted += 1
+            return False, False
+
+    def refund_probe(self, key) -> None:
+        """A probe that produced no usable AOT sample (gate-shed, or the
+        fallback served) must not count as taken, or sustained
+        concurrency would starve recovery."""
+        with self._lock:
+            if key in self._probe_countdown:
+                self._probe_countdown[key] = 1
+
+
 class ShedGate:
     """Thread-safe admission control for load-aware routing, shared by the
     MLP (``LoadAwareJaxBackend``) and set (``LoadAwareSetBackend``)
@@ -277,14 +441,27 @@ class LoadAwareJaxBackend:
     numpy) forward instead — whose GIL-holding matmuls stay flat
     (~0.09 ms p50) from 1-way to 8-way. Transitions are counted and
     logged (rate-limited) so operators can see when load is being shed.
+
+    The AOT path is also LATENCY-AWARE (round 5, same router as the set
+    family): its dispatch round-trip is pool-dependent, so both paths
+    are calibrated at startup and single-stream samples feed a latency
+    EWMA; once the AOT dispatch runs ``margin`` x worse than the host
+    forward it is demoted, with periodic recovery probes — see
+    :class:`AdaptiveLatencyRouter`. Demoted traffic is exported as
+    ``reroute_fraction``, deliberately NOT ``shed_fraction``: shedding
+    keeps meaning overload, so a host-path-is-faster steady state
+    cannot masquerade as saturation.
     """
 
     name = "jax"
+    _KEY = "mlp"    # the flat obs shape is fixed: one router key
 
     def __init__(self, params_tree: dict, hidden: tuple = (256, 256),
                  device: str = "cpu", algo: str = "ppo",
                  max_concurrent_jax: int = 2):
         self._jax = JaxAOTBackend(params_tree, hidden, device, algo)
+        self._adaptive = None
+        self._tracker = ConcurrencyTracker()
         if device != "cpu":
             # Shedding only keeps decisions consistent when the AOT path
             # runs on the host's XLA-CPU (f32 matmuls matching numpy/C++
@@ -306,6 +483,27 @@ class LoadAwareJaxBackend:
             except Exception as e:  # noqa: BLE001 - missing toolchain/.so
                 logger.info("native overflow path unavailable (%s); numpy", e)
                 self._overflow = NumpyMLPBackend(params_tree, algo)
+            # Both paths are built and warm: calibrate the latency EWMAs
+            # with min_samples timed single-stream calls each (one extra
+            # untimed overflow warmup first — lazy init must not bias
+            # the baseline). Full calibration matters: with fewer than
+            # min_samples the router could not demote until live traffic
+            # topped the count up, so a server started against an
+            # already-degraded pool would pay the slow dispatch for its
+            # first requests. ~1 ms at startup on a healthy pool.
+            self._adaptive = AdaptiveLatencyRouter(label="AOT MLP dispatch")
+            zeros = np.zeros(env_core.OBS_DIM, np.float32)
+            self._overflow.decide(zeros)
+            for _ in range(self._adaptive.min_samples):
+                t0 = time.perf_counter()
+                self._overflow.decide(zeros)
+                self._adaptive.observe("host", self._KEY,
+                                       (time.perf_counter() - t0) * 1e3)
+            for _ in range(self._adaptive.min_samples):
+                t0 = time.perf_counter()
+                self._jax.decide(zeros)
+                self._adaptive.observe("aot", self._KEY,
+                                       (time.perf_counter() - t0) * 1e3)
         # Only JAX-PATH calls count against the concurrency cap: a shed
         # request running the overflow forward must not keep later
         # arrivals away from an idle jax dispatcher.
@@ -318,16 +516,55 @@ class LoadAwareJaxBackend:
     def shed_fraction(self) -> float:
         return self._gate.shed_fraction
 
+    @property
+    def reroute_fraction(self) -> float:
+        """Fraction of routing decisions the latency router sent host-
+        side — separate from ``shed_fraction`` (overload), which must
+        stay meaningful when rerouting is the healthy steady state."""
+        return (self._adaptive.reroute_fraction
+                if self._adaptive is not None else 0.0)
+
     def decide(self, obs: np.ndarray) -> tuple[int, np.ndarray]:
-        take_jax, log_line = self._gate.admit()
-        if not take_jax:
-            if log_line:
-                logger.info("%s", log_line)
-            return self._overflow.decide(obs)
-        try:
+        if self._overflow is None:
+            # Accelerator serve device: no host paths, no routing.
             return self._jax.decide(obs)
+        concurrent = self._tracker.enter()
+        try:
+            route_aot, is_probe = self._adaptive.route_aot(self._KEY)
+            if not route_aot:
+                # Latency-routed to the host path (router-counted as a
+                # reroute, NOT overload shed — see reroute_fraction).
+                t0m = time.monotonic()
+                t0 = time.perf_counter()
+                out = self._overflow.decide(obs)
+                if not concurrent and self._tracker.clean_since(t0m):
+                    self._adaptive.observe("host", self._KEY,
+                                           (time.perf_counter() - t0) * 1e3)
+                return out
+            take_jax, log_line = self._gate.admit()
+            if not take_jax:
+                if log_line:
+                    logger.info("%s", log_line)
+                if is_probe:
+                    # The probe never reached the AOT path (cheap to
+                    # retry). A probe that RAN the dispatch but whose
+                    # sample was contaminated is NOT refunded — it paid
+                    # the degraded latency, and refunding would make
+                    # sustained concurrency probe near-continuously.
+                    self._adaptive.refund_probe(self._KEY)
+                return self._overflow.decide(obs)
+            try:
+                t0m = time.monotonic()
+                t0 = time.perf_counter()
+                out = self._jax.decide(obs)
+                if not concurrent and self._tracker.clean_since(t0m):
+                    self._adaptive.observe("aot", self._KEY,
+                                           (time.perf_counter() - t0) * 1e3)
+                return out
+            finally:
+                self._gate.release()
         finally:
-            self._gate.release()
+            self._tracker.exit()
 
 
 class GreedyBackend:

@@ -49,6 +49,12 @@ import time
 
 import numpy as np
 
+from rl_scheduler_tpu.scheduler.policy_backend import (
+    AdaptiveLatencyRouter,
+    ConcurrencyTracker,
+    ShedGate,
+)
+
 logger = logging.getLogger(__name__)
 
 SET_DIM = 64    # SetTransformerPolicy defaults (models/transformer.py)
@@ -404,8 +410,6 @@ class LoadAwareSetBackend:
     def __init__(self, params_tree: dict, num_heads: int = 1,
                  device: str = "cpu", max_concurrent_jax: int = 2,
                  warm_counts: tuple = (8,)):
-        from rl_scheduler_tpu.scheduler.policy_backend import ShedGate
-
         self._jax = JaxSetAOTBackend(params_tree, num_heads, device=device,
                                      warm_counts=warm_counts)
         if device != "cpu":
@@ -448,15 +452,19 @@ class LoadAwareSetBackend:
         self._gate = ShedGate(max_concurrent_jax,
                               primary="set jax dispatcher",
                               overflow=overflow_label)
-        self._active = 0            # in-flight decisions on ANY path
-        self._active_lock = threading.Lock()
-        self._last_concurrent = float("-inf")  # monotonic seconds
-        # Adaptive routing state (see the ADAPTIVE_* constants):
-        # per-node-count latency EWMAs for each path + probe countdowns.
-        self._lat_lock = threading.Lock()
-        self._lat = {"aot": {}, "host": {}}    # n -> (ewma_ms, samples)
-        self._probe_countdown = {}             # n -> requests to next probe
-        self._demotion_logged = set()          # n values already logged
+        self._tracker = ConcurrencyTracker()   # shared impl (policy_backend)
+        # Adaptive routing state (see the ADAPTIVE_* constants): the
+        # shared router keyed on node count (policy_backend.py — one
+        # implementation for both serving families).
+        self._adaptive = AdaptiveLatencyRouter(
+            label="AOT set dispatch",
+            alpha=self.ADAPTIVE_ALPHA,
+            margin=self.ADAPTIVE_MARGIN,
+            probe_every=self.ADAPTIVE_PROBE_EVERY,
+            min_samples=self.ADAPTIVE_MIN_SAMPLES,
+            max_tracked=self.ADAPTIVE_MAX_TRACKED_N,
+        )
+        self._seed_lock = threading.Lock()
         self._seeding = set()                  # n values mid host-seed
 
     NATIVE_OVERFLOW_MAX_N = 20  # measured single-stream crossover
@@ -471,14 +479,16 @@ class LoadAwareSetBackend:
     # EWMA exceeds ADAPTIVE_MARGIN x the host path's, route single-stream
     # traffic host-side and keep probing 1-in-ADAPTIVE_PROBE_EVERY
     # requests through AOT so recovery promotes it back automatically.
-    ADAPTIVE_ALPHA = 0.2
-    ADAPTIVE_MARGIN = 1.5
-    ADAPTIVE_PROBE_EVERY = 32
-    ADAPTIVE_MIN_SAMPLES = 8
+    # Values aliased from the shared router so both serving families
+    # tune from ONE source of truth (policy_backend.AdaptiveLatencyRouter).
+    ADAPTIVE_ALPHA = AdaptiveLatencyRouter.ALPHA
+    ADAPTIVE_MARGIN = AdaptiveLatencyRouter.MARGIN
+    ADAPTIVE_PROBE_EVERY = AdaptiveLatencyRouter.PROBE_EVERY
+    ADAPTIVE_MIN_SAMPLES = AdaptiveLatencyRouter.MIN_SAMPLES
     # Bound on tracked node counts (same rationale as the AOT executable
     # LRU: a kube-scheduler's candidate-list size varies per pod, so
     # per-N state must not grow without bound). Oldest-tracked evicts.
-    ADAPTIVE_MAX_TRACKED_N = 64
+    ADAPTIVE_MAX_TRACKED_N = AdaptiveLatencyRouter.MAX_TRACKED
     # After concurrency is observed, large-N requests stay on the uniform
     # numpy path for this long even if in-flight momentarily drops to 0:
     # under a sustained 8-way bench the pool's arrival gaps let single
@@ -500,86 +510,55 @@ class LoadAwareSetBackend:
     def shed_fraction(self) -> float:
         return self._gate.shed_fraction
 
+    @property
+    def reroute_fraction(self) -> float:
+        """Fraction of routing decisions the latency router sent host-
+        side — separate from ``shed_fraction`` (overload): on a degraded
+        pool, rerouting is the healthy steady state and must not
+        saturate the overload metric."""
+        return self._adaptive.reroute_fraction
+
+    @property
+    def _lat(self) -> dict:
+        """The router's EWMA tables (kept as an attribute-shaped view —
+        tests and debugging tooling read/seed it directly)."""
+        return self._adaptive.lat
+
     def _observe_latency(self, path: str, n: int, ms: float) -> None:
-        with self._lat_lock:
-            table = self._lat[path]
-            prev = table.get(n)
-            if prev is None:
-                while len(table) >= self.ADAPTIVE_MAX_TRACKED_N:
-                    evicted = next(iter(table))
-                    del table[evicted]
-                    self._probe_countdown.pop(evicted, None)
-                    self._demotion_logged.discard(evicted)
-                table[n] = (ms, 1)
-            else:
-                ewma, count = prev
-                table[n] = (
-                    ewma + self.ADAPTIVE_ALPHA * (ms - ewma), count + 1)
+        self._adaptive.observe(path, n, ms)
 
     def _host_decide(self, node_obs: np.ndarray,
                      record: bool = True) -> tuple[int, np.ndarray]:
         """Serve from the host path for this N. ``record=False`` for
         calls made under concurrency: queued/contended wall times would
         inflate the host EWMA and mask real AOT degradation, so only
-        single-stream samples feed the comparison."""
+        single-stream samples feed the comparison — including calls
+        that were single-stream at ENTRY but got joined mid-call."""
         n = len(node_obs)
+        t0m = time.monotonic()
         t0 = time.perf_counter()
         out = self._overflow_for(n).decide_nodes(node_obs)
-        if record:
+        if record and self._tracker.clean_since(t0m):
             self._observe_latency("host", n,
                                   (time.perf_counter() - t0) * 1e3)
         return out
 
     def _aot_route(self, n: int) -> tuple[bool, bool]:
-        """``(route_aot, is_probe)`` for single-stream traffic at this N.
-
-        Routes AOT while the path is healthy, unmeasured, or due a
-        recovery probe; routes host once the AOT latency EWMA exceeds
-        ``ADAPTIVE_MARGIN`` x the host path's (a degraded tunnel/pool —
-        the host forwards are deterministic, so serve there and probe
-        1-in-``ADAPTIVE_PROBE_EVERY`` so recovery promotes AOT back).
-        """
-        with self._lat_lock:
-            aot = self._lat["aot"].get(n)
-            host = self._lat["host"].get(n)
-            if (aot is None or host is None
-                    or aot[1] < self.ADAPTIVE_MIN_SAMPLES
-                    or aot[0] <= self.ADAPTIVE_MARGIN * host[0]):
-                self._demotion_logged.discard(n)
-                return True, False
-            if n not in self._demotion_logged:
-                self._demotion_logged.add(n)
-                logger.warning(
-                    "AOT set dispatch demoted at N=%d: EWMA %.2f ms vs "
-                    "host %.2f ms — serving host-side, probing every %d "
-                    "requests", n, aot[0], host[0], self.ADAPTIVE_PROBE_EVERY)
-            left = self._probe_countdown.get(n, self.ADAPTIVE_PROBE_EVERY)
-            if left <= 1:
-                self._probe_countdown[n] = self.ADAPTIVE_PROBE_EVERY
-                return True, True
-            self._probe_countdown[n] = left - 1
-            return False, False
+        """``(route_aot, is_probe)`` for single-stream traffic at this N
+        (the shared router's decision — see ``AdaptiveLatencyRouter``)."""
+        return self._adaptive.route_aot(n)
 
     def _refund_probe(self, n: int) -> None:
-        """A probe that could not reach the AOT path (gate shed it under
-        concurrency) must not count as taken, or sustained concurrency
-        would starve recovery: the next single-stream request re-probes."""
-        with self._lat_lock:
-            if n in self._probe_countdown:
-                self._probe_countdown[n] = 1
+        self._adaptive.refund_probe(n)
 
     def decide_nodes(self, node_obs: np.ndarray) -> tuple[int, np.ndarray]:
         if self._overflow_numpy is None:
             # Accelerator serve device: no host overflow paths, no routing.
             return self._jax.decide_nodes(node_obs)
-        with self._active_lock:
-            self._active += 1
-            now = time.monotonic()
-            if self._active > 1:
-                self._last_concurrent = now
-            concurrent = (self._active > 1
-                          or now - self._last_concurrent
-                          < self.CONCURRENT_COOLDOWN_S)
+        joined = self._tracker.enter()
+        concurrent = (joined
+                      or time.monotonic() - self._tracker.last_concurrent
+                      < self.CONCURRENT_COOLDOWN_S)
         try:
             if concurrent and len(node_obs) > self.NATIVE_OVERFLOW_MAX_N:
                 # Large-N under concurrency: serve the uniform host path
@@ -598,16 +577,12 @@ class LoadAwareSetBackend:
             n = len(node_obs)
             route_aot, is_probe = self._aot_route(n)
             if not route_aot:
-                # Degraded AOT path at this N (latency EWMA, class
-                # docstring): the host forward is the faster server right
-                # now. Accounted as shed traffic. Single-stream by
-                # construction (the concurrent branch returned above), so
-                # the sample feeds the host EWMA.
-                log_line = self._gate.record_shed(
-                    f"degraded AOT dispatch (N={n})"
-                )
-                if log_line:
-                    logger.info("%s", log_line)
+                # The host forward measures faster at this N right now
+                # (latency EWMA, class docstring). Router-counted as a
+                # reroute — NOT overload shed: on a degraded pool this
+                # is the healthy steady state and must not saturate
+                # shed_fraction. The one-time demotion warning is the
+                # operator signal.
                 return self._host_decide(node_obs, record=not concurrent)
             take_jax, log_line = self._gate.admit()
             if not take_jax:
@@ -621,12 +596,12 @@ class LoadAwareSetBackend:
                 # record the contended wall time.
                 return self._host_decide(node_obs, record=False)
             try:
-                with self._lat_lock:
+                with self._seed_lock:
                     # Seed only single-stream: a contended seed sample
                     # would become a permanently inflated host baseline
                     # (it is rarely updated later) and mask degradation.
                     need_seed = (not concurrent
-                                 and self._lat["host"].get(n) is None
+                                 and not self._adaptive.host_known(n)
                                  and n not in self._seeding)
                     if need_seed:
                         self._seeding.add(n)
@@ -642,7 +617,7 @@ class LoadAwareSetBackend:
                         self._overflow_for(n).decide_nodes(node_obs)
                         self._host_decide(node_obs)
                     finally:
-                        with self._lat_lock:
+                        with self._seed_lock:
                             self._seeding.discard(n)
                 # Attribute the timing to the AOT path only when the
                 # executable will actually serve it — the compiling-
@@ -650,22 +625,27 @@ class LoadAwareSetBackend:
                 # would false-demote a healthy AOT path at exactly the
                 # Ns that compile on demand.
                 served_aot = self._jax.has_executable(n)
+                t0m = time.monotonic()
                 t0 = time.perf_counter()
                 out = self._jax.decide_nodes(node_obs)
-                if not concurrent and served_aot:
+                if (not concurrent and served_aot
+                        and self._tracker.clean_since(t0m)):
                     self._observe_latency("aot", n,
                                           (time.perf_counter() - t0) * 1e3)
-                elif is_probe:
-                    # The probe produced no usable AOT sample (still
-                    # compiling, or contended timing): hand it back so
-                    # recovery isn't starved.
+                elif is_probe and not served_aot:
+                    # The probe never reached the executable (still
+                    # compiling — the cheap fallback served): hand it
+                    # back so recovery isn't starved. A probe that RAN
+                    # the dispatch but whose timing was contaminated is
+                    # NOT refunded — it paid the degraded latency, and
+                    # refunding would make sustained concurrency probe
+                    # near-continuously.
                     self._refund_probe(n)
                 return out
             finally:
                 self._gate.release()
         finally:
-            with self._active_lock:
-                self._active -= 1
+            self._tracker.exit()
 
 
 def make_set_backend(backend: str, params_tree: dict, num_heads: int = 1,
