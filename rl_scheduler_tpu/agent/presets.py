@@ -120,10 +120,11 @@ PPO_PRESETS: dict[str, PPOTrainConfig] = {
     # per-sample compute grows ~10x with the node set (4096 envs
     # measured the same steps/s with 4x the memory). Measured
     # (docs/scaling.md): 245k env-steps/s steady-state, greedy eval
-    # +24.6%/+25.4% over the best node baseline at 100 episodes (seeds
-    # 0/1; seed 2's greedy eval fails while its training reward matches
-    # — detectable by iteration ~16 with --eval-every 8, see the seed
-    # caveat in docs/scaling.md §1b), serving p50 <1 ms at N=64.
+    # +17-26% over the best node baseline on converged seeds — a 9-seed
+    # study measured ~44% of seeds failing the greedy eval while their
+    # training reward looks healthy, so run fleet presets with
+    # --eval-every 8 --reseed-on-stall 2 (catches both measured failure
+    # modes; docs/scaling.md §1b) — serving p50 <1 ms at N=64.
     "set_fleet64": PPOTrainConfig(
         num_envs=1024,
         rollout_steps=100,

@@ -42,6 +42,7 @@ import bisect
 import json
 import logging
 import queue
+import random
 import re
 import threading
 import time
@@ -263,11 +264,34 @@ class ExtenderPolicy:
     def __init__(self, backend, telemetry: TableTelemetry, placer=None,
                  node_capacity_cores: float = DEFAULT_NODE_CAPACITY_CORES,
                  price_replay: str = "counter",
-                 price_replay_period_s: float = 300.0):
+                 price_replay_period_s: float = 300.0,
+                 max_score_nodes: int = 0):
         self.backend = backend
         self.family = getattr(backend, "family", "cloud")
         self.telemetry = telemetry
         self.node_capacity_cores = node_capacity_cores
+        # Candidate-list cap for the structured families — the same idea
+        # as kube-scheduler's percentageOfNodesToScore: scoring cost per
+        # request is O(cap) no matter how large the fleet's node list
+        # grows, and every large request hits ONE AOT executable size.
+        # 0 = score every candidate. Unsampled nodes score 0 (they just
+        # can't win this pod — the next request samples independently).
+        if max_score_nodes < 0 or max_score_nodes == 1:
+            # Same refuse-before-traffic rule as the CLI: a negative cap
+            # would make random.sample raise inside the fail-open
+            # handlers (every request silently passthrough), and a
+            # 1-node sample is a coin flip, not a policy decision.
+            raise ValueError(
+                f"max_score_nodes={max_score_nodes}: pass a cap >= 2 "
+                "(0 disables the cap)"
+            )
+        self.max_score_nodes = max_score_nodes
+        # OS-entropy seed: replicas must sample DIFFERENT subsets (a
+        # constant seed would make every replica's n-th request score
+        # the identical nodes, so a retried pod re-hits the same
+        # unsampled set).
+        self._cap_rng = random.Random()
+        self._cap_lock = threading.Lock()
         if self.family == "graph":
             from rl_scheduler_tpu.scheduler.graph_backend import RawPriceReplay
 
@@ -349,10 +373,30 @@ class ExtenderPolicy:
                            clouds: list) -> tuple[int, np.ndarray]:
         pod = args.get("pod")
         pod_cpu = pod_cpu_fraction(pod, self.node_capacity_cores)
-        if self.family == "set":
-            action, probs, _ = self.decide_set(clouds, pod_cpu)
+        cap = self.max_score_nodes
+        idx = None
+        if cap and len(clouds) > cap:
+            # Uniform subset per request (seeded process RNG: which nodes
+            # get scored varies by request, so no node is systematically
+            # unscoreable; replicas sample independently — fail-open
+            # semantics, an unsampled node just can't win this pod). An
+            # affinity-annotated node outside the sample falls back to
+            # the graph family's documented mean-hops neutral handling.
+            with self._cap_lock:
+                idx = sorted(self._cap_rng.sample(range(len(clouds)), cap))
+            sub_clouds = [clouds[i] for i in idx]
+            sub_display = [display[i] for i in idx]
         else:
-            action, probs, _ = self.decide_graph(clouds, display, pod, pod_cpu)
+            sub_clouds, sub_display = clouds, display
+        if self.family == "set":
+            action, probs, _ = self.decide_set(sub_clouds, pod_cpu)
+        else:
+            action, probs, _ = self.decide_graph(sub_clouds, sub_display,
+                                                 pod, pod_cpu)
+        if idx is not None:
+            full = np.zeros(len(clouds), probs.dtype)
+            full[idx] = probs
+            action, probs = idx[action], full
         return action, probs
 
     @staticmethod
@@ -675,6 +719,7 @@ def build_policy(
     price_replay: str = "counter",
     price_replay_period_s: float = 300.0,
     warm_nodes: tuple | None = None,
+    max_score_nodes: int = 0,
 ) -> ExtenderPolicy:
     """Assemble the serving stack: checkpoint -> backend -> telemetry.
 
@@ -796,7 +841,18 @@ def build_policy(
     policy = ExtenderPolicy(backend_obj, telemetry, placer,
                             node_capacity_cores=node_capacity_cores,
                             price_replay=price_replay,
-                            price_replay_period_s=price_replay_period_s)
+                            price_replay_period_s=price_replay_period_s,
+                            max_score_nodes=max_score_nodes)
+    if max_score_nodes and policy.family not in ExtenderPolicy.STRUCTURED:
+        # Same refuse-before-traffic rule as price_replay below: the flat
+        # family scores per CLOUD (two logits however long the node list
+        # is), so a candidate cap would silently do nothing.
+        raise ValueError(
+            f"max_score_nodes={max_score_nodes}: the candidate cap bounds "
+            f"the structured families' per-node forward; the loaded "
+            f"checkpoint serves family {policy.family!r} (drop the flag "
+            "or serve a cluster_set/cluster_graph checkpoint)"
+        )
     if price_replay != "counter" and policy.family != "graph":
         # Refuse here (not just in the CLI) so every entry point —
         # embeddings, tests — learns the flag did nothing BEFORE traffic:
@@ -845,11 +901,25 @@ def main(argv: list[str] | None = None) -> None:
                         "fleet's actual candidate-list sizes so no first "
                         "request is served by the overflow forward while "
                         "a background compile runs")
+    p.add_argument("--max-score-nodes", type=int, default=0, metavar="K",
+                   help="structured families: score at most K candidate "
+                        "nodes per request (a uniform per-request sample; "
+                        "unsampled nodes score 0). The kube-scheduler's "
+                        "percentageOfNodesToScore idea — bounds the "
+                        "per-request forward at fleet-giant N and pins "
+                        "large requests to one AOT executable size. "
+                        "0 scores every candidate")
     p.add_argument("--price-replay-period", type=float, default=300.0,
                    help="wallclock replay only: real-world seconds one "
                         "pricing-table row represents (default 300 — the "
                         "5-minute cloud-pricing update cadence)")
     args = p.parse_args(argv)
+    if args.max_score_nodes < 0 or args.max_score_nodes == 1:
+        raise SystemExit(
+            f"--max-score-nodes {args.max_score_nodes}: pass a cap >= 2 "
+            "(a 1-node sample is a coin flip, not a policy decision; "
+            "0 disables the cap)"
+        )
     if args.price_replay_period <= 0:
         # RawPriceReplay validates too (for programmatic entry points);
         # refusing here keeps the CLI's exit clean and pre-startup.
@@ -890,6 +960,7 @@ def main(argv: list[str] | None = None) -> None:
             price_replay=args.price_replay,
             price_replay_period_s=args.price_replay_period,
             warm_nodes=warm_nodes,
+            max_score_nodes=args.max_score_nodes,
         )
     except ValueError as e:
         # build_policy refuses misconfigurations (explicitly-named

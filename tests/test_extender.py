@@ -13,6 +13,7 @@ import pytest
 from rl_scheduler_tpu.env import core as env_core
 from rl_scheduler_tpu.models import ActorCritic
 from rl_scheduler_tpu.scheduler.extender import (
+    MAX_EXTENDER_SCORE,
     ExtenderPolicy,
     build_policy,
     make_server,
@@ -769,7 +770,9 @@ def test_load_aware_set_adaptive_demotion(set_params_tree):
     b = LoadAwareSetBackend(set_params_tree, warm_counts=(40,))
     calls = []
     real_jax = b._jax.decide_nodes
+    real_overflow_for = b._overflow_for
     slow = [True]
+    slow_host = [False]
 
     def jax_decide(o):
         calls.append("jax")
@@ -777,7 +780,14 @@ def test_load_aware_set_adaptive_demotion(set_params_tree):
             _time.sleep(0.01)           # a degraded 10 ms dispatch
         return real_jax(o)
 
+    class SlowHost:
+        def decide_nodes(self, o):
+            if slow_host[0]:
+                _time.sleep(0.002)      # deterministic recovery margin
+            return real_overflow_for(len(o)).decide_nodes(o)
+
     b._jax.decide_nodes = jax_decide
+    b._overflow_for = lambda n: SlowHost()
     rng = np.random.default_rng(5)
     obs = rng.uniform(0, 1, (40, 6)).astype(np.float32)
 
@@ -795,9 +805,12 @@ def test_load_aware_set_adaptive_demotion(set_params_tree):
     assert b.reroute_fraction > 0.0     # counted as latency rerouting...
     assert b.shed_fraction == 0.0       # ...NOT as overload shedding
 
-    # Recovery: force the next probe, serve fast, and let the EWMA pull
-    # the AOT estimate back under the margin.
+    # Recovery: the dispatch is fast again and the host path reads
+    # slower (deterministic margin — on a real host the numpy forward
+    # may legitimately stay the faster path, which is routing working,
+    # not a recovery failure). Probes must promote AOT back.
     slow[0] = False
+    slow_host[0] = True
     promoted = False
     for _ in range(40 * LoadAwareSetBackend.ADAPTIVE_PROBE_EVERY):
         calls.clear()
@@ -825,6 +838,69 @@ def test_adaptive_ignores_compiling_fallback(set_params_tree):
         b.decide_nodes(obs)
     assert b._lat["aot"].get(24) is None      # nothing attributed to AOT
     assert b._aot_route(24) == (True, False)  # and no demotion possible
+
+
+def test_max_score_nodes_caps_structured_scoring(set_params_tree):
+    """--max-score-nodes K (kube's percentageOfNodesToScore idea): the
+    per-node forward sees at most K candidates per request; unsampled
+    nodes score 0; /filter still keeps exactly one (sampled) node."""
+    from rl_scheduler_tpu.scheduler.set_backend import NumpySetBackend
+
+    backend = NumpySetBackend(set_params_tree)
+    seen_shapes = []
+    real = backend.decide_nodes
+    backend.decide_nodes = (
+        lambda o: (seen_shapes.append(np.asarray(o).shape), real(o))[1])
+    telemetry = TableTelemetry.from_table(cpu_source=RandomCpu(seed=3))
+    policy = ExtenderPolicy(backend, telemetry, max_score_nodes=8)
+
+    args = _set_request(num_nodes=30)
+    scores = policy.prioritize(args)
+    assert len(scores) == 30                     # every candidate answered
+    assert seen_shapes[-1][0] == 8               # forward saw the cap only
+    positive = [s for s in scores if s["score"] > 0]
+    assert 1 <= len(positive) <= 8               # unsampled nodes score 0
+    assert max(s["score"] for s in scores) == MAX_EXTENDER_SCORE
+
+    out = policy.filter(args)
+    kept = out["nodes"]["items"]
+    assert len(kept) == 1 and len(out["failedNodes"]) == 29
+    assert seen_shapes[-1][0] == 8
+
+    # Below the cap nothing changes: the forward sees the full list.
+    policy.prioritize(_set_request(num_nodes=5))
+    assert seen_shapes[-1][0] == 5
+
+    # Successive requests sample independently (no node is permanently
+    # unscoreable): over a few requests the union of scored nodes grows
+    # past one sample's worth.
+    scored = set()
+    for _ in range(6):
+        for s in policy.prioritize(args):
+            if s["score"] > 0:
+                scored.add(s["host"])
+    assert len(scored) > 8
+
+
+def test_max_score_nodes_flat_family_refused():
+    """The cap bounds the structured families' per-node forward; a flat
+    (cloud-decision) serving stack refuses it before traffic."""
+    from rl_scheduler_tpu.scheduler.extender import build_policy
+
+    with pytest.raises(ValueError, match="candidate cap"):
+        build_policy("greedy", max_score_nodes=4)
+    with pytest.raises(SystemExit, match="cap >= 2"):
+        from rl_scheduler_tpu.scheduler import extender as cli
+
+        cli.main(["--max-score-nodes", "1"])
+    # Programmatic entry points refuse bad ranges too (a negative cap
+    # would make random.sample raise inside the fail-open handlers —
+    # every request would silently passthrough).
+    with pytest.raises(ValueError, match="cap >= 2"):
+        build_policy("greedy", max_score_nodes=-4)
+    with pytest.raises(ValueError, match="cap >= 2"):
+        ExtenderPolicy(GreedyBackend(),
+                       TableTelemetry.from_table(), max_score_nodes=1)
 
 
 def test_set_filter_keeps_argmax_node(set_params_tree):
@@ -1288,7 +1364,7 @@ def test_price_replay_period_reaches_replay(monkeypatch):
 
         def __init__(self, backend, telemetry, placer=None,
                      node_capacity_cores=4.0, price_replay="counter",
-                     price_replay_period_s=300.0):
+                     price_replay_period_s=300.0, max_score_nodes=0):
             captured["mode"] = price_replay
             captured["period"] = price_replay_period_s
 

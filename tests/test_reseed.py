@@ -171,6 +171,50 @@ class TestReseedMechanics:
         mgr.close()
 
 
+class TestStallGuardUnit:
+    """make_stall_guard's two checkpoints, driven with synthetic eval
+    streams (the 9-seed study showed BOTH are needed: never-converge
+    fails the deadline, late-degrade passes it and fails the final
+    acceptance — docs/scaling.md §1b)."""
+
+    def _guard(self, **kw):
+        kw.setdefault("decision_iter", 2)
+        kw.setdefault("final_iter", 6)
+        kw.setdefault("threshold", -100.0)
+        return cli.make_stall_guard(lambda i, m: None, **kw)
+
+    @staticmethod
+    def _eval(guard, iteration, value):
+        guard(iteration - 1, {"eval_episode_reward_mean": value})
+
+    def test_never_converged_fails_deadline(self):
+        g = self._guard()
+        self._eval(g, 1, -500.0)
+        with pytest.raises(cli.EvalStall) as e:
+            self._eval(g, 2, -500.0)
+        assert e.value.iteration == 2
+
+    def test_late_degrader_fails_final_acceptance(self):
+        g = self._guard()
+        self._eval(g, 2, -50.0)      # healthy at the deadline
+        self._eval(g, 4, -50.0)
+        with pytest.raises(cli.EvalStall) as e:
+            self._eval(g, 6, -500.0)  # degraded by the last eval
+        assert e.value.iteration == 6
+
+    def test_healthy_run_passes_both(self):
+        g = self._guard()
+        for it in (1, 2, 4, 6):
+            self._eval(g, it, -50.0)  # no raise
+
+    def test_budget_spent_warns_instead(self, capsys):
+        g = self._guard(raise_on_stall=False)
+        self._eval(g, 2, -500.0)
+        self._eval(g, 6, -500.0)
+        out = capsys.readouterr().out
+        assert out.count("WARNING") == 2
+
+
 def test_best_node_baseline_reward_is_best():
     """The threshold helper returns the max over the three node
     baselines (the value the guard compares evals against)."""
