@@ -122,9 +122,9 @@ PPO_PRESETS: dict[str, PPOTrainConfig] = {
     # (docs/scaling.md): 245k env-steps/s steady-state, greedy eval
     # +17-26% over the best node baseline on converged seeds — a 9-seed
     # study measured ~44% of seeds failing the greedy eval while their
-    # training reward looks healthy, so run fleet presets with
-    # --eval-every 8 --reseed-on-stall 2 (catches both measured failure
-    # modes; docs/scaling.md §1b) — serving p50 <1 ms at N=64.
+    # training reward looks healthy, so the preset implies the reseed
+    # guard (catches both measured failure modes; docs/scaling.md §1b)
+    # — serving p50 <1 ms at N=64.
     "set_fleet64": PPOTrainConfig(
         num_envs=1024,
         rollout_steps=100,
@@ -133,6 +133,11 @@ PPO_PRESETS: dict[str, PPOTrainConfig] = {
         lr=1e-3,
         gamma=0.99,
         compute_dtype="bfloat16",
+        # The measured recipe INCLUDES the eval cadence the reseed
+        # guard needs (docs/scaling.md §1b); the CLI implies
+        # --reseed-on-stall 2 for runs long enough to use it.
+        eval_every=8,
+        eval_episodes=64,
     ),
     # N=256 fleet recipe: same shape as set_fleet64 with envs scaled
     # down another 4x (per-sample compute grows with N; the flax policy
@@ -148,6 +153,8 @@ PPO_PRESETS: dict[str, PPOTrainConfig] = {
         lr=1e-3,
         gamma=0.99,
         compute_dtype="bfloat16",
+        eval_every=8,
+        eval_episodes=64,
     ),
 }
 
@@ -158,8 +165,15 @@ PPO_PRESETS: dict[str, PPOTrainConfig] = {
 PRESET_IMPLIES: dict[str, dict] = {
     "set_fast": {"env": "cluster_set", "fused_set": True},
     "gnn_fast": {"env": "cluster_graph", "fused_gnn": True},
-    "set_fleet64": {"env": "cluster_set", "num_nodes": 64},
-    "set_fleet256": {"env": "cluster_set", "num_nodes": 256},
+    # The fleet presets imply the bad-seed guard (the measured ~44%
+    # per-seed greedy failure rate, docs/scaling.md §1b): the CLI fills
+    # reseed_on_stall when the user left it unset AND the run is long
+    # enough for the stall deadline to fire (auto-disabled with an info
+    # line otherwise — smoke runs with --iterations 1 stay valid).
+    "set_fleet64": {"env": "cluster_set", "num_nodes": 64,
+                    "reseed_on_stall": 2},
+    "set_fleet256": {"env": "cluster_set", "num_nodes": 256,
+                     "reseed_on_stall": 2},
 }
 
 DQN_PRESETS: dict[str, DQNConfig] = {

@@ -190,7 +190,7 @@ def main(argv: list[str] | None = None) -> Path:
                         "their env (and fast-path policy)")
     p.add_argument("--iterations", type=int, default=5)
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--reseed-on-stall", type=int, default=0, metavar="N",
+    p.add_argument("--reseed-on-stall", type=int, default=None, metavar="N",
                    help="structured envs: if the in-training greedy eval "
                         "has not crossed the best hand-coded node "
                         "baseline by --stall-deadline, abandon the "
@@ -559,6 +559,43 @@ def main(argv: list[str] | None = None) -> Path:
                 f"minibatch_size={cfg.minibatch_size} must both divide by "
                 "the device count"
             )
+    def guard_ineligible() -> str | None:
+        """Why the reseed guard cannot run with this invocation — ONE
+        predicate for both the implied path (auto-disable with a note)
+        and the explicit flag (hard error); two copies already drifted
+        once."""
+        if cfg.eval_every <= 0:
+            return ("needs the in-training eval signal: pass "
+                    "--eval-every (e.g. 8 — the measured recipe)")
+        if cfg.eval_every > args.stall_deadline:
+            return (f"--eval-every {cfg.eval_every} fires no eval at or "
+                    f"before --stall-deadline {args.stall_deadline}; the "
+                    "guard could never trigger")
+        if args.stall_deadline >= args.iterations:
+            return (f"--stall-deadline {args.stall_deadline} >= "
+                    f"--iterations {args.iterations}: the guard would "
+                    "fire at or after the end of training (raise "
+                    "--iterations or lower the deadline)")
+        if args.resume:
+            return ("restarts training from scratch on a stalled eval; "
+                    "that contradicts --resume (drop one)")
+        return None
+
+    if args.reseed_on_stall is None:
+        # Fleet presets imply the guard (the measured ~44% per-seed
+        # greedy failure rate, docs/scaling.md §1b) — whenever the
+        # invocation can use it; smoke runs and resumes auto-disable it
+        # with a note instead of erroring.
+        implied_guard = implied.get("reseed_on_stall")
+        reason = guard_ineligible() if implied_guard else None
+        args.reseed_on_stall = implied_guard if (implied_guard
+                                                 and reason is None) else 0
+        if args.reseed_on_stall:
+            print(f"Preset {args.preset} implies --reseed-on-stall "
+                  f"{implied_guard} (pass --reseed-on-stall 0 to disable)")
+        elif implied_guard:
+            print(f"note: preset {args.preset}'s implied reseed guard is "
+                  f"disabled for this invocation ({reason})")
     if args.reseed_on_stall < 0:
         raise SystemExit(
             f"--reseed-on-stall {args.reseed_on_stall}: pass a maximum "
@@ -574,29 +611,9 @@ def main(argv: list[str] | None = None) -> Path:
                 f"greedy-eval seed fragility (docs/scaling.md §1b); --env "
                 f"{args.env} has no node baselines to threshold against"
             )
-        if cfg.eval_every <= 0:
-            raise SystemExit(
-                "--reseed-on-stall needs the in-training eval signal: "
-                "pass --eval-every (e.g. 8 — the measured recipe)"
-            )
-        if cfg.eval_every > args.stall_deadline:
-            raise SystemExit(
-                f"--reseed-on-stall: --eval-every {cfg.eval_every} fires "
-                f"no eval at or before --stall-deadline "
-                f"{args.stall_deadline}; the guard could never trigger"
-            )
-        if args.stall_deadline >= args.iterations:
-            raise SystemExit(
-                f"--stall-deadline {args.stall_deadline} >= --iterations "
-                f"{args.iterations}: the guard would fire at or after the "
-                "end of training (raise --iterations or lower the "
-                "deadline)"
-            )
-        if args.resume:
-            raise SystemExit(
-                "--reseed-on-stall restarts training from scratch on a "
-                "stalled eval; that contradicts --resume (drop one)"
-            )
+        reason = guard_ineligible()
+        if reason is not None:
+            raise SystemExit(f"--reseed-on-stall {reason}")
     bundle, net = make_bundle_and_net(args.env, cfg, args.legacy_reward_sign,
                                       fault_prob, args.num_heads,
                                       fused_gnn=args.fused_gnn,

@@ -51,7 +51,8 @@ def test_set_fleet64_preset_implies_fleet_recipe(tmp_path):
     assert (cfg.num_envs, cfg.num_epochs, cfg.compute_dtype) == \
         (1024, 1, "bfloat16")
     assert PRESET_IMPLIES["set_fleet64"] == {"env": "cluster_set",
-                                            "num_nodes": 64}
+                                             "num_nodes": 64,
+                                             "reseed_on_stall": 2}
     with pytest.raises(SystemExit, match="cluster_set"):
         cli.main(["--preset", "set_fleet64", "--env", "cluster_graph",
                   "--run-root", str(tmp_path)])

@@ -215,6 +215,90 @@ class TestStallGuardUnit:
         assert out.count("WARNING") == 2
 
 
+class TestPresetImpliedGuard:
+    """The fleet presets imply --reseed-on-stall 2 (the preset IS the
+    guarded recipe), auto-disabled for invocations that can't use it."""
+
+    TINY_FLEET = [
+        "--preset", "set_fleet64", "--num-nodes", "4", "--num-envs", "4",
+        "--rollout-steps", "8", "--minibatch-size", "16",
+    ]
+
+    def test_implied_for_long_runs(self, tmp_path, monkeypatch, capsys):
+        monkeypatch.setattr(
+            "rl_scheduler_tpu.agent.evaluate.best_node_baseline_reward",
+            lambda *a, **k: float("-inf"),   # healthy: never stalls
+        )
+        cli.main(self.TINY_FLEET + ["--iterations", "20",
+                                    "--run-root", str(tmp_path),
+                                    "--run-name", "long"])
+        out = capsys.readouterr().out
+        assert "implies --reseed-on-stall 2" in out
+        assert "Stall guard:" in out
+
+    def test_auto_disabled_for_smoke_runs(self, tmp_path, monkeypatch,
+                                          capsys):
+        def boom(*a, **k):
+            raise AssertionError("threshold must not be computed")
+
+        monkeypatch.setattr(
+            "rl_scheduler_tpu.agent.evaluate.best_node_baseline_reward",
+            boom)
+        cli.main(self.TINY_FLEET + ["--iterations", "1",
+                                    "--run-root", str(tmp_path),
+                                    "--run-name", "smoke"])
+        assert "implied reseed guard is disabled" in capsys.readouterr().out
+
+    def test_incompatible_eval_cadence_auto_disables(self, tmp_path,
+                                                     monkeypatch, capsys):
+        """An eval cadence the guard can't use (no eval at or before the
+        deadline) auto-disables the IMPLIED guard with a note — it must
+        not turn into the explicit flag's hard error."""
+        def boom(*a, **k):
+            raise AssertionError("threshold must not be computed")
+
+        monkeypatch.setattr(
+            "rl_scheduler_tpu.agent.evaluate.best_node_baseline_reward",
+            boom)
+        cli.main(self.TINY_FLEET + ["--iterations", "40",
+                                    "--eval-every", "32",
+                                    "--run-root", str(tmp_path),
+                                    "--run-name", "cadence"])
+        assert "implied reseed guard is disabled" in capsys.readouterr().out
+
+    def test_explicit_zero_respected(self, tmp_path, monkeypatch, capsys):
+        def boom(*a, **k):
+            raise AssertionError("threshold must not be computed")
+
+        monkeypatch.setattr(
+            "rl_scheduler_tpu.agent.evaluate.best_node_baseline_reward",
+            boom)
+        cli.main(self.TINY_FLEET + ["--iterations", "20",
+                                    "--reseed-on-stall", "0",
+                                    "--run-root", str(tmp_path),
+                                    "--run-name", "off"])
+        out = capsys.readouterr().out
+        assert "implies --reseed-on-stall" not in out
+
+    def test_resume_auto_disables(self, tmp_path, monkeypatch, capsys):
+        cli.main(self.TINY_FLEET + ["--iterations", "1",
+                                    "--checkpoint-every", "1",
+                                    "--run-root", str(tmp_path),
+                                    "--run-name", "res"])
+
+        def boom(*a, **k):
+            raise AssertionError("threshold must not be computed")
+
+        monkeypatch.setattr(
+            "rl_scheduler_tpu.agent.evaluate.best_node_baseline_reward",
+            boom)
+        cli.main(self.TINY_FLEET + ["--iterations", "20", "--resume",
+                                    "--checkpoint-every", "1",
+                                    "--run-root", str(tmp_path),
+                                    "--run-name", "res"])
+        assert "implied reseed guard is disabled" in capsys.readouterr().out
+
+
 def test_best_node_baseline_reward_is_best():
     """The threshold helper returns the max over the three node
     baselines (the value the guard compares evals against)."""
