@@ -20,6 +20,10 @@ Usage::
         --minibatch 8192 --variants flax_bf16,fused
     python loadgen/set_scale_bench.py --nodes 8,16,32,64,128,256 \
         --scale-envs 65536 --variants flax_bf16   # scaling curve
+    python loadgen/set_scale_bench.py --nodes 64 --envs 1024 \
+        --minibatch 12800 --variants flax_bf16,fused_block
+        # the fused-block A/B at the set_fleet64 recipe (run ON TPU:
+        # off-chip the kernel interprets and the timing is meaningless)
 
 Prints one JSON line per (nodes, variant): per-update ms, env-steps/s,
 and the window times it derives from.
@@ -59,7 +63,16 @@ def build_update(nodes: int, envs: int, minibatch: int, epochs: int,
     bundle = cluster_set_bundle(cs.make_params(num_nodes=nodes))
     fused_impls = {"fused": None, "fused_chunked": "chunked",
                    "fused_matmul": "matmul"}
-    if variant in fused_impls:
+    if variant == "fused_block":
+        # The whole-network fused Pallas kernel (ops/pallas_set_block.py)
+        # — the --fused-set-block path the fleet presets auto-select on
+        # TPU. Off-chip this runs interpret mode: numerically the same
+        # path, but its timing measures the interpreter, not the chip.
+        from rl_scheduler_tpu.models.set_fast import FusedBlockSetPolicy
+
+        net = FusedBlockSetPolicy(num_nodes=nodes, dim=64, depth=2,
+                                  dtype=jnp.bfloat16)
+    elif variant in fused_impls:
         from rl_scheduler_tpu.models.set_fast import BatchMinorSetPolicy
 
         # "fused" = auto attention formulation (by node count);

@@ -170,10 +170,17 @@ PRESET_IMPLIES: dict[str, dict] = {
     # reseed_on_stall when the user left it unset AND the run is long
     # enough for the stall deadline to fire (auto-disabled with an info
     # line otherwise — smoke runs with --iterations 1 stay valid).
+    # fused_set_block "tpu": the whole-network fused kernel
+    # (ops/pallas_set_block.py) is auto-selected ON TPU at fleet N —
+    # where the round-5 roofline rows put the ~65-op XLA body at
+    # 8.9-12.4% of its HBM floor — and stays off elsewhere (off-chip the
+    # kernel runs interpret mode: correct but slow; dense XLA is the
+    # fallback). An explicit --fused-set-block/--fused-set/--flash-attn/
+    # --sp or a non-fleet --num-nodes override disables the implication.
     "set_fleet64": {"env": "cluster_set", "num_nodes": 64,
-                    "reseed_on_stall": 2},
+                    "reseed_on_stall": 2, "fused_set_block": "tpu"},
     "set_fleet256": {"env": "cluster_set", "num_nodes": 256,
-                     "reseed_on_stall": 2},
+                     "reseed_on_stall": 2, "fused_set_block": "tpu"},
 }
 
 DQN_PRESETS: dict[str, DQNConfig] = {

@@ -100,7 +100,8 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
                         fused_gnn: bool = False,
                         fused_set: bool = False,
                         num_nodes: int | None = None,
-                        flash_attn: bool = False):
+                        flash_attn: bool = False,
+                        fused_set_block: bool = False):
     """``(bundle, net)`` for each BASELINE env family.
 
     ``net=None`` means the default flat-obs ActorCritic; the set/graph envs
@@ -110,6 +111,9 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
     the cluster_set policy for the batch-minor fast path
     (``models/set_fast.py`` — same checkpoint tree, ~1.7x the honest
     end-to-end update throughput at tpu4096, see docs/status.md).
+    ``fused_set_block`` swaps it for the whole-network fused Pallas
+    kernel (``ops/pallas_set_block.py`` — same checkpoint tree, fleet
+    node counts only; the fleet presets auto-select it on TPU).
     ``num_nodes`` sizes the structured envs' node set (default 8, the
     small-cluster regime). The set/GNN policies share per-node weights,
     so one checkpoint applies at any N — the env size is a training-
@@ -140,6 +144,15 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
         set_params = cs.make_params(
             **({} if num_nodes is None else {"num_nodes": num_nodes})
         )
+        if fused_set_block:
+            from rl_scheduler_tpu.models.set_fast import FusedBlockSetPolicy
+
+            # Shape-specialized kernel: built at the env's actual node
+            # count (constructor refuses non-fleet N with the pointer to
+            # the dense path).
+            return cluster_set_bundle(set_params), FusedBlockSetPolicy(
+                num_nodes=set_params.num_nodes, dim=64, depth=2, dtype=dtype,
+            )
         if fused_set:
             from rl_scheduler_tpu.models.set_fast import BatchMinorSetPolicy
 
@@ -254,6 +267,17 @@ def main(argv: list[str] | None = None) -> Path:
                         "by default (override with --compute-dtype "
                         "float32); ~1.7x honest end-to-end throughput at "
                         "tpu4096")
+    p.add_argument("--fused-set-block", action="store_true",
+                   help="cluster_set at fleet node counts (>= 32, "
+                        "multiple of 8) only: run the set policy through "
+                        "the whole-network fused Pallas kernel "
+                        "(ops/pallas_set_block.py): embed + blocks + "
+                        "heads VMEM-resident per row block, identical "
+                        "function and checkpoint tree. The fleet presets "
+                        "auto-select this on TPU (off-chip it runs "
+                        "interpret mode: correct but slow). Single-head "
+                        "only; incompatible with --fused-set/"
+                        "--flash-attn/--sp")
     p.add_argument("--flash-attn", action="store_true",
                    help="cluster_set only: run the set policy's attention "
                         "through the Pallas TPU flash kernel "
@@ -347,6 +371,32 @@ def main(argv: list[str] | None = None) -> Path:
     from rl_scheduler_tpu.parallel import maybe_initialize_distributed
 
     maybe_initialize_distributed()  # no-op unless multi-host coords are set
+
+    if implied.get("fused_set_block") == "tpu" and not args.fused_set_block:
+        # Fleet presets auto-select the whole-network fused kernel ON TPU
+        # (where the round-5 roofline rows measured the XLA body an order
+        # off its HBM floor). The implication yields to anything that
+        # contradicts it: another policy path, a node-axis sharding
+        # (--sp), a non-fleet --num-nodes override, or --resume (resumes
+        # keep the checkpoint's recorded path — pass --fused-set-block
+        # explicitly to resume a fused-block run). This platform probe
+        # touches the backend, so it must stay AFTER
+        # maybe_initialize_distributed() — jax.distributed refuses to
+        # initialize once a backend exists.
+        from rl_scheduler_tpu.ops.gae import default_platform
+        from rl_scheduler_tpu.ops.pallas_set_block import is_fleet_node_count
+
+        nodes = args.num_nodes if args.num_nodes is not None else 8
+        eligible = (default_platform() == "tpu"
+                    and not (args.fused_set or args.flash_attn)
+                    and args.sp == 1 and not args.resume
+                    and args.num_heads in (None, 1)
+                    and is_fleet_node_count(nodes))
+        if eligible:
+            args.fused_set_block = True
+            print(f"Preset {args.preset} implies --fused-set-block on TPU "
+                  "(whole-network fused kernel; identical checkpoints — "
+                  "train without it by picking the flags explicitly)")
 
     import dataclasses
 
@@ -468,6 +518,55 @@ def main(argv: list[str] | None = None) -> Path:
             # The fast path's measured win includes bf16 block compute;
             # make it the default unless the user pins a dtype.
             cfg = dataclasses.replace(cfg, compute_dtype="bfloat16")
+    if args.fused_set_block:
+        if args.env != "cluster_set":
+            raise SystemExit(
+                f"--fused-set-block selects the fused set-transformer "
+                f"kernel; it has no meaning for --env {args.env}"
+            )
+        if args.fused_set:
+            raise SystemExit(
+                "--fused-set-block and --fused-set are different "
+                "cluster_set fast paths (whole-network Pallas kernel vs "
+                "batch-minor XLA formulation); pick one"
+            )
+        if args.flash_attn:
+            raise SystemExit(
+                "--fused-set-block fuses its own attention in-kernel; "
+                "--flash-attn needs the flax policy's attention seam "
+                "(drop one)"
+            )
+        if args.num_heads is not None and args.num_heads != 1:
+            raise SystemExit(
+                f"--fused-set-block is single-head; --num-heads "
+                f"{args.num_heads} needs the flax policy (drop "
+                "--fused-set-block)"
+            )
+        from rl_scheduler_tpu.ops.pallas_set_block import (
+            MIN_FLEET_NODES,
+            is_fleet_node_count,
+        )
+
+        fb_nodes = args.num_nodes if args.num_nodes is not None else 8
+        if not is_fleet_node_count(fb_nodes):
+            if fb_nodes < MIN_FLEET_NODES:
+                hint = ("below the fleet floor, where the hand-fused "
+                        "kernel measured 3-5x WORSE than XLA "
+                        "(docs/roofline.md) — use --fused-set or the "
+                        "flax default there")
+            else:
+                hint = ("not a multiple of 8 (the kernel's sublane "
+                        "tile) — round the node count, e.g. "
+                        f"{fb_nodes + (-fb_nodes) % 8}")
+            raise SystemExit(
+                f"--fused-set-block targets fleet node counts (multiples "
+                f"of 8, >= {MIN_FLEET_NODES}); --num-nodes {fb_nodes} is "
+                f"{hint}"
+            )
+        if args.compute_dtype is None:
+            # Same measured-recipe default as --fused-set: bf16 block
+            # compute (LN stats / softmax / heads stay f32 in-kernel).
+            cfg = dataclasses.replace(cfg, compute_dtype="bfloat16")
     if args.dp != 1 or args.sp != 1 or args.tp != 1:
         # Full validation here, BEFORE the run directory is created: every
         # bad flag combination in this CLI exits with an actionable message
@@ -504,6 +603,13 @@ def main(argv: list[str] | None = None) -> Path:
                     "--fused-set is the single-chip batch-minor path; "
                     "sequence parallelism needs the flax policy's ring "
                     "attention (drop one of the flags)"
+                )
+            if args.fused_set_block:
+                raise SystemExit(
+                    "--fused-set-block is the single-chip fused kernel "
+                    "(whole node axis in VMEM); sequence parallelism "
+                    "needs the flax policy's ring attention (drop one of "
+                    "the flags)"
                 )
             if args.flash_attn:
                 raise SystemExit(
@@ -619,7 +725,8 @@ def main(argv: list[str] | None = None) -> Path:
                                       fused_gnn=args.fused_gnn,
                                       fused_set=args.fused_set,
                                       num_nodes=args.num_nodes,
-                                      flash_attn=args.flash_attn)
+                                      flash_attn=args.flash_attn,
+                                      fused_set_block=args.fused_set_block)
     eval_net = None
     if args.sp > 1:
         # Training net: the bundle's own policy cloned with axis_name="sp"
@@ -713,6 +820,22 @@ def main(argv: list[str] | None = None) -> Path:
                     f"{ckpt_nodes}, or start a fresh run to fine-tune at a "
                     "different node count)"
                 )
+        ckpt_fblock = meta.get("fused_set_block")
+        if ckpt_fblock is not None and bool(ckpt_fblock) != args.fused_set_block:
+            # The checkpoint TREE is identical either way; the guard keeps
+            # the run's recorded recipe identity stable across resumes —
+            # silently switching the policy path mid-run would make the
+            # run's recorded throughput provenance a lie. (The fleet
+            # presets' TPU auto-selection deliberately skips --resume for
+            # the same reason.)
+            raise SystemExit(
+                f"--resume: run was trained with "
+                f"{'--fused-set-block' if ckpt_fblock else 'the dense set path'}; "
+                f"{'pass' if ckpt_fblock else 'drop'} --fused-set-block to "
+                "keep the recorded policy path (checkpoints are "
+                "identical, but the run's recipe identity must not "
+                "switch silently mid-run)"
+            )
         ckpt_legacy = meta.get("legacy_reward_sign")
         if ckpt_legacy is not None and ckpt_legacy != args.legacy_reward_sign:
             raise SystemExit(
@@ -808,6 +931,7 @@ def main(argv: list[str] | None = None) -> Path:
                 # dense [B, N, N] scores never materialize there
                 "fused_gnn": args.fused_gnn,
                 "fused_set": args.fused_set,
+                "fused_set_block": args.fused_set_block,
                 "flash_attn": args.flash_attn,
                 # mesh axes: tp changes the param-tree layout (serving
                 # converts it, parallel/tensor_parallel.py); sp only

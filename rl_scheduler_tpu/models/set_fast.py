@@ -49,6 +49,20 @@ from jax import lax
 _LN_EPS = 1e-6
 
 
+def _validate_single_head(params: dict, who: str, flag: str) -> None:
+    """Reject multi-head parameter trees with an actionable message
+    instead of failing deep inside an einsum/kernel (shared by the
+    batch-minor and fused-block fast paths)."""
+    qk = params["params"]["block_0"]["MultiHeadDotProductAttention_0"][
+        "query"]["kernel"]
+    if qk.ndim == 3 and qk.shape[1] != 1:
+        raise ValueError(
+            f"{who} is single-head; this parameter tree has "
+            f"num_heads={qk.shape[1]} (query kernel {qk.shape}). "
+            f"Re-train with num_heads=1 or drop {flag}."
+        )
+
+
 def _ln_feature(h: jnp.ndarray, ln: dict) -> jnp.ndarray:
     """flax ``nn.LayerNorm`` (fast variance) over the feature axis of a
     batch-minor ``[N, D, B]`` activation.
@@ -219,14 +233,7 @@ class BatchMinorSetPolicy:
         return self.inner.init(key, obs)
 
     def _validate(self, params):
-        qk = params["params"]["block_0"]["MultiHeadDotProductAttention_0"][
-            "query"]["kernel"]
-        if qk.ndim == 3 and qk.shape[1] != 1:
-            raise ValueError(
-                f"BatchMinorSetPolicy is single-head; this parameter tree "
-                f"has num_heads={qk.shape[1]} (query kernel {qk.shape}). "
-                "Re-train with num_heads=1 or drop --fused-set."
-            )
+        _validate_single_head(params, "BatchMinorSetPolicy", "--fused-set")
 
     def apply(self, params, obs):
         from rl_scheduler_tpu.models.heads import apply_with_optional_batch
@@ -237,3 +244,51 @@ class BatchMinorSetPolicy:
                                           self.dtype, self.attn_impl),
             obs,
         )
+
+
+class FusedBlockSetPolicy:
+    """Drop-in for ``SetTransformerPolicy`` (num_heads=1) running the
+    whole-network fused Pallas kernel (``ops/pallas_set_block.py``) — the
+    fleet-N training fast path (``train_ppo --fused-set-block``).
+
+    Where :class:`BatchMinorSetPolicy` re-FORMULATES the network for
+    XLA's per-op execution (the measured N=8 winner), this path re-
+    DISPATCHES it: one kernel per forward/backward with every
+    intermediate VMEM-resident, targeting the fleet shapes (N >= 32)
+    where the [N, dim] tiles are MXU-shaped and the ~65-op XLA body pays
+    an order of magnitude in per-op HBM traffic (docs/roofline.md,
+    round-5 fleet rows). The kernel refuses non-fleet N at construction.
+
+    ``init`` delegates to the flax module so parameter trees (and
+    checkpoints) are identical; ``dtype`` selects the in-kernel matmul
+    precision (``jnp.bfloat16`` for the perf recipe; LayerNorm stats,
+    softmax, and heads stay f32 either way). Single-head only, like the
+    batch-minor path.
+    """
+
+    num_heads = 1  # the train CLI's resume guard reads this
+
+    def __init__(self, num_nodes: int, dim: int = 64, depth: int = 2,
+                 dtype: Any = None, block_b: int | None = None,
+                 interpret: bool | None = None):
+        from rl_scheduler_tpu.models import SetTransformerPolicy
+        from rl_scheduler_tpu.ops.pallas_set_block import make_fused_set_apply
+
+        self.inner = SetTransformerPolicy(dim=dim, depth=depth, num_heads=1)
+        self.num_nodes = num_nodes
+        self.dim = dim
+        self.depth = depth
+        self.dtype = dtype  # compute dtype (mirrors the other policies)
+        self._apply = make_fused_set_apply(
+            num_nodes=num_nodes, dim=dim, depth=depth, block_b=block_b,
+            interpret=interpret,
+            compute_dtype=dtype if dtype is not None else jnp.float32,
+        )
+
+    def init(self, key, obs):
+        return self.inner.init(key, obs)
+
+    def apply(self, params, obs):
+        _validate_single_head(params, "FusedBlockSetPolicy",
+                              "--fused-set-block")
+        return self._apply(params, obs)

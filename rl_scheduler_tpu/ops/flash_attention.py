@@ -37,17 +37,28 @@ import jax.numpy as jnp
 FLASH_MIN_NODES = 128  # default pallas block size; N must divide by it
 
 
-def make_flax_flash_attention_fn():
+def make_flax_flash_attention_fn(kernel_fn=None):
     """An ``attention_fn`` for ``nn.MultiHeadDotProductAttention`` that
     runs the Pallas TPU flash kernel.
 
     flax hands ``query/key/value`` as ``[batch..., seq, heads, head_dim]``
     and expects the same layout back; the kernel wants
     ``[batch, heads, seq, head_dim]``.
+
+    ``kernel_fn``: override for the attention inner, with the KERNEL's
+    calling convention (``fn(q, k, v, sm_scale=...)`` on the folded
+    ``[batch, heads, seq, head_dim]`` layout). The Pallas TPU flash
+    kernel has no CPU/interpret lowering in this JAX version, so the CPU
+    suite injects a dense reference here to pin the wrapper's
+    fold/unfold layout and constraint logic off-chip
+    (``tests/test_fleet.py``); production callers leave it ``None``.
     """
-    from jax.experimental.pallas.ops.tpu.flash_attention import (
-        flash_attention,
-    )
+    if kernel_fn is None:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention,
+        )
+
+        kernel_fn = flash_attention
 
     # bias/mask/dropout_rate are DECLARED (not **kwargs): flax only
     # delivers kwargs whose names appear in the fn's signature, so a
@@ -72,7 +83,7 @@ def make_flax_flash_attention_fn():
             x.reshape((-1,) + x.shape[-3:]), -2, -3
         )
         scale = 1.0 / math.sqrt(query.shape[-1])
-        out = flash_attention(
+        out = kernel_fn(
             fold(query), fold(key), fold(value), sm_scale=scale
         )
         out = jnp.moveaxis(out, -3, -2)

@@ -1,0 +1,586 @@
+"""Fused Pallas TPU kernel for the whole set-transformer policy at FLEET
+node counts (N=64/256) — forward AND backward.
+
+WHY: the fleet-N roofline rows (docs/roofline.md, round 5) measured the
+config-4 SGD body at **8.9-12.4% of its own HBM-bandwidth floor** — 324 ms
+per epoch at N=64 against a 24.6 ms floor — because the ~65-op XLA
+transformer body streams every ``[B, N, dim]`` activation through HBM
+per op. The codebase already proved the cure on a sibling family: the
+kron-flattened fused GNN kernel (``ops/pallas_gnn.py``) holds its whole
+forward VMEM-resident per row block and reaches ~65% MFU. This kernel is
+the same playbook (FlashAttention-style: tile + fuse so intermediates
+never materialize in HBM) applied to the set-transformer block at the
+shapes where it is finally MXU-friendly.
+
+Explicitly NOT the deleted round-2 N=8 lane-slice design: that suite
+fused per-op at shapes that underfill the 8x128 tiles and lost 3-5x to
+XLA (negative result, docs/status.md row 4; docs/roofline.md). Here the
+per-sample activations are ``[64, 64]`` / ``[256, 64]`` — MXU-shaped
+tiles — and the fusion unit is the WHOLE network (embed -> depth x
+(LN + single-head attention + MLP + residuals) -> final LN -> pointer/
+value heads) per block of samples, touching HBM once for the obs in and
+once for logits/value out. The guard below refuses non-fleet N rather
+than silently re-entering the measured-bad regime.
+
+HOW: a block of ``block_b`` samples lives as one ``[block_b*N, dim]``
+f32 matrix in VMEM, so every per-node op (LayerNorm, qkv/out/MLP
+projections, heads) is a single 2D MXU matmul; attention runs per sample
+inside a ``fori_loop`` over the block (``[N, dim] x [dim, N]`` scores,
+f32 softmax, ``[N, N] x [N, dim]`` context — 2D only, no batched 3D
+ops, which keeps the Mosaic lowering simple). The value head's per-
+sample mean-pool is a matmul against a block-diagonal ``1/N`` matrix
+built from ``broadcasted_iota`` — again 2D. The backward kernel
+recomputes the forward from the obs block in VMEM (in-kernel remat — the
+whole point is never re-reading stored activations from HBM) and
+accumulates parameter gradients across the sequential TPU grid, exactly
+the ``pallas_gnn`` accumulator pattern. Wrapped in ``jax.custom_vjp`` so
+the PPO loss differentiates straight through.
+
+Parity: computes the IDENTICAL function (f32, tolerance-level — float
+reassociation only) to ``SetTransformerPolicy(num_heads=1)`` /
+``models/set_fast.py`` on the same flax parameter tree: flax LayerNorm
+fast-variance semantics (eps 1e-6), approximate-tanh gelu, softmax over
+the key axis in f32, heads in f32. Checkpoints are interchangeable.
+Runs in interpret mode on CPU so tests cover the same code path without
+a TPU (``tests/test_pallas_set_block.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# The fleet floor: below this the per-sample [N, dim] tiles underfill the
+# MXU and the round-2/4 negative result applies (hand fusion measured
+# 3-5x WORSE than XLA at N=8, compile failure at N=16) — refuse rather
+# than quietly lose. 32 is the smallest N where a [N, 64] f32 tile spans
+# 4 full sublane groups; the measured fleet recipes are 64 and 256.
+MIN_FLEET_NODES = 32
+
+def is_fleet_node_count(num_nodes: int) -> bool:
+    """The kernel's shape constraint, in one place: fleet node counts are
+    multiples of 8 (sublane tile) at or above :data:`MIN_FLEET_NODES`.
+    The train CLI's auto-selection and validation both call this so they
+    cannot drift from the constructor's own guard."""
+    return num_nodes >= MIN_FLEET_NODES and num_nodes % 8 == 0
+
+
+# Rows (= block_b * num_nodes) per grid step. The backward kernel keeps
+# ~12 live [rows, dim] f32 activations plus [rows, 2*dim] MLP tensors and
+# the grad accumulators; 1024 rows x dim 64 keeps it ~6 MB of the ~16 MB
+# VMEM budget.
+DEFAULT_BLOCK_ROWS = 1024
+
+_LN_EPS = 1e-6
+# jax.nn.gelu(approximate=True) constants — the backward needs the
+# analytic derivative of the tanh approximation.
+_GELU_C = 0.7978845608028654  # sqrt(2/pi)
+_GELU_A = 0.044715
+
+# Packed-parameter layout (all leaves 2D f32, in this order):
+#   [we, be] + per block [ln0_s, ln0_b, wq, bq, wk, bk, wv, bv, wo, bo,
+#                         ln1_s, ln1_b, w1, b1, w2, b2]
+#   + [lnf_s, lnf_b, wsc, bsc, wv1, bv1, wv2, bv2]
+_PER_BLOCK = 16
+_TAIL = 8
+
+
+def _n_leaves(depth: int) -> int:
+    return 2 + _PER_BLOCK * depth + _TAIL
+
+
+def _squeeze_head(leaf: jnp.ndarray) -> jnp.ndarray:
+    """flax single-head DenseGeneral axis: ``[D, 1, D]`` (q/k/v) or
+    ``[1, D, D]`` (out) -> ``[D, D]`` (same squeeze as set_fast._w2)."""
+    if leaf.ndim == 3:
+        if leaf.shape[0] == 1:
+            return leaf.reshape(-1, leaf.shape[-1])
+        if leaf.shape[1] == 1:
+            return leaf.reshape(leaf.shape[0], -1)
+    return leaf
+
+
+def _pack_params(p: dict, depth: int) -> list:
+    """flax ``SetTransformerPolicy(num_heads=1)`` param tree -> the flat
+    2D f32 leaf list the kernels consume (order above)."""
+
+    def f32(x):
+        return _squeeze_head(x).astype(jnp.float32)
+
+    def row(x):
+        return x.astype(jnp.float32).reshape(1, -1)
+
+    out = [f32(p["embed"]["kernel"]), row(p["embed"]["bias"])]
+    for i in range(depth):
+        b = p[f"block_{i}"]
+        attn = b["MultiHeadDotProductAttention_0"]
+        out += [row(b["LayerNorm_0"]["scale"]), row(b["LayerNorm_0"]["bias"])]
+        for name in ("query", "key", "value", "out"):
+            out += [f32(attn[name]["kernel"]), row(attn[name]["bias"])]
+        out += [row(b["LayerNorm_1"]["scale"]), row(b["LayerNorm_1"]["bias"]),
+                f32(b["Dense_0"]["kernel"]), row(b["Dense_0"]["bias"]),
+                f32(b["Dense_1"]["kernel"]), row(b["Dense_1"]["bias"])]
+    out += [row(p["final_norm"]["scale"]), row(p["final_norm"]["bias"])]
+    head = p["head"]
+    out += [f32(head["score_head"]["kernel"]), row(head["score_head"]["bias"]),
+            f32(head["value_hidden"]["kernel"]),
+            row(head["value_hidden"]["bias"]),
+            f32(head["value_head"]["kernel"]), row(head["value_head"]["bias"])]
+    return out
+
+
+def _unpack_grads(p: dict, flat: list, depth: int) -> dict:
+    """Flat gradient list (packed order) -> the flax param tree, restoring
+    the DenseGeneral head axes and 1D bias/LN shapes."""
+    it = iter(flat)
+
+    def like(ref):
+        return next(it).reshape(ref.shape).astype(ref.dtype)
+
+    out = {"embed": {"kernel": like(p["embed"]["kernel"]),
+                     "bias": like(p["embed"]["bias"])}}
+    for i in range(depth):
+        b = p[f"block_{i}"]
+        attn = b["MultiHeadDotProductAttention_0"]
+        blk = {"LayerNorm_0": {"scale": like(b["LayerNorm_0"]["scale"]),
+                               "bias": like(b["LayerNorm_0"]["bias"])}}
+        mhdpa = {}
+        for name in ("query", "key", "value", "out"):
+            mhdpa[name] = {"kernel": like(attn[name]["kernel"]),
+                           "bias": like(attn[name]["bias"])}
+        blk["MultiHeadDotProductAttention_0"] = mhdpa
+        blk["LayerNorm_1"] = {"scale": like(b["LayerNorm_1"]["scale"]),
+                              "bias": like(b["LayerNorm_1"]["bias"])}
+        blk["Dense_0"] = {"kernel": like(b["Dense_0"]["kernel"]),
+                          "bias": like(b["Dense_0"]["bias"])}
+        blk["Dense_1"] = {"kernel": like(b["Dense_1"]["kernel"]),
+                          "bias": like(b["Dense_1"]["bias"])}
+        out[f"block_{i}"] = blk
+    out["final_norm"] = {"scale": like(p["final_norm"]["scale"]),
+                         "bias": like(p["final_norm"]["bias"])}
+    head = p["head"]
+    out["head"] = {
+        "score_head": {"kernel": like(head["score_head"]["kernel"]),
+                       "bias": like(head["score_head"]["bias"])},
+        "value_hidden": {"kernel": like(head["value_hidden"]["kernel"]),
+                         "bias": like(head["value_hidden"]["bias"])},
+        "value_head": {"kernel": like(head["value_head"]["kernel"]),
+                       "bias": like(head["value_head"]["bias"])},
+    }
+    return out
+
+
+# ------------------------------------------------------- in-kernel math
+
+
+def _mm(a, b, dt):
+    return jnp.dot(a.astype(dt), b.astype(dt),
+                   preferred_element_type=jnp.float32)
+
+
+def _mm_nt(a, b, dt):
+    """``a @ b.T`` contracting the trailing axes — no materialized
+    transpose."""
+    return jax.lax.dot_general(a.astype(dt), b.astype(dt),
+                               (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _mm_tn(a, b, dt):
+    """``a.T @ b`` contracting the leading (row) axes."""
+    return jax.lax.dot_general(a.astype(dt), b.astype(dt),
+                               (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _ln_fwd(h, scale_row, bias_row):
+    """flax ``nn.LayerNorm`` fast-variance forward, f32, over the feature
+    (lane) axis of ``[rows, dim]``."""
+    mean = jnp.mean(h, axis=1, keepdims=True)
+    var = jnp.maximum(jnp.mean(h * h, axis=1, keepdims=True) - mean * mean,
+                      0.0)
+    inv = jax.lax.rsqrt(var + _LN_EPS)
+    return (h - mean) * inv * scale_row + bias_row
+
+
+def _ln_bwd(x, scale_row, dy):
+    """Analytic LayerNorm backward (biased variance): returns
+    ``(dx, dscale [1, D], dbias [1, D])``."""
+    mean = jnp.mean(x, axis=1, keepdims=True)
+    var = jnp.maximum(jnp.mean(x * x, axis=1, keepdims=True) - mean * mean,
+                      0.0)
+    inv = jax.lax.rsqrt(var + _LN_EPS)
+    xhat = (x - mean) * inv
+    dscale = jnp.sum(dy * xhat, axis=0, keepdims=True)
+    dbias = jnp.sum(dy, axis=0, keepdims=True)
+    dxhat = dy * scale_row
+    dx = inv * (dxhat - jnp.mean(dxhat, axis=1, keepdims=True)
+                - xhat * jnp.mean(dxhat * xhat, axis=1, keepdims=True))
+    return dx, dscale, dbias
+
+
+def _gelu_grad(z):
+    """d/dz of jax.nn.gelu(z, approximate=True)."""
+    u = _GELU_C * (z + _GELU_A * z * z * z)
+    t = jnp.tanh(u)
+    return (0.5 * (1.0 + t)
+            + 0.5 * z * (1.0 - t * t)
+            * _GELU_C * (1.0 + 3.0 * _GELU_A * z * z))
+
+
+def _attn_fwd(q, k, v, num_nodes, block_b, dt):
+    """Per-sample single-head attention over a ``[block_b*N, dim]`` block:
+    ``fori_loop`` over samples, 2D matmuls only, f32 softmax over keys."""
+    scale = q.shape[-1] ** -0.5
+
+    def body(b, ctx):
+        def sl(a):
+            return jax.lax.dynamic_slice_in_dim(a, b * num_nodes, num_nodes, 0)
+
+        qb, kb, vb = sl(q), sl(k), sl(v)
+        s = _mm_nt(qb, kb, dt) * scale          # [N, N] f32
+        p_att = jax.nn.softmax(s, axis=-1)      # over keys, f32
+        cb = _mm(p_att, vb, dt)
+        return jax.lax.dynamic_update_slice(ctx, cb, (b * num_nodes, 0))
+
+    return jax.lax.fori_loop(0, block_b, body, jnp.zeros_like(q))
+
+
+def _attn_bwd(q, k, v, dctx, num_nodes, block_b, dt):
+    """Backward of :func:`_attn_fwd`: recompute scores/probs per sample
+    (cheap, VMEM-resident) and backprop the softmax-attention chain."""
+    scale = q.shape[-1] ** -0.5
+
+    def body(b, carry):
+        dq, dk, dv = carry
+
+        def sl(a):
+            return jax.lax.dynamic_slice_in_dim(a, b * num_nodes, num_nodes, 0)
+
+        qb, kb, vb, dcb = sl(q), sl(k), sl(v), sl(dctx)
+        s = _mm_nt(qb, kb, dt) * scale
+        p_att = jax.nn.softmax(s, axis=-1)
+        dvb = _mm_tn(p_att, dcb, dt)            # [N(keys), dim]
+        dp = _mm_nt(dcb, vb, dt)                # [N(q), N(keys)]
+        ds = (dp - jnp.sum(dp * p_att, axis=-1, keepdims=True)) \
+            * p_att * scale
+        dqb = _mm(ds, kb, dt)
+        dkb = _mm_tn(ds, qb, dt)
+
+        def up(acc, val):
+            return jax.lax.dynamic_update_slice(acc, val, (b * num_nodes, 0))
+
+        return up(dq, dqb), up(dk, dkb), up(dv, dvb)
+
+    zeros = jnp.zeros_like(q)
+    return jax.lax.fori_loop(0, block_b, body, (zeros, zeros, zeros))
+
+
+def _pool_matrix(block_b, num_nodes):
+    """Block-diagonal ``[block_b, block_b*N]`` mean-pool matrix (1/N where
+    row r belongs to sample i) — the per-sample node mean as one 2D
+    matmul, no 3D reshapes in the kernel."""
+    rows = block_b * num_nodes
+    owner = jax.lax.broadcasted_iota(jnp.int32, (block_b, rows), 1) // num_nodes
+    sample = jax.lax.broadcasted_iota(jnp.int32, (block_b, rows), 0)
+    return jnp.where(owner == sample, 1.0 / num_nodes, 0.0).astype(jnp.float32)
+
+
+# --------------------------------------------------------------- kernels
+
+
+def _forward_body(obs, p_vals, *, depth, num_nodes, block_b, dt,
+                  with_saves: bool):
+    """Shared forward chain. ``p_vals`` is the packed leaf list (values,
+    already read from refs). Returns ``(logits_col, value, saves)`` where
+    ``saves`` holds the per-layer residuals the backward needs (None
+    entries when ``with_saves`` is False)."""
+    it = iter(p_vals)
+    nxt = lambda: next(it)
+
+    we, be = nxt(), nxt()
+    h = _mm(obs, we, dt) + be                     # linear embed, [R, D] f32
+    saves = []
+    for _ in range(depth):
+        ln0s, ln0b = nxt(), nxt()
+        wq, bq, wk, bk, wv, bv, wo, bo = (nxt() for _ in range(8))
+        ln1s, ln1b, w1, b1, w2, b2 = (nxt() for _ in range(6))
+        h_in = h
+        hn = _ln_fwd(h, ln0s, ln0b)
+        q = _mm(hn, wq, dt) + bq
+        k = _mm(hn, wk, dt) + bk
+        v = _mm(hn, wv, dt) + bv
+        ctx = _attn_fwd(q, k, v, num_nodes, block_b, dt)
+        h_mid = h_in + _mm(ctx, wo, dt) + bo
+        m = _ln_fwd(h_mid, ln1s, ln1b)
+        z1 = _mm(m, w1, dt) + b1
+        g1 = jax.nn.gelu(z1)
+        h = h_mid + _mm(g1, w2, dt) + b2
+        saves.append((h_in, hn, q, k, v, ctx, h_mid, m, z1, g1)
+                     if with_saves else None)
+
+    lnfs, lnfb = nxt(), nxt()
+    wsc, bsc, wv1, bv1, wv2, bv2 = (nxt() for _ in range(6))
+    hf = _ln_fwd(h, lnfs, lnfb)
+    # Heads stay f32 (same contract as set_fast / pallas_gnn: near-zero
+    # pointer logits and value targets are precision-sensitive).
+    logits_col = _mm(hf, wsc, jnp.float32) + bsc          # [R, 1]
+    pool = _pool_matrix(block_b, num_nodes)
+    pooled = _mm(pool, hf, jnp.float32)                   # [blk, D]
+    v1 = jnp.tanh(_mm(pooled, wv1, jnp.float32) + bv1)
+    value = _mm(v1, wv2, jnp.float32) + bv2               # [blk, 1]
+    return logits_col, value, (h, hf, pool, pooled, v1, saves)
+
+
+def _fwd_kernel(*refs, depth, num_nodes, block_b, compute_dtype):
+    n_p = _n_leaves(depth)
+    obs = refs[0][:]
+    p_vals = [r[:] for r in refs[1:1 + n_p]]
+    logits_ref, value_ref = refs[1 + n_p], refs[2 + n_p]
+    logits_col, value, _ = _forward_body(
+        obs, p_vals, depth=depth, num_nodes=num_nodes, block_b=block_b,
+        dt=compute_dtype, with_saves=False)
+    logits_ref[:] = logits_col
+    value_ref[:] = value
+
+
+def _bwd_kernel(*refs, depth, num_nodes, block_b, compute_dtype):
+    n_p = _n_leaves(depth)
+    obs = refs[0][:]
+    p_vals = [r[:] for r in refs[1:1 + n_p]]
+    dlog = refs[1 + n_p][:]                      # [R, 1] f32
+    dval = refs[2 + n_p][:]                      # [blk, 1] f32
+    grad_refs = refs[3 + n_p:3 + 2 * n_p]
+    dt = compute_dtype
+
+    # Zero accumulators on the first grid step; TPU grid steps run
+    # sequentially on the core, so plain += accumulation is race-free.
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        for r in grad_refs:
+            r[:] = jnp.zeros_like(r)
+
+    # In-kernel remat: recompute the whole forward for this block in VMEM.
+    _, _, (h_last, hf, pool, pooled, v1, saves) = _forward_body(
+        obs, p_vals, depth=depth, num_nodes=num_nodes, block_b=block_b,
+        dt=dt, with_saves=True)
+
+    it = iter(p_vals)
+    we, be = next(it), next(it)
+    blocks = [[next(it) for _ in range(_PER_BLOCK)] for _ in range(depth)]
+    lnfs, lnfb = next(it), next(it)
+    wsc, bsc, wv1, bv1, wv2, bv2 = (next(it) for _ in range(6))
+
+    f32 = jnp.float32
+    # Value head (all f32, matching the forward).
+    dwv2 = _mm_tn(v1, dval, f32)
+    dbv2 = jnp.sum(dval, axis=0, keepdims=True)
+    dv1 = _mm_nt(dval, wv2, f32)
+    dzv1 = dv1 * (1.0 - v1 * v1)
+    dwv1 = _mm_tn(pooled, dzv1, f32)
+    dbv1 = jnp.sum(dzv1, axis=0, keepdims=True)
+    dpooled = _mm_nt(dzv1, wv1, f32)
+    # Pointer head + pool both feed the final-norm output.
+    dwsc = _mm_tn(hf, dlog, f32)
+    dbsc = jnp.sum(dlog, axis=0, keepdims=True)
+    dhf = _mm_nt(dlog, wsc, f32) + _mm_tn(pool, dpooled, f32)
+    dh, dlnfs, dlnfb = _ln_bwd(h_last, lnfs, dhf)
+
+    block_grads = []
+    for i in range(depth - 1, -1, -1):
+        (ln0s, ln0b, wq, bq, wk, bk, wv, bv, wo, bo,
+         ln1s, ln1b, w1, b1, w2, b2) = blocks[i]
+        h_in, hn, q, k, v, ctx, h_mid, m, z1, g1 = saves[i]
+        # MLP branch: h_out = h_mid + gelu(LN1(h_mid) @ w1 + b1) @ w2 + b2
+        dw2 = _mm_tn(g1, dh, dt)
+        db2 = jnp.sum(dh, axis=0, keepdims=True)
+        dg1 = _mm_nt(dh, w2, dt)
+        dz1 = dg1 * _gelu_grad(z1)
+        dw1 = _mm_tn(m, dz1, dt)
+        db1 = jnp.sum(dz1, axis=0, keepdims=True)
+        dm = _mm_nt(dz1, w1, dt)
+        dm_h, dln1s, dln1b = _ln_bwd(h_mid, ln1s, dm)
+        dh_mid = dh + dm_h
+        # Attention branch: h_mid = h_in + attn(LN0(h_in)) @ wo + bo
+        dwo = _mm_tn(ctx, dh_mid, dt)
+        dbo = jnp.sum(dh_mid, axis=0, keepdims=True)
+        dctx = _mm_nt(dh_mid, wo, dt)
+        dq, dk, dv_ = _attn_bwd(q, k, v, dctx, num_nodes, block_b, dt)
+        dwq = _mm_tn(hn, dq, dt)
+        dbq = jnp.sum(dq, axis=0, keepdims=True)
+        dwk = _mm_tn(hn, dk, dt)
+        dbk = jnp.sum(dk, axis=0, keepdims=True)
+        dwv = _mm_tn(hn, dv_, dt)
+        dbv = jnp.sum(dv_, axis=0, keepdims=True)
+        dhn = (_mm_nt(dq, wq, dt) + _mm_nt(dk, wk, dt)
+               + _mm_nt(dv_, wv, dt))
+        dhn_h, dln0s, dln0b = _ln_bwd(h_in, ln0s, dhn)
+        dh = dh_mid + dhn_h
+        block_grads.insert(0, [dln0s, dln0b, dwq, dbq, dwk, dbk, dwv, dbv,
+                               dwo, dbo, dln1s, dln1b, dw1, db1, dw2, db2])
+
+    dwe = _mm_tn(obs, dh, dt)
+    dbe = jnp.sum(dh, axis=0, keepdims=True)
+
+    step_grads = [dwe, dbe]
+    for g in block_grads:
+        step_grads += g
+    step_grads += [dlnfs, dlnfb, dwsc, dbsc, dwv1, dbv1, dwv2, dbv2]
+    for r, g in zip(grad_refs, step_grads):
+        r[:] += g
+
+
+# ------------------------------------------------------------ entry point
+
+
+def _full_spec():
+    return pl.BlockSpec(memory_space=pltpu.VMEM)
+
+
+def _run_forward(flat, obs_flat, num_nodes, depth, block_b, interpret, dt):
+    rtot, feat = obs_flat.shape
+    rows = block_b * num_nodes
+    bpad = rtot // num_nodes
+
+    def row_spec(cols, r=rows):
+        return pl.BlockSpec((r, cols), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, depth=depth, num_nodes=num_nodes,
+                          block_b=block_b, compute_dtype=dt),
+        grid=(rtot // rows,),
+        in_specs=[row_spec(feat)] + [_full_spec()] * len(flat),
+        out_specs=[row_spec(1), row_spec(1, block_b)],
+        out_shape=[jax.ShapeDtypeStruct((rtot, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((bpad, 1), jnp.float32)],
+        interpret=interpret,
+    )(obs_flat, *flat)
+
+
+def _run_backward(flat, obs_flat, dlog, dval, num_nodes, depth, block_b,
+                  interpret, dt):
+    rtot, feat = obs_flat.shape
+    rows = block_b * num_nodes
+
+    def row_spec(cols, r=rows):
+        return pl.BlockSpec((r, cols), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+
+    # Accumulator outputs: every grid step maps to the same (whole-array)
+    # block; the kernel zero-initializes on step 0 and += thereafter.
+    def acc_spec(shape):
+        return pl.BlockSpec(shape, lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+
+    return pl.pallas_call(
+        functools.partial(_bwd_kernel, depth=depth, num_nodes=num_nodes,
+                          block_b=block_b, compute_dtype=dt),
+        grid=(rtot // rows,),
+        in_specs=[row_spec(feat)] + [_full_spec()] * len(flat)
+        + [row_spec(1), row_spec(1, block_b)],
+        out_specs=[acc_spec(f.shape) for f in flat],
+        out_shape=[jax.ShapeDtypeStruct(f.shape, jnp.float32) for f in flat],
+        interpret=interpret,
+    )(obs_flat, *flat, dlog, dval)
+
+
+def make_fused_set_apply(
+    num_nodes: int,
+    dim: int = 64,
+    depth: int = 2,
+    block_b: int | None = None,
+    interpret: bool | None = None,
+    compute_dtype: Any = jnp.float32,
+):
+    """Build ``apply(params, obs) -> (logits, value)`` running the fused
+    whole-network kernels, differentiable via ``jax.custom_vjp``.
+
+    ``params`` is a ``SetTransformerPolicy(num_heads=1)`` param tree (the
+    ``{"params": ...}`` dict from ``init``); ``obs`` is ``[B, N, feat]``
+    (or unbatched ``[N, feat]``) with ``N == num_nodes`` — the kernel is
+    shape-specialized to one fleet size. ``compute_dtype=jnp.bfloat16``
+    runs the block matmuls at MXU-native precision with f32 accumulation
+    (LayerNorm statistics, softmax, and heads stay f32 — the set_fast
+    contract). ``block_b`` is samples per grid step (default sized so
+    ``block_b * num_nodes`` ~ :data:`DEFAULT_BLOCK_ROWS`).
+    """
+    if not is_fleet_node_count(num_nodes):
+        raise ValueError(
+            f"fused set-block kernel targets fleet node counts "
+            f"(multiples of 8, >= {MIN_FLEET_NODES}); got num_nodes="
+            f"{num_nodes}. Below the fleet floor the hand-fused kernel "
+            "measured 3-5x WORSE than XLA (docs/roofline.md) — use the "
+            "dense path (--fused-set / the flax policy) there."
+        )
+    if dim % 8:
+        raise ValueError(
+            f"fused set-block kernel needs dim to be a multiple of 8 "
+            f"(sublane tile), got dim={dim}"
+        )
+    if compute_dtype not in (jnp.float32, jnp.bfloat16):
+        raise ValueError(
+            f"fused set-block kernel computes in float32 or bfloat16, "
+            f"got dtype {compute_dtype!r}"
+        )
+    if interpret is None:
+        from rl_scheduler_tpu.ops.gae import default_platform
+
+        interpret = default_platform() != "tpu"
+    if block_b is None:
+        block_b = max(DEFAULT_BLOCK_ROWS // num_nodes, 1)
+
+    @jax.custom_vjp
+    def fused(params, obs_flat):
+        flat = _pack_params(params["params"], depth)
+        return _run_forward(flat, obs_flat, num_nodes, depth, block_b,
+                            interpret, compute_dtype)
+
+    def fused_fwd(params, obs_flat):
+        return fused(params, obs_flat), (params, obs_flat)
+
+    def fused_bwd(res, cotangents):
+        params, obs_flat = res
+        dlog, dval = cotangents
+        flat = _pack_params(params["params"], depth)
+        grads = _run_backward(
+            flat, obs_flat, dlog.astype(jnp.float32),
+            dval.astype(jnp.float32), num_nodes, depth, block_b, interpret,
+            compute_dtype,
+        )
+        small = _unpack_grads(params["params"], grads, depth)
+        # Observations are env data, never differentiated; zeros keep
+        # custom_vjp's signature contract (XLA drops the unused cotangent).
+        return {"params": small}, jnp.zeros_like(obs_flat)
+
+    fused.defvjp(fused_fwd, fused_bwd)
+
+    def apply(params, obs):
+        from rl_scheduler_tpu.models.heads import apply_with_optional_batch
+
+        def forward(batched_obs):
+            b, n, feat = batched_obs.shape
+            if n != num_nodes:
+                raise ValueError(
+                    f"fused set-block kernel was built for num_nodes="
+                    f"{num_nodes}; got obs with node axis {n} (rebuild "
+                    "the policy at this N — the kernel is shape-"
+                    "specialized)"
+                )
+            flat = batched_obs.reshape(b * n, feat).astype(jnp.float32)
+            pad = (-b) % block_b
+            if pad:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((pad * n, feat), jnp.float32)], axis=0)
+            logits_col, value = fused(params, flat)
+            logits = logits_col.reshape(-1, num_nodes)[:b]
+            return logits, value[:b, 0]
+
+        return apply_with_optional_batch(forward, obs)
+
+    return apply

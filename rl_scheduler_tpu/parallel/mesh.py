@@ -20,6 +20,23 @@ def device_count() -> int:
     return len(jax.devices())
 
 
+def shard_map_compat(f, mesh: Mesh, in_specs, out_specs):
+    """``jax.shard_map`` with the replication check off, tolerant of the
+    pre-0.5 API surface (``jax.experimental.shard_map`` with its
+    ``check_rep`` spelling of the same flag). The build containers and
+    the bench chips do not always run the same JAX release; tests that
+    must verify sharded-path NUMERICS on both (e.g. the fused-block
+    dp x sp gradient equivalence, ``tests/test_pallas_set_block.py``)
+    shard through this instead of ``jax.shard_map`` directly."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=False)
+
+
 def make_mesh(axes: dict[str, int] | None = None) -> Mesh:
     """Build a mesh from ``{axis_name: size}``; -1 means "all remaining".
 

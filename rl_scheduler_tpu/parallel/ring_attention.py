@@ -31,9 +31,23 @@ from jax import lax
 if hasattr(lax, "pcast"):
     def _to_varying(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
         return lax.pcast(x, axis_name, to="varying")
-else:  # JAX < 0.9: pcast does not exist yet, pvary is the only spelling
+elif hasattr(lax, "pvary"):  # JAX < 0.9: pvary is the only spelling
     def _to_varying(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
         return lax.pvary(x, axis_name)
+else:  # pre-varying-check JAX: everything is already "varying"
+    def _to_varying(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+        return x
+
+if hasattr(lax, "axis_size"):
+    _axis_size = lax.axis_size
+else:  # pre-0.5 spelling: the trace-time axis env carries the size
+    def _axis_size(axis_name: str) -> int:
+        import jax.core as core
+
+        size = core.axis_frame(axis_name)
+        # axis_frame returned the frame object in some 0.4.x point
+        # releases and the bare size in others.
+        return getattr(size, "size", size)
 
 
 def _dense_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -60,7 +74,7 @@ def ring_attention(
     scale = 1.0 / (q.shape[-1] ** 0.5)
     if axis_name is None:
         return _dense_attention(q, k, v, scale)
-    ring = lax.axis_size(axis_name)
+    ring = _axis_size(axis_name)
     if ring == 1:
         return _dense_attention(q, k, v, scale)
 

@@ -1,0 +1,310 @@
+"""Fused whole-network set-transformer kernel (``ops/pallas_set_block.py``).
+
+Parity contract: ``FusedBlockSetPolicy`` computes the IDENTICAL function
+to ``SetTransformerPolicy(num_heads=1)`` at fleet node counts — float32
+forward AND gradients agree with the flax module on the same parameter
+tree (interpret mode on CPU covers the exact kernel code path), so a
+checkpoint trained on either path serves and evaluates on the other.
+Constraint refusals, the CLI round trip with the ``--resume`` meta
+guard, and dp / dp x sp gradient equivalence are pinned here too.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_scheduler_tpu.models import SetTransformerPolicy
+from rl_scheduler_tpu.models.set_fast import FusedBlockSetPolicy
+
+FLEET_N = 64
+
+
+@pytest.fixture(scope="module")
+def nets_and_params():
+    flax_net = SetTransformerPolicy(dim=64, depth=2, num_heads=1)
+    fused_net = FusedBlockSetPolicy(num_nodes=FLEET_N, dim=64, depth=2)
+    params = flax_net.init(jax.random.PRNGKey(3),
+                           jnp.zeros((1, FLEET_N, 6)))
+    return flax_net, fused_net, params
+
+
+def _ppo_style_loss(apply_fn, obs, act):
+    def f(p):
+        logits, value = apply_fn(p, obs)
+        logp = jax.nn.log_softmax(logits)
+        return jnp.mean(jnp.take_along_axis(
+            logp, act[:, None], axis=1)) + jnp.mean(value ** 2)
+    return f
+
+
+def test_forward_parity_f32(nets_and_params):
+    """fwd <= 1e-5 vs the dense flax module at fleet N, with a batch that
+    does NOT divide the kernel's row block (exercises the pad path)."""
+    flax_net, fused_net, params = nets_and_params
+    obs = jax.random.uniform(jax.random.PRNGKey(1), (5, FLEET_N, 6))
+    l0, v0 = flax_net.apply(params, obs)
+    l1, v1 = jax.jit(fused_net.apply)(params, obs)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l0),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v0),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gradient_parity_f32(nets_and_params):
+    """grads <= 1e-4 vs the flax module through a PPO-shaped loss —
+    the custom-VJP remat backward against flax autodiff."""
+    flax_net, fused_net, params = nets_and_params
+    obs = jax.random.uniform(jax.random.PRNGKey(2), (6, FLEET_N, 6))
+    act = jax.random.randint(jax.random.PRNGKey(4), (6,), 0, FLEET_N)
+    g0 = jax.grad(_ppo_style_loss(flax_net.apply, obs, act))(params)
+    g1 = jax.grad(_ppo_style_loss(fused_net.apply, obs, act))(params)
+    for leaf0, leaf1 in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(leaf1), np.asarray(leaf0),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_multi_grid_step_parity_f32(nets_and_params):
+    """Forward AND gradients with the batch spanning SEVERAL grid steps
+    (block_b=2, batch 5 -> 3 steps incl. a padded one): pins the backward
+    kernel's accumulator path — zero-init on program_id 0, += on every
+    later step, whole-array acc_spec indexing — which the production
+    fleet recipes hit with ~800 grid steps per minibatch but single-block
+    batches never touch."""
+    flax_net, _, params = nets_and_params
+    fused_net = FusedBlockSetPolicy(num_nodes=FLEET_N, dim=64, depth=2,
+                                    block_b=2)
+    obs = jax.random.uniform(jax.random.PRNGKey(11), (5, FLEET_N, 6))
+    act = jax.random.randint(jax.random.PRNGKey(12), (5,), 0, FLEET_N)
+    l0, v0 = flax_net.apply(params, obs)
+    l1, v1 = jax.jit(fused_net.apply)(params, obs)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l0),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v0),
+                               rtol=1e-5, atol=1e-5)
+    g0 = jax.grad(_ppo_style_loss(flax_net.apply, obs, act))(params)
+    g1 = jax.grad(_ppo_style_loss(fused_net.apply, obs, act))(params)
+    for leaf0, leaf1 in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(leaf1), np.asarray(leaf0),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_bf16_close_to_f32(nets_and_params):
+    flax_net, _, params = nets_and_params
+    fused_bf16 = FusedBlockSetPolicy(num_nodes=FLEET_N, dim=64, depth=2,
+                                     dtype=jnp.bfloat16)
+    obs = jax.random.uniform(jax.random.PRNGKey(5), (4, FLEET_N, 6))
+    l0, v0 = flax_net.apply(params, obs)
+    l1, v1 = fused_bf16.apply(params, obs)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l0),
+                               rtol=0.05, atol=0.05)
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v0),
+                               rtol=0.05, atol=0.05)
+
+
+def test_unbatched_matches_flax(nets_and_params):
+    flax_net, fused_net, params = nets_and_params
+    obs = jax.random.uniform(jax.random.PRNGKey(6), (FLEET_N, 6))
+    l0, v0 = flax_net.apply(params, obs)
+    l1, v1 = fused_net.apply(params, obs)
+    assert l1.shape == (FLEET_N,) and v1.shape == ()
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l0),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(v1), float(v0), rtol=1e-5, atol=1e-5)
+
+
+def test_permutation_equivariance(nets_and_params):
+    """The fused path inherits the flax module's contract: logits
+    permutation-equivariant, value permutation-invariant."""
+    _, fused_net, params = nets_and_params
+    obs = jax.random.uniform(jax.random.PRNGKey(7), (3, FLEET_N, 6))
+    perm = jax.random.permutation(jax.random.PRNGKey(8), FLEET_N)
+    l0, v0 = fused_net.apply(params, obs)
+    l1, v1 = fused_net.apply(params, obs[:, perm])
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l0)[:, perm],
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v0),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_constraint_refusals():
+    """Shape/dtype guards fire at CONSTRUCTION with actionable messages —
+    the kernel must never silently re-enter the measured-bad N=8 regime
+    or run at an unsupported precision."""
+    from rl_scheduler_tpu.ops.pallas_set_block import make_fused_set_apply
+
+    with pytest.raises(ValueError, match="fleet"):
+        make_fused_set_apply(num_nodes=8)       # the deleted-design regime
+    with pytest.raises(ValueError, match="fleet"):
+        make_fused_set_apply(num_nodes=36)      # not a multiple of 8
+    with pytest.raises(ValueError, match="multiple of 8"):
+        make_fused_set_apply(num_nodes=64, dim=60)
+    with pytest.raises(ValueError, match="float32 or bfloat16"):
+        make_fused_set_apply(num_nodes=64, compute_dtype=jnp.float16)
+
+
+def test_node_count_mismatch_refused(nets_and_params):
+    """The kernel is shape-specialized: applying a policy built at N=64
+    to a 32-node observation is refused, not silently mis-sliced."""
+    _, fused_net, params = nets_and_params
+    with pytest.raises(ValueError, match="num_nodes"):
+        fused_net.apply(params, jnp.zeros((2, 32, 6)))
+
+
+def test_multihead_tree_rejected():
+    multi = SetTransformerPolicy(dim=64, depth=2, num_heads=4)
+    params = multi.init(jax.random.PRNGKey(0), jnp.zeros((1, FLEET_N, 6)))
+    fused = FusedBlockSetPolicy(num_nodes=FLEET_N)
+    with pytest.raises(ValueError, match="num_heads=4"):
+        fused.apply(params, jnp.zeros((2, FLEET_N, 6)))
+
+
+def test_train_cli_fused_set_block_and_resume_guard(tmp_path):
+    """--fused-set-block trains cluster_set end to end at fleet N (tiny
+    overrides, interpret mode on CPU), meta records the path, the saved
+    tree restores onto the FLAX policy with matching outputs, and a
+    resume that silently drops the flag is refused."""
+    import json
+
+    from rl_scheduler_tpu.agent import train_ppo as cli
+    from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+    common = [
+        "--preset", "quick", "--env", "cluster_set", "--num-nodes", "32",
+        "--num-envs", "4", "--rollout-steps", "8", "--minibatch-size", "16",
+        "--num-epochs", "1", "--checkpoint-every", "1",
+        "--run-root", str(tmp_path), "--run-name", "fused_block",
+    ]
+    run_dir = cli.main(common + ["--fused-set-block", "--iterations", "1"])
+    mgr = CheckpointManager(run_dir)
+    assert mgr.latest_step() == 1
+    meta = mgr.restore_meta(1)
+    assert meta["fused_set_block"] is True
+    assert meta["num_heads"] == 1
+    assert meta["num_nodes"] == 32
+    tree, _ = mgr.restore(1)
+    mgr.close()
+    # Serving/evaluation never need to know which path trained the
+    # checkpoint: the saved tree is the flax tree.
+    params = {"params": tree["params"]["params"]}
+    obs = jax.random.uniform(jax.random.PRNGKey(9), (4, 32, 6))
+    l_flax, v_flax = SetTransformerPolicy(
+        dim=64, depth=2, num_heads=1).apply(params, obs)
+    l_fused, v_fused = FusedBlockSetPolicy(num_nodes=32).apply(params, obs)
+    np.testing.assert_allclose(np.asarray(l_fused), np.asarray(l_flax),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(v_fused), np.asarray(v_flax),
+                               rtol=1e-5, atol=1e-5)
+    records = [json.loads(l) for l in (run_dir / "metrics.jsonl").open()]
+    assert all(np.isfinite(r["reward_mean"]) for r in records
+               if "reward_mean" in r)
+
+    # The recorded recipe identity must not switch silently on resume.
+    with pytest.raises(SystemExit, match="fused-set-block"):
+        cli.main(common + ["--iterations", "2", "--resume"])
+
+
+def test_dp_sp_gradient_equivalence_fused_block():
+    """The ISSUE's sharded-path check: the PPO-loss gradient through the
+    single-chip fused kernel equals the gradient through the dp x sp
+    machinery at fleet N — both the node-axis-sharded flax path
+    (SeqParallelNet: ring attention + logits all-gather + pmean'd value
+    pool, pmean over sp) and the fused kernel itself run data-parallel
+    (per-shard grads pmean'd over dp). One parameter tree, three routes,
+    one gradient."""
+    from jax.sharding import PartitionSpec as P
+
+    from rl_scheduler_tpu.env import cluster_set
+    from rl_scheduler_tpu.parallel import make_mesh
+    from rl_scheduler_tpu.parallel.mesh import shard_map_compat
+    from rl_scheduler_tpu.parallel.sharding import SeqParallelNet
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+
+    num_nodes, feat, batch = 32, cluster_set.NODE_FEAT, 16
+    key = jax.random.PRNGKey(2)
+    k_obs, k_par, k_act = jax.random.split(key, 3)
+    obs = jax.random.uniform(k_obs, (batch, num_nodes, feat), jnp.float32)
+    act = jax.random.randint(k_act, (batch,), 0, num_nodes, jnp.int32)
+    # dim 16: a multiple of 8 (the kernel's sublane constraint) that keeps
+    # the interpret-mode backward fast on CPU.
+    flax_net = SetTransformerPolicy(dim=16, depth=2)
+    params = flax_net.init(k_par, obs)
+    fused_net = FusedBlockSetPolicy(num_nodes=num_nodes, dim=16, depth=2)
+
+    g_ref = jax.grad(_ppo_style_loss(flax_net.apply, obs, act))(params)
+    g_fused = jax.grad(_ppo_style_loss(fused_net.apply, obs, act))(params)
+
+    # Route 2: node axis sharded over sp (the flax dp x sp machinery).
+    sp_mesh = make_mesh({"sp": 4})
+    wrapped = SeqParallelNet(
+        SetTransformerPolicy(dim=16, depth=2, axis_name="sp"), "sp", 4)
+
+    def sp_grad(p):
+        g = jax.grad(_ppo_style_loss(wrapped.apply, obs, act))(p)
+        return jax.lax.pmean(g, "sp")
+
+    g_sp = jax.jit(shard_map_compat(
+        sp_grad, sp_mesh, in_specs=(P(),), out_specs=P()))(params)
+
+    # Route 3: the fused kernel itself under dp (batch sharded, grads
+    # pmean'd — how --preset set_fleet64 trains it when the TPU
+    # auto-selection turns the kernel on).
+    dp_mesh = make_mesh({"dp": 4})
+
+    def dp_grad(p, local_obs, local_act):
+        g = jax.grad(_ppo_style_loss(fused_net.apply, local_obs,
+                                     local_act))(p)
+        return jax.lax.pmean(g, "dp")
+
+    g_dp = jax.jit(shard_map_compat(
+        dp_grad, dp_mesh, in_specs=(P(), P("dp"), P("dp")),
+        out_specs=P()))(params, obs, act)
+
+    for ref, fused, sp, dp in zip(
+            jax.tree.leaves(g_ref), jax.tree.leaves(g_fused),
+            jax.tree.leaves(g_sp), jax.tree.leaves(g_dp)):
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(sp), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(dp), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_dp_update_fused_block_finite_and_synced():
+    """A full dp-sharded PPO update through the fused kernel (the
+    dryrun_multichip family 7 path) stays finite and keeps params
+    replicated bit-identical across shards."""
+    from rl_scheduler_tpu.agent.ppo import PPOTrainConfig
+    from rl_scheduler_tpu.env import cluster_set as cs
+    from rl_scheduler_tpu.env.bundle import cluster_set_bundle
+    from rl_scheduler_tpu.parallel import (
+        make_data_parallel_ppo_bundle,
+        make_mesh,
+    )
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    if not hasattr(jax, "shard_map"):
+        # parallel/sharding.py targets the bench env's JAX (>= 0.5,
+        # jax.shard_map); older-JAX containers cover the same numerics
+        # through test_dp_sp_gradient_equivalence_fused_block above,
+        # which shards via the version-compat helper.
+        pytest.skip("library sharding paths need jax.shard_map")
+
+    cfg = PPOTrainConfig(num_envs=8, rollout_steps=8, minibatch_size=16,
+                         num_epochs=2, lr=1e-3)
+    bundle = cluster_set_bundle(cs.make_params(num_nodes=32))
+    net = FusedBlockSetPolicy(num_nodes=32, dim=16, depth=1)
+    mesh = make_mesh({"dp": 4})
+    init_fn, update_fn, _ = make_data_parallel_ppo_bundle(
+        bundle, cfg, mesh, net=net)
+    runner = jax.jit(init_fn)(jax.random.PRNGKey(0))
+    runner, metrics = jax.jit(update_fn)(runner)
+    assert np.isfinite(float(metrics["policy_loss"]))
+    assert np.isfinite(float(metrics["value_loss"]))
+    leaf = jax.tree.leaves(runner.params)[0]
+    shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+    assert all(np.array_equal(shards[0], s) for s in shards[1:])
