@@ -100,9 +100,16 @@ class K8sMultiCloudEnv(_GYM_BASE):
             # Host-side, outside jit: dry-run a pod placement on the chosen
             # cluster (reference slow mode, k8s_multi_cloud_env.py:125-137).
             self._placer.place(cloud="aws" if action == 0 else "azure")
-        self.current_step = int(ts.step)
+        # ONE device->host transfer for the whole timestep: the previous
+        # per-field conversions (float(ts.reward), bool(ts.done), ...) each
+        # forced a separate device sync — ~100 ms apiece through a tunneled
+        # TPU (GL008, tools/graftlint).
+        obs, reward, done, step_idx = jax.device_get(
+            (ts.obs, ts.reward, ts.done, ts.step)
+        )
+        self.current_step = int(step_idx)
         info = {"chosen_cloud": "aws" if action == 0 else "azure", "step": self.current_step}
-        return np.asarray(ts.obs), float(ts.reward), bool(ts.done), False, info
+        return obs, float(reward), bool(done), False, info
 
     def render(self):
         pass
@@ -186,18 +193,21 @@ class K8sMultiCloudVectorEnv(_VEC_BASE):
     def step(self, actions):
         actions = np.asarray(actions, np.int32)
         self._state, obs, ts = _JIT_VEC_STEP(self.params, self._state, actions)
-        done = np.asarray(ts.done)
+        # One batched fetch for everything the Gym API returns (GL008): the
+        # per-field np.asarray calls each cost a device round-trip.
+        obs, raw, reward, done = jax.device_get(
+            (obs, ts.obs, ts.reward, ts.done)
+        )
         infos: dict[str, Any] = {}
         if done.any():
             final = np.empty(self.num_envs, dtype=object)
-            raw = np.asarray(ts.obs)
             for i in np.nonzero(done)[0]:
                 final[i] = raw[i]
             infos["final_obs"] = final
             infos["_final_obs"] = done.copy()
         return (
-            np.asarray(obs),
-            np.asarray(ts.reward),
+            obs,
+            reward,
             done,
             np.zeros(self.num_envs, bool),
             infos,
