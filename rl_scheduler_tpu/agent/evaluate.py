@@ -260,17 +260,23 @@ def quick_eval(
     policy = greedy_policy_fn(net, params)
     key = jax.random.PRNGKey(seed)
     state, obs = env_core.reset(env_params, key)
+    obs = jax.device_get(obs)
     total = 0.0
+    t = -1  # num_steps=0: report "0 steps" instead of NameError below
     for t in range(num_steps):
         action = int(policy(obs[None, :], None)[0])
         state, ts = env_core.step(env_params, state, jnp.asarray(action))
-        total += float(ts.reward)
+        # One device sync for the whole timestep (GL008): the previous
+        # float(ts.reward) (twice!) + bool(ts.done) + obs formatting cost
+        # four separate round-trips per printed step.
+        next_obs, reward, done = jax.device_get((ts.obs, ts.reward, ts.done))
+        total += float(reward)
         print_fn(
             f"Step {t + 1:2d}: cloud={CLOUD_NAMES[action]:5s} "
-            f"reward={float(ts.reward):8.3f} cpu={obs[4]:.2f}/{obs[5]:.2f}"
+            f"reward={float(reward):8.3f} cpu={obs[4]:.2f}/{obs[5]:.2f}"
         )
-        obs = ts.obs
-        if bool(ts.done):
+        obs = next_obs
+        if done:
             break
     print_fn(f"Total reward over {t + 1} steps: {total:.3f}")
     return total
