@@ -198,3 +198,41 @@ class TestSelectAlongLast:
 
         g = jax.grad(lambda v: select_along_last(v, idx).sum())(vals)
         assert np.isfinite(np.asarray(g)).all()
+
+
+# --------------------------------------------- impl resolution + entropy
+# (GL007: every public op needs at least one direct test reference)
+
+
+def test_default_platform_and_resolve_impl():
+    """`auto` must resolve per the default device: scan off-TPU, pallas on
+    TPU — the dispatch that keeps the Pallas GAE kernel off CPU CI."""
+    from rl_scheduler_tpu.ops.gae import default_platform, resolve_impl
+
+    platform = default_platform()
+    assert isinstance(platform, str) and platform  # "cpu" under tier-1
+    expected_auto = "pallas" if platform == "tpu" else "scan"
+    assert resolve_impl("auto") == expected_auto
+    assert resolve_impl("scan") == "scan"
+    assert resolve_impl("pallas") == "pallas"
+    with pytest.raises(ValueError, match="unknown GAE impl"):
+        resolve_impl("numpy")
+
+
+def test_categorical_entropy_golden():
+    """Uniform logits -> log(A); a near-deterministic distribution -> ~0."""
+    from rl_scheduler_tpu.ops.losses import categorical_entropy
+
+    uniform = jnp.zeros((3, 5))
+    np.testing.assert_allclose(
+        np.asarray(categorical_entropy(uniform)), np.log(5.0), rtol=1e-6
+    )
+    peaked = jnp.asarray([[30.0, 0.0, 0.0]])
+    assert float(categorical_entropy(peaked)[0]) < 1e-8
+    # Shift invariance: logits are unnormalized, entropy must not care.
+    shifted = uniform + 7.25
+    np.testing.assert_allclose(
+        np.asarray(categorical_entropy(shifted)),
+        np.asarray(categorical_entropy(uniform)),
+        rtol=1e-6,
+    )

@@ -308,3 +308,17 @@ def test_dp_update_fused_block_finite_and_synced():
     leaf = jax.tree.leaves(runner.params)[0]
     shards = [np.asarray(s.data) for s in leaf.addressable_shards]
     assert all(np.array_equal(shards[0], s) for s in shards[1:])
+
+
+def test_is_fleet_node_count_table():
+    """The one shape gate shared by the kernel guard, the train CLI's
+    auto-selection, and validation — pin its boundary semantics."""
+    from rl_scheduler_tpu.ops.pallas_set_block import (
+        MIN_FLEET_NODES,
+        is_fleet_node_count,
+    )
+
+    assert MIN_FLEET_NODES == 32
+    for n, ok in [(8, False), (16, False), (31, False), (32, True),
+                  (36, False), (40, True), (64, True), (256, True)]:
+        assert is_fleet_node_count(n) is ok, n

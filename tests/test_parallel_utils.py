@@ -1,0 +1,74 @@
+"""Version-split-safe units of ``parallel/``: the helpers every sharded
+path leans on but no sharded test exercised directly (GL007).
+
+Unlike ``test_tensor_parallel.py`` / ``test_sharding.py`` (which need
+``jax.shard_map`` and 8 virtual devices, so they only run on the driver's
+newer JAX), everything here is single-device semantics — the parts of the
+parallel stack whose contracts must hold on BOTH sides of the
+container-vs-driver JAX version split.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rl_scheduler_tpu.parallel.mesh import device_count
+from rl_scheduler_tpu.parallel.tensor_parallel import (
+    copy_to_tp,
+    reduce_from_tp,
+    untp_checkpoint_tree,
+)
+
+
+def test_device_count_matches_jax():
+    n = device_count()
+    assert isinstance(n, int) and n >= 1
+    assert n == len(jax.devices())
+
+
+def test_copy_and_reduce_identity_off_mesh():
+    """With ``axis_name=None`` (the unsharded twin modules) both Megatron
+    markers must be exact identities in forward AND backward — that is
+    what makes the tp=1 twin the parity reference."""
+    x = jnp.arange(6.0).reshape(2, 3)
+    np.testing.assert_array_equal(np.asarray(copy_to_tp(x, None)), np.asarray(x))
+    np.testing.assert_array_equal(
+        np.asarray(reduce_from_tp(x, None)), np.asarray(x)
+    )
+
+    g_copy = jax.grad(lambda v: copy_to_tp(v, None).sum())(x)
+    g_red = jax.grad(lambda v: reduce_from_tp(v, None).sum())(x)
+    np.testing.assert_array_equal(np.asarray(g_copy), np.ones_like(x))
+    np.testing.assert_array_equal(np.asarray(g_red), np.ones_like(x))
+
+
+def _tp_params():
+    """Minimal TPActorCritic-layout torso: one (col, row, row_bias) pair."""
+    return {
+        "actor_torso": {
+            "col0": {"kernel": jnp.ones((4, 8)), "bias": jnp.zeros(8)},
+            "row0": {"kernel": jnp.ones((8, 4)), "bias": jnp.zeros(4)},
+            "row_bias0": jnp.full(4, 0.5),
+        },
+        "logits_head": {"kernel": jnp.ones((4, 2)), "bias": jnp.zeros(2)},
+    }
+
+
+def test_untp_checkpoint_tree_passthrough_and_convert():
+    tree = {"params": _tp_params()}
+    # Non-tp runs (tp absent or 1) pass through untouched.
+    assert untp_checkpoint_tree({}, tree) is tree
+    assert untp_checkpoint_tree({"tp": 1}, tree) is tree
+    # tp>1 meta converts the torso to ActorCritic Dense_{2i}/Dense_{2i+1}
+    # layout, with row_bias{i} (the true bias of the row-parallel matmul)
+    # replacing the sharded row bias; heads are layout-identical.
+    out = untp_checkpoint_tree({"tp": 2}, tree)["params"]
+    torso = out["actor_torso"]
+    assert set(torso) == {"Dense_0", "Dense_1"}
+    np.testing.assert_array_equal(
+        np.asarray(torso["Dense_0"]["kernel"]), np.ones((4, 8))
+    )
+    np.testing.assert_array_equal(
+        np.asarray(torso["Dense_1"]["bias"]), np.full(4, 0.5)
+    )
+    assert out["logits_head"] is tree["params"]["logits_head"]
