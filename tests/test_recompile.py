@@ -1,0 +1,88 @@
+"""Recompilation regression: a second same-shaped call must NOT retrace.
+
+The runtime twin of graftlint's GL006 (weak-type cache-key churn) and
+GL003 (tracer control flow baking per-value programs): if anything in the
+update path keys compilation on VALUES — a weak-typed constant flipping
+strength, a Python branch on a tracer leaked through static args, a
+non-hashable config sneaking into the cache key — the second iteration of
+training silently recompiles. On the fleet configs one extra XLA compile
+is tens of seconds of chip time per occurrence, paid every iteration; the
+failure is invisible on CPU tests that only check numerics.
+
+Probes ``jit(...)._cache_size()`` (stable across the container's 0.4.x
+and the driver's newer JAX — asserted here so a version bump that drops
+it fails loudly rather than silently weakening the gate).
+"""
+
+import jax
+import pytest
+
+from rl_scheduler_tpu.agent.dqn import DQNConfig, make_dqn
+from rl_scheduler_tpu.agent.ppo import PPOTrainConfig, make_ppo_bundle
+from rl_scheduler_tpu.env.bundle import multi_cloud_bundle, single_cluster_bundle
+
+
+def _cache_size(jitted) -> int:
+    assert hasattr(jitted, "_cache_size"), (
+        "jit cache probe missing on this JAX version — port this test to "
+        "jax.log_compiles before trusting the recompile gate"
+    )
+    return jitted._cache_size()
+
+
+def test_ppo_update_does_not_retrace():
+    bundle = multi_cloud_bundle()
+    cfg = PPOTrainConfig(
+        num_envs=4, rollout_steps=8, minibatch_size=16, num_epochs=2,
+        rollout_impl="scan",
+    )
+    init_fn, update_fn, _ = make_ppo_bundle(bundle, cfg)
+    update = jax.jit(update_fn, donate_argnums=0)
+    runner = jax.jit(init_fn)(jax.random.PRNGKey(0))
+    runner, _ = update(runner)
+    first = _cache_size(update)
+    runner, _ = update(runner)
+    runner, _ = update(runner)
+    assert _cache_size(update) == first == 1, (
+        "PPO update retraced on same-shaped inputs — something in the "
+        "update keys compilation on values (weak type, host branch, or an "
+        "unhashable static)"
+    )
+
+
+def test_ppo_open_loop_update_does_not_retrace():
+    """The open-loop rollout path builds different programs (batched RNG,
+    no scan) — gate it separately."""
+    bundle = multi_cloud_bundle()
+    cfg = PPOTrainConfig(
+        num_envs=4, rollout_steps=8, minibatch_size=16, num_epochs=2,
+        rollout_impl="open_loop",
+    )
+    init_fn, update_fn, _ = make_ppo_bundle(bundle, cfg)
+    update = jax.jit(update_fn, donate_argnums=0)
+    runner = jax.jit(init_fn)(jax.random.PRNGKey(1))
+    runner, _ = update(runner)
+    first = _cache_size(update)
+    runner, _ = update(runner)
+    assert _cache_size(update) == first == 1
+
+
+def test_dqn_update_does_not_retrace():
+    bundle = single_cluster_bundle()
+    cfg = DQNConfig(
+        num_envs=2, collect_steps=4, buffer_size=64, batch_size=8,
+        learning_starts=4,
+    )
+    init_fn, update_fn, _ = make_dqn(bundle, cfg)
+    update = jax.jit(update_fn, donate_argnums=0)
+    runner = jax.jit(init_fn)(jax.random.PRNGKey(0))
+    runner, _ = update(runner)
+    first = _cache_size(update)
+    # Crossing the learning_starts threshold must not retrace either: the
+    # warm/cold switch is a lax.cond INSIDE one program, not two programs.
+    for _ in range(6):
+        runner, _ = update(runner)
+    assert _cache_size(update) == first == 1, (
+        "DQN update retraced on same-shaped inputs (did the buffer-warm "
+        "branch leak to Python?)"
+    )
