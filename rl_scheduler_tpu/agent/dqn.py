@@ -95,6 +95,7 @@ def buffer_add(buf: ReplayBuffer, batch: dict) -> ReplayBuffer:
     """
     n = batch["action"].shape[0]
     cap = buf.capacity
+    # graftlint: disable=GL003 -- cap is buf.capacity == buf.obs.shape[0], a static Python int; this branch is shape-driven and resolves identically at every trace
     if n > cap:
         batch = {k: v[n - cap:] for k, v in batch.items()}
         # The head still advances by the FULL n (as if each row had been

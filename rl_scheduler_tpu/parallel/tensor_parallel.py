@@ -457,6 +457,7 @@ def make_tensor_parallel_ppo(
         params = net.init(tp_key, dummy)
 
         def sync_replicated(leaf, rep):
+            # graftlint: disable=GL003 -- rep is a host-side Python bool leaf of the is_replicated tree (tree.map metadata), never a tracer
             if not rep:
                 return leaf
             return lax.index_in_dim(
