@@ -1,0 +1,17 @@
+# Convenience entry points. The pytest gate (tests/test_graftlint.py) is
+# the source of truth for lint; `make lint` is the same check, standalone.
+
+PY ?= python
+
+.PHONY: lint lint-json test tier1
+
+lint:
+	$(PY) -m tools.graftlint --check
+
+lint-json:
+	$(PY) -m tools.graftlint --check --json
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+tier1: test

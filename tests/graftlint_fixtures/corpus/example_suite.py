@@ -1,0 +1,11 @@
+"""Stand-in test corpus for the GL007 self-tests (not a pytest module).
+
+References the good fixture's public op and deliberately nothing from the
+bad fixture.
+"""
+
+from fixtures.ops.gl007_good import covered_op
+
+
+def check_covered_op():
+    assert covered_op is not None

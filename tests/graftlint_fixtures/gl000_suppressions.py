@@ -1,0 +1,21 @@
+"""GL000 fixture: suppression hygiene (2 GL000 findings + 1 suppressed
+GL002 + 1 UNsuppressed GL002 because its comment lacks a justification)."""
+
+import jax
+
+
+def justified(key):
+    a = jax.random.normal(key, (4,))
+    # graftlint: disable=GL002 -- fixture: deliberately correlated draws to document the suppression syntax
+    b = jax.random.uniform(key, (4,))
+    return a + b
+
+
+def unjustified(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))  # graftlint: disable=GL002
+    return a + b
+
+
+def unknown_rule(x):
+    return x  # graftlint: disable=GL999 -- no such rule

@@ -1,0 +1,30 @@
+"""GL001 positive fixture: host syncs inside traced scopes (3 findings).
+
+Never imported — parsed by the graftlint self-tests only.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def update(state):
+    loss = jnp.sum(state)
+    scale = float(loss)            # GL001: concretizes a tracer
+    fetched = jax.device_get(loss)  # GL001: device_get inside the trace
+    return state * scale + fetched
+
+
+def helper(x):
+    # Traced one call-graph level deep: `body` below is scanned and calls
+    # helper by name.
+    return x * np.asarray(x)       # GL001: np pull on a tracer
+
+
+def rollout(init):
+    def body(carry, _):
+        carry = helper(carry)
+        return carry, carry
+
+    return jax.lax.scan(body, init, None, length=4)

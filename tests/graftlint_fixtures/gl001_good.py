@@ -1,0 +1,24 @@
+"""GL001 negative fixture: the same calls OUTSIDE traced scopes (the
+adapter-boundary pattern) plus static metadata reads inside one."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def update(state):
+    # Shape/metadata reads are static under trace — not syncs.
+    n = state.shape[0]
+    return state / jnp.asarray(n, state.dtype)
+
+
+def adapter_step(params, state, action):
+    state, ts = update_step(params, state, action)
+    # Boundary code: conversions AFTER the jitted call returned are fine
+    # (one combined fetch, so GL008 stays quiet too).
+    reward, done = jax.device_get((ts.reward, ts.done))
+    return state, float(reward), bool(done)
+
+
+def update_step(params, state, action):
+    return state, state

@@ -1,0 +1,17 @@
+"""GL002 positive fixture: key reuse, linear and loop-carried (2 findings)."""
+
+import jax
+
+
+def sample_twice(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))   # GL002: key already consumed
+    return a + b
+
+
+def sample_in_loop(key, steps):
+    total = 0.0
+    for _ in range(steps):
+        # GL002: same key every iteration — identical draws.
+        total += jax.random.normal(key, ())
+    return total

@@ -1,0 +1,27 @@
+"""GL002 negative fixture: the split/fold_in discipline this repo uses."""
+
+import jax
+
+
+def sample_twice(key):
+    akey, bkey = jax.random.split(key)
+    a = jax.random.normal(akey, (4,))
+    b = jax.random.uniform(bkey, (4,))
+    return a + b
+
+
+def sample_in_loop(key, steps):
+    total = 0.0
+    for i in range(steps):
+        # fold_in derives a fresh key per iteration: a derivation, not a
+        # consumption — the ppo eval-hook idiom.
+        total += jax.random.normal(jax.random.fold_in(key, i), ())
+    return total
+
+
+def reassigned_in_loop(key, steps):
+    total = 0.0
+    for _ in range(steps):
+        key, draw = jax.random.split(key)
+        total += jax.random.normal(draw, ())
+    return total

@@ -1,0 +1,19 @@
+"""GL003 positive fixture: Python control flow on tracer values (2)."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clip_positive(x):
+    total = jnp.sum(x)
+    if total > 0:                 # GL003: tracer boolean
+        return x
+    return -x
+
+
+@jax.jit
+def drain(x):
+    while jnp.any(x > 0):         # GL003: tracer loop condition
+        x = x - 1.0
+    return x

@@ -1,0 +1,32 @@
+"""GL003 negative fixture: every static branch idiom the repo relies on."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def shape_driven(x):
+    n = x.shape[-1]
+    if n % 8:                         # static: shapes are Python ints
+        x = jnp.pad(x, ((0, 0), (0, 8 - n % 8)))
+    if x.ndim == 3 and len(x.shape) == 3:   # static metadata
+        x = x.reshape(-1, x.shape[-1])
+    return jnp.where(jnp.sum(x) > 0, x, -x)   # tracer branch done right
+
+
+@jax.jit
+def optional_arg(x, mask=None):
+    if mask is not None:              # `is None` is a static Python test
+        x = x * mask
+    if isinstance(x, tuple):          # type checks are static
+        x = x[0]
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("block_n",))
+def blocked(x, block_n):
+    if block_n > 8:                   # static_argnames param: a Python int
+        return x.reshape(-1, block_n)
+    return x

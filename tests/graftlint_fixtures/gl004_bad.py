@@ -1,0 +1,26 @@
+"""GL004 positive fixture: train-step-shaped jits without donation (2)."""
+
+from typing import NamedTuple
+
+import jax
+import optax
+
+
+class Runner(NamedTuple):
+    params: dict
+    opt_state: dict
+
+
+@jax.jit
+def train_step(runner: Runner):          # GL004: returns updated Runner
+    grads = runner.params
+    return Runner(params=grads, opt_state=runner.opt_state)
+
+
+def sgd(params, grads, opt_state, tx):
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state
+
+
+jitted_sgd = jax.jit(sgd)                # GL004: rebinds + returns `params`

@@ -1,0 +1,35 @@
+"""GL004 negative fixture: donation present, or nothing to donate."""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class Runner(NamedTuple):
+    params: dict
+    opt_state: dict
+
+
+@jax.jit
+def metrics_only(runner: Runner):
+    # Reads an argument, returns fresh scalars — no update, no donation
+    # needed.
+    return {"norm": jnp.sum(runner.params["w"])}
+
+
+def train_step(runner: Runner):
+    return Runner(params=runner.params, opt_state=runner.opt_state)
+
+
+update = jax.jit(train_step, donate_argnums=0)
+
+
+def init_fn(key):
+    # Produces a fresh tree from a PRNG key: not an updated argument.
+    params = {"w": jax.random.normal(key, (4, 4))}
+    return Runner(params=params, opt_state=optax.adam(1e-3).init(params))
+
+
+jit_init = jax.jit(init_fn)

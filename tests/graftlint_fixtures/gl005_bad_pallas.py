@@ -1,0 +1,32 @@
+"""GL005 positive fixture: misaligned tiles (3) + VMEM oversubscription (1)."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def kernel(x_ref, o_ref):
+    acc = jnp.zeros((8, 100), jnp.float32)   # GL005: 100 lanes -> pad to 128
+    o_ref[...] = x_ref[...] + acc[:, :100]
+
+
+def run(x):
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec((48, 100), lambda i: (i, 0))],  # GL005
+        out_specs=pl.BlockSpec((48, 100), lambda i: (i, 0)),   # GL005
+        grid=(4,),
+    )(x)
+
+
+def run_oversubscribed(x):
+    # GL005: 2 x (8192, 512) f32 blocks = 32 MiB static footprint > 16 MiB.
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec((8192, 512), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8192, 512), lambda i: (i, 0)),
+        grid=(1,),
+    )(x)

@@ -1,0 +1,24 @@
+"""GL005 negative fixture: aligned literals, symbolic shapes, 1-row blocks."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def kernel(x_ref, o_ref):
+    acc = jnp.zeros((8, 128), jnp.float32)       # aligned f32 tile
+    row = jnp.zeros((1, 128), jnp.float32)       # 1-row blocks are legal
+    o_ref[...] = x_ref[...] + acc + row
+
+
+def run(x, block_rows):
+    # Symbolic shapes are the author's runtime contract — lint stays out.
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec((block_rows, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((64, 128), lambda i: (i, 0)),
+        grid=(4,),
+        scratch_shapes=[pltpu.VMEM((256, 128), jnp.float32)],
+    )(x)

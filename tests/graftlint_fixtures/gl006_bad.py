@@ -1,0 +1,11 @@
+"""GL006 positive fixture: dtype-less float-literal arrays in traced code (2)."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def loss(x):
+    eps = jnp.asarray(1e-8)             # GL006: weak-typed constant
+    floor = jnp.full((8,), 0.5)         # GL006: weak-typed fill
+    return jnp.sum(x / (x + eps)) + jnp.sum(floor)

@@ -1,0 +1,16 @@
+"""GL006 negative fixture: explicit dtypes, scalar-literal arithmetic, and
+host-side constants."""
+
+import jax
+import jax.numpy as jnp
+
+# Module scope is not traced: weak typing here is resolved once at import.
+_TABLE = jnp.asarray(0.25)
+
+
+@jax.jit
+def loss(x):
+    eps = jnp.asarray(1e-8, x.dtype)        # dtype pinned
+    floor = jnp.full((8,), 0.5, jnp.float32)
+    ints = jnp.asarray(3)                   # int literals don't promote floats
+    return jnp.sum(x / (x + eps)) * 0.5 + jnp.sum(floor) + ints

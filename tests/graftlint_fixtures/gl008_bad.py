@@ -1,0 +1,8 @@
+"""GL008 positive fixture: per-field host conversions of one timestep (1)."""
+
+
+def adapter_step(env, action):
+    state, ts = env.step_fn(env.params, action)
+    reward = float(ts.reward)     # one device round-trip
+    done = bool(ts.done)          # ... and another, for the same timestep
+    return state, reward, done

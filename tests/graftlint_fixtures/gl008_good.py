@@ -1,0 +1,14 @@
+"""GL008 negative fixture: one batched fetch, then host-side conversion."""
+
+import jax
+
+
+def adapter_step(env, action):
+    state, ts = env.step_fn(env.params, action)
+    reward, done = jax.device_get((ts.reward, ts.done))
+    return state, float(reward), bool(done)
+
+
+def single_conversion(env, action):
+    state, ts = env.step_fn(env.params, action)
+    return state, float(ts.reward)    # one field, one sync: fine

@@ -1,0 +1,12 @@
+"""GL007 positive fixture (lives under an ``ops/`` dir on purpose): one
+public op with no test reference (1 finding)."""
+
+import jax.numpy as jnp
+
+
+def totally_untested_op(x):              # GL007: nothing references this
+    return jnp.cumsum(x, axis=-1)
+
+
+def _private_helper(x):                  # private: out of scope
+    return x

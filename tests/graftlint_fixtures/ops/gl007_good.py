@@ -1,0 +1,11 @@
+"""GL007 negative fixture: the public op IS referenced by the corpus."""
+
+import jax.numpy as jnp
+
+
+def covered_op(x):
+    return jnp.cumsum(x, axis=-1)
+
+
+def _private_helper(x):
+    return x

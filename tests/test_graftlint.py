@@ -1,0 +1,207 @@
+"""graftlint: the repo lint gate + per-rule fixture self-tests.
+
+Two layers:
+
+- **The gate** (tier-1): run the analyzer over the whole configured repo
+  (``[tool.graftlint]`` paths) and fail on ANY unsuppressed finding. This
+  makes the lint part of ``pytest`` — no new CI machinery — so a future
+  PR cannot quietly reintroduce a host sync in a jitted body, reuse a
+  PRNG key, or ship a misaligned Pallas tile.
+- **Self-tests**: every rule has a minimal positive and negative fixture
+  under ``tests/graftlint_fixtures/`` (never imported, only parsed); the
+  parametrized cases pin each rule's detection surface so engine changes
+  cannot silently blunt a rule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tools.graftlint import LintConfig, lint_paths, load_config, load_rules
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+FIXTURES = REPO_ROOT / "tests" / "graftlint_fixtures"
+
+
+def fixture_config() -> LintConfig:
+    """Config for linting fixture files in isolation: no excludes, the
+    fixture corpus as the GL007 reference test set."""
+    return LintConfig(
+        exclude=(),
+        test_paths=(str(FIXTURES / "corpus"),),
+        per_path_ignore={},
+    )
+
+
+# ------------------------------------------------------------------ gate
+
+
+def test_repo_gate_zero_unsuppressed_findings():
+    """The tentpole invariant: the analyzer over the WHOLE repo (same
+    paths as `python -m tools.graftlint`) reports nothing unsuppressed."""
+    config = load_config(REPO_ROOT / "pyproject.toml")
+    config = dataclasses.replace(
+        config, test_paths=tuple(str(REPO_ROOT / p) for p in config.test_paths)
+    )
+    result = lint_paths(
+        [REPO_ROOT / p for p in config.paths], config, root=REPO_ROOT
+    )
+    assert result.files_checked > 50, "lint set collapsed — check config"
+    pretty = "\n".join(f.format() for f in result.unsuppressed)
+    assert not result.unsuppressed, f"unsuppressed graftlint findings:\n{pretty}"
+
+
+def test_repo_gate_suppressions_all_justified():
+    """Every suppression that exists in the repo parses with a
+    justification (GL000 would fire otherwise — covered by the gate — but
+    assert the count explicitly so drive-by suppressions stay visible)."""
+    config = load_config(REPO_ROOT / "pyproject.toml")
+    result = lint_paths(
+        [REPO_ROOT / p for p in config.paths], config, root=REPO_ROOT
+    )
+    assert not [f for f in result.findings if f.rule == "GL000"]
+    # The two shape-driven-branch boundary cases documented in
+    # docs/static_analysis.md; update this count when adding one.
+    assert len(result.suppressed) == 2
+
+
+# ------------------------------------------------------- fixture self-tests
+
+CASES = [
+    ("gl001_bad.py", "GL001", 3),
+    ("gl001_good.py", "GL001", 0),
+    ("gl002_bad.py", "GL002", 2),
+    ("gl002_good.py", "GL002", 0),
+    ("gl003_bad.py", "GL003", 2),
+    ("gl003_good.py", "GL003", 0),
+    ("gl004_bad.py", "GL004", 2),
+    ("gl004_good.py", "GL004", 0),
+    ("gl005_bad_pallas.py", "GL005", 4),
+    ("gl005_good_pallas.py", "GL005", 0),
+    ("gl006_bad.py", "GL006", 2),
+    ("gl006_good.py", "GL006", 0),
+    ("ops/gl007_bad.py", "GL007", 1),
+    ("ops/gl007_good.py", "GL007", 0),
+    ("gl008_bad.py", "GL008", 1),
+    ("gl008_good.py", "GL008", 0),
+]
+
+
+@pytest.mark.parametrize("fixture,rule,expected", CASES,
+                         ids=[c[0].replace("/", "_") for c in CASES])
+def test_rule_fixture(fixture, rule, expected):
+    result = lint_paths([FIXTURES / fixture], fixture_config(), root=REPO_ROOT)
+    got = [f for f in result.unsuppressed if f.rule == rule]
+    pretty = "\n".join(f.format() for f in result.unsuppressed)
+    assert len(got) == expected, (
+        f"{fixture}: expected {expected} {rule} finding(s), got "
+        f"{len(got)}:\n{pretty}"
+    )
+    # Fixtures are single-rule by construction: nothing ELSE may fire.
+    others = [f for f in result.unsuppressed if f.rule != rule]
+    assert not others, f"{fixture}: unexpected cross-rule findings:\n{pretty}"
+
+
+def test_suppression_semantics():
+    """Justified suppressions suppress; unjustified or unknown-rule ones
+    become GL000 findings and do NOT suppress."""
+    result = lint_paths(
+        [FIXTURES / "gl000_suppressions.py"], fixture_config(), root=REPO_ROOT
+    )
+    gl000 = [f for f in result.unsuppressed if f.rule == "GL000"]
+    assert len(gl000) == 2  # missing justification + unknown rule
+    gl002_open = [f for f in result.unsuppressed if f.rule == "GL002"]
+    gl002_closed = [f for f in result.suppressed if f.rule == "GL002"]
+    assert len(gl002_open) == 1   # the unjustified comment did not suppress
+    assert len(gl002_closed) == 1  # the justified one did
+
+
+# ------------------------------------------------------------ engine units
+
+
+def test_traced_scope_resolution_one_level():
+    """Decorator, transform-argument, lexical nesting, and one-hop calls
+    all mark traced; a function nobody traces stays unmarked."""
+    from tools.graftlint.engine import Module
+
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def direct(x):\n"
+        "    def nested(y):\n"
+        "        return helper(y)\n"
+        "    return nested(x)\n"
+        "def helper(z):\n"
+        "    return z\n"
+        "def scanned(c, _):\n"
+        "    return c, c\n"
+        "def run(c):\n"
+        "    return jax.lax.scan(scanned, c, None, length=2)\n"
+        "def untouched(w):\n"
+        "    return w\n"
+    )
+    mod = Module(Path("synthetic.py"), "synthetic.py", src, known_rules=())
+    verdict = {r.qualname: r.traced for r in mod.functions}
+    assert verdict["direct"] and verdict["direct.nested"]
+    assert verdict["helper"], "one-hop call from traced body"
+    assert verdict["scanned"]
+    assert not verdict["untouched"]
+    assert not verdict["run"]  # calling scan does not trace the CALLER
+
+
+def test_static_argnames_not_tainted():
+    from tools.graftlint.engine import Module
+
+    src = (
+        "import functools, jax\n"
+        "@functools.partial(jax.jit, static_argnames=('block',))\n"
+        "def f(x, block):\n"
+        "    return x, block\n"
+    )
+    mod = Module(Path("s.py"), "s.py", src, known_rules=())
+    (rec,) = [r for r in mod.functions if r.name == "f"]
+    assert rec.traced and rec.static_params == {"block"}
+    assert "block" not in rec.taint() and "x" in rec.taint()
+
+
+# ----------------------------------------------------------------- the CLI
+
+
+def _run_cli(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", *args],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+
+
+def test_cli_gate_exits_zero_on_repo():
+    """The acceptance command: explicit paths, zero unsuppressed, exit 0."""
+    proc = _run_cli("rl_scheduler_tpu", "tests", "loadgen")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stderr
+
+
+def test_cli_json_and_exit_code_on_bad_fixture():
+    rel = "tests/graftlint_fixtures/gl002_bad.py"
+    # Explicit file paths bypass the config's fixture exclude on purpose.
+    proc = _run_cli("--json", "--select", "GL002", rel)
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["files_checked"] == 1
+    rules = {f["rule"] for f in payload["unsuppressed"]}
+    assert rules == {"GL002"}
+    assert all(f["path"] == rel for f in payload["unsuppressed"])
+
+
+def test_cli_list_rules_covers_registry():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rid in ["GL000"] + [f"GL00{i}" for i in range(1, 9)]:
+        assert rid in proc.stdout
+    assert len(load_rules()) == 8

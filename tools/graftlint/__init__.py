@@ -1,0 +1,45 @@
+"""graftlint: a JAX/TPU-aware static analyzer for this repo's invariants.
+
+The codebase depends on unwritten discipline — no host syncs inside traced
+scopes, no PRNG key reuse, MXU-aligned Pallas tile shapes, stable jit cache
+keys — that nothing machine-checks: CI runs pytest only, and past PRs have
+paid for silent violations by benchmarking them back out (the ~65
+materialized HBM round-trips behind the fused set-block kernel, the
+per-step device syncs in the adapter). In the spirit of chex (assert the
+discipline, don't hope for it) and the PPO implementation-details
+literature (most regressions are silent, not loud), graftlint turns those
+invariants into AST-checked rules.
+
+Pure-AST by design: no imports of the linted code, no JAX at analysis
+time, so it runs identically on the CPU-only container and the TPU driver
+regardless of their JAX version split (docs/static_analysis.md).
+
+Usage::
+
+    python -m tools.graftlint rl_scheduler_tpu tests loadgen
+    python -m tools.graftlint --check          # paths from pyproject.toml
+    python -m tools.graftlint --json --list-rules
+
+Suppress a deliberate boundary case with a justified comment on (or
+immediately above) the flagged line::
+
+    return float(ts.reward)  # graftlint: disable=GL001 -- adapter boundary
+
+Unjustified or unknown-rule suppressions are themselves findings (GL000).
+The pytest gate (``tests/test_graftlint.py``) runs the analyzer over the
+whole repo and fails on any unsuppressed finding.
+"""
+
+from tools.graftlint.config import LintConfig, load_config
+from tools.graftlint.engine import Finding, LintResult, lint_paths
+from tools.graftlint.rules import RULES, load_rules
+
+__all__ = [
+    "Finding",
+    "LintConfig",
+    "LintResult",
+    "RULES",
+    "lint_paths",
+    "load_config",
+    "load_rules",
+]

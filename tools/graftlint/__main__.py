@@ -1,0 +1,105 @@
+"""graftlint CLI.
+
+::
+
+    python -m tools.graftlint [paths ...] [--json] [--list-rules]
+                              [--select GL001,GL002] [--disable GL007]
+                              [--show-suppressed] [--check]
+
+With no paths, lints the ``[tool.graftlint]`` paths from pyproject.toml
+(falling back to the repo defaults). Exit status is 0 when no unsuppressed
+finding remains, 1 otherwise — ``--check`` is an explicit alias for that
+default so ``make lint`` reads honestly. Suppressed findings are counted
+in the summary (and listed with ``--show-suppressed``) so deliberate
+boundary cases stay visible without failing the gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from tools.graftlint.config import load_config
+from tools.graftlint.engine import lint_paths
+from tools.graftlint.rules import RULES, load_rules
+
+
+def main(argv: list | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="JAX/TPU-aware static analyzer for this repo's trace, "
+                    "PRNG, sync, and Pallas-tile invariants",
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to lint (default: "
+                             "[tool.graftlint] paths from pyproject.toml)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable JSON on stdout")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule registry and exit")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule ids to run exclusively")
+    parser.add_argument("--disable", default=None,
+                        help="comma-separated rule ids to skip (adds to "
+                             "the config's disable list)")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also print suppressed findings")
+    parser.add_argument("--check", action="store_true",
+                        help="explicit gate mode (the default behavior): "
+                             "exit 1 on any unsuppressed finding")
+    parser.add_argument("--config", default=None,
+                        help="path to a pyproject.toml (default: ./pyproject.toml)")
+    args = parser.parse_args(argv)
+
+    load_rules()
+    if args.list_rules:
+        rows = [("GL000", "bad-suppression",
+                 "suppression without justification / unknown rule / "
+                 "unparsable file")]
+        rows += [(r.id, r.name, r.summary) for _, r in sorted(RULES.items())]
+        if args.as_json:
+            print(json.dumps(
+                [{"id": i, "name": n, "summary": s} for i, n, s in rows],
+                indent=2))
+        else:
+            for rid, name, summary in rows:
+                print(f"{rid}  {name:28s} {summary}")
+        return 0
+
+    config = load_config(args.config)
+    if args.select:
+        selected = {r.strip() for r in args.select.split(",") if r.strip()}
+        config = dataclasses.replace(
+            config,
+            disable=tuple(set(RULES) - selected) + tuple(config.disable),
+        )
+    if args.disable:
+        extra = tuple(r.strip() for r in args.disable.split(",") if r.strip())
+        config = dataclasses.replace(config, disable=config.disable + extra)
+
+    paths = args.paths or list(config.paths)
+    result = lint_paths(paths, config)
+
+    if args.as_json:
+        print(json.dumps({
+            "files_checked": result.files_checked,
+            "unsuppressed": [f.to_dict() for f in result.unsuppressed],
+            "suppressed": [f.to_dict() for f in result.suppressed],
+        }, indent=2))
+    else:
+        shown = result.findings if args.show_suppressed else result.unsuppressed
+        for f in shown:
+            print(f.format())
+        print(
+            f"graftlint: {len(result.unsuppressed)} finding(s), "
+            f"{len(result.suppressed)} suppressed, "
+            f"{result.files_checked} file(s) checked",
+            file=sys.stderr,
+        )
+    return 1 if result.unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,85 @@
+"""graftlint configuration: defaults + the ``[tool.graftlint]`` pyproject
+section.
+
+Keys (all optional — defaults are this repo's layout)::
+
+    [tool.graftlint]
+    paths = ["rl_scheduler_tpu", "tests", "loadgen"]   # default lint set
+    exclude = ["tests/graftlint_fixtures"]             # never linted
+    test-paths = ["tests"]          # reference corpus for GL007
+    disable = []                    # rule ids disabled everywhere
+
+    [tool.graftlint.per-path-ignore]            # glob -> rule ids
+    "loadgen/*" = ["GL007"]
+
+TOML parsing uses stdlib ``tomllib`` when available (3.11+) and falls back
+to ``tomli`` (the container's 3.10); with neither present the defaults
+apply and a note goes to stderr — the analyzer itself never needs more
+than the standard library.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import sys
+from pathlib import Path
+
+DEFAULT_PATHS = ("rl_scheduler_tpu", "tests", "loadgen", "tools")
+DEFAULT_EXCLUDE = ("tests/graftlint_fixtures",)
+DEFAULT_TEST_PATHS = ("tests",)
+
+
+@dataclasses.dataclass
+class LintConfig:
+    paths: tuple = DEFAULT_PATHS
+    exclude: tuple = DEFAULT_EXCLUDE
+    test_paths: tuple = DEFAULT_TEST_PATHS
+    disable: tuple = ()
+    per_path_ignore: dict = dataclasses.field(default_factory=dict)
+
+    def rules_ignored_for(self, rel: str) -> set:
+        ignored: set = set()
+        for pattern, rules in self.per_path_ignore.items():
+            if fnmatch.fnmatch(rel, pattern) or rel.startswith(
+                pattern.rstrip("*").rstrip("/") + "/"
+            ):
+                ignored.update(rules)
+        return ignored
+
+
+def _load_toml(path: Path) -> dict:
+    try:
+        import tomllib  # Python 3.11+
+    except ImportError:
+        try:
+            import tomli as tomllib
+        except ImportError:
+            print(
+                "graftlint: no TOML parser (tomllib/tomli); using built-in "
+                "defaults instead of [tool.graftlint]",
+                file=sys.stderr,
+            )
+            return {}
+    with path.open("rb") as fh:
+        return tomllib.load(fh)
+
+
+def load_config(pyproject: Path | str | None = None) -> LintConfig:
+    """Read ``[tool.graftlint]`` from ``pyproject.toml`` (cwd by default)."""
+    path = Path(pyproject) if pyproject is not None else Path("pyproject.toml")
+    if not path.is_file():
+        return LintConfig()
+    section = _load_toml(path).get("tool", {}).get("graftlint", {})
+    if not section:
+        return LintConfig()
+    return LintConfig(
+        paths=tuple(section.get("paths", DEFAULT_PATHS)),
+        exclude=tuple(section.get("exclude", DEFAULT_EXCLUDE)),
+        test_paths=tuple(section.get("test-paths", DEFAULT_TEST_PATHS)),
+        disable=tuple(section.get("disable", ())),
+        per_path_ignore={
+            k: tuple(v)
+            for k, v in section.get("per-path-ignore", {}).items()
+        },
+    )

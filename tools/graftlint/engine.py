@@ -1,0 +1,652 @@
+"""graftlint analysis engine: modules, traced-scope resolution, suppressions.
+
+Everything here is pure-AST (``ast`` + ``re`` only — no JAX import), so the
+analyzer behaves identically under the container's CPU JAX and the driver's
+newer TPU JAX. The engine owns the three shared capabilities every rule
+builds on:
+
+- **Traced-scope resolution**: which functions run under a JAX trace. A
+  function is traced when it is decorated with / passed to a tracing
+  transform (``jax.jit``, ``vmap``, ``lax.scan``/``cond``/``while_loop``,
+  ``shard_map``, ``pallas_call``, ``grad``, ...), when it is lexically
+  nested inside a traced function, or — one call-graph level deep, per the
+  design — when a traced function calls it by name within the same module.
+- **Taint**: which names inside a traced function derive from its
+  parameters (i.e. are tracers under trace). Static metadata reads
+  (``x.shape``, ``x.ndim``, ``x.dtype``, ``len(x)``, ``isinstance(x, ..)``)
+  are NOT tracer-valued and are excluded, so shape-driven Python control
+  flow stays legal.
+- **Suppressions**: ``# graftlint: disable=GL001[,GL002] -- justification``
+  on the flagged line or the line directly above; ``disable-file=`` within
+  the first ten lines for whole-file scope. A suppression without a
+  ``--``-separated justification, or naming an unknown rule, is itself a
+  finding (GL000) — suppressions are reserved for deliberate boundary
+  cases and each must say why.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import re
+from pathlib import Path
+from typing import Iterable, Iterator
+
+# Last attribute segments that put their function arguments under a JAX
+# trace. Bare-name forms (``jit``, ``vmap``, ...) are accepted too: modules
+# commonly do ``from jax import jit``. ``map`` is deliberately absent —
+# matching the Python builtin would mark arbitrary host callbacks traced.
+TRACING_CALL_NAMES = frozenset({
+    "jit", "vmap", "pmap", "grad", "value_and_grad", "scan", "cond",
+    "while_loop", "fori_loop", "switch", "shard_map", "shard_map_compat",
+    "pallas_call", "checkpoint", "remat", "custom_vjp", "checkify",
+    "named_scope", "eval_shape",
+})
+
+# Attribute reads that are static under trace (Python values, not tracers).
+STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "aval", "sharding"})
+
+# Call wrappers whose results are plain Python values even on tracer args.
+STATIC_CALLS = frozenset({"isinstance", "hasattr", "getattr", "len", "type",
+                          "callable", "id", "repr"})
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*(disable|disable-file)\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_,\s]+?)\s*(?:--\s*(?P<why>\S.*))?$"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+
+    def format(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.rule} {self.message}{tag}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list  # list[Finding], sorted by (path, line, rule)
+    files_checked: int
+
+    @property
+    def unsuppressed(self) -> list:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> list:
+        return [f for f in self.findings if f.suppressed]
+
+
+def _comment_lines(source: str, lines: list) -> Iterator:
+    """``(lineno, text)`` for lines carrying a REAL comment token.
+
+    Tokenizing (rather than scanning raw lines) keeps string literals and
+    docstrings out: documentation that QUOTES the suppression syntax must
+    neither suppress nor trip the malformed-comment check. Falls back to
+    every line when tokenization fails (the file already yields a GL000
+    parse finding in that case).
+    """
+    import io
+    import tokenize
+
+    try:
+        commented = {
+            tok.start[0]
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline)
+            if tok.type == tokenize.COMMENT
+        }
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        commented = None
+    for lineno, text in enumerate(lines, start=1):
+        if commented is None or lineno in commented:
+            yield lineno, text
+
+
+class Suppressions:
+    """Per-module suppression comments, with justification enforcement."""
+
+    def __init__(self, source: str, lines: list, known_rules: Iterable[str]):
+        known = set(known_rules)
+        self.line_rules: dict = {}   # line number -> set of rule ids
+        self.file_rules: set = set()
+        self.bad: list = []          # (line, message) for GL000
+        for lineno, text in _comment_lines(source, lines):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                if "graftlint:" in text:
+                    self.bad.append(
+                        (lineno, "malformed graftlint comment (expected "
+                         "'# graftlint: disable=GLxxx -- justification')")
+                    )
+                continue
+            kind = m.group(1)
+            rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+            why = m.group("why")
+            unknown = sorted(r for r in rules if r not in known)
+            if unknown:
+                self.bad.append(
+                    (lineno, f"suppression names unknown rule(s) "
+                             f"{', '.join(unknown)}")
+                )
+            if not why:
+                self.bad.append(
+                    (lineno, "suppression has no justification (append "
+                             "' -- <why this boundary case is deliberate>')")
+                )
+                continue  # unjustified suppressions do not suppress
+            rules &= known
+            if kind == "disable-file":
+                if lineno > 10:
+                    self.bad.append(
+                        (lineno, "disable-file must appear in the first "
+                                 "10 lines")
+                    )
+                else:
+                    self.file_rules |= rules
+            else:
+                self.line_rules.setdefault(lineno, set()).update(rules)
+
+    def covers(self, rule: str, line: int) -> bool:
+        if rule in self.file_rules:
+            return True
+        for candidate in (line, line - 1):
+            if rule in self.line_rules.get(candidate, ()):
+                return True
+        return False
+
+
+@dataclasses.dataclass
+class FunctionRecord:
+    """One function/method definition with its traced-scope verdict."""
+
+    node: ast.AST                 # FunctionDef | AsyncFunctionDef
+    qualname: str
+    parent: "FunctionRecord | None"
+    traced: bool = False
+    traced_reason: str = ""
+    # Parameters declared static at the jit site (static_argnums/
+    # static_argnames): plain Python values under trace, never tainted.
+    static_params: set = dataclasses.field(default_factory=set)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def taint(self) -> set:
+        return taint_set(self.node, self.static_params)
+
+
+def dotted_last(node: ast.AST) -> str | None:
+    """Last segment of a Name/Attribute callee (``jax.lax.scan`` -> ``scan``)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """Full dotted name of a Name/Attribute chain, or None if not one."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def iter_own_statements(fn_node: ast.AST) -> Iterator[ast.stmt]:
+    """Statements belonging to ``fn_node`` itself, recursing into compound
+    statements but NOT into nested function/class definitions (those are
+    analyzed as their own scopes)."""
+
+    def walk_block(stmts):
+        for stmt in stmts:
+            yield stmt
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                yield from walk_block(getattr(stmt, field, []) or [])
+            for handler in getattr(stmt, "handlers", []) or []:
+                yield from walk_block(handler.body)
+            for case in getattr(stmt, "cases", []) or []:  # ast.Match
+                yield from walk_block(case.body)
+
+    yield from walk_block(fn_node.body)
+
+
+def walk_own(fn_node: ast.AST) -> Iterator[ast.AST]:
+    """All AST nodes of a function's own statements (no nested defs)."""
+    for stmt in iter_own_statements(fn_node):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        # Walk the statement but prune nested definitions and compound
+        # bodies (already yielded by iter_own_statements).
+        yield from _walk_pruned(stmt)
+
+
+def _walk_pruned(stmt: ast.stmt) -> Iterator[ast.AST]:
+    yield stmt
+    for field, value in ast.iter_fields(stmt):
+        if field in ("body", "orelse", "finalbody", "handlers", "cases"):
+            continue  # compound bodies come through iter_own_statements
+        if isinstance(value, ast.AST):
+            yield from _walk_expr(value)
+        elif isinstance(value, list):
+            for item in value:
+                if isinstance(item, ast.AST):
+                    yield from _walk_expr(item)
+    for case in getattr(stmt, "cases", []) or []:  # ast.Match: patterns +
+        if case.guard is not None:                 # guards are expressions
+            yield from _walk_expr(case.guard)      # of this scope; bodies
+        yield from _walk_expr(case.pattern)        # come via the caller
+
+
+def _walk_expr(node: ast.AST) -> Iterator[ast.AST]:
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        return
+    yield node
+    for child in ast.iter_child_nodes(node):
+        yield from _walk_expr(child)
+
+
+def param_names(fn_node: ast.AST) -> set:
+    args = fn_node.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    for extra in (args.vararg, args.kwarg):
+        if extra is not None:
+            names.append(extra.arg)
+    return {n for n in names if n != "self"}
+
+
+def _assign_targets(node: ast.AST) -> list:
+    """Flat list of simple Name targets of an assignment-ish statement."""
+    out = []
+
+    def collect(t):
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                collect(e)
+        elif isinstance(t, ast.Starred):
+            collect(t.value)
+
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            collect(t)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        collect(node.target)
+    elif isinstance(node, (ast.For, ast.AsyncFor)):
+        collect(node.target)
+    elif isinstance(node, (ast.With, ast.AsyncWith)):
+        for item in node.items:
+            if item.optional_vars is not None:
+                collect(item.optional_vars)
+    return out
+
+
+def _names_in(node: ast.AST) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def taint_set(fn_node: ast.AST, static_params: set = frozenset()) -> set:
+    """Names in ``fn_node`` that (may) derive from its parameters.
+
+    Under trace the parameters are tracers; any value computed from them is
+    a tracer too — EXCEPT values computed from static metadata
+    (``x.shape``/``x.ndim``/``len(x)``/...), which stay Python values, so
+    ``n = x.shape[0]`` does not taint ``n``, and EXCEPT parameters the jit
+    site declared static (``static_params``). Two line-ordered passes over
+    the function's own statements (enough for the back-reference patterns
+    real code has; taint only grows, so this converges fast).
+    """
+    tainted = set(param_names(fn_node)) - set(static_params)
+    for _ in range(2):
+        for stmt in iter_own_statements(fn_node):
+            targets = _assign_targets(stmt)
+            if not targets:
+                continue
+            if isinstance(stmt, ast.Assign):
+                source = stmt.value
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                source = stmt.value
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                source = stmt.iter
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                source = ast.Tuple(
+                    elts=[i.context_expr for i in stmt.items], ctx=ast.Load()
+                )
+            else:
+                source = None
+            if source is None:
+                continue
+            if (isinstance(stmt, ast.AugAssign) and
+                    set(targets) & tainted) or \
+                    tracer_valued_names(source, tainted):
+                tainted.update(targets)
+    return tainted
+
+
+def tracer_valued_names(expr: ast.AST, tainted: set) -> list:
+    """Tainted Name nodes in ``expr`` that are tracer-VALUED uses.
+
+    Excludes names whose use is static under trace: operands of
+    ``isinstance``/``hasattr``/``len``/... calls, ``x is None`` tests, and
+    reads of ``.shape``/``.ndim``/``.dtype``/... metadata.
+    """
+    out = []
+
+    def visit(node, static):
+        if isinstance(node, ast.Name):
+            if node.id in tainted and not static:
+                out.append(node)
+            return
+        if isinstance(node, ast.Call):
+            callee = dotted_last(node.func)
+            inner_static = static or callee in STATIC_CALLS
+            if isinstance(node.func, ast.Attribute):
+                # A method call's receiver is a real use: `state.sum()` is
+                # tracer-valued when `state` is. (A bare callee Name is
+                # not — referencing a function is not consuming a tracer.)
+                visit(node.func.value, inner_static)
+            for a in node.args:
+                visit(a, inner_static)
+            for kw in node.keywords:
+                visit(kw.value, inner_static)
+            return
+        if isinstance(node, ast.Attribute):
+            visit(node.value, static or node.attr in STATIC_ATTRS)
+            return
+        if isinstance(node, ast.Compare):
+            is_only = all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops)
+            visit(node.left, static or is_only)
+            for comp in node.comparators:
+                visit(comp, static or is_only)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, static)
+
+    visit(expr, False)
+    return out
+
+
+class Module:
+    """A parsed source file plus the shared per-module analyses."""
+
+    def __init__(self, path: Path, rel: str, source: str,
+                 known_rules: Iterable[str]):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel)
+        self.suppressions = Suppressions(source, self.lines, known_rules)
+        self.functions: list = []           # list[FunctionRecord]
+        self._by_name: dict = {}            # bare name -> [FunctionRecord]
+        self._index_functions()
+        self._resolve_traced()
+
+    # ---------------------------------------------------------- indexing
+
+    def _index_functions(self) -> None:
+        def visit(node, parent, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}"
+                    rec = FunctionRecord(child, qual, parent)
+                    self.functions.append(rec)
+                    self._by_name.setdefault(child.name, []).append(rec)
+                    visit(child, rec, qual + ".")
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, parent, f"{prefix}{child.name}.")
+                else:
+                    visit(child, parent, prefix)
+
+        visit(self.tree, None, "")
+
+    def records_named(self, name: str) -> list:
+        return self._by_name.get(name, [])
+
+    # ----------------------------------------------------- traced scopes
+
+    def _mark(self, rec: FunctionRecord, reason: str) -> None:
+        if not rec.traced:
+            rec.traced = True
+            rec.traced_reason = reason
+
+    def _is_tracing_callee(self, func: ast.AST) -> bool:
+        last = dotted_last(func)
+        return last in TRACING_CALL_NAMES
+
+    def _tracing_decorator(self, dec: ast.AST) -> bool:
+        # @jax.jit / @jit / @jax.custom_vjp
+        if self._is_tracing_callee(dec):
+            return True
+        # @jax.jit(static_argnames=...) / @partial(jax.jit, ...)
+        if isinstance(dec, ast.Call):
+            if self._is_tracing_callee(dec.func):
+                return True
+            if dotted_last(dec.func) == "partial" and dec.args:
+                return self._is_tracing_callee(dec.args[0])
+        return False
+
+    @staticmethod
+    def _static_params(keywords: list, fn_node: ast.AST) -> set:
+        """Param names declared static by static_argnums/static_argnames
+        keywords at a jit site, resolved against ``fn_node``'s signature."""
+        out: set = set()
+        args = fn_node.args
+        positional = [a.arg for a in args.posonlyargs + args.args]
+        for kw in keywords:
+            if kw.arg == "static_argnames":
+                out.update(
+                    c.value for c in ast.walk(kw.value)
+                    if isinstance(c, ast.Constant) and isinstance(c.value, str)
+                )
+            elif kw.arg == "static_argnums":
+                for c in ast.walk(kw.value):
+                    if isinstance(c, ast.Constant) and \
+                            isinstance(c.value, int) and \
+                            not isinstance(c.value, bool) and \
+                            0 <= c.value < len(positional):
+                        out.add(positional[c.value])
+        return out
+
+    def _resolve_traced(self) -> None:
+        # Pass 1: direct marks — tracing decorators, and function names
+        # passed as arguments to tracing calls anywhere in the module.
+        for rec in self.functions:
+            for dec in rec.node.decorator_list:
+                if self._tracing_decorator(dec):
+                    self._mark(rec, "tracing decorator")
+                    if isinstance(dec, ast.Call):
+                        rec.static_params |= self._static_params(
+                            dec.keywords, rec.node
+                        )
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee_args: list = []
+            if self._is_tracing_callee(node.func):
+                callee_args = list(node.args) + [k.value for k in node.keywords]
+            elif dotted_last(node.func) == "partial" and node.args and \
+                    self._is_tracing_callee(node.args[0]):
+                callee_args = list(node.args[1:])
+            transform = dotted_last(node.func) or "transform"
+            for arg in callee_args:
+                if isinstance(arg, ast.Name):
+                    for rec in self.records_named(arg.id):
+                        self._mark(rec, f"passed to {transform}")
+                        rec.static_params |= self._static_params(
+                            node.keywords, rec.node
+                        )
+        # Pass 2: lexical containment — a def nested inside a traced
+        # function executes during the trace.
+        self._propagate_containment()
+        # Pass 3: one call-graph level — functions a traced body calls by
+        # name are traced too (deep enough to catch helpers called from
+        # jitted bodies without whole-program analysis).
+        called: dict = {}
+        for rec in [r for r in self.functions if r.traced]:
+            for node in walk_own(rec.node):
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                    called.setdefault(node.func.id, rec.qualname)
+        for name, caller in called.items():
+            for rec in self.records_named(name):
+                self._mark(rec, f"called from traced {caller}")
+        self._propagate_containment()
+
+    def _propagate_containment(self) -> None:
+        for rec in self.functions:  # outer-to-inner indexing order
+            parent = rec.parent
+            if parent is not None and parent.traced:
+                self._mark(rec, f"nested in traced {parent.qualname}")
+
+    def traced_functions(self) -> list:
+        return [r for r in self.functions if r.traced]
+
+
+def _rel_to(path: Path, root: Path) -> str:
+    try:
+        return path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def _is_excluded(rel: str, excludes: Iterable[str]) -> bool:
+    return any(
+        fnmatch.fnmatch(rel, pat)
+        or rel.startswith(pat.rstrip("*").rstrip("/") + "/")
+        for pat in excludes
+    )
+
+
+@dataclasses.dataclass
+class LintContext:
+    """Cross-module state shared with rules."""
+
+    config: "LintConfig"
+    modules: list                  # list[Module], the full lint set
+    root: Path = dataclasses.field(default_factory=Path.cwd)
+    _test_corpus: str | None = None
+
+    def test_corpus(self) -> str:
+        """Concatenated text of the configured test paths (GL007).
+
+        Config excludes apply here too: the deliberately-bad fixture
+        corpus must not count as "a test references this op"."""
+        if self._test_corpus is None:
+            chunks = []
+            for base in self.config.test_paths:
+                base_path = Path(base)
+                if base_path.is_file():
+                    candidates = [base_path]
+                elif base_path.is_dir():
+                    candidates = sorted(base_path.rglob("*.py"))
+                else:
+                    candidates = []
+                for p in candidates:
+                    if _is_excluded(_rel_to(p, self.root),
+                                    self.config.exclude):
+                        continue
+                    chunks.append(p.read_text(errors="replace"))
+            self._test_corpus = "\n".join(chunks)
+        return self._test_corpus
+
+
+def collect_files(paths: Iterable, excludes: Iterable[str],
+                  root: Path) -> list:
+    """Resolve CLI/config paths to the sorted list of .py files to lint."""
+    files: list = []
+    seen = set()
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            candidates = sorted(p.rglob("*.py"))
+            apply_excludes = True
+        elif p.suffix == ".py":
+            # A file named explicitly is linted even if a config exclude
+            # covers it — `python -m tools.graftlint <fixture>.py` is how
+            # rule authors iterate on deliberately-bad fixture files.
+            candidates = [p]
+            apply_excludes = False
+        else:
+            candidates = []
+            apply_excludes = True
+        for c in candidates:
+            rel = _rel_to(c, root)
+            if rel in seen:
+                continue
+            if apply_excludes and _is_excluded(rel, excludes):
+                continue
+            seen.add(rel)
+            files.append((c, rel))
+    return files
+
+
+def lint_paths(paths: Iterable, config: "LintConfig | None" = None,
+               root: "Path | str | None" = None) -> LintResult:
+    """Run every enabled rule over ``paths`` and return all findings
+    (suppressed ones included, flagged)."""
+    from tools.graftlint.config import LintConfig
+    from tools.graftlint.rules import RULES, load_rules
+
+    load_rules()
+    config = config or LintConfig()
+    root = Path(root) if root is not None else Path.cwd()
+    known = set(RULES) | {"GL000"}
+    files = collect_files(paths, config.exclude, root)
+
+    modules: list = []
+    findings: list = []
+    for path, rel in files:
+        try:
+            source = path.read_text(errors="replace")
+            modules.append(Module(path, rel, source, known))
+        except SyntaxError as e:
+            findings.append(Finding(
+                "GL000", rel, e.lineno or 1,
+                f"file does not parse: {e.msg} (graftlint needs valid "
+                "Python to check invariants)"))
+
+    ctx = LintContext(config=config, modules=modules, root=root)
+    enabled = [r for rid, r in sorted(RULES.items())
+               if rid not in config.disable]
+    for module in modules:
+        ignored_here = config.rules_ignored_for(module.rel)
+        for lineno, msg in module.suppressions.bad:
+            # GL000 is itself suppressible (with a justified
+            # `disable=GL000`) so documenting or deliberately exercising
+            # broken suppression syntax has an escape hatch.
+            findings.append(Finding(
+                "GL000", module.rel, lineno, msg,
+                suppressed=module.suppressions.covers("GL000", lineno),
+            ))
+        for rule in enabled:
+            if rule.id in ignored_here:
+                continue
+            for finding in rule.check(module, ctx):
+                finding.suppressed = module.suppressions.covers(
+                    finding.rule, finding.line
+                )
+                findings.append(finding)
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return LintResult(findings=findings, files_checked=len(files))

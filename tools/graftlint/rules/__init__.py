@@ -1,0 +1,62 @@
+"""graftlint rule registry.
+
+Rules are plugins: each module under ``tools/graftlint/rules/`` defines one
+or more :class:`Rule` subclasses and registers them with ``@register``. The
+engine asks the registry (not the modules) what to run, so adding a rule is
+one new file plus a fixture pair — nothing in the engine changes.
+
+Every rule is grounded in a failure mode this repo has actually paid for;
+the rule docstrings and ``docs/static_analysis.md`` carry the receipts.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from tools.graftlint.engine import Finding, LintContext, Module
+
+RULES: dict = {}  # rule id -> Rule instance
+
+
+class Rule:
+    """Base class: subclass, set ``id``/``name``/``summary``, implement
+    ``check(module, ctx) -> Iterator[Finding]``."""
+
+    id: str = "GL999"
+    name: str = "unnamed"
+    summary: str = ""
+
+    def check(self, module: Module, ctx: LintContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: Module, line: int, message: str) -> Finding:
+        return Finding(self.id, module.rel, line, message)
+
+
+def register(cls):
+    """Class decorator: instantiate and add to the registry."""
+    inst = cls()
+    if inst.id in RULES:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    RULES[inst.id] = inst
+    return cls
+
+
+_LOADED = False
+
+
+def load_rules() -> dict:
+    """Import every rule module exactly once; return the registry."""
+    global _LOADED
+    if not _LOADED:
+        from tools.graftlint.rules import (  # noqa: F401
+            control_flow,
+            donate,
+            host_sync,
+            pallas_tiles,
+            prng,
+            test_coverage,
+            weak_types,
+        )
+        _LOADED = True
+    return RULES

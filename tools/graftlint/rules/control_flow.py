@@ -1,0 +1,51 @@
+"""GL003 tracer-control-flow: Python ``if``/``while`` on tracer values.
+
+Inside a traced function, a Python ``if``/``while`` on a value derived
+from the function's (tracer) arguments either raises
+ConcretizationTypeError or — worse, with ``static_argnums`` or a stray
+host sync — silently BAKES one branch into the compiled program and
+retraces per value. Shape-driven branching stays legal: ``x.ndim``,
+``x.shape[0]``, ``isinstance(x, tuple)``, ``x is None`` are static under
+trace and are excluded by the engine's tracer-value analysis. The fix is
+``jnp.where`` / ``lax.cond`` / ``lax.while_loop``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.engine import (
+    LintContext,
+    Module,
+    iter_own_statements,
+    tracer_valued_names,
+)
+from tools.graftlint.rules import Rule, register
+
+
+@register
+class TracerControlFlow(Rule):
+    id = "GL003"
+    name = "tracer-control-flow"
+    summary = ("Python if/while on a tracer-derived boolean inside a "
+               "traced function (use jnp.where / lax.cond)")
+
+    def check(self, module: Module, ctx: LintContext) -> Iterator:
+        for rec in module.traced_functions():
+            tainted = rec.taint()
+            for stmt in iter_own_statements(rec.node):
+                if not isinstance(stmt, (ast.If, ast.While)):
+                    continue
+                hits = tracer_valued_names(stmt.test, tainted)
+                if not hits:
+                    continue
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                names = ", ".join(sorted({f"`{n.id}`" for n in hits}))
+                yield self.finding(
+                    module, stmt.lineno,
+                    f"Python `{kind}` on tracer-derived {names} in traced "
+                    f"`{rec.qualname}` — branch is resolved at TRACE time, "
+                    "not per value (use jnp.where / lax.cond / "
+                    "lax.while_loop)",
+                )

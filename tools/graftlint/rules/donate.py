@@ -1,0 +1,164 @@
+"""GL004 missing-donate: train-step-shaped jits without buffer donation.
+
+A jitted function that takes a runner/params pytree and returns an UPDATED
+version of it holds both the old and new buffers live across the call
+unless the input is donated — for this repo's fleet configs that is the
+whole optimizer + env state doubled in HBM every iteration, plus an extra
+device copy XLA could have elided. ``agent/loop.py::make_update`` jits
+every trainer with ``donate_argnums=0`` for exactly this reason; this rule
+keeps ad-hoc jit sites honest.
+
+"Train-step-shaped" is structural, not name-based: the jitted function
+returns (possibly inside a tuple) either a rebound parameter, a
+``._replace(...)``/``dataclasses.replace(...)`` of a parameter-derived
+value, an ``optax.apply_updates`` result, or a constructor call of the
+same class a parameter is annotated with. Pure producers (init functions
+keyed by a PRNG key, evaluators returning fresh metrics) do not match.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.engine import (
+    LintContext,
+    Module,
+    dotted_last,
+    param_names,
+    taint_set,
+)
+from tools.graftlint.rules import Rule, register
+
+_DONATE_KWARGS = {"donate_argnums", "donate_argnames"}
+
+
+def _jit_sites(module: Module):
+    """Yield ``(line, fn_name, has_donate)`` for every resolvable
+    ``jax.jit`` application (call form, decorator, or partial)."""
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) and dotted_last(node.func) == "jit":
+            kwargs = {k.arg for k in node.keywords}
+            if node.args and isinstance(node.args[0], ast.Name):
+                yield (node.lineno, node.args[0].id,
+                       bool(kwargs & _DONATE_KWARGS))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if dotted_last(dec) == "jit":
+                    yield (dec.lineno, node.name, False)
+                elif isinstance(dec, ast.Call):
+                    kwargs = {k.arg for k in dec.keywords}
+                    if dotted_last(dec.func) == "jit":
+                        yield (dec.lineno, node.name,
+                               bool(kwargs & _DONATE_KWARGS))
+                    elif (dotted_last(dec.func) == "partial" and dec.args
+                          and dotted_last(dec.args[0]) == "jit"):
+                        yield (dec.lineno, node.name,
+                               bool(kwargs & _DONATE_KWARGS))
+
+
+def _annotation_classes(fn_node) -> set:
+    out = set()
+    args = fn_node.args
+    for a in args.posonlyargs + args.args + args.kwonlyargs:
+        if a.annotation is not None:
+            last = dotted_last(a.annotation)
+            if last:
+                out.add(last)
+    return out
+
+
+def _returned_exprs(fn_node):
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Return) and node.value is not None:
+            val = node.value
+            if isinstance(val, ast.Tuple):
+                yield from val.elts
+            else:
+                yield val
+
+
+def _updates_argument(fn_node) -> bool:
+    """Does this function return an updated version of an argument?"""
+    params = param_names(fn_node)
+    if not params:
+        return False
+    tainted = taint_set(fn_node)
+    ann_classes = _annotation_classes(fn_node)
+
+    # name -> last assignment RHS, for one-hop resolution of returned names
+    last_rhs: dict = {}
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    last_rhs[t.id] = node.value
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for e in t.elts:
+                        if isinstance(e, ast.Name):
+                            last_rhs[e.id] = node.value
+        elif isinstance(node, ast.AugAssign) and isinstance(node.target, ast.Name):
+            last_rhs[node.target.id] = node.value
+
+    def expr_updates(expr) -> bool:
+        if isinstance(expr, ast.Call):
+            callee_last = dotted_last(expr.func)
+            arg_names = {
+                a.id for a in list(expr.args)
+                + [k.value for k in expr.keywords]
+                if isinstance(a, ast.Name)
+            }
+            # runner._replace(...) / dataclasses.replace(runner, ...)
+            if callee_last in ("_replace", "replace"):
+                base = (expr.func.value if isinstance(expr.func, ast.Attribute)
+                        else expr.args[0] if expr.args else None)
+                if isinstance(base, ast.Name) and base.id in tainted:
+                    return True
+            # optax.apply_updates(params, updates)
+            if callee_last == "apply_updates" and (arg_names & tainted):
+                return True
+            # RunnerState(...) where a param is annotated `: RunnerState`
+            if callee_last in ann_classes and (arg_names & tainted):
+                return True
+        return False
+
+    for expr in _returned_exprs(fn_node):
+        if expr_updates(expr):
+            return True
+        if isinstance(expr, ast.Name):
+            # One-hop resolution: `params = optax.apply_updates(...);
+            # return params` / `runner = runner._replace(...); return
+            # runner`. A returned name whose last assignment is NOT
+            # update-shaped (plain arithmetic rebinding) deliberately does
+            # not match — flagging every `x = x * s; return x` would be
+            # noise, not discipline.
+            rhs = last_rhs.get(expr.id)
+            if rhs is not None and expr_updates(rhs):
+                return True
+    return False
+
+
+@register
+class MissingDonate(Rule):
+    id = "GL004"
+    name = "missing-donate"
+    summary = ("jitted train-step-shaped function returns an updated "
+               "argument without donate_argnums")
+
+    def check(self, module: Module, ctx: LintContext) -> Iterator:
+        flagged = set()
+        for line, fn_name, has_donate in _jit_sites(module):
+            if has_donate:
+                continue
+            for rec in module.records_named(fn_name):
+                if (fn_name, line) in flagged:
+                    continue
+                if _updates_argument(rec.node):
+                    flagged.add((fn_name, line))
+                    yield self.finding(
+                        module, line,
+                        f"`{fn_name}` is jitted without donate_argnums but "
+                        "returns an updated version of an argument — the "
+                        "old and new pytrees stay live simultaneously "
+                        "(double HBM) and XLA cannot reuse the buffers",
+                    )

@@ -1,0 +1,160 @@
+"""GL001 host-sync-in-traced-scope and GL008 unbatched-host-transfers.
+
+GL001: inside a function that runs under a JAX trace, ``.item()``,
+``float()``/``int()``/``bool()`` on tracer-derived values, ``np.asarray``/
+``np.array``, and ``jax.device_get`` all force a device->host sync (or a
+ConcretizationTypeError at trace time). The same calls are FINE at adapter
+boundaries — ``env/gym_adapter.py`` converts a fetched timestep for the
+Gymnasium API — but fatal inside jitted bodies like the training update,
+where one stray ``float()`` serializes the whole async dispatch pipeline
+(~100 ms per sync through this repo's tunneled TPU, agent/loop.py).
+
+GL008: boundary code that converts SEVERAL fields of one device result
+with separate ``float()``/``bool()``/``np.asarray()`` calls pays one full
+device round-trip PER FIELD. Fetch the whole structure once with
+``jax.device_get`` and convert on the host — the exact fix measured in
+``env/gym_adapter.py`` (two syncs per env step became one).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.engine import (
+    LintContext,
+    Module,
+    dotted_last,
+    dotted_name,
+    tracer_valued_names,
+    walk_own,
+)
+from tools.graftlint.rules import Rule, register
+
+_CONVERTERS = ("float", "int", "bool")
+_NP_PULLS = ("asarray", "array")
+
+
+def _is_np_call(node: ast.Call, names=("np", "numpy")) -> bool:
+    return (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr in _NP_PULLS
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id in names
+    )
+
+
+@register
+class HostSyncInTracedScope(Rule):
+    id = "GL001"
+    name = "host-sync-in-traced-scope"
+    summary = ("device->host sync (.item()/float()/np.asarray/device_get) "
+               "inside a jit/vmap/scan-traced function")
+
+    def check(self, module: Module, ctx: LintContext) -> Iterator:
+        for rec in module.traced_functions():
+            tainted = rec.taint()
+            for node in walk_own(rec.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                # x.item() on a tracer-derived value
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item" and not node.args
+                        and tracer_valued_names(node.func.value, tainted)):
+                    yield self.finding(
+                        module, node.lineno,
+                        f"`.item()` on a tracer-derived value in traced "
+                        f"`{rec.qualname}` forces a host sync",
+                    )
+                # float(x) / int(x) / bool(x) on tracer-derived values
+                elif (isinstance(node.func, ast.Name)
+                        and node.func.id in _CONVERTERS and node.args
+                        and tracer_valued_names(node.args[0], tainted)):
+                    yield self.finding(
+                        module, node.lineno,
+                        f"`{node.func.id}()` on a tracer-derived value in "
+                        f"traced `{rec.qualname}` concretizes (host sync or "
+                        "ConcretizationTypeError)",
+                    )
+                # np.asarray / np.array pulls the value to host
+                elif _is_np_call(node) and node.args and \
+                        tracer_valued_names(node.args[0], tainted):
+                    yield self.finding(
+                        module, node.lineno,
+                        f"`{dotted_name(node.func)}` on a tracer-derived "
+                        f"value in traced `{rec.qualname}` materializes on "
+                        "host (use jnp.*)",
+                    )
+                # jax.device_get anywhere in a traced body
+                elif dotted_last(node.func) == "device_get":
+                    yield self.finding(
+                        module, node.lineno,
+                        f"`jax.device_get` inside traced `{rec.qualname}` "
+                        "— fetch AFTER the jitted call returns",
+                    )
+
+
+@register
+class UnbatchedHostTransfers(Rule):
+    id = "GL008"
+    name = "unbatched-host-transfers"
+    summary = ("multiple per-field host conversions of one device result "
+               "— batch them into a single jax.device_get")
+
+    # How many separate field conversions of the same result object it
+    # takes to flag: two conversions == two device round-trips.
+    THRESHOLD = 2
+
+    def check(self, module: Module, ctx: LintContext) -> Iterator:
+        for rec in module.functions:
+            if rec.traced:
+                continue  # traced scopes are GL001's jurisdiction
+            # Names bound by tuple-unpacking a call result (the
+            # `state, ts = step(...)` shape device APIs return). Single
+            # assignments are skipped on purpose: `args = parse_args()`
+            # style host objects would be false positives.
+            unpacked: set = set()
+            for node in walk_own(rec.node):
+                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                    callee = dotted_last(node.value.func) or ""
+                    if "parse" in callee.lower():
+                        # parse_args / parse_known_args / json parsing —
+                        # host objects whose field reads cost nothing.
+                        continue
+                    for t in node.targets:
+                        if isinstance(t, (ast.Tuple, ast.List)):
+                            unpacked.update(
+                                e.id for e in t.elts if isinstance(e, ast.Name)
+                            )
+            if not unpacked:
+                continue
+            # Every `float(ts.field)`-style call is one device round-trip;
+            # a device_get elsewhere in the function does NOT excuse the
+            # per-field conversions that remain outside it (a partial
+            # fetch still pays one sync per leftover field).
+            conversions: dict = {}  # base name -> [call nodes]
+            for node in walk_own(rec.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                is_converter = (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in _CONVERTERS
+                )
+                if not (is_converter or _is_np_call(node)) or not node.args:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Attribute) and \
+                        isinstance(arg.value, ast.Name):
+                    base = arg.value.id
+                    if base in unpacked:
+                        conversions.setdefault(base, []).append(node)
+            for base, calls in sorted(conversions.items()):
+                if len(calls) >= self.THRESHOLD:
+                    first = min(c.lineno for c in calls)
+                    yield self.finding(
+                        module, first,
+                        f"{len(calls)} separate host conversions of "
+                        f"`{base}.*` in `{rec.qualname}` — each is a device "
+                        f"round-trip; fetch once with "
+                        f"`jax.device_get(({base}.…,))`",
+                    )

@@ -1,0 +1,174 @@
+"""GL005 pallas-tile-misalignment: TPU tile shapes and the VMEM ceiling.
+
+TPU vector memory is tiled (8, 128) for float32 (sublanes x lanes; wider
+for narrower dtypes — (16, 128) bf16, (32, 128) int8). A Pallas BlockSpec
+or in-kernel buffer whose trailing dims are not multiples of that tile is
+silently padded UP to it: a (48, 100) f32 block occupies (48, 128) — 28%
+of the tile rows moved and computed for nothing — and lane-dim padding
+breaks the "whole block is MXU work" premise the fused kernels here are
+built on. This repo has already measured the failure mode: the deleted
+round-2 N=8 kernels underfilled 8x128 tiles and lost 3-5x to XLA
+(docs/status.md row 4), which is why ``ops/pallas_set_block.py`` refuses
+node counts below 32 that are not multiples of the 8-row sublane group —
+this rule is the static, repo-wide form of that guard.
+
+The rule also sums the statically-known per-block buffer footprints
+(literal BlockSpec shapes + ``pltpu.VMEM`` scratch) per ``pallas_call``
+against the ~16 MiB/core VMEM budget: a kernel that oversubscribes VMEM
+fails at Mosaic compile time on the TPU driver, which the CPU container
+(interpret mode) never sees — lint catches it before the chip does.
+
+Only applies to files that import ``jax.experimental.pallas`` (i.e. files
+that BUILD kernels — a test merely named ``test_pallas_*.py`` builds
+observation arrays, not blocks); only literal integer shapes are judged —
+symbolic shapes (``block_rows``, ``dim``) are the author's runtime
+contract, not lint's.
+"""
+
+from __future__ import annotations
+
+import ast
+import math
+from typing import Iterator
+
+from tools.graftlint.engine import LintContext, Module, dotted_last
+from tools.graftlint.rules import Rule, register
+
+SUBLANE = 8     # float32 second-minor tile dim
+LANE = 128      # minor tile dim
+VMEM_BYTES = 16 * 1024 * 1024
+# In-kernel / scratch allocations that live in VMEM per block. NOT
+# ShapeDtypeStruct: out_shape is the LOGICAL array — its per-block VMEM
+# residency is whatever the out_specs BlockSpec says.
+_SHAPED_ALLOCS = frozenset({"zeros", "ones", "full", "empty", "VMEM"})
+
+
+def _literal_shape(node: ast.AST) -> tuple | None:
+    """``(48, 100)`` -> (48, 100); None unless every element is an int
+    literal (symbolic shapes are out of scope)."""
+    if isinstance(node, (ast.Tuple, ast.List)) and node.elts:
+        dims = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                    and not isinstance(e.value, bool):
+                dims.append(e.value)
+            else:
+                return None
+        return tuple(dims)
+    return None
+
+
+def _misaligned(shape: tuple) -> str | None:
+    """Why ``shape`` underfills the f32 (8, 128) tile, or None if aligned.
+
+    A second-minor dim of exactly 1 is allowed (a single-row block is a
+    legal degenerate layout); everything else must fill whole sublane
+    groups and whole lanes.
+    """
+    if len(shape) == 0:
+        return None
+    lane = shape[-1]
+    if lane % LANE:
+        return (f"minor dim {lane} is not a multiple of {LANE} "
+                f"(padded to {math.ceil(lane / LANE) * LANE} lanes)")
+    if len(shape) >= 2:
+        sub = shape[-2]
+        if sub != 1 and sub % SUBLANE:
+            return (f"second-minor dim {sub} is not a multiple of "
+                    f"{SUBLANE} (padded to "
+                    f"{math.ceil(sub / SUBLANE) * SUBLANE} sublane rows)")
+    return None
+
+
+@register
+class PallasTileMisalignment(Rule):
+    id = "GL005"
+    name = "pallas-tile-misalignment"
+    summary = ("BlockSpec/buffer shape not a multiple of the (8, 128) f32 "
+               "TPU tile, or static VMEM footprint over the 16 MiB budget")
+
+    def applies(self, module: Module) -> bool:
+        # `from jax.experimental import pallas [as pl]` /
+        # `from jax.experimental.pallas import tpu as pltpu` /
+        # `import jax.experimental.pallas` — NOT repo modules whose own
+        # path merely contains "pallas".
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                if node.module.startswith("jax.experimental.pallas"):
+                    return True
+                if node.module == "jax.experimental" and any(
+                    alias.name == "pallas" for alias in node.names
+                ):
+                    return True
+            elif isinstance(node, ast.Import):
+                if any(alias.name.startswith("jax.experimental.pallas")
+                       for alias in node.names):
+                    return True
+        return False
+
+    def check(self, module: Module, ctx: LintContext) -> Iterator:
+        if not self.applies(module):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_last(node.func)
+            if callee == "BlockSpec":
+                shape_node = node.args[0] if node.args else None
+                for kw in node.keywords:
+                    if kw.arg == "block_shape":
+                        shape_node = kw.value
+                shape = _literal_shape(shape_node) if shape_node else None
+                if shape:
+                    why = _misaligned(shape)
+                    if why:
+                        yield self.finding(
+                            module, node.lineno,
+                            f"BlockSpec {shape}: {why} — pad the block "
+                            "shape (or restructure) to fill whole (8, 128) "
+                            "f32 tiles",
+                        )
+            elif callee in _SHAPED_ALLOCS and node.args:
+                shape = _literal_shape(node.args[0])
+                if shape and len(shape) >= 2:
+                    why = _misaligned(shape)
+                    if why:
+                        yield self.finding(
+                            module, node.lineno,
+                            f"`{callee}` buffer {shape}: {why}",
+                        )
+            elif callee == "pallas_call":
+                yield from self._vmem_budget(module, node)
+
+    def _vmem_budget(self, module: Module, call: ast.Call) -> Iterator:
+        """Sum literal f32 block footprints inside one pallas_call."""
+        total = 0
+        shapes = []
+        for node in ast.walk(call):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_last(node.func)
+            shape_node = None
+            if callee == "BlockSpec":
+                shape_node = node.args[0] if node.args else None
+                for kw in node.keywords:
+                    if kw.arg == "block_shape":
+                        shape_node = kw.value
+            elif callee == "VMEM" and node.args:
+                shape_node = node.args[0]
+            shape = _literal_shape(shape_node) if shape_node is not None else None
+            if shape:
+                padded = list(shape)
+                if padded:
+                    padded[-1] = math.ceil(padded[-1] / LANE) * LANE
+                if len(padded) >= 2:
+                    padded[-2] = math.ceil(padded[-2] / SUBLANE) * SUBLANE
+                total += 4 * math.prod(padded)  # f32 lower bound
+                shapes.append(shape)
+        if total > VMEM_BYTES:
+            yield self.finding(
+                module, call.lineno,
+                f"pallas_call static VMEM footprint ~{total / 2**20:.1f} "
+                f"MiB from literal block shapes {shapes} exceeds the "
+                f"~16 MiB/core budget — shrink the block or re-tile",
+            )

@@ -1,0 +1,228 @@
+"""GL002 prng-key-reuse: one key, two draws.
+
+JAX PRNG keys are values, not stateful generators: feeding the same key to
+two sampling calls yields CORRELATED (often identical) randomness — the
+classic silent RL bug where exploration noise repeats or env resets
+duplicate across a batch, degrading training with no error anywhere. The
+42+ ``jax.random.*`` sites across this repo were audited by hand until
+this rule; now the discipline (``split``/``fold_in`` before every
+consumption) is machine-checked.
+
+Two patterns are flagged:
+
+- **Linear reuse**: the same key variable consumed by two sampler calls
+  with no intervening ``split``/``fold_in``/reassignment.
+- **Loop-carried reuse**: a key consumed inside a ``for``/``while`` body
+  that never reassigns it — every iteration draws with the same key.
+
+``split`` and ``fold_in`` are derivations, not consumptions: deriving
+twice from one key (``fold_in(key, i)`` per step) is the intended idiom.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.engine import LintContext, Module, dotted_name
+from tools.graftlint.rules import Rule, register
+
+# jax.random.* callees that CONSUME entropy. Everything else on the module
+# (split, fold_in, PRNGKey, key, wrap_key_data, key_data, clone, ...)
+# derives or constructs.
+_NON_CONSUMING = frozenset({
+    "split", "fold_in", "PRNGKey", "key", "wrap_key_data", "key_data",
+    "clone", "key_impl",
+})
+
+_KEY_SOURCES = frozenset({"PRNGKey", "split", "fold_in", "key"})
+
+
+def _random_callee(node: ast.Call) -> str | None:
+    """``jax.random.categorical(...)`` -> ``categorical``; None if the call
+    is not on a ``random`` module path."""
+    name = dotted_name(node.func)
+    if not name:
+        return None
+    parts = name.split(".")
+    if len(parts) >= 2 and parts[-2] == "random":
+        return parts[-1]
+    return None
+
+
+@register
+class PRNGKeyReuse(Rule):
+    id = "GL002"
+    name = "prng-key-reuse"
+    summary = ("the same PRNG key consumed by two sampling calls without "
+               "an intervening split/fold_in")
+
+    def check(self, module: Module, ctx: LintContext) -> Iterator:
+        for rec in module.functions:
+            yield from self._check_function(module, rec)
+
+    # ------------------------------------------------------------------
+
+    def _key_names(self, fn_node) -> set:
+        """Names that hold PRNG keys: assigned from PRNGKey/split/fold_in
+        (incl. tuple-unpacked split results) or key-ish parameters."""
+        names = set()
+        args = fn_node.args
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            low = a.arg.lower()
+            if low == "rng" or low.endswith("key") or low.startswith("rng"):
+                names.add(a.arg)
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Assign):
+                callee = (
+                    _random_callee(node.value)
+                    if isinstance(node.value, ast.Call) else None
+                )
+                if callee in _KEY_SOURCES:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+                        elif isinstance(t, (ast.Tuple, ast.List)):
+                            names.update(
+                                e.id for e in t.elts if isinstance(e, ast.Name)
+                            )
+        return names
+
+    def _check_function(self, module: Module, rec) -> Iterator:
+        fn = rec.node
+        keys = self._key_names(fn)
+        if not keys:
+            return
+
+        # consumed[name] = line of the consuming sampler call since the
+        # last (re)assignment of `name`.
+        consumed: dict = {}
+
+        def assigned_names(stmt) -> set:
+            out = set()
+
+            def collect(t):
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for e in t.elts:
+                        collect(e)
+
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    collect(t)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                collect(stmt.target)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                collect(stmt.target)
+            return out
+
+        def key_args(call: ast.Call) -> set:
+            used = set()
+            for a in list(call.args) + [k.value for k in call.keywords]:
+                if isinstance(a, ast.Name) and a.id in keys:
+                    used.add(a.id)
+            return used
+
+        def scan_expr(expr, findings):
+            """Consumption events in one expression, inner-first."""
+            for node in ast.walk(expr):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _random_callee(node)
+                if callee is None or callee in _NON_CONSUMING:
+                    continue
+                for name in sorted(key_args(node)):
+                    if name in consumed:
+                        findings.append((node.lineno, name, consumed[name]))
+                    consumed[name] = node.lineno
+
+        def walk_block(stmts, loop_depth, loop_assigned):
+            findings: list = []
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                # Consumptions in this statement's expressions.
+                for field, value in ast.iter_fields(stmt):
+                    if field in ("body", "orelse", "finalbody", "handlers"):
+                        continue
+                    if isinstance(value, ast.AST):
+                        scan_expr(value, findings)
+                    elif isinstance(value, list):
+                        for item in value:
+                            if isinstance(item, ast.AST):
+                                scan_expr(item, findings)
+                # Then assignments clear the consumed state.
+                for name in assigned_names(stmt):
+                    consumed.pop(name, None)
+                    if loop_depth:
+                        loop_assigned.add(name)
+                # Recurse into compound bodies.
+                if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                    inner_assigned: set = set()
+                    inner = walk_block(
+                        stmt.body, loop_depth + 1, inner_assigned
+                    )
+                    findings.extend(inner)
+                    # Loop-carried reuse: consumed in the body, never
+                    # reassigned in the body -> same key every iteration.
+                    for name, line in sorted(consumed.items()):
+                        body_lines = range(stmt.body[0].lineno,
+                                           (stmt.end_lineno or line) + 1)
+                        if name not in inner_assigned and line in body_lines:
+                            findings.append((line, name, "loop"))
+                            consumed.pop(name, None)
+                    findings.extend(
+                        walk_block(stmt.orelse, loop_depth, loop_assigned)
+                    )
+                elif isinstance(stmt, ast.If) or (
+                    hasattr(ast, "Match") and isinstance(stmt, ast.Match)
+                ):
+                    # if/else arms (and match cases) are mutually
+                    # exclusive: each starts from the pre-branch state;
+                    # afterwards a key counts as consumed if ANY arm
+                    # consumed it (conservative for what follows, no
+                    # false reuse across arms).
+                    arms = (
+                        [stmt.body, stmt.orelse] if isinstance(stmt, ast.If)
+                        else [case.body for case in stmt.cases]
+                    )
+                    before = dict(consumed)
+                    merged = dict(consumed)
+                    for arm in arms:
+                        consumed.clear()
+                        consumed.update(before)
+                        findings.extend(
+                            walk_block(arm, loop_depth, loop_assigned)
+                        )
+                        merged.update(consumed)
+                    consumed.clear()
+                    consumed.update(merged)
+                else:
+                    for field in ("body", "orelse", "finalbody"):
+                        findings.extend(walk_block(
+                            getattr(stmt, field, []) or [],
+                            loop_depth, loop_assigned,
+                        ))
+                    for handler in getattr(stmt, "handlers", []) or []:
+                        findings.extend(
+                            walk_block(handler.body, loop_depth, loop_assigned)
+                        )
+            return findings
+
+        for lineno, name, prior in walk_block(fn.body, 0, set()):
+            if prior == "loop":
+                msg = (
+                    f"key `{name}` consumed inside a loop in "
+                    f"`{rec.qualname}` without reassignment — every "
+                    "iteration draws with the SAME key (split or fold_in "
+                    "per iteration)"
+                )
+            else:
+                msg = (
+                    f"key `{name}` already consumed on line {prior} of "
+                    f"`{rec.qualname}` — two draws from one key are "
+                    "correlated; split/fold_in first"
+                )
+            yield self.finding(module, lineno, msg)

@@ -1,0 +1,62 @@
+"""GL007 untested-public-op: public kernels/collectives nobody tests.
+
+``ops/`` and ``parallel/`` hold the code with the widest
+container-vs-driver behavior gap: Pallas kernels run in interpret mode on
+CPU but compile through Mosaic on the TPU driver, and collectives change
+behavior across the JAX version split (``parallel/mesh.py``'s shims exist
+for exactly that). A public function there with NO reference anywhere in
+``tests/`` has zero parity coverage on either side — historically how
+"correct" kernels shipped with 10x roofline gaps (docs/roofline.md).
+
+The check is a name-reference scan of the configured test corpus, not a
+coverage run: pure-AST/text, so it is identical on both JAX versions and
+costs milliseconds. Underscore-prefixed functions, dunders, and
+re-exports referenced via ``__all__`` conventions are out of scope —
+public API only.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from tools.graftlint.engine import LintContext, Module
+from tools.graftlint.rules import Rule, register
+
+# Path segments whose public functions must be referenced from tests.
+OP_DIRS = frozenset({"ops", "parallel"})
+
+
+@register
+class UntestedPublicOp(Rule):
+    id = "GL007"
+    name = "untested-public-op"
+    summary = ("public function in ops/ or parallel/ with no reference "
+               "anywhere in the test corpus")
+
+    def applies(self, module: Module) -> bool:
+        parts = set(module.rel.split("/")[:-1])
+        return bool(parts & OP_DIRS)
+
+    def check(self, module: Module, ctx: LintContext) -> Iterator:
+        if not self.applies(module):
+            return
+        corpus = ctx.test_corpus()
+        for node in module.tree.body:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                continue
+            name = node.name
+            if name.startswith("_"):
+                continue
+            if re.search(rf"\b{re.escape(name)}\b", corpus):
+                continue
+            kind = "class" if isinstance(node, ast.ClassDef) else "function"
+            yield self.finding(
+                module, node.lineno,
+                f"public {kind} `{name}` has no reference in the test "
+                "corpus — ops/parallel code is where CPU-interpret and "
+                "TPU-Mosaic behavior diverge; add at least a parity or "
+                "shape test",
+            )

@@ -1,0 +1,79 @@
+"""GL006 weak-type-leak: dtype-less float literals materialized in traced
+code.
+
+``jnp.array(0.5)`` / ``jnp.asarray(1e-6)`` / ``jnp.full(shape, 0.1)``
+without an explicit ``dtype`` produce WEAK-typed arrays. Two silent
+failure modes follow:
+
+- **Cache-key churn**: weak and strong types are different jit cache
+  entries, so the "same" function retraces when a weak constant meets a
+  strong one — the runtime twin of this rule is the recompilation
+  regression test (``tests/test_recompile.py``).
+- **Promotion drift**: a weak f32 scalar flowing into bf16 math silently
+  promotes the whole expression back to f32, undoing a deliberate
+  ``compute_dtype=bfloat16`` choice (the torso-matmul knob in
+  ``agent/ppo.py``) with no error anywhere — only a slower profile.
+
+Bare Python literals in arithmetic (``x * 0.5``) are FINE — they stay
+weak scalars and adopt the array operand's dtype; the leak is
+materializing a literal as an ARRAY without saying which dtype.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.engine import LintContext, Module, dotted_name, walk_own
+from tools.graftlint.rules import Rule, register
+
+# jnp constructors whose literal-value argument takes the weak type.
+# fn name -> index of the value argument to inspect.
+_CONSTRUCTORS = {"array": 0, "asarray": 0, "full": 1, "full_like": 1}
+
+
+def _float_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, float):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, (ast.USub, ast.UAdd)):
+        return _float_literal(node.operand)
+    return False
+
+
+@register
+class WeakTypeLeak(Rule):
+    id = "GL006"
+    name = "weak-type-leak"
+    summary = ("dtype-less jnp.array/asarray/full of a float literal in "
+               "traced code — weak type churns the jit cache key and "
+               "promotes dtypes")
+
+    def check(self, module: Module, ctx: LintContext) -> Iterator:
+        for rec in module.traced_functions():
+            for node in walk_own(rec.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if not name:
+                    continue
+                parts = name.split(".")
+                if parts[0] not in ("jnp", "jax", "numpy", "np"):
+                    continue
+                fn = parts[-1]
+                if fn not in _CONSTRUCTORS:
+                    continue
+                value_idx = _CONSTRUCTORS[fn]
+                args = list(node.args)
+                if len(args) <= value_idx or not _float_literal(args[value_idx]):
+                    continue
+                has_dtype = any(k.arg == "dtype" for k in node.keywords) or \
+                    len(args) > value_idx + 1  # positional dtype
+                if has_dtype:
+                    continue
+                yield self.finding(
+                    module, node.lineno,
+                    f"`{name}(...)` materializes a float literal with no "
+                    f"dtype in traced `{rec.qualname}` — the weak-typed "
+                    "array churns the jit cache key and can silently "
+                    "promote bf16 math to f32; pass dtype= explicitly",
+                )
