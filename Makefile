@@ -1,15 +1,28 @@
-# Convenience entry points. The pytest gate (tests/test_graftlint.py) is
-# the source of truth for lint; `make lint` is the same check, standalone.
+# Convenience entry points. The pytest gates (tests/test_graftlint.py,
+# tests/test_traceview.py) are the source of truth; `make lint` / `make
+# obs` are the same checks, standalone.
 
 PY ?= python
+# Trace under inspection: defaults to the checked-in fixture so the obs
+# gate is self-contained; point TRACE at a profiler log dir (e.g.
+# `train_ppo --profile-dir`) to summarize/check a real run.
+TRACE ?= tests/fixtures/traceview/fixture.trace.json.gz
 
-.PHONY: lint lint-json test tier1
+.PHONY: lint lint-json test tier1 trace-summary obs
 
 lint:
 	$(PY) -m tools.graftlint --check
 
 lint-json:
 	$(PY) -m tools.graftlint --check --json
+
+trace-summary:
+	$(PY) -m tools.traceview $(TRACE)
+
+# lint's observability neighbor: phase budgets enforced the same way
+# graftlint findings are (exit nonzero on a >tolerance regression).
+obs:
+	$(PY) -m tools.traceview --check --budgets tools/traceview/budgets.json $(TRACE)
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

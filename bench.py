@@ -39,6 +39,8 @@ def _window_steps_per_sec(init_fn, update_fn, batch_size: int,
     """Best-of-N fetch-synced window throughput (module docstring)."""
     import jax
 
+    from rl_scheduler_tpu.utils.profiling import fetch_sync
+
     runner = jax.jit(init_fn)(jax.random.PRNGKey(0))
 
     def window(r):
@@ -47,12 +49,14 @@ def _window_steps_per_sec(init_fn, update_fn, batch_size: int,
     update = jax.jit(window, donate_argnums=0)
 
     def sync(r) -> float:
-        # Fetch a parameter value: params depend on EVERY SGD phase of the
+        # Fetch over the PARAMS: they depend on EVERY SGD phase of the
         # window including the last iteration's (a metric like reward_mean
         # would not cover the final SGD tail), so this provably waits for
-        # the whole window on every backend (see module docstring).
-        leaf = jax.tree.leaves(r.params)[0]
-        return float(jax.device_get(leaf).ravel()[0])
+        # the whole window on every backend. The sync-by-fetching
+        # discipline itself lives in utils/profiling.fetch_sync (shared
+        # with StepTimer) — see that docstring for why block_until_ready
+        # is not trusted here.
+        return fetch_sync(r.params)
 
     # Warmup: compile + one full window.
     runner, metrics = update(runner)
@@ -132,7 +136,62 @@ def fleet_metric() -> dict:
     }
 
 
-def main() -> None:
+def graftscope_ab(preset: str = "tpu4096") -> dict:
+    """Same-process A/B (ISSUE 4 acceptance): the graftscope-instrumented
+    train window vs the uninstrumented one, identical fetch-synced window
+    methodology. The instrumented update compiles the full PPO scope spec
+    in (Welford stats, grad-norm/ratio/advantage/action histograms); the
+    scan window stacks its per-iteration MetricsState exactly as a fused
+    dispatch does. Acceptance: overhead_pct within 2 at config 3
+    (``preset="tpu4096"``, the default — run it on the chip; the config-3
+    windows do not finish in tractable time on the CPU container, where
+    ``--ab-preset tpu64`` is the same-methodology stand-in)."""
+    import jax
+
+    from rl_scheduler_tpu.agent.ppo import make_ppo
+    from rl_scheduler_tpu.agent.presets import PPO_PRESETS
+    from rl_scheduler_tpu.config import EnvConfig
+    from rl_scheduler_tpu.env import core as env_core
+    from rl_scheduler_tpu.utils.metrics import ppo_scope_spec
+
+    cfg = PPO_PRESETS[preset]
+    env_params = env_core.make_params(EnvConfig())
+
+    init_fn, update_fn, _ = make_ppo(env_params, cfg)
+    plain = _window_steps_per_sec(init_fn, update_fn, cfg.batch_size)
+
+    spec = ppo_scope_spec(env_core.NUM_ACTIONS)
+    init_fn, update_fn, _ = make_ppo(env_params, cfg, scope=spec)
+    scoped = _window_steps_per_sec(init_fn, update_fn, cfg.batch_size)
+
+    overhead_pct = (plain - scoped) / plain * 100.0
+    return {
+        "metric": f"graftscope A/B overhead ({preset}, fetch-synced windows)",
+        "preset": preset,
+        "plain_steps_per_sec": round(plain, 1),
+        "instrumented_steps_per_sec": round(scoped, 1),
+        "overhead_pct": round(overhead_pct, 2),
+        "backend": jax.devices()[0].platform,
+        "within_2pct": bool(overhead_pct <= 2.0),
+    }
+
+
+def main(argv: list | None = None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--graftscope-ab", action="store_true",
+                   help="print ONE JSON line instead: instrumented-vs-"
+                        "plain window throughput "
+                        "(docs/observability.md A/B)")
+    p.add_argument("--ab-preset", default="tpu4096",
+                   help="PPO preset for the A/B (default tpu4096 = "
+                        "config 3, the acceptance config — chip-sized; "
+                        "use tpu64 on the CPU container)")
+    args = p.parse_args(argv)
+    if args.graftscope_ab:
+        print(json.dumps(graftscope_ab(args.ab_preset)), flush=True)
+        return
     print(json.dumps(headline_metric()), flush=True)
     print(json.dumps(fleet_metric()), flush=True)
 

@@ -153,9 +153,27 @@ def epsilon_by_step(cfg: DQNConfig, env_steps: jnp.ndarray) -> jnp.ndarray:
 
 
 def make_dqn(
-    bundle: EnvBundle, cfg: DQNConfig, net: Any | None = None
+    bundle: EnvBundle, cfg: DQNConfig, net: Any | None = None,
+    scope: Any | None = None
 ) -> tuple[Callable, Callable, Any]:
-    """Build ``(init_fn, update_fn, net)``; both are pure and jit-safe."""
+    """Build ``(init_fn, update_fn, net)``; both are pure and jit-safe.
+
+    ``scope``: a graftscope MetricsSpec (``utils/metrics.dqn_scope_spec``).
+    When set, the update returns device-resident stats/histograms over the
+    replay batch (reward/td/q streams, grad norm, replayed-action counts)
+    under the ``"graftscope"`` metrics key — no host syncs; the loop
+    flushes one summary per window. During buffer warm-up the skipped
+    learner observes grad_norm 0 (visible underflow-bucket spike, by
+    design). ``None`` leaves the update byte-identical."""
+    if scope is not None:
+        from rl_scheduler_tpu.utils.metrics import validate_spec
+
+        # Build-time guard (same contract as make_ppo_bundle): unknown
+        # stream names fail here with the available set spelled out.
+        validate_spec(
+            scope,
+            values=("reward", "td_abs", "q_mean", "grad_norm", "action"),
+            context="make_dqn(scope=...)")
     net = net or QNetwork(num_actions=bundle.num_actions, hidden=cfg.hidden)
     tx = optax.adam(cfg.lr)
 
@@ -307,6 +325,8 @@ def make_dqn(
             return loss, {"loss": loss, **aux}
 
         (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        if scope is not None:
+            metrics["grad_norm"] = optax.global_norm(grads)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         target_params = optax.incremental_update(params, target_params, cfg.target_tau)
@@ -314,7 +334,8 @@ def make_dqn(
 
     def update_fn(runner: DQNRunnerState):
         """One iteration: collect transitions, then learn (once warm)."""
-        (buf, env_state, obs, key, ep_ret, ep_stat), eps = collect_fn(runner)
+        with jax.named_scope("collect"):
+            (buf, env_state, obs, key, ep_ret, ep_stat), eps = collect_fn(runner)
         key, skey = jax.random.split(key)
         batch = buffer_sample(buf, skey, cfg.batch_size)
 
@@ -327,11 +348,29 @@ def make_dqn(
                 "q_mean": jnp.zeros(()),
                 "td_abs_mean": jnp.zeros(()),
             }
+            if scope is not None:
+                zero["grad_norm"] = jnp.zeros(())
             return runner.params, runner.target_params, runner.opt_state, zero
 
-        params, target_params, opt_state, metrics = jax.lax.cond(
-            buf.size >= cfg.learning_starts, do_learn, skip, None
-        )
+        with jax.named_scope("learn"):
+            params, target_params, opt_state, metrics = jax.lax.cond(
+                buf.size >= cfg.learning_starts, do_learn, skip, None
+            )
+        scope_state = None
+        if scope is not None:
+            from rl_scheduler_tpu.utils.metrics import scope_observe
+
+            with jax.named_scope("scope_metrics"):
+                scope_state = scope_observe(
+                    scope,
+                    values={
+                        "reward": batch["reward"],
+                        "td_abs": metrics["td_abs_mean"],
+                        "q_mean": metrics["q_mean"],
+                        "grad_norm": metrics["grad_norm"],
+                        "action": batch["action"],
+                    },
+                )
         new_runner = DQNRunnerState(
             params=params,
             target_params=target_params,
@@ -350,6 +389,8 @@ def make_dqn(
             "buffer_size": buf.size,
             "episode_reward_mean": ep_stat,
         }
+        if scope_state is not None:
+            metrics["graftscope"] = scope_state
         return new_runner, metrics
 
     return init_fn, update_fn, net
@@ -366,8 +407,13 @@ def dqn_train(
     eval_log_fn: Callable[[int, dict], None] | None = None,
     debug_checks: bool = False,
     updates_per_dispatch: int = 1,
+    scope: Any | None = None,
+    observer: Any | None = None,
 ):
     """Host-side training loop mirroring :func:`rl_scheduler_tpu.agent.ppo.ppo_train`.
+
+    ``scope``/``observer``: graftscope instrumentation, exactly as in
+    ``ppo_train`` (see :func:`make_dqn` for the DQN watch set).
 
     ``sync_every`` batches device->host metric fetches exactly as in
     ``ppo_train``; ``updates_per_dispatch=k`` goes further and fuses ``k``
@@ -390,7 +436,7 @@ def dqn_train(
     from rl_scheduler_tpu.agent.loop import make_update, run_train_loop
     from rl_scheduler_tpu.agent.ppo import make_greedy_eval_hook
 
-    init_fn, update_fn, net = make_dqn(bundle, cfg)
+    init_fn, update_fn, net = make_dqn(bundle, cfg, scope=scope)
     runner = jax.jit(init_fn)(jax.random.PRNGKey(seed))
     update = make_update(update_fn, debug_checks, updates_per_dispatch)
     eval_hook = make_greedy_eval_hook(
@@ -400,5 +446,5 @@ def dqn_train(
         update, runner, 0, num_iterations,
         sync_every=sync_every, log_fn=log_fn, checkpoint_fn=checkpoint_fn,
         eval_every=cfg.eval_every, eval_hook=eval_hook,
-        updates_per_dispatch=updates_per_dispatch,
+        updates_per_dispatch=updates_per_dispatch, observer=observer,
     )

@@ -38,8 +38,18 @@ def run_train_loop(
     eval_every: int = 0,
     eval_hook: Callable[[int, Any], None] | None = None,
     updates_per_dispatch: int = 1,
+    observer: Any | None = None,
 ) -> tuple[Any, list[dict]]:
     """Run ``update`` for iterations ``[start_iteration, num_iterations)``.
+
+    ``observer`` (graftscope, ``utils/metrics.TrainObserver``) gets three
+    hooks: ``observe(i0, metrics, k) -> metrics`` right after each
+    dispatch (device-side bookkeeping; it pops the non-scalar
+    ``"graftscope"`` state out of the metrics dict before the loop
+    fetches/logs), ``after_log(i, row)`` per fetched row (host-side
+    anomaly checks), and ``close()`` in the loop's ``finally`` (final
+    partial-window flush). Without an observer, a stray ``"graftscope"``
+    key is dropped so the scalar flush below stays well-typed.
 
     With ``eval_every > 0`` and an ``eval_hook``, the hook fires after
     every ``eval_every``-th iteration (reference semantics:
@@ -93,6 +103,8 @@ def run_train_loop(
                 history.append(row)
                 if log_fn is not None:
                     log_fn(j0 + j, row)
+                if observer is not None:
+                    observer.after_log(j0 + j, row)
 
     k = max(1, updates_per_dispatch)
     if (num_iterations - start_iteration) % k:
@@ -131,6 +143,14 @@ def run_train_loop(
     try:
         for i0 in range(start_iteration, num_iterations, k):
             runner, metrics = update(runner)
+            if observer is not None:
+                metrics = observer.observe(i0, metrics, k)
+            elif isinstance(metrics, dict) and "graftscope" in metrics:
+                # Scope-instrumented update without an observer (direct
+                # ppo_train(scope=...) callers): drop the non-scalar
+                # state so the flush below stays well-typed.
+                metrics = {k2: v for k2, v in metrics.items()
+                           if k2 != "graftscope"}
             pending.append((i0, metrics, k))
             i = i0 + k - 1
             covered = sum(kk for _, _, kk in pending)
@@ -143,7 +163,11 @@ def run_train_loop(
                 flush()
                 eval_hook(i, runner)
     finally:
-        flush()
+        try:
+            flush()
+        finally:
+            if observer is not None:
+                observer.close()
     return runner, history
 
 
@@ -208,6 +232,70 @@ def make_jsonl_log_fn(
             print_line(i, sps, metrics)
 
     return log_fn
+
+
+def make_scope_log_fn(
+    metrics_file: Any,
+    tb: TensorBoardLogger | None = None,
+) -> Callable[[int, dict], None]:
+    """Standard CLI sink for graftscope window summaries: one JSONL line
+    tagged ``"graftscope": true`` (so analysis can split the stream, same
+    convention as the eval sink), scalar entries mirrored to TensorBoard
+    (histogram dicts stay JSONL-only)."""
+
+    def scope_log_fn(i: int, summary: dict) -> None:
+        line = {"iteration": i + 1, "graftscope": True, **summary}
+        metrics_file.write(json.dumps(line) + "\n")
+        metrics_file.flush()
+        if tb is not None:
+            tb.add(i + 1, {k: v for k, v in summary.items()
+                           if isinstance(v, (int, float))})
+
+    return scope_log_fn
+
+
+def validate_metrics_window(window: int, updates_per_dispatch: int) -> None:
+    """The train CLIs' shared ``--metrics-window`` validation; SystemExit
+    with the flag-level message on misuse so both CLIs reject identically."""
+    if window < 0:
+        raise SystemExit(
+            f"--metrics-window {window}: pass a positive "
+            "iteration count (0 disables)"
+        )
+    if window and window % max(1, updates_per_dispatch):
+        raise SystemExit(
+            f"--metrics-window {window} is not a multiple of "
+            f"--updates-per-dispatch {updates_per_dispatch}: windows "
+            "are observed at dispatch boundaries, so the flush cadence "
+            "would silently drift (pick a multiple)"
+        )
+
+
+def make_graftscope(spec, window: int, run_dir, metrics_file,
+                    tb: TensorBoardLogger | None, config: dict):
+    """One-stop graftscope construction for the train CLIs: a ScopeSession
+    flushing window summaries through :func:`make_scope_log_fn`, a flight
+    recorder with a run manifest under ``run_dir``, and the TrainObserver
+    tying both into ``run_train_loop``. Returns ``(observer, recorder)`` —
+    one shared builder so the manifest fields and artifact layout cannot
+    drift between the PPO and DQN CLIs."""
+    from pathlib import Path
+
+    from rl_scheduler_tpu.utils.flight_recorder import (
+        FlightRecorder,
+        build_manifest,
+    )
+    from rl_scheduler_tpu.utils.metrics import ScopeSession, TrainObserver
+
+    session = ScopeSession(spec, window, make_scope_log_fn(metrics_file, tb))
+    recorder = FlightRecorder(
+        path=Path(run_dir) / "flight_recorder.jsonl",
+        manifest=build_manifest(config=config),
+    )
+    observer = TrainObserver(session, recorder)
+    print(f"graftscope: metrics window {window}, flight "
+          f"recorder ring {recorder.capacity} -> {recorder.path}")
+    return observer, recorder
 
 
 def make_update(

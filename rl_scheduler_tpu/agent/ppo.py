@@ -159,8 +159,20 @@ def make_ppo_bundle(
     net: Any | None = None,
     axis_name: str | None = None,
     tx: optax.GradientTransformation | None = None,
+    scope: Any | None = None,
 ) -> tuple[Callable, Callable, Any]:
     """Build ``(init_fn, update_fn, net)`` for ANY :class:`EnvBundle`.
+
+    ``scope``: a graftscope :class:`~rl_scheduler_tpu.utils.metrics.
+    MetricsSpec`. When set, the update also computes device-resident
+    distribution metrics — advantage/reward/value stats + histograms,
+    per-minibatch grad norms, the PPO ratio histogram (bucketized inside
+    the SGD scan), per-action counts — and returns them under the
+    ``"graftscope"`` metrics key as a :data:`MetricsState` pytree. The
+    host loop merges those states on device and fetches ONE summary per
+    logging window (``utils/metrics.ScopeSession``); nothing here ever
+    syncs. ``None`` (the default) leaves the update byte-identical to the
+    un-instrumented build.
 
     ``init_fn(key) -> RunnerState``; ``update_fn(runner) -> (runner, metrics)``
     is pure and jit/shard_map-safe — it performs one full PPO iteration:
@@ -177,6 +189,16 @@ def make_ppo_bundle(
     ``(logits [B, num_actions], value [B])`` — MLPs over flat obs and
     set-transformer / GNN policies over structured obs all fit.
     """
+    if scope is not None:
+        from rl_scheduler_tpu.utils.metrics import validate_spec
+
+        # Build-time, so a custom spec naming a stream this trainer does
+        # not produce fails with the available names spelled out instead
+        # of a KeyError from inside the first traced update.
+        validate_spec(
+            scope,
+            values=("advantage", "reward", "value", "action", "grad_norm"),
+            counts=("ratio",), context="make_ppo_bundle(scope=...)")
     compute_dtypes = {"float32": None, "bfloat16": jnp.bfloat16}
     if cfg.compute_dtype not in compute_dtypes:
         raise ValueError(
@@ -325,12 +347,16 @@ def make_ppo_bundle(
     collect = rollout_open_loop if use_open_loop else rollout
 
     def update_fn(runner: RunnerState):
-        env_state, obs, key, ep_ret, traj, last_value = collect(runner)
+        # named_scope: zero-cost trace annotations that let
+        # tools/traceview attribute profiler events to training phases.
+        with jax.named_scope("rollout"):
+            env_state, obs, key, ep_ret, traj, last_value = collect(runner)
 
-        advantages, targets = gae_op(
-            traj["reward"], traj["value"], traj["done"], last_value,
-            cfg.gamma, cfg.gae_lambda, impl=cfg.gae_impl,
-        )
+        with jax.named_scope("gae"):
+            advantages, targets = gae_op(
+                traj["reward"], traj["value"], traj["done"], last_value,
+                cfg.gamma, cfg.gae_lambda, impl=cfg.gae_impl,
+            )
 
         # Pack every per-sample field into ONE [B, K] f32 matrix. The epoch
         # shuffle then needs a single 2-D row gather instead of six 1-D
@@ -363,6 +389,15 @@ def make_ppo_bundle(
             }
 
         loss_cfg = cfg.loss_config()
+        ratio_hist = None
+        if scope is not None:
+            ratio_hist = next(
+                (h for h in scope.hists if h.name == "ratio"), None)
+        if ratio_hist is not None:
+            # Ratio counts are bucketized inside ppo_loss (static edges
+            # from the spec) so the per-sample ratio array reduces in
+            # place instead of stacking [epochs, minibatches, B].
+            loss_cfg = loss_cfg._replace(ratio_hist_edges=ratio_hist.edges)
         # Minibatches keep the exact configured size (static shapes for XLA);
         # when minibatch_size does not divide the batch, each epoch trains on
         # a fresh random subset of num_minibatches*minibatch_size samples —
@@ -380,6 +415,11 @@ def make_ppo_bundle(
             params, opt_state = carry
             mb = unpack(mb_rows)
             (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, mb)
+            if scope is not None:
+                # Pre-clip global grad norm, one scalar per minibatch —
+                # the flight recorder's spike signal and the scope's
+                # grad_norm stream.
+                metrics["grad_norm"] = optax.global_norm(grads)
             if axis_name is not None:
                 # Data-parallel gradient sync over the mesh axis (ICI
                 # all-reduce); identity in the single-device path.
@@ -407,10 +447,35 @@ def make_ppo_bundle(
             return (params, opt_state), metrics
 
         key, shuffle_key = jax.random.split(key)
-        epoch_keys = jax.random.split(shuffle_key, cfg.num_epochs)
-        (params, opt_state), loss_metrics = jax.lax.scan(
-            sgd_epoch, (runner.params, runner.opt_state), epoch_keys
-        )
+        with jax.named_scope("sgd"):
+            epoch_keys = jax.random.split(shuffle_key, cfg.num_epochs)
+            (params, opt_state), loss_metrics = jax.lax.scan(
+                sgd_epoch, (runner.params, runner.opt_state), epoch_keys
+            )
+
+        scope_state = None
+        if scope is not None:
+            from rl_scheduler_tpu.utils.metrics import scope_observe
+
+            with jax.named_scope("scope_metrics"):
+                # hist_ratio arrives [epochs, minibatches, buckets] from
+                # the scans; grad_norm [epochs, minibatches]. Reduce both
+                # here — still inside the one XLA program.
+                counts = {}
+                if "hist_ratio" in loss_metrics:
+                    counts["ratio"] = jnp.sum(
+                        loss_metrics.pop("hist_ratio"), axis=(0, 1))
+                scope_state = scope_observe(
+                    scope,
+                    values={
+                        "advantage": advantages,
+                        "reward": traj["reward"],
+                        "value": traj["value"],
+                        "action": traj["action"],
+                        "grad_norm": loss_metrics["grad_norm"],
+                    },
+                    counts=counts,
+                )
 
         num_completed = jnp.sum(traj["done"])
         metrics = {
@@ -422,6 +487,10 @@ def make_ppo_bundle(
         }
         if axis_name is not None:
             metrics = jax.lax.pmean(metrics, axis_name)
+        if scope_state is not None:
+            # Rides out of the jitted update as ordinary pytree leaves;
+            # the host loop pops it before logging (TrainObserver).
+            metrics["graftscope"] = scope_state
         new_runner = RunnerState(
             params=params,
             opt_state=opt_state,
@@ -441,9 +510,11 @@ def make_ppo(
     cfg: PPOTrainConfig,
     net: Any | None = None,
     axis_name: str | None = None,
+    scope: Any | None = None,
 ) -> tuple[Callable, Callable, Any]:
     """:func:`make_ppo_bundle` specialized to the flagship multi-cloud env."""
-    return make_ppo_bundle(multi_cloud_bundle(env_params), cfg, net, axis_name)
+    return make_ppo_bundle(multi_cloud_bundle(env_params), cfg, net,
+                           axis_name, scope=scope)
 
 
 def ppo_train(
@@ -461,8 +532,18 @@ def ppo_train(
     updates_per_dispatch: int = 1,
     mesh=None,
     eval_net: Any | None = None,
+    scope: Any | None = None,
+    observer: Any | None = None,
 ):
     """Host-side training loop: jitted update per iteration + logging hooks.
+
+    ``scope``/``observer``: graftscope instrumentation (see
+    :func:`make_ppo_bundle` and ``utils/metrics.py``). ``scope`` is the
+    MetricsSpec compiled into the update; ``observer`` (usually a
+    ``TrainObserver`` holding the ScopeSession + flight recorder) is the
+    host-side hook the loop drives. Single-device only for now: the
+    sharded updates pmean their scalar metrics, which would average the
+    Welford counts wrongly.
 
     ``mesh``: a ``jax.sharding.Mesh`` with a ``dp`` axis runs the update
     data-parallel via ``shard_map`` (``parallel/sharding.py``) — env batch
@@ -521,6 +602,12 @@ def ppo_train(
     than replaying the stream the original run already consumed.
     """
     bundle = env if isinstance(env, EnvBundle) else multi_cloud_bundle(env)
+    if mesh is not None and scope is not None:
+        raise ValueError(
+            "graftscope instruments the single-chip update; the sharded "
+            "paths pmean scalar metrics, which would corrupt Welford "
+            "counts — drop the mesh or the scope"
+        )
     if mesh is not None and debug_checks:
         # Reject before the gae_impl branch below: its "forces scan GAE"
         # warning would describe a run that never happens.
@@ -585,7 +672,8 @@ def ppo_train(
                 bundle, cfg, mesh, net=net
             )
     else:
-        init_fn, update_fn, net = make_ppo_bundle(bundle, cfg, net=net)
+        init_fn, update_fn, net = make_ppo_bundle(bundle, cfg, net=net,
+                                                  scope=scope)
     start_iteration = 0
     key = jax.random.PRNGKey(seed)
     if restore is not None:
@@ -614,7 +702,7 @@ def ppo_train(
         update, runner, start_iteration, num_iterations,
         sync_every=sync_every, log_fn=log_fn, checkpoint_fn=checkpoint_fn,
         eval_every=cfg.eval_every, eval_hook=eval_hook,
-        updates_per_dispatch=updates_per_dispatch,
+        updates_per_dispatch=updates_per_dispatch, observer=observer,
     )
 
 

@@ -93,7 +93,18 @@ def main(argv: list[str] | None = None) -> Path:
                         "silently corrupting training (slower; for "
                         "debugging; incompatible with "
                         "--updates-per-dispatch > 1)")
+    p.add_argument("--metrics-window", type=int, default=0, metavar="N",
+                   help="graftscope (docs/observability.md): device-"
+                        "resident replay/grad distribution metrics "
+                        "accumulated inside the jitted update, ONE host "
+                        "fetch per N iterations, plus the anomaly flight "
+                        "recorder (<run>/flight_recorder.jsonl). 0 "
+                        "disables (the default)")
     args = p.parse_args(argv)
+
+    from rl_scheduler_tpu.agent.loop import validate_metrics_window
+
+    validate_metrics_window(args.metrics_window, args.updates_per_dispatch)
 
     cfg = DQN_PRESETS[args.preset]
     overrides = {}
@@ -159,15 +170,41 @@ def main(argv: list[str] | None = None) -> Path:
         },
     )
 
+    scope = observer = recorder = None
+    if args.metrics_window:
+        from rl_scheduler_tpu.agent.loop import make_graftscope
+        from rl_scheduler_tpu.utils.metrics import dqn_scope_spec
+
+        scope = dqn_scope_spec(bundle.num_actions)
+        observer, recorder = make_graftscope(
+            scope, args.metrics_window, run_dir, metrics_file, tb,
+            config={"algo": "dqn", "preset": args.preset,
+                    "env": args.env, "seed": args.seed,
+                    "iterations": args.iterations,
+                    "metrics_window": args.metrics_window,
+                    "hidden": list(cfg.hidden)},
+        )
+
+    eval_log = make_eval_log_fn(metrics_file, tb)
+    if recorder is not None:
+        eval_log = recorder.wrap_eval_log(eval_log, threshold=None)
     print(f"Training DQN preset={args.preset} env={args.env} on "
           f"{jax.devices()[0].platform} "
           f"({cfg.num_envs} envs x {cfg.collect_steps} steps/iter)")
-    dqn_train(bundle, cfg, args.iterations, seed=args.seed,
-              log_fn=log_fn, checkpoint_fn=checkpoint_fn,
-              sync_every=args.sync_every,
-              eval_log_fn=make_eval_log_fn(metrics_file, tb),
-              debug_checks=args.debug_checks,
-              updates_per_dispatch=args.updates_per_dispatch)
+    try:
+        dqn_train(bundle, cfg, args.iterations, seed=args.seed,
+                  log_fn=log_fn, checkpoint_fn=checkpoint_fn,
+                  sync_every=args.sync_every,
+                  eval_log_fn=eval_log,
+                  debug_checks=args.debug_checks,
+                  updates_per_dispatch=args.updates_per_dispatch,
+                  scope=scope, observer=observer)
+    except Exception as e:
+        # --debug-checks composition: preserve the steps leading up to
+        # the first NaN before the checkified error unwinds.
+        if recorder is not None:
+            recorder.dump_exception(e)
+        raise
     metrics_file.close()
     if tb is not None:
         tb.close()

@@ -45,7 +45,8 @@ class EvalStall(RuntimeError):
 
 
 def make_stall_guard(eval_log_fn, decision_iter: int, final_iter: int,
-                     threshold: float, raise_on_stall: bool = True):
+                     threshold: float, raise_on_stall: bool = True,
+                     on_stall=None):
     """Wrap an eval-log sink with the bad-seed detector.
 
     Two checkpoints, both measured necessary (the 9-seed fleet64 study,
@@ -63,7 +64,11 @@ def make_stall_guard(eval_log_fn, decision_iter: int, final_iter: int,
       failures sit 10-50% below the bar, far outside that noise).
 
     Raises :class:`EvalStall` at whichever checkpoint fails (or warns
-    when the reseed budget is spent).
+    when the reseed budget is spent). ``on_stall(iteration, value)``
+    fires right before either outcome — the flight recorder's
+    eval-collapse dump hook, called exactly when the guard trips (NOT on
+    pre-deadline evals, which are expected below the bar) and before the
+    raise, so a reseeded attempt leaves its artifact behind.
     """
     best = float("-inf")
 
@@ -81,6 +86,8 @@ def make_stall_guard(eval_log_fn, decision_iter: int, final_iter: int,
         if not stalled:
             return
         value = best if iteration == decision_iter else current
+        if on_stall is not None:
+            on_stall(iteration, value)
         if raise_on_stall:
             raise EvalStall(iteration, value, threshold)
         print(
@@ -337,6 +344,17 @@ def main(argv: list[str] | None = None) -> Path:
                         "zero-division/out-of-bounds index instead of "
                         "silently corrupting training (slower; for "
                         "debugging)")
+    p.add_argument("--metrics-window", type=int, default=0, metavar="N",
+                   help="graftscope (docs/observability.md): accumulate "
+                        "device-resident distribution metrics (grad-norm/"
+                        "ratio/advantage histograms, Welford stats, "
+                        "per-cloud action counts) INSIDE the jitted "
+                        "update and flush ONE summary per N iterations "
+                        "(a single device_get — the GL008/GL009 "
+                        "discipline). Also arms the anomaly flight "
+                        "recorder (NaN/grad-spike/eval-collapse ring "
+                        "dump to <run>/flight_recorder.jsonl). 0 "
+                        "disables (the default)")
     p.add_argument("--tensorboard", action="store_true",
                    help="also log metrics to TensorBoard under <run>/tb")
     p.add_argument("--profile-dir", default=None,
@@ -665,6 +683,16 @@ def main(argv: list[str] | None = None) -> Path:
                 f"minibatch_size={cfg.minibatch_size} must both divide by "
                 "the device count"
             )
+    from rl_scheduler_tpu.agent.loop import validate_metrics_window
+
+    validate_metrics_window(args.metrics_window, args.updates_per_dispatch)
+    if args.metrics_window and (args.dp != 1 or args.sp != 1 or args.tp != 1):
+        raise SystemExit(
+            "--metrics-window instruments the single-chip update; the "
+            "sharded paths pmean scalar metrics, which would corrupt "
+            "the Welford counts — drop --dp/--sp/--tp or the window"
+        )
+
     def guard_ineligible() -> str | None:
         """Why the reseed guard cannot run with this invocation — ONE
         predicate for both the implied path (auto-disable with a note)
@@ -989,6 +1017,21 @@ def main(argv: list[str] | None = None) -> Path:
               f"{decision_iter} AND at the final eval (iteration "
               f"{final_iter}); up to {args.reseed_on_stall} reseed(s)")
 
+    scope = observer = recorder = None
+    if args.metrics_window:
+        from rl_scheduler_tpu.agent.loop import make_graftscope
+        from rl_scheduler_tpu.utils.metrics import ppo_scope_spec
+
+        scope = ppo_scope_spec(bundle.num_actions)
+        observer, recorder = make_graftscope(
+            scope, args.metrics_window, run_dir, metrics_file, tb,
+            config={**checkpoint_extras, "seed": args.seed,
+                    "iterations": args.iterations,
+                    "metrics_window": args.metrics_window,
+                    "num_envs": cfg.num_envs,
+                    "compute_dtype": cfg.compute_dtype},
+        )
+
     print(f"Training PPO preset={args.preset} env={args.env} on "
           f"{jax.devices()[0].platform} "
           f"({cfg.num_envs} envs x {cfg.rollout_steps} steps/iter)")
@@ -1006,9 +1049,26 @@ def main(argv: list[str] | None = None) -> Path:
             attempt_seed = args.seed + attempt
             eval_log = make_eval_log_fn(metrics_file, tb)
             if stall_threshold is not None:
+                on_stall = None
+                if recorder is not None:
+                    def on_stall(iteration, value, _rec=recorder):
+                        _rec.dump(
+                            "eval_collapse", iteration - 1,
+                            detail=f"eval_episode_reward_mean={value:.3f} "
+                                   f"below node-baseline threshold "
+                                   f"{stall_threshold:.3f}")
                 eval_log = make_stall_guard(
                     eval_log, decision_iter, final_iter, stall_threshold,
-                    raise_on_stall=attempt < args.reseed_on_stall)
+                    raise_on_stall=attempt < args.reseed_on_stall,
+                    on_stall=on_stall)
+            if recorder is not None:
+                # NaN-eval check only: collapse dumps route through the
+                # guard's on_stall at its decision/final checkpoints.
+                # Pre-deadline evals are EXPECTED below the baseline
+                # (untrained policy), so threshold-dumping each would
+                # spend max_dumps before a late real anomaly could
+                # leave its ring.
+                eval_log = recorder.wrap_eval_log(eval_log, threshold=None)
             try:
                 ppo_train(bundle, cfg, args.iterations, seed=attempt_seed,
                           net=net, log_fn=log_fn,
@@ -1016,7 +1076,8 @@ def main(argv: list[str] | None = None) -> Path:
                           restore=restore, debug_checks=args.debug_checks,
                           sync_every=args.sync_every, eval_log_fn=eval_log,
                           updates_per_dispatch=args.updates_per_dispatch,
-                          mesh=mesh, eval_net=eval_net)
+                          mesh=mesh, eval_net=eval_net,
+                          scope=scope, observer=observer)
                 break
             except EvalStall as stall:
                 attempt += 1
@@ -1049,6 +1110,22 @@ def main(argv: list[str] | None = None) -> Path:
                 # replacement (same step numbers — Orbax would refuse the
                 # overwrite and the evaluator would read stale weights).
                 ckpt.clear()
+                if recorder is not None:
+                    # Same reasoning for the flight recorder: the
+                    # replacement re-uses iteration numbers under a new
+                    # seed, so stale ring rows would be misattributed in
+                    # a later dump. The manifest tags which attempt a
+                    # dump belongs to.
+                    recorder.reset(reseed_attempt=attempt,
+                                   seed=args.seed + attempt)
+            except Exception as e:
+                # --debug-checks composition (and any other mid-run
+                # failure): a checkified JaxRuntimeError unwinds here —
+                # dump the ring so the steps LEADING UP to the first
+                # NaN are preserved, then re-raise unchanged.
+                if recorder is not None:
+                    recorder.dump_exception(e)
+                raise
     metrics_file.close()
     if tb is not None:
         tb.close()

@@ -22,6 +22,12 @@ class PPOLossConfig(NamedTuple):
     vf_coeff: float = 1.0
     entropy_coeff: float = 0.0
     normalize_advantages: bool = True
+    # graftscope (utils/metrics.py): when set (a static tuple of bucket
+    # edges), the metrics dict gains "hist_ratio" — per-minibatch ratio
+    # counts, bucketized HERE so the [B] ratio array is reduced in place
+    # instead of stacking through the SGD scan. None (the default) leaves
+    # the loss byte-identical to the un-instrumented build.
+    ratio_hist_edges: tuple | None = None
 
 
 def categorical_log_prob(logits: jnp.ndarray, actions: jnp.ndarray) -> jnp.ndarray:
@@ -72,6 +78,10 @@ def ppo_loss(
         "approx_kl": approx_kl,
         "clip_fraction": clip_frac,
     }
+    if cfg.ratio_hist_edges is not None:
+        from rl_scheduler_tpu.utils.metrics import hist_observe
+
+        metrics["hist_ratio"] = hist_observe(ratio, cfg.ratio_hist_edges)
     return total, metrics
 
 

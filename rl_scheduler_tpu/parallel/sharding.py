@@ -218,15 +218,25 @@ def dp_ppo_train(
     seed: int = 0,
     log_fn=None,
 ):
-    """Host loop for the data-parallel path (mirrors ``agent.ppo.ppo_train``)."""
+    """Host loop for the data-parallel path (mirrors ``agent.ppo.ppo_train``).
+
+    Metrics follow the GL009 discipline: device results queue during the
+    loop and ONE batched ``jax.device_get`` fetches them all at the end —
+    the demo loop must not re-teach the per-iteration-sync pattern the real
+    loop (``agent/loop.py``) batches away. ``log_fn`` therefore fires after
+    the loop finishes, which is fine for the tests/demos this serves (the
+    production path with live logging is ``ppo_train(mesh=...)``).
+    """
     init_fn, update_fn, _ = make_data_parallel_ppo(env_params, cfg, mesh)
     runner = jax.jit(init_fn)(jax.random.PRNGKey(seed))
     update = jax.jit(update_fn, donate_argnums=0)
-    history = []
-    for i in range(num_iterations):
+    pending = []
+    for _ in range(num_iterations):
         runner, metrics = update(runner)
-        metrics = {k: float(v) for k, v in metrics.items()}
-        history.append(metrics)
-        if log_fn is not None:
-            log_fn(i, metrics)
+        pending.append(metrics)
+    history = [{k: float(v) for k, v in row.items()}
+               for row in jax.device_get(pending)]
+    if log_fn is not None:
+        for i, row in enumerate(history):
+            log_fn(i, row)
     return runner, history

@@ -194,6 +194,29 @@ class LatencyStats:
             "p99_ms": round(float(p99), 4),
         }
 
+    @classmethod
+    def merged_histogram(cls, stats) -> tuple[list, float, int]:
+        """Aggregate several workers' histograms for ONE shared scrape.
+
+        Multi-worker serving runs one ``LatencyStats`` per process; a
+        fronting scrape can sum them because cumulative bucket counts are
+        LINEAR: every worker shares ``cls.BUCKETS``, so bucket-wise sums
+        of per-worker cumulative counts are exactly the cumulative counts
+        of the union stream (same for ``sum``/``count``). Percentiles do
+        NOT merge this way — ``histogram_quantile()`` over the merged
+        buckets is the aggregate story, per-worker ``/stats`` stays the
+        exact one (docs/serving.md).
+        """
+        totals = [0] * (len(cls.BUCKETS) + 1)
+        total_sum, total_count = 0.0, 0
+        for s in stats:
+            cumulative, ssum, count = s.histogram()
+            for i, c in enumerate(cumulative):
+                totals[i] += c
+            total_sum += ssum
+            total_count += count
+        return totals, total_sum, total_count
+
 
 class AsyncPlacer:
     """Bounded async wrapper around a pod placer.

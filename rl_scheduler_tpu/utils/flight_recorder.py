@@ -1,0 +1,265 @@
+"""graftscope part 2: the anomaly flight recorder (docs/observability.md).
+
+Fleet seed failures today vanish: a NaN poisons the update, the greedy
+eval collapses, and by the time a human looks, the metrics JSONL holds
+only window-averaged scalars from AFTER the damage. The recorder keeps a
+ring buffer of the last ``capacity`` iterations' per-step metrics ON
+DEVICE — written by a tiny jitted scatter per dispatch, never fetched in
+the steady state — and dumps it, together with a run manifest, to a JSONL
+artifact the moment an anomaly is detected:
+
+- **NaN/inf** in any watched metric of a fetched row;
+- **grad-norm spike**: z-score over a host-side running Welford of the
+  ``grad_norm`` stream exceeds ``zscore_threshold`` (after ``min_count``
+  healthy observations);
+- **greedy-eval collapse**: the ``--reseed-on-stall`` guard's checkpoint
+  decision — its ``on_stall`` hook calls :meth:`FlightRecorder.dump`
+  BEFORE the guard raises, so a reseeded attempt leaves its artifact.
+  (``wrap_eval_log``'s own ``threshold`` path fires on EVERY
+  below-threshold eval; early in-training evals are expected below the
+  node baseline, so wiring it to the guard's bar would spend
+  ``max_dumps`` on healthy warm-up — production CLIs pass
+  ``threshold=None`` and let the guard decide.);
+- **raised exceptions**: the CLIs call :meth:`FlightRecorder.dump` when a
+  checkified run (``--debug-checks``) or any other failure unwinds.
+
+The artifact is self-describing: line 1 is the manifest (config, jax
+version, device kind, precision flags, git sha, reason), the rest are the
+ring's rows in chronological order. Fleet seed failures (docs/scaling.md
+§1b) become diagnosable post-hoc instead of unobservable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Same monkeypatch seam convention as utils/metrics.py: the recorder's
+# only steady-state transfer is ZERO; dumps go through this.
+_device_get = jax.device_get
+
+
+def build_manifest(config: dict | None = None,
+                   extra: dict | None = None) -> dict:
+    """Run provenance for the dump header: everything needed to reproduce
+    or triage without the run directory. Best-effort on every field —
+    a recorder must never be the thing that crashes the run."""
+    manifest: dict = {"config": config or {}}
+    try:
+        manifest["jax_version"] = jax.__version__
+        dev = jax.devices()[0]
+        manifest["backend"] = dev.platform
+        manifest["device_kind"] = dev.device_kind
+        manifest["device_count"] = jax.device_count()
+    except Exception:  # noqa: BLE001 — provenance, not control flow
+        manifest.setdefault("jax_version", "unknown")
+    try:
+        manifest["precision"] = {
+            "jax_enable_x64": bool(jax.config.jax_enable_x64),
+            "jax_default_matmul_precision":
+                getattr(jax.config, "jax_default_matmul_precision", None),
+        }
+    except Exception:  # noqa: BLE001
+        manifest["precision"] = {}
+    try:
+        import subprocess
+
+        sha = subprocess.run(
+            ["git", "-C", str(Path(__file__).resolve().parents[2]),
+             "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+        )
+        manifest["git_sha"] = sha.stdout.strip() if sha.returncode == 0 else None
+    except Exception:  # noqa: BLE001
+        manifest["git_sha"] = None
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+@jax.jit
+def _ring_write(ring: dict, steps: jnp.ndarray, rows: dict) -> dict:
+    """Scatter ``k`` rows at the ring head (one fused op per field)."""
+    cap = ring["step"].shape[0]
+    idx = (ring["pos"] + jnp.arange(steps.shape[0], dtype=jnp.int32)) % cap
+    out = {"pos": ring["pos"] + steps.shape[0],
+           "step": ring["step"].at[idx].set(steps)}
+    for name, buf in ring.items():
+        # graftlint: disable=GL003 -- name is a dict KEY (a host str from ring.items()), never a tracer; the branch resolves identically at every trace
+        if name in ("pos", "step"):
+            continue
+        out[name] = buf.at[idx].set(rows[name])
+    return out
+
+
+@dataclasses.dataclass
+class FlightRecorder:
+    """Device-resident metrics ring + host-side anomaly triggers.
+
+    ``record`` runs per dispatch (device ops only). ``check_row`` runs per
+    FETCHED row at the loop's flush cadence — detection latency is the
+    sync window, the ring's contents always run ahead of it (everything
+    dispatched, not just everything logged). At most ``max_dumps``
+    artifacts per run so a persistently-NaN run cannot fill a disk.
+    """
+
+    path: Path
+    manifest: dict = dataclasses.field(default_factory=dict)
+    capacity: int = 64
+    zscore_threshold: float = 8.0
+    zscore_keys: tuple = ("grad_norm",)
+    min_count: int = 20
+    max_dumps: int = 3
+
+    def __post_init__(self):
+        self.path = Path(self.path)
+        self.dump_count = 0
+        self._ring: dict | None = None
+        self._keys: tuple = ()
+        # Host-side running Welford per z-score key (plain floats — this
+        # runs per logged row, device arrays would be syncs).
+        self._welford: dict = {}
+
+    # ------------------------------------------------------ device side
+
+    def record(self, first_iteration: int, metrics: dict, k: int = 1) -> None:
+        """Write this dispatch's ``k`` iterations into the device ring."""
+        rows = {name: jnp.reshape(v, (-1,)).astype(jnp.float32)
+                for name, v in metrics.items()
+                if not isinstance(v, (dict, tuple))}
+        if self._ring is None:
+            # The ring must hold at least one full dispatch: k > capacity
+            # would scatter duplicate indices in a single ``.at[].set``,
+            # whose winning update XLA leaves undefined — a dump could
+            # then mix stale and fresh steps while claiming chronological
+            # order. Grow instead of truncating.
+            cap = max(self.capacity, k)
+            self._keys = tuple(sorted(rows))
+            self._ring = {
+                "pos": jnp.zeros((), jnp.int32),
+                "step": jnp.full((cap,), -1, jnp.int32),
+                **{name: jnp.full((cap,), jnp.nan, jnp.float32)
+                   for name in self._keys},
+            }
+        steps = first_iteration + jnp.arange(k, dtype=jnp.int32)
+        self._ring = _ring_write(self._ring, steps,
+                                 {name: rows[name] for name in self._keys})
+
+    def reset(self, **manifest_updates) -> None:
+        """Clear the device ring and the host z-score baselines — called
+        between ``--reseed-on-stall`` attempts. The replacement attempt
+        re-uses the abandoned attempt's iteration numbers under a new
+        seed, so stale ring rows would be indistinguishable from (and
+        misattributed to) the new run in a later dump. ``manifest_updates``
+        (e.g. ``attempt=``, ``seed=``) keep subsequent dumps attributable
+        to the attempt that produced them."""
+        self._ring = None
+        self._keys = ()
+        self._welford = {}
+        self.manifest.update(manifest_updates)
+
+    # -------------------------------------------------------- host side
+
+    def check_row(self, iteration: int, row: dict) -> None:
+        """Anomaly checks on one fetched metrics row (host floats)."""
+        bad = [name for name, v in row.items()
+               if isinstance(v, float) and not math.isfinite(v)]
+        if bad:
+            self.dump("nan_inf", iteration,
+                      detail=f"non-finite metric(s): {', '.join(sorted(bad))}")
+            return
+        for name in self.zscore_keys:
+            x = row.get(name)
+            if x is None:
+                continue
+            count, mean, m2 = self._welford.get(name, (0, 0.0, 0.0))
+            if count >= self.min_count:
+                std = math.sqrt(m2 / count)
+                if std > 0 and (x - mean) / std > self.zscore_threshold:
+                    self.dump(
+                        "zscore_spike", iteration,
+                        detail=f"{name}={x:.6g} is "
+                               f"{(x - mean) / std:.1f} sigma above its "
+                               f"running mean {mean:.6g} (std {std:.3g}, "
+                               f"n={count})")
+                    # The spike itself stays OUT of the baseline stats:
+                    # folding it in would mask an immediately-following
+                    # second spike.
+                    continue
+            count += 1
+            delta = x - mean
+            mean += delta / count
+            m2 += delta * (x - mean)
+            self._welford[name] = (count, mean, m2)
+
+    def wrap_eval_log(self, eval_log_fn, threshold: float | None):
+        """Wrap an eval sink with eval-anomaly triggers: a non-finite
+        eval reward, or one below ``threshold``, dumps BEFORE the inner
+        sink runs — so an inner guard that raises ``EvalStall`` still
+        leaves the artifact behind.
+
+        ``threshold`` fires on EVERY below-threshold eval, which is only
+        right for a bar the run should clear from the start. The train
+        CLIs pass ``threshold=None`` (NaN check only) and route collapse
+        detection through the stall guard's ``on_stall`` hook instead:
+        pre-deadline evals are expected below the node baseline, and
+        dumping each would exhaust ``max_dumps`` before a real anomaly."""
+
+        def wrapped(i: int, metrics: dict) -> None:
+            r = metrics.get("eval_episode_reward_mean")
+            if r is not None and not math.isfinite(r):
+                self.dump("eval_nan", i,
+                          detail=f"eval_episode_reward_mean={r}")
+            elif threshold is not None and r is not None and r < threshold:
+                self.dump("eval_collapse", i,
+                          detail=f"eval_episode_reward_mean={r:.3f} below "
+                                 f"node-baseline threshold {threshold:.3f}")
+            eval_log_fn(i, metrics)
+
+        return wrapped
+
+    def dump_exception(self, e: BaseException) -> bool:
+        """CLI unwind hook: preserve the ring when a mid-run failure
+        (e.g. a checkified ``--debug-checks`` NaN) unwinds; the caller
+        re-raises unchanged. One place for the reason/detail format so
+        the PPO and DQN CLIs' artifacts stay greppable the same way."""
+        return self.dump(f"exception:{type(e).__name__}", -1,
+                         detail=str(e)[:500])
+
+    def dump(self, reason: str, iteration: int, detail: str = "") -> bool:
+        """Fetch the ring once and append the artifact. Returns whether a
+        dump was written (rate-limited by ``max_dumps``)."""
+        if self.dump_count >= self.max_dumps:
+            return False
+        self.dump_count += 1
+        lines = [json.dumps({
+            "kind": "manifest", "reason": reason, "iteration": iteration,
+            "detail": detail, **self.manifest,
+        })]
+        if self._ring is not None:
+            host = _device_get(self._ring)
+            pos = int(host["pos"])
+            cap = self._ring["step"].shape[0]
+            order = [(pos + j) % cap for j in range(cap)]
+            for slot in order:
+                step = int(host["step"][slot])
+                if step < 0:
+                    continue  # never written
+                row = {"kind": "ring", "step": step}
+                for name in self._keys:
+                    v = float(host[name][slot])
+                    row[name] = v if math.isfinite(v) else str(v)
+                lines.append(json.dumps(row))
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        with self.path.open("a") as fh:
+            fh.write("\n".join(lines) + "\n")
+        print(f"flight recorder: {reason} at iteration {iteration + 1} — "
+              f"ring + manifest dumped to {self.path}", flush=True)
+        return True

@@ -1,0 +1,382 @@
+"""graftscope part 1: device-resident training metrics (docs/observability.md).
+
+The GL008 discipline as a library. Today's training loop fetches scalar
+metrics per sync burst; anything richer — distributions of grad norms, PPO
+ratios, advantages, per-cloud action counts — would naively mean per-step
+host fetches, each a full network round-trip on a tunneled accelerator
+(~100 ms, ``agent/loop.py``). Podracer-style architectures (Hessel et al.,
+2021) solve this by keeping the metrics INSIDE the device program. Here:
+
+- :class:`TensorStats`: a Welford accumulator (count/mean/M2 + min/max)
+  as a tiny pytree of scalars. ``stats_observe`` summarizes one array;
+  ``stats_merge`` combines two accumulators (Chan's parallel update);
+  ``stats_reduce`` collapses a stacked ``[k]`` axis in closed form —
+  all pure jnp, all jit-safe.
+- Fixed-bucket histograms: ``hist_observe`` bucketizes an array against
+  STATIC edges (one scatter-add, no host sync); categorical counts for
+  integer streams (per-cloud/per-node action ids) via the same scatter.
+- :class:`MetricsSpec` names what a trainer watches; ``scope_observe``
+  builds one :data:`MetricsState` (a flat dict pytree) per update, which
+  rides out of the jitted update in the metrics dict under the
+  ``"graftscope"`` key.
+- :class:`ScopeSession` accumulates those states ON DEVICE (jitted merge,
+  no transfer) and flushes to host in exactly ONE batched
+  ``jax.device_get`` per ``window`` iterations — the invariant
+  ``tests/test_metrics.py`` pins and graftlint GL009 enforces on loops.
+- :class:`TrainObserver` is the ``run_train_loop`` hook that carries a
+  session plus (optionally) the flight recorder
+  (``utils/flight_recorder.py``).
+
+Everything here is version-portable jnp (no Pallas, no backend probes): it
+behaves identically on the CPU container and the TPU driver.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+# Monkeypatch seam for tests that count host fetches; the ONLY transfer
+# this module ever performs goes through it.
+_device_get = jax.device_get
+
+
+class TensorStats(NamedTuple):
+    """Welford accumulator over a scalar stream: 5 device scalars."""
+
+    count: jnp.ndarray   # f32 scalar (f32 counts are exact to 2^24 obs)
+    mean: jnp.ndarray
+    m2: jnp.ndarray      # sum of squared deviations from the mean
+    min: jnp.ndarray
+    max: jnp.ndarray
+
+
+def stats_observe(x: jnp.ndarray) -> TensorStats:
+    """One-shot stats of an array (any shape; summarized as a flat stream)."""
+    x = jnp.ravel(x).astype(jnp.float32)
+    mean = jnp.mean(x)
+    return TensorStats(
+        count=jnp.float32(x.size),
+        mean=mean,
+        m2=jnp.sum(jnp.square(x - mean)),
+        min=jnp.min(x),
+        max=jnp.max(x),
+    )
+
+
+def stats_merge(a: TensorStats, b: TensorStats) -> TensorStats:
+    """Chan's parallel Welford merge; exact for any split of the stream."""
+    n = a.count + b.count
+    safe_n = jnp.maximum(n, 1.0)
+    delta = b.mean - a.mean
+    mean = a.mean + delta * b.count / safe_n
+    m2 = a.m2 + b.m2 + jnp.square(delta) * a.count * b.count / safe_n
+    return TensorStats(
+        count=n,
+        mean=jnp.where(n > 0, mean, 0.0),
+        m2=jnp.where(n > 0, m2, 0.0),
+        min=jnp.minimum(a.min, b.min),
+        max=jnp.maximum(a.max, b.max),
+    )
+
+
+def stats_reduce(s: TensorStats) -> TensorStats:
+    """Collapse a stacked ``TensorStats`` (leaves ``[k]``) in closed form.
+
+    The fused-dispatch path (``updates_per_dispatch=k``) stacks one
+    accumulator per iteration; merging k groups at once is
+    ``n = Σnᵢ; mean = Σnᵢmᵢ/n; M2 = ΣM2ᵢ + Σnᵢ(mᵢ - mean)²`` — the same
+    algebra as pairwise merging, associativity folded into one reduction.
+    """
+    n = jnp.sum(s.count)
+    safe_n = jnp.maximum(n, 1.0)
+    mean = jnp.sum(s.count * s.mean) / safe_n
+    m2 = jnp.sum(s.m2) + jnp.sum(s.count * jnp.square(s.mean - mean))
+    return TensorStats(
+        count=n,
+        mean=jnp.where(n > 0, mean, 0.0),
+        m2=jnp.where(n > 0, m2, 0.0),
+        min=jnp.min(s.min),
+        max=jnp.max(s.max),
+    )
+
+
+def hist_observe(x: jnp.ndarray, edges: tuple) -> jnp.ndarray:
+    """Counts of ``x`` against static ``edges``: ``len(edges)+1`` buckets
+    (bucket 0 is the underflow ``x < edges[0]``, the last is the overflow
+    ``x >= edges[-1]``). One searchsorted + one scatter-add, no sync."""
+    x = jnp.ravel(x).astype(jnp.float32)
+    idx = jnp.searchsorted(jnp.asarray(edges, jnp.float32), x, side="right")
+    return jnp.zeros(len(edges) + 1, jnp.int32).at[idx].add(1)
+
+
+def categorical_observe(ids: jnp.ndarray, num_bins: int) -> jnp.ndarray:
+    """Counts of integer ids in ``[0, num_bins)`` (action/cloud counters).
+    Out-of-range ids are clipped into the end bins rather than dropped —
+    a visible pile-up beats silent loss."""
+    idx = jnp.clip(jnp.ravel(ids).astype(jnp.int32), 0, num_bins - 1)
+    return jnp.zeros(num_bins, jnp.int32).at[idx].add(1)
+
+
+@dataclasses.dataclass(frozen=True)
+class HistSpec:
+    """One histogram the scope tracks. ``edges`` (static float bounds) for
+    value streams, or ``bins`` for categorical integer streams."""
+
+    name: str
+    edges: tuple | None = None
+    bins: int | None = None
+
+    def __post_init__(self):
+        if (self.edges is None) == (self.bins is None):
+            raise ValueError(
+                f"HistSpec {self.name!r}: set exactly one of edges/bins"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricsSpec:
+    """What one trainer's scope watches. ``stats`` names get a
+    :class:`TensorStats`; ``hists`` get fixed-bucket counts. Both index
+    into the ``values`` dict the trainer hands ``scope_observe``."""
+
+    stats: tuple = ()
+    hists: tuple = ()       # tuple[HistSpec, ...]
+
+    def hist(self, name: str) -> HistSpec:
+        for h in self.hists:
+            if h.name == name:
+                return h
+        raise KeyError(name)
+
+
+# MetricsState: {name: TensorStats} ∪ {"hist/"+name: int32 counts} — a
+# plain dict pytree, so it scans/stacks/jits like any other metrics leaf.
+MetricsState = dict
+
+
+def validate_spec(spec: MetricsSpec, values: tuple, counts: tuple = (),
+                  context: str = "scope") -> None:
+    """Reject a spec naming streams the trainer does not provide — at
+    BUILD time, with the available names spelled out, instead of a bare
+    ``KeyError`` from inside the first traced update. ``values`` are the
+    raw-array streams the trainer feeds ``scope_observe``; ``counts`` the
+    pre-bucketized in-scan streams (histogram-only, e.g. PPO's ratio)."""
+    unknown = [n for n in spec.stats if n not in values]
+    unknown += [h.name for h in spec.hists
+                if h.name not in values and h.name not in counts]
+    if unknown:
+        raise ValueError(
+            f"{context}: MetricsSpec names unknown stream(s) "
+            f"{sorted(set(unknown))}. Available value streams: "
+            f"{sorted(values)}; histogram-only in-scan streams: "
+            f"{sorted(counts)}")
+    # An in-scan stream is bucketized by the TRAINER against the spec's
+    # static edges; a bins-typed spec has none, so the trainer would skip
+    # bucketization and scope_observe would hit a KeyError from inside
+    # the first traced update — the exact failure this guard exists for.
+    bins_only = [h.name for h in spec.hists
+                 if h.name in counts and h.name not in values
+                 and h.edges is None]
+    if bins_only:
+        raise ValueError(
+            f"{context}: histogram(s) {sorted(bins_only)} name in-scan "
+            f"bucketized stream(s), which require static `edges` (the "
+            f"trainer buckets against them inside its scan); `bins` "
+            f"specs need a raw value stream")
+
+
+def scope_observe(spec: MetricsSpec, values: dict,
+                  counts: dict | None = None) -> MetricsState:
+    """Build one MetricsState from this update's raw arrays.
+
+    ``values[name]`` feeds both the stats and hist entries of that name;
+    ``counts[name]`` supplies pre-bucketized histogram counts for streams
+    the caller already reduced in place (e.g. the PPO ratio, bucketized
+    inside the SGD scan so the per-sample array never stacks up).
+    """
+    counts = counts or {}
+    state: MetricsState = {}
+    for name in spec.stats:
+        state[name] = stats_observe(values[name])
+    for h in spec.hists:
+        if h.name in counts:
+            state["hist/" + h.name] = counts[h.name].astype(jnp.int32)
+        elif h.bins is not None:
+            state["hist/" + h.name] = categorical_observe(
+                values[h.name], h.bins)
+        else:
+            state["hist/" + h.name] = hist_observe(values[h.name], h.edges)
+    return state
+
+
+def scope_merge(a: MetricsState, b: MetricsState) -> MetricsState:
+    return {
+        k: stats_merge(v, b[k]) if isinstance(v, TensorStats) else v + b[k]
+        for k, v in a.items()
+    }
+
+
+def scope_reduce(stacked: MetricsState) -> MetricsState:
+    """Collapse the leading ``[k]`` axis a fused dispatch stacks on."""
+    return {
+        k: stats_reduce(v) if isinstance(v, TensorStats)
+        else jnp.sum(v, axis=0)
+        for k, v in stacked.items()
+    }
+
+
+def scope_summary(host_state: dict, spec: MetricsSpec) -> dict:
+    """Flatten a FETCHED state into the JSONL/TB-ready summary dict.
+
+    Scalar keys (``<name>/mean`` etc.) are plain floats — the existing
+    writers consume them unchanged; histogram keys hold
+    ``{"edges"|"bins", "counts"}`` dicts (JSONL keeps them; the TB sink
+    skips non-scalars)."""
+    import math
+
+    out: dict = {}
+    for name in spec.stats:
+        s = host_state[name]
+        count = float(s.count)
+        var = float(s.m2) / count if count > 0 else 0.0
+        out[f"{name}/count"] = count
+        out[f"{name}/mean"] = float(s.mean)
+        out[f"{name}/std"] = math.sqrt(max(var, 0.0))
+        out[f"{name}/min"] = float(s.min)
+        out[f"{name}/max"] = float(s.max)
+    for h in spec.hists:
+        counts = [int(c) for c in host_state["hist/" + h.name]]
+        entry: dict = {"counts": counts}
+        if h.edges is not None:
+            entry["edges"] = list(h.edges)
+        else:
+            entry["bins"] = h.bins
+        out[f"hist/{h.name}"] = entry
+    return out
+
+
+class ScopeSession:
+    """Host-side controller: device-merge per update, ONE fetch per window.
+
+    ``accumulate(state, first_iteration, k)`` jit-merges the update's
+    MetricsState into a device-resident accumulator (async, no transfer)
+    and — when the window boundary ``(first_iteration + k) % window == 0``
+    lands — flushes: one ``jax.device_get`` of the accumulator, summarize,
+    ``emit(last_iteration, summary)``, reset. ``fetch_count`` counts the
+    flushes so tests can assert the one-fetch-per-window contract.
+    """
+
+    def __init__(self, spec: MetricsSpec, window: int,
+                 emit: Callable[[int, dict], None]):
+        if window < 1:
+            raise ValueError(f"metrics window must be >= 1, got {window}")
+        self.spec = spec
+        self.window = window
+        self.emit = emit
+        self.fetch_count = 0
+        self._acc: MetricsState | None = None
+        self._last_iteration = -1
+        self._merge = jax.jit(scope_merge)
+        self._reduce = jax.jit(scope_reduce)
+
+    def accumulate(self, state: MetricsState, first_iteration: int,
+                   k: int = 1) -> None:
+        if k > 1:
+            state = self._reduce(state)
+        self._acc = (state if self._acc is None
+                     else self._merge(self._acc, state))
+        self._last_iteration = first_iteration + k - 1
+        if (first_iteration + k) % self.window == 0:
+            self.flush()
+
+    def flush(self) -> None:
+        """The window's single host fetch; no-op when nothing accumulated."""
+        if self._acc is None:
+            return
+        host = _device_get(self._acc)
+        self.fetch_count += 1
+        self.emit(self._last_iteration, scope_summary(host, self.spec))
+        self._acc = None
+
+
+class TrainObserver:
+    """``run_train_loop`` observer: scope session + optional flight recorder.
+
+    - ``observe(i0, metrics, k)``: pops the ``"graftscope"`` state out of
+      the update's metrics (device-side bookkeeping only — accumulate into
+      the session, record the scalar leaves into the recorder's on-device
+      ring) and returns the scalar-only metrics dict the loop logs.
+    - ``after_log(i, row)``: host-side anomaly checks on each fetched row
+      (delegated to the recorder).
+    - ``close()``: final partial-window flush.
+    """
+
+    def __init__(self, session: ScopeSession | None = None,
+                 recorder: Any | None = None):
+        self.session = session
+        self.recorder = recorder
+
+    def observe(self, first_iteration: int, metrics: dict, k: int = 1) -> dict:
+        metrics = dict(metrics)
+        state = metrics.pop("graftscope", None)
+        if self.session is not None and state is not None:
+            self.session.accumulate(state, first_iteration, k)
+        if self.recorder is not None:
+            self.recorder.record(first_iteration, metrics, k)
+        return metrics
+
+    def after_log(self, iteration: int, row: dict) -> None:
+        if self.recorder is not None:
+            self.recorder.check_row(iteration, row)
+
+    def close(self) -> None:
+        if self.session is not None:
+            self.session.flush()
+
+
+# --------------------------------------------------------- default specs
+
+# Edges chosen to bracket the measured regimes (docs/observability.md):
+# grad norms are log-spaced decades around the healthy ~1e-2..1e1 band;
+# PPO ratios cluster at 1 with the clip region (±0.3 at the default
+# clip_eps) resolved; advantages/rewards get a symmetric pseudo-log grid.
+GRAD_NORM_EDGES = (1e-4, 1e-3, 1e-2, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0,
+                   30.0, 100.0, 1e3)
+RATIO_EDGES = (0.5, 0.7, 0.8, 0.9, 0.95, 0.99, 1.01, 1.05, 1.1, 1.2,
+               1.3, 1.5, 2.0)
+SYMLOG_EDGES = (-100.0, -30.0, -10.0, -3.0, -1.0, -0.3, -0.1, 0.0,
+                0.1, 0.3, 1.0, 3.0, 10.0, 30.0, 100.0)
+
+
+def ppo_scope_spec(num_actions: int) -> MetricsSpec:
+    """What the PPO update watches: advantage/reward/value streams with
+    stats+histograms, grad-norm per minibatch, the in-scan ratio
+    histogram, and per-cloud (or per-node) action counts."""
+    return MetricsSpec(
+        stats=("advantage", "reward", "value", "grad_norm"),
+        hists=(
+            HistSpec("advantage", edges=SYMLOG_EDGES),
+            HistSpec("grad_norm", edges=GRAD_NORM_EDGES),
+            HistSpec("ratio", edges=RATIO_EDGES),
+            HistSpec("action", bins=num_actions),
+        ),
+    )
+
+
+def dqn_scope_spec(num_actions: int) -> MetricsSpec:
+    """DQN watch set: replay-batch reward/td streams, grad norm, and the
+    replayed action distribution. During buffer warm-up the learner is
+    skipped and grad_norm observes 0 — visible as a spike at the underflow
+    bucket, documented rather than masked."""
+    return MetricsSpec(
+        stats=("reward", "td_abs", "q_mean", "grad_norm"),
+        hists=(
+            HistSpec("reward", edges=SYMLOG_EDGES),
+            HistSpec("grad_norm", edges=GRAD_NORM_EDGES),
+            HistSpec("action", bins=num_actions),
+        ),
+    )

@@ -40,6 +40,32 @@ def trace_iterations(log_dir: str | Path):
         yield log_dir
 
 
+@jax.jit
+def _reduce_all_leaves(tree):
+    import jax.numpy as jnp
+
+    parts = [
+        jnp.ravel(leaf)[0].astype(jnp.float32)
+        for leaf in jax.tree.leaves(tree)
+    ]
+    return sum(parts, jnp.float32(0))
+
+
+def fetch_sync(tree) -> float:
+    """Force completion of everything ``tree`` depends on, by FETCHING.
+
+    This is the one shared implementation of the repo's sync-by-fetching
+    discipline (module docstring): ``jax.block_until_ready`` can return
+    before execution finishes on tunneled backends, so the only trustworthy
+    sync is a ``jax.device_get`` of a scalar that data-depends on every
+    leaf of the state under test. Used by :class:`StepTimer` and by
+    ``bench.py``'s measurement windows — the invariant lives here and
+    nowhere else. Leaves must be non-empty arrays (the reduction reads one
+    element of each). Returns the fetched scalar (callers usually ignore
+    it)."""
+    return float(jax.device_get(_reduce_all_leaves(tree)))
+
+
 @dataclasses.dataclass
 class StepReport:
     iters: int
@@ -66,29 +92,18 @@ class StepTimer:
         self._fn = fn
         self._steps_per_iter = env_steps_per_iter
         self._returns_aux = returns_aux
-        self._sync_fn = None
 
     def _step(self, state):
         out = self._fn(state)
         return out[0] if self._returns_aux else out
 
     def _sync(self, state) -> None:
-        """Force completion by fetching a scalar that data-depends on
-        EVERY state leaf (module docstring: block_until_ready is not a
-        reliable sync, and fetching a compute-independent leaf — e.g. an
-        iteration counter — would not provably wait either)."""
-        if self._sync_fn is None:
-            import jax.numpy as jnp
-
-            def reduce_all(tree):
-                parts = [
-                    jnp.ravel(leaf)[0].astype(jnp.float32)
-                    for leaf in jax.tree.leaves(tree)
-                ]
-                return sum(parts, jnp.float32(0))
-
-            self._sync_fn = jax.jit(reduce_all)
-        jax.device_get(self._sync_fn(state))
+        """Force completion via the shared :func:`fetch_sync` helper —
+        a fetched scalar that data-depends on EVERY state leaf (module
+        docstring: block_until_ready is not a reliable sync, and fetching
+        a compute-independent leaf — e.g. an iteration counter — would
+        not provably wait either)."""
+        fetch_sync(state)
 
     def run(self, state, iters: int = 10) -> tuple:
         state = self._step(state)

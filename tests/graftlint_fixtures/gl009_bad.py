@@ -1,0 +1,12 @@
+"""GL009 positive fixture: per-iteration host fetches in a logging loop (3)."""
+
+import jax
+
+
+def train_loop(update, runner, steps, log_fn):
+    for i in range(steps):
+        runner, metrics = update(runner)
+        loss = float(metrics["loss"])        # per-step concretization sync
+        grad = metrics["grad_norm"].item()   # ... a second sync
+        row = jax.device_get(metrics)        # ... and a third, unbatched
+        log_fn(i, {"loss": loss, "grad": grad, **row})

@@ -1,0 +1,35 @@
+"""GL009 negative fixture: the window-gated / measurement-only shapes."""
+
+import jax
+
+
+def train_loop(update, runner, steps, window, merge, log_fn):
+    """Device-side accumulation, ONE batched fetch per logging window."""
+    acc = None
+    for i in range(steps):
+        runner, metrics = update(runner)
+        acc = metrics if acc is None else merge(acc, metrics)  # on device
+        if (i + 1) % window == 0:
+            host = jax.device_get(acc)  # the window's single fetch
+            log_fn(i, {k: float(v) for k, v in host.items()})
+            acc = None
+    return runner
+
+
+def measure(update, runner, steps):
+    """Fetch-synced measurement loop: the fetch IS the measurement and
+    nothing logs per step — GL009 stays silent (GL001/GL008 territory)."""
+    total = 0.0
+    for _ in range(steps):
+        runner, metrics = update(runner)
+        total += float(metrics["loss"])
+    return runner, total
+
+
+def convert_fetched(pending, log_fn):
+    """Converting an already-fetched result is free: the batched
+    ``device_get`` happened BEFORE the loop, so ``float()`` here touches
+    host memory only."""
+    host_rows = jax.device_get(pending)
+    for i in range(len(host_rows)):
+        log_fn(i, float(host_rows[i]))

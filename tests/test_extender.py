@@ -15,6 +15,7 @@ from rl_scheduler_tpu.models import ActorCritic
 from rl_scheduler_tpu.scheduler.extender import (
     MAX_EXTENDER_SCORE,
     ExtenderPolicy,
+    LatencyStats,
     build_policy,
     make_server,
     node_cloud,
@@ -169,6 +170,45 @@ def test_stats_accumulate(telemetry):
     assert stats["latency"]["count"] == 10
     assert sum(stats["decisions"].values()) == 10
     assert stats["backend"] == "greedy"
+
+
+def test_latency_stats_merge_for_shared_scrape():
+    """Multi-worker serving: one LatencyStats per worker process, and a
+    shared scrape sums them — cumulative Prometheus histograms are linear,
+    so the bucket-wise merge of two workers must equal one stats instance
+    that saw the union of both latency streams."""
+    rng = np.random.RandomState(3)
+    streams = [rng.exponential(0.002, 200), rng.exponential(0.01, 50)]
+    workers = [LatencyStats(), LatencyStats()]
+    union = LatencyStats()
+    for worker, stream in zip(workers, streams):
+        for v in stream:
+            worker.record(float(v))
+            union.record(float(v))
+    merged_counts, merged_sum, merged_count = \
+        LatencyStats.merged_histogram(workers)
+    union_counts, union_sum, union_count = union.histogram()
+    assert merged_counts == union_counts
+    assert merged_sum == pytest.approx(union_sum)
+    assert merged_count == union_count == 250
+    # Prometheus histogram invariants of the merged result: cumulative
+    # counts are monotone and the +Inf bucket equals the total count.
+    assert merged_counts == sorted(merged_counts)
+    assert merged_counts[-1] == merged_count
+
+
+def test_latency_stats_merge_survives_worker_reset():
+    """/stats/reset clears a worker's percentile ring, never its lifetime
+    histogram — the merged scrape must not go backwards (Prometheus
+    counters treat decreases as counter resets)."""
+    workers = [LatencyStats(), LatencyStats()]
+    for w in workers:
+        for v in (0.0002, 0.003, 0.04):
+            w.record(v)
+    before = LatencyStats.merged_histogram(workers)
+    workers[0].reset()
+    assert workers[0].percentiles_ms() == {"count": 0}  # window cleared
+    assert LatencyStats.merged_histogram(workers) == before
 
 
 def test_build_policy_greedy_without_checkpoint(tmp_path):

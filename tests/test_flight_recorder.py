@@ -1,0 +1,198 @@
+"""Flight recorder: device ring semantics, anomaly triggers, dump artifact
+schema, and the end-to-end path through the training loop."""
+
+import json
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_scheduler_tpu.utils.flight_recorder import (
+    FlightRecorder,
+    build_manifest,
+)
+from rl_scheduler_tpu.utils.metrics import TrainObserver
+
+
+def _read(path):
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    manifests = [ln for ln in lines if ln["kind"] == "manifest"]
+    rings = [ln for ln in lines if ln["kind"] == "ring"]
+    return manifests, rings
+
+
+def _record_rows(rec, values):
+    for i, v in enumerate(values):
+        rec.record(i, {"loss": jnp.float32(v), "grad_norm": jnp.float32(1.0)})
+
+
+def test_dump_fires_on_injected_nan(tmp_path):
+    """The acceptance path: a NaN in a watched row dumps ring + manifest."""
+    rec = FlightRecorder(
+        path=tmp_path / "fr.jsonl",
+        manifest=build_manifest(config={"preset": "quick", "seed": 3}),
+    )
+    _record_rows(rec, [0.5, 0.4, 0.3])
+    rec.check_row(0, {"loss": 0.5, "grad_norm": 1.0})
+    assert rec.dump_count == 0
+    rec.check_row(2, {"loss": float("nan"), "grad_norm": 1.0})
+    assert rec.dump_count == 1
+    manifests, rings = _read(rec.path)
+    (m,) = manifests
+    assert m["reason"] == "nan_inf" and "loss" in m["detail"]
+    assert m["iteration"] == 2
+    # The manifest is self-describing run provenance.
+    assert m["config"] == {"preset": "quick", "seed": 3}
+    for key in ("jax_version", "backend", "device_kind", "precision",
+                "git_sha"):
+        assert key in m, key
+    # Ring rows: every recorded step, chronological, with the metrics.
+    assert [r["step"] for r in rings] == [0, 1, 2]
+    assert [r["loss"] for r in rings] == pytest.approx([0.5, 0.4, 0.3])
+
+
+def test_ring_wraparound_keeps_last_k(tmp_path):
+    rec = FlightRecorder(path=tmp_path / "fr.jsonl", capacity=4)
+    _record_rows(rec, np.arange(7, dtype=np.float32))
+    rec.dump("manual", 6)
+    _, rings = _read(rec.path)
+    assert [r["step"] for r in rings] == [3, 4, 5, 6]
+    assert [r["loss"] for r in rings] == [3.0, 4.0, 5.0, 6.0]
+
+
+def test_stacked_record_fused_dispatch(tmp_path):
+    """updates_per_dispatch=k hands [k]-stacked metrics; the ring writes
+    k rows in one scatter."""
+    rec = FlightRecorder(path=tmp_path / "fr.jsonl", capacity=8)
+    rec.record(0, {"loss": jnp.asarray([1.0, 2.0, 3.0])}, k=3)
+    rec.record(3, {"loss": jnp.asarray([4.0, 5.0, 6.0])}, k=3)
+    rec.dump("manual", 5)
+    _, rings = _read(rec.path)
+    assert [r["step"] for r in rings] == [0, 1, 2, 3, 4, 5]
+    assert [r["loss"] for r in rings] == [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]
+
+
+def test_ring_grows_to_hold_one_dispatch(tmp_path):
+    """updates_per_dispatch > capacity would scatter duplicate indices in
+    one .at[].set (undefined winner per XLA scatter semantics); the ring
+    grows to hold a full dispatch instead."""
+    rec = FlightRecorder(path=tmp_path / "fr.jsonl", capacity=4)
+    rec.record(0, {"loss": jnp.arange(6, dtype=jnp.float32)}, k=6)
+    rec.dump("manual", 5)
+    _, rings = _read(rec.path)
+    assert [r["step"] for r in rings] == [0, 1, 2, 3, 4, 5]
+    assert [r["loss"] for r in rings] == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+
+
+def test_zscore_spike_trigger(tmp_path):
+    rec = FlightRecorder(path=tmp_path / "fr.jsonl", zscore_threshold=8.0,
+                         min_count=20)
+    rng = np.random.RandomState(0)
+    for i in range(30):
+        rec.check_row(i, {"grad_norm": 1.0 + 0.05 * float(rng.randn())})
+    assert rec.dump_count == 0
+    rec.check_row(30, {"grad_norm": 100.0})
+    assert rec.dump_count == 1
+    manifests, _ = _read(rec.path)
+    assert manifests[0]["reason"] == "zscore_spike"
+    assert "sigma" in manifests[0]["detail"]
+    # The spike stayed out of the running baseline: a second spike right
+    # after still triggers (rate-limit permitting).
+    rec.check_row(31, {"grad_norm": 100.0})
+    assert rec.dump_count == 2
+
+
+def test_eval_collapse_wrap(tmp_path):
+    rec = FlightRecorder(path=tmp_path / "fr.jsonl")
+    seen = []
+    wrapped = rec.wrap_eval_log(lambda i, m: seen.append(i), threshold=-50.0)
+    wrapped(4, {"eval_episode_reward_mean": -20.0,
+                "eval_episodes_completed": 8.0})
+    assert rec.dump_count == 0
+    wrapped(9, {"eval_episode_reward_mean": -80.0,
+                "eval_episodes_completed": 8.0})
+    assert rec.dump_count == 1
+    manifests, _ = _read(rec.path)
+    assert manifests[0]["reason"] == "eval_collapse"
+    assert seen == [4, 9], "inner sink must still run after the dump"
+    # And the wrap composes with a raising inner sink (the reseed guard):
+    def raising(i, m):
+        raise RuntimeError("stall")
+
+    wrapped = rec.wrap_eval_log(raising, threshold=-50.0)
+    with pytest.raises(RuntimeError):
+        wrapped(12, {"eval_episode_reward_mean": -90.0})
+    assert rec.dump_count == 2, "dump lands BEFORE the guard raises"
+
+
+def test_reset_clears_ring_and_tags_manifest(tmp_path):
+    """The --reseed-on-stall contract: a reset between attempts drops the
+    abandoned attempt's ring rows (same step numbers, different seed) and
+    stamps the manifest so later dumps are attributable."""
+    rec = FlightRecorder(path=tmp_path / "fr.jsonl", manifest={"seed": 0})
+    _record_rows(rec, [0.5, 0.4])
+    # A healthy baseline accumulates, then the attempt is abandoned.
+    for i in range(25):
+        rec.check_row(i, {"grad_norm": 1.0})
+    rec.reset(reseed_attempt=1, seed=1)
+    rec.record(0, {"loss": jnp.float32(9.0), "grad_norm": jnp.float32(1.0)})
+    rec.check_row(0, {"loss": float("nan")})
+    manifests, rings = _read(rec.path)
+    assert manifests[0]["reseed_attempt"] == 1 and manifests[0]["seed"] == 1
+    # Only the replacement attempt's row survives — step 0 appears once.
+    assert [(r["step"], r["loss"]) for r in rings] == [(0, 9.0)]
+    # The z-score baseline restarted too (below min_count again).
+    assert rec._welford.get("grad_norm", (0,))[0] <= 1
+
+
+def test_dump_exception_unwind(tmp_path):
+    """The CLIs' shared unwind hook: reason tags the exception type and
+    the detail is bounded, with the ring preserved."""
+    rec = FlightRecorder(path=tmp_path / "fr.jsonl")
+    _record_rows(rec, [0.5])
+    try:
+        raise ValueError("boom " + "x" * 600)
+    except ValueError as e:
+        assert rec.dump_exception(e)
+    manifests, rings = _read(rec.path)
+    assert manifests[0]["reason"] == "exception:ValueError"
+    assert len(manifests[0]["detail"]) == 500
+    assert [r["step"] for r in rings] == [0]
+
+
+def test_dump_rate_limit(tmp_path):
+    rec = FlightRecorder(path=tmp_path / "fr.jsonl", max_dumps=2)
+    for i in range(5):
+        assert rec.dump("manual", i) == (i < 2)
+    manifests, _ = _read(rec.path)
+    assert len(manifests) == 2
+
+
+def test_end_to_end_through_train_loop(tmp_path):
+    """run_train_loop + TrainObserver: an update that goes NaN mid-run
+    triggers the dump with no CLI involvement, and the ring holds the
+    healthy steps leading up to it."""
+    import jax
+
+    from rl_scheduler_tpu.agent.loop import run_train_loop
+
+    rec = FlightRecorder(path=tmp_path / "fr.jsonl", capacity=16)
+
+    @jax.jit
+    def update(state):
+        i = state["i"]
+        loss = jnp.where(i >= 5, jnp.float32(jnp.nan), 1.0 / (1.0 + i))
+        return {"i": i + 1}, {"loss": loss, "grad_norm": jnp.float32(1.0)}
+
+    run_train_loop(update, {"i": jnp.float32(0)}, 0, 8,
+                   observer=TrainObserver(recorder=rec))
+    manifests, rings = _read(rec.path)
+    assert manifests[0]["reason"] == "nan_inf"
+    assert manifests[0]["iteration"] == 5
+    # Healthy prefix preserved; the poisoned step itself is in the ring
+    # too (it was dispatched before detection).
+    by_step = {r["step"]: r for r in rings}
+    assert by_step[4]["loss"] == pytest.approx(0.2)
+    assert isinstance(by_step[5]["loss"], str) and \
+        math.isnan(float(by_step[5]["loss"]))

@@ -1,0 +1,306 @@
+"""graftscope device-resident metrics: numpy parity, merge algebra, the
+one-fetch-per-window contract, and the instrumented-update equivalence
+(observability must not change the math)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_scheduler_tpu.agent.ppo import PPOTrainConfig, ppo_train
+from rl_scheduler_tpu.config import EnvConfig
+from rl_scheduler_tpu.env import core as env_core
+from rl_scheduler_tpu.utils import metrics as gs
+
+SMOKE_CFG = PPOTrainConfig(
+    num_envs=8, rollout_steps=16, minibatch_size=32, num_epochs=2,
+    hidden=(16, 16),
+)
+
+
+@pytest.fixture(scope="module")
+def env_params():
+    return env_core.make_params(EnvConfig())
+
+
+# ------------------------------------------------------- numpy parity
+
+
+def test_welford_observe_matches_numpy(rng):
+    x = rng.randn(1000).astype(np.float32) * 3.0 + 1.5
+    s = jax.device_get(gs.stats_observe(jnp.asarray(x)))
+    assert float(s.count) == 1000
+    np.testing.assert_allclose(float(s.mean), x.mean(), rtol=1e-5)
+    np.testing.assert_allclose(float(s.m2) / 1000, x.var(), rtol=1e-4)
+    assert float(s.min) == pytest.approx(x.min())
+    assert float(s.max) == pytest.approx(x.max())
+
+
+def test_welford_merge_matches_whole_stream(rng):
+    """Chunked observe+merge == one-shot observe of the concatenation,
+    for unequal chunk sizes (the merge algebra, not just the mean)."""
+    chunks = [rng.randn(n).astype(np.float32) * (i + 1)
+              for i, n in enumerate((7, 400, 1, 93))]
+    acc = gs.stats_observe(jnp.asarray(chunks[0]))
+    for c in chunks[1:]:
+        acc = gs.stats_merge(acc, gs.stats_observe(jnp.asarray(c)))
+    whole = np.concatenate(chunks)
+    acc = jax.device_get(acc)
+    assert float(acc.count) == whole.size
+    np.testing.assert_allclose(float(acc.mean), whole.mean(), rtol=1e-5)
+    np.testing.assert_allclose(float(acc.m2) / whole.size, whole.var(),
+                               rtol=1e-4)
+    assert float(acc.min) == pytest.approx(whole.min())
+    assert float(acc.max) == pytest.approx(whole.max())
+
+
+def test_stats_reduce_matches_pairwise_merge(rng):
+    """The closed-form stacked reduction (fused-dispatch path) equals
+    folding stats_merge pairwise."""
+    parts = [gs.stats_observe(jnp.asarray(rng.randn(50).astype(np.float32)))
+             for _ in range(4)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *parts)
+    reduced = jax.device_get(gs.stats_reduce(stacked))
+    folded = parts[0]
+    for p in parts[1:]:
+        folded = gs.stats_merge(folded, p)
+    folded = jax.device_get(folded)
+    for field in gs.TensorStats._fields:
+        np.testing.assert_allclose(
+            float(getattr(reduced, field)), float(getattr(folded, field)),
+            rtol=1e-5, err_msg=field)
+
+
+def test_hist_observe_matches_numpy(rng):
+    edges = (-2.0, -0.5, 0.0, 0.5, 2.0)
+    x = rng.randn(2000).astype(np.float32)
+    counts = np.asarray(gs.hist_observe(jnp.asarray(x), edges))
+    expected = np.bincount(
+        np.searchsorted(np.asarray(edges), x, side="right"),
+        minlength=len(edges) + 1,
+    )
+    np.testing.assert_array_equal(counts, expected)
+    assert counts.sum() == 2000
+
+
+def test_categorical_observe_counts_and_clips():
+    ids = jnp.asarray([0, 1, 1, 2, 2, 2, 7, -3])
+    counts = np.asarray(gs.categorical_observe(ids, 4))
+    # 7 clips into the top bin, -3 into bin 0 — piled up, not dropped.
+    np.testing.assert_array_equal(counts, [2, 2, 3, 1])
+
+
+def test_hist_spec_validation():
+    with pytest.raises(ValueError, match="exactly one"):
+        gs.HistSpec("x")
+    with pytest.raises(ValueError, match="exactly one"):
+        gs.HistSpec("x", edges=(0.0,), bins=2)
+    with pytest.raises(ValueError, match=">= 1"):
+        gs.ScopeSession(gs.MetricsSpec(), 0, lambda i, s: None)
+
+
+def test_scope_observe_merge_summary_roundtrip(rng):
+    spec = gs.MetricsSpec(
+        stats=("loss",),
+        hists=(gs.HistSpec("loss", edges=(0.0, 1.0)),
+               gs.HistSpec("action", bins=3)),
+    )
+    a = rng.rand(64).astype(np.float32)
+    b = rng.rand(64).astype(np.float32)
+    s1 = gs.scope_observe(spec, {"loss": jnp.asarray(a),
+                                 "action": jnp.zeros(64, jnp.int32)})
+    s2 = gs.scope_observe(spec, {"loss": jnp.asarray(b),
+                                 "action": jnp.ones(64, jnp.int32)})
+    merged = jax.device_get(gs.scope_merge(s1, s2))
+    out = gs.scope_summary(merged, spec)
+    whole = np.concatenate([a, b])
+    assert out["loss/count"] == 128
+    np.testing.assert_allclose(out["loss/mean"], whole.mean(), rtol=1e-5)
+    np.testing.assert_allclose(out["loss/std"], whole.std(), rtol=1e-4)
+    assert sum(out["hist/loss"]["counts"]) == 128
+    assert out["hist/loss"]["edges"] == [0.0, 1.0]
+    assert out["hist/action"]["counts"] == [64, 64, 0]
+
+
+# ------------------------------------------- the one-fetch-per-window gate
+
+
+def _run_scoped(env_params, iterations, window, k=1, monkeypatch=None):
+    spec = gs.ppo_scope_spec(2)
+    summaries = []
+    session = gs.ScopeSession(
+        spec, window, lambda i, s: summaries.append((i, s)))
+    observer = gs.TrainObserver(session)
+    fetches = []
+    if monkeypatch is not None:
+        real = gs._device_get
+        monkeypatch.setattr(
+            gs, "_device_get",
+            lambda tree: (fetches.append(1), real(tree))[1])
+    _, history = ppo_train(env_params, SMOKE_CFG, iterations, seed=0,
+                           scope=spec, observer=observer,
+                           updates_per_dispatch=k)
+    return session, summaries, fetches, history
+
+
+def test_exactly_one_host_fetch_per_logging_window(env_params, monkeypatch):
+    """THE graftscope invariant (GL008/GL009 by construction): 10
+    iterations at window 5 cost exactly 2 scope fetches — counted at the
+    module's single device_get seam — and every per-update accumulate is
+    fetch-free."""
+    session, summaries, fetches, history = _run_scoped(
+        env_params, 10, 5, monkeypatch=monkeypatch)
+    assert session.fetch_count == 2
+    assert len(fetches) == 2, "scope performed a host fetch outside flush"
+    assert [i for i, _ in summaries] == [4, 9]
+    assert len(history) == 10  # scalar logging unchanged
+    # Each window summary covers exactly window * batch samples.
+    for _, s in summaries:
+        assert s["advantage/count"] == 5 * SMOKE_CFG.batch_size
+
+
+def test_window_with_fused_dispatch(env_params):
+    """updates_per_dispatch=2 stacks the per-iteration states; the
+    stacked closed-form reduction keeps window accounting exact."""
+    session, summaries, _, _ = _run_scoped(env_params, 8, 4, k=2)
+    assert session.fetch_count == 2
+    assert [i for i, _ in summaries] == [3, 7]
+    for _, s in summaries:
+        assert s["advantage/count"] == 4 * SMOKE_CFG.batch_size
+        assert sum(s["hist/action"]["counts"]) == 4 * SMOKE_CFG.batch_size
+
+
+def test_partial_window_flushes_at_close(env_params):
+    session, summaries, _, _ = _run_scoped(env_params, 5, 4)
+    assert session.fetch_count == 2  # one full window + the remainder
+    assert [i for i, _ in summaries] == [3, 4]
+    assert summaries[-1][1]["advantage/count"] == 1 * SMOKE_CFG.batch_size
+
+
+def test_instrumentation_does_not_change_training(env_params):
+    """Observability is free in MATH, not just time: the instrumented
+    update consumes no extra RNG and computes the same function — to
+    float tolerance, since the added metric ops shift XLA's fusion/
+    reassociation choices by a few ulps."""
+    _, plain = ppo_train(env_params, SMOKE_CFG, 3, seed=7)
+    spec = gs.ppo_scope_spec(2)
+    session = gs.ScopeSession(spec, 3, lambda i, s: None)
+    _, scoped = ppo_train(env_params, SMOKE_CFG, 3, seed=7, scope=spec,
+                          observer=gs.TrainObserver(session))
+    for a, b in zip(plain, scoped):
+        for key in a:
+            if key == "wall_time":
+                continue
+            assert a[key] == pytest.approx(b[key], rel=1e-3, abs=1e-6), key
+
+
+def test_scope_refused_on_sharded_path(env_params):
+    import jax.sharding as shd
+
+    mesh = shd.Mesh(np.array(jax.devices()[:2]), ("dp",))
+    with pytest.raises(ValueError, match="single-chip"):
+        ppo_train(env_params, SMOKE_CFG, 1, mesh=mesh,
+                  scope=gs.ppo_scope_spec(2))
+
+
+def test_custom_spec_without_ratio_hist_trains(env_params):
+    """The scope contract is any validating MetricsSpec, not only
+    ppo_scope_spec: a trimmed spec with no ratio hist skips the in-scan
+    bucketization entirely and still summarizes per window."""
+    spec = gs.MetricsSpec(stats=("reward",),
+                          hists=(gs.HistSpec("action", bins=2),))
+    summaries = []
+    session = gs.ScopeSession(spec, 2, lambda i, s: summaries.append((i, s)))
+    ppo_train(env_params, SMOKE_CFG, 2, seed=0, scope=spec,
+              observer=gs.TrainObserver(session))
+    assert [i for i, _ in summaries] == [1]
+    assert set(summaries[0][1]) == {"reward/count", "reward/mean",
+                                    "reward/std", "reward/min", "reward/max",
+                                    "hist/action"}
+
+
+def test_unknown_stream_rejected_at_build_time(env_params):
+    """A spec naming a stream the trainer does not produce fails before
+    any tracing, with the available names spelled out."""
+    with pytest.raises(ValueError, match="advantage"):
+        ppo_train(env_params, SMOKE_CFG, 1, scope=gs.MetricsSpec(
+            stats=("loss",)))
+
+
+def test_validate_spec_lists_unknown_and_available():
+    spec = gs.MetricsSpec(stats=("loss",),
+                          hists=(gs.HistSpec("ratio", edges=(1.0,)),))
+    with pytest.raises(ValueError) as err:
+        gs.validate_spec(spec, values=("reward",), context="ctx")
+    msg = str(err.value)
+    assert "ctx" in msg and "loss" in msg and "ratio" in msg \
+        and "reward" in msg
+    # Histogram-only streams delivered via counts= validate cleanly.
+    gs.validate_spec(spec, values=("reward", "loss"), counts=("ratio",))
+
+
+def test_validate_spec_rejects_bins_for_counts_stream():
+    """An in-scan stream is bucketized by the trainer against the spec's
+    static edges; a bins-typed HistSpec has none, so scope_observe would
+    KeyError from inside the first traced update — the guard must catch
+    it at build time instead."""
+    spec = gs.MetricsSpec(hists=(gs.HistSpec("ratio", bins=8),))
+    with pytest.raises(ValueError, match="edges"):
+        gs.validate_spec(spec, values=(), counts=("ratio",))
+    # The same bins spec is fine when a raw value stream exists.
+    gs.validate_spec(spec, values=("ratio",), counts=("ratio",))
+
+
+# ----------------------------------------------------------- CLI plumbing
+
+
+def test_train_ppo_cli_metrics_window(tmp_path):
+    from rl_scheduler_tpu.agent import train_ppo as cli
+
+    run_dir = cli.main([
+        "--preset", "quick", "--num-envs", "8", "--rollout-steps", "16",
+        "--minibatch-size", "32", "--num-epochs", "2", "--iterations", "4",
+        "--metrics-window", "2", "--run-root", str(tmp_path),
+        "--run-name", "scoped",
+    ])
+    lines = [json.loads(ln) for ln in
+             (run_dir / "metrics.jsonl").read_text().splitlines()]
+    scoped = [ln for ln in lines if ln.get("graftscope")]
+    assert [ln["iteration"] for ln in scoped] == [2, 4]
+    for ln in scoped:
+        assert {"advantage/mean", "grad_norm/max", "hist/ratio",
+                "hist/action"} <= set(ln)
+        assert len(ln["hist/action"]["counts"]) == 2  # per-cloud
+    # Per-iteration scalar lines also gained the grad_norm stream.
+    scalar = [ln for ln in lines if "env_steps_per_sec" in ln]
+    assert all("grad_norm" in ln for ln in scalar)
+
+
+def test_train_dqn_cli_metrics_window(tmp_path):
+    from rl_scheduler_tpu.agent import train_dqn as cli
+
+    run_dir = cli.main([
+        "--preset", "config1", "--iterations", "6", "--metrics-window", "3",
+        "--sync-every", "2", "--checkpoint-every", "6",
+        "--run-root", str(tmp_path), "--run-name", "scoped",
+    ])
+    lines = [json.loads(ln) for ln in
+             (run_dir / "metrics.jsonl").read_text().splitlines()]
+    scoped = [ln for ln in lines if ln.get("graftscope")]
+    assert [ln["iteration"] for ln in scoped] == [3, 6]
+    assert all("reward/mean" in ln and "hist/action" in ln for ln in scoped)
+
+
+def test_cli_metrics_window_validation(tmp_path):
+    from rl_scheduler_tpu.agent import train_ppo as cli
+
+    with pytest.raises(SystemExit, match="multiple"):
+        cli.main(["--metrics-window", "3", "--updates-per-dispatch", "2",
+                  "--iterations", "4", "--run-root", str(tmp_path)])
+    with pytest.raises(SystemExit, match="single-chip"):
+        cli.main(["--metrics-window", "2", "--dp", "2",
+                  "--run-root", str(tmp_path)])
+    with pytest.raises(SystemExit, match="positive"):
+        cli.main(["--metrics-window", "-1", "--run-root", str(tmp_path)])

@@ -214,6 +214,27 @@ class TestStallGuardUnit:
         out = capsys.readouterr().out
         assert out.count("WARNING") == 2
 
+    def test_on_stall_hook_fires_only_at_checkpoints(self):
+        """The flight recorder's collapse hook must NOT fire on
+        pre-deadline evals (expected below the bar while the policy is
+        still untrained — each spurious dump would burn the recorder's
+        max_dumps budget), only when the guard actually trips."""
+        calls = []
+        g = self._guard(on_stall=lambda it, v: calls.append((it, v)))
+        self._eval(g, 1, -500.0)  # pre-deadline: no stall decision yet
+        assert calls == []
+        with pytest.raises(cli.EvalStall):
+            self._eval(g, 2, -500.0)
+        assert calls == [(2, -500.0)]
+
+    def test_on_stall_hook_fires_in_warn_path_too(self, capsys):
+        calls = []
+        g = self._guard(raise_on_stall=False,
+                        on_stall=lambda it, v: calls.append(it))
+        self._eval(g, 2, -500.0)
+        self._eval(g, 6, -500.0)
+        assert calls == [2, 6]
+
 
 class TestPresetImpliedGuard:
     """The fleet presets imply --reseed-on-stall 2 (the preset IS the

@@ -1,0 +1,236 @@
+"""traceview: fixture round-trip into the documented schema, self-time
+attribution, budget checking (the obs gate), and the CLI contract.
+
+The checked-in fixture (``tests/fixtures/traceview/fixture.trace.json.gz``)
+is a hand-built Perfetto trace with exactly-known self-times: a 50 ms
+``jit(update_fn)`` span containing rollout (10 compute + 2 copy), gae (3),
+sgd (25 compute + 5 copy) children — so the parent's SELF time is 5 ms —
+plus a 1 ms host python frame. ``tools/traceview/budgets.json`` records the
+phase totals; this file is the pytest gate behind ``make obs``.
+"""
+
+import gzip
+import json
+from pathlib import Path
+
+import pytest
+
+from tools.traceview import (
+    budgets_from_summary,
+    check_budgets,
+    find_trace,
+    load_trace,
+    summarize,
+)
+from tools.traceview.__main__ import main as traceview_main
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+FIXTURE = REPO_ROOT / "tests" / "fixtures" / "traceview" / "fixture.trace.json.gz"
+BUDGETS = REPO_ROOT / "tools" / "traceview" / "budgets.json"
+
+
+@pytest.fixture(scope="module")
+def fixture_summary():
+    return summarize(load_trace(FIXTURE), source=str(FIXTURE))
+
+
+# ------------------------------------------------- schema round-trip
+
+
+def test_fixture_roundtrips_documented_schema(fixture_summary):
+    """The acceptance path: checked-in trace -> the docs/observability.md
+    schema, with self-times attributed exactly once."""
+    s = fixture_summary
+    assert s["metric"] == "traceview-phase-breakdown"
+    assert s["unit"] == "ms"
+    assert s["schema_version"] == 1
+    assert s["source"].endswith("fixture.trace.json.gz")
+    # Self-time accounting: child durations subtracted from the enclosing
+    # jit span, every microsecond attributed exactly once.
+    assert s["total_ms"] == pytest.approx(51.0)
+    phases = s["phases"]
+    assert set(phases) == {"rollout", "gae", "sgd", "other"}
+    assert phases["rollout"]["total_ms"] == pytest.approx(12.0)
+    assert phases["rollout"]["categories"]["compute"] == pytest.approx(10.0)
+    assert phases["rollout"]["categories"]["transfer"] == pytest.approx(2.0)
+    assert phases["gae"]["total_ms"] == pytest.approx(3.0)
+    assert phases["sgd"]["total_ms"] == pytest.approx(30.0)
+    assert phases["sgd"]["categories"]["transfer"] == pytest.approx(5.0)
+    # The jit parent's SELF time (50 - 45 of children) plus the 1 ms
+    # host frame land in "other": 5 compute + 1 host.
+    assert phases["other"]["total_ms"] == pytest.approx(6.0)
+    assert phases["other"]["categories"]["host"] == pytest.approx(1.0)
+    for entry in phases.values():
+        assert entry["fraction"] == pytest.approx(
+            entry["total_ms"] / s["total_ms"], abs=1e-5)
+        assert entry["total_ms"] == pytest.approx(
+            sum(entry["categories"].values()))
+    # JSON-serializable end to end (the bench.py-style output line).
+    json.dumps(s)
+
+
+def test_self_time_nesting_and_thread_isolation():
+    """Unit check on the stack pass: siblings, grandchildren, and an
+    identical-ts event on ANOTHER thread must not steal self-time."""
+    events = [
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 100,
+         "name": "parent"},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 30, "name": "c1"},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 10, "dur": 5, "name": "g1"},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 40, "dur": 20, "name": "c2"},
+        {"ph": "X", "pid": 1, "tid": 2, "ts": 0, "dur": 100,
+         "name": "othread"},
+    ]
+    s = summarize({"traceEvents": events})
+    # parent self = 100 - 30 - 20; c1 self = 30 - 5; all in phase "other".
+    assert s["total_ms"] == pytest.approx(0.2)  # 100 + 100 us per thread
+    assert s["phases"]["other"]["total_ms"] == pytest.approx(0.2)
+
+
+def test_phase_markers_from_long_name_and_thread_name():
+    events = [
+        {"ph": "M", "pid": 1, "tid": 9, "name": "thread_name",
+         "args": {"name": "rollout worker"}},
+        {"ph": "X", "pid": 1, "tid": 9, "ts": 0, "dur": 10, "name": "op"},
+        {"ph": "X", "pid": 1, "tid": 3, "ts": 0, "dur": 7, "name": "f.1",
+         "args": {"long_name": "jit(update_fn)/sgd/while/f.1"}},
+        {"ph": "X", "pid": 1, "tid": 3, "ts": 10, "dur": 4,
+         "name": "all-reduce.2",
+         "args": {"long_name": "jit(update_fn)/sgd/all-reduce.2"}},
+    ]
+    s = summarize({"traceEvents": events})
+    assert s["phases"]["rollout"]["total_ms"] == pytest.approx(0.01)
+    assert s["phases"]["sgd"]["total_ms"] == pytest.approx(0.011)
+    assert s["phases"]["sgd"]["categories"]["transfer"] == pytest.approx(0.004)
+
+
+# ------------------------------------------------------- budget checks
+
+
+def test_checked_in_budgets_pass_on_fixture(fixture_summary):
+    """The make-obs invariant: the committed budgets accept the committed
+    fixture."""
+    budgets = json.loads(BUDGETS.read_text())
+    assert check_budgets(fixture_summary, budgets) == []
+
+
+def test_injected_25pct_regression_fails_budgets(fixture_summary):
+    """A 25% across-the-board slowdown must trip the 20% tolerance for
+    every budgeted phase."""
+    budgets = json.loads(BUDGETS.read_text())
+    slowed = json.loads(json.dumps(fixture_summary))
+    for entry in slowed["phases"].values():
+        entry["total_ms"] *= 1.25
+    violations = check_budgets(slowed, budgets)
+    assert len(violations) == len(budgets["phases"])
+    assert all("exceeds budget" in v for v in violations)
+
+
+def test_within_tolerance_regression_passes(fixture_summary):
+    budgets = json.loads(BUDGETS.read_text())
+    slowed = json.loads(json.dumps(fixture_summary))
+    for entry in slowed["phases"].values():
+        entry["total_ms"] *= 1.15
+    assert check_budgets(slowed, budgets) == []
+
+
+def test_absent_budgeted_phase_is_a_violation(fixture_summary):
+    """A renamed named_scope zeroes its phase — that must FAIL, not pass
+    with 0 ms < budget."""
+    stripped = json.loads(json.dumps(fixture_summary))
+    del stripped["phases"]["sgd"]
+    violations = check_budgets(stripped,
+                               json.loads(BUDGETS.read_text()))
+    assert len(violations) == 1
+    assert "absent" in violations[0] and "'sgd'" in violations[0]
+
+
+def test_budgets_from_summary_excludes_other(fixture_summary):
+    budgets = budgets_from_summary(fixture_summary, tolerance_pct=20.0)
+    assert budgets["tolerance_pct"] == 20.0
+    assert set(budgets["phases"]) == {"rollout", "gae", "sgd"}
+    assert budgets["phases"]["sgd"] == pytest.approx(30.0)
+    # And the freshly-recorded baseline accepts the trace it came from.
+    assert check_budgets(fixture_summary, budgets) == []
+
+
+# ------------------------------------------------------------ find_trace
+
+
+def test_find_trace_resolves_newest_in_profiler_dir(tmp_path):
+    layout = tmp_path / "plugins" / "profile"
+    for i, ts in enumerate(("2026_01_01", "2026_01_02")):
+        d = layout / ts
+        d.mkdir(parents=True)
+        p = d / f"host.trace.json.gz"
+        with gzip.open(p, "wt") as fh:
+            json.dump({"traceEvents": []}, fh)
+        # Ensure distinct mtimes regardless of filesystem resolution.
+        import os
+        os.utime(p, (1000 + i, 1000 + i))
+    assert find_trace(tmp_path).parent.name == "2026_01_02"
+
+
+def test_find_trace_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match="no trace"):
+        find_trace(tmp_path / "nope")
+    with pytest.raises(FileNotFoundError):
+        find_trace(tmp_path)  # empty dir
+
+
+def test_load_trace_reads_plain_json(tmp_path):
+    p = tmp_path / "t.trace.json"
+    p.write_text(json.dumps({"traceEvents": []}))
+    assert load_trace(p) == {"traceEvents": []}
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def test_cli_prints_one_summary_line_and_checks_budgets(capsys):
+    rc = traceview_main(["--check", "--budgets", str(BUDGETS), str(FIXTURE)])
+    out = capsys.readouterr()
+    assert rc == 0
+    lines = out.out.strip().splitlines()
+    assert len(lines) == 1  # ONE bench.py-style JSON line on stdout
+    summary = json.loads(lines[0])
+    assert summary["metric"] == "traceview-phase-breakdown"
+    assert "OK" in out.err
+
+
+def test_cli_exits_2_on_budget_violation(tmp_path, capsys):
+    """The fail-the-build contract: an injected 25% regression on the
+    trace side exits nonzero under --check."""
+    data = load_trace(FIXTURE)
+    for e in data["traceEvents"]:
+        if e.get("ph") == "X":
+            e["dur"] = int(e["dur"] * 1.25)
+    slowed = tmp_path / "slow.trace.json"
+    slowed.write_text(json.dumps(data))
+    rc = traceview_main(["--check", "--budgets", str(BUDGETS), str(slowed)])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "BUDGET VIOLATION" in err
+
+
+def test_cli_write_budgets_round_trip(tmp_path, capsys):
+    out_path = tmp_path / "budgets.json"
+    rc = traceview_main(["--write-budgets", str(out_path),
+                         "--tolerance-pct", "10", str(FIXTURE)])
+    capsys.readouterr()
+    assert rc == 0
+    written = json.loads(out_path.read_text())
+    assert written["tolerance_pct"] == 10.0
+    assert written["phases"]["rollout"] == pytest.approx(12.0)
+    # The recorded baseline gates itself: same trace passes, --check works.
+    rc = traceview_main(["--check", "--budgets", str(out_path),
+                         str(FIXTURE)])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_cli_missing_trace_and_missing_budgets(tmp_path, capsys):
+    assert traceview_main([str(tmp_path / "absent")]) == 1
+    assert "traceview:" in capsys.readouterr().err
+    assert traceview_main(["--check", str(FIXTURE)]) == 1
+    assert "--check needs --budgets" in capsys.readouterr().err

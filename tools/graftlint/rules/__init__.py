@@ -53,6 +53,7 @@ def load_rules() -> dict:
             control_flow,
             donate,
             host_sync,
+            metrics_loop,
             pallas_tiles,
             prng,
             test_coverage,

@@ -1,0 +1,201 @@
+"""GL009 metrics-loop-host-fetch: per-iteration syncs in logging loops.
+
+The pattern graftscope (``utils/metrics.py``) exists to kill: a host-side,
+step-indexed training/driver loop that fetches device values every
+iteration — ``jax.device_get``, ``float()``/``int()``/``bool()`` on an
+update result, ``.item()`` — and hands them to a logging sink. Each fetch
+serializes the async dispatch pipeline once PER ITERATION (~100 ms per
+round-trip on this repo's tunneled TPU, ``agent/loop.py``), so a 1000-step
+run spends minutes waiting on metrics nobody reads mid-run. The discipline:
+accumulate device-side (``MetricsState`` / a pending list) and flush ONE
+batched ``jax.device_get`` per logging window.
+
+Scope and exemptions (the fixture pair pins these):
+
+- Only loops of the shape ``for i in range(...)`` (step-indexed) whose body
+  also calls a logging sink (callee name containing ``log`` or ``print``)
+  are checked — a fetch-synced *measurement* loop (``bench.py``) is the
+  measurement, not a logging loop, and stays GL001/GL008 jurisdiction.
+- Window-gated fetches are the GOOD pattern, not a finding: statements
+  under an ``if`` whose test involves ``%`` or a ``*window*``/``*every*``/
+  ``*sync*`` name are exempt (``if (i + 1) % window == 0: flush()``).
+- ``float()``-family findings require the converted value to derive from a
+  call result in the enclosing scope (the ``runner, metrics = update(...)``
+  shape); converting an already-fetched ``jax.device_get`` result is free
+  and never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.engine import (
+    LintContext,
+    Module,
+    dotted_last,
+    dotted_name,
+    iter_own_statements,
+    tracer_valued_names,
+)
+from tools.graftlint.rules import Rule, register
+
+_CONVERTERS = ("float", "int", "bool")
+# Call results that are host values (or host bookkeeping) by construction:
+# assigning from these does NOT mark the target as possibly-device.
+_HOST_RESULT_CALLS = ("device_get", "perf_counter", "monotonic", "len",
+                      "range", "enumerate", "sorted", "open",
+                      "float", "int", "bool", "str")
+_GATE_NAME_MARKERS = ("window", "every", "sync")
+
+
+def _target_names(target: ast.AST) -> set:
+    out: set = set()
+    if isinstance(target, ast.Name):
+        out.add(target.id)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            out |= _target_names(e)
+    elif isinstance(target, ast.Starred):
+        out |= _target_names(target.value)
+    return out
+
+
+def _names_in(node: ast.AST) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_window_gate(stmt: ast.AST) -> bool:
+    """``if`` statements that look like a logging-window boundary."""
+    if not isinstance(stmt, ast.If):
+        return False
+    for n in ast.walk(stmt.test):
+        if isinstance(n, ast.Mod):
+            return True
+        if isinstance(n, ast.Name) and any(
+                m in n.id.lower() for m in _GATE_NAME_MARKERS):
+            return True
+    return False
+
+
+def _walk_ungated(node: ast.AST) -> Iterator[ast.AST]:
+    """All nodes under ``node`` minus nested defs and window-gated ifs."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef, ast.Lambda)):
+        return
+    if _is_window_gate(node):
+        return
+    yield node
+    for child in ast.iter_child_nodes(node):
+        yield from _walk_ungated(child)
+
+
+def _scope_call_taint(scope: ast.AST) -> set:
+    """Names in ``scope`` bound from call results — the static proxy for
+    'possibly still a device value'. Two line-ordered passes, same
+    convergence argument as ``engine.taint_set``; comprehension and
+    for-loop targets iterating a tainted value propagate. Rebinding from a
+    host-result call UN-taints (``obs = jax.device_get(obs)`` makes every
+    later ``float(obs[...])`` free), so the final set reflects the last
+    binding in program order."""
+    tainted: set = set()
+    for _ in range(2):
+        for stmt in iter_own_statements(scope):
+            if isinstance(stmt, ast.Assign):
+                src = stmt.value
+                if isinstance(src, ast.Call):
+                    callee = (dotted_last(src.func) or "").lower()
+                    host = callee in _HOST_RESULT_CALLS or "parse" in callee
+                    for t in stmt.targets:
+                        if host:
+                            tainted -= _target_names(t)
+                        else:
+                            tainted |= _target_names(t)
+                elif _names_in(src) & tainted:
+                    for t in stmt.targets:
+                        tainted |= _target_names(t)
+                else:
+                    for t in stmt.targets:
+                        tainted -= _target_names(t)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                if _names_in(stmt.iter) & tainted:
+                    tainted |= _target_names(stmt.target)
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.ListComp, ast.SetComp,
+                                     ast.DictComp, ast.GeneratorExp)):
+                    for gen in node.generators:
+                        if _names_in(gen.iter) & tainted:
+                            tainted |= _target_names(gen.target)
+    return tainted
+
+
+def _has_log_call(loop: ast.For) -> bool:
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call):
+            name = (dotted_name(node.func) or dotted_last(node.func)
+                    or "").lower()
+            if "log" in name or "print" in name:
+                return True
+    return False
+
+
+@register
+class MetricsLoopHostFetch(Rule):
+    id = "GL009"
+    name = "metrics-loop-host-fetch"
+    summary = ("per-iteration host fetch (device_get/float()/.item()) in a "
+               "step-indexed logging loop — accumulate on device "
+               "(utils/metrics.MetricsState) and flush once per window")
+
+    def check(self, module: Module, ctx: LintContext) -> Iterator:
+        scopes = [module.tree] + [
+            rec.node for rec in module.functions if not rec.traced
+        ]
+        for scope in scopes:
+            tainted = None  # computed lazily, once per scope
+            seen: set = set()
+            for stmt in iter_own_statements(scope):
+                if not (isinstance(stmt, ast.For)
+                        and isinstance(stmt.iter, ast.Call)
+                        and dotted_last(stmt.iter.func) == "range"):
+                    continue
+                if not _has_log_call(stmt):
+                    continue
+                if tainted is None:
+                    tainted = _scope_call_taint(scope)
+                for body_stmt in stmt.body + stmt.orelse:
+                    for node in _walk_ungated(body_stmt):
+                        yield from self._check_node(
+                            module, node, tainted, seen)
+
+    def _check_node(self, module, node, tainted, seen):
+        if not isinstance(node, ast.Call) or node.lineno in seen:
+            return
+        if dotted_last(node.func) == "device_get":
+            seen.add(node.lineno)
+            yield self.finding(
+                module, node.lineno,
+                "`jax.device_get` every iteration of a logging loop — one "
+                "device round-trip per step; accumulate on device and "
+                "flush one batched fetch per window",
+            )
+        elif (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item" and not node.args
+                and tracer_valued_names(node.func.value, tainted)):
+            seen.add(node.lineno)
+            yield self.finding(
+                module, node.lineno,
+                "`.item()` on an update result every iteration of a "
+                "logging loop forces a per-step sync — batch the window's "
+                "metrics into one fetch",
+            )
+        elif (isinstance(node.func, ast.Name)
+                and node.func.id in _CONVERTERS and node.args
+                and tracer_valued_names(node.args[0], tainted)):
+            seen.add(node.lineno)
+            yield self.finding(
+                module, node.lineno,
+                f"`{node.func.id}()` on an update result every iteration "
+                "of a logging loop forces a per-step sync — batch the "
+                "window's metrics into one fetch (or carry a MetricsState)",
+            )

@@ -1,0 +1,215 @@
+"""graftscope part 3: trace-derived phase profiling (docs/observability.md).
+
+``utils/profiling.trace_iterations`` (and ``train_ppo --profile-dir``)
+writes Perfetto/Chrome-trace ``.trace.json.gz`` artifacts that, until now,
+were only ever eyeballed in a UI — nothing parsed them into numbers a
+regression check could hold. traceview does exactly that, offline:
+
+- **Self-time attribution**: duration events nest (an XLA module event
+  spans every op inside it); naive summing double-counts. Per thread, a
+  stack pass subtracts each event's duration from its parent, so every
+  microsecond is attributed exactly once.
+- **Phase classification**: the trainers annotate their update with
+  ``jax.named_scope`` (``rollout``/``gae``/``sgd`` in PPO,
+  ``collect``/``learn`` in DQN, ``scope_metrics`` for the metrics layer
+  itself). On op-metadata-bearing traces (the TPU driver) those scopes
+  appear in event ``long_name``/arg strings and events classify by
+  substring; events without a marker land in ``other``. CPU-container
+  traces carry op names only, so phases mostly read ``other`` there —
+  the CATEGORY split still works, and the parser itself is pure offline
+  JSON: it runs identically on both sides of the version split.
+- **Category classification**: ``transfer`` (copies, infeed/outfeed,
+  collectives — the HBM/ICI traffic the roofline docs reason about),
+  ``host`` (python frames, callbacks, executor scaffolding), else
+  ``compute``.
+- **Budgets**: ``budgets.json`` records per-phase millisecond budgets
+  with a tolerance; ``--check`` exits nonzero when a phase exceeds its
+  budget by more than the tolerance (or vanished entirely — renamed
+  scopes must not pass silently), the same fail-the-build contract as a
+  graftlint finding. ``--write-budgets`` records the current trace as
+  the new baseline.
+
+Pure stdlib (json/gzip) — no JAX import, usable on any checkout.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+from pathlib import Path
+
+SCHEMA_VERSION = 1
+
+# Phase markers: substrings searched in each event's name + argument
+# strings. Ordered — first hit wins (longer/rarer markers first so e.g.
+# "scope_metrics" is not swallowed by a hypothetical "metrics" phase).
+DEFAULT_PHASES = (
+    ("scope_metrics", ("scope_metrics",)),
+    ("rollout", ("rollout",)),
+    ("gae", ("/gae/", "gae/", "(gae)")),
+    ("sgd", ("sgd",)),
+    ("collect", ("/collect/", "collect/", "(collect)")),
+    ("learn", ("/learn/", "learn/", "(learn)")),
+)
+
+TRANSFER_MARKERS = ("copy", "transfer", "infeed", "outfeed", "memset",
+                    "all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "send", "recv")
+HOST_MARKERS = ("python", "callback", "pjit", "executehelper",
+                "parsearguments", "threadpool", "$")
+CATEGORIES = ("compute", "transfer", "host")
+
+
+def find_trace(path: str | Path) -> Path:
+    """Resolve a trace artifact: a file as-is, or the newest
+    ``*.trace.json.gz`` under a directory (the layout
+    ``jax.profiler.trace`` writes: ``<dir>/plugins/profile/<ts>/...``)."""
+    path = Path(path)
+    if path.is_file():
+        return path
+    if path.is_dir():
+        candidates = sorted(path.rglob("*.trace.json.gz"),
+                            key=lambda p: p.stat().st_mtime)
+        if candidates:
+            return candidates[-1]
+    raise FileNotFoundError(
+        f"no trace at {path} (expected a .trace.json[.gz] file or a "
+        "profiler log dir containing one)")
+
+
+def load_trace(path: str | Path) -> dict:
+    path = find_trace(path)
+    opener = gzip.open if path.name.endswith(".gz") else open
+    with opener(path, "rt") as fh:
+        return json.load(fh)
+
+
+def _event_text(event: dict, thread_names: dict) -> str:
+    parts = [str(event.get("name", ""))]
+    for v in (event.get("args") or {}).values():
+        if isinstance(v, str):
+            parts.append(v)
+    tname = thread_names.get((event.get("pid"), event.get("tid")))
+    if tname:
+        parts.append(tname)
+    return " ".join(parts).lower()
+
+
+def _classify_phase(text: str, phases) -> str:
+    for phase, markers in phases:
+        if any(m in text for m in markers):
+            return phase
+    return "other"
+
+
+def _classify_category(text: str) -> str:
+    if any(m in text for m in HOST_MARKERS):
+        return "host"
+    if any(m in text for m in TRANSFER_MARKERS):
+        return "transfer"
+    return "compute"
+
+
+def _self_times(events: list) -> list:
+    """``(event, self_dur_us)`` with child durations subtracted, per the
+    Chrome-trace nesting convention (same thread, enclosing [ts, ts+dur)).
+    Events are attributed exactly once; partial overlaps (clock skew in
+    real traces) degrade gracefully to inner-wins."""
+    out = []
+    by_thread: dict = {}
+    for e in events:
+        by_thread.setdefault((e.get("pid"), e.get("tid")), []).append(e)
+    for thread_events in by_thread.values():
+        thread_events.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack: list = []  # [event, end, self_dur]
+        for e in thread_events:
+            while stack and stack[-1][1] <= e["ts"]:
+                out.append((stack[-1][0], max(stack[-1][2], 0.0)))
+                stack.pop()
+            if stack:
+                stack[-1][2] -= e["dur"]
+            stack.append([e, e["ts"] + e["dur"], float(e["dur"])])
+        for ev, _, self_dur in stack:
+            out.append((ev, max(self_dur, 0.0)))
+    return out
+
+
+def summarize(data: dict, source: str = "", phases=DEFAULT_PHASES) -> dict:
+    """The documented traceview schema (docs/observability.md): total and
+    per-phase self-time in ms, each phase split by category."""
+    events = data.get("traceEvents", data if isinstance(data, list) else [])
+    thread_names = {}
+    durations = []
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") in ("thread_name",
+                                                    "process_name"):
+            thread_names[(e.get("pid"), e.get("tid"))] = \
+                (e.get("args") or {}).get("name", "")
+        elif e.get("ph") == "X" and e.get("dur", 0) > 0:
+            durations.append(e)
+
+    buckets: dict = {}
+    total_us = 0.0
+    for event, self_us in _self_times(durations):
+        if self_us <= 0:
+            continue
+        text = _event_text(event, thread_names)
+        phase = _classify_phase(text, phases)
+        category = _classify_category(text)
+        row = buckets.setdefault(phase, {c: 0.0 for c in CATEGORIES})
+        row[category] += self_us
+        total_us += self_us
+
+    phase_out = {}
+    for phase, cats in sorted(buckets.items()):
+        phase_total = sum(cats.values())
+        phase_out[phase] = {
+            "total_ms": round(phase_total / 1e3, 6),
+            "fraction": round(phase_total / total_us, 6) if total_us else 0.0,
+            "categories": {c: round(v / 1e3, 6) for c, v in cats.items()},
+        }
+    return {
+        "metric": "traceview-phase-breakdown",
+        "unit": "ms",
+        "schema_version": SCHEMA_VERSION,
+        "source": source,
+        "total_ms": round(total_us / 1e3, 6),
+        "phases": phase_out,
+    }
+
+
+def check_budgets(summary: dict, budgets: dict) -> list:
+    """Violation strings (empty = within budget). A phase fails when its
+    self-time exceeds ``budget_ms * (1 + tolerance_pct/100)``, or when a
+    budgeted phase produced NO time at all — a renamed named_scope would
+    otherwise zero a phase and sail through."""
+    tolerance = float(budgets.get("tolerance_pct", 20.0))
+    violations = []
+    for phase, budget_ms in sorted(budgets.get("phases", {}).items()):
+        measured = summary["phases"].get(phase, {}).get("total_ms", 0.0)
+        limit = float(budget_ms) * (1.0 + tolerance / 100.0)
+        if measured == 0.0 and float(budget_ms) > 0.0:
+            violations.append(
+                f"phase {phase!r}: absent from the trace (budget "
+                f"{budget_ms} ms) — renamed scope or broken attribution?")
+        elif measured > limit:
+            violations.append(
+                f"phase {phase!r}: {measured:.3f} ms exceeds budget "
+                f"{budget_ms} ms by more than {tolerance:.0f}% "
+                f"(limit {limit:.3f} ms)")
+    return violations
+
+
+def budgets_from_summary(summary: dict, tolerance_pct: float = 20.0) -> dict:
+    """Record the current trace as the new per-phase baseline (the
+    ``--write-budgets`` path). ``other`` is excluded: it aggregates
+    unattributed time and would make the budget meaninglessly broad."""
+    return {
+        "tolerance_pct": tolerance_pct,
+        "unit": "ms",
+        "phases": {
+            phase: entry["total_ms"]
+            for phase, entry in summary["phases"].items()
+            if phase != "other"
+        },
+    }

@@ -1,0 +1,84 @@
+"""traceview CLI.
+
+Usage::
+
+    python -m tools.traceview <trace.json[.gz] | profiler-log-dir>
+    python -m tools.traceview --check --budgets tools/traceview/budgets.json \
+        tests/fixtures/traceview/fixture.trace.json.gz
+    python -m tools.traceview --write-budgets tools/traceview/budgets.json \
+        /tmp/profile_dir
+
+Prints ONE bench.py-style JSON summary line to stdout (the documented
+schema, docs/observability.md); ``--check`` exits 2 on any budget
+violation, the same fail-the-build contract as graftlint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tools.traceview import (
+    budgets_from_summary,
+    check_budgets,
+    find_trace,
+    load_trace,
+    summarize,
+)
+
+
+def main(argv: list | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.traceview",
+        description="Parse a jax.profiler Perfetto trace into a per-phase/"
+                    "per-category breakdown and check it against budgets.")
+    p.add_argument("trace", help="a .trace.json[.gz] file or a profiler "
+                                 "log dir (newest trace inside is used)")
+    p.add_argument("--budgets", default=None,
+                   help="budgets JSON (per-phase ms + tolerance_pct); "
+                        "violations print to stderr")
+    p.add_argument("--check", action="store_true",
+                   help="exit 2 when any budgeted phase exceeds its "
+                        "budget by more than the tolerance")
+    p.add_argument("--write-budgets", default=None, metavar="OUT",
+                   help="record this trace's per-phase totals as the new "
+                        "budget baseline")
+    p.add_argument("--tolerance-pct", type=float, default=20.0,
+                   help="tolerance recorded by --write-budgets "
+                        "(default 20)")
+    args = p.parse_args(argv)
+
+    try:
+        source = find_trace(args.trace)
+        summary = summarize(load_trace(source), source=str(source))
+    except FileNotFoundError as e:
+        print(f"traceview: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(summary), flush=True)
+
+    if args.write_budgets:
+        budgets = budgets_from_summary(summary, args.tolerance_pct)
+        Path(args.write_budgets).write_text(json.dumps(budgets, indent=2) + "\n")
+        print(f"traceview: budgets written to {args.write_budgets}",
+              file=sys.stderr)
+
+    if args.budgets:
+        budgets = json.loads(Path(args.budgets).read_text())
+        violations = check_budgets(summary, budgets)
+        for v in violations:
+            print(f"traceview: BUDGET VIOLATION: {v}", file=sys.stderr)
+        if violations and args.check:
+            return 2
+        if not violations:
+            print(f"traceview: {len(budgets.get('phases', {}))} phase "
+                  "budget(s) OK", file=sys.stderr)
+    elif args.check:
+        print("traceview: --check needs --budgets", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
