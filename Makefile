@@ -8,7 +8,7 @@ PY ?= python
 # `train_ppo --profile-dir`) to summarize/check a real run.
 TRACE ?= tests/fixtures/traceview/fixture.trace.json.gz
 
-.PHONY: lint lint-json test tier1 trace-summary obs
+.PHONY: lint lint-json test tier1 trace-summary obs chaos chaos-soak
 
 lint:
 	$(PY) -m tools.graftlint --check
@@ -28,3 +28,13 @@ test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
 
 tier1: test
+
+# graftguard chaos gate: the fault-injection suite (seeded FaultPlan
+# attacks on every host-I/O boundary — checkpoint writes, scrapes, kube
+# API, backend, preemption; docs/robustness.md). `chaos` is the fast
+# deterministic gate; `chaos-soak` adds the long rate-based soak runs.
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_graftguard.py -q -m 'not slow'
+
+chaos-soak:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_graftguard.py -q

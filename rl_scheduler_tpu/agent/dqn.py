@@ -409,8 +409,24 @@ def dqn_train(
     updates_per_dispatch: int = 1,
     scope: Any | None = None,
     observer: Any | None = None,
+    restore: tuple[dict, int] | None = None,
+    preemption: Any | None = None,
+    on_preempt: Callable[[int, DQNRunnerState], None] | None = None,
 ):
     """Host-side training loop mirroring :func:`rl_scheduler_tpu.agent.ppo.ppo_train`.
+
+    ``restore=(tree, completed_iterations)`` resumes a checkpointed run.
+    A tree with a ``"loop"`` key (graftguard full-state checkpoints:
+    buffer/env_state/obs/key/env_steps/ep_return/last_episode_return) is
+    a DETERMINISTIC resume — the whole runner, replay buffer included,
+    comes from the checkpoint and the RNG is not re-seeded, so
+    interrupt-and-resume is bitwise-identical to an uninterrupted run.
+    A params/target_params/opt_state-only tree resumes learning state
+    with a fresh collection stream (key folded with the resume point).
+
+    ``preemption``/``on_preempt``: see ``run_train_loop`` — polled at
+    dispatch boundaries; a stop flushes, force-checkpoints, fires
+    ``on_preempt``, and returns cleanly.
 
     ``scope``/``observer``: graftscope instrumentation, exactly as in
     ``ppo_train`` (see :func:`make_dqn` for the DQN watch set).
@@ -437,14 +453,46 @@ def dqn_train(
     from rl_scheduler_tpu.agent.ppo import make_greedy_eval_hook
 
     init_fn, update_fn, net = make_dqn(bundle, cfg, scope=scope)
-    runner = jax.jit(init_fn)(jax.random.PRNGKey(seed))
+    start_iteration = 0
+    full_state = restore is not None and "loop" in restore[0]
+    key = jax.random.PRNGKey(seed)
+    if restore is not None and not full_state:
+        key = jax.random.fold_in(key, restore[1])
+    runner = jax.jit(init_fn)(key)
+    if restore is not None:
+        tree, start_iteration = restore
+        # Copy: the jitted update donates the runner's buffers (ppo_train
+        # has the same guard) — without it the caller's checkpoint tree
+        # would be deleted out from under it on accelerator backends.
+        tree = jax.tree.map(lambda x: jnp.array(x, copy=True), tree)
+        if full_state:
+            loop_state = tree["loop"]
+            runner = runner._replace(
+                params=tree["params"],
+                target_params=tree["target_params"],
+                opt_state=tree["opt_state"],
+                buffer=ReplayBuffer(**loop_state["buffer"]),
+                env_state=loop_state["env_state"],
+                obs=loop_state["obs"],
+                key=loop_state["key"],
+                env_steps=loop_state["env_steps"],
+                ep_return=loop_state["ep_return"],
+                last_episode_return=loop_state["last_episode_return"],
+            )
+        else:
+            runner = runner._replace(
+                params=tree["params"],
+                target_params=tree["target_params"],
+                opt_state=tree["opt_state"],
+            )
     update = make_update(update_fn, debug_checks, updates_per_dispatch)
     eval_hook = make_greedy_eval_hook(
         bundle, net, cfg.eval_every, cfg.eval_episodes, seed, eval_log_fn
     )
     return run_train_loop(
-        update, runner, 0, num_iterations,
+        update, runner, start_iteration, num_iterations,
         sync_every=sync_every, log_fn=log_fn, checkpoint_fn=checkpoint_fn,
         eval_every=cfg.eval_every, eval_hook=eval_hook,
         updates_per_dispatch=updates_per_dispatch, observer=observer,
+        preemption=preemption, on_preempt=on_preempt,
     )

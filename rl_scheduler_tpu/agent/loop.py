@@ -39,8 +39,21 @@ def run_train_loop(
     eval_hook: Callable[[int, Any], None] | None = None,
     updates_per_dispatch: int = 1,
     observer: Any | None = None,
+    preemption: Any | None = None,
+    on_preempt: Callable[[int, Any], None] | None = None,
 ) -> tuple[Any, list[dict]]:
     """Run ``update`` for iterations ``[start_iteration, num_iterations)``.
+
+    ``preemption`` (a ``utils/preemption.PreemptionGuard``) is polled at
+    each dispatch boundary — the one point where the runner is a
+    consistent pytree. When it reports a stop: pending metrics flush, a
+    FINAL checkpoint is written through ``checkpoint_fn.force`` (saving
+    even mid-interval; falls back to a plain ``checkpoint_fn`` call),
+    ``on_preempt(last_iteration, runner)`` fires (the CLIs dump a
+    flight-recorder manifest there), and the loop returns normally with
+    ``preemption.stopped_at`` set. The in-flight dispatch always
+    completes first: stopping is checked BEFORE dispatching, never by
+    abandoning dispatched work.
 
     ``observer`` (graftscope, ``utils/metrics.TrainObserver``) gets three
     hooks: ``observe(i0, metrics, k) -> metrics`` right after each
@@ -142,6 +155,35 @@ def run_train_loop(
         )
     try:
         for i0 in range(start_iteration, num_iterations, k):
+            if preemption is not None and preemption.should_stop():
+                # Acting here (before the next dispatch) means the last
+                # dispatched update has already been folded into runner:
+                # the final checkpoint covers everything trained.
+                last = i0 - 1
+                preemption.stopped_at = last
+                # Checkpoint FIRST: the final save is the artifact this
+                # path exists to write; the metrics flush is a device
+                # fetch that can itself fail on a dying VM and must not
+                # forfeit it.
+                if checkpoint_fn is not None and last >= start_iteration:
+                    force = getattr(checkpoint_fn, "force", checkpoint_fn)
+                    force(last, runner)
+                try:
+                    flush()
+                except Exception:  # noqa: BLE001 — shutdown path
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "metrics flush failed during preemption shutdown; "
+                        "final checkpoint was already written")
+                if on_preempt is not None:
+                    on_preempt(last, runner)
+                print(
+                    f"preemption: stopped cleanly after iteration "
+                    f"{last + 1} (resume with --resume to continue)",
+                    flush=True,
+                )
+                break
             runner, metrics = update(runner)
             if observer is not None:
                 metrics = observer.observe(i0, metrics, k)
@@ -408,13 +450,43 @@ def make_periodic_checkpoint_fn(
 ) -> Callable[[int, Any], None]:
     """Standard CLI ``checkpoint_fn``: save every ``every`` iterations and
     at the end (the reference's Ray lifecycle, ``train_final.py:27-31``).
+
+    graftguard semantics (docs/robustness.md): a FAILED save is logged
+    and counted (``checkpoint_fn.failures``) but never unwinds training —
+    the data-loss bound is "everything since the last verified
+    checkpoint", and killing the run on a transient disk error would
+    forfeit the training still to come. ``checkpoint_fn.force(i, runner)``
+    saves regardless of the cadence (skipping only a step already saved)
+    — the preemption path's final checkpoint.
     """
+    import logging
+
+    log = logging.getLogger(__name__)
+    state = {"last_saved": None}
+
+    def _save(step: int, runner: Any) -> None:
+        try:
+            ckpt.save(step, tree_fn(runner), extras=extras)
+            state["last_saved"] = step
+        except Exception as e:  # noqa: BLE001 — a checkpoint write
+            # failure must not kill training (graftguard contract)
+            checkpoint_fn.failures.append((step, repr(e)))
+            log.error(
+                "checkpoint save at step %d failed (%s); training "
+                "continues — data-loss bound is the last verified "
+                "checkpoint", step, e)
 
     def checkpoint_fn(i: int, runner: Any) -> None:
         if (i + 1) % every == 0 or (i + 1) == total_iterations:
-            ckpt.save(i + 1, tree_fn(runner), extras=extras)
+            _save(i + 1, runner)
+
+    def force(i: int, runner: Any) -> None:
+        if state["last_saved"] != i + 1:
+            _save(i + 1, runner)
 
     # run_train_loop validates this against updates_per_dispatch (fused
     # dispatches only observe every k-th iteration boundary).
     checkpoint_fn.every = every
+    checkpoint_fn.force = force
+    checkpoint_fn.failures = []
     return checkpoint_fn

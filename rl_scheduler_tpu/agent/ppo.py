@@ -534,6 +534,8 @@ def ppo_train(
     eval_net: Any | None = None,
     scope: Any | None = None,
     observer: Any | None = None,
+    preemption: Any | None = None,
+    on_preempt: Callable[[int, RunnerState], None] | None = None,
 ):
     """Host-side training loop: jitted update per iteration + logging hooks.
 
@@ -600,6 +602,18 @@ def ppo_train(
     carry over; env state and rollout RNG restart from ``seed`` folded with
     the resume point, so the continued run sees fresh randomness rather
     than replaying the stream the original run already consumed.
+
+    With a ``"loop"`` key in the restored tree (graftguard full-state
+    checkpoints: env_state/obs/key/ep_return/update_idx), the ENTIRE
+    runner is restored and the RNG is NOT re-folded — the resumed run
+    replays exactly the trajectory the uninterrupted run would have
+    taken, so interrupt-and-resume is bitwise-identical to never being
+    interrupted (the deterministic-resume guarantee,
+    ``tests/test_graftguard.py``).
+
+    ``preemption``/``on_preempt``: see ``run_train_loop`` — a
+    ``PreemptionGuard`` polled at dispatch boundaries; on a stop the loop
+    flushes, force-checkpoints, fires ``on_preempt`` and returns.
     """
     bundle = env if isinstance(env, EnvBundle) else multi_cloud_bundle(env)
     if mesh is not None and scope is not None:
@@ -675,8 +689,9 @@ def ppo_train(
         init_fn, update_fn, net = make_ppo_bundle(bundle, cfg, net=net,
                                                   scope=scope)
     start_iteration = 0
+    full_state = restore is not None and "loop" in restore[0]
     key = jax.random.PRNGKey(seed)
-    if restore is not None:
+    if restore is not None and not full_state:
         key = jax.random.fold_in(key, restore[1])
     runner = init_fn(key)
     if restore is not None:
@@ -685,11 +700,26 @@ def ppo_train(
         # buffers, which would otherwise delete the caller's checkpoint
         # tree out from under it on accelerator backends.
         tree = jax.tree.map(lambda x: jnp.array(x, copy=True), tree)
-        runner = runner._replace(
-            params=tree["params"],
-            opt_state=tree["opt_state"],
-            update_idx=jnp.asarray(start_iteration, jnp.int32),
-        )
+        if full_state:
+            # Deterministic resume: every carried leaf (env state, obs,
+            # RNG key, episode returns) comes from the checkpoint, so the
+            # continuation replays the uninterrupted run's exact stream.
+            loop_state = tree["loop"]
+            runner = runner._replace(
+                params=tree["params"],
+                opt_state=tree["opt_state"],
+                env_state=loop_state["env_state"],
+                obs=loop_state["obs"],
+                key=loop_state["key"],
+                ep_return=loop_state["ep_return"],
+                update_idx=loop_state["update_idx"],
+            )
+        else:
+            runner = runner._replace(
+                params=tree["params"],
+                opt_state=tree["opt_state"],
+                update_idx=jnp.asarray(start_iteration, jnp.int32),
+            )
     from rl_scheduler_tpu.agent.loop import make_update, run_train_loop
 
     update = make_update(update_fn, debug_checks, updates_per_dispatch)
@@ -703,6 +733,7 @@ def ppo_train(
         sync_every=sync_every, log_fn=log_fn, checkpoint_fn=checkpoint_fn,
         eval_every=cfg.eval_every, eval_hook=eval_hook,
         updates_per_dispatch=updates_per_dispatch, observer=observer,
+        preemption=preemption, on_preempt=on_preempt,
     )
 
 

@@ -66,6 +66,12 @@ def main(argv: list[str] | None = None) -> Path:
                         "train_final.py:19 semantics); 0 disables")
     p.add_argument("--eval-episodes", type=int, default=None,
                    help="episodes per in-training evaluation (default 20)")
+    p.add_argument("--resume", action="store_true",
+                   help="continue from the latest VERIFIED checkpoint in "
+                        "the run dir (requires --run-name of an existing "
+                        "run). graftguard full-state checkpoints resume "
+                        "bitwise-deterministically: replay buffer, env "
+                        "state and RNG stream all carry over")
     p.add_argument("--num-envs", type=int, default=None,
                    help="override the preset's parallel env count")
     p.add_argument("--hidden", default=None,
@@ -135,12 +141,102 @@ def main(argv: list[str] | None = None) -> Path:
 
     ckpt = CheckpointManager(run_dir, keep=args.keep)
 
+    restore = None
+    if args.resume:
+        # graftguard verified selection: corrupt steps are quarantined and
+        # the resume falls back to the newest step whose manifest checks
+        # out (docs/robustness.md).
+        latest = ckpt.latest_verified_step()
+        if latest is None:
+            raise SystemExit(
+                f"--resume: no checkpoints under {run_dir} — pass "
+                "--run-name of an existing run (drop --resume to start "
+                "fresh)"
+            )
+        if latest >= args.iterations:
+            raise SystemExit(
+                f"--resume: run already has {latest} iterations; "
+                f"--iterations is a TOTAL, so pass a value > {latest}"
+            )
+        meta = ckpt.restore_meta(latest)
+        # PPO meta predates the algo key (train_ppo never writes one), so
+        # a missing key means PPO — defaulting to "dqn" would wave a PPO
+        # run dir through and fail deep inside the Orbax restore instead.
+        if meta.get("algo", "ppo") != "dqn":
+            raise SystemExit(
+                f"--resume: run was trained by algo "
+                f"{meta.get('algo', 'ppo')!r}; this is the DQN CLI "
+                "(use train_ppo for PPO runs)"
+            )
+        ckpt_env = meta.get("env")
+        if ckpt_env is not None and ckpt_env != args.env:
+            raise SystemExit(
+                f"--resume: run was trained on --env {ckpt_env}; pass "
+                f"--env {ckpt_env}"
+            )
+        ckpt_preset = meta.get("preset")
+        if ckpt_preset is not None and ckpt_preset != args.preset:
+            raise SystemExit(
+                f"--resume: run was trained with --preset {ckpt_preset}; "
+                f"resuming as {args.preset!r} would silently switch "
+                f"optimizer hyperparameters mid-run (pass --preset "
+                f"{ckpt_preset})"
+            )
+        if meta.get("hidden") is not None and \
+                tuple(meta["hidden"]) != tuple(cfg.hidden):
+            raise SystemExit(
+                f"--resume: checkpoint hidden={meta['hidden']} does not "
+                f"match configured hidden={list(cfg.hidden)} (pass --hidden "
+                f"{','.join(str(w) for w in meta['hidden'])})"
+            )
+        from rl_scheduler_tpu.agent.dqn import make_dqn
+
+        init_fn, _, _ = make_dqn(bundle, cfg)
+        abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(args.seed))
+        target = {"params": abstract.params,
+                  "target_params": abstract.target_params,
+                  "opt_state": abstract.opt_state}
+        ckpt_full = bool(meta.get("full_state"))
+        shape_keys = ("num_envs", "collect_steps", "buffer_size")
+        shape_ok = all(meta.get(k) == getattr(cfg, k) for k in shape_keys)
+        if ckpt_full:
+            target["loop"] = {
+                "buffer": abstract.buffer._asdict(),
+                "env_state": abstract.env_state,
+                "obs": abstract.obs,
+                "key": abstract.key,
+                "env_steps": abstract.env_steps,
+                "ep_return": abstract.ep_return,
+                "last_episode_return": abstract.last_episode_return,
+            }
+        tree, _ = ckpt.restore(latest, target=target)
+        if ckpt_full and not shape_ok:
+            # Orbax needs the 'loop' item in the target (the target must
+            # cover the checkpoint's structure; shapes it takes from
+            # disk), but the buffer/env arrays are shaped for the OLD
+            # knobs. Scaling a run is legitimate — drop them and resume
+            # learning state only.
+            tree.pop("loop")
+            print("note: checkpoint env/buffer shape "
+                  f"({', '.join(f'{k}={meta.get(k)}' for k in shape_keys)}) "
+                  "differs from the configured run — resuming learning "
+                  "state only (replay buffer and env/RNG stream restart "
+                  "fresh; deterministic resume needs identical shapes)")
+        restore = (tree, latest)
+        import json
+
+        metrics_file.write(json.dumps({"resumed_from_iteration": latest}) + "\n")
+        metrics_file.flush()
+        print(f"Resuming from iteration {latest} (checkpoints in {run_dir})")
+
     from rl_scheduler_tpu.agent.loop import (
         TensorBoardLogger,
         make_eval_log_fn,
         make_jsonl_log_fn,
         make_periodic_checkpoint_fn,
     )
+
+    start_iteration = restore[1] if restore is not None else 0
 
     def print_line(i: int, sps: float, metrics: dict) -> None:
         if (i + 1) % args.log_every == 0 or (i + 1) == args.iterations:
@@ -154,19 +250,37 @@ def main(argv: list[str] | None = None) -> Path:
 
     tb = TensorBoardLogger(run_dir) if args.tensorboard else None
     log_fn = make_jsonl_log_fn(metrics_file, cfg.collect_steps * cfg.num_envs,
-                               print_line=print_line, tb=tb)
+                               start_iteration, print_line=print_line, tb=tb)
     checkpoint_fn = make_periodic_checkpoint_fn(
         ckpt, args.checkpoint_every, args.iterations,
+        # graftguard full-state tree: the replay buffer, env state, and
+        # RNG stream ride along so interrupt-and-resume replays the
+        # uninterrupted run exactly (docs/robustness.md).
         lambda runner: {
             "params": runner.params,
             "target_params": runner.target_params,
             "opt_state": runner.opt_state,
+            "loop": {
+                "buffer": runner.buffer._asdict(),
+                "env_state": runner.env_state,
+                "obs": runner.obs,
+                "key": runner.key,
+                "env_steps": runner.env_steps,
+                "ep_return": runner.ep_return,
+                "last_episode_return": runner.last_episode_return,
+            },
         },
         extras={
             "algo": "dqn",
             "preset": args.preset,
             "env": args.env,
             "hidden": list(cfg.hidden),
+            "full_state": True,
+            # The 'loop' subtree's shapes are keyed on these; resume
+            # degrades to params-only when they differ.
+            "num_envs": cfg.num_envs,
+            "collect_steps": cfg.collect_steps,
+            "buffer_size": cfg.buffer_size,
         },
     )
 
@@ -191,14 +305,30 @@ def main(argv: list[str] | None = None) -> Path:
     print(f"Training DQN preset={args.preset} env={args.env} on "
           f"{jax.devices()[0].platform} "
           f"({cfg.num_envs} envs x {cfg.collect_steps} steps/iter)")
+
+    import os
+
+    from rl_scheduler_tpu.utils.preemption import guard_from_env
+
+    # SIGTERM/SIGINT -> finish the in-flight dispatch, final checkpoint +
+    # flight-recorder manifest, clean exit (same contract as train_ppo).
+    guard = guard_from_env(os.environ.get("GRAFTGUARD_PREEMPT_AFTER"))
+    on_preempt = None
+    if recorder is not None:
+        def on_preempt(iteration, _runner, _rec=recorder):
+            _rec.dump("preemption", iteration,
+                      detail=f"signal={guard.signum or 'simulated'}; final "
+                             "checkpoint written at this iteration")
     try:
-        dqn_train(bundle, cfg, args.iterations, seed=args.seed,
-                  log_fn=log_fn, checkpoint_fn=checkpoint_fn,
-                  sync_every=args.sync_every,
-                  eval_log_fn=eval_log,
-                  debug_checks=args.debug_checks,
-                  updates_per_dispatch=args.updates_per_dispatch,
-                  scope=scope, observer=observer)
+        with guard:
+            dqn_train(bundle, cfg, args.iterations, seed=args.seed,
+                      log_fn=log_fn, checkpoint_fn=checkpoint_fn,
+                      sync_every=args.sync_every,
+                      eval_log_fn=eval_log,
+                      debug_checks=args.debug_checks,
+                      updates_per_dispatch=args.updates_per_dispatch,
+                      scope=scope, observer=observer, restore=restore,
+                      preemption=guard, on_preempt=on_preempt)
     except Exception as e:
         # --debug-checks composition: preserve the steps leading up to
         # the first NaN before the checkified error unwinds.
@@ -208,7 +338,15 @@ def main(argv: list[str] | None = None) -> Path:
     metrics_file.close()
     if tb is not None:
         tb.close()
-    print(f"Training finished! Checkpoints in {run_dir}")
+    # Finalize the async save: an unfinalized final save has no integrity
+    # manifest and would restore as 'legacy'.
+    ckpt.close()
+    if guard.stopped_at is not None:
+        print(f"Preempted: clean shutdown after iteration "
+              f"{guard.stopped_at + 1}; verified checkpoints in {run_dir} "
+              "(resume with --resume)")
+    else:
+        print(f"Training finished! Checkpoints in {run_dir}")
     return run_dir
 
 

@@ -782,7 +782,11 @@ def main(argv: list[str] | None = None) -> Path:
     restore = None
     restored_seed = None
     if args.resume:
-        latest = ckpt.latest_step()
+        # Integrity-verified selection (graftguard): the newest step whose
+        # manifest checks out — corrupt/truncated steps are quarantined
+        # and the resume falls back, so a torn final write costs one
+        # checkpoint interval, not the run (docs/robustness.md).
+        latest = ckpt.latest_verified_step()
         if latest is None:
             raise SystemExit(
                 f"--resume: no checkpoints under {run_dir} — pass --run-name "
@@ -888,6 +892,9 @@ def main(argv: list[str] | None = None) -> Path:
                 f"pass the same --sp (param shapes match, but the RNG/env "
                 "replication layout does not)"
             )
+        ckpt_full = bool(meta.get("full_state"))
+        ckpt_env_shape_ok = (meta.get("num_envs") == cfg.num_envs and
+                             meta.get("rollout_steps") == cfg.rollout_steps)
         if args.tp > 1:
             from rl_scheduler_tpu.parallel.tensor_parallel import (
                 tp_abstract_state,
@@ -904,11 +911,41 @@ def main(argv: list[str] | None = None) -> Path:
                 bundle, cfg, net=eval_net if args.sp > 1 else net
             )
             abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(args.seed))
-            tree, _ = ckpt.restore(
-                latest,
-                target={"params": abstract.params,
-                        "opt_state": abstract.opt_state},
-            )
+            target = {"params": abstract.params,
+                      "opt_state": abstract.opt_state}
+            if ckpt_full:
+                # graftguard full-state checkpoint: env state, obs, RNG
+                # key, episode returns — the deterministic-resume tree
+                # (interrupt-and-resume == uninterrupted, bitwise).
+                target["loop"] = {
+                    "env_state": abstract.env_state,
+                    "obs": abstract.obs,
+                    "key": abstract.key,
+                    "ep_return": abstract.ep_return,
+                    "update_idx": abstract.update_idx,
+                }
+            tree, _ = ckpt.restore(latest, target=target)
+            if ckpt_full and not ckpt_env_shape_ok:
+                # Orbax needs the 'loop' item in the target at all (the
+                # target must cover the checkpoint's structure; shapes it
+                # takes from disk), but its arrays are shaped for the OLD
+                # env knobs. Scaling a run up/down is legitimate — drop
+                # them and resume learning state only.
+                tree.pop("loop")
+                print("note: checkpoint env shape (num_envs="
+                      f"{meta.get('num_envs')}, rollout_steps="
+                      f"{meta.get('rollout_steps')}) differs from the "
+                      "configured run — resuming learning state only "
+                      "(env/RNG stream restarts fresh; deterministic "
+                      "resume needs identical env-shape flags)")
+            elif ckpt_full and (args.dp != 1 or args.sp > 1):
+                # The sharded init paths own their env/RNG layout; carry
+                # only the learning state and let the continuation draw
+                # fresh randomness (the pre-graftguard resume semantics).
+                tree.pop("loop")
+                print("note: full-state checkpoint resumed onto a "
+                      "sharded mesh — env/RNG state restarts fresh "
+                      "(deterministic resume is single-chip only)")
         restore = (tree, latest)
         # Mark the resume point in the metrics log so post-crash duplicate
         # iteration entries are separable by downstream analysis.
@@ -966,7 +1003,27 @@ def main(argv: list[str] | None = None) -> Path:
                 # changes the training-time replication layout
                 "tp": args.tp,
                 "sp": args.sp,
+                # graftguard: single-chip runs checkpoint the FULL runner
+                # (env state, obs, RNG key, episode returns) so a
+                # preempted run resumes bitwise-deterministically; the
+                # sharded paths keep the learning-state-only tree (their
+                # init owns the env/RNG layout).
+                "full_state": args.dp == 1 and args.sp == 1 and args.tp == 1,
+                # The 'loop' subtree's shapes are keyed on these; resume
+                # degrades to params-only when they differ.
+                "num_envs": cfg.num_envs,
+                "rollout_steps": cfg.rollout_steps,
                 "legacy_reward_sign": args.legacy_reward_sign}
+
+    def checkpoint_tree_fn(runner):
+        tree = {"params": runner.params, "opt_state": runner.opt_state}
+        if checkpoint_extras["full_state"]:
+            tree["loop"] = {"env_state": runner.env_state,
+                            "obs": runner.obs,
+                            "key": runner.key,
+                            "ep_return": runner.ep_return,
+                            "update_idx": runner.update_idx}
+        return tree
 
     def make_checkpoint_fn(attempt_seed: int):
         # The seed lands in checkpoint meta so reproductions (and the
@@ -980,8 +1037,7 @@ def main(argv: list[str] | None = None) -> Path:
                             else restored_seed)
         return make_periodic_checkpoint_fn(
             ckpt, args.checkpoint_every, args.iterations,
-            lambda runner: {"params": runner.params,
-                            "opt_state": runner.opt_state},
+            checkpoint_tree_fn,
             extras={**checkpoint_extras, "seed": attempt_seed},
         )
 
@@ -1043,7 +1099,22 @@ def main(argv: list[str] | None = None) -> Path:
         import contextlib
 
         ctx = contextlib.nullcontext()
-    with ctx:
+
+    import os
+
+    from rl_scheduler_tpu.utils.preemption import guard_from_env
+
+    # SIGTERM/SIGINT -> finish the in-flight dispatch, final checkpoint +
+    # flight-recorder manifest, clean exit; GRAFTGUARD_PREEMPT_AFTER=<n>
+    # arms the chaos harness's deterministic stand-in (docs/robustness.md).
+    guard = guard_from_env(os.environ.get("GRAFTGUARD_PREEMPT_AFTER"))
+    on_preempt = None
+    if recorder is not None:
+        def on_preempt(iteration, _runner, _rec=recorder):
+            _rec.dump("preemption", iteration,
+                      detail=f"signal={guard.signum or 'simulated'}; final "
+                             "checkpoint written at this iteration")
+    with guard, ctx:
         attempt = 0
         while True:
             attempt_seed = args.seed + attempt
@@ -1077,7 +1148,8 @@ def main(argv: list[str] | None = None) -> Path:
                           sync_every=args.sync_every, eval_log_fn=eval_log,
                           updates_per_dispatch=args.updates_per_dispatch,
                           mesh=mesh, eval_net=eval_net,
-                          scope=scope, observer=observer)
+                          scope=scope, observer=observer,
+                          preemption=guard, on_preempt=on_preempt)
                 break
             except EvalStall as stall:
                 attempt += 1
@@ -1129,7 +1201,15 @@ def main(argv: list[str] | None = None) -> Path:
     metrics_file.close()
     if tb is not None:
         tb.close()
-    print(f"Training finished! Checkpoints in {run_dir}")
+    # Finalize the async save (graftguard: an unfinalized final save has
+    # no integrity manifest and would restore as 'legacy').
+    ckpt.close()
+    if guard.stopped_at is not None:
+        print(f"Preempted: clean shutdown after iteration "
+              f"{guard.stopped_at + 1}; verified checkpoints in {run_dir} "
+              "(resume with --resume)")
+    else:
+        print(f"Training finished! Checkpoints in {run_dir}")
     return run_dir
 
 

@@ -51,6 +51,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from rl_scheduler_tpu.scheduler.policy_backend import make_backend
+from rl_scheduler_tpu.utils.retry import CircuitOpenError
 from rl_scheduler_tpu.scheduler.telemetry import (
     PrometheusCpu,
     RandomCpu,
@@ -327,7 +328,19 @@ class ExtenderPolicy:
             )
         # Optional DryRunPodPlacer (slow-mode parity), wrapped so kube API
         # stalls can neither block responses nor exhaust threads.
+        self._placer_impl = placer
         self.placer = AsyncPlacer(placer) if placer is not None else None
+        from rl_scheduler_tpu.utils.retry import CircuitBreaker
+
+        # graftguard: repeated backend failures trip this breaker and the
+        # decision paths degrade to their documented fail-open answers
+        # WITHOUT invoking the backend — a poisoned checkpoint cannot tax
+        # every scheduling request with a raise/catch round trip. State is
+        # exported on /stats and /metrics with the telemetry and kube
+        # breakers (docs/robustness.md).
+        self.backend_breaker = CircuitBreaker(
+            name="backend", failure_threshold=5, reset_timeout_s=10.0,
+        )
         self.stats = LatencyStats()
         # Structured-family decisions can land on an unknown-cloud node
         # (scored from neutral features); give those their own bucket.
@@ -335,11 +348,18 @@ class ExtenderPolicy:
         self._decisions = {c: 0 for c in keys}
         self._lock = threading.Lock()
 
+    def _backend_call(self, fn, *args):
+        """Run one backend decision through the circuit breaker: an open
+        breaker refuses WITHOUT calling the backend (CircuitOpenError —
+        absorbed by the same fail-open handlers that catch backend
+        raises), successes/failures drive its state."""
+        return self.backend_breaker.call(fn, *args)
+
     def decide(self) -> tuple[int, np.ndarray, np.ndarray]:
         """One placement decision: ``(action, probs, obs)``; timed."""
         t0 = time.perf_counter()
         obs = self.telemetry.observe()
-        action, logits = self.backend.decide(obs)
+        action, logits = self._backend_call(self.backend.decide, obs)
         self.stats.record(time.perf_counter() - t0)
         z = logits - logits.max()
         probs = np.exp(z) / np.exp(z).sum()
@@ -352,7 +372,7 @@ class ExtenderPolicy:
         like :meth:`decide`. ``clouds`` has one aws/azure/None per node."""
         t0 = time.perf_counter()
         obs = self.telemetry.observe_nodes(clouds, pod_cpu)
-        action, logits = self.backend.decide_nodes(obs)
+        action, logits = self._backend_call(self.backend.decide_nodes, obs)
         self.stats.record(time.perf_counter() - t0)
         z = logits - logits.max()
         probs = np.exp(z) / np.exp(z).sum()
@@ -384,7 +404,7 @@ class ExtenderPolicy:
             affinity = display.index(aff_name)
         obs = build_graph_obs(clouds, price_row, cpus, hops, adj,
                               affinity, pod_cpu, step_frac)
-        action, logits = self.backend.decide_nodes(obs, adj)
+        action, logits = self._backend_call(self.backend.decide_nodes, obs, adj)
         self.stats.record(time.perf_counter() - t0)
         z = logits - logits.max()
         probs = np.exp(z) / np.exp(z).sum()
@@ -459,6 +479,12 @@ class ExtenderPolicy:
             return self._passthrough(args)
         try:
             action, _ = self._structured_decide(args, display, clouds)
+        except CircuitOpenError:
+            # Expected for the whole open window — the breaker logged its
+            # trip; a traceback per refused request would flood the hot
+            # serving path.
+            logger.debug("backend breaker open; passing all nodes")
+            return self._passthrough(args)
         except Exception:  # never wedge scheduling: pass all nodes through.
             logger.exception("%s policy decision failed; passing all nodes",
                              self.family)
@@ -484,6 +510,9 @@ class ExtenderPolicy:
         try:
             _, probs = self._structured_decide(args, display, clouds)
             scores = np.round(probs / probs.max() * MAX_EXTENDER_SCORE)
+        except CircuitOpenError:
+            logger.debug("backend breaker open; uniform priorities")
+            scores = np.full(len(sources), MAX_EXTENDER_SCORE // 2)
         except Exception:
             logger.exception("%s policy decision failed; uniform priorities",
                              self.family)
@@ -503,6 +532,9 @@ class ExtenderPolicy:
             return self._passthrough(args)
         try:
             action, _, _ = self.decide()
+        except CircuitOpenError:
+            logger.debug("backend breaker open; passing all nodes")
+            return self._passthrough(args)
         except Exception:  # never wedge scheduling: pass all nodes through.
             # error stays "" — kube-scheduler treats a non-empty Error as a
             # hard extender failure unless ignorable=true is configured.
@@ -529,6 +561,9 @@ class ExtenderPolicy:
         _, _, display, clouds = self._request_nodes(args)
         try:
             _, probs, _ = self.decide()
+        except CircuitOpenError:
+            logger.debug("backend breaker open; uniform priorities")
+            probs = np.full(len(CLOUDS), 1.0 / len(CLOUDS))
         except Exception:
             logger.exception("policy decision failed; uniform priorities")
             probs = np.full(len(CLOUDS), 1.0 / len(CLOUDS))
@@ -559,6 +594,20 @@ class ExtenderPolicy:
         percentiles were contaminated by the preceding run's traffic."""
         self.stats.reset()
         return {"status": "reset"}
+
+    def breakers(self) -> dict:
+        """Name -> snapshot of every circuit breaker on this serving
+        stack's host-I/O boundaries: the backend decision path, the
+        Prometheus telemetry source (when configured), and the kube pod
+        placer (when configured)."""
+        out = {self.backend_breaker.name: self.backend_breaker.snapshot()}
+        for cpu_breaker in getattr(self.telemetry.cpu, "breakers",
+                                   {}).values():
+            out[cpu_breaker.name] = cpu_breaker.snapshot()
+        for placer_breaker in getattr(self._placer_impl, "breakers",
+                                      {}).values():
+            out[placer_breaker.name] = placer_breaker.snapshot()
+        return out
 
     def health(self) -> dict:
         return {"status": "ok", "backend": self.backend.name,
@@ -592,6 +641,9 @@ class ExtenderPolicy:
             out["reroute_fraction"] = round(float(reroute), 4)
         if self.placer is not None:
             out["placements_dropped"] = self.placer.dropped
+        # graftguard breaker states: "is a dependency down" is a /stats
+        # read, not a log dive (docs/robustness.md).
+        out["breakers"] = self.breakers()
         return out
 
     def metrics_text(self) -> str:
@@ -648,6 +700,26 @@ class ExtenderPolicy:
                 f"# TYPE {p}_placements_dropped_total counter",
                 f"{p}_placements_dropped_total {self.placer.dropped}",
             ]
+        from rl_scheduler_tpu.utils.retry import CircuitBreaker
+
+        snapshots = self.breakers()
+        lines += [
+            f"# HELP {p}_circuit_state Circuit breaker state per host-I/O "
+            "boundary (0=closed, 1=half_open, 2=open).",
+            f"# TYPE {p}_circuit_state gauge",
+        ]
+        for name, snap in sorted(snapshots.items()):
+            code = CircuitBreaker.STATE_CODES[snap["state"]]
+            lines.append(f'{p}_circuit_state{{breaker="{name}"}} {code}')
+        lines += [
+            f"# HELP {p}_circuit_opens_total Times each breaker tripped "
+            "open (lifetime).",
+            f"# TYPE {p}_circuit_opens_total counter",
+        ]
+        for name, snap in sorted(snapshots.items()):
+            lines.append(
+                f'{p}_circuit_opens_total{{breaker="{name}"}} '
+                f'{snap["opens_total"]}')
         lines += [
             f"# HELP {p}_info Serving backend and decision family.",
             f"# TYPE {p}_info gauge",

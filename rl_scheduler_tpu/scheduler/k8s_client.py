@@ -27,19 +27,55 @@ CLOUD_CONTEXTS = {
 
 
 class DryRunPodPlacer:
-    """Dry-run pod creation against per-cloud kind clusters."""
+    """Dry-run pod creation against per-cloud kind clusters.
+
+    graftguard (docs/robustness.md): kube API calls run under the unified
+    ``utils/retry.py`` policy — bounded retries with backoff for the
+    transient 5xx an apiserver throws under pressure, behind a circuit
+    breaker PER cloud so a down cluster is probed at recovery cadence
+    instead of per decision — without its failure streak being reset by
+    the healthy cloud, and without refusing the healthy cloud when open.
+    Breaker state rides the extender's ``/stats`` and ``/metrics``
+    (``breakers["k8s_aws"]``/``["k8s_azure"]``). ``fault_plan`` is the
+    chaos seam (site ``k8s.place``).
+    """
 
     def __init__(
         self,
         namespace: str = "default",
         image: str = "nginx:alpine",
         request_timeout: float = 10.0,
+        retry=None,
+        breakers=None,
+        fault_plan=None,
     ):
+        from rl_scheduler_tpu.utils.retry import CircuitBreaker, RetryPolicy
+
         self.namespace = namespace
         self.image = image
         # Bounded (connect, read) timeout: without it one stalled kube API
         # connection wedges AsyncPlacer's single drain thread forever.
         self.request_timeout = request_timeout
+        self.fault_plan = fault_plan
+        # Deadline = one request_timeout: retries are for FAST transient
+        # 5xx, and the deadline gates whether another attempt may START —
+        # so a timeout-dominated failure (connect 5 s + read
+        # request_timeout) never re-runs, keeping the worst case one
+        # stalled connection can hold AsyncPlacer's single drain thread
+        # at ~one attempt, not attempts x timeout.
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_attempts=3, base_delay_s=0.1, max_delay_s=1.0,
+            deadline_s=request_timeout, seed=0,
+        )
+        # One breaker PER cloud (mirrors telemetry's per-endpoint split):
+        # a dead aws cluster must not have its failure streak reset by
+        # healthy azure placements, nor an open aws breaker refuse azure.
+        self.breakers = {
+            cloud: CircuitBreaker(name=f"k8s_{cloud}",
+                                  failure_threshold=5, reset_timeout_s=30.0)
+            for cloud in CLOUD_CONTEXTS
+        }
+        self.breakers.update(breakers or {})
         self._clients: dict[str, object] = {}
         self._warned: set[str] = set()
         self._load_clients()
@@ -63,12 +99,12 @@ class DryRunPodPlacer:
         if missing:
             logger.warning("no kube context found for clouds: %s", sorted(missing))
 
-    def place(self, cloud: str, dry_run: bool = True) -> bool:
-        """Dry-run create an nginx pod on the chosen cloud. Returns success."""
-        v1 = self._clients.get(cloud)
-        if v1 is None:
-            self._warn_once(f"no-client-{cloud}", f"no kube client for cloud {cloud}")
-            return False
+    def _create_pod(self, v1, cloud: str, dry_run: bool) -> None:
+        """One kube API attempt (the unit the retry policy re-runs)."""
+        if self.fault_plan is not None:
+            # Simulated apiserver 5xx — the transient family the retry
+            # policy exists for.
+            self.fault_plan.check("k8s.place", ConnectionError)
         from kubernetes import client
 
         pod = client.V1Pod(
@@ -77,15 +113,40 @@ class DryRunPodPlacer:
                 containers=[client.V1Container(name="nginx", image=self.image)]
             ),
         )
+        v1.create_namespaced_pod(
+            namespace=self.namespace,
+            body=pod,
+            dry_run="All" if dry_run else None,
+            _request_timeout=(5.0, self.request_timeout),
+        )
+
+    def place(self, cloud: str, dry_run: bool = True) -> bool:
+        """Dry-run create an nginx pod on the chosen cloud. Returns success."""
+        v1 = self._clients.get(cloud)
+        if v1 is None:
+            # Unconditional: with a fault plan armed but no client, the
+            # non-firing calls would reach create_namespaced_pod on None
+            # and trip the breaker on harness artifacts, not faults.
+            self._warn_once(f"no-client-{cloud}", f"no kube client for cloud {cloud}")
+            return False
+        breaker = self.breakers[cloud]
+        if not breaker.allow():
+            # Keyed on opens_total: one warning per OPEN WINDOW, not per
+            # process lifetime — a breaker that re-trips hours later must
+            # not drop placements invisibly (the GL010 principle).
+            self._warn_once(
+                f"breaker-{cloud}-{breaker.snapshot()['opens_total']}",
+                f"kube breaker {breaker.name} open; dropping placements "
+                f"until a recovery probe succeeds (state exported on "
+                f"/stats and /metrics)")
+            return False
         try:
-            v1.create_namespaced_pod(
-                namespace=self.namespace,
-                body=pod,
-                dry_run="All" if dry_run else None,
-                _request_timeout=(5.0, self.request_timeout),
-            )
+            self.retry.call(self._create_pod, v1, cloud, dry_run)
+            breaker.record_success()
             return True
+        # graftlint: disable=GL010 -- logs through the rate-limited _warn_once helper (one logger.warning per failure kind); the rule's AST walk cannot see one level of indirection
         except Exception as e:  # noqa: BLE001 - surface, don't crash the env loop
+            breaker.record_failure()
             self._warn_once(f"place-{cloud}", f"pod placement on {cloud} failed: {e}")
             return False
 

@@ -55,15 +55,42 @@ class PrometheusCpu:
     refresh lands (or when Prometheus is down) it serves the random
     fallback, so the extender's <1 ms p50 holds regardless of Prometheus
     health.
+
+    graftguard (docs/robustness.md): scrapes run under the unified
+    ``utils/retry.py`` policy — one bounded retry with backoff inside
+    each refresh, behind one circuit breaker PER endpoint (a dead aws
+    Prometheus must not have its failure streak reset by a healthy
+    azure, nor an open aws breaker refuse azure scrapes), so a dead
+    endpoint is probed at the breaker's recovery cadence instead of
+    every ttl expiry. Breaker state rides the extender's
+    ``/stats``/``/metrics`` (``breakers["prometheus_aws"]``/
+    ``["prometheus_azure"]``). ``fault_plan`` is the chaos seam (site
+    ``telemetry.scrape``).
     """
 
     QUERY = '1 - avg(rate(node_cpu_seconds_total{mode="idle"}[1m]))'
 
     def __init__(self, urls: dict | None = None, timeout_s: float = 0.2,
-                 ttl_s: float = 1.0):
+                 ttl_s: float = 1.0, retry=None, breakers=None,
+                 fault_plan=None):
+        from rl_scheduler_tpu.utils.retry import CircuitBreaker, RetryPolicy
+
         self.urls = dict(urls or PROMETHEUS_URLS)
         self.timeout_s = timeout_s
         self.ttl_s = ttl_s
+        self.fault_plan = fault_plan
+        # Deadline caps the retried scrape well under a ttl so a slow
+        # Prometheus cannot make refreshes pile up.
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_attempts=2, base_delay_s=0.05, max_delay_s=0.2,
+            deadline_s=max(2 * timeout_s, 0.5), seed=0,
+        )
+        self.breakers = {
+            cloud: CircuitBreaker(name=f"prometheus_{cloud}",
+                                  failure_threshold=3, reset_timeout_s=15.0)
+            for cloud in ("aws", "azure")
+        }
+        self.breakers.update(breakers or {})
         self._fallback = RandomCpu()
         self._cached: tuple[float, float] | None = None
         self._cached_at = 0.0
@@ -75,6 +102,10 @@ class PrometheusCpu:
         import urllib.parse
         import urllib.request
 
+        if self.fault_plan is not None:
+            # Simulated scrape timeout — the exact exception family a
+            # stalled socket raises through urlopen.
+            self.fault_plan.check("telemetry.scrape", TimeoutError)
         url = (
             f"{base_url}/api/v1/query?"
             + urllib.parse.urlencode({"query": self.QUERY})
@@ -87,10 +118,21 @@ class PrometheusCpu:
         try:
             out = []
             for cloud in ("aws", "azure"):
+                breaker = self.breakers[cloud]
+                if not breaker.allow():
+                    # Open breaker: skip the HTTP attempt entirely and
+                    # serve the fallback until a half-open probe heals it.
+                    out.append(self._fallback.sample()[0])
+                    continue
                 try:
-                    out.append(self._query_one(self.urls[cloud]))
+                    out.append(self.retry.call(self._query_one,
+                                               self.urls[cloud]))
+                    breaker.record_success()
                 except Exception:
-                    logger.debug("prometheus query failed for %s; using random", cloud)
+                    breaker.record_failure()
+                    logger.warning(
+                        "prometheus query failed for %s (breaker %s); "
+                        "using random fallback", cloud, breaker.state)
                     out.append(self._fallback.sample()[0])
             with self._lock:
                 self._cached = tuple(out)

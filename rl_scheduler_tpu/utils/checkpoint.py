@@ -1,33 +1,157 @@
-"""Orbax checkpointing with the reference's lifecycle semantics.
+"""Orbax checkpointing, hardened (graftguard part 1).
 
 Capability parity with the reference's Ray-delegated checkpointing
-(SURVEY.md §5.4): periodic save, keep-N, save-at-end (the caller's loop
-decides when), latest-checkpoint auto-discovery across runs
-(``final_evaluation.py:13-27`` does this with ``rglob`` + max numeric
-suffix), and a ``from_checkpoint``-style restore shared by evaluation and
-the scheduler-extender server.
+(SURVEY.md §5.4) — periodic save, keep-N, save-at-end, latest-run
+auto-discovery, shared restore — plus the production failure modes the
+reference never met (docs/robustness.md):
+
+- **Async saves.** ``save`` dispatches the Orbax write and returns; the
+  training step never blocks on storage. The PREVIOUS save is finalized
+  (waited on + manifest written) at the next ``save``/``restore``/
+  ``close`` — by then it has had a whole checkpoint interval to land, so
+  the wait is ~0 in the steady state.
+- **Integrity manifests.** Every finalized step gets a sidecar manifest
+  (``checkpoint_manifests/<step>.json``): a tree-structure hash (leaf
+  shapes/dtypes, container-agnostic) captured at save time plus sha256 +
+  size of every file Orbax wrote. Restore verifies the files BEFORE
+  deserializing and the tree hash after.
+- **Quarantine + fallback.** A step that fails verification (truncated
+  file, digest mismatch, missing file, restore exception) is moved to
+  ``quarantine/`` — never deleted: it is evidence — and restore falls
+  back to the newest step that DOES verify. A preempted VM that died
+  mid-write costs one checkpoint interval, not the run.
+
+Pre-graftguard checkpoints have no manifest; they restore with a logged
+warning (legacy acceptance) so old runs stay loadable.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
 import json
+import logging
+import shutil
+import threading
+import time
 from pathlib import Path
 from typing import Any
 
 import orbax.checkpoint as ocp
 
+logger = logging.getLogger(__name__)
+
+MANIFEST_DIR = "checkpoint_manifests"
+QUARANTINE_DIR = "quarantine"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """An explicitly-requested step failed integrity verification (the
+    auto-selection path falls back instead of raising this)."""
+
+
+def tree_structure_hash(tree: Any) -> str:
+    """Container-agnostic structure hash: sorted leaf ``shape:dtype``
+    descriptors plus the leaf count.
+
+    Deliberately ignores container TYPES (dict vs namedtuple vs list):
+    Orbax restores without a target as nested dicts/lists while the
+    save-time tree holds optax namedtuples, and both must hash equal —
+    the integrity signal is "same tensors", byte integrity itself is the
+    file digests' job.
+    """
+    import jax
+    import numpy as np
+
+    descs = []
+    for leaf in jax.tree_util.tree_leaves(tree):
+        # Read shape/dtype off the leaf's metadata: np.asarray on a
+        # device array would pull the whole tree host-side (for DQN,
+        # replay buffer included) inside save(), defeating the async
+        # path. Only scalar Python leaves need materializing.
+        shape, dtype = getattr(leaf, "shape", None), getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            arr = np.asarray(leaf)
+            shape, dtype = arr.shape, arr.dtype
+        descs.append(f"{tuple(shape)}:{dtype}")
+    descs.sort()
+    payload = ";".join(descs) + f";n={len(descs)}"
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def _digest_dir(step_dir: Path) -> dict:
+    """``{relpath: {"sha256", "size"}}`` over every file under a step."""
+    out = {}
+    for p in sorted(step_dir.rglob("*")):
+        if not p.is_file():
+            continue
+        h = hashlib.sha256()
+        with p.open("rb") as fh:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                h.update(chunk)
+        out[p.relative_to(step_dir).as_posix()] = {
+            "sha256": h.hexdigest(), "size": p.stat().st_size,
+        }
+    return out
+
+
+@dataclasses.dataclass
+class _PendingSave:
+    """A dispatched-but-not-finalized async save awaiting its manifest."""
+
+    step: int
+    tree_hash: str
+    extras_keys: list
+
 
 class CheckpointManager:
     """Thin wrapper over ``ocp.CheckpointManager`` for one training run."""
 
-    def __init__(self, run_dir: str | Path, keep: int = 5):
+    def __init__(self, run_dir: str | Path, keep: int = 5,
+                 async_save: bool = True, fault_plan: Any | None = None):
         self.run_dir = Path(run_dir)
-        options = ocp.CheckpointManagerOptions(max_to_keep=keep, create=True)
+        self.fault_plan = fault_plan
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=keep, create=True,
+            enable_async_checkpointing=async_save,
+        )
         self._mgr = ocp.CheckpointManager(
             (self.run_dir / "checkpoints").absolute(), options=options
         )
+        self._pending: _PendingSave | None = None
+        self._digest_thread: threading.Thread | None = None
+        # Steps whose manifest already verified this process: resume paths
+        # call latest_verified_step() then restore(step), and re-hashing
+        # GBs of unchanged Orbax files on the second pass buys nothing.
+        self._verified: set = set()
 
-    def save(self, step: int, tree: Any, extras: dict | None = None) -> None:
+    # ------------------------------------------------------------- paths
+
+    def _step_dir(self, step: int) -> Path:
+        return self.run_dir / "checkpoints" / str(step)
+
+    def _manifest_path(self, step: int) -> Path:
+        return self.run_dir / MANIFEST_DIR / f"{step}.json"
+
+    # -------------------------------------------------------------- save
+
+    def save(self, step: int, tree: Any, extras: dict | None = None,
+             wait: bool = False) -> None:
+        """Dispatch an async save of ``(tree, extras)`` at ``step``.
+
+        Finalizes the previous pending save first (waits for it — ~0 in
+        the steady state — then hands its integrity manifest to a
+        background digest thread), so at most one save is ever in flight.
+        ``wait=True`` additionally finalizes THIS step — manifest on disk
+        included — before returning (save-at-end semantics).
+        """
+        self._finalize_pending(wait_digest=False)
+        if self.fault_plan is not None:
+            # Simulated write failure (disk full / volume detached):
+            # raised before the Orbax save dispatches, so the failed step
+            # leaves nothing behind. Callers that must survive this wrap
+            # save in try/except (make_periodic_checkpoint_fn does).
+            self.fault_plan.check("checkpoint.save", OSError)
         self._mgr.save(
             step,
             args=ocp.args.Composite(
@@ -35,15 +159,168 @@ class CheckpointManager:
                 meta=ocp.args.JsonSave(extras or {}),
             ),
         )
+        self._pending = _PendingSave(
+            step=step,
+            tree_hash=tree_structure_hash(tree),
+            extras_keys=sorted(extras or {}),
+        )
+        if self.fault_plan is not None and self.fault_plan.fires(
+                "checkpoint.partial"):
+            # Torn write: the manifest is written from the intact files,
+            # THEN a file is truncated — the artifact of a VM preempted
+            # between the manifest fsync and the data fsync. Restore-time
+            # verification must quarantine this step and fall back.
+            from rl_scheduler_tpu.utils.faults import corrupt_checkpoint_step
+
+            self._finalize_pending()
+            corrupt_checkpoint_step(self._step_dir(step))
+            return
+        if wait:
+            self._finalize_pending()
+
+    def _finalize_pending(self, wait_digest: bool = True) -> None:
+        """Wait for the in-flight save (if any) and hand its manifest
+        digest to a background thread; prune manifests of steps Orbax's
+        keep-N GC has deleted. With ``wait_digest`` (every caller except
+        ``save``) the manifest is on disk before returning — readers
+        treat a manifest-less step as unfinalized."""
         self._mgr.wait_until_finished()
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            if self._digest_thread is not None:
+                self._digest_thread.join()
+            # sha256 over the step's files OFF the training thread: a DQN
+            # full-state step includes the replay buffer (GBs at
+            # production size), and hashing it synchronously at the next
+            # save() would re-insert the storage stall async saves exist
+            # to remove.
+            t = threading.Thread(target=self._write_manifest,
+                                 args=(pending,), daemon=True)
+            t.start()
+            self._digest_thread = t
+        if wait_digest and self._digest_thread is not None:
+            self._digest_thread.join()
+            self._digest_thread = None
+        self._prune_manifests()
+
+    def _write_manifest(self, pending: _PendingSave) -> None:
+        try:
+            step_dir = self._step_dir(pending.step)
+            manifest = {
+                "step": pending.step,
+                "tree_hash": pending.tree_hash,
+                "extras_keys": pending.extras_keys,
+                "files": _digest_dir(step_dir),
+                "created_at": time.time(),
+            }
+            mpath = self._manifest_path(pending.step)
+            mpath.parent.mkdir(parents=True, exist_ok=True)
+            tmp = mpath.with_suffix(".json.tmp")
+            tmp.write_text(json.dumps(manifest, indent=1))
+            tmp.replace(mpath)  # atomic: a manifest is whole or absent
+        except Exception:  # noqa: BLE001 — a failed manifest leaves the
+            # step restorable as unfinalized/legacy; never kill training
+            logger.exception(
+                "manifest write for checkpoint step %d failed; the step "
+                "will restore unverified", pending.step)
+
+    def _prune_manifests(self) -> None:
+        mdir = self.run_dir / MANIFEST_DIR
+        if not mdir.is_dir():
+            return
+        live = {str(s) for s in self._mgr.all_steps()}
+        for p in mdir.glob("*.json"):
+            if p.stem not in live:
+                p.unlink(missing_ok=True)
+
+    # ------------------------------------------------------ verification
+
+    def verify_step(self, step: int) -> tuple[bool, str]:
+        """``(ok, reason)`` for one step's on-disk integrity.
+
+        ``ok`` with reason ``"legacy"`` means no manifest exists (pre-
+        graftguard checkpoint): accepted, but the caller may want to log.
+        """
+        self._finalize_pending()
+        if step in self._verified:
+            return True, "verified"
+        mpath = self._manifest_path(step)
+        if not mpath.exists():
+            return True, "legacy"
+        try:
+            manifest = json.loads(mpath.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            return False, f"unreadable manifest: {e}"
+        step_dir = self._step_dir(step)
+        on_disk = _digest_dir(step_dir) if step_dir.is_dir() else {}
+        want = manifest.get("files", {})
+        missing = sorted(set(want) - set(on_disk))
+        if missing:
+            return False, f"missing file(s): {', '.join(missing[:3])}"
+        for rel, meta in want.items():
+            got = on_disk[rel]
+            if got["size"] != meta["size"]:
+                return False, (f"{rel}: size {got['size']} != manifest "
+                               f"{meta['size']} (truncated write)")
+            if got["sha256"] != meta["sha256"]:
+                return False, f"{rel}: sha256 mismatch (corrupt write)"
+        self._verified.add(step)
+        return True, "verified"
+
+    def quarantine(self, step: int, reason: str) -> Path:
+        """Move a failed step (and its manifest) to ``quarantine/`` —
+        preserved as evidence, out of the restore path."""
+        self._verified.discard(step)
+        qdir = self.run_dir / QUARANTINE_DIR
+        qdir.mkdir(parents=True, exist_ok=True)
+        dest = qdir / str(step)
+        n = 0
+        while dest.exists():
+            n += 1
+            dest = qdir / f"{step}.{n}"
+        step_dir = self._step_dir(step)
+        if step_dir.exists():
+            shutil.move(str(step_dir), str(dest))
+        mpath = self._manifest_path(step)
+        if mpath.exists():
+            shutil.move(str(mpath), str(dest) + ".manifest.json")
+        logger.warning(
+            "checkpoint step %d failed verification (%s); quarantined to %s",
+            step, reason, dest)
+        # Orbax caches its step list; make it re-read the directory so the
+        # quarantined step stops being offered as latest.
+        self._mgr.reload()
+        return dest
+
+    def latest_verified_step(self, exclude: frozenset | set = frozenset()) -> int | None:
+        """Newest step that passes verification; corrupt steps met along
+        the way are quarantined. ``None`` when nothing verifies.
+        ``exclude`` skips steps the caller already tried (restore's
+        fallback past unfinalized saves)."""
+        self._finalize_pending()
+        for step in sorted(self._mgr.all_steps(), reverse=True):
+            if step in exclude:
+                continue
+            ok, reason = self.verify_step(step)
+            if ok:
+                if reason == "legacy":
+                    logger.warning(
+                        "checkpoint step %d has no integrity manifest "
+                        "(pre-graftguard run); restoring unverified", step)
+                return step
+            self.quarantine(step, reason)
+        return None
+
+    # ----------------------------------------------------------- restore
 
     def latest_step(self) -> int | None:
+        self._finalize_pending()
         return self._mgr.latest_step()
 
     def restore_meta(self, step: int | None = None) -> dict:
         """Restore only the extras dict (cheap; no state tree involved)."""
         if step is None:
-            step = self.latest_step()
+            step = self.latest_verified_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.run_dir}")
         out = self._mgr.restore(
@@ -52,30 +329,116 @@ class CheckpointManager:
         return dict(out["meta"] or {})
 
     def restore(self, step: int | None = None, target: Any | None = None):
-        """Restore ``(tree, extras)``. With ``target`` given, the tree is
-        restored with the target's exact pytree structure (needed for
-        opt_state); otherwise as nested dicts/lists (fine for params)."""
-        if step is None:
-            step = self.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {self.run_dir}")
+        """Restore ``(tree, extras)`` from a VERIFIED step.
+
+        ``step=None`` auto-selects: newest step whose manifest verifies,
+        quarantining corrupt ones and falling back — so a torn final
+        write costs one interval, not the run. An EXPLICIT corrupt step
+        quarantines and raises :class:`CheckpointCorrupt` instead (the
+        caller named it; silently restoring something else would lie).
+        With ``target`` given, the tree is restored with the target's
+        exact pytree structure (needed for opt_state); otherwise as
+        nested dicts/lists (fine for params).
+        """
+        explicit = step is not None
+        skipped: set = set()
+        while True:
+            if step is None:
+                step = self.latest_verified_step(exclude=skipped)
+                if step is None:
+                    raise FileNotFoundError(
+                        f"no verified checkpoints under {self.run_dir}")
+            else:
+                ok, reason = self.verify_step(step)
+                if not ok:
+                    self.quarantine(step, reason)
+                    if explicit:
+                        raise CheckpointCorrupt(
+                            f"checkpoint step {step} under {self.run_dir} "
+                            f"failed verification ({reason}); quarantined. "
+                            "Pass step=None to fall back to the newest "
+                            "verified step.")
+                    step = None
+                    continue
+            try:
+                return self._restore_verified(step, target)
+            except (CheckpointCorrupt, FileNotFoundError):
+                raise
+            except Exception as e:  # noqa: BLE001 — see below: corrupt
+                # step vs caller error, decided by the manifest
+                if self._manifest_path(step).exists():
+                    # The digests just verified these bytes, so a restore
+                    # failure here means the TARGET is wrong (wrong net/
+                    # algo/config — including the tree-hash mismatch),
+                    # not the disk. Quarantining would relocate healthy
+                    # checkpoints — in auto mode, the entire run, one
+                    # fallback step at a time.
+                    raise
+                if (self.run_dir / MANIFEST_DIR).is_dir():
+                    # No manifest for this step but the run HAS a manifest
+                    # dir: a graftguard-era run, so this is almost
+                    # certainly a not-yet-finalized async save by a live
+                    # trainer. Quarantining would move the directory out
+                    # from under the in-flight Orbax write — leave it in
+                    # place and fall back to an older step.
+                    logger.warning(
+                        "checkpoint step %d has no manifest and failed to "
+                        "restore (%s); treating as an unfinalized save — "
+                        "left in place, falling back", step, e)
+                    if explicit:
+                        raise
+                    skipped.add(step)
+                    step = None
+                    continue
+                # Legacy step (no manifest, pre-graftguard run): nothing
+                # vouched for the bytes, so a deserialization failure is
+                # treated as corruption — same quarantine-or-raise as
+                # verify_step.
+                self.quarantine(step, f"restore failed: {e}")
+                if explicit:
+                    raise CheckpointCorrupt(
+                        f"checkpoint step {step} under {self.run_dir} "
+                        f"failed to restore ({e}); quarantined."
+                    ) from e
+                step = None
+
+    def _restore_verified(self, step: int, target: Any | None):
         state_args = (
             ocp.args.StandardRestore(target) if target is not None else ocp.args.StandardRestore()
         )
         out = self._mgr.restore(
             step, args=ocp.args.Composite(state=state_args, meta=ocp.args.JsonRestore())
         )
-        return out["state"], dict(out["meta"] or {})
+        tree, extras = out["state"], dict(out["meta"] or {})
+        mpath = self._manifest_path(step)
+        if mpath.exists():
+            want = json.loads(mpath.read_text()).get("tree_hash")
+            got = tree_structure_hash(tree)
+            if want is not None and got != want:
+                raise ValueError(
+                    f"restored tree structure hash {got[:12]} != manifest "
+                    f"{str(want)[:12]} (wrong architecture or partial "
+                    "restore)")
+        return tree, extras
+
+    # -------------------------------------------------------- lifecycle
 
     def clear(self) -> None:
         """Delete every checkpoint step in this run (used when an
         abandoned training attempt's checkpoints must not shadow its
         replacement — e.g. ``train_ppo --reseed-on-stall``)."""
+        self._finalize_pending()
         for step in list(self._mgr.all_steps()):
             self._mgr.delete(step)
         self._mgr.wait_until_finished()
+        self._verified.clear()
+        self._prune_manifests()
 
     def close(self) -> None:
+        """Finalize the in-flight save (manifest included) and release
+        Orbax's resources. Always call this — an unfinalized final save
+        has no integrity manifest and restores as 'legacy'."""
+        self._finalize_pending()
         self._mgr.close()
 
 
@@ -112,6 +475,10 @@ def find_latest_run(root: str | Path, prefix: str = "") -> Path:
 def load_policy_params(run_dir: str | Path, step: int | None = None):
     """Restore just the policy params (+meta) from a run directory."""
     mgr = CheckpointManager(run_dir)
-    tree, meta = mgr.restore(step)
-    mgr.close()
+    try:
+        tree, meta = mgr.restore(step)
+    finally:
+        # A raised restore (corrupt step, wrong structure) must not leak
+        # the manager's Orbax resources — serving retries this in a loop.
+        mgr.close()
     return tree["params"], meta

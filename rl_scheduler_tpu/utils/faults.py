@@ -1,0 +1,144 @@
+"""graftguard part 4: the deterministic fault-injection harness.
+
+The failure paths graftguard adds (hardened checkpoints, retry/breaker
+adoption, preemption-safe shutdown) are worthless if they are never
+executed — "fail-open everywhere" code that only runs in production IS
+the untested path. A :class:`FaultPlan` makes every host-I/O boundary
+attackable on purpose, deterministically:
+
+- **Named sites.** Each injection point asks the plan by site name
+  (:data:`SITES` lists the wired ones). Sites are consulted once per
+  call, so a plan fully determines WHICH call of WHICH boundary fails.
+- **Two trigger modes.** ``schedule={site: (call_indices...)}`` fires on
+  exact 1-based call numbers (the chaos suite's mode — byte-reproducible
+  runs); ``rates={site: p}`` fires each call with probability ``p`` from
+  a per-site ``random.Random`` seeded from ``(seed, site)`` (the soak
+  mode — still reproducible from the seed, but site streams are
+  independent, so adding a new injection point never shifts another
+  site's pattern).
+- **Observability.** ``plan.calls``/``plan.fired`` count per site, so a
+  test can assert a fault actually happened (a chaos test whose fault
+  never fired is a green lie).
+
+Production code never constructs a plan; every seam defaults to
+``fault_plan=None`` (zero overhead, zero behavior change). The seams are
+plumbed, not monkeypatched, so the chaos suite exercises the exact code
+paths production runs.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+# The wired injection sites (see docs/robustness.md for the map):
+#   checkpoint.save    raised before the Orbax save dispatches (write error)
+#   checkpoint.partial step files truncated AFTER the manifest is written
+#                      (torn write — restore-time verification must catch it)
+#   telemetry.scrape   Prometheus HTTP query raises TimeoutError
+#   k8s.place          kube pod-create raises a 503-style error
+#   backend.decide     policy backend raises (wired by the chaos suite's
+#                      backend stub; the extender's breaker absorbs it)
+#   preempt            PreemptionGuard.should_stop() reports a simulated
+#                      SIGTERM at the next dispatch boundary
+SITES = ("checkpoint.save", "checkpoint.partial", "telemetry.scrape",
+         "k8s.place", "backend.decide", "preempt")
+
+
+class FaultInjected(RuntimeError):
+    """The base exception a fired site raises (sites that simulate a
+    specific error family raise that family instead — the seam decides)."""
+
+    def __init__(self, site: str, call_index: int):
+        self.site = site
+        self.call_index = call_index
+        super().__init__(f"injected fault at {site} (call #{call_index})")
+
+
+class FaultPlan:
+    """Seeded, deterministic per-site fault triggers. Thread-safe: the
+    telemetry/extender seams are consulted from serving threads."""
+
+    def __init__(self, seed: int = 0,
+                 schedule: dict | None = None,
+                 rates: dict | None = None):
+        self.seed = seed
+        self.schedule = {k: frozenset(v) for k, v in (schedule or {}).items()}
+        self.rates = dict(rates or {})
+        bad = [s for s in list(self.schedule) + list(self.rates)
+               if s not in SITES]
+        if bad:
+            raise ValueError(
+                f"unknown fault site(s) {sorted(bad)}; wired sites: "
+                f"{list(SITES)}"
+            )
+        self.calls: dict = {}   # site -> consult count
+        self.fired: dict = {}   # site -> fire count
+        self._lock = threading.Lock()
+        # Independent stream per site: (seed, site) keys the RNG, so a new
+        # injection point cannot shift an existing site's pattern.
+        self._rngs = {s: random.Random(f"{seed}:{s}") for s in self.rates}
+
+    def fires(self, site: str) -> bool:
+        """Consult the plan for one call at ``site`` (advances the site's
+        call counter either way)."""
+        with self._lock:
+            n = self.calls.get(site, 0) + 1
+            self.calls[site] = n
+            hit = n in self.schedule.get(site, ())
+            if not hit and site in self._rngs:
+                hit = self._rngs[site].random() < self.rates[site]
+            if hit:
+                self.fired[site] = self.fired.get(site, 0) + 1
+                logger.info("fault plan: firing %s (call #%d)", site, n)
+            return hit
+
+    def check(self, site: str, exc: type = FaultInjected) -> None:
+        """Raise when the plan fires this call. ``exc`` is the error
+        family the real dependency would raise (TimeoutError for a
+        scrape, OSError for a write, ...); :class:`FaultInjected` itself
+        is raised when the family's constructor does not take our
+        message."""
+        if not self.fires(site):
+            return
+        n = self.calls[site]
+        if exc is FaultInjected:
+            raise FaultInjected(site, n)
+        raise exc(f"injected fault at {site} (call #{n})")
+
+
+def corrupt_checkpoint_step(step_dir: str | Path, mode: str = "truncate") -> list:
+    """Simulate a torn/corrupt checkpoint write on a FINALIZED step dir.
+
+    ``truncate`` halves the largest file (a write cut off mid-flush —
+    the classic disk-full/preempted-VM artifact); ``garbage`` overwrites
+    its head with junk bytes (bit rot / torn sector). Returns the
+    relative paths touched so tests can assert exactly what was damaged.
+    The hardened restore path must detect either against the step's
+    integrity manifest and fall back to the previous verified step.
+    """
+    step_dir = Path(step_dir)
+    files = sorted(
+        (p for p in step_dir.rglob("*") if p.is_file()),
+        key=lambda p: p.stat().st_size, reverse=True,
+    )
+    if not files:
+        raise FileNotFoundError(f"no files to corrupt under {step_dir}")
+    target = files[0]
+    size = target.stat().st_size
+    if mode == "truncate":
+        with target.open("rb+") as fh:
+            fh.truncate(max(size // 2, 0))
+    elif mode == "garbage":
+        with target.open("rb+") as fh:
+            fh.write(b"\xde\xad\xbe\xef" * max(1, min(size, 256) // 4))
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}; "
+                         "choose truncate|garbage")
+    logger.info("corrupted checkpoint file %s (%s, was %d bytes)",
+                target, mode, size)
+    return [str(target.relative_to(step_dir))]

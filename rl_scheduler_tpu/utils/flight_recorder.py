@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import math
 from pathlib import Path
 from typing import Any
@@ -51,6 +52,7 @@ def build_manifest(config: dict | None = None,
     """Run provenance for the dump header: everything needed to reproduce
     or triage without the run directory. Best-effort on every field —
     a recorder must never be the thing that crashes the run."""
+    log = logging.getLogger(__name__)
     manifest: dict = {"config": config or {}}
     try:
         manifest["jax_version"] = jax.__version__
@@ -59,6 +61,7 @@ def build_manifest(config: dict | None = None,
         manifest["device_kind"] = dev.device_kind
         manifest["device_count"] = jax.device_count()
     except Exception:  # noqa: BLE001 — provenance, not control flow
+        log.debug("device provenance unavailable for manifest", exc_info=True)
         manifest.setdefault("jax_version", "unknown")
     try:
         manifest["precision"] = {
@@ -67,6 +70,7 @@ def build_manifest(config: dict | None = None,
                 getattr(jax.config, "jax_default_matmul_precision", None),
         }
     except Exception:  # noqa: BLE001
+        log.debug("precision flags unavailable for manifest", exc_info=True)
         manifest["precision"] = {}
     try:
         import subprocess
@@ -78,6 +82,7 @@ def build_manifest(config: dict | None = None,
         )
         manifest["git_sha"] = sha.stdout.strip() if sha.returncode == 0 else None
     except Exception:  # noqa: BLE001
+        log.debug("git sha unavailable for manifest", exc_info=True)
         manifest["git_sha"] = None
     if extra:
         manifest.update(extra)
@@ -235,31 +240,45 @@ class FlightRecorder:
 
     def dump(self, reason: str, iteration: int, detail: str = "") -> bool:
         """Fetch the ring once and append the artifact. Returns whether a
-        dump was written (rate-limited by ``max_dumps``)."""
+        dump was written (rate-limited by ``max_dumps``).
+
+        NON-FATAL by contract (graftguard): an unwritable/full dump dir —
+        or any other failure in here — logs and returns False; a
+        diagnostic artifact must never be the thing that kills the run it
+        is diagnosing. Failed attempts still count against ``max_dumps``
+        (an unwritable dir fails every time; retry-spamming it per
+        anomaly would flood the logs the operator needs).
+        """
         if self.dump_count >= self.max_dumps:
             return False
         self.dump_count += 1
-        lines = [json.dumps({
-            "kind": "manifest", "reason": reason, "iteration": iteration,
-            "detail": detail, **self.manifest,
-        })]
-        if self._ring is not None:
-            host = _device_get(self._ring)
-            pos = int(host["pos"])
-            cap = self._ring["step"].shape[0]
-            order = [(pos + j) % cap for j in range(cap)]
-            for slot in order:
-                step = int(host["step"][slot])
-                if step < 0:
-                    continue  # never written
-                row = {"kind": "ring", "step": step}
-                for name in self._keys:
-                    v = float(host[name][slot])
-                    row[name] = v if math.isfinite(v) else str(v)
-                lines.append(json.dumps(row))
-        self.path.parent.mkdir(parents=True, exist_ok=True)
-        with self.path.open("a") as fh:
-            fh.write("\n".join(lines) + "\n")
+        try:
+            lines = [json.dumps({
+                "kind": "manifest", "reason": reason, "iteration": iteration,
+                "detail": detail, **self.manifest,
+            })]
+            if self._ring is not None:
+                host = _device_get(self._ring)
+                pos = int(host["pos"])
+                cap = self._ring["step"].shape[0]
+                order = [(pos + j) % cap for j in range(cap)]
+                for slot in order:
+                    step = int(host["step"][slot])
+                    if step < 0:
+                        continue  # never written
+                    row = {"kind": "ring", "step": step}
+                    for name in self._keys:
+                        v = float(host[name][slot])
+                        row[name] = v if math.isfinite(v) else str(v)
+                    lines.append(json.dumps(row))
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            with self.path.open("a") as fh:
+                fh.write("\n".join(lines) + "\n")
+        except Exception:  # noqa: BLE001 — see docstring: log and continue
+            logging.getLogger(__name__).exception(
+                "flight recorder dump (%s at iteration %d) failed; "
+                "training continues", reason, iteration + 1)
+            return False
         print(f"flight recorder: {reason} at iteration {iteration + 1} — "
               f"ring + manifest dumped to {self.path}", flush=True)
         return True

@@ -1,0 +1,126 @@
+"""graftguard part 2: preemption-safe training shutdown.
+
+Production TPU pods get preempted: the VM receives SIGTERM and has a
+grace window to get its state out. Before graftguard a SIGTERM mid-run
+unwound the training loop wherever Python happened to be, losing every
+iteration since the last periodic checkpoint. :class:`PreemptionGuard`
+turns the signal into a cooperative stop:
+
+- The handler only SETS A FLAG (signal-safe; no I/O, no locks). The
+  training loop polls it at dispatch boundaries — the one place where
+  the runner state is a consistent, checkpointable pytree — finishes the
+  in-flight dispatch, flushes pending metrics, writes a final checkpoint
+  plus a flight-recorder manifest, and returns cleanly
+  (``agent/loop.run_train_loop``).
+- A SECOND signal escalates: the original handler is restored and
+  ``KeyboardInterrupt`` is raised, so a stuck shutdown can still be
+  killed interactively.
+- ``simulated`` is the chaos harness's seam: a zero-arg callable (e.g.
+  ``lambda: plan.fires("preempt")``) consulted at each poll, so the
+  chaos suite triggers byte-reproducible "preemptions" at exact dispatch
+  indices without process signals. The CLIs arm it from the
+  ``GRAFTGUARD_PREEMPT_AFTER`` env var (dispatch count) for end-to-end
+  interrupt/resume tests.
+
+Handlers install in ``__enter__`` and restore in ``__exit__``; signal
+handling is process-wide and main-thread-only, so the guard refuses to
+install off the main thread (it still works as a pure simulated guard
+there).
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Callable
+
+logger = logging.getLogger(__name__)
+
+
+class PreemptionGuard:
+    """Cooperative SIGTERM/SIGINT stop flag for training loops."""
+
+    def __init__(self, signals: tuple = (signal.SIGTERM, signal.SIGINT),
+                 simulated: Callable[[], bool] | None = None):
+        self.signals = tuple(signals)
+        self.simulated = simulated
+        self.requested = False
+        self.signum: int | None = None
+        # Set by run_train_loop when it acts on the request: the last
+        # completed iteration the final checkpoint covers.
+        self.stopped_at: int | None = None
+        self._old: dict = {}
+        self._installed = False
+
+    # ----------------------------------------------------- signal wiring
+
+    def _handle(self, signum, frame) -> None:
+        if self.requested:
+            # Second signal: the operator (or the platform) is done
+            # waiting — restore original disposition and escalate.
+            self._uninstall()
+            raise KeyboardInterrupt(
+                f"second signal {signum} during preemption shutdown")
+        self.requested = True
+        self.signum = signum
+        logger.warning(
+            "signal %s received: finishing the in-flight dispatch, then "
+            "checkpointing and exiting (send again to force)", signum)
+
+    def __enter__(self) -> "PreemptionGuard":
+        if threading.current_thread() is threading.main_thread():
+            for s in self.signals:
+                self._old[s] = signal.signal(s, self._handle)
+            self._installed = True
+        else:
+            logger.warning(
+                "PreemptionGuard off the main thread: OS signal handlers "
+                "not installed (simulated trigger still active)")
+        return self
+
+    def _uninstall(self) -> None:
+        if self._installed:
+            for s, old in self._old.items():
+                signal.signal(s, old)
+            self._installed = False
+
+    def __exit__(self, *exc) -> bool:
+        self._uninstall()
+        return False
+
+    # ------------------------------------------------------------ polling
+
+    def should_stop(self) -> bool:
+        """Polled by the training loop at each dispatch boundary."""
+        if not self.requested and self.simulated is not None and \
+                self.simulated():
+            self.requested = True
+            logger.warning("simulated preemption fired (fault plan)")
+        return self.requested
+
+
+def guard_from_env(env_value: str | None) -> PreemptionGuard:
+    """Build the CLIs' guard, optionally armed by
+    ``GRAFTGUARD_PREEMPT_AFTER=<n>``: a deterministic simulated SIGTERM
+    after ``n`` dispatch boundaries — the chaos suite's stand-in for a
+    real preemption, identical downstream path (final checkpoint +
+    flight-recorder manifest + clean exit)."""
+    if not env_value:
+        return PreemptionGuard()
+    try:
+        after = int(env_value)
+    except ValueError:
+        raise SystemExit(
+            f"GRAFTGUARD_PREEMPT_AFTER={env_value!r}: pass a dispatch "
+            "count (integer)")
+    if after < 1:
+        raise SystemExit(
+            f"GRAFTGUARD_PREEMPT_AFTER={after}: must be >= 1")
+    state = {"polls": 0}
+
+    def fire() -> bool:
+        state["polls"] += 1
+        return state["polls"] > after
+
+    return PreemptionGuard(simulated=fire)

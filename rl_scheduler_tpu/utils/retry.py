@@ -1,0 +1,269 @@
+"""graftguard part 3: the one retry/backoff/circuit-breaker policy.
+
+Every host-I/O boundary in this repo talks to something that fails in
+production — Prometheus scrapes time out, the kube API returns 5xx under
+apiserver pressure, a policy backend can throw on a poisoned checkpoint.
+Before graftguard each call site hand-rolled its own "try once, fall
+back" shape, which meant no backoff (a dead Prometheus got re-probed at
+full request rate), no deadline, and no way to see from /metrics that a
+dependency was down. This module is the single policy all of them adopt
+(``scheduler/telemetry.py``, ``scheduler/k8s_client.py``, the extender's
+backend calls):
+
+- :class:`RetryPolicy` — bounded attempts, exponential backoff with
+  seeded-RNG jitter (deterministic under the chaos harness), and a total
+  deadline so a retried call can never exceed its caller's latency
+  budget. The sleep function is injectable so tests never actually wait.
+- :class:`CircuitBreaker` — consecutive-failure trip, a cool-down after
+  which ONE half-open probe is admitted, closing again only on probe
+  success. State is exported as a dict snapshot; the extender mirrors it
+  onto ``/stats`` and ``/metrics`` so "the breaker is open" is a scrape,
+  not a log-dive.
+
+Both are plain host-side Python (never inside jit) and thread-safe: the
+extender serves requests concurrently and telemetry refreshes on a
+background thread.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
+
+
+class RetryBudgetExceeded(RuntimeError):
+    """All attempts (or the deadline) were exhausted; carries the last
+    underlying exception as ``__cause__``."""
+
+
+class CircuitOpenError(RuntimeError):
+    """The breaker is open: the call was refused without being attempted."""
+
+
+class RetryPolicy:
+    """Bounded retries with exponential backoff, jitter, and a deadline.
+
+    ``call(fn, *args, **kwargs)`` runs ``fn`` up to ``max_attempts``
+    times. Between attempts it sleeps ``base_delay_s * 2**n``, capped at
+    ``max_delay_s``, plus uniform jitter of up to ``jitter`` of the delay
+    (seeded RNG — the chaos suite asserts exact schedules). A non-None
+    ``deadline_s`` bounds the TOTAL time (attempt time + sleeps): once
+    exceeded, no further attempt is made even if the attempt budget
+    remains — a retried scrape must never outlive its caller's latency
+    budget. Exceptions not listed in ``retry_on`` propagate immediately.
+    """
+
+    def __init__(
+        self,
+        max_attempts: int = 3,
+        base_delay_s: float = 0.05,
+        max_delay_s: float = 2.0,
+        jitter: float = 0.1,
+        deadline_s: float | None = None,
+        retry_on: tuple = (Exception,),
+        seed: int | None = None,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts={max_attempts}: must be >= 1")
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError(f"jitter={jitter}: pass a fraction in [0, 1]")
+        self.max_attempts = max_attempts
+        self.base_delay_s = base_delay_s
+        self.max_delay_s = max_delay_s
+        self.jitter = jitter
+        self.deadline_s = deadline_s
+        self.retry_on = retry_on
+        self._rng = random.Random(seed)
+        self._sleep = sleep
+        self._clock = clock
+
+    def delays(self) -> list:
+        """The backoff schedule this policy WOULD sleep (jitter included),
+        one entry per retry gap. Fresh jitter draws each call; with a
+        seeded policy the sequence is reproducible from construction."""
+        out = []
+        for n in range(self.max_attempts - 1):
+            d = min(self.base_delay_s * (2 ** n), self.max_delay_s)
+            out.append(d + self._rng.uniform(0.0, self.jitter * d))
+        return out
+
+    def call(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
+        t0 = self._clock()
+        last: BaseException | None = None
+        for attempt in range(self.max_attempts):
+            if self.deadline_s is not None and \
+                    self._clock() - t0 >= self.deadline_s and attempt > 0:
+                break
+            try:
+                return fn(*args, **kwargs)
+            except self.retry_on as e:  # noqa: PERF203 - retry loop
+                last = e
+                logger.debug("retry %d/%d of %s failed: %s", attempt + 1,
+                             self.max_attempts, getattr(fn, "__name__", fn), e)
+                if attempt + 1 >= self.max_attempts:
+                    break
+                d = min(self.base_delay_s * (2 ** attempt), self.max_delay_s)
+                d += self._rng.uniform(0.0, self.jitter * d)
+                if self.deadline_s is not None:
+                    remaining = self.deadline_s - (self._clock() - t0)
+                    if remaining <= 0:
+                        break
+                    d = min(d, remaining)
+                self._sleep(d)
+        raise RetryBudgetExceeded(
+            f"{getattr(fn, '__name__', fn)} failed after {self.max_attempts} "
+            f"attempt(s): {last}"
+        ) from last
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker with half-open recovery probes.
+
+    States: ``closed`` (calls flow; ``failure_threshold`` consecutive
+    failures trip it) -> ``open`` (calls refused for ``reset_timeout_s``)
+    -> ``half_open`` (ONE probe call admitted; ``probe_successes``
+    consecutive probe successes close the breaker, any probe failure
+    re-opens it and restarts the cool-down). The caller drives it either
+    through :meth:`call` (raises :class:`CircuitOpenError` when refused)
+    or through the ``allow``/``record_success``/``record_failure``
+    primitives when it wants to substitute a fallback instead of raising
+    — the fail-open serving paths do the latter.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(
+        self,
+        name: str = "breaker",
+        failure_threshold: int = 5,
+        reset_timeout_s: float = 30.0,
+        probe_successes: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if failure_threshold < 1 or probe_successes < 1:
+            raise ValueError(
+                "failure_threshold and probe_successes must be >= 1"
+            )
+        self.name = name
+        self.failure_threshold = failure_threshold
+        self.reset_timeout_s = reset_timeout_s
+        self.probe_successes = probe_successes
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive_failures = 0
+        self._probe_streak = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+        self._probe_started = 0.0
+        # Lifetime counters for /metrics (monotonic, Prometheus-safe).
+        self._failures_total = 0
+        self._refusals_total = 0
+        self._opens_total = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._peek_state()
+
+    def _peek_state(self) -> str:
+        # Caller holds the lock. Promote open -> half_open lazily on read:
+        # there is no timer thread, the next allow() after the cool-down
+        # is the probe.
+        if self._state == self.OPEN and \
+                self._clock() - self._opened_at >= self.reset_timeout_s:
+            self._state = self.HALF_OPEN
+            self._probe_streak = 0
+            self._probe_in_flight = False
+        return self._state
+
+    def allow(self) -> bool:
+        """Whether the next call may proceed. In half-open, exactly one
+        in-flight probe is admitted at a time (concurrent serving threads
+        must not stampede a recovering dependency)."""
+        with self._lock:
+            state = self._peek_state()
+            if state == self.CLOSED:
+                return True
+            if state == self.HALF_OPEN and (
+                    not self._probe_in_flight or
+                    self._clock() - self._probe_started >=
+                    self.reset_timeout_s):
+                # The in-flight check re-arms after a cool-down: a probe
+                # that never reported back (wedged dependency, caller
+                # thread died on a BaseException) must not block breaker
+                # recovery for the rest of the process lifetime.
+                self._probe_in_flight = True
+                self._probe_started = self._clock()
+                return True
+            self._refusals_total += 1
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            state = self._peek_state()
+            self._consecutive_failures = 0
+            if state == self.HALF_OPEN:
+                self._probe_in_flight = False
+                self._probe_streak += 1
+                if self._probe_streak >= self.probe_successes:
+                    self._state = self.CLOSED
+                    logger.info("breaker %s closed after %d probe "
+                                "success(es)", self.name, self._probe_streak)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            state = self._peek_state()
+            self._failures_total += 1
+            if state == self.HALF_OPEN:
+                # Failed probe: back to open, restart the cool-down.
+                self._probe_in_flight = False
+                self._trip("probe failed")
+                return
+            self._consecutive_failures += 1
+            if state == self.CLOSED and \
+                    self._consecutive_failures >= self.failure_threshold:
+                self._trip(
+                    f"{self._consecutive_failures} consecutive failures"
+                )
+
+    def _trip(self, why: str) -> None:
+        # Caller holds the lock.
+        self._state = self.OPEN
+        self._opened_at = self._clock()
+        self._opens_total += 1
+        self._consecutive_failures = 0
+        logger.warning("breaker %s opened (%s); cooling down %.1fs",
+                       self.name, why, self.reset_timeout_s)
+
+    def call(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
+        if not self.allow():
+            raise CircuitOpenError(f"breaker {self.name} is open")
+        try:
+            out = fn(*args, **kwargs)
+        except Exception:
+            self.record_failure()
+            raise
+        self.record_success()
+        return out
+
+    def snapshot(self) -> dict:
+        """State + lifetime counters for /stats and /metrics export."""
+        with self._lock:
+            return {
+                "state": self._peek_state(),
+                "consecutive_failures": self._consecutive_failures,
+                "failures_total": self._failures_total,
+                "refusals_total": self._refusals_total,
+                "opens_total": self._opens_total,
+            }
+
+    # Numeric encoding for the Prometheus gauge (docs/robustness.md).
+    STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}

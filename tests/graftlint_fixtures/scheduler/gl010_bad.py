@@ -1,0 +1,53 @@
+"""GL010 positive fixture: broad exception handlers in a (fixture)
+scheduler/ path that swallow failures silently. Expected findings: 4."""
+
+import logging
+import math
+
+logger = logging.getLogger(__name__)
+
+
+def scrape_cpu(url):
+    try:
+        return float(open(url).read())
+    except Exception:  # finding 1: broad catch, no log, no raise
+        return 0.5
+
+
+def place_pod(client, cloud):
+    try:
+        client.create(cloud)
+        return True
+    except:  # noqa: E722 — finding 2: bare except, silent fallback
+        return False
+
+
+def read_stats(path):
+    try:
+        with open(path) as fh:
+            return fh.read()
+    except (OSError, Exception):  # finding 3: tuple containing a broad type
+        return ""
+
+
+def score_node(cpu):
+    try:
+        return 1.0 / cpu
+    except Exception:  # finding 4: math.log is not logging — the method
+        # name alone must not satisfy the rule
+        return math.log(2.0)
+
+
+def parse_quantity(raw):
+    try:
+        return int(raw)
+    except ValueError:  # NOT a finding: narrow catch is a deliberate pattern
+        return None
+
+
+def load_table(path):
+    try:
+        return open(path).read()
+    except Exception as e:  # NOT a finding: logs what it swallowed
+        logger.warning("table load failed: %s", e)
+        return None

@@ -1,0 +1,869 @@
+"""graftguard chaos suite (docs/robustness.md).
+
+Attacks every host-I/O boundary under a seeded, deterministic
+:class:`~rl_scheduler_tpu.utils.faults.FaultPlan` and asserts the stack
+degrades the way the failure-domain design promises:
+
+- checkpoint write failures are non-fatal; torn writes are caught by the
+  integrity manifest, quarantined, and restore falls back to the newest
+  VERIFIED step — the data-loss bound;
+- simulated preemption stops the loop at a dispatch boundary, writes a
+  final checkpoint, and interrupt-and-resume is BITWISE identical to an
+  uninterrupted run (PPO via the real CLI, DQN via the API);
+- Prometheus scrape timeouts and kube 5xx are retried under the unified
+  ``utils/retry.py`` policy behind circuit breakers whose state the
+  extender exports on ``/stats`` and ``/metrics``;
+- a failing policy backend trips the extender's breaker and scheduling
+  keeps answering (fail-open) without invoking the poisoned backend.
+
+Every test asserts its fault actually FIRED (``plan.fired``): a chaos
+test whose fault never triggers is a green lie. Long soak variants are
+marked ``slow`` (``make chaos`` runs the fast gate; ``make chaos-soak``
+includes them).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from rl_scheduler_tpu.agent.loop import make_periodic_checkpoint_fn
+from rl_scheduler_tpu.utils.checkpoint import (
+    CheckpointCorrupt,
+    CheckpointManager,
+    tree_structure_hash,
+)
+from rl_scheduler_tpu.utils.faults import (
+    FaultInjected,
+    FaultPlan,
+    corrupt_checkpoint_step,
+)
+from rl_scheduler_tpu.utils.preemption import PreemptionGuard, guard_from_env
+from rl_scheduler_tpu.utils.retry import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryBudgetExceeded,
+    RetryPolicy,
+)
+
+SMALL_TREE = {"params": {"w": np.arange(12.0, dtype=np.float32).reshape(3, 4),
+                         "b": np.zeros(4, np.float32)}}
+
+
+def preempt_after(n: int) -> PreemptionGuard:
+    """Simulated guard firing after exactly ``n`` dispatch boundaries."""
+    state = {"polls": 0}
+
+    def fire() -> bool:
+        state["polls"] += 1
+        return state["polls"] > n
+
+    return PreemptionGuard(simulated=fire)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ------------------------------------------------------------ retry policy
+
+
+def test_retry_policy_succeeds_after_transient_failures():
+    calls = {"n": 0}
+    sleeps = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("503")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.1, jitter=0.0,
+                         sleep=sleeps.append)
+    assert policy.call(flaky) == "ok"
+    assert calls["n"] == 3
+    # Exponential backoff: 0.1, then 0.2.
+    assert sleeps == [pytest.approx(0.1), pytest.approx(0.2)]
+
+
+def test_retry_policy_exhausts_and_chains_cause():
+    policy = RetryPolicy(max_attempts=2, base_delay_s=0.0, jitter=0.0,
+                         sleep=lambda _s: None)
+
+    def always():
+        raise TimeoutError("scrape")
+
+    with pytest.raises(RetryBudgetExceeded) as exc:
+        policy.call(always)
+    assert isinstance(exc.value.__cause__, TimeoutError)
+
+
+def test_retry_policy_jitter_is_seeded_deterministic():
+    a = RetryPolicy(max_attempts=4, base_delay_s=0.1, jitter=0.5, seed=7)
+    b = RetryPolicy(max_attempts=4, base_delay_s=0.1, jitter=0.5, seed=7)
+    assert a.delays() == b.delays()
+
+
+def test_retry_policy_deadline_stops_early():
+    clock = FakeClock()
+    sleeps = []
+
+    def slow_sleep(s):
+        sleeps.append(s)
+        clock.advance(s)
+
+    def failing():
+        clock.advance(0.4)
+        raise TimeoutError
+
+    policy = RetryPolicy(max_attempts=10, base_delay_s=0.1, jitter=0.0,
+                         deadline_s=1.0, sleep=slow_sleep, clock=clock)
+    with pytest.raises(RetryBudgetExceeded):
+        policy.call(failing)
+    # Far fewer than 10 attempts fit inside the 1 s deadline.
+    assert len(sleeps) <= 2
+
+
+def test_retry_policy_propagates_non_retryable():
+    policy = RetryPolicy(max_attempts=3, retry_on=(ConnectionError,),
+                         sleep=lambda _s: None)
+
+    def typo():
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        policy.call(typo)
+
+
+# -------------------------------------------------------- circuit breaker
+
+
+def test_breaker_full_cycle_closed_open_halfopen_closed():
+    clock = FakeClock()
+    br = CircuitBreaker(name="t", failure_threshold=2, reset_timeout_s=10.0,
+                        clock=clock)
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "closed"
+    br.record_failure()
+    assert br.state == "open"
+    assert not br.allow()  # refused while cooling down
+    clock.advance(10.1)
+    assert br.state == "half_open"
+    assert br.allow()          # the single probe
+    assert not br.allow()      # concurrent second probe refused
+    br.record_success()
+    assert br.state == "closed"
+    snap = br.snapshot()
+    assert snap["opens_total"] == 1 and snap["failures_total"] == 2
+    assert snap["refusals_total"] >= 2
+
+
+def test_breaker_failed_probe_reopens():
+    clock = FakeClock()
+    br = CircuitBreaker(name="t", failure_threshold=1, reset_timeout_s=5.0,
+                        clock=clock)
+    br.record_failure()
+    assert br.state == "open"
+    clock.advance(5.1)
+    assert br.allow()
+    br.record_failure()  # probe fails
+    assert br.state == "open"
+    assert not br.allow()  # cool-down restarted
+    assert br.snapshot()["opens_total"] == 2
+
+
+def test_breaker_stuck_probe_rearms_after_cooldown():
+    """A half-open probe that never reports back (wedged dependency,
+    caller thread died) must not block recovery forever: the probe slot
+    re-arms after another cool-down."""
+    clock = FakeClock()
+    br = CircuitBreaker(name="t", failure_threshold=1, reset_timeout_s=5.0,
+                        clock=clock)
+    br.record_failure()
+    clock.advance(5.1)
+    assert br.allow()       # probe admitted... and never reports back
+    assert not br.allow()   # slot held
+    clock.advance(5.1)
+    assert br.allow()       # slot re-armed: recovery still possible
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_breaker_call_raises_circuit_open():
+    br = CircuitBreaker(name="t", failure_threshold=1, reset_timeout_s=60.0)
+    with pytest.raises(RuntimeError):
+        br.call(lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    with pytest.raises(CircuitOpenError):
+        br.call(lambda: "never runs")
+
+
+# ------------------------------------------------------------- fault plan
+
+
+def test_fault_plan_schedule_and_counters():
+    plan = FaultPlan(schedule={"checkpoint.save": (2,)})
+    assert not plan.fires("checkpoint.save")
+    assert plan.fires("checkpoint.save")
+    assert not plan.fires("checkpoint.save")
+    assert plan.calls["checkpoint.save"] == 3
+    assert plan.fired["checkpoint.save"] == 1
+
+
+def test_fault_plan_rates_deterministic_per_seed_and_site():
+    a = FaultPlan(seed=3, rates={"telemetry.scrape": 0.5, "k8s.place": 0.5})
+    b = FaultPlan(seed=3, rates={"telemetry.scrape": 0.5, "k8s.place": 0.5})
+    pattern_a = [a.fires("telemetry.scrape") for _ in range(50)]
+    pattern_b = [b.fires("telemetry.scrape") for _ in range(50)]
+    assert pattern_a == pattern_b
+    assert any(pattern_a) and not all(pattern_a)
+    # Independent streams: consuming one site does not shift the other.
+    c = FaultPlan(seed=3, rates={"telemetry.scrape": 0.5, "k8s.place": 0.5})
+    [c.fires("k8s.place") for _ in range(17)]
+    assert [c.fires("telemetry.scrape") for _ in range(50)] == pattern_a
+
+
+def test_fault_plan_rejects_unknown_site():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultPlan(schedule={"not.a.site": (1,)})
+    plan = FaultPlan(schedule={"preempt": (1,)})
+    with pytest.raises(FaultInjected):
+        plan.check("preempt")
+
+
+# ------------------------------------------------- hardened checkpointing
+
+
+def test_checkpoint_manifest_written_and_verifies(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep=3)
+    mgr.save(1, SMALL_TREE, extras={"k": 1}, wait=True)
+    mpath = tmp_path / "checkpoint_manifests" / "1.json"
+    assert mpath.exists()
+    manifest = json.loads(mpath.read_text())
+    assert manifest["tree_hash"] == tree_structure_hash(SMALL_TREE)
+    assert manifest["files"], "manifest recorded no files"
+    ok, reason = mgr.verify_step(1)
+    assert ok and reason == "verified"
+    tree, extras = mgr.restore(1)
+    assert extras == {"k": 1}
+    np.testing.assert_array_equal(tree["params"]["w"],
+                                  SMALL_TREE["params"]["w"])
+    mgr.close()
+
+
+def test_async_save_finalizes_at_close(tmp_path):
+    mgr = CheckpointManager(tmp_path)
+    mgr.save(1, SMALL_TREE)  # async: no wait
+    mgr.close()              # finalize happens here
+    assert (tmp_path / "checkpoint_manifests" / "1.json").exists()
+
+
+def test_corrupt_step_quarantined_and_restore_falls_back(tmp_path):
+    mgr = CheckpointManager(tmp_path)
+    tree2 = {"params": {"w": SMALL_TREE["params"]["w"] + 1.0,
+                        "b": SMALL_TREE["params"]["b"]}}
+    mgr.save(1, SMALL_TREE, extras={"step": 1}, wait=True)
+    mgr.save(2, tree2, extras={"step": 2}, wait=True)
+    corrupt_checkpoint_step(tmp_path / "checkpoints" / "2")
+    ok, reason = mgr.verify_step(2)
+    assert not ok and "truncated" in reason
+    tree, extras = mgr.restore()  # auto-select: falls back to step 1
+    assert extras == {"step": 1}
+    np.testing.assert_array_equal(tree["params"]["w"],
+                                  SMALL_TREE["params"]["w"])
+    assert (tmp_path / "quarantine" / "2").exists(), \
+        "corrupt step must be quarantined as evidence, not deleted"
+    assert mgr.latest_verified_step() == 1
+    mgr.close()
+
+
+def test_corrupt_garbage_detected_by_digest(tmp_path):
+    mgr = CheckpointManager(tmp_path)
+    mgr.save(1, SMALL_TREE, wait=True)
+    corrupt_checkpoint_step(tmp_path / "checkpoints" / "1", mode="garbage")
+    ok, reason = mgr.verify_step(1)
+    assert not ok and "sha256" in reason
+    mgr.close()
+
+
+def test_explicit_corrupt_step_raises_not_silently_substitutes(tmp_path):
+    mgr = CheckpointManager(tmp_path)
+    mgr.save(1, SMALL_TREE, wait=True)
+    mgr.save(2, SMALL_TREE, wait=True)
+    corrupt_checkpoint_step(tmp_path / "checkpoints" / "2")
+    with pytest.raises(CheckpointCorrupt):
+        mgr.restore(2)
+    mgr.close()
+
+
+def test_wrong_target_on_verified_step_does_not_quarantine(tmp_path):
+    """A restore failure on a step whose DIGESTS verified clean is a
+    caller error (wrong net/algo/config), not disk corruption — it must
+    raise without relocating the healthy checkpoint (in auto mode the
+    old behavior quarantined the entire run, one fallback at a time)."""
+    mgr = CheckpointManager(tmp_path)
+    mgr.save(1, SMALL_TREE, wait=True)
+    # Structure mismatch (a DQN/PPO-style extra key the checkpoint lacks)
+    # — Orbax raises on it; wrong SHAPES alone it silently ignores here.
+    bad_target = {"params": {"w": jax.ShapeDtypeStruct((3, 4), np.float32),
+                             "b": jax.ShapeDtypeStruct((4,), np.float32)},
+                  "opt_state": {"m": jax.ShapeDtypeStruct((4,), np.float32)}}
+    with pytest.raises(ValueError, match="key mismatch"):
+        mgr.restore(1, target=bad_target)
+    assert not (tmp_path / "quarantine").exists()
+    assert mgr.latest_verified_step() == 1
+    mgr.close()
+
+
+def test_unfinalized_async_save_not_quarantined_on_fallback(tmp_path):
+    """A manifest-less step in a run that HAS manifests is an in-flight
+    async save (a live trainer finalizes it at its next save/close): a
+    concurrent reader's failed restore must fall back WITHOUT moving the
+    directory out from under the trainer's in-flight Orbax write."""
+    mgr = CheckpointManager(tmp_path)
+    mgr.save(1, SMALL_TREE, extras={"step": 1}, wait=True)
+    mgr.close()
+    # Fabricate the on-disk shape of a dispatched-but-unfinalized save:
+    # a step dir Orbax cannot yet read, with no manifest.
+    step2 = tmp_path / "checkpoints" / "2"
+    (step2 / "state").mkdir(parents=True)
+    (step2 / "state" / "partial").write_bytes(b"\x00" * 64)
+    reader = CheckpointManager(tmp_path)
+    _, extras = reader.restore()
+    assert extras == {"step": 1}
+    assert step2.exists(), \
+        "the unfinalized save must stay in place for the live trainer"
+    assert not (tmp_path / "quarantine").exists()
+    reader.close()
+
+
+def test_ppo_cli_resume_with_changed_env_shape_degrades(tmp_path):
+    """Resuming a full-state run with different env-shape knobs must not
+    die inside Orbax: it degrades to the params-only resume with a note
+    (scaling a run up/down is a legitimate operation)."""
+    from rl_scheduler_tpu.agent import train_ppo as cli
+
+    common = ["--preset", "quick", "--rollout-steps", "16",
+              "--minibatch-size", "32", "--hidden", "8,8",
+              "--checkpoint-every", "2", "--run-root", str(tmp_path),
+              "--run-name", "scale"]
+    cli.main(common + ["--num-envs", "8", "--iterations", "2"])
+    cli.main(common + ["--num-envs", "4", "--iterations", "4", "--resume"])
+    mgr = CheckpointManager(tmp_path / "scale")
+    assert mgr.latest_verified_step() == 4
+    mgr.close()
+
+
+def test_legacy_checkpoint_without_manifest_still_restores(tmp_path):
+    mgr = CheckpointManager(tmp_path)
+    mgr.save(1, SMALL_TREE, wait=True)
+    (tmp_path / "checkpoint_manifests" / "1.json").unlink()
+    ok, reason = mgr.verify_step(1)
+    assert ok and reason == "legacy"
+    tree, _ = mgr.restore()
+    np.testing.assert_array_equal(tree["params"]["w"],
+                                  SMALL_TREE["params"]["w"])
+    mgr.close()
+
+
+def test_injected_save_failure_is_nonfatal_in_periodic_fn(tmp_path):
+    plan = FaultPlan(schedule={"checkpoint.save": (2,)})
+    mgr = CheckpointManager(tmp_path, fault_plan=plan)
+    fn = make_periodic_checkpoint_fn(
+        mgr, every=1, total_iterations=3,
+        tree_fn=lambda r: SMALL_TREE, extras={})
+    runner = object()
+    fn(0, runner)   # step 1 saves
+    fn(1, runner)   # step 2: injected OSError — logged, not raised
+    fn(2, runner)   # step 3 saves
+    assert plan.fired["checkpoint.save"] == 1
+    assert [s for s, _ in fn.failures] == [2]
+    assert mgr.latest_verified_step() == 3
+    mgr.close()
+
+
+def test_injected_partial_write_caught_on_restore(tmp_path):
+    plan = FaultPlan(schedule={"checkpoint.partial": (2,)})
+    mgr = CheckpointManager(tmp_path, fault_plan=plan)
+    mgr.save(1, SMALL_TREE, extras={"step": 1}, wait=True)
+    mgr.save(2, SMALL_TREE, extras={"step": 2}, wait=True)  # torn write
+    mgr.close()
+    assert plan.fired["checkpoint.partial"] == 1
+    fresh = CheckpointManager(tmp_path)
+    _, extras = fresh.restore()
+    assert extras == {"step": 1}, \
+        "restore must fall back past the torn step-2 write"
+    fresh.close()
+
+
+def test_load_policy_params_closes_manager_on_raise(tmp_path, monkeypatch):
+    from rl_scheduler_tpu.utils import checkpoint as ckpt_mod
+
+    closed = []
+    monkeypatch.setattr(
+        ckpt_mod.CheckpointManager, "restore",
+        lambda self, step=None, target=None: (_ for _ in ()).throw(
+            RuntimeError("boom")))
+    monkeypatch.setattr(
+        ckpt_mod.CheckpointManager, "close",
+        lambda self: closed.append(True))
+    with pytest.raises(RuntimeError, match="boom"):
+        ckpt_mod.load_policy_params(tmp_path)
+    assert closed == [True], "manager must close even when restore raises"
+
+
+# --------------------------------------------------- preemption mechanics
+
+
+def test_run_train_loop_stops_at_dispatch_boundary(tmp_path):
+    from rl_scheduler_tpu.agent.loop import run_train_loop
+
+    saves = []
+
+    def update(r):
+        return r + 1, {"loss": float(r)}
+
+    def checkpoint_fn(i, r):
+        if (i + 1) % 10 == 0:
+            saves.append(("periodic", i + 1))
+
+    checkpoint_fn.force = lambda i, r: saves.append(("force", i + 1))
+    guard = preempt_after(3)
+    runner, history = run_train_loop(
+        update, 0, 0, 10, checkpoint_fn=checkpoint_fn, preemption=guard)
+    assert runner == 3 and len(history) == 3
+    assert guard.stopped_at == 2
+    assert saves == [("force", 3)], \
+        "preemption must force a final checkpoint at the last iteration"
+
+
+def test_guard_from_env_validation():
+    assert guard_from_env(None).simulated is None
+    assert guard_from_env("").simulated is None
+    with pytest.raises(SystemExit):
+        guard_from_env("zero-ish")
+    with pytest.raises(SystemExit):
+        guard_from_env("0")
+    g = guard_from_env("2")
+    assert not g.should_stop() and not g.should_stop()
+    assert g.should_stop()
+
+
+# ------------------------------------------- interrupt-resume equivalence
+
+
+PPO_COMMON = [
+    "--preset", "quick", "--num-envs", "8", "--rollout-steps", "16",
+    "--minibatch-size", "64", "--hidden", "8,8", "--checkpoint-every", "2",
+]
+
+
+def _ppo_cli_params(run_dir: Path, step: int):
+    mgr = CheckpointManager(run_dir)
+    tree, _ = mgr.restore(step)
+    mgr.close()
+    return jax.tree_util.tree_leaves(tree["params"])
+
+
+def test_ppo_cli_interrupt_resume_bitwise(tmp_path, monkeypatch):
+    """The acceptance criterion: interrupt at iteration 2 via simulated
+    SIGTERM, resume, and the step-4 params are BITWISE identical to the
+    uninterrupted run's — the full-state checkpoint carries env state,
+    obs, and the RNG stream, so the continuation replays the exact same
+    trajectory through the real CLI."""
+    from rl_scheduler_tpu.agent import train_ppo as cli
+
+    common = PPO_COMMON + ["--run-root", str(tmp_path)]
+    cli.main(common + ["--run-name", "full", "--iterations", "4"])
+    monkeypatch.setenv("GRAFTGUARD_PREEMPT_AFTER", "2")
+    cli.main(common + ["--run-name", "cut", "--iterations", "4"])
+    monkeypatch.delenv("GRAFTGUARD_PREEMPT_AFTER")
+    # The preempted run stopped at its step-2 checkpoint...
+    mgr = CheckpointManager(tmp_path / "cut")
+    assert mgr.latest_verified_step() == 2
+    mgr.close()
+    # ...and the resumed continuation reaches 4 with identical params.
+    cli.main(common + ["--run-name", "cut", "--iterations", "4", "--resume"])
+    leaves_full = _ppo_cli_params(tmp_path / "full", 4)
+    leaves_cut = _ppo_cli_params(tmp_path / "cut", 4)
+    assert len(leaves_full) == len(leaves_cut)
+    for a, b in zip(leaves_full, leaves_cut):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _dqn_setup():
+    from rl_scheduler_tpu.agent.dqn import DQNConfig, make_dqn
+    from rl_scheduler_tpu.env.bundle import single_cluster_bundle
+
+    bundle = single_cluster_bundle()
+    cfg = DQNConfig(num_envs=2, collect_steps=4, buffer_size=64,
+                    batch_size=8, learning_starts=4)
+    return bundle, cfg, make_dqn(bundle, cfg)
+
+
+def _dqn_tree_fn(runner):
+    return {
+        "params": runner.params,
+        "target_params": runner.target_params,
+        "opt_state": runner.opt_state,
+        "loop": {
+            "buffer": runner.buffer._asdict(),
+            "env_state": runner.env_state,
+            "obs": runner.obs,
+            "key": runner.key,
+            "env_steps": runner.env_steps,
+            "ep_return": runner.ep_return,
+            "last_episode_return": runner.last_episode_return,
+        },
+    }
+
+
+def test_dqn_interrupt_resume_bitwise(tmp_path):
+    """Same guarantee for DQN at the API level: the full-state tree
+    includes the REPLAY BUFFER, so the resumed learner samples the exact
+    minibatches the uninterrupted run would have."""
+    from rl_scheduler_tpu.agent.dqn import dqn_train
+
+    bundle, cfg, (init_fn, _, _) = _dqn_setup()
+    runner_full, _ = dqn_train(bundle, cfg, 6, seed=1)
+
+    mgr = CheckpointManager(tmp_path)
+    fn = make_periodic_checkpoint_fn(mgr, every=3, total_iterations=6,
+                                     tree_fn=_dqn_tree_fn, extras={})
+    guard = preempt_after(3)
+    dqn_train(bundle, cfg, 6, seed=1, checkpoint_fn=fn, preemption=guard)
+    assert guard.stopped_at == 2  # iterations 1-3 done (0-indexed last=2)
+    mgr.close()
+
+    fresh = CheckpointManager(tmp_path)
+    step = fresh.latest_verified_step()
+    assert step == 3
+    abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(1))
+    target = {"params": abstract.params,
+              "target_params": abstract.target_params,
+              "opt_state": abstract.opt_state,
+              "loop": {"buffer": abstract.buffer._asdict(),
+                       "env_state": abstract.env_state,
+                       "obs": abstract.obs,
+                       "key": abstract.key,
+                       "env_steps": abstract.env_steps,
+                       "ep_return": abstract.ep_return,
+                       "last_episode_return": abstract.last_episode_return}}
+    tree, _ = fresh.restore(step, target=target)
+    fresh.close()
+    runner_resumed, _ = dqn_train(bundle, cfg, 6, seed=1,
+                                  restore=(tree, step))
+    for a, b in zip(jax.tree_util.tree_leaves(runner_full.params),
+                    jax.tree_util.tree_leaves(runner_resumed.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------ telemetry under attack
+
+
+class StubProm:
+    """PrometheusCpu with the HTTP layer replaced by the fault seam +
+    a constant reading — the breaker/retry/fallback logic is the code
+    under test, not urllib."""
+
+    def __new__(cls, *a, **k):
+        from rl_scheduler_tpu.scheduler.telemetry import PrometheusCpu
+
+        class _Stub(PrometheusCpu):
+            def _query_one(self, base_url):
+                if self.fault_plan is not None:
+                    self.fault_plan.check("telemetry.scrape", TimeoutError)
+                return 0.42
+
+        return _Stub(*a, **k)
+
+
+def test_scrape_timeouts_fall_back_and_trip_breaker():
+    clock = FakeClock()
+    # Calls 1-4: the first refresh's two clouds x two retry attempts all
+    # time out; everything after (the recovery probes) succeeds.
+    plan = FaultPlan(schedule={"telemetry.scrape": (1, 2, 3, 4)})
+    cpu = StubProm(
+        fault_plan=plan,
+        retry=RetryPolicy(max_attempts=2, base_delay_s=0.0, jitter=0.0,
+                          sleep=lambda _s: None),
+        breakers={c: CircuitBreaker(name=f"prometheus_{c}",
+                                    failure_threshold=1,
+                                    reset_timeout_s=10.0, clock=clock)
+                  for c in ("aws", "azure")},
+    )
+    cpu._refresh()  # both endpoints fail (2 retries each) -> both open
+    assert plan.fired["telemetry.scrape"] >= 2
+    assert all(b.state == "open" for b in cpu.breakers.values())
+    a, b = cpu.sample()
+    assert 0.0 <= a <= 1.0 and 0.0 <= b <= 1.0  # fallback values, no block
+    consults_before = plan.calls["telemetry.scrape"]
+    cpu._refresh()  # breakers open: no HTTP attempt at all
+    assert plan.calls["telemetry.scrape"] == consults_before
+    # Cool-down passes; the plan's schedule is exhausted -> probes heal.
+    clock.advance(10.1)
+    cpu._refresh()
+    assert all(b.state == "closed" for b in cpu.breakers.values())
+    assert cpu._cached == (0.42, 0.42)
+
+
+def test_scrape_breakers_are_per_endpoint():
+    """One dead endpoint must neither have its failure streak reset by
+    the healthy one (the shared-breaker bug: it would never open) nor,
+    once open, refuse the healthy endpoint's scrapes."""
+    clock = FakeClock()
+    # Odd consults = aws (the refresh loop queries aws first): aws times
+    # out every refresh, azure always succeeds.
+    plan = FaultPlan(schedule={"telemetry.scrape": (1, 3, 5)})
+    cpu = StubProm(
+        fault_plan=plan,
+        retry=RetryPolicy(max_attempts=1, sleep=lambda _s: None),
+        breakers={c: CircuitBreaker(name=f"prometheus_{c}",
+                                    failure_threshold=3,
+                                    reset_timeout_s=10.0, clock=clock)
+                  for c in ("aws", "azure")},
+    )
+    for _ in range(3):
+        cpu._refresh()
+    assert cpu.breakers["aws"].state == "open"
+    assert cpu.breakers["azure"].state == "closed"
+    # The healthy endpoint keeps scraping real values past the open peer.
+    cpu._refresh()
+    assert cpu._cached[1] == 0.42
+
+
+# ------------------------------------------------- kube API under attack
+
+
+class StubPlacer:
+    """DryRunPodPlacer with the kube client call replaced by the fault
+    seam (no kubernetes package in the container)."""
+
+    def __new__(cls, *a, **k):
+        from rl_scheduler_tpu.scheduler.k8s_client import DryRunPodPlacer
+
+        class _Stub(DryRunPodPlacer):
+            def _load_clients(self):
+                self._clients = {"aws": object(), "azure": object()}
+
+            def _create_pod(self, v1, cloud, dry_run):
+                if self.fault_plan is not None:
+                    self.fault_plan.check("k8s.place", ConnectionError)
+
+        return _Stub(*a, **k)
+
+
+def test_k8s_5xx_retried_then_succeeds():
+    plan = FaultPlan(schedule={"k8s.place": (1,)})
+    placer = StubPlacer(
+        fault_plan=plan,
+        retry=RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0,
+                          sleep=lambda _s: None),
+    )
+    assert placer.place("aws") is True  # first attempt 503s, retry lands
+    assert plan.fired["k8s.place"] == 1
+    assert plan.calls["k8s.place"] == 2
+    assert placer.breakers["aws"].state == "closed"
+
+
+def test_k8s_persistent_5xx_trips_breaker_and_skips_calls():
+    clock = FakeClock()
+    plan = FaultPlan(rates={"k8s.place": 1.0})
+    placer = StubPlacer(
+        fault_plan=plan,
+        retry=RetryPolicy(max_attempts=2, base_delay_s=0.0, jitter=0.0,
+                          sleep=lambda _s: None),
+        breakers={c: CircuitBreaker(name=f"k8s_{c}", failure_threshold=2,
+                                    reset_timeout_s=30.0, clock=clock)
+                  for c in ("aws", "azure")},
+    )
+    assert placer.place("aws") is False
+    assert placer.place("aws") is False
+    assert placer.breakers["aws"].state == "open"
+    consults = plan.calls["k8s.place"]
+    assert placer.place("aws") is False  # refused pre-call
+    assert plan.calls["k8s.place"] == consults
+    # Per-cloud isolation: the open aws breaker must not refuse azure —
+    # and azure's single failure must not be polluted by aws's streak.
+    assert placer.place("azure") is False
+    assert plan.calls["k8s.place"] > consults
+    assert placer.breakers["azure"].state == "closed"
+
+
+# --------------------------------------------- extender backend breaker
+
+
+class FaultyBackend:
+    name = "chaos"
+    family = "cloud"
+
+    def __init__(self, plan):
+        self.plan = plan
+
+    def decide(self, obs):
+        self.plan.check("backend.decide", RuntimeError)
+        return 1, np.array([0.0, 1.0], np.float32)
+
+
+def _telemetry():
+    from rl_scheduler_tpu.scheduler.telemetry import RandomCpu, TableTelemetry
+
+    return TableTelemetry.from_table(cpu_source=RandomCpu(seed=0))
+
+
+def test_backend_failures_fail_open_then_breaker_short_circuits():
+    from rl_scheduler_tpu.scheduler.extender import ExtenderPolicy
+
+    clock = FakeClock()
+    plan = FaultPlan(rates={"backend.decide": 1.0})
+    policy = ExtenderPolicy(FaultyBackend(plan), _telemetry())
+    policy.backend_breaker = CircuitBreaker(
+        name="backend", failure_threshold=2, reset_timeout_s=10.0,
+        clock=clock)
+    args = {"nodenames": ["aws-node-1", "azure-node-1"]}
+    for _ in range(2):  # failures: fail-open passthrough, breaker counts
+        out = policy.filter(dict(args))
+        assert out["nodenames"] == args["nodenames"] and out["error"] == ""
+    assert policy.backend_breaker.state == "open"
+    consults = plan.calls["backend.decide"]
+    out = policy.filter(dict(args))  # breaker open: backend NOT invoked
+    assert out["nodenames"] == args["nodenames"]
+    assert plan.calls["backend.decide"] == consults
+    # Breaker state is a /stats read...
+    stats = policy.statistics()
+    assert stats["breakers"]["backend"]["state"] == "open"
+    assert stats["breakers"]["backend"]["opens_total"] == 1
+    # ...and a /metrics scrape (state code 2 = open).
+    text = policy.metrics_text()
+    assert 'circuit_state{breaker="backend"} 2' in text
+    assert 'circuit_opens_total{breaker="backend"} 1' in text
+
+
+def test_stats_exports_all_configured_breakers():
+    from rl_scheduler_tpu.scheduler.extender import ExtenderPolicy
+
+    plan = FaultPlan()
+    cpu = StubProm(fault_plan=None)
+    telemetry = _telemetry()
+    telemetry.cpu = cpu
+    placer = StubPlacer(fault_plan=plan)
+    policy = ExtenderPolicy(FaultyBackend(plan), telemetry, placer=placer)
+    names = set(policy.breakers())
+    assert names == {"backend", "prometheus_aws", "prometheus_azure",
+                     "k8s_aws", "k8s_azure"}
+    text = policy.metrics_text()
+    for name in names:
+        assert f'circuit_state{{breaker="{name}"}}' in text
+
+
+# ----------------------------------------------- flight recorder dumps
+
+
+def test_flight_recorder_dump_is_nonfatal_on_unwritable_dir(tmp_path):
+    from rl_scheduler_tpu.utils.flight_recorder import FlightRecorder
+
+    blocker = tmp_path / "blocker"
+    blocker.write_text("a file where the dump dir should be")
+    rec = FlightRecorder(path=blocker / "sub" / "dump.jsonl", manifest={})
+    # mkdir(parents=True) under a FILE raises; dump must swallow + log.
+    assert rec.dump("nan_inf", 3, detail="test") is False
+    assert rec.dump_count == 1, "failed attempts still count vs max_dumps"
+
+
+def test_flight_recorder_dump_still_works_normally(tmp_path):
+    from rl_scheduler_tpu.utils.flight_recorder import FlightRecorder
+
+    rec = FlightRecorder(path=tmp_path / "fr.jsonl", manifest={"run": "x"})
+    assert rec.dump("nan_inf", 0, detail="t") is True
+    lines = (tmp_path / "fr.jsonl").read_text().splitlines()
+    head = json.loads(lines[0])
+    assert head["kind"] == "manifest" and head["run"] == "x"
+
+
+# ------------------------------------------------------------ chaos soak
+
+
+def test_chaos_training_survives_combined_faults(tmp_path):
+    """The fast chaos gate: one PPO training run attacked with a
+    checkpoint write failure AND a torn write AND a preemption, all from
+    one seeded plan — training never crashes, the preempted state is
+    checkpointed, and restore lands on a VERIFIED step."""
+    from rl_scheduler_tpu.agent.ppo import PPOTrainConfig, ppo_train
+    from rl_scheduler_tpu.config import EnvConfig
+    from rl_scheduler_tpu.env import core as env_core
+
+    cfg = PPOTrainConfig(num_envs=4, rollout_steps=8, minibatch_size=16,
+                         num_epochs=2, rollout_impl="scan")
+    env_params = env_core.make_params(EnvConfig())
+    # Call-index bookkeeping: checkpoint.save is consulted once per save
+    # attempt (steps 1,2,3,4 -> calls 1-4); checkpoint.partial only on
+    # saves that DISPATCH (step 2's save raised first), so its calls are
+    # step1->1, step3->2, step4->3 — firing call 2 tears step 3.
+    plan = FaultPlan(schedule={
+        "checkpoint.save": (2,),      # step-2 save: write error (nonfatal)
+        "checkpoint.partial": (2,),   # step-3 save: torn write
+        "preempt": (5,),              # stop before the 5th dispatch
+    })
+    mgr = CheckpointManager(tmp_path / "run", fault_plan=plan)
+    fn = make_periodic_checkpoint_fn(
+        mgr, every=1, total_iterations=8,
+        tree_fn=lambda r: {"params": r.params, "opt_state": r.opt_state},
+        extras={})
+    guard = PreemptionGuard(simulated=lambda: plan.fires("preempt"))
+    runner, history = ppo_train(env_params, cfg, 8, seed=0,
+                                checkpoint_fn=fn, preemption=guard)
+    assert guard.stopped_at == 3, "preemption must stop after 4 iterations"
+    assert len(history) == 4
+    assert [s for s, _ in fn.failures] == [2], "write failure was nonfatal"
+    assert plan.fired["checkpoint.partial"] == 1
+    mgr.close()
+
+    fresh = CheckpointManager(tmp_path / "run")
+    step = fresh.latest_verified_step()
+    # Step 4 (the pre-preemption boundary) verified; the torn step 3
+    # would only surface (and quarantine) if 4 were ever damaged.
+    assert step == 4
+    tree, _ = fresh.restore(step)
+    assert all(math.isfinite(float(np.asarray(leaf).ravel()[0]))
+               for leaf in jax.tree_util.tree_leaves(tree["params"]))
+    fresh.close()
+
+
+@pytest.mark.slow
+def test_chaos_soak_random_rates(tmp_path):
+    """Soak variant (make chaos-soak): longer run, rate-based plan — the
+    fault pattern is still reproducible from the seed, but not hand
+    placed. Training must complete or stop cleanly, and at least one
+    verified checkpoint must survive whatever fired."""
+    from rl_scheduler_tpu.agent.ppo import PPOTrainConfig, ppo_train
+    from rl_scheduler_tpu.config import EnvConfig
+    from rl_scheduler_tpu.env import core as env_core
+
+    cfg = PPOTrainConfig(num_envs=4, rollout_steps=8, minibatch_size=16,
+                         num_epochs=2, rollout_impl="scan")
+    env_params = env_core.make_params(EnvConfig())
+    plan = FaultPlan(seed=11, rates={"checkpoint.save": 0.25,
+                                     "checkpoint.partial": 0.25})
+    mgr = CheckpointManager(tmp_path / "soak", fault_plan=plan)
+    fn = make_periodic_checkpoint_fn(
+        mgr, every=1, total_iterations=24,
+        tree_fn=lambda r: {"params": r.params, "opt_state": r.opt_state},
+        extras={})
+    ppo_train(env_params, cfg, 24, seed=0, checkpoint_fn=fn)
+    mgr.close()
+    assert plan.fired, "soak plan fired nothing — raise the rates"
+    fresh = CheckpointManager(tmp_path / "soak")
+    assert fresh.latest_verified_step() is not None
+    fresh.close()

@@ -148,6 +148,10 @@ class TestReseedMechanics:
         meta = json.loads(meta_file.read_text())
         del meta["seed"]
         meta_file.write_text(json.dumps(meta))
+        # A pre-seed-key run also predates integrity manifests; without
+        # this the edit above reads as a tampered file and graftguard
+        # (correctly) quarantines the step instead of restoring it.
+        (tmp_path / "leg" / "checkpoint_manifests" / "1.json").unlink()
 
         _run(tmp_path, "leg", ["--iterations", "2",
                                "--checkpoint-every", "1", "--resume"])

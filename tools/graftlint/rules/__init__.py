@@ -56,6 +56,7 @@ def load_rules() -> dict:
             metrics_loop,
             pallas_tiles,
             prng,
+            swallow,
             test_coverage,
             weak_types,
         )

@@ -1,0 +1,101 @@
+"""GL010 silent-exception-swallow in host-I/O paths.
+
+The failure-domain layer (graftguard, docs/robustness.md) only works if
+failures are OBSERVABLE: the reference's ``except: pass`` around its kube
+context lookup hid a naming bug for the repo's whole life (SURVEY.md —
+``kind-aws`` vs ``kind-kind-aws``), and a fallback that engages silently
+is indistinguishable from a healthy primary. In ``scheduler/`` and
+``utils/`` — the directories that own every host-I/O boundary
+(checkpoints, HTTP telemetry, kube API, dump files) — a handler that
+catches broadly (bare ``except``, ``except Exception``/``BaseException``)
+must either log what it swallowed or re-raise. Narrow handlers
+(``except ValueError``) stay unflagged: catching a SPECIFIC expected
+error silently is a deliberate parse-style pattern, not a black hole.
+
+"Logs" means: a call to a ``logging`` method (``logger.debug`` ...
+``.exception``), ``warnings.warn``, or ``print``; raising anything
+(including a translated exception) also satisfies the rule. Handlers
+inside nested function definitions are checked as part of this same walk
+(exception handling does not change jurisdiction with nesting).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.engine import LintContext, Module, dotted_last
+from tools.graftlint.rules import Rule, register
+
+# Broad exception type names: catching these without observation swallows
+# failures the author did not enumerate.
+_BROAD = frozenset({"Exception", "BaseException"})
+
+# Call names that make a swallow observable.
+_LOG_METHODS = frozenset({
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "log",
+})
+_LOG_CALLS = frozenset({"print"})
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare `except:`
+        return True
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    return any(dotted_last(x) in _BROAD for x in types)
+
+
+def _observes(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted_last(node.func)
+            if name in _LOG_CALLS:
+                return True
+            if isinstance(node.func, ast.Attribute) and name in _LOG_METHODS:
+                # The receiver must look like a logger: without this,
+                # math.log(x) or a metrics object's .error() would
+                # satisfy the rule while observing nothing. Covers
+                # logger/log/_log/self._logger/logging.getLogger(...)
+                # chains and warnings.warn.
+                value = node.func.value
+                if isinstance(value, ast.Call):
+                    value = value.func  # chained: logging.getLogger(...)
+                recv = (dotted_last(value) or "").lower()
+                if "log" in recv or recv == "warnings":
+                    return True
+    return False
+
+
+@register
+class SilentExceptionSwallow(Rule):
+    id = "GL010"
+    name = "silent-exception-swallow"
+    summary = ("broad except (bare/Exception/BaseException) in a "
+               "scheduler//utils/ host-I/O path that neither logs nor "
+               "re-raises")
+
+    # Directories owning the host-I/O boundaries this rule polices.
+    DIRS = frozenset({"scheduler", "utils"})
+
+    def check(self, module: Module, ctx: LintContext) -> Iterator:
+        # Same jurisdiction convention as GL007: match on the module's
+        # parent directory names (fixtures live under a matching subdir).
+        if not (self.DIRS & set(module.rel.split("/")[:-1])):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node) or _observes(node):
+                continue
+            shape = ("bare `except:`" if node.type is None
+                     else "broad `except Exception`")
+            yield self.finding(
+                module, node.lineno,
+                f"{shape} swallows the failure silently — log what was "
+                "caught (logger.*/warnings.warn) or re-raise; an invisible "
+                "fallback is indistinguishable from a healthy primary",
+            )
