@@ -8,7 +8,8 @@ PY ?= python
 # `train_ppo --profile-dir`) to summarize/check a real run.
 TRACE ?= tests/fixtures/traceview/fixture.trace.json.gz
 
-.PHONY: lint lint-json test tier1 trace-summary obs chaos chaos-soak
+.PHONY: lint lint-json test tier1 trace-summary obs chaos chaos-soak \
+        serve-pool serve-soak
 
 lint:
 	$(PY) -m tools.graftlint --check
@@ -38,3 +39,20 @@ chaos:
 
 chaos-soak:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_graftguard.py -q
+
+# graftserve (docs/serving.md): run the multi-worker pool locally —
+# WORKERS extender processes share PORT via SO_REUSEPORT behind a
+# supervisor whose aggregated /stats + /metrics live on PORT+1. Point
+# RUN at a checkpoint dir to serve a trained policy (default:
+# auto-discover, greedy fallback).
+WORKERS ?= 2
+PORT ?= 8787
+RUN ?=
+serve-pool:
+	$(PY) -m rl_scheduler_tpu.scheduler.extender --workers $(WORKERS) \
+		--port $(PORT) $(if $(RUN),--run $(RUN))
+
+# The pool soak gate: slow-marked tests driving the bench's --duration
+# mode through a live pool (tests/test_pool.py), next to `make chaos`.
+serve-soak:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_pool.py -q

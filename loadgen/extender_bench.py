@@ -10,7 +10,22 @@ Usage::
     python -m rl_scheduler_tpu.scheduler.extender --backend native --port 8787 &
     python loadgen/extender_bench.py --port 8787 --requests 2000 --threads 8
 
-Prints one JSON line with client p50/p90/p99 (ms) and achieved req/s.
+    # graftserve pool soak: fixed wall-clock duration, pool-wide reset
+    # and stats via the supervisor's control plane (docs/serving.md)
+    python -m rl_scheduler_tpu.scheduler.extender --workers 2 --port 8787 &
+    python loadgen/extender_bench.py --port 8787 --duration 60 --threads 8 \
+        --nodes 1024 --control-port 8788
+
+Prints ONE JSON result line (``schema_version`` 1) carrying ``workers``,
+``nodes``, ``concurrency`` and achieved ``req_per_sec`` alongside the
+client/server percentiles, so the driver can track serving performance
+across rounds the way ``BENCH_r*`` tracks training. Two modes:
+
+- ``--requests N`` (default): a fixed request count, as before.
+- ``--duration S``: a soak — every thread issues requests until the
+  wall-clock deadline; failures are counted instead of aborting the run
+  (a soak's job is to report errors, not die on the first one).
+
 Stdlib-only (no locust dependency) so it runs anywhere the extender does.
 """
 
@@ -20,9 +35,12 @@ import argparse
 import concurrent.futures
 import json
 import sys
+import threading
 import time
 import urllib.error
 import urllib.request
+
+SCHEMA_VERSION = 1
 
 
 def make_payload(i: int, num_nodes: int = 2) -> bytes:
@@ -41,10 +59,11 @@ def make_payload(i: int, num_nodes: int = 2) -> bytes:
     ).encode()
 
 
-def one_request(base: str, i: int, num_nodes: int = 2) -> float:
+def one_request(base: str, i: int, num_nodes: int = 2,
+                payload: bytes | None = None) -> float:
     path = "/filter" if i % 2 == 0 else "/prioritize"
     req = urllib.request.Request(
-        base + path, data=make_payload(i, num_nodes),
+        base + path, data=payload or make_payload(i, num_nodes),
         headers={"Content-Type": "application/json"},
     )
     t0 = time.perf_counter()
@@ -53,29 +72,87 @@ def one_request(base: str, i: int, num_nodes: int = 2) -> float:
     return (time.perf_counter() - t0) * 1000.0
 
 
+def _soak(base: str, duration_s: float, threads: int, num_nodes: int):
+    """Duration-based load: each thread loops until the deadline.
+
+    Payloads are prebuilt once (at N=1024 a node list is ~100 KB of
+    JSON; rebuilding per request would bench the CLIENT's json.dumps)
+    and reused round-robin so /filter and /prioritize both stay hot.
+    Returns ``(sorted_latencies_ms, wall_s, failures)``.
+    """
+    payloads = [make_payload(i, num_nodes) for i in range(16)]
+    deadline = time.perf_counter() + duration_s
+    latencies: list = []
+    failures = [0]
+    lock = threading.Lock()
+
+    def run(thread_id: int) -> None:
+        local: list = []
+        failed = 0
+        i = thread_id
+        while time.perf_counter() < deadline:
+            try:
+                local.append(one_request(base, i, num_nodes,
+                                         payloads[i % len(payloads)]))
+            except Exception:  # noqa: BLE001 - soak counts, never aborts
+                failed += 1
+            i += threads
+        with lock:
+            latencies.extend(local)
+            failures[0] += failed
+
+    t_start = time.perf_counter()
+    workers = [threading.Thread(target=run, args=(t,)) for t in range(threads)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()
+    return sorted(latencies), time.perf_counter() - t_start, failures[0]
+
+
+def _get_json(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
 def main(argv: list[str] | None = None) -> dict:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8787)
     p.add_argument("--requests", type=int, default=2000)
+    p.add_argument("--duration", type=float, default=None, metavar="S",
+                   help="soak mode: run for S wall-clock seconds instead "
+                        "of a fixed --requests count (failures are "
+                        "counted, not fatal)")
     p.add_argument("--threads", type=int, default=8)
     p.add_argument("--warmup", type=int, default=50)
     p.add_argument("--nodes", type=int, default=2,
                    help="candidate nodes per request (set-family serving "
                         "scores each one; 2 matches the two-cloud MLP)")
+    p.add_argument("--control-port", type=int, default=None,
+                   help="graftserve pool: the supervisor's control-plane "
+                        "port — /stats/reset fans out to EVERY worker "
+                        "(the data port resets only whichever worker the "
+                        "kernel hands that connection) and the reported "
+                        "server stats/worker count are pool-wide")
     args = p.parse_args(argv)
     if args.requests < 1:
         p.error("--requests must be >= 1")
+    if args.duration is not None and args.duration <= 0:
+        p.error("--duration must be a positive number of seconds")
     base = f"http://{args.host}:{args.port}"
+    control = (f"http://{args.host}:{args.control_port}"
+               if args.control_port is not None else base)
 
     for i in range(args.warmup):
         one_request(base, i, args.nodes)
     # Scope the server-side percentiles to THIS run: the latency ring
     # holds 4096 entries, so without a reset the reported p50/p99 mix in
-    # the preceding run's traffic (a round-4 measurement bug). Older
+    # the preceding run's traffic (a round-4 measurement bug). Against a
+    # pool, reset through the control plane so it fans out. Older
     # extender builds lack the endpoint — warn and report un-scoped
     # stats rather than aborting the bench.
-    reset_req = urllib.request.Request(base + "/stats/reset", data=b"{}",
+    reset_req = urllib.request.Request(control + "/stats/reset", data=b"{}",
                                        headers={"Content-Type": "application/json"})
     try:
         with urllib.request.urlopen(reset_req, timeout=10) as resp:
@@ -84,28 +161,56 @@ def main(argv: list[str] | None = None) -> dict:
         print("warning: server has no /stats/reset; server-side "
               "percentiles may include pre-run traffic", file=sys.stderr)
 
-    t_start = time.perf_counter()
-    with concurrent.futures.ThreadPoolExecutor(args.threads) as pool:
-        latencies = sorted(pool.map(
-            lambda i: one_request(base, i, args.nodes), range(args.requests)))
-    wall = time.perf_counter() - t_start
+    failures = 0
+    if args.duration is not None:
+        latencies, wall, failures = _soak(base, args.duration, args.threads,
+                                          args.nodes)
+        if not latencies:
+            raise SystemExit(
+                f"soak completed zero requests in {args.duration}s "
+                f"({failures} failures) — is the server up?"
+            )
+    else:
+        t_start = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(args.threads) as pool:
+            latencies = sorted(pool.map(
+                lambda i: one_request(base, i, args.nodes),
+                range(args.requests)))
+        wall = time.perf_counter() - t_start
 
     def pct(p_):
         return latencies[min(len(latencies) - 1, int(p_ * len(latencies)))]
 
-    with urllib.request.urlopen(base + "/stats", timeout=10) as resp:
-        server_stats = json.loads(resp.read())
+    # Worker count: the pool control plane knows it authoritatively;
+    # a pool WORKER's /healthz reports its pool size too; the classic
+    # single-process server reports neither -> 1.
+    try:
+        health = _get_json(control + "/healthz")
+    except Exception:  # noqa: BLE001 - health is advisory for the line
+        health = {}
+    workers = int(health.get("workers", 1))
+
+    server_stats = _get_json(control + "/stats")
+    server_latency = server_stats.get("latency", {})
 
     out = {
-        "requests": args.requests,
+        "schema_version": SCHEMA_VERSION,
+        "bench": "extender_serving",
+        "mode": "soak" if args.duration is not None else "count",
+        "workers": workers,
+        "nodes": args.nodes,
+        "concurrency": args.threads,
+        "requests": len(latencies),
         "threads": args.threads,
+        "duration_s": round(wall, 3),
+        "failures": failures,
         "client_p50_ms": round(pct(0.50), 3),
         "client_p90_ms": round(pct(0.90), 3),
         "client_p99_ms": round(pct(0.99), 3),
-        "req_per_sec": round(args.requests / wall, 1),
-        "server_p50_ms": server_stats["latency"]["p50_ms"],
-        "server_p99_ms": server_stats["latency"]["p99_ms"],
-        "backend": server_stats["backend"],
+        "req_per_sec": round(len(latencies) / wall, 1),
+        "server_p50_ms": server_latency.get("p50_ms"),
+        "server_p99_ms": server_latency.get("p99_ms"),
+        "backend": server_stats.get("backend"),
     }
     print(json.dumps(out))
     return out

@@ -289,11 +289,16 @@ class ExtenderPolicy:
                  node_capacity_cores: float = DEFAULT_NODE_CAPACITY_CORES,
                  price_replay: str = "counter",
                  price_replay_period_s: float = 300.0,
-                 max_score_nodes: int = 0):
+                 max_score_nodes: int = 0,
+                 price_counter=None):
         self.backend = backend
         self.family = getattr(backend, "family", "cloud")
         self.telemetry = telemetry
         self.node_capacity_cores = node_capacity_cores
+        # graftserve (scheduler/pool.py) sets this on pool workers so
+        # /healthz reports pool membership; None keeps the single-process
+        # health body byte-identical.
+        self.pool_info: dict | None = None
         # Candidate-list cap for the structured families — the same idea
         # as kube-scheduler's percentageOfNodesToScore: scoring cost per
         # request is O(cap) no matter how large the fleet's node list
@@ -321,10 +326,16 @@ class ExtenderPolicy:
 
             # The graph env replays RAW dollar prices, not the normalized
             # table. "counter" mirrors the env's per-step counter
-            # (process-local); "wallclock" derives the row from wall time
-            # so replicas/restarts agree — see RawPriceReplay.
+            # (process-local — unless a pool supervisor supplies a shared
+            # cross-process counter so all workers of one pool walk one
+            # trajectory); "wallclock" derives the row from wall time so
+            # replicas/restarts agree — see RawPriceReplay.
             self._price_replay = RawPriceReplay(
-                mode=price_replay, period_s=price_replay_period_s
+                mode=price_replay, period_s=price_replay_period_s,
+                # The pool supplies the shared counter unconditionally;
+                # wallclock derives its position from time and needs no
+                # coordination, so the seam only engages in counter mode.
+                counter=price_counter if price_replay == "counter" else None,
             )
         # Optional DryRunPodPlacer (slow-mode parity), wrapped so kube API
         # stalls can neither block responses nor exhaust threads.
@@ -610,8 +621,11 @@ class ExtenderPolicy:
         return out
 
     def health(self) -> dict:
-        return {"status": "ok", "backend": self.backend.name,
-                "family": self.family}
+        out = {"status": "ok", "backend": self.backend.name,
+               "family": self.family}
+        if self.pool_info is not None:
+            out.update(self.pool_info)
+        return out
 
     def statistics(self) -> dict:
         with self._lock:
@@ -796,9 +810,40 @@ class _Handler(BaseHTTPRequestHandler):
         logger.debug("%s " + fmt, self.address_string(), *log_args)
 
 
-def make_server(policy: ExtenderPolicy, host: str = "0.0.0.0", port: int = 8787):
+def make_server(policy: ExtenderPolicy, host: str = "0.0.0.0", port: int = 8787,
+                reuse_port: bool = False, inherited_socket=None):
+    """The extender's HTTP server. Two pool-worker variants (graftserve,
+    ``scheduler/pool.py``) share the handler stack unchanged:
+
+    - ``reuse_port=True``: bind our own listener with ``SO_REUSEPORT`` so
+      N worker processes share one port and the kernel balances
+      connections across them.
+    - ``inherited_socket``: skip bind/listen entirely and ``accept()`` on
+      a listener the supervisor bound before forking — the fallback where
+      ``SO_REUSEPORT`` is unavailable (pre-fork accept sharing).
+    """
     handler = type("BoundHandler", (_Handler,), {"policy": policy})
-    return ThreadingHTTPServer((host, port), handler)
+    if inherited_socket is not None:
+        server = ThreadingHTTPServer((host, port), handler,
+                                     bind_and_activate=False)
+        server.socket.close()  # the unbound placeholder from __init__
+        server.socket = inherited_socket
+        server.server_address = inherited_socket.getsockname()
+        return server
+    if not reuse_port:
+        return ThreadingHTTPServer((host, port), handler)
+    import socket as _socket
+
+    if not hasattr(_socket, "SO_REUSEPORT"):
+        raise ValueError("reuse_port=True: SO_REUSEPORT unavailable on "
+                         "this platform (the pool's inherit mode is the "
+                         "fallback)")
+    server = ThreadingHTTPServer((host, port), handler,
+                                 bind_and_activate=False)
+    server.socket.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEPORT, 1)
+    server.server_bind()
+    server.server_activate()
+    return server
 
 
 def build_policy(
@@ -815,8 +860,14 @@ def build_policy(
     price_replay_period_s: float = 300.0,
     warm_nodes: tuple | None = None,
     max_score_nodes: int = 0,
+    price_counter=None,
+    table_counter=None,
 ) -> ExtenderPolicy:
     """Assemble the serving stack: checkpoint -> backend -> telemetry.
+
+    ``price_counter``/``table_counter`` are graftserve's pool seams
+    (``scheduler/pool.SharedCounter``): cross-process replay positions so
+    every worker of one pool walks the single-process trajectory.
 
     Serves three checkpoint families: flat ``multi_cloud`` MLP/DQN runs
     (cloud-level decision), ``cluster_set`` set-transformer runs
@@ -927,7 +978,8 @@ def build_policy(
         backend_obj, _ = make_backend(backend, params_tree, hidden,
                                       serve_device, algo)
     cpu_source = PrometheusCpu() if prometheus else RandomCpu(seed=cpu_seed)
-    telemetry = TableTelemetry.from_table(data_path, cpu_source)
+    telemetry = TableTelemetry.from_table(data_path, cpu_source,
+                                          counter=table_counter)
     placer = None
     if dry_run_place:
         from rl_scheduler_tpu.scheduler.k8s_client import DryRunPodPlacer
@@ -937,7 +989,8 @@ def build_policy(
                             node_capacity_cores=node_capacity_cores,
                             price_replay=price_replay,
                             price_replay_period_s=price_replay_period_s,
-                            max_score_nodes=max_score_nodes)
+                            max_score_nodes=max_score_nodes,
+                            price_counter=price_counter)
     if max_score_nodes and policy.family not in ExtenderPolicy.STRUCTURED:
         # Same refuse-before-traffic rule as price_replay below: the flat
         # family scores per CLOUD (two logits however long the node list
@@ -961,6 +1014,26 @@ def build_policy(
     return policy
 
 
+def check_warm_nodes_served(policy: ExtenderPolicy,
+                            warm_nodes: tuple | None) -> None:
+    """Refuse a ``--warm-nodes`` request the built policy cannot honor:
+    the no-op (wrong checkpoint family / non-jax backend) AND the
+    silently-degraded case (a failed warm compile falls back to greedy,
+    family "cloud") — the operator asked for pre-compiled executables
+    and must not boot without them. Runs after ``build_policy`` in the
+    single-process CLI and inside every pool worker (graftserve), so a
+    pool cannot come up half-warmed either."""
+    if warm_nodes is not None and (
+            policy.family != "set" or policy.backend.name != "jax"):
+        raise SystemExit(
+            f"--warm-nodes applies to cluster_set checkpoints on "
+            f"--backend jax; the loaded policy serves family "
+            f"{policy.family!r} via backend {policy.backend.name!r} "
+            "(if you passed a set checkpoint with --backend jax, a warm "
+            "AOT compile failed — see the log above)"
+        )
+
+
 def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--backend", default="jax",
@@ -969,6 +1042,30 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--run-root", default=None)
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8787)
+    p.add_argument("--workers", type=int, default=None, metavar="N",
+                   help="graftserve pool mode: fork N worker processes "
+                        "sharing --port via SO_REUSEPORT (fork-after-bind "
+                        "inheritance where unavailable), with a supervisor "
+                        "that restarts dead workers and serves pool-wide "
+                        "aggregated /stats, /metrics, /stats/reset and "
+                        "/healthz on --control-port. Omit for the classic "
+                        "single-process server (docs/serving.md)")
+    p.add_argument("--control-port", type=int, default=None,
+                   help="pool mode only: port for the supervisor's "
+                        "aggregated control plane (default: --port + 1)")
+    p.add_argument("--control-host", default=None,
+                   help="pool mode only: bind address for the control "
+                        "plane (default: --host, so k8s probes and "
+                        "Prometheus reach it wherever the data plane is "
+                        "reachable; pass 127.0.0.1 to keep it "
+                        "operator-local)")
+    p.add_argument("--blas-threads", type=int, default=None, metavar="T",
+                   help="pool mode only: BLAS intra-op threads per worker "
+                        "(default: cores//workers, min 1 — worker "
+                        "processes are the parallelism, and leaving every "
+                        "worker a full per-core BLAS pool oversubscribes "
+                        "the host workers-fold; 0 leaves library "
+                        "defaults untouched)")
     p.add_argument("--serve-device", default="cpu",
                    help="XLA device for the jax backend: cpu (default; "
                         "single-obs serving is dispatch-bound) or tpu")
@@ -1045,36 +1142,65 @@ def main(argv: list[str] | None = None) -> None:
                 "positive"
             )
 
-    logging.basicConfig(level=logging.INFO)
-    try:
-        policy = build_policy(
-            args.backend, args.run, args.run_root,
-            prometheus=args.prometheus, dry_run_place=args.dry_run_place,
-            serve_device=args.serve_device,
-            node_capacity_cores=args.node_capacity_cores,
-            price_replay=args.price_replay,
-            price_replay_period_s=args.price_replay_period,
-            warm_nodes=warm_nodes,
-            max_score_nodes=args.max_score_nodes,
+    if args.workers is not None and args.workers < 1:
+        raise SystemExit(
+            f"--workers {args.workers}: pass at least 1 worker process "
+            "(omit the flag for the classic single-process server)"
         )
+    if args.control_port is not None and args.workers is None:
+        raise SystemExit(
+            "--control-port only applies to pool mode (pass --workers N); "
+            "the single-process server exposes /stats and /metrics on "
+            "--port itself"
+        )
+    if args.control_host is not None and args.workers is None:
+        raise SystemExit(
+            "--control-host only applies to pool mode (pass --workers N)"
+        )
+    if args.blas_threads is not None and args.workers is None:
+        raise SystemExit(
+            "--blas-threads only applies to pool mode (pass --workers N); "
+            "set OPENBLAS_NUM_THREADS/OMP_NUM_THREADS for the "
+            "single-process server"
+        )
+    if args.blas_threads is not None and args.blas_threads < 0:
+        raise SystemExit(
+            f"--blas-threads {args.blas_threads}: pass a positive count "
+            "or 0 to leave library defaults untouched"
+        )
+
+    logging.basicConfig(level=logging.INFO)
+    build_kwargs = dict(
+        backend=args.backend, run=args.run, run_root=args.run_root,
+        prometheus=args.prometheus, dry_run_place=args.dry_run_place,
+        serve_device=args.serve_device,
+        node_capacity_cores=args.node_capacity_cores,
+        price_replay=args.price_replay,
+        price_replay_period_s=args.price_replay_period,
+        warm_nodes=warm_nodes,
+        max_score_nodes=args.max_score_nodes,
+    )
+    if args.workers is not None:
+        # graftserve: the supervisor never builds a policy (workers each
+        # restore the checkpoint and compile their backend AFTER the
+        # fork, so the supervisor process stays jax-free and tiny); any
+        # build_policy refusal kills every worker identically and the
+        # pool reports it as a startup failure.
+        from rl_scheduler_tpu.scheduler.pool import run_pool
+
+        run_pool(build_kwargs, workers=args.workers, host=args.host,
+                 port=args.port, control_port=args.control_port,
+                 control_host=args.control_host,
+                 blas_threads=args.blas_threads)
+        return
+    try:
+        policy = build_policy(**build_kwargs)
     except ValueError as e:
         # build_policy refuses misconfigurations (explicitly-named
         # wrong-family checkpoint; --price-replay on a non-graph family)
         # with actionable messages — exit cleanly, not with a traceback.
         raise SystemExit(str(e))
-    if warm_nodes is not None and (
-            policy.family != "set" or policy.backend.name != "jax"):
-        # Refuse the no-op (wrong checkpoint family / non-jax backend)
-        # AND the silently-degraded case (a failed warm compile falls
-        # back to greedy, family "cloud") — the operator asked for
-        # pre-compiled executables and must not boot without them.
-        raise SystemExit(
-            f"--warm-nodes applies to cluster_set checkpoints on "
-            f"--backend jax; the loaded policy serves family "
-            f"{policy.family!r} via backend {policy.backend.name!r} "
-            "(if you passed a set checkpoint with --backend jax, a warm "
-            "AOT compile failed — see the log above)"
-        )
+    check_warm_nodes_served(policy, warm_nodes)
     server = make_server(policy, args.host, args.port)
     print(f"Scheduler extender serving on {args.host}:{args.port} "
           f"(backend={policy.backend.name})", flush=True)

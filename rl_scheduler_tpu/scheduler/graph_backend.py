@@ -133,7 +133,12 @@ class RawPriceReplay:
       replica sees a valid in-distribution price path — the rows are the
       same table — but their score trajectories differ). Pinned by
       ``tests/test_extender.py``; right for single-replica deployments
-      and training parity.
+      and training parity. A graftserve pool (``scheduler/pool.py``)
+      passes ``counter=`` — a cross-process ``SharedCounter`` — so every
+      worker of ONE pool advances the same position and the pool as a
+      whole walks exactly the trajectory a single process would
+      (cross-replica deployments keep the ``wallclock`` answer: separate
+      pools never share memory).
     - ``"wallclock"``: the row derives from wall time
       (``int(now / period_s) % T``), so restarts and ALL replicas agree
       on the current row with zero coordination. ``period_s`` is the
@@ -145,9 +150,17 @@ class RawPriceReplay:
 
     def __init__(self, prices: np.ndarray | None = None,
                  mode: str = "counter", period_s: float = 300.0,
-                 now_fn=None):
+                 now_fn=None, counter=None):
         if mode not in ("counter", "wallclock"):
             raise ValueError(f"unknown price replay mode {mode!r}")
+        if counter is not None and mode != "counter":
+            # Wallclock already agrees across processes with zero
+            # coordination; accepting a counter there would imply it
+            # drives the position when it never would.
+            raise ValueError(
+                f"price replay counter= only backs mode='counter' "
+                f"(got mode={mode!r})"
+            )
         if period_s <= 0:
             # Validate at construction for EVERY entry point: wallclock
             # divides by the period per request (0 -> ZeroDivisionError
@@ -164,6 +177,7 @@ class RawPriceReplay:
         self.mode = mode
         self._period = float(period_s)
         self._now = now_fn if now_fn is not None else time.time
+        self._counter = counter
         self._step = 0
         self._lock = threading.Lock()
 
@@ -171,6 +185,10 @@ class RawPriceReplay:
         """``(row [2], step_frac)`` at the current replay position."""
         if self.mode == "wallclock":
             idx = int(self._now() / self._period) % len(self.prices)
+        elif self._counter is not None:
+            # Pool-shared position: the counter's own cross-process lock
+            # makes the fetch-and-increment atomic across workers.
+            idx = self._counter.next_index() % len(self.prices)
         else:
             with self._lock:
                 idx = self._step % len(self.prices)

@@ -1,0 +1,1035 @@
+"""graftserve: the multi-worker serving plane for the scheduler extender.
+
+The extender's entire serving plane was one Python process: a trained
+N=1024 fleet policy answers at 16 ms p50 single-stream but queues to
+~160 ms at 8-way because the set-transformer numpy forward holds the GIL
+(docs/serving.md). Every piece below it was already built for a pool —
+the backends are stateless, ``--price-replay wallclock`` gives
+cross-replica agreement, and ``LatencyStats.merged_histogram`` pins how
+multi-worker scrapes sum — but nothing could run more than one serving
+core. This module is the missing plane:
+
+- :class:`ServingPool` forks ``N`` worker processes that each run the
+  EXISTING ``ThreadingHTTPServer`` + backend stack unchanged, sharing one
+  data port via ``SO_REUSEPORT`` (each worker binds its own listener; the
+  kernel load-balances connections). Where the option is unavailable the
+  pool falls back to binding once in the supervisor and letting the
+  forked workers ``accept()`` on the inherited socket — classic pre-fork
+  sharing, same semantics, no kernel hashing.
+- A lightweight **supervisor** restarts dead workers on the
+  ``utils/retry.RetryPolicy`` backoff schedule (deaths within the
+  stability window walk the exponential schedule; a worker that stays up
+  resets it; a slot that exhausts the schedule is marked failed so a
+  crash-looping misconfiguration cannot flap forever) and serves the
+  pool-wide control plane on its own port:
+
+  - ``GET /stats``      — decision counts summed, latency percentiles
+    derived from ``LatencyStats.merged_histogram`` (bucket sums are the
+    union stream's buckets; exact per-worker ring percentiles ride in the
+    ``workers`` array), shed/reroute fractions request-weighted.
+  - ``GET /metrics``    — ONE Prometheus histogram for the pool, summed
+    decision/opens counters, breaker state per boundary as the MAX across
+    workers (``CircuitBreaker.merge_snapshots``: "this dependency is down
+    anywhere" is one gauge), plus per-worker ``_pool_worker_*`` series
+    where per-worker identity matters (liveness, decision share).
+  - ``POST /stats/reset`` — fanned out to every worker (each clears its
+    percentile ring; lifetime histograms stay monotonic, as Prometheus
+    requires).
+  - ``GET /healthz``    — live worker count vs configured, restart total.
+
+- Workers publish snapshots to the supervisor over a **local control
+  socket** (AF_UNIX where available, else loopback TCP; newline-delimited
+  JSON both ways — stdlib only, matching the repo's zero-dependency
+  serving stack). The supervisor is the client: one ``snapshot``/``reset``
+  command per worker per scrape, so a wedged worker costs one timeout,
+  never the scrape.
+- :class:`SharedCounter` (``multiprocessing.Value``) backs the graph
+  family's ``--price-replay counter`` row position and the telemetry
+  table replay, so all workers of ONE pool walk the same trajectory a
+  single process would (cross-replica deployments keep the documented
+  ``wallclock`` answer — separate pools never share memory).
+
+The pool requires the ``fork`` start method (Linux): workers must inherit
+the policy factory, the shared counters, and (in fallback mode) the bound
+listener without pickling. Aggregation itself is pure functions over
+worker snapshot dicts (:func:`aggregate_stats`,
+:func:`aggregate_metrics`) so the semantics are unit-testable without
+processes.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import multiprocessing
+import os
+import signal
+import socket
+import tempfile
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from rl_scheduler_tpu.scheduler.extender import LatencyStats, make_server
+from rl_scheduler_tpu.utils.retry import CircuitBreaker, RetryPolicy
+
+logger = logging.getLogger(__name__)
+
+METRIC_PREFIX = "rl_scheduler_extender"
+SNAPSHOT_SCHEMA = 1
+_LISTEN_BACKLOG = 128
+
+
+class SharedCounter:
+    """Monotonic cross-process counter (``multiprocessing.Value``).
+
+    Duck-typed for ``RawPriceReplay(counter=...)`` and
+    ``TableTelemetry(counter=...)``: one ``next_index()`` per request,
+    under the Value's own cross-process lock. Stores the RAW monotonic
+    count — consumers apply their own ``% len(table)``, so one counter
+    can back tables of different lengths.
+    """
+
+    def __init__(self, ctx=None):
+        ctx = ctx or multiprocessing.get_context("fork")
+        self._val = ctx.Value("Q", 0)  # uint64: never wraps in practice
+
+    def next_index(self) -> int:
+        with self._val.get_lock():
+            idx = self._val.value
+            self._val.value = idx + 1
+            return idx
+
+    @property
+    def value(self) -> int:
+        with self._val.get_lock():
+            return int(self._val.value)
+
+
+class PoolShared:
+    """The cross-process state one pool's workers share: the graph
+    family's raw-price replay position and the telemetry table replay
+    position. Created by the supervisor BEFORE forking; each worker's
+    ``build_policy`` threads them into ``RawPriceReplay`` and
+    ``TableTelemetry`` so the pool walks one trajectory."""
+
+    def __init__(self, ctx=None):
+        ctx = ctx or multiprocessing.get_context("fork")
+        self.price_counter = SharedCounter(ctx)
+        self.table_counter = SharedCounter(ctx)
+
+
+# --------------------------------------------------------------- snapshots
+
+
+def worker_snapshot(policy, worker_id: int | None = None) -> dict:
+    """One worker's control-plane snapshot: the existing ``/stats`` body
+    (decision counts, ring percentiles, breakers, shed/reroute) plus the
+    raw lifetime histogram — the one piece ``/stats`` doesn't carry and
+    the only one that merges exactly across workers."""
+    cumulative, total_sum, count = policy.stats.histogram()
+    return {
+        "schema": SNAPSHOT_SCHEMA,
+        "worker_id": worker_id,
+        "pid": os.getpid(),
+        "stats": policy.statistics(),
+        "histogram": {
+            "cumulative": cumulative,
+            "sum": total_sum,
+            "count": count,
+        },
+    }
+
+
+class _HistogramView:
+    """Adapts a snapshot's histogram dict to the ``.histogram()`` shape
+    ``LatencyStats.merged_histogram`` consumes, so the pool aggregation
+    literally reuses the method that pinned the multi-worker scrape
+    story (extender.py)."""
+
+    def __init__(self, hist: dict):
+        self._hist = hist
+
+    def histogram(self):
+        return (
+            list(self._hist["cumulative"]),
+            float(self._hist["sum"]),
+            int(self._hist["count"]),
+        )
+
+
+def quantiles_from_histogram(cumulative: list, qs=(0.5, 0.9, 0.99)) -> dict:
+    """Prometheus ``histogram_quantile``-style estimates from cumulative
+    bucket counts over ``LatencyStats.BUCKETS``.
+
+    Linear interpolation inside the winning bucket; the first bucket
+    interpolates from 0, and a quantile landing in the +Inf bucket
+    reports the highest finite bound (exactly histogram_quantile's
+    behavior — the histogram carries no information above it). Ring
+    percentiles do not merge across workers; these do, because bucket
+    sums are the union stream's buckets (``merged_histogram``).
+    Returns ``{"p50_ms": ..., ...}`` keyed like ``percentiles_ms``.
+    """
+    bounds = LatencyStats.BUCKETS
+    count = cumulative[-1] if cumulative else 0
+    if count <= 0:
+        return {"count": 0}
+    out = {"count": int(count)}
+    for q in qs:
+        rank = q * count
+        idx = next(i for i, c in enumerate(cumulative) if c >= rank)
+        if idx >= len(bounds):  # +Inf bucket: no upper bound to lerp to
+            value = bounds[-1]
+        else:
+            lo = bounds[idx - 1] if idx > 0 else 0.0
+            hi = bounds[idx]
+            prev = cumulative[idx - 1] if idx > 0 else 0
+            span = cumulative[idx] - prev
+            frac = (rank - prev) / span if span > 0 else 1.0
+            value = lo + (hi - lo) * frac
+        out[f"p{int(q * 100)}_ms"] = round(value * 1e3, 4)
+    return out
+
+
+def _weighted_fraction(snapshots: list, key: str) -> float | None:
+    """Request-weighted pool fraction of a per-worker fraction gauge
+    (shed/reroute): each worker's fraction is over ITS lifetime
+    decisions, so the pool value weights by decision count. ``None``
+    when no worker reports the gauge (backend doesn't track it)."""
+    num = den = 0.0
+    seen = False
+    for snap in snapshots:
+        frac = snap["stats"].get(key)
+        if frac is None:
+            continue
+        seen = True
+        weight = sum(snap["stats"].get("decisions", {}).values())
+        num += frac * weight
+        den += weight
+    if not seen:
+        return None
+    return round(num / den, 4) if den else 0.0
+
+
+def _merged_breakers(snapshots: list) -> dict:
+    by_name: dict = {}
+    for snap in snapshots:
+        for name, breaker_snap in snap["stats"].get("breakers", {}).items():
+            by_name.setdefault(name, []).append(breaker_snap)
+    return {
+        name: CircuitBreaker.merge_snapshots(snaps)
+        for name, snaps in sorted(by_name.items())
+    }
+
+
+def _consensus(snapshots: list, key: str) -> str:
+    """One value when all workers agree; a sorted '/'-join when they
+    drifted (e.g. a respawned worker fell back to greedy on a corrupt
+    checkpoint) — divergence must be VISIBLE on the pool scrape, not
+    averaged away."""
+    values = sorted({str(s["stats"].get(key)) for s in snapshots})
+    return values[0] if len(values) == 1 else "/".join(values)
+
+
+def merge_worker_histograms(snapshots: list) -> tuple[list, float, int]:
+    """``LatencyStats.merged_histogram`` over snapshot dicts — the ONE
+    place the pool's union histogram is computed (``/stats`` and
+    ``/metrics`` must never drift)."""
+    return LatencyStats.merged_histogram(
+        [_HistogramView(s["histogram"]) for s in snapshots]
+    )
+
+
+def aggregate_stats(snapshots: list, pool: dict, merged=None) -> dict:
+    """The pool-wide ``GET /stats`` body from per-worker snapshots.
+
+    Decision counts sum; latency percentiles come from
+    ``LatencyStats.merged_histogram`` (lifetime — the only cross-worker
+    merge that is exact; each worker's reset-scoped ring percentiles ride
+    in ``workers[]``); shed/reroute fractions are request-weighted;
+    breakers merge per boundary via ``CircuitBreaker.merge_snapshots``.
+    ``merged`` lets a caller that already merged the histograms (the
+    ``/metrics`` exposition) share the computation.
+    """
+    merged_cum, merged_sum, merged_count = (
+        merged if merged is not None else merge_worker_histograms(snapshots)
+    )
+    decisions: dict = {}
+    for snap in snapshots:
+        for cloud, n in snap["stats"].get("decisions", {}).items():
+            decisions[cloud] = decisions.get(cloud, 0) + n
+    total = sum(decisions.values())
+    latency = quantiles_from_histogram(merged_cum)
+    latency["source"] = "merged_histogram"
+    latency["sum_seconds"] = round(merged_sum, 6)
+    out = {
+        "pool": dict(pool),
+        "backend": _consensus(snapshots, "backend") if snapshots else None,
+        "family": _consensus(snapshots, "family") if snapshots else None,
+        "decisions": decisions,
+        "choice_fractions": {
+            c: (n / total if total else 0.0) for c, n in decisions.items()
+        },
+        "latency": latency,
+        "breakers": _merged_breakers(snapshots),
+        "workers": [
+            {
+                "worker_id": s.get("worker_id"),
+                "pid": s.get("pid"),
+                "decisions_total": sum(
+                    s["stats"].get("decisions", {}).values()
+                ),
+                "latency": s["stats"].get("latency", {}),
+            }
+            for s in snapshots
+        ],
+    }
+    for key in ("shed_fraction", "reroute_fraction"):
+        frac = _weighted_fraction(snapshots, key)
+        if frac is not None:
+            out[key] = frac
+    dropped = [s["stats"]["placements_dropped"] for s in snapshots
+               if "placements_dropped" in s["stats"]]
+    if dropped:
+        out["placements_dropped"] = sum(dropped)
+    return out
+
+
+def aggregate_metrics(snapshots: list, pool: dict) -> str:
+    """Pool-wide Prometheus exposition: the SAME metric names the
+    single-process plane exports (one scrape config serves both), with
+    counters summed, ONE merged histogram, breaker state as the
+    per-boundary max, and ``_pool_*`` series carrying the per-worker
+    labels that matter (liveness, decision share, restarts)."""
+    p = METRIC_PREFIX
+    merged_cum, merged_sum, merged_count = merge_worker_histograms(snapshots)
+    stats = aggregate_stats(snapshots, pool,
+                            merged=(merged_cum, merged_sum, merged_count))
+    lines = [
+        f"# HELP {p}_decisions_total Placement decisions by cloud "
+        "(summed across pool workers).",
+        f"# TYPE {p}_decisions_total counter",
+    ]
+    for cloud, n in sorted(stats["decisions"].items()):
+        lines.append(f'{p}_decisions_total{{cloud="{cloud}"}} {n}')
+    lines += [
+        f"# HELP {p}_decision_latency_seconds Server-side decision "
+        "latency (merged across pool workers; lifetime histogram).",
+        f"# TYPE {p}_decision_latency_seconds histogram",
+    ]
+    bounds = [f"{b:g}" for b in LatencyStats.BUCKETS] + ["+Inf"]
+    for bound, c in zip(bounds, merged_cum or [0] * len(bounds)):
+        lines.append(
+            f'{p}_decision_latency_seconds_bucket{{le="{bound}"}} {c}'
+        )
+    lines.append(f"{p}_decision_latency_seconds_sum {merged_sum:.9g}")
+    lines.append(f"{p}_decision_latency_seconds_count {merged_count}")
+    for key, help_text in (
+        ("shed_fraction", "Pool request-weighted fraction served off the "
+                          "primary path by the load-aware backends."),
+        ("reroute_fraction", "Pool request-weighted fraction of "
+                             "latency-router decisions served host-side."),
+    ):
+        if key in stats:
+            lines += [
+                f"# HELP {p}_{key} {help_text}",
+                f"# TYPE {p}_{key} gauge",
+                f"{p}_{key} {stats[key]:.9g}",
+            ]
+    if "placements_dropped" in stats:
+        lines += [
+            f"# HELP {p}_placements_dropped_total Dry-run placements "
+            "dropped by the bounded async queues (pool total).",
+            f"# TYPE {p}_placements_dropped_total counter",
+            f"{p}_placements_dropped_total {stats['placements_dropped']}",
+        ]
+    breakers = stats["breakers"]
+    lines += [
+        f"# HELP {p}_circuit_state Circuit breaker state per host-I/O "
+        "boundary, MAX across workers (0=closed, 1=half_open, 2=open): "
+        "a dependency down anywhere in the pool shows here.",
+        f"# TYPE {p}_circuit_state gauge",
+    ]
+    for name, snap in breakers.items():
+        code = CircuitBreaker.STATE_CODES[snap["state"]]
+        lines.append(f'{p}_circuit_state{{breaker="{name}"}} {code}')
+    lines += [
+        f"# HELP {p}_circuit_opens_total Times each breaker tripped open "
+        "(summed across workers, lifetime).",
+        f"# TYPE {p}_circuit_opens_total counter",
+    ]
+    for name, snap in breakers.items():
+        lines.append(
+            f'{p}_circuit_opens_total{{breaker="{name}"}} '
+            f'{snap["opens_total"]}')
+    # Per-worker series: identity matters for liveness and load balance,
+    # nowhere else — everything above stays pool-scoped so dashboards
+    # built against the single-process plane keep working.
+    lines += [
+        f"# HELP {p}_pool_workers Configured worker count.",
+        f"# TYPE {p}_pool_workers gauge",
+        f"{p}_pool_workers {pool.get('workers', len(snapshots))}",
+        f"# HELP {p}_pool_workers_alive Workers that answered this scrape.",
+        f"# TYPE {p}_pool_workers_alive gauge",
+        f"{p}_pool_workers_alive {pool.get('alive', len(snapshots))}",
+        f"# HELP {p}_pool_restarts_total Dead workers restarted by the "
+        "supervisor (lifetime).",
+        f"# TYPE {p}_pool_restarts_total counter",
+        f"{p}_pool_restarts_total {pool.get('restarts_total', 0)}",
+        f"# HELP {p}_pool_worker_up Per-worker liveness (answered this "
+        "scrape).",
+        f"# TYPE {p}_pool_worker_up gauge",
+    ]
+    answered = {s.get("worker_id") for s in snapshots}
+    for worker_id in range(pool.get("workers", len(snapshots))):
+        lines.append(
+            f'{p}_pool_worker_up{{worker="{worker_id}"}} '
+            f"{1 if worker_id in answered else 0}")
+    lines += [
+        f"# HELP {p}_pool_worker_decisions_total Per-worker decision "
+        "share (kernel connection balancing is visible here).",
+        f"# TYPE {p}_pool_worker_decisions_total counter",
+    ]
+    for snap in snapshots:
+        n = sum(snap["stats"].get("decisions", {}).values())
+        lines.append(
+            f'{p}_pool_worker_decisions_total{{worker="{snap.get("worker_id")}"}} {n}')
+    lines += [
+        f"# HELP {p}_info Serving backend and decision family.",
+        f"# TYPE {p}_info gauge",
+        f'{p}_info{{backend="{stats["backend"]}",family="{stats["family"]}",'
+        f'workers="{pool.get("workers", len(snapshots))}"}} 1',
+    ]
+    return "\n".join(lines) + "\n"
+
+
+# ----------------------------------------------------------- control plane
+
+
+def _control_listener() -> tuple[socket.socket, str]:
+    """``(listener, address_spec)`` for the supervisor's control socket.
+
+    AF_UNIX under a private tempdir where the platform has it (one file,
+    no port exhaustion, filesystem permissions); loopback TCP otherwise.
+    The spec string (``unix:<path>`` / ``tcp:<host>:<port>``) is what
+    workers get — it survives fork trivially.
+    """
+    if hasattr(socket, "AF_UNIX"):
+        path = os.path.join(
+            tempfile.mkdtemp(prefix="graftserve-"), "control.sock"
+        )
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.bind(path)
+        sock.listen(_LISTEN_BACKLOG)
+        return sock, f"unix:{path}"
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind(("127.0.0.1", 0))
+    sock.listen(_LISTEN_BACKLOG)
+    host, port = sock.getsockname()
+    return sock, f"tcp:{host}:{port}"
+
+
+def _control_connect(spec: str) -> socket.socket:
+    kind, _, rest = spec.partition(":")
+    if kind == "unix":
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.connect(rest)
+        return sock
+    host, _, port = rest.rpartition(":")
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.connect((host, int(port)))
+    return sock
+
+
+def _send_line(sock: socket.socket, payload: dict) -> None:
+    sock.sendall(json.dumps(payload).encode() + b"\n")
+
+
+def _worker_control_loop(policy, server, sock, worker_id: int) -> None:
+    """Answer supervisor commands over the control connection; treat EOF
+    (or any socket error) as 'the supervisor is gone' and shut the
+    worker down — the supervisor owns the pool's lifecycle, and orphan
+    workers would hold the data port forever."""
+    try:
+        reader = sock.makefile("rb")
+        for line in reader:
+            try:
+                cmd = json.loads(line).get("cmd")
+            except (json.JSONDecodeError, AttributeError):
+                _send_line(sock, {"error": "bad command"})
+                continue
+            if cmd == "snapshot":
+                _send_line(sock, worker_snapshot(policy, worker_id))
+            elif cmd == "reset":
+                _send_line(sock, {"ok": True, **policy.reset_stats()})
+            elif cmd == "ping":
+                _send_line(sock, {"ok": True})
+            else:
+                _send_line(sock, {"error": f"unknown cmd {cmd!r}"})
+    except OSError:
+        pass  # connection torn down mid-command: same as EOF below
+    logger.info("worker %d lost its control connection; shutting down",
+                worker_id)
+    threading.Thread(target=server.shutdown, daemon=True).start()
+
+
+def _limit_blas_threads(n: int, worker_id: int):
+    """Clamp the worker's BLAS intra-op thread pools to ``n``.
+
+    With a worker pool, PROCESSES are the parallelism: the default
+    OpenBLAS pool (one thread per core, per worker) oversubscribes the
+    host N-fold and measurably LOSES even single-stream (2-thread
+    OpenBLAS: 124 ms/decide at N=1024 on this 2-core container vs 71 ms
+    pinned to 1 — pthread handoff costs more than the second core
+    brings; docs/serving.md). numpy is already loaded when the worker
+    forks, so the env vars are too late — threadpoolctl talks to the
+    loaded libraries' own set_num_threads APIs. Best-effort: without
+    threadpoolctl the worker logs and serves with library defaults.
+    Returns the controller (kept alive by the caller) or None.
+    """
+    try:
+        from threadpoolctl import threadpool_limits
+
+        limiter = threadpool_limits(limits=n)
+        logger.info("worker %d: BLAS pools limited to %d thread(s)",
+                    worker_id, n)
+        return limiter
+    except Exception:  # noqa: BLE001 - optional dependency / odd BLAS
+        logger.warning(
+            "worker %d: threadpoolctl unavailable; BLAS thread pools "
+            "keep library defaults — set OPENBLAS_NUM_THREADS/"
+            "OMP_NUM_THREADS before starting the pool to avoid "
+            "oversubscription", worker_id)
+        return None
+
+
+def _worker_main(worker_id: int, n_workers: int, policy_factory, shared,
+                 host: str, port: int, listener, reuse_port: bool,
+                 control_spec: str, blas_threads: int = 0) -> None:
+    """The forked worker body: build the policy, serve the data port
+    (own SO_REUSEPORT listener, or the inherited pre-fork socket), and
+    answer the supervisor's control commands. Any startup failure exits
+    nonzero — the supervisor sees the death and applies its backoff."""
+    # The supervisor's signal handlers were inherited across fork; the
+    # supervisor terminates workers explicitly, so default handlers are
+    # correct here (SIGTERM kills, exactly what the supervisor sends).
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    signal.signal(signal.SIGINT, signal.SIG_IGN)  # ^C goes to supervisor
+    limiter = _limit_blas_threads(blas_threads, worker_id) \
+        if blas_threads > 0 else None
+    try:
+        policy = policy_factory(worker_id, shared)
+        policy.pool_info = {"workers": n_workers, "worker_id": worker_id}
+        if reuse_port:
+            server = make_server(policy, host, port, reuse_port=True)
+            if listener is not None:
+                listener.close()  # the supervisor's startup placeholder
+        else:
+            server = make_server(policy, host, port,
+                                 inherited_socket=listener)
+        control = _control_connect(control_spec)
+        _send_line(control, {
+            "hello": True, "worker_id": worker_id, "pid": os.getpid(),
+            "port": server.server_address[1],
+        })
+    except Exception:
+        logger.exception("worker %d failed to start", worker_id)
+        raise SystemExit(1)
+    threading.Thread(
+        target=_worker_control_loop, args=(policy, server, control, worker_id),
+        daemon=True,
+    ).start()
+    try:
+        server.serve_forever()
+    finally:
+        control.close()
+        del limiter  # the BLAS clamp lives exactly as long as serving
+
+
+# -------------------------------------------------------------- supervisor
+
+
+class _WorkerSlot:
+    """Supervisor-side state for one worker index."""
+
+    def __init__(self, worker_id: int, backoff: list):
+        self.worker_id = worker_id
+        self.process = None
+        self.conn: socket.socket | None = None
+        self.conn_lock = threading.Lock()
+        self.deaths = 0
+        self.last_spawn = 0.0
+        self.failed = False
+        self.backoff = backoff  # RetryPolicy.delays() schedule
+
+    @property
+    def alive(self) -> bool:
+        return self.process is not None and self.process.is_alive()
+
+
+class ServingPool:
+    """Supervisor for a pool of extender worker processes (module doc).
+
+    ``policy_factory(worker_id, shared) -> ExtenderPolicy`` runs INSIDE
+    each forked worker (fork start method: no pickling), so checkpoint
+    restore and backend compiles happen per worker, off the supervisor.
+    ``mode``: ``"auto"`` picks SO_REUSEPORT when the platform has it,
+    ``"reuseport"``/``"inherit"`` force one (inherit is the fallback and
+    stays testable everywhere).
+    """
+
+    def __init__(self, policy_factory, workers: int, host: str = "0.0.0.0",
+                 port: int = 8787, control_host: str = "127.0.0.1",
+                 control_port: int | None = None, mode: str = "auto",
+                 restart_policy: RetryPolicy | None = None,
+                 stable_after_s: float = 30.0, poll_interval_s: float = 0.2,
+                 blas_threads: int | None = None):
+        if workers < 1:
+            raise ValueError(f"workers={workers}: pass at least 1")
+        if blas_threads is not None and blas_threads < 0:
+            raise ValueError(f"blas_threads={blas_threads}: pass a positive "
+                             "count, 0 to leave library defaults, or None "
+                             "for the cores//workers heuristic")
+        if mode not in ("auto", "reuseport", "inherit"):
+            raise ValueError(f"unknown pool mode {mode!r}")
+        ctx = multiprocessing.get_context("fork")
+        self._ctx = ctx
+        self.workers = workers
+        self.host, self.port = host, port
+        self.control_host = control_host
+        self.control_port = control_port
+        have_reuseport = hasattr(socket, "SO_REUSEPORT")
+        if mode == "reuseport" and not have_reuseport:
+            raise ValueError("SO_REUSEPORT unavailable on this platform "
+                             "(mode='auto' falls back to socket inheritance)")
+        self.reuse_port = (mode == "reuseport"
+                          or (mode == "auto" and have_reuseport))
+        self._factory = policy_factory
+        self.shared = PoolShared(ctx)
+        # One backoff schedule per slot, straight off RetryPolicy — the
+        # repo's single backoff implementation. Seeded per slot so the
+        # jitter is deterministic under test yet decorrelated across
+        # slots (simultaneous deaths don't respawn in lockstep).
+        restart_policy = restart_policy or RetryPolicy(
+            max_attempts=8, base_delay_s=0.5, max_delay_s=30.0, jitter=0.1,
+        )
+        self._slots = [
+            _WorkerSlot(i, RetryPolicy(
+                max_attempts=restart_policy.max_attempts,
+                base_delay_s=restart_policy.base_delay_s,
+                max_delay_s=restart_policy.max_delay_s,
+                jitter=restart_policy.jitter, seed=i,
+            ).delays())
+            for i in range(workers)
+        ]
+        self.stable_after_s = stable_after_s
+        self.poll_interval_s = poll_interval_s
+        # Worker processes ARE the pool's parallelism: the default gives
+        # each worker its fair share of cores for intra-op BLAS (min 1)
+        # instead of every worker spawning one thread per core and
+        # oversubscribing the host workers-fold (_limit_blas_threads).
+        if blas_threads is None:
+            blas_threads = max(1, (os.cpu_count() or 1) // workers)
+        self.blas_threads = blas_threads
+        self.restarts_total = 0
+        self._lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._listener: socket.socket | None = None
+        self._control_sock: socket.socket | None = None
+        self._control_spec = ""
+        self._http: ThreadingHTTPServer | None = None
+        self._threads: list = []
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self, ready_timeout_s: float = 60.0) -> None:
+        """Bind, fork all workers, wait until every worker has bound its
+        listener and connected to the control plane, then (in reuseport
+        mode) drop the supervisor's startup placeholder socket so the
+        kernel only balances across sockets a worker actually accepts
+        on. A failed start tears the partial pool down before raising —
+        orphaned non-daemon workers would otherwise hold the data port
+        and deadlock the supervisor's interpreter exit (multiprocessing
+        joins non-daemon children at atexit, while the workers only exit
+        on control EOF, i.e. after the supervisor is gone)."""
+        try:
+            self._start(ready_timeout_s)
+        except BaseException:
+            self.shutdown()
+            raise
+
+    def _start(self, ready_timeout_s: float = 60.0) -> None:
+        # Always bind in the supervisor first: it resolves port 0 once
+        # (every worker must share the SAME port) and holds the port so
+        # nothing steals it between worker spawns. In reuseport mode the
+        # placeholder never accepts and closes once the pool is ready.
+        self._listener = _make_data_listener(self.host, self.port,
+                                             self.reuse_port)
+        self.port = self._listener.getsockname()[1]
+        self._control_sock, self._control_spec = _control_listener()
+        accept_thread = threading.Thread(target=self._accept_control,
+                                         daemon=True)
+        accept_thread.start()
+        self._threads.append(accept_thread)
+        for slot in self._slots:
+            self._spawn(slot)
+        deadline = time.monotonic() + ready_timeout_s
+        connected = 0
+        while time.monotonic() < deadline:
+            with self._lock:
+                connected = sum(1 for s in self._slots if s.conn is not None)
+            if connected == self.workers:
+                break
+            if all(not s.alive for s in self._slots):
+                raise RuntimeError(
+                    "every pool worker died during startup — see worker "
+                    "logs (a build_policy refusal, e.g. a wrong-family "
+                    "checkpoint, kills all workers identically)"
+                )
+            time.sleep(0.02)
+        else:
+            raise RuntimeError(
+                f"pool not ready after {ready_timeout_s:.0f}s: "
+                f"{connected}/{self.workers} workers connected"
+            )
+        if self.reuse_port:
+            self._listener.close()
+            self._listener = None
+        monitor = threading.Thread(target=self._monitor, daemon=True)
+        monitor.start()
+        self._threads.append(monitor)
+        self._http = _make_control_server(
+            self, self.control_host,
+            self.port + 1 if self.control_port is None else self.control_port,
+        )
+        # The control plane serves on its own thread from the moment
+        # start() returns — embedders (tests, notebooks) must not need
+        # to dedicate a thread to serve_forever() just to be scrapeable.
+        http_thread = threading.Thread(target=self._http.serve_forever,
+                                       daemon=True)
+        http_thread.start()
+        self._threads.append(http_thread)
+
+    def serve_forever(self) -> None:
+        """Block until :meth:`shutdown` (the CLI's foreground loop)."""
+        self._shutdown.wait()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        if self._http is not None:
+            threading.Thread(target=self._http.shutdown,
+                             daemon=True).start()
+        for slot in self._slots:
+            proc = slot.process
+            if proc is not None and proc.is_alive():
+                proc.terminate()
+        for slot in self._slots:
+            proc = slot.process
+            if proc is not None:
+                proc.join(timeout=5.0)
+                if proc.is_alive():
+                    proc.kill()
+                    proc.join(timeout=5.0)
+            with slot.conn_lock:
+                if slot.conn is not None:
+                    slot.conn.close()
+                    slot.conn = None
+        for sock in (self._control_sock, self._listener):
+            if sock is not None:
+                sock.close()
+        if self._control_spec.startswith("unix:"):
+            path = self._control_spec[len("unix:"):]
+            for target in (path, os.path.dirname(path)):
+                try:
+                    os.remove(target) if target == path else os.rmdir(target)
+                except OSError:
+                    pass
+
+    @property
+    def control_address(self) -> tuple[str, int]:
+        return self._http.server_address[:2]
+
+    # ------------------------------------------------------------- workers
+
+    def _spawn(self, slot: _WorkerSlot) -> None:
+        slot.last_spawn = time.monotonic()
+        slot.process = self._ctx.Process(
+            target=_worker_main,
+            args=(slot.worker_id, self.workers, self._factory, self.shared,
+                  self.host, self.port, self._listener, self.reuse_port,
+                  self._control_spec, self.blas_threads),
+            daemon=False,
+            name=f"graftserve-worker-{slot.worker_id}",
+        )
+        slot.process.start()
+
+    def _accept_control(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = self._control_sock.accept()
+            except OSError:
+                return  # listener closed during shutdown
+            try:
+                conn.settimeout(5.0)
+                hello = json.loads(conn.makefile("rb").readline())
+                worker_id = int(hello["worker_id"])
+                if not 0 <= worker_id < len(self._slots):
+                    # Range check BEFORE indexing: on the loopback-TCP
+                    # fallback any local process can reach this listener,
+                    # and an IndexError here would kill the accept thread
+                    # for the pool's lifetime (restarted workers could
+                    # never rejoin); a negative id would silently alias
+                    # an existing slot.
+                    raise ValueError(f"worker_id {worker_id} out of range")
+                conn.settimeout(None)
+            except (OSError, ValueError, KeyError, TypeError):
+                logger.warning("dropping control connection with bad hello")
+                conn.close()
+                continue
+            with self._lock:
+                slot = self._slots[worker_id]
+                with slot.conn_lock:
+                    if slot.conn is not None:
+                        slot.conn.close()
+                    slot.conn = conn
+            logger.info("worker %d (pid %s) joined the control plane",
+                        worker_id, hello.get("pid"))
+
+    def _monitor(self) -> None:
+        """Restart dead workers on the slot's RetryPolicy backoff
+        schedule. A death after ``stable_after_s`` of uptime resets the
+        slot's position in the schedule (the crash was not a loop); a
+        slot that exhausts the schedule is marked failed and left down —
+        a crash-looping worker must not flap forever, and /healthz makes
+        the degradation visible. All slots failed ends the pool."""
+        while not self._shutdown.is_set():
+            time.sleep(self.poll_interval_s)
+            for slot in self._slots:
+                if slot.failed or slot.alive or self._shutdown.is_set():
+                    continue
+                uptime = time.monotonic() - slot.last_spawn
+                exitcode = (slot.process.exitcode
+                            if slot.process is not None else None)
+                with slot.conn_lock:
+                    if slot.conn is not None:
+                        slot.conn.close()
+                        slot.conn = None
+                if uptime >= self.stable_after_s:
+                    slot.deaths = 0
+                slot.deaths += 1
+                if slot.deaths > len(slot.backoff):
+                    slot.failed = True
+                    logger.error(
+                        "worker %d died %d times (last exitcode %s); "
+                        "restart schedule exhausted — slot marked failed",
+                        slot.worker_id, slot.deaths, exitcode)
+                    if all(s.failed for s in self._slots):
+                        logger.error("all pool workers failed; shutting "
+                                     "down the pool")
+                        threading.Thread(target=self.shutdown,
+                                         daemon=True).start()
+                        return
+                    continue
+                delay = slot.backoff[min(slot.deaths - 1,
+                                         len(slot.backoff) - 1)]
+                logger.warning(
+                    "worker %d died (exitcode %s, uptime %.1fs); "
+                    "restarting in %.2fs (death %d/%d)",
+                    slot.worker_id, exitcode, uptime, delay, slot.deaths,
+                    len(slot.backoff))
+                if self._shutdown.wait(delay):
+                    return
+                with self._lock:
+                    self.restarts_total += 1
+                self._spawn(slot)
+
+    # -------------------------------------------------------- control plane
+
+    def _command(self, slot: _WorkerSlot, cmd: str,
+                 timeout_s: float) -> dict | None:
+        with slot.conn_lock:
+            conn = slot.conn
+            if conn is None:
+                return None
+            try:
+                conn.settimeout(timeout_s)
+                _send_line(conn, {"cmd": cmd})
+                reader = conn.makefile("rb")
+                line = reader.readline()
+                conn.settimeout(None)
+                if not line:
+                    raise OSError("control EOF")
+                return json.loads(line)
+            except (OSError, ValueError):
+                logger.warning("worker %d control %s failed; dropping its "
+                               "connection", slot.worker_id, cmd)
+                conn.close()
+                slot.conn = None
+                return None
+
+    def _fanout(self, cmd: str, timeout_s: float) -> list:
+        """Issue ``cmd`` to every worker CONCURRENTLY (one thread per
+        slot): a wedged worker costs max one timeout, not one timeout
+        per wedged worker serially — a degraded pool is exactly when the
+        scrape must still fit inside Prometheus' scrape_timeout."""
+        results: list = [None] * len(self._slots)
+
+        def ask(i: int, slot: _WorkerSlot) -> None:
+            results[i] = self._command(slot, cmd, timeout_s)
+
+        threads = [threading.Thread(target=ask, args=(i, slot), daemon=True)
+                   for i, slot in enumerate(self._slots)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout_s + 1.0)
+        return results
+
+    def scrape(self, timeout_s: float = 2.0) -> list:
+        """Per-worker snapshots from every worker that answers — the
+        ground truth the aggregated endpoints are computed from (and the
+        same per-worker records the pool tests sum independently)."""
+        return [snap for snap in self._fanout("snapshot", timeout_s)
+                if snap is not None and "error" not in snap]
+
+    def reset_stats(self, timeout_s: float = 2.0) -> dict:
+        """Fan ``/stats/reset`` out to every worker; each clears its
+        percentile ring (decision counters and lifetime histograms stay,
+        exactly like the single-process endpoint)."""
+        acked = sum(1 for ack in self._fanout("reset", timeout_s)
+                    if (ack or {}).get("ok"))
+        return {"status": "reset", "workers": acked}
+
+    def status(self) -> dict:
+        alive = sum(1 for s in self._slots if s.alive)
+        with self._lock:
+            restarts = self.restarts_total
+        return {
+            "workers": self.workers,
+            "alive": alive,
+            "failed": sum(1 for s in self._slots if s.failed),
+            "restarts_total": restarts,
+            "mode": "reuseport" if self.reuse_port else "inherit",
+            "port": self.port,
+        }
+
+    def health(self) -> dict:
+        status = self.status()
+        status["status"] = ("ok" if status["alive"] == status["workers"]
+                            else "degraded")
+        return status
+
+
+def _make_data_listener(host: str, port: int,
+                        reuse_port: bool) -> socket.socket:
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    if reuse_port:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    sock.bind((host, port))
+    sock.listen(_LISTEN_BACKLOG)
+    return sock
+
+
+class _PoolHandler(BaseHTTPRequestHandler):
+    pool: ServingPool  # bound by _make_control_server
+
+    def _send(self, code: int, payload, content_type="application/json"):
+        body = (payload if isinstance(payload, bytes)
+                else json.dumps(payload).encode())
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        if self.path == "/healthz":
+            health = self.pool.health()
+            self._send(200 if health["status"] == "ok" else 503, health)
+        elif self.path == "/stats":
+            pool = self.pool.status()
+            snapshots = self.pool.scrape()
+            pool["responding"] = len(snapshots)
+            self._send(200, aggregate_stats(snapshots, pool))
+        elif self.path == "/metrics":
+            pool = self.pool.status()
+            snapshots = self.pool.scrape()
+            pool["alive"] = len(snapshots)
+            self._send(200, aggregate_metrics(snapshots, pool).encode(),
+                       content_type="text/plain; version=0.0.4; "
+                                    "charset=utf-8")
+        else:
+            self._send(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):  # noqa: N802
+        length = int(self.headers.get("Content-Length", 0))
+        self.rfile.read(length)  # drain; reset takes no arguments
+        if self.path == "/stats/reset":
+            self._send(200, self.pool.reset_stats())
+        else:
+            self._send(404, {"error": f"unknown path {self.path}"})
+
+    def log_message(self, fmt, *log_args):  # quiet, like the data plane
+        logger.debug("%s " + fmt, self.address_string(), *log_args)
+
+
+def _make_control_server(pool: ServingPool, host: str,
+                         port: int) -> ThreadingHTTPServer:
+    handler = type("BoundPoolHandler", (_PoolHandler,), {"pool": pool})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+# --------------------------------------------------------------- CLI glue
+
+
+def run_pool(build_kwargs: dict, workers: int, host: str, port: int,
+             control_port: int | None, control_host: str | None = None,
+             blas_threads: int | None = None) -> None:
+    """The ``--workers N`` entry point behind the extender CLI: wrap
+    ``build_policy`` into a per-worker factory (each worker restores the
+    checkpoint and compiles its own backend AFTER the fork — the
+    supervisor never imports jax), start the pool, serve until
+    SIGTERM/SIGINT."""
+
+    def factory(worker_id, shared):
+        from rl_scheduler_tpu.scheduler.extender import (
+            build_policy,
+            check_warm_nodes_served,
+        )
+
+        policy = build_policy(
+            **build_kwargs,
+            price_counter=shared.price_counter,
+            table_counter=shared.table_counter,
+        )
+        check_warm_nodes_served(policy, build_kwargs.get("warm_nodes"))
+        return policy
+
+    # The control plane follows the data plane's bind address by default:
+    # k8s probes and Prometheus reach both through the pod IP
+    # (k8s_manifests/extender-deployment.yaml) — a loopback-only control
+    # plane would leave the Deployment permanently unready.
+    pool = ServingPool(factory, workers=workers, host=host, port=port,
+                       control_host=control_host if control_host is not None
+                       else host,
+                       control_port=control_port, blas_threads=blas_threads)
+    pool.start()
+
+    def _stop(signum, frame):  # noqa: ARG001 (signal API)
+        threading.Thread(target=pool.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    status = pool.status()
+    print(
+        f"graftserve pool: {workers} worker(s) on {host}:{pool.port} "
+        f"({status['mode']}), control plane on "
+        f"{pool.control_address[0]}:{pool.control_address[1]}",
+        flush=True,
+    )
+    try:
+        pool.serve_forever()
+    finally:
+        pool.shutdown()

@@ -165,26 +165,41 @@ class TableTelemetry:
     A monotonically increasing decision counter indexes the table (mod its
     length) — the serving-side analogue of the env's ``step_idx``.
     Thread-safe: the extender server handles requests concurrently.
+
+    ``counter`` (graftserve, ``scheduler/pool.SharedCounter``) replaces
+    the process-local step with a cross-process position, so every worker
+    of one pool replays the single-process table trajectory — the same
+    seam ``RawPriceReplay`` has for the graph family's raw prices.
     """
 
-    def __init__(self, costs: np.ndarray, latencies: np.ndarray, cpu_source=None):
+    def __init__(self, costs: np.ndarray, latencies: np.ndarray,
+                 cpu_source=None, counter=None):
         self.costs = np.asarray(costs, np.float32)
         self.latencies = np.asarray(latencies, np.float32)
         self.cpu = cpu_source or RandomCpu()
+        self._counter = counter
         self._step = 0
         self._lock = threading.Lock()
 
     @classmethod
-    def from_table(cls, data_path: str | None = None, cpu_source=None):
+    def from_table(cls, data_path: str | None = None, cpu_source=None,
+                   counter=None):
         from rl_scheduler_tpu.data.loader import load_table
 
         table = load_table(data_path)
-        return cls(np.asarray(table.costs), np.asarray(table.latencies), cpu_source)
+        return cls(np.asarray(table.costs), np.asarray(table.latencies),
+                   cpu_source, counter=counter)
 
-    def observe(self) -> np.ndarray:
+    def _next_idx(self) -> int:
+        if self._counter is not None:
+            return self._counter.next_index() % len(self.costs)
         with self._lock:
             idx = self._step % len(self.costs)
             self._step += 1
+        return idx
+
+    def observe(self) -> np.ndarray:
+        idx = self._next_idx()
         cpu_aws, cpu_azure = self.cpu.sample()
         return np.concatenate(
             [self.costs[idx], self.latencies[idx], [cpu_aws, cpu_azure]]
@@ -202,9 +217,7 @@ class TableTelemetry:
         cross-cloud mean and ``cloud_id = 0.5``, so they score from neutral
         features instead of being special-cased out of the decision.
         """
-        with self._lock:
-            idx = self._step % len(self.costs)
-            self._step += 1
+        idx = self._next_idx()
         costs, lats = self.costs[idx], self.latencies[idx]
         cpus = np.asarray(self.cpu.sample(), np.float32)
         step_frac = idx / max(len(self.costs) - 1, 1)

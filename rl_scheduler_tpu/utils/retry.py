@@ -267,3 +267,33 @@ class CircuitBreaker:
 
     # Numeric encoding for the Prometheus gauge (docs/robustness.md).
     STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+    @classmethod
+    def merge_snapshots(cls, snapshots: list) -> dict:
+        """Pool-wide view of ONE breaker boundary across worker
+        processes (graftserve, ``scheduler/pool.py``): the state is the
+        MAX by :data:`STATE_CODES` — "this dependency is down anywhere
+        in the pool" must surface as one gauge, and a single open
+        breaker outranks any number of closed ones — while the lifetime
+        counters sum (each worker's counters are independent monotonic
+        streams, so their sum is the pool's monotonic stream) and
+        ``consecutive_failures`` reports the worst worker. The returned
+        dict has exactly :meth:`snapshot`'s shape, so every exporter
+        that renders single-process snapshots renders merged ones
+        unchanged."""
+        if not snapshots:
+            return {"state": cls.CLOSED, "consecutive_failures": 0,
+                    "failures_total": 0, "refusals_total": 0,
+                    "opens_total": 0}
+        return {
+            "state": max((s["state"] for s in snapshots),
+                         key=cls.STATE_CODES.__getitem__),
+            "consecutive_failures": max(
+                s.get("consecutive_failures", 0) for s in snapshots),
+            "failures_total": sum(
+                s.get("failures_total", 0) for s in snapshots),
+            "refusals_total": sum(
+                s.get("refusals_total", 0) for s in snapshots),
+            "opens_total": sum(
+                s.get("opens_total", 0) for s in snapshots),
+        }

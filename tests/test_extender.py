@@ -1404,7 +1404,8 @@ def test_price_replay_period_reaches_replay(monkeypatch):
 
         def __init__(self, backend, telemetry, placer=None,
                      node_capacity_cores=4.0, price_replay="counter",
-                     price_replay_period_s=300.0, max_score_nodes=0):
+                     price_replay_period_s=300.0, max_score_nodes=0,
+                     price_counter=None):
             captured["mode"] = price_replay
             captured["period"] = price_replay_period_s
 

@@ -1,0 +1,657 @@
+"""graftserve (scheduler/pool.py): the multi-worker serving plane.
+
+Aggregation semantics are pinned at two levels: pure-function tests feed
+synthetic per-worker snapshots to ``aggregate_stats``/``aggregate_metrics``
+(breaker max-merge, request-weighted fractions, merged-histogram
+quantiles), and end-to-end tests fork a real pool — SO_REUSEPORT workers
+plus the inherit fallback — and check the supervisor's ``/stats``,
+``/metrics``, ``/stats/reset`` fan-out, dead-worker restart, and the
+shared price-replay/table counters against single-process ground truth.
+Multi-process tests keep worker counts small and backoffs short so they
+stay inside the tier-1 budget; the bench-driven soak is marked ``slow``
+(``make serve-soak``).
+"""
+
+import json
+import os
+import signal
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from rl_scheduler_tpu.scheduler.extender import (
+    ExtenderPolicy,
+    LatencyStats,
+    make_server,
+)
+from rl_scheduler_tpu.scheduler.policy_backend import GreedyBackend
+from rl_scheduler_tpu.scheduler.pool import (
+    PoolShared,
+    ServingPool,
+    SharedCounter,
+    _HistogramView,
+    aggregate_metrics,
+    aggregate_stats,
+    quantiles_from_histogram,
+    worker_snapshot,
+)
+from rl_scheduler_tpu.scheduler.telemetry import RandomCpu, TableTelemetry
+from rl_scheduler_tpu.utils.retry import CircuitBreaker, RetryPolicy
+
+pytestmark = pytest.mark.skipif(
+    not hasattr(os, "fork"), reason="graftserve pools require fork"
+)
+
+FAST_RESTARTS = RetryPolicy(max_attempts=5, base_delay_s=0.05,
+                            max_delay_s=0.2, jitter=0.0)
+
+
+def _greedy_factory(worker_id, shared):
+    """The cheapest real policy: no checkpoint, no jax — safe to build
+    inside a forked test worker."""
+    telemetry = TableTelemetry.from_table(
+        cpu_source=RandomCpu(seed=0), counter=shared.table_counter
+    )
+    return ExtenderPolicy(GreedyBackend(), telemetry)
+
+
+def _post(port, path, payload, timeout=10):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.load(resp)
+
+
+def _get(port, path, timeout=10):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=timeout) as resp:
+        body = resp.read()
+    if resp.headers.get("Content-Type", "").startswith("application/json"):
+        return json.loads(body)
+    return body.decode()
+
+
+def _filter_args(i=0):
+    return {"nodenames": [f"aws-w{i}", f"azure-w{i}"], "pod": {}}
+
+
+def _make_pool(workers, **kwargs):
+    kwargs.setdefault("restart_policy", FAST_RESTARTS)
+    kwargs.setdefault("stable_after_s", 60.0)
+    kwargs.setdefault("poll_interval_s", 0.05)
+    pool = ServingPool(_greedy_factory, workers=workers, host="127.0.0.1",
+                       port=0, control_port=0, **kwargs)
+    pool.start(ready_timeout_s=60.0)
+    return pool
+
+
+# ------------------------------------------------------------ pure helpers
+
+
+def test_quantiles_from_histogram_bucket_semantics():
+    """histogram_quantile-style estimates: monotone, inside the winning
+    bucket's bounds, +Inf reports the highest finite bound, empty is
+    count 0."""
+    stats = LatencyStats()
+    for _ in range(100):
+        stats.record(0.0003)  # lands in the (0.25 ms, 0.5 ms] bucket
+    cumulative, _, _ = stats.histogram()
+    q = quantiles_from_histogram(cumulative)
+    assert q["count"] == 100
+    for key in ("p50_ms", "p90_ms", "p99_ms"):
+        assert 0.25 <= q[key] <= 0.5
+
+    stats = LatencyStats()
+    for v in (0.0002,) * 50 + (0.002,) * 40 + (5.0,) * 10:
+        stats.record(v)
+    cumulative, _, _ = stats.histogram()
+    q = quantiles_from_histogram(cumulative)
+    assert q["p50_ms"] <= q["p90_ms"] <= q["p99_ms"]
+    # 5 s sits beyond the last finite bound (1 s): the histogram carries
+    # no information above it, so p99 caps there — exactly
+    # histogram_quantile's behavior.
+    assert q["p99_ms"] == pytest.approx(1000.0)
+
+    assert quantiles_from_histogram([0] * (len(LatencyStats.BUCKETS) + 1)) \
+        == {"count": 0}
+
+
+def test_breaker_merge_snapshots_max_state_summed_counters():
+    """'A dependency is down ANYWHERE' is one gauge: merged state is the
+    max by STATE_CODES; lifetime counters sum; the dict keeps
+    snapshot()'s exact shape."""
+    healthy = CircuitBreaker(name="backend", failure_threshold=2)
+    healthy.record_success()
+    tripped = CircuitBreaker(name="backend", failure_threshold=2)
+    tripped.record_failure()
+    tripped.record_failure()  # trips open
+    assert tripped.state == CircuitBreaker.OPEN
+
+    merged = CircuitBreaker.merge_snapshots(
+        [healthy.snapshot(), tripped.snapshot()]
+    )
+    assert merged["state"] == CircuitBreaker.OPEN
+    assert merged["failures_total"] == 2
+    assert merged["opens_total"] == 1
+    assert set(merged) == set(healthy.snapshot())
+
+    # half_open outranks closed but not open
+    assert CircuitBreaker.merge_snapshots(
+        [{"state": "closed", "consecutive_failures": 0, "failures_total": 0,
+          "refusals_total": 0, "opens_total": 0},
+         {"state": "half_open", "consecutive_failures": 1,
+          "failures_total": 3, "refusals_total": 2, "opens_total": 1}]
+    )["state"] == "half_open"
+
+    assert CircuitBreaker.merge_snapshots([])["state"] == "closed"
+
+
+def _synthetic_snapshot(worker_id, decisions, latencies_s, shed=None,
+                        breakers=None):
+    stats = LatencyStats()
+    for v in latencies_s:
+        stats.record(v)
+    cumulative, total_sum, count = stats.histogram()
+    body = {
+        "backend": "cpu", "family": "set", "decisions": decisions,
+        "choice_fractions": {}, "latency": stats.percentiles_ms(),
+        "breakers": breakers or {},
+    }
+    if shed is not None:
+        body["shed_fraction"] = shed
+    return {
+        "schema": 1, "worker_id": worker_id, "pid": 1000 + worker_id,
+        "stats": body,
+        "histogram": {"cumulative": cumulative, "sum": total_sum,
+                      "count": count},
+    }, stats
+
+
+def test_aggregate_stats_merges_three_workers():
+    """Pool /stats over a 3-worker pool: decision counts sum, the latency
+    histogram equals ``LatencyStats.merged_histogram`` of the per-worker
+    records, shed fractions are request-weighted, and one worker's open
+    breaker dominates the pool view."""
+    open_breaker = {"state": "open", "consecutive_failures": 0,
+                    "failures_total": 5, "refusals_total": 7,
+                    "opens_total": 1}
+    closed_breaker = {"state": "closed", "consecutive_failures": 1,
+                      "failures_total": 1, "refusals_total": 0,
+                      "opens_total": 0}
+    snap_a, stats_a = _synthetic_snapshot(
+        0, {"aws": 8, "azure": 2}, [0.0002] * 10, shed=0.5,
+        breakers={"backend": closed_breaker})
+    snap_b, stats_b = _synthetic_snapshot(
+        1, {"aws": 5, "azure": 25}, [0.002] * 30, shed=0.0,
+        breakers={"backend": open_breaker})
+    snap_c, stats_c = _synthetic_snapshot(
+        2, {"aws": 0, "azure": 0}, [], breakers={"backend": closed_breaker})
+
+    out = aggregate_stats([snap_a, snap_b, snap_c],
+                          {"workers": 3, "alive": 3, "restarts_total": 0})
+    assert out["decisions"] == {"aws": 13, "azure": 27}
+    assert out["choice_fractions"]["aws"] == pytest.approx(13 / 40)
+
+    # merged histogram == union of the per-worker records (ground truth
+    # from the same per-worker scrapes, merged by the pinned method)
+    ref_cum, ref_sum, ref_count = LatencyStats.merged_histogram(
+        [stats_a, stats_b, stats_c])
+    assert out["latency"]["count"] == ref_count == 40
+    assert out["latency"]["source"] == "merged_histogram"
+    assert out["latency"]["sum_seconds"] == pytest.approx(ref_sum)
+
+    # request-weighted shed: (0.5*10 + 0.0*30) / 40
+    assert out["shed_fraction"] == pytest.approx(0.125)
+
+    # breaker max-merge: open anywhere -> open pool-wide, counters summed
+    assert out["breakers"]["backend"]["state"] == "open"
+    assert out["breakers"]["backend"]["failures_total"] == 7
+    assert out["breakers"]["backend"]["refusals_total"] == 7
+
+    assert [w["worker_id"] for w in out["workers"]] == [0, 1, 2]
+    assert out["backend"] == "cpu" and out["family"] == "set"
+
+
+def test_aggregate_metrics_exposition():
+    """Pool /metrics: ONE histogram whose buckets are the bucket-wise
+    sums of the per-worker cumulative counts, summed decision counters,
+    max-merged breaker gauge, and per-worker liveness/decision labels."""
+    snap_a, stats_a = _synthetic_snapshot(0, {"aws": 3}, [0.0002] * 3)
+    snap_b, stats_b = _synthetic_snapshot(1, {"azure": 4}, [0.02] * 4)
+    pool = {"workers": 3, "alive": 2, "restarts_total": 1}
+    text = aggregate_metrics([snap_a, snap_b], pool)
+
+    ref_cum, ref_sum, ref_count = LatencyStats.merged_histogram(
+        [stats_a, stats_b])
+    got_buckets = [
+        int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+        if line.startswith("rl_scheduler_extender_decision_latency_seconds_bucket")
+    ]
+    assert got_buckets == ref_cum
+    assert f"rl_scheduler_extender_decision_latency_seconds_count {ref_count}" in text
+    assert 'rl_scheduler_extender_decisions_total{cloud="aws"} 3' in text
+    assert 'rl_scheduler_extender_decisions_total{cloud="azure"} 4' in text
+    assert "rl_scheduler_extender_pool_workers 3" in text
+    assert "rl_scheduler_extender_pool_workers_alive 2" in text
+    assert "rl_scheduler_extender_pool_restarts_total 1" in text
+    # worker 2 never answered the scrape: visible, not silently absent
+    assert 'rl_scheduler_extender_pool_worker_up{worker="0"} 1' in text
+    assert 'rl_scheduler_extender_pool_worker_up{worker="2"} 0' in text
+    assert 'rl_scheduler_extender_pool_worker_decisions_total{worker="1"} 4' in text
+
+
+def test_worker_snapshot_round_trips_histogram():
+    """The control-plane snapshot carries exactly the worker's lifetime
+    histogram, and _HistogramView feeds it back to merged_histogram
+    unchanged — the pool aggregation literally reuses the pinned
+    method."""
+    telemetry = TableTelemetry.from_table(cpu_source=RandomCpu(seed=0))
+    policy = ExtenderPolicy(GreedyBackend(), telemetry)
+    for i in range(7):
+        policy.filter(_filter_args(i))
+    snap = worker_snapshot(policy, worker_id=4)
+    assert snap["worker_id"] == 4 and snap["pid"] == os.getpid()
+    assert _HistogramView(snap["histogram"]).histogram() == \
+        policy.stats.histogram()
+    merged = LatencyStats.merged_histogram(
+        [_HistogramView(snap["histogram"]), policy.stats])
+    assert merged[2] == 2 * snap["histogram"]["count"]
+
+
+# ----------------------------------------------------------- shared state
+
+
+def test_shared_counter_is_cross_process_atomic():
+    """Every index is handed out exactly once across processes."""
+    import multiprocessing
+
+    ctx = multiprocessing.get_context("fork")
+    counter = SharedCounter(ctx)
+    queue = ctx.Queue()
+
+    def worker():
+        queue.put([counter.next_index() for _ in range(200)])
+
+    procs = [ctx.Process(target=worker) for _ in range(3)]
+    for p in procs:
+        p.start()
+    seen = [i for _ in procs for i in queue.get(timeout=30)]
+    for p in procs:
+        p.join(timeout=30)
+    assert sorted(seen) == list(range(600))
+    assert counter.value == 600
+
+
+def _constant_cpu():
+    return RandomCpu(low=0.4, high=0.4, seed=0)  # uniform(0.4, 0.4) == 0.4
+
+
+def test_pool_price_counter_score_parity_graph_family():
+    """Satellite: all workers of one pool walk the SAME price trajectory
+    under ``--price-replay counter``. Two policies sharing the pool's
+    counter, serving an identical request stream interleaved, produce
+    exactly the score sequence one single-process policy produces —
+    request k scores identically no matter which worker serves it."""
+    import jax
+    import jax.numpy as jnp
+
+    from rl_scheduler_tpu.env.cluster_graph import build_topology
+    from rl_scheduler_tpu.models import GNNPolicy
+    from rl_scheduler_tpu.scheduler.graph_backend import NumpyGNNBackend
+
+    _, adj, _ = build_topology(8)
+    net = GNNPolicy.from_adjacency(adj, dim=64, depth=3)
+    tree = net.init(jax.random.PRNGKey(4), jnp.zeros((8, 7), jnp.float32))
+
+    shared = PoolShared()
+    clouds = ["aws", "aws", "azure", "azure"]
+    display = ["aws-a", "aws-b", "azure-a", "azure-b"]
+
+    def graph_policy(counter):
+        return ExtenderPolicy(
+            NumpyGNNBackend(tree),
+            TableTelemetry.from_table(cpu_source=_constant_cpu()),
+            price_replay="counter", price_counter=counter,
+        )
+
+    worker_a, worker_b = (graph_policy(shared.price_counter)
+                          for _ in range(2))
+    reference = graph_policy(None)  # process-local counter, same stream
+
+    pool_probs = [
+        (worker_a if k % 2 == 0 else worker_b)
+        .decide_graph(clouds, display, None, 0.25)[1]
+        for k in range(12)
+    ]
+    ref_probs = [reference.decide_graph(clouds, display, None, 0.25)[1]
+                 for _ in range(12)]
+    for pooled, ref in zip(pool_probs, ref_probs):
+        np.testing.assert_array_equal(pooled, ref)
+    # The trajectory genuinely advanced — the pool consumed one shared
+    # position per request, and the price rows moved the distribution
+    # (otherwise the parity above would be vacuous).
+    assert shared.price_counter.value == 12
+    assert any(not np.array_equal(ref_probs[0], p) for p in ref_probs[1:])
+
+
+def test_pool_table_counter_score_parity_set_family():
+    """The normalized-table replay has the same pool seam: set-family
+    workers sharing the table counter reproduce the single-process
+    score sequence for an identical request stream."""
+    import jax
+    import jax.numpy as jnp
+
+    from rl_scheduler_tpu.models.transformer import SetTransformerPolicy
+    from rl_scheduler_tpu.scheduler.set_backend import NumpySetBackend
+
+    net = SetTransformerPolicy(dim=64, depth=2)
+    tree = net.init(jax.random.PRNGKey(3), jnp.zeros((8, 6), jnp.float32))
+
+    shared = PoolShared()
+    clouds = ["aws", "aws", "azure"]
+
+    def set_policy(counter):
+        return ExtenderPolicy(
+            NumpySetBackend(tree),
+            TableTelemetry.from_table(cpu_source=_constant_cpu(),
+                                      counter=counter),
+        )
+
+    worker_a = set_policy(shared.table_counter)
+    worker_b = set_policy(shared.table_counter)
+    reference = set_policy(None)
+
+    pool_probs = [
+        (worker_a if k % 2 == 0 else worker_b).decide_set(clouds, 0.25)[1]
+        for k in range(12)
+    ]
+    ref_probs = [reference.decide_set(clouds, 0.25)[1] for _ in range(12)]
+    for pooled, ref in zip(pool_probs, ref_probs):
+        np.testing.assert_array_equal(pooled, ref)
+    assert shared.table_counter.value == 12
+    assert any(not np.array_equal(ref_probs[0], p) for p in ref_probs[1:])
+
+
+def test_raw_price_replay_refuses_counter_with_wallclock():
+    from rl_scheduler_tpu.scheduler.graph_backend import RawPriceReplay
+
+    with pytest.raises(ValueError, match="counter"):
+        RawPriceReplay(np.ones((4, 2), np.float32), mode="wallclock",
+                       counter=SharedCounter())
+
+
+# ------------------------------------------------------------- end to end
+
+
+def test_pool_end_to_end_aggregation_reset_and_health():
+    """A real 3-worker pool: traffic through the shared data port, then
+    the supervisor's aggregated endpoints against per-worker-scrape
+    ground truth, /stats/reset fan-out (rings clear everywhere, lifetime
+    histograms don't), and /healthz live-worker reporting."""
+    pool = _make_pool(workers=3)
+    try:
+        cport = pool.control_address[1]
+        n_requests = 45
+        for i in range(n_requests):
+            result = _post(pool.port, "/filter", _filter_args(i))
+            assert len(result["nodenames"]) == 1
+
+        health = _get(cport, "/healthz")
+        assert health["status"] == "ok"
+        assert health["workers"] == 3 and health["alive"] == 3
+
+        # a pool worker's own /healthz names its pool membership
+        worker_health = _get(pool.port, "/healthz")
+        assert worker_health["workers"] == 3
+        assert worker_health["worker_id"] in (0, 1, 2)
+
+        # ground truth: per-worker scrapes, merged by the pinned method
+        snapshots = pool.scrape()
+        assert len(snapshots) == 3
+        ref_cum, ref_sum, ref_count = LatencyStats.merged_histogram(
+            [_HistogramView(s["histogram"]) for s in snapshots])
+        assert ref_count == n_requests
+
+        stats = _get(cport, "/stats")
+        assert sum(stats["decisions"].values()) == n_requests
+        assert stats["latency"]["count"] == n_requests
+        assert stats["latency"]["source"] == "merged_histogram"
+        assert stats["backend"] == "greedy" and stats["family"] == "cloud"
+        assert sum(w["decisions_total"] for w in stats["workers"]) \
+            == n_requests
+        assert "backend" in stats["breakers"]
+
+        metrics = _get(cport, "/metrics")
+        got_buckets = [
+            int(line.rsplit(" ", 1)[1]) for line in metrics.splitlines()
+            if line.startswith(
+                "rl_scheduler_extender_decision_latency_seconds_bucket")
+        ]
+        assert got_buckets == ref_cum
+        assert (f"rl_scheduler_extender_decision_latency_seconds_count "
+                f"{n_requests}") in metrics
+        assert 'rl_scheduler_extender_circuit_state{breaker="backend"} 0' \
+            in metrics
+        for worker_id in range(3):
+            assert (f'rl_scheduler_extender_pool_worker_up{{worker='
+                    f'"{worker_id}"}} 1') in metrics
+
+        # reset fans out: every worker's percentile ring clears, the
+        # lifetime histogram stays (Prometheus monotonicity)
+        reset = _post(cport, "/stats/reset", {})
+        assert reset == {"status": "reset", "workers": 3}
+        for snap in pool.scrape():
+            assert snap["stats"]["latency"]["count"] == 0
+        stats_after = _get(cport, "/stats")
+        assert stats_after["latency"]["count"] == n_requests  # lifetime
+        assert sum(stats_after["decisions"].values()) == n_requests
+
+        # a junk hello on the control listener (out-of-range worker_id,
+        # then raw garbage) must not kill the accept thread — the pool
+        # keeps scraping all workers afterwards
+        from rl_scheduler_tpu.scheduler.pool import _control_connect
+
+        for payload in (b'{"worker_id": 99}\n', b'not json\n'):
+            rogue = _control_connect(pool._control_spec)
+            rogue.sendall(payload)
+            rogue.close()
+        time.sleep(0.2)
+        assert len(pool.scrape()) == 3
+    finally:
+        pool.shutdown()
+
+
+def test_pool_restarts_dead_worker():
+    """The supervisor notices a SIGKILLed worker, restarts it on the
+    RetryPolicy backoff, and the control plane heals: /healthz reports
+    full strength again and the new worker answers scrapes."""
+    pool = _make_pool(workers=2)
+    try:
+        cport = pool.control_address[1]
+        pids = {s["pid"] for s in pool.scrape()}
+        assert len(pids) == 2
+        victim = sorted(pids)[0]
+        os.kill(victim, signal.SIGKILL)
+
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            try:
+                health = _get(cport, "/healthz")
+            except urllib.error.HTTPError:
+                health = None  # 503: degraded while the worker is down
+            if health is not None and health["alive"] == 2 \
+                    and health["restarts_total"] >= 1 \
+                    and len(pool.scrape()) == 2:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail(f"pool did not heal: {pool.status()}")
+
+        new_pids = {s["pid"] for s in pool.scrape()}
+        assert victim not in new_pids and len(new_pids) == 2
+
+        # the healed pool still serves (retry a few times: connections
+        # hashed to the dying socket during the window may be refused)
+        for attempt in range(20):
+            try:
+                result = _post(pool.port, "/filter", _filter_args(attempt))
+                break
+            except OSError:
+                time.sleep(0.1)
+        assert len(result["nodenames"]) == 1
+    finally:
+        pool.shutdown()
+
+
+def test_pool_inherit_fallback_mode():
+    """Where SO_REUSEPORT is unavailable the pool binds once and forks:
+    workers accept() on the inherited listener — same endpoints, same
+    aggregation, no kernel balancing required."""
+    pool = _make_pool(workers=2, mode="inherit")
+    try:
+        assert pool.status()["mode"] == "inherit"
+        for i in range(10):
+            result = _post(pool.port, "/filter", _filter_args(i))
+            assert len(result["nodenames"]) == 1
+        stats = _get(pool.control_address[1], "/stats")
+        assert sum(stats["decisions"].values()) == 10
+        assert stats["latency"]["count"] == 10
+    finally:
+        pool.shutdown()
+
+
+def _free_port():
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port
+
+
+def test_pool_cli_workers_flag_and_sigterm():
+    """``--workers 2`` through the real CLI: the supervisor forks the
+    pool, both planes answer, and SIGTERM shuts the whole tree down
+    cleanly (exit 0, port released)."""
+    import multiprocessing
+
+    from rl_scheduler_tpu.scheduler import extender as ext
+
+    ctx = multiprocessing.get_context("fork")
+    port, cport = _free_port(), _free_port()
+    proc = ctx.Process(target=ext.main, args=(
+        ["--workers", "2", "--backend", "greedy", "--host", "127.0.0.1",
+         "--port", str(port), "--control-port", str(cport)],))
+    proc.start()
+    try:
+        deadline = time.monotonic() + 60.0
+        health = None
+        while time.monotonic() < deadline:
+            try:
+                health = _get(cport, "/healthz", timeout=2)
+                if health["alive"] == 2:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.1)
+        assert health is not None and health["alive"] == 2, health
+        result = _post(port, "/filter", _filter_args())
+        assert len(result["nodenames"]) == 1
+        assert _get(port, "/healthz")["workers"] == 2
+
+        os.kill(proc.pid, signal.SIGTERM)
+        proc.join(timeout=30)
+        assert proc.exitcode == 0
+    finally:
+        if proc.is_alive():
+            proc.kill()
+            proc.join(timeout=10)
+
+
+def test_pool_cli_flag_validation():
+    from rl_scheduler_tpu.scheduler import extender as ext
+
+    with pytest.raises(SystemExit, match="at least 1"):
+        ext.main(["--workers", "0"])
+    with pytest.raises(SystemExit, match="pool mode"):
+        ext.main(["--control-port", "9999"])
+    with pytest.raises(SystemExit, match="pool mode"):
+        ext.main(["--blas-threads", "1"])
+    with pytest.raises(SystemExit, match="pool mode"):
+        ext.main(["--control-host", "0.0.0.0"])
+    with pytest.raises(SystemExit, match="positive"):
+        ext.main(["--workers", "2", "--blas-threads", "-1"])
+    with pytest.raises(ValueError, match="blas_threads"):
+        ServingPool(_greedy_factory, workers=2, blas_threads=-1)
+    # the heuristic splits cores across workers, never below 1
+    pool = ServingPool(_greedy_factory, workers=64)
+    assert pool.blas_threads == 1
+
+
+def test_make_server_reuse_port_two_listeners():
+    """Two make_server(reuse_port=True) servers share one port — the
+    primitive each pool worker uses to join the kernel's balancing
+    group."""
+    if not hasattr(socket, "SO_REUSEPORT"):
+        pytest.skip("no SO_REUSEPORT on this platform")
+    telemetry = TableTelemetry.from_table(cpu_source=RandomCpu(seed=0))
+    policy_a = ExtenderPolicy(GreedyBackend(), telemetry)
+    policy_b = ExtenderPolicy(GreedyBackend(), telemetry)
+    srv_a = make_server(policy_a, "127.0.0.1", 0, reuse_port=True)
+    port = srv_a.server_address[1]
+    srv_b = make_server(policy_b, "127.0.0.1", port, reuse_port=True)
+    threads = [threading.Thread(target=s.serve_forever, daemon=True)
+               for s in (srv_a, srv_b)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(12):
+            assert len(_post(port, "/filter", _filter_args(i))["nodenames"]) == 1
+        total = policy_a.stats.histogram()[2] + policy_b.stats.histogram()[2]
+        assert total == 12
+    finally:
+        srv_a.shutdown()
+        srv_b.shutdown()
+
+
+# ------------------------------------------------------------------- soak
+
+
+@pytest.mark.slow
+def test_pool_soak_via_bench():
+    """``make serve-soak``: the bench's --duration mode against a live
+    2-worker pool, pool-wide reset/stats via --control-port, zero
+    failures, schema-tagged result line."""
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "extender_bench",
+        Path(__file__).resolve().parents[1] / "loadgen" / "extender_bench.py",
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    pool = _make_pool(workers=2)
+    try:
+        out = bench.main([
+            "--port", str(pool.port), "--duration", "3", "--threads", "4",
+            "--warmup", "5", "--control-port",
+            str(pool.control_address[1]),
+        ])
+    finally:
+        pool.shutdown()
+    assert out["schema_version"] == 1
+    assert out["mode"] == "soak"
+    assert out["workers"] == 2
+    assert out["concurrency"] == 4
+    assert out["failures"] == 0
+    assert out["requests"] > 0 and out["req_per_sec"] > 0
+    assert out["server_p50_ms"] is not None
