@@ -9,7 +9,7 @@ PY ?= python
 TRACE ?= tests/fixtures/traceview/fixture.trace.json.gz
 
 .PHONY: lint lint-json test tier1 trace-summary obs chaos chaos-soak \
-        serve-pool serve-soak
+        serve-pool serve-soak eval-matrix scenario-bench
 
 lint:
 	$(PY) -m tools.graftlint --check
@@ -56,3 +56,20 @@ serve-pool:
 # mode through a live pool (tests/test_pool.py), next to `make chaos`.
 serve-soak:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_pool.py -q
+
+# graftscenario (docs/scenarios.md): the scenario x policy-family eval
+# matrix — one schema_version-tagged JSON line per cell to
+# results/scenario_matrix.jsonl + a summary grid. EPISODES sizes each
+# cell; point RUN at a cluster_set checkpoint to add it as a policy
+# column (MATRIX_ARGS for anything else, e.g. --best / --matrix-nodes).
+EPISODES ?= 32
+eval-matrix:
+	JAX_PLATFORMS=cpu $(PY) -m rl_scheduler_tpu.agent.evaluate --matrix \
+		--episodes $(EPISODES) $(if $(RUN),--run $(RUN)) $(MATRIX_ARGS)
+
+# Scenario throughput A/B vs the CSV replay (training path + env-step
+# microbench; BLAS pinned — the container's 2-thread default is measured
+# slower AND noisier for perf A/Bs).
+scenario-bench:
+	OPENBLAS_NUM_THREADS=1 OMP_NUM_THREADS=1 JAX_PLATFORMS=cpu \
+		$(PY) bench.py --scenario-bench

@@ -412,6 +412,7 @@ def dqn_train(
     restore: tuple[dict, int] | None = None,
     preemption: Any | None = None,
     on_preempt: Callable[[int, DQNRunnerState], None] | None = None,
+    on_eval: Callable[[int, DQNRunnerState, dict], None] | None = None,
 ):
     """Host-side training loop mirroring :func:`rl_scheduler_tpu.agent.ppo.ppo_train`.
 
@@ -487,7 +488,8 @@ def dqn_train(
             )
     update = make_update(update_fn, debug_checks, updates_per_dispatch)
     eval_hook = make_greedy_eval_hook(
-        bundle, net, cfg.eval_every, cfg.eval_episodes, seed, eval_log_fn
+        bundle, net, cfg.eval_every, cfg.eval_episodes, seed, eval_log_fn,
+        on_eval=on_eval,
     )
     return run_train_loop(
         update, runner, start_iteration, num_iterations,

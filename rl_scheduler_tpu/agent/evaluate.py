@@ -406,6 +406,132 @@ def structured_evaluate(env_name: str, bundle, net, params,
     )
 
 
+# ------------------------------------------ scenario × policy eval matrix
+
+MATRIX_SCHEMA_VERSION = 1
+
+
+def _matrix_cell_policies(scenario_name: str, columns: dict,
+                          node_feat: int, checkpoint: tuple | None) -> dict:
+    """``{policy_name: policy_fn}`` for one matrix row: the hand-coded
+    node baselines read THIS scenario's column layout (satellite fix —
+    a widened observation must not silently score the wrong column), and
+    a checkpoint policy joins only when its trained observation width
+    matches the scenario's (an incompatible cell is reported, not
+    silently scored on garbage features)."""
+    from rl_scheduler_tpu.env.baselines import structured_baselines
+
+    policies = dict(structured_baselines("cluster_set", columns=columns))
+    if checkpoint is not None:
+        net, params, ckpt_feat = checkpoint
+        if ckpt_feat == node_feat:
+            policies["checkpoint"] = greedy_policy_fn(net, params)
+        else:
+            policies["checkpoint"] = None  # incompatible: reported below
+    return policies
+
+
+def scenario_policy_matrix(
+    scenario_names: list,
+    num_nodes: int = 8,
+    episodes: int = 32,
+    seed: int = 0,
+    checkpoint: tuple | None = None,
+    emit: Callable[[dict], None] | None = None,
+) -> list[dict]:
+    """The scenario × policy-family eval matrix (ROADMAP item 5).
+
+    One cell per (scenario, policy): ``episodes`` full fixed-length
+    episodes through the scenario's vmapped bundle, every policy in a row
+    evaluated on the SAME seeded episode draws (paired comparison — one
+    ``PRNGKey(seed)`` per scenario, like ``structured_evaluate``'s
+    baseline convention). ``"csv"`` names the un-scenarioed CSV-replay
+    env, the baseline row every scenario is read against.
+
+    ``checkpoint`` is ``(net, params, node_feat)`` from a trained run;
+    cells whose scenario trains a different observation width record
+    ``"incompatible": true`` instead of a reward (the embed kernel bakes
+    the width — docs/scenarios.md).
+
+    Emits one bench-style ``schema_version``-tagged dict per cell through
+    ``emit`` (the CLI writes them as JSON lines) and returns them all.
+    """
+    import numpy as np
+
+    from rl_scheduler_tpu.scenarios import (
+        baseline_columns,
+        get_scenario,
+        node_feat_for,
+        scenario_bundle,
+    )
+
+    rows = []
+    for sname in scenario_names:
+        if sname == "csv":
+            from rl_scheduler_tpu.env import cluster_set as cs
+            from rl_scheduler_tpu.env.bundle import cluster_set_bundle
+
+            bundle = cluster_set_bundle(cs.make_params(num_nodes=num_nodes))
+            columns, feat = {"cost": 0, "cpu": 2}, cs.NODE_FEAT
+        else:
+            scn = get_scenario(sname)
+            bundle = scenario_bundle(scn, num_nodes)
+            columns, feat = baseline_columns(scn), node_feat_for(scn)
+        for pname, fn in _matrix_cell_policies(
+                sname, columns, feat, checkpoint).items():
+            cell = {
+                "schema_version": MATRIX_SCHEMA_VERSION,
+                "metric": "scenario_matrix_cell",
+                "scenario": sname,
+                "policy": pname,
+                "episodes": episodes,
+                "num_nodes": num_nodes,
+                "node_feat": feat,
+                "seed": seed,
+            }
+            if fn is None:
+                cell["incompatible"] = True
+                cell["note"] = (f"checkpoint trained at node_feat="
+                                f"{checkpoint[2]}, scenario observes {feat}")
+            else:
+                ep_rewards, _ = run_bundle_episodes(bundle, fn, episodes,
+                                                    seed)
+                ep = np.asarray(ep_rewards)
+                cell["reward_mean"] = round(float(ep.mean()), 3)
+                cell["reward_std"] = round(float(ep.std()), 3)
+            rows.append(cell)
+            if emit is not None:
+                emit(cell)
+    return rows
+
+
+def matrix_summary(rows: list) -> str:
+    """Human-readable grid of the matrix cells (policies × scenarios)."""
+    scenarios = list(dict.fromkeys(r["scenario"] for r in rows))
+    policies = list(dict.fromkeys(r["policy"] for r in rows))
+    cell = {(r["scenario"], r["policy"]): r for r in rows}
+    width = max(12, *(len(s) + 2 for s in scenarios))
+    lines = [
+        "=" * (16 + width * len(scenarios)),
+        "SCENARIO x POLICY EVAL MATRIX (mean episode reward)",
+        "=" * (16 + width * len(scenarios)),
+        " " * 16 + "".join(f"{s:>{width}}" for s in scenarios),
+    ]
+    for p in policies:
+        vals = []
+        for s in scenarios:
+            r = cell.get((s, p))
+            if r is None:
+                vals.append(f"{'-':>{width}}")
+            elif r.get("incompatible"):
+                vals.append(f"{'incompat.':>{width}}")
+            else:
+                vals.append(f"{r['reward_mean']:>{width}.1f}")
+        lines.append(f"{p:<16}" + "".join(vals))
+    lines.append("=" * (16 + width * len(scenarios)))
+    return "\n".join(lines)
+
+
 def _write_report(results_dir: Path, stem: str, report) -> None:
     """Write the ``<stem>.txt`` + ``<stem>.json`` artifact pair (shared by
     the flat and structured evaluation families)."""
@@ -417,19 +543,103 @@ def _write_report(results_dir: Path, stem: str, report) -> None:
     print(f"Report written to {results_dir}/{stem}.txt")
 
 
-def main(argv: list[str] | None = None) -> EvalReport | StructuredEvalReport:
+def _run_matrix(args) -> list:
+    """``--matrix`` mode: sweep scenarios × policy families, one JSON
+    line per cell to stdout AND <results-dir>/scenario_matrix.jsonl, then
+    the summary grid (``make eval-matrix``)."""
+    from rl_scheduler_tpu.scenarios import list_scenarios
+
+    names = (["csv"] + list_scenarios() if args.scenarios == "all"
+             else [s.strip() for s in args.scenarios.split(",") if s.strip()])
+    checkpoint = None
+    if args.run is not None or args.best:
+        from rl_scheduler_tpu.utils.checkpoint import (
+            find_latest_run,
+            load_policy_params,
+        )
+
+        run_dir = Path(args.run) if args.run else find_latest_run(args.run_root)
+        if args.best:
+            from rl_scheduler_tpu.agent.loop import BEST_DIR
+
+            best_dir = run_dir / BEST_DIR
+            if not (best_dir / "checkpoints").is_dir():
+                # Same friendly refusal as the non-matrix --best path.
+                raise SystemExit(
+                    f"--best: no best-eval checkpoint under {run_dir} "
+                    "(the keeper runs whenever training has --eval-every "
+                    "active)")
+            run_dir = best_dir
+        params, meta = load_policy_params(run_dir)
+        if meta.get("env") != "cluster_set":
+            raise SystemExit(
+                f"--matrix with --run: the matrix sweeps the set family; "
+                f"checkpoint {run_dir} trained env {meta.get('env')!r}")
+        from rl_scheduler_tpu.models import SetTransformerPolicy
+
+        num_heads = meta.get("num_heads")
+        if num_heads is None:
+            # Checkpoints from before num_heads was recorded were always
+            # 4-head (the same mandatory fallback as the --run eval path).
+            num_heads = 4
+        net = SetTransformerPolicy(dim=64, depth=2, num_heads=num_heads)
+        checkpoint = (net, params, meta.get("node_feat") or 6)
+        print(f"Matrix checkpoint column: {run_dir} "
+              f"(node_feat={checkpoint[2]})")
+
+    results_dir = Path(args.results_dir)
+    results_dir.mkdir(parents=True, exist_ok=True)
+    out_path = results_dir / "scenario_matrix.jsonl"
+    with out_path.open("w") as fh:
+        def emit(cell: dict) -> None:
+            line = json.dumps(cell)
+            print(line)
+            fh.write(line + "\n")
+
+        rows = scenario_policy_matrix(
+            names, num_nodes=args.matrix_nodes, episodes=args.episodes,
+            seed=args.seed, checkpoint=checkpoint, emit=emit)
+    summary = matrix_summary(rows)
+    print(summary)
+    (results_dir / "scenario_matrix.txt").write_text(summary + "\n")
+    print(f"Matrix written to {out_path}")
+    return rows
+
+
+def main(argv: list[str] | None = None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--run", default=None,
                    help="run directory (default: auto-discover newest)")
     p.add_argument("--run-root", default=RuntimeConfig().checkpoint_dir)
+    p.add_argument("--best", action="store_true",
+                   help="evaluate the run's BEST-in-training-eval "
+                        "checkpoint (<run>/best, kept whenever training "
+                        "ran with --eval-every) instead of the latest — "
+                        "the salvage path for late-degrade seeds "
+                        "(docs/scaling.md §1b)")
     p.add_argument("--episodes", type=int, default=100)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quick", action="store_true",
                    help="20-step per-step printout (eval_ppo.py parity)")
     p.add_argument("--baseline", choices=sorted(BASELINE_POLICIES), default=None,
                    help="evaluate a built-in baseline instead of a checkpoint")
+    p.add_argument("--matrix", action="store_true",
+                   help="emit the scenario x policy-family eval matrix "
+                        "(one schema_version-tagged JSON line per cell to "
+                        "<results-dir>/scenario_matrix.jsonl + a summary "
+                        "grid; docs/scenarios.md). --run adds the "
+                        "checkpoint as a policy column; --baseline/"
+                        "--quick do not apply")
+    p.add_argument("--scenarios", default="all",
+                   help="--matrix: comma-separated scenario names, or "
+                        "'all' (the registry + the csv baseline row)")
+    p.add_argument("--matrix-nodes", type=int, default=8,
+                   help="--matrix: node-set size each scenario builds")
     p.add_argument("--results-dir", default="results")
     args = p.parse_args(argv)
+
+    if args.matrix:
+        return _run_matrix(args)
 
     if args.baseline is not None:
         env_params = env_core.make_params(EnvConfig())
@@ -438,8 +648,21 @@ def main(argv: list[str] | None = None) -> EvalReport | StructuredEvalReport:
         from rl_scheduler_tpu.utils.checkpoint import find_latest_run, load_policy_params
 
         run_dir = Path(args.run) if args.run else find_latest_run(args.run_root)
+        if args.best:
+            from rl_scheduler_tpu.agent.loop import BEST_DIR
+
+            best_dir = run_dir / BEST_DIR
+            if not (best_dir / "checkpoints").is_dir():
+                raise SystemExit(
+                    f"--best: no best-eval checkpoint under {run_dir} "
+                    "(the keeper runs whenever training has --eval-every "
+                    "active)")
+            run_dir = best_dir
         print(f"Using checkpoint run: {run_dir}")
         params, meta = load_policy_params(run_dir)
+        if args.best and meta.get("best_eval") is not None:
+            print(f"Best-eval checkpoint: in-training eval "
+                  f"{meta['best_eval']:.2f} at its save point")
         ckpt_env = meta.get("env", "multi_cloud")
         if ckpt_env in ("cluster_set", "cluster_graph"):
             # Structured checkpoints: greedy episodes vs the hand-coded
@@ -454,8 +677,22 @@ def main(argv: list[str] | None = None) -> EvalReport | StructuredEvalReport:
                 # always 4-head (same fallback as the resume guard,
                 # train_ppo.py).
                 num_heads = 4
+            scenario = None
+            if meta.get("scenario"):
+                # Scenario-trained run: rebuild the SAME compiled
+                # workload (name + table seed from meta) so the policy is
+                # measured on the distribution it trained for — and the
+                # node baselines inside structured_evaluate run on the
+                # same scenario episodes (the per-scenario baseline).
+                from rl_scheduler_tpu.scenarios import get_scenario
+
+                scenario = get_scenario(meta["scenario"],
+                                        seed=meta.get("scenario_seed", 0))
+                print(f"Rebuilding scenario {scenario.name!r} "
+                      f"(seed {scenario.seed}) from checkpoint meta")
             bundle, net = make_bundle_and_net(
                 ckpt_env, PPOTrainConfig(), num_heads=num_heads,
+                scenario=scenario,
                 # Rebuild the env at the trained node count (fleet
                 # checkpoints; pre-fleet meta lacks the key -> default 8)
                 # and keep flash attention for flash-trained runs — at
@@ -482,8 +719,21 @@ def main(argv: list[str] | None = None) -> EvalReport | StructuredEvalReport:
                 "(cluster_set/cluster_graph) envs — single_cluster runs "
                 "are evaluated by their convergence tests"
             )
+        flat_table = None
+        if meta.get("scenario"):
+            # Flat scenario run (bursty/price_spike tables): evaluate on
+            # the same compiled table — and WITHOUT random episode
+            # phases, so the closed-form cost-greedy baseline (computed
+            # from this scenario's table, not the CSV's) stays exact.
+            from rl_scheduler_tpu.scenarios import cloud_table, get_scenario
+
+            flat_table = cloud_table(get_scenario(
+                meta["scenario"], seed=meta.get("scenario_seed", 0)))
+            print(f"Rebuilding scenario {meta['scenario']!r} tables from "
+                  "checkpoint meta")
         env_params = env_core.make_params(
-            EnvConfig(legacy_reward_sign=bool(meta.get("legacy_reward_sign", False)))
+            EnvConfig(legacy_reward_sign=bool(meta.get("legacy_reward_sign", False))),
+            table=flat_table,
         )
         from rl_scheduler_tpu.models import build_flat_policy_net
 

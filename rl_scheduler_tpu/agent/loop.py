@@ -20,6 +20,7 @@ every completed iteration's metrics.
 from __future__ import annotations
 
 import json
+import logging
 import time
 from typing import Any, Callable
 
@@ -439,6 +440,60 @@ def align_checkpoint_interval(requested: int | None, default: int,
             "silently be skipped (pick a multiple)"
         )
     return requested
+
+
+BEST_DIR = "best"
+
+
+def make_best_checkpoint_hook(
+    best_ckpt: Any,
+    tree_fn: Callable[[Any], dict],
+    extras: dict,
+    metric: str = "eval_episode_reward_mean",
+    initial_best: float | None = None,
+) -> Callable[[int, Any, dict], None]:
+    """Best-in-training-eval checkpoint keeper (ROADMAP item 3a).
+
+    An ``on_eval(i, runner, metrics)`` hook for the trainers' greedy-eval
+    seam: whenever this firing's ``metric`` beats every previous one, the
+    runner is saved through ``best_ckpt`` (a ``CheckpointManager`` over
+    ``<run>/best``, keep=1 — graftguard's async manifested saves make the
+    write nearly free: dispatch + return, finalized at the next save/
+    close). The measured fleet late-degrade mode — healthy at the stall
+    deadline, below baseline at the final eval (seeds 5/8 of the 9-seed
+    study, docs/scaling.md §1b) — is salvaged outright: the peak-eval
+    weights survive in ``best/`` while ``checkpoints/`` holds the
+    degraded tail, and ``--resume-best`` / ``evaluate --best`` select
+    them (chaos-suite proof: ``tests/test_graftguard.py``).
+
+    Save failures follow the periodic-checkpoint contract: logged and
+    counted on ``hook.failures``, never fatal. ``hook.best`` exposes the
+    running maximum (``initial_best`` seeds it on resume so a restored
+    run does not clobber a better earlier save).
+    """
+    state = {"best": float("-inf") if initial_best is None else initial_best}
+    log = logging.getLogger(__name__)
+
+    def hook(i: int, runner: Any, metrics: dict) -> None:
+        value = metrics.get(metric)
+        if value is None or value <= state["best"]:
+            return
+        state["best"] = value
+        try:
+            best_ckpt.save(i + 1, tree_fn(runner),
+                           extras={**extras, "best_eval": value,
+                                   "best_metric": metric})
+            print(f"  best-eval checkpoint updated at iteration {i + 1} "
+                  f"({metric}={value:.2f})", flush=True)
+        except Exception as e:  # noqa: BLE001 — same non-fatal contract
+            # as periodic saves: losing a best-save must not kill training
+            hook.failures.append((i + 1, repr(e)))
+            log.error("best-eval checkpoint save at iteration %d failed "
+                      "(%s); training continues", i + 1, e)
+
+    hook.failures = []
+    hook.best_value = lambda: state["best"]
+    return hook
 
 
 def make_periodic_checkpoint_fn(

@@ -536,6 +536,7 @@ def ppo_train(
     observer: Any | None = None,
     preemption: Any | None = None,
     on_preempt: Callable[[int, RunnerState], None] | None = None,
+    on_eval: Callable[[int, RunnerState, dict], None] | None = None,
 ):
     """Host-side training loop: jitted update per iteration + logging hooks.
 
@@ -726,6 +727,7 @@ def ppo_train(
     eval_hook = make_greedy_eval_hook(
         bundle, eval_net if eval_net is not None else net,
         cfg.eval_every, cfg.eval_episodes, seed, eval_log_fn,
+        on_eval=on_eval,
     )
 
     return run_train_loop(
@@ -744,11 +746,18 @@ def make_greedy_eval_hook(
     eval_episodes: int,
     seed: int,
     eval_log_fn: Callable[[int, dict], None] | None,
+    on_eval: Callable[[int, Any, dict], None] | None = None,
 ) -> Callable[[int, Any], None] | None:
     """Shared PPO/DQN in-training eval hook: ``hook(i, runner)`` runs the
     jitted greedy evaluation on ``runner.params`` (distinct key per firing)
     and hands the fetched metrics to ``eval_log_fn`` — or prints them.
-    Returns ``None`` when disabled."""
+    Returns ``None`` when disabled.
+
+    ``on_eval(i, runner, metrics)`` fires AFTER logging (and after any
+    stall guard wrapped into ``eval_log_fn`` has accepted the value, so a
+    raising guard skips it): the one place per firing that sees both the
+    fetched metrics and the live runner — the best-eval checkpoint
+    tracker's seam (``agent/loop.make_best_checkpoint_hook``)."""
     if eval_every <= 0:
         return None
     from rl_scheduler_tpu.agent.evaluate import make_greedy_eval_fn
@@ -768,5 +777,7 @@ def make_greedy_eval_hook(
             from rl_scheduler_tpu.agent.loop import print_eval_line
 
             print_eval_line(i, metrics)
+        if on_eval is not None:
+            on_eval(i, runner, metrics)
 
     return eval_hook

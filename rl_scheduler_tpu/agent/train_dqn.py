@@ -31,7 +31,7 @@ from rl_scheduler_tpu.env import core as env_core
 ENVS = ("single_cluster", "multi_cloud")
 
 
-def make_bundle(env_name: str):
+def make_bundle(env_name: str, scenario=None):
     if env_name == "single_cluster":
         from rl_scheduler_tpu.env.bundle import single_cluster_bundle
 
@@ -39,7 +39,20 @@ def make_bundle(env_name: str):
     if env_name == "multi_cloud":
         from rl_scheduler_tpu.env.bundle import multi_cloud_bundle
 
-        return multi_cloud_bundle(env_core.make_params(EnvConfig()))
+        table = None
+        random_start = False
+        if scenario is not None:
+            # Scenario layer (docs/scenarios.md): swap the CSV replay for
+            # the scenario's compiled cloud tables + per-episode random
+            # phases. The flat obs shape is unchanged, so the Q-network
+            # and the serving stack carry over untouched.
+            from rl_scheduler_tpu.scenarios import cloud_table
+
+            table = cloud_table(scenario)
+            random_start = bool(scenario.knob("random_phase", False))
+        return multi_cloud_bundle(
+            env_core.make_params(EnvConfig(), table=table),
+            random_start=random_start)
     raise ValueError(f"unknown env {env_name!r}; choose from {ENVS}")
 
 
@@ -53,6 +66,14 @@ def main(argv: list[str] | None = None) -> Path:
                    help="learner iterations (each = collect_steps x num_envs "
                         "env steps + one learner step)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--scenario", default=None,
+                   help="multi_cloud only: train on a workload scenario's "
+                        "compiled cloud tables instead of the CSV replay "
+                        "(bursty | price_spike — the families with a "
+                        "cloud-level story; docs/scenarios.md). Recorded "
+                        "in checkpoint meta")
+    p.add_argument("--scenario-seed", type=int, default=0,
+                   help="seed for the scenario's table compilation")
     p.add_argument("--run-name", default=None)
     p.add_argument("--run-root", default=RuntimeConfig().checkpoint_dir)
     p.add_argument("--checkpoint-every", type=int, default=None,
@@ -124,7 +145,30 @@ def main(argv: list[str] | None = None) -> Path:
         overrides["eval_episodes"] = args.eval_episodes
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
-    bundle = make_bundle(args.env)
+    scenario = None
+    if args.scenario is not None:
+        if args.env != "multi_cloud":
+            raise SystemExit(
+                f"--scenario shapes the multi_cloud tables; --env "
+                f"{args.env} has no scenario families here (the "
+                "structured scenarios train through train_ppo)")
+        from rl_scheduler_tpu.scenarios import get_scenario
+
+        try:
+            scenario = get_scenario(args.scenario, seed=args.scenario_seed)
+        except ValueError as e:
+            raise SystemExit(f"--scenario: {e}")
+        if scenario.family not in ("bursty_diurnal", "price_spike"):
+            raise SystemExit(
+                f"--scenario {args.scenario} (family {scenario.family}) "
+                "has no cloud-level tables; multi_cloud DQN takes "
+                "bursty | price_spike")
+    bundle = make_bundle(args.env, scenario=scenario)
+    scenario_extras = {"scenario": None}
+    if scenario is not None:
+        from rl_scheduler_tpu.scenarios import scenario_meta
+
+        scenario_extras = scenario_meta(scenario)
 
     from rl_scheduler_tpu.agent.loop import align_checkpoint_interval
 
@@ -189,6 +233,25 @@ def main(argv: list[str] | None = None) -> Path:
                 f"match configured hidden={list(cfg.hidden)} (pass --hidden "
                 f"{','.join(str(w) for w in meta['hidden'])})"
             )
+        if meta.get("scenario") != args.scenario:
+            raise SystemExit(
+                f"--resume: run was trained on "
+                f"{'scenario ' + repr(meta.get('scenario')) if meta.get('scenario') else 'the CSV replay'}; "
+                "resuming with a different workload would silently switch "
+                "the training distribution mid-run "
+                + (f"(pass --scenario {meta['scenario']})"
+                   if meta.get("scenario") else "(drop --scenario)"))
+        if (args.scenario is not None
+                and meta.get("scenario_seed") is not None
+                and meta.get("scenario_seed") != args.scenario_seed):
+            # Same guard as train_ppo's resume path: a different table
+            # seed is a different compiled workload.
+            raise SystemExit(
+                f"--resume: run was trained with --scenario-seed "
+                f"{meta['scenario_seed']}; resuming with "
+                f"{args.scenario_seed} would swap the compiled workload "
+                f"tables mid-run (pass --scenario-seed "
+                f"{meta['scenario_seed']})")
         from rl_scheduler_tpu.agent.dqn import make_dqn
 
         init_fn, _, _ = make_dqn(bundle, cfg)
@@ -275,6 +338,9 @@ def main(argv: list[str] | None = None) -> Path:
             "preset": args.preset,
             "env": args.env,
             "hidden": list(cfg.hidden),
+            # Scenario provenance (None = CSV replay): the resume guard
+            # and serving read it back.
+            **scenario_extras,
             "full_state": True,
             # The 'loop' subtree's shapes are keyed on these; resume
             # degrades to params-only when they differ.

@@ -108,7 +108,8 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
                         fused_set: bool = False,
                         num_nodes: int | None = None,
                         flash_attn: bool = False,
-                        fused_set_block: bool = False):
+                        fused_set_block: bool = False,
+                        scenario=None):
     """``(bundle, net)`` for each BASELINE env family.
 
     ``net=None`` means the default flat-obs ActorCritic; the set/graph envs
@@ -126,6 +127,15 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
     so one checkpoint applies at any N — the env size is a training-
     distribution choice, not an architecture change (fleet-scale regime:
     docs/scaling.md).
+
+    ``scenario`` (a :class:`rl_scheduler_tpu.scenarios.Scenario`) swaps
+    the env's CSV replay for the scenario's compiled tables and
+    per-episode randomization (docs/scenarios.md): cluster_set takes
+    every family (the heterogeneous family substitutes its widened env,
+    ``scenarios/het_env.py``, keeping the same flax set policy);
+    multi_cloud takes bursty_diurnal/price_spike cloud tables (plus
+    random episode phases); cluster_graph takes the price_spike family's
+    raw dollar regimes.
     """
     dtype = None
     if cfg.compute_dtype == "bfloat16":
@@ -136,10 +146,18 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
         from rl_scheduler_tpu.env.bundle import multi_cloud_bundle
 
         kwargs = {} if fault_prob is None else {"fault_prob": fault_prob}
+        table = None
+        random_start = False
+        if scenario is not None:
+            from rl_scheduler_tpu.scenarios import cloud_table
+
+            table = cloud_table(scenario)  # bursty/price_spike families
+            random_start = bool(scenario.knob("random_phase", False))
         params = env_core.make_params(
-            EnvConfig(legacy_reward_sign=legacy_reward_sign, **kwargs)
+            EnvConfig(legacy_reward_sign=legacy_reward_sign, **kwargs),
+            table=table,
         )
-        return multi_cloud_bundle(params), None
+        return multi_cloud_bundle(params, random_start=random_start), None
     if env_name == "single_cluster":
         from rl_scheduler_tpu.env.bundle import single_cluster_bundle
 
@@ -148,9 +166,29 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
         from rl_scheduler_tpu.env import cluster_set as cs
         from rl_scheduler_tpu.env.bundle import cluster_set_bundle
 
-        set_params = cs.make_params(
-            **({} if num_nodes is None else {"num_nodes": num_nodes})
-        )
+        if scenario is not None and scenario.family == "heterogeneous":
+            # The widened multi-resource env pairs with the SAME flax set
+            # policy (the embed layer infers its width from the obs); the
+            # shape-specialized fast paths are refused by the CLI.
+            from rl_scheduler_tpu.models import SetTransformerPolicy
+            from rl_scheduler_tpu.scenarios import scenario_bundle
+
+            het = scenario_bundle(
+                scenario, num_nodes if num_nodes is not None else 8)
+            kwargs = {} if num_heads is None else {"num_heads": num_heads}
+            if flash_attn:
+                kwargs["attn_impl"] = "flash"
+            return het, SetTransformerPolicy(dim=64, depth=2, dtype=dtype,
+                                             **kwargs)
+        if scenario is not None:
+            from rl_scheduler_tpu.scenarios import cluster_set_params
+
+            set_params = cluster_set_params(
+                scenario, num_nodes if num_nodes is not None else 8)
+        else:
+            set_params = cs.make_params(
+                **({} if num_nodes is None else {"num_nodes": num_nodes})
+            )
         if fused_set_block:
             from rl_scheduler_tpu.models.set_fast import FusedBlockSetPolicy
 
@@ -180,9 +218,12 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
         from rl_scheduler_tpu.env import cluster_graph
         from rl_scheduler_tpu.env.bundle import cluster_graph_bundle
 
-        params = cluster_graph.make_params(
-            **({} if num_nodes is None else {"num_nodes": num_nodes})
-        )
+        graph_kwargs = {} if num_nodes is None else {"num_nodes": num_nodes}
+        if scenario is not None:
+            from rl_scheduler_tpu.scenarios import raw_prices
+
+            graph_kwargs["prices"] = raw_prices(scenario)  # price_spike
+        params = cluster_graph.make_params(**graph_kwargs)
         if fused_gnn:
             from rl_scheduler_tpu.ops.pallas_gnn import FusedGNNPolicy
 
@@ -222,6 +263,20 @@ def main(argv: list[str] | None = None) -> Path:
                    help="iteration by which the in-training eval must "
                         "beat the node-baseline threshold (default 16 — "
                         "the measured separation point at fleet N)")
+    p.add_argument("--scenario", default=None,
+                   help="train on a workload scenario instead of the flat "
+                        "CSV replay (rl_scheduler_tpu/scenarios/, "
+                        "docs/scenarios.md): bursty | heterogeneous | "
+                        "churn | price_spike. cluster_set (the default "
+                        "env when this flag is set) takes every family; "
+                        "multi_cloud takes bursty/price_spike; "
+                        "cluster_graph takes price_spike. Recorded in "
+                        "checkpoint meta — evaluation rebuilds the same "
+                        "scenario and serving refuses a mismatch")
+    p.add_argument("--scenario-seed", type=int, default=0,
+                   help="seed for the scenario's table compilation "
+                        "(independent of --seed, so a reseeded training "
+                        "attempt keeps the SAME workload)")
     p.add_argument("--run-name", default=None)
     p.add_argument("--run-root", default=RuntimeConfig().checkpoint_dir)
     p.add_argument("--checkpoint-every", type=int, default=None,
@@ -247,6 +302,12 @@ def main(argv: list[str] | None = None) -> Path:
     p.add_argument("--resume", action="store_true",
                    help="continue from the latest checkpoint in the run dir "
                         "(requires --run-name of an existing run)")
+    p.add_argument("--resume-best", action="store_true",
+                   help="continue from the BEST-in-training-eval checkpoint "
+                        "(<run>/best, kept automatically whenever "
+                        "--eval-every is active) instead of the latest — "
+                        "salvages a late-degrade run by training onward "
+                        "from its peak (docs/scaling.md §1b)")
     p.add_argument("--num-envs", type=int, default=None,
                    help="override the preset's parallel env count")
     p.add_argument("--rollout-steps", type=int, default=None,
@@ -384,7 +445,47 @@ def main(argv: list[str] | None = None) -> Path:
             # an explicit --num-nodes overrides a preset's implied default.
             args.num_nodes = implied.get("num_nodes")
     if args.env is None:
-        args.env = "multi_cloud"
+        # A scenario names a workload for the structured set family by
+        # default; the flat flagship stays the no-flag default.
+        args.env = "cluster_set" if args.scenario is not None else "multi_cloud"
+
+    if args.resume and args.resume_best:
+        # Validate before ANY side effect (run dir, managers): the two
+        # flags name different restore sources.
+        raise SystemExit(
+            "--resume and --resume-best name different restore sources "
+            "(latest vs best-in-training-eval); pick one")
+
+    scenario = None
+    if args.scenario is not None:
+        from rl_scheduler_tpu.scenarios import get_scenario, node_feat_for
+
+        try:
+            scenario = get_scenario(args.scenario, seed=args.scenario_seed)
+        except ValueError as e:
+            raise SystemExit(f"--scenario: {e}")
+        env_families = {
+            "multi_cloud": ("bursty_diurnal", "price_spike"),
+            "cluster_set": ("bursty_diurnal", "heterogeneous", "churn",
+                            "price_spike"),
+            "cluster_graph": ("price_spike",),
+        }
+        allowed = env_families.get(args.env, ())
+        if scenario.family not in allowed:
+            raise SystemExit(
+                f"--scenario {args.scenario} (family {scenario.family}) "
+                f"does not shape --env {args.env}"
+                + (f" (that env takes: {', '.join(allowed)})" if allowed
+                   else " (scenarios shape multi_cloud/cluster_set/"
+                        "cluster_graph)"))
+        if scenario.family == "heterogeneous" and (
+                args.fused_set or args.fused_set_block):
+            raise SystemExit(
+                "--scenario heterogeneous widens the observation to "
+                f"{node_feat_for(scenario)} features; the shape-"
+                "specialized fast paths (--fused-set/--fused-set-block) "
+                "compile the classic 6-feature layout — train the flax "
+                "set policy (drop the fast-path flag)")
 
     from rl_scheduler_tpu.parallel import maybe_initialize_distributed
 
@@ -407,9 +508,14 @@ def main(argv: list[str] | None = None) -> Path:
         nodes = args.num_nodes if args.num_nodes is not None else 8
         eligible = (default_platform() == "tpu"
                     and not (args.fused_set or args.flash_attn)
-                    and args.sp == 1 and not args.resume
+                    and args.sp == 1
+                    and not (args.resume or args.resume_best)
                     and args.num_heads in (None, 1)
-                    and is_fleet_node_count(nodes))
+                    and is_fleet_node_count(nodes)
+                    # The fused kernel compiles the classic 6-feature
+                    # layout; the het scenario's widened obs keeps flax.
+                    and (scenario is None
+                         or scenario.family != "heterogeneous"))
         if eligible:
             args.fused_set_block = True
             print(f"Preset {args.preset} implies --fused-set-block on TPU "
@@ -710,9 +816,9 @@ def main(argv: list[str] | None = None) -> Path:
                     f"--iterations {args.iterations}: the guard would "
                     "fire at or after the end of training (raise "
                     "--iterations or lower the deadline)")
-        if args.resume:
+        if args.resume or args.resume_best:
             return ("restarts training from scratch on a stalled eval; "
-                    "that contradicts --resume (drop one)")
+                    "that contradicts --resume/--resume-best (drop one)")
         return None
 
     if args.reseed_on_stall is None:
@@ -754,7 +860,8 @@ def main(argv: list[str] | None = None) -> Path:
                                       fused_set=args.fused_set,
                                       num_nodes=args.num_nodes,
                                       flash_attn=args.flash_attn,
-                                      fused_set_block=args.fused_set_block)
+                                      fused_set_block=args.fused_set_block,
+                                      scenario=scenario)
     eval_net = None
     if args.sp > 1:
         # Training net: the bundle's own policy cloned with axis_name="sp"
@@ -775,32 +882,66 @@ def main(argv: list[str] | None = None) -> Path:
     run_dir.mkdir(parents=True, exist_ok=True)
     metrics_file = (run_dir / "metrics.jsonl").open("a")
 
+    from rl_scheduler_tpu.agent.loop import BEST_DIR
     from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
 
     ckpt = CheckpointManager(run_dir, keep=args.keep)
 
     restore = None
     restored_seed = None
-    if args.resume:
+    if args.resume or args.resume_best:
+        resume_flag = "--resume-best" if args.resume_best else "--resume"
+        # --resume-best restores from the best-eval keeper (<run>/best,
+        # ROADMAP item 3a) instead of the newest periodic step; everything
+        # else — verification, quarantine fallback, architecture guards —
+        # is identical, and the continuation's new checkpoints land in
+        # the MAIN manager as usual.
+        resume_mgr = (CheckpointManager(run_dir / BEST_DIR, keep=1)
+                      if args.resume_best else ckpt)
         # Integrity-verified selection (graftguard): the newest step whose
         # manifest checks out — corrupt/truncated steps are quarantined
         # and the resume falls back, so a torn final write costs one
         # checkpoint interval, not the run (docs/robustness.md).
-        latest = ckpt.latest_verified_step()
+        latest = resume_mgr.latest_verified_step()
         if latest is None:
+            hint = ("no best-eval checkpoint (the keeper runs whenever "
+                    "--eval-every is active)" if args.resume_best
+                    else "no checkpoints")
             raise SystemExit(
-                f"--resume: no checkpoints under {run_dir} — pass --run-name "
-                "of an existing run (drop --resume to start fresh)"
+                f"{resume_flag}: {hint} under "
+                f"{run_dir / BEST_DIR if args.resume_best else run_dir} — "
+                f"pass --run-name of an existing run (drop {resume_flag} "
+                "to start fresh)"
             )
         if latest >= args.iterations:
             raise SystemExit(
-                f"--resume: run already has {latest} iterations; --iterations "
-                f"is a TOTAL, so pass a value > {latest} to train further"
+                f"{resume_flag}: run already has {latest} iterations; "
+                f"--iterations is a TOTAL, so pass a value > {latest} to "
+                "train further"
             )
         # Validate architecture from the cheap meta record BEFORE the
         # state restore — a hidden-size mismatch would otherwise surface
         # as a raw Orbax structure error.
-        meta = ckpt.restore_meta(latest)
+        meta = resume_mgr.restore_meta(latest)
+        ckpt_scn = meta.get("scenario")
+        if ckpt_scn != args.scenario:
+            raise SystemExit(
+                f"{resume_flag}: run was trained on "
+                f"{'scenario ' + repr(ckpt_scn) if ckpt_scn else 'the CSV replay'}; "
+                f"resuming on "
+                f"{'scenario ' + repr(args.scenario) if args.scenario else 'the CSV replay'} "
+                "would silently switch the training distribution mid-run "
+                + (f"(pass --scenario {ckpt_scn})" if ckpt_scn
+                   else "(drop --scenario)"))
+        if (args.scenario is not None
+                and meta.get("scenario_seed") is not None
+                and meta.get("scenario_seed") != args.scenario_seed):
+            raise SystemExit(
+                f"{resume_flag}: run was trained with --scenario-seed "
+                f"{meta['scenario_seed']}; resuming with "
+                f"{args.scenario_seed} would swap the compiled workload "
+                f"tables mid-run (pass --scenario-seed "
+                f"{meta['scenario_seed']})")
         # The seed that INITIALIZED the weights: carried forward into the
         # resumed run's checkpoint meta so attribution survives a resume
         # under a different --seed (which only changes the continuation's
@@ -900,7 +1041,8 @@ def main(argv: list[str] | None = None) -> Path:
                 tp_abstract_state,
             )
 
-            tree, _ = ckpt.restore(latest, target=tp_abstract_state(bundle, cfg))
+            tree, _ = resume_mgr.restore(latest,
+                                         target=tp_abstract_state(bundle, cfg))
         else:
             from rl_scheduler_tpu.agent.ppo import make_ppo_bundle
 
@@ -924,7 +1066,7 @@ def main(argv: list[str] | None = None) -> Path:
                     "ep_return": abstract.ep_return,
                     "update_idx": abstract.update_idx,
                 }
-            tree, _ = ckpt.restore(latest, target=target)
+            tree, _ = resume_mgr.restore(latest, target=target)
             if ckpt_full and not ckpt_env_shape_ok:
                 # Orbax needs the 'loop' item in the target at all (the
                 # target must cover the checkpoint's structure; shapes it
@@ -947,11 +1089,33 @@ def main(argv: list[str] | None = None) -> Path:
                       "sharded mesh — env/RNG state restarts fresh "
                       "(deterministic resume is single-chip only)")
         restore = (tree, latest)
+        if resume_mgr is not ckpt:
+            # The best keeper was only a restore source here; the
+            # continuation's own best saves reopen it below.
+            resume_mgr.close()
+            # Salvage semantics: training onward from the peak ABANDONS
+            # the degraded tail — and frees its step numbers, or the
+            # continuation's periodic/final saves at them would be
+            # refused by Orbax and silently swallowed (non-fatal save
+            # contract), leaving the continued run persisted nowhere
+            # while --resume/evaluate still select the degraded weights.
+            stale = [s for s in (ckpt.latest_step(),) if s is not None
+                     and s > latest]
+            ckpt.delete_steps_after(latest)
+            if stale:
+                print(f"--resume-best: abandoned the degraded tail past "
+                      f"iteration {latest} (checkpoints newer than the "
+                      "peak deleted; the continuation re-trains them)")
         # Mark the resume point in the metrics log so post-crash duplicate
         # iteration entries are separable by downstream analysis.
-        metrics_file.write(json.dumps({"resumed_from_iteration": latest}) + "\n")
+        metrics_file.write(json.dumps(
+            {"resumed_from_iteration": latest,
+             "resume_source": "best" if args.resume_best else "latest"})
+            + "\n")
         metrics_file.flush()
-        print(f"Resuming from iteration {latest} (checkpoints in {run_dir})")
+        print(f"Resuming from iteration {latest} "
+              f"({'best-eval checkpoint' if args.resume_best else 'latest'}; "
+              f"checkpoints in {run_dir})")
 
     from rl_scheduler_tpu.agent.loop import (
         TensorBoardLogger,
@@ -1014,6 +1178,16 @@ def main(argv: list[str] | None = None) -> Path:
                 "num_envs": cfg.num_envs,
                 "rollout_steps": cfg.rollout_steps,
                 "legacy_reward_sign": args.legacy_reward_sign}
+    if scenario is not None:
+        # Scenario provenance: evaluation rebuilds the same workload from
+        # this record, the resume guard refuses a mismatch, and serving
+        # refuses a serve config whose scenario (or observation width)
+        # disagrees (scheduler/extender.py).
+        from rl_scheduler_tpu.scenarios import scenario_meta
+
+        checkpoint_extras.update(scenario_meta(scenario))
+    else:
+        checkpoint_extras["scenario"] = None
 
     def checkpoint_tree_fn(runner):
         tree = {"params": runner.params, "opt_state": runner.opt_state}
@@ -1114,11 +1288,42 @@ def main(argv: list[str] | None = None) -> Path:
             _rec.dump("preemption", iteration,
                       detail=f"signal={guard.signum or 'simulated'}; final "
                              "checkpoint written at this iteration")
+    # Best-in-training-eval keeper (ROADMAP item 3a): whenever the eval
+    # hook is active, the peak-eval runner is saved to <run>/best (keep=1,
+    # async manifested saves — nearly free). Salvages the measured
+    # late-degrade seeds: the final eval can reject the run while best/
+    # still holds its peak (--resume-best / evaluate --best select it).
+    best_ckpt = None
+    initial_best = None
+    if cfg.eval_every > 0:
+        best_ckpt = CheckpointManager(run_dir / BEST_DIR, keep=1)
+        if args.resume or args.resume_best:
+            try:
+                # A prior attempt's best must not be clobbered by a worse
+                # continuation eval: seed the tracker's running maximum.
+                initial_best = best_ckpt.restore_meta().get("best_eval")
+            except FileNotFoundError:
+                initial_best = None
+
     with guard, ctx:
         attempt = 0
         while True:
             attempt_seed = args.seed + attempt
             eval_log = make_eval_log_fn(metrics_file, tb)
+            on_eval = None
+            if best_ckpt is not None:
+                from rl_scheduler_tpu.agent.loop import (
+                    make_best_checkpoint_hook,
+                )
+
+                meta_seed = attempt_seed
+                if restored_seed is not None:
+                    meta_seed = (None if restored_seed == "unknown"
+                                 else restored_seed)
+                on_eval = make_best_checkpoint_hook(
+                    best_ckpt, checkpoint_tree_fn,
+                    extras={**checkpoint_extras, "seed": meta_seed},
+                    initial_best=initial_best)
             if stall_threshold is not None:
                 on_stall = None
                 if recorder is not None:
@@ -1149,7 +1354,8 @@ def main(argv: list[str] | None = None) -> Path:
                           updates_per_dispatch=args.updates_per_dispatch,
                           mesh=mesh, eval_net=eval_net,
                           scope=scope, observer=observer,
-                          preemption=guard, on_preempt=on_preempt)
+                          preemption=guard, on_preempt=on_preempt,
+                          on_eval=on_eval)
                 break
             except EvalStall as stall:
                 attempt += 1
@@ -1182,6 +1388,11 @@ def main(argv: list[str] | None = None) -> Path:
                 # replacement (same step numbers — Orbax would refuse the
                 # overwrite and the evaluator would read stale weights).
                 ckpt.clear()
+                if best_ckpt is not None:
+                    # Same rule for the best keeper: the reseeded attempt
+                    # starts its own best race from scratch.
+                    best_ckpt.clear()
+                    initial_best = None
                 if recorder is not None:
                     # Same reasoning for the flight recorder: the
                     # replacement re-uses iteration numbers under a new
@@ -1204,6 +1415,8 @@ def main(argv: list[str] | None = None) -> Path:
     # Finalize the async save (graftguard: an unfinalized final save has
     # no integrity manifest and would restore as 'legacy').
     ckpt.close()
+    if best_ckpt is not None:
+        best_ckpt.close()
     if guard.stopped_at is not None:
         print(f"Preempted: clean shutdown after iteration "
               f"{guard.stopped_at + 1}; verified checkpoints in {run_dir} "

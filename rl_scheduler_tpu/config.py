@@ -38,6 +38,11 @@ class EnvConfig:
     fault_prob: float = 0.0
     fault_latency_penalty: float = 1.0  # normalized latency when faulted
 
+    # (The scenario layer's per-episode random episode phases are a
+    # BUNDLE-construction choice, not an env-params field:
+    # env/bundle.multi_cloud_bundle(random_start=True) — a flag leaf in
+    # the params pytree would trace under vmap/jit.)
+
 
 @dataclasses.dataclass(frozen=True)
 class SingleClusterConfig:

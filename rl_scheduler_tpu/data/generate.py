@@ -72,6 +72,58 @@ def generate_all(
     return full
 
 
+def decaying_bursts(events: np.ndarray, magnitudes: np.ndarray,
+                    decay: float) -> np.ndarray:
+    """Exponentially-relaxing excursion level from a 0/1 event train —
+    the shared spike shape (spot-price crunches here, load bursts in
+    ``scenarios/families.py``). One implementation so the two spike
+    processes cannot silently diverge."""
+    level = 0.0
+    out = np.zeros(len(events))
+    for t in range(len(events)):
+        level = level * decay + (magnitudes[t] if events[t] else 0.0)
+        out[t] = level
+    return out
+
+
+def generate_price_spikes(
+    steps: int = DEFAULT_STEPS,
+    seed: int = DEFAULT_SEED,
+    spike_prob: float = 0.04,
+    spike_mult: float = 4.0,
+    decay: float = 0.7,
+    anti_correlated: bool = True,
+) -> pd.DataFrame:
+    """Price traces with seeded spot-market spike regimes (scenario family 4).
+
+    The flat generator above draws i.i.d. jitter around the on-demand
+    anchors; real spot markets instead show rare multiplicative spikes
+    that decay over hours (capacity crunches). Each cloud gets an
+    independent Bernoulli(``spike_prob``) spike process whose excursions
+    multiply the base price by up to ``spike_mult`` and relax
+    geometrically (``decay`` per step). ``anti_correlated=True`` delays
+    Azure's spike stream by half the trace so the two clouds rarely
+    spike together — the regime where a price-aware scheduler has
+    something to win.
+
+    Deterministic given ``seed`` (one ``RandomState``, fixed draw order);
+    returns the same frame schema as :func:`generate_prices` so
+    ``normalize.build_normalized_table`` and the cluster-graph env's raw
+    replay both consume it unchanged.
+    """
+    rng = np.random.RandomState(seed)
+    base = generate_prices(steps, rng)
+    for i, col in enumerate(("cost_aws", "cost_azure")):
+        events = rng.uniform(size=steps) < spike_prob
+        magnitude = rng.uniform(1.0, spike_mult - 1.0, steps)
+        if anti_correlated and i == 1:
+            events = np.roll(events, steps // 2)
+            magnitude = np.roll(magnitude, steps // 2)
+        base[col] = base[col] * (1.0 + decaying_bursts(events, magnitude,
+                                                       decay))
+    return base
+
+
 # Column order of a Locust --csv stats_history export (verified against the
 # reference's data/local_*_load_stats_history.csv header).
 LOCUST_HISTORY_COLUMNS = (

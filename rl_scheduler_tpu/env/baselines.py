@@ -42,6 +42,10 @@ STRUCTURED_COLUMNS = {
     # env name -> {feature: column} (see the env modules' _observe)
     "cluster_set": {"cost": 0, "cpu": 2},
     "cluster_graph": {"cost": 0, "cpu": 1},
+    # Scenario layer: the heterogeneous multi-resource env widens the set
+    # layout but keeps cost first and the first (cpu) utilization column
+    # at index 2 (scenarios/het_env.py docstring).
+    "cluster_set_het": {"cost": 0, "cpu": 2},
 }
 
 
@@ -64,11 +68,17 @@ def random_node_policy(key: jnp.ndarray, obs: jnp.ndarray) -> jnp.ndarray:
     )
 
 
-def structured_baselines(env_name: str) -> dict:
+def structured_baselines(env_name: str, columns: dict | None = None) -> dict:
     """``{name: policy_fn(obs, key) -> actions}`` for a structured env
     family — the baselines the status-table convergence rows compare
-    against, reproducible from the evaluation CLI."""
-    cols = STRUCTURED_COLUMNS[env_name]
+    against, reproducible from the evaluation CLI.
+
+    ``columns`` overrides the layout lookup — the scenario eval matrix
+    passes each scenario's own column map so every matrix cell's
+    baseline reads the right features (a scenario can reorder or widen
+    the observation; hardcoding cluster_set's layout would silently
+    score the wrong column there)."""
+    cols = columns if columns is not None else STRUCTURED_COLUMNS[env_name]
     return {
         "random": lambda obs, key: random_node_policy(key, obs),
         "cheapest_node": lambda obs, key: cheapest_node_policy(obs, cols["cost"]),

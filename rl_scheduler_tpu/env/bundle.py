@@ -111,13 +111,31 @@ def bundle_from_single(
     )
 
 
-def multi_cloud_bundle(params=None) -> EnvBundle:
+def multi_cloud_bundle(params=None, random_start: bool = False) -> EnvBundle:
     """The flagship multi-cloud placement env as a bundle (reuses the
-    batched steppers from :mod:`rl_scheduler_tpu.env.vector`)."""
+    batched steppers from :mod:`rl_scheduler_tpu.env.vector`).
+
+    ``random_start`` (scenario layer, docs/scenarios.md): every episode —
+    initial AND auto-reset — begins at a uniformly random table row
+    (``core.reset_random_start``). The open-loop horizon fast path is
+    withheld then (its auto-reset wraps deterministically to row 0, which
+    would diverge from the randomized resets), so ``rollout_impl='auto'``
+    falls back to the scan rollout.
+    """
     from rl_scheduler_tpu.env import core, vector
 
     if params is None:
         params = core.make_params()
+    if random_start:
+        reset_fn = lambda key: core.reset_random_start(params, key)
+        return bundle_from_single(
+            reset_fn,
+            lambda state, action: core.step(params, state, action),
+            obs_shape=(core.OBS_DIM,),
+            num_actions=core.NUM_ACTIONS,
+            name="multi_cloud",
+            episode_steps=int(params.max_steps),
+        )
     return EnvBundle(
         reset_batch=lambda key, n: vector.reset_batch(params, key, n),
         step_batch=lambda state, action: vector.step_autoreset_batch(

@@ -127,8 +127,15 @@ def make_params(
     drain_rate: float = 0.85,
     prices_path: str | None = None,
     max_steps: int | None = None,
+    prices=None,
 ) -> ClusterGraphParams:
-    prices = load_raw_prices(prices_path)
+    """``prices``: a preloaded ``[T, 2]`` raw $/hr array — the scenario
+    layer's seam (e.g. the price-spike family's generated regimes,
+    ``scenarios/families.py``) — replacing the CSV replay; default loads
+    the shipped trace."""
+    if prices is None:
+        prices = load_raw_prices(prices_path)
+    prices = jnp.asarray(prices, jnp.float32)
     cloud, adj, hops = build_topology(num_nodes)
     f32 = lambda x: jnp.asarray(x, jnp.float32)
     t = prices.shape[0]

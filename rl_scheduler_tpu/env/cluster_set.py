@@ -29,6 +29,26 @@ state matter: a greedy cheapest-node policy overloads it and loses to
 load-aware placement.
 
 Episode length follows the pricing table (99 steps), like the reference.
+
+Scenario extensions (``rl_scheduler_tpu/scenarios/``): every optional
+field below defaults to the legacy behavior — ``None``/``False`` leaves
+reset/step bit-identical to the pre-scenario env (same RNG draw order,
+same values), so the CSV-replay configs and their measured record are
+untouched. When set:
+
+- ``table``/``pod_scale``: scenario-compiled cost/latency tables and a
+  per-step arrival-intensity multiplier on the pod draw (bursty-diurnal
+  and price-spike families).
+- ``avail_mask``/``churn_penalty``: a ``[T, N]`` availability mask
+  (node-pool churn) — down nodes observe as maximally loaded/expensive
+  and placing on one pays ``churn_penalty`` (scaled by ``reward_scale``
+  like every other term).
+- ``jitter_range``/``drain_range``/``overload_range``/``random_phase``:
+  PER-EPISODE domain randomization, drawn from each env's own
+  ``jax.random`` key at reset (fully vmappable): the node-premium scale,
+  drain rate, overload penalty, and the table-replay phase offset —
+  exactly the static quantities the fleet seed-fragility diagnostic
+  found argmax latching onto (docs/scaling.md §1b; ROADMAP item 3b).
 """
 
 from __future__ import annotations
@@ -56,10 +76,31 @@ class ClusterSetParams(NamedTuple):
     pod_cpu_high: jnp.ndarray
     drain_rate: jnp.ndarray     # per-step utilization retention in (0,1)
     max_steps: jnp.ndarray      # scalar int32
+    # --- scenario fields (None/False = legacy CSV-replay behavior) ---
+    pod_scale: jnp.ndarray | None = None     # [T] arrival-intensity mult
+    avail_mask: jnp.ndarray | None = None    # [T, N] 1=up (churn family)
+    churn_penalty: jnp.ndarray | None = None  # scalar, with avail_mask
+    jitter_range: jnp.ndarray | None = None  # [2] per-episode node_jitter
+    drain_range: jnp.ndarray | None = None   # [2] per-episode drain_rate
+    overload_range: jnp.ndarray | None = None  # [2] per-episode penalty
+    random_phase: bool = False               # per-episode table offset
 
     @property
     def num_nodes(self) -> int:
         return self.cloud_of_node.shape[0]
+
+    @property
+    def num_table_rows(self) -> int:
+        return self.costs.shape[0]
+
+    @property
+    def episode_randomized(self) -> bool:
+        """True when reset draws any per-episode scenario randomization
+        (static at trace time — params are closed over, never traced)."""
+        return (self.jitter_range is not None
+                or self.drain_range is not None
+                or self.overload_range is not None
+                or self.random_phase)
 
 
 class ClusterSetState(NamedTuple):
@@ -68,6 +109,14 @@ class ClusterSetState(NamedTuple):
     node_premium: jnp.ndarray  # [N, 2] static per-episode (cost, lat) offsets
     pod_cpu: jnp.ndarray    # scalar f32: the pod awaiting placement
     key: jnp.ndarray
+    # Per-episode scenario draws — populated by reset() with the params'
+    # static values when randomization is off, so the added leaves never
+    # change behavior there (step multiplies by the same numbers). No
+    # defaults: a hand-built state missing them should fail loudly, not
+    # drain to zero.
+    phase: jnp.ndarray      # table-replay offset (0 legacy)
+    ep_drain: jnp.ndarray   # this episode's drain rate
+    ep_overload: jnp.ndarray  # this episode's overload penalty
 
 
 class TimeStep(NamedTuple):
@@ -90,16 +139,37 @@ def make_params(
     drain_rate: float = 0.85,
     data_path: str | None = None,
     max_steps: int | None = None,
+    table=None,
+    pod_scale=None,
+    avail_mask=None,
+    churn_penalty: float | None = None,
+    jitter_range: tuple | None = None,
+    drain_range: tuple | None = None,
+    overload_range: tuple | None = None,
+    random_phase: bool = False,
 ) -> ClusterSetParams:
-    table = load_table(data_path)
+    """Build params from the shipped CSV (default) or a scenario's
+    compiled tables (``table=``, a :class:`~rl_scheduler_tpu.data.loader.
+    CloudTable` or anything with ``.costs``/``.latencies``); the scenario
+    keyword fields are documented on the module."""
+    if table is None:
+        table = load_table(data_path)
     t = table.costs.shape[0]
     f32 = lambda x: jnp.asarray(x, jnp.float32)
+    opt = lambda x: None if x is None else f32(x)
+    if avail_mask is not None and jnp.asarray(avail_mask).shape != (t, num_nodes):
+        raise ValueError(
+            f"avail_mask shape {jnp.asarray(avail_mask).shape} != "
+            f"(table rows, num_nodes) = ({t}, {num_nodes})")
+    if pod_scale is not None and jnp.asarray(pod_scale).shape != (t,):
+        raise ValueError(
+            f"pod_scale shape {jnp.asarray(pod_scale).shape} != ({t},)")
     # First half aws, second half azure (node order is irrelevant to the
     # permutation-invariant policy; tests shuffle it).
     cloud = (jnp.arange(num_nodes) >= num_nodes // 2).astype(jnp.int32)
     return ClusterSetParams(
-        costs=table.costs,
-        latencies=table.latencies,
+        costs=f32(table.costs),
+        latencies=f32(table.latencies),
         cloud_of_node=cloud,
         cost_weight=f32(cost_weight),
         latency_weight=f32(latency_weight),
@@ -110,7 +180,22 @@ def make_params(
         pod_cpu_high=f32(pod_cpu_high),
         drain_rate=f32(drain_rate),
         max_steps=jnp.asarray(max_steps if max_steps is not None else t - 1, jnp.int32),
+        pod_scale=opt(pod_scale),
+        avail_mask=opt(avail_mask),
+        churn_penalty=(f32(churn_penalty if churn_penalty is not None else 1.0)
+                       if avail_mask is not None else None),
+        jitter_range=opt(jitter_range),
+        drain_range=opt(drain_range),
+        overload_range=opt(overload_range),
+        random_phase=bool(random_phase),
     )
+
+
+def _table_row(params: ClusterSetParams, state: ClusterSetState) -> jnp.ndarray:
+    """The table row this step replays: the episode's phase offset shifts
+    it (mod T). Legacy phase is 0 and ``step_idx < T`` always, so the mod
+    is the identity there — values are unchanged."""
+    return (state.step_idx + state.phase) % params.num_table_rows
 
 
 def node_costs_latencies(
@@ -118,22 +203,38 @@ def node_costs_latencies(
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Per-node (cost, latency) at the current table row: cloud value +
     static node premium, clipped to [0, 1]."""
-    row_costs = jax.lax.dynamic_index_in_dim(params.costs, state.step_idx, keepdims=False)
-    row_lats = jax.lax.dynamic_index_in_dim(params.latencies, state.step_idx, keepdims=False)
+    row = _table_row(params, state)
+    row_costs = jax.lax.dynamic_index_in_dim(params.costs, row, keepdims=False)
+    row_lats = jax.lax.dynamic_index_in_dim(params.latencies, row, keepdims=False)
     cost = row_costs[params.cloud_of_node] + state.node_premium[:, 0]
     lat = row_lats[params.cloud_of_node] + state.node_premium[:, 1]
     return jnp.clip(cost, 0.0, 1.0), jnp.clip(lat, 0.0, 1.0)
 
 
+def _avail_row(params: ClusterSetParams, state: ClusterSetState) -> jnp.ndarray:
+    """``[N]`` availability at the current row (churn family only)."""
+    return jax.lax.dynamic_index_in_dim(
+        params.avail_mask, _table_row(params, state), keepdims=False)
+
+
 def _observe(params: ClusterSetParams, state: ClusterSetState) -> jnp.ndarray:
     cost, lat = node_costs_latencies(params, state)
+    cpu_used = state.cpu_used
+    if params.avail_mask is not None:
+        # A down node observes as maximally expensive/slow/loaded — the
+        # serving-time shape of a cordoned node, and argmax-repellent
+        # without widening the feature space trained checkpoints expect.
+        up = _avail_row(params, state) > 0
+        cost = jnp.where(up, cost, 1.0)
+        lat = jnp.where(up, lat, 1.0)
+        cpu_used = jnp.where(up, cpu_used, 1.0)
     n = params.num_nodes
     step_frac = state.step_idx.astype(jnp.float32) / params.max_steps.astype(jnp.float32)
     return jnp.stack(
         [
             cost,
             lat,
-            state.cpu_used,
+            cpu_used,
             params.cloud_of_node.astype(jnp.float32),
             jnp.full((n,), state.pod_cpu),
             jnp.full((n,), step_frac),
@@ -142,24 +243,56 @@ def _observe(params: ClusterSetParams, state: ClusterSetState) -> jnp.ndarray:
     ).astype(jnp.float32)
 
 
-def _draw_pod(params: ClusterSetParams, key: jnp.ndarray) -> jnp.ndarray:
-    return jax.random.uniform(
+def _draw_pod(params: ClusterSetParams, key: jnp.ndarray,
+              row: jnp.ndarray | None = None) -> jnp.ndarray:
+    pod = jax.random.uniform(
         key, (), jnp.float32, minval=params.pod_cpu_low, maxval=params.pod_cpu_high
     )
+    if params.pod_scale is not None and row is not None:
+        # Arrival intensity: peak-hours pods are bigger (bursty-diurnal
+        # family). Same RNG draw either way — the multiplier is a table
+        # gather, so legacy streams are untouched.
+        pod = jnp.clip(pod * params.pod_scale[row], 0.0, 1.0)
+    return pod
 
 
 def reset(params: ClusterSetParams, key: jnp.ndarray) -> tuple[ClusterSetState, jnp.ndarray]:
-    carry_key, prem_key, pod_key = jax.random.split(key, 3)
-    premium = params.node_jitter * jax.random.uniform(
+    if params.episode_randomized:
+        (carry_key, prem_key, pod_key, jit_key, drain_key, over_key,
+         phase_key) = jax.random.split(key, 7)
+        rng_between = lambda k, rg, default: (
+            default if rg is None else jax.random.uniform(
+                k, (), jnp.float32, minval=rg[0], maxval=rg[1]))
+        jitter = rng_between(jit_key, params.jitter_range, params.node_jitter)
+        ep_drain = rng_between(drain_key, params.drain_range, params.drain_rate)
+        ep_overload = rng_between(over_key, params.overload_range,
+                                  params.overload_penalty)
+        phase = (jax.random.randint(phase_key, (), 0, params.num_table_rows,
+                                    jnp.int32)
+                 if params.random_phase else jnp.zeros((), jnp.int32))
+    else:
+        # Legacy path: identical split count and draw order, so CSV-replay
+        # trajectories (and every measured baseline) stay bit-identical.
+        carry_key, prem_key, pod_key = jax.random.split(key, 3)
+        jitter = params.node_jitter
+        ep_drain = params.drain_rate
+        ep_overload = params.overload_penalty
+        phase = jnp.zeros((), jnp.int32)
+    premium = jitter * jax.random.uniform(
         prem_key, (params.num_nodes, 2), jnp.float32
     )
     state = ClusterSetState(
         step_idx=jnp.zeros((), jnp.int32),
         cpu_used=jnp.zeros(params.num_nodes, jnp.float32),
         node_premium=premium,
-        pod_cpu=_draw_pod(params, pod_key),
+        pod_cpu=jnp.zeros(()),  # placeholder; drawn below with the phase row
         key=carry_key,
+        phase=phase,
+        ep_drain=jnp.asarray(ep_drain, jnp.float32),
+        ep_overload=jnp.asarray(ep_overload, jnp.float32),
     )
+    state = state._replace(
+        pod_cpu=_draw_pod(params, pod_key, _table_row(params, state)))
     return state, _observe(params, state)
 
 
@@ -173,21 +306,33 @@ def step(
     cost, lat = node_costs_latencies(params, state)
     new_cpu = state.cpu_used.at[action].add(state.pod_cpu)
     overload = jnp.maximum(new_cpu[action] - 1.0, 0.0)
-    reward = -params.reward_scale * (
+    penalty_terms = (
         params.cost_weight * cost[action]
         + params.latency_weight * lat[action]
-        + params.overload_penalty * overload
+        + state.ep_overload * overload
     )
+    if params.avail_mask is not None:
+        # Placing on a down node costs churn_penalty reward units (the
+        # eviction + reschedule a real cluster pays). All-ones mask adds
+        # exactly 0.0, preserving the no-churn reward bitwise.
+        down = 1.0 - _avail_row(params, state)[action]
+        penalty_terms = penalty_terms + params.churn_penalty * down
+    reward = -params.reward_scale * penalty_terms
 
     new_step = state.step_idx + 1
     done = new_step >= params.max_steps
     new_state = ClusterSetState(
         step_idx=new_step,
-        cpu_used=new_cpu * params.drain_rate,  # completions drain load
+        cpu_used=new_cpu * state.ep_drain,  # completions drain load
         node_premium=state.node_premium,
-        pod_cpu=_draw_pod(params, pod_key),
+        pod_cpu=jnp.zeros(()),
         key=carry_key,
+        phase=state.phase,
+        ep_drain=state.ep_drain,
+        ep_overload=state.ep_overload,
     )
+    new_state = new_state._replace(
+        pod_cpu=_draw_pod(params, pod_key, _table_row(params, new_state)))
     ts = TimeStep(
         obs=_observe(params, new_state),
         reward=reward.astype(jnp.float32),

@@ -120,6 +120,24 @@ def reset(params: EnvParams, key: jnp.ndarray) -> tuple[EnvState, jnp.ndarray]:
     return state, _observe(params, step_idx, obs_key)
 
 
+def reset_random_start(
+    params: EnvParams, key: jnp.ndarray
+) -> tuple[EnvState, jnp.ndarray]:
+    """Scenario-layer reset: start at a uniformly random table row — the
+    per-episode phase randomization of :mod:`rl_scheduler_tpu.scenarios`
+    (policies cannot latch onto absolute row positions). A SEPARATE
+    function, not a params flag: the choice is made at bundle build time
+    (``env/bundle.multi_cloud_bundle(random_start=True)``), so the
+    legacy reset keeps its exact split count and draw order and the
+    params pytree stays all-array (a flag leaf would trace under
+    vmap/jit)."""
+    carry_key, obs_key, start_key = jax.random.split(key, 3)
+    step_idx = jax.random.randint(
+        start_key, (), 0, params.max_steps, jnp.int32)
+    state = EnvState(step_idx=step_idx, key=carry_key)
+    return state, _observe(params, step_idx, obs_key)
+
+
 def step(
     params: EnvParams, state: EnvState, action: jnp.ndarray
 ) -> tuple[EnvState, TimeStep]:

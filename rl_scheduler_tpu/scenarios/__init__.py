@@ -1,0 +1,52 @@
+"""graftscenario — trace-driven, heterogeneous workload scenarios.
+
+The package the north star's "as many scenarios as you can imagine" axis
+lives in (ROADMAP item 5): a :class:`Scenario` is a pure-functional,
+seeded spec that compiles into env-ready tables and per-episode
+randomized params, vmappable end-to-end so fleet training speed carries
+over. Four production-shaped families ship (``spec.SCENARIOS``):
+
+- ``bursty``        — bursty-diurnal arrival/load processes (sinusoid +
+                      seeded spike bursts; pod sizes follow the wave)
+- ``heterogeneous`` — multi-resource pods (cpu+mem+accelerator) over a
+                      heterogeneous fleet (``het_env.py``)
+- ``churn``         — node-pool preemptions/drains from graftguard's
+                      seeded FaultPlan stream, masked in/out mid-episode
+- ``price_spike``   — spot-market price-spike regimes generated through
+                      ``data/generate.py``
+
+Entry points: ``train_ppo --scenario NAME`` / ``train_dqn --scenario``,
+``python -m rl_scheduler_tpu.agent.evaluate --matrix`` (the scenario ×
+policy-family eval matrix), ``make eval-matrix``, and the extender's
+scenario-conformance check. Design doc: ``docs/scenarios.md``.
+"""
+
+from rl_scheduler_tpu.scenarios.spec import (
+    FAMILIES,
+    SCENARIOS,
+    Scenario,
+    baseline_columns,
+    cloud_table,
+    cluster_set_params,
+    get_scenario,
+    list_scenarios,
+    node_feat_for,
+    raw_prices,
+    scenario_bundle,
+    scenario_meta,
+)
+
+__all__ = [
+    "FAMILIES",
+    "SCENARIOS",
+    "Scenario",
+    "baseline_columns",
+    "cloud_table",
+    "cluster_set_params",
+    "get_scenario",
+    "list_scenarios",
+    "node_feat_for",
+    "raw_prices",
+    "scenario_bundle",
+    "scenario_meta",
+]

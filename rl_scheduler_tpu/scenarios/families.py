@@ -1,0 +1,197 @@
+"""Scenario-family table generators: production-shaped workloads, host-side.
+
+Every env family used to replay the same 100-row synthetic CSV
+(``data/real_prices.csv``'s flat i.i.d. jitter around two anchors), so no
+trained policy ever saw anything shaped like production traffic. The
+generators here compile a :class:`~rl_scheduler_tpu.scenarios.spec.Scenario`
+into the table space the envs already gather from — costs/latencies
+``[T, 2]``, per-step arrival intensity ``[T]``, node availability
+``[T, N]`` — once, host-side, seeded; the envs then step them inside the
+same jit/vmap programs as the CSV replay (no new per-step host work, so
+fleet training speed carries over — measured in ``bench.py
+--scenario-bench``).
+
+Determinism contract (pinned by ``tests/test_scenarios.py``): same
+``(family, knobs, seed)`` ⇒ bitwise-identical tables. Each generator owns
+ONE ``np.random.RandomState(seed)`` with a fixed draw order (the same
+discipline as ``data/generate.py``), and the churn generator reuses
+graftguard's :class:`~rl_scheduler_tpu.utils.faults.FaultPlan` per-site
+stream seeding so a churn schedule is reproducible from ``(seed, rate)``.
+
+Per-EPISODE randomization (phase offsets, node-premium/drain/overload
+draws) is NOT generated here — it rides the envs' per-env ``jax.random``
+keys at reset (``env/cluster_set.py`` scenario fields), so it stays fully
+vmappable and re-draws every episode.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+TWO_PI = 2.0 * np.pi
+
+
+def bursty_diurnal_tables(
+    steps: int = 100,
+    seed: int = 0,
+    period: float = 24.0,
+    spike_rate: float = 0.06,
+    spike_mag: float = 0.8,
+    spike_decay: float = 0.6,
+    load_latency_coupling: float = 0.5,
+    load_cost_coupling: float = 0.25,
+    pod_scale_low: float = 0.5,
+    pod_scale_high: float = 1.8,
+) -> dict:
+    """Family 1 — bursty-diurnal arrival/load processes.
+
+    A sinusoidal daily cycle (per-cloud phase offsets drawn from the
+    seed) plus seeded spike bursts drives three tables at once, the way
+    load actually propagates: latency follows load hardest
+    (``load_latency_coupling``), cost follows it weakly (demand pricing),
+    and the arriving pods' sizes follow it via ``pod_scale`` — the
+    arrival-intensity multiplier the cluster_set env applies to its
+    per-step pod draw (``ClusterSetParams.pod_scale``). Peak hours mean
+    bigger pods AND slower/costlier nodes, which is exactly when
+    bin-packing discipline pays.
+
+    Returns ``{"costs": [T,2], "latencies": [T,2], "pod_scale": [T]}``,
+    all float32, costs/latencies in [0, 1].
+    """
+    from rl_scheduler_tpu.data.generate import decaying_bursts
+
+    rng = np.random.RandomState(seed)
+    t = np.arange(steps, dtype=np.float64)
+    phases = rng.uniform(0.0, TWO_PI, 2)          # per-cloud diurnal phase
+    loads = []
+    for c in range(2):
+        diurnal = 0.5 + 0.5 * np.sin(TWO_PI * t / period + phases[c])
+        events = rng.uniform(size=steps) < spike_rate
+        mags = rng.uniform(0.5, 1.0, steps) * spike_mag
+        load = diurnal + decaying_bursts(events, mags, spike_decay)
+        loads.append(load)
+    loads = np.stack(loads, axis=1)               # [T, 2]
+    jitter = rng.uniform(-0.03, 0.03, (steps, 2))
+    lat = 0.25 + load_latency_coupling * loads + jitter
+    cost_base = np.array([0.3, 0.45])             # aws cheaper on average
+    cost = cost_base + load_cost_coupling * loads + rng.uniform(
+        -0.03, 0.03, (steps, 2))
+    mean_load = loads.mean(axis=1)
+    span = mean_load.max() - mean_load.min()
+    norm_load = (mean_load - mean_load.min()) / (span if span else 1.0)
+    pod_scale = pod_scale_low + (pod_scale_high - pod_scale_low) * norm_load
+    return {
+        "costs": np.clip(cost, 0.0, 1.0).astype(np.float32),
+        "latencies": np.clip(lat, 0.0, 1.0).astype(np.float32),
+        "pod_scale": pod_scale.astype(np.float32),
+    }
+
+
+def churn_mask(
+    steps: int = 100,
+    num_nodes: int = 8,
+    seed: int = 0,
+    preempt_rate: float = 0.02,
+    drain_steps: int = 8,
+) -> np.ndarray:
+    """Family 3 — node-pool churn: a ``[T, N]`` availability mask (1 = up).
+
+    Preemption events come from graftguard's seeded
+    :class:`~rl_scheduler_tpu.utils.faults.FaultPlan` (site
+    ``scenario.churn``, rates mode) consulted once per (node, step) in
+    node-major order — the identical ``(seed, site)`` stream discipline
+    the chaos suite runs on, so a churn schedule is byte-reproducible
+    from ``(seed, preempt_rate)`` and independent of every other fault
+    site. A preempted node stays down (drained) for ``drain_steps``
+    steps, then rejoins.
+
+    At least one node is kept up at every step (node 0 revived on
+    fully-dark rows): an all-down cluster has no placement decision to
+    learn from, only a constant penalty.
+    """
+    from rl_scheduler_tpu.utils.faults import FaultPlan
+
+    if drain_steps < 1:
+        raise ValueError(f"drain_steps={drain_steps}: must be >= 1")
+    plan = FaultPlan(seed=seed, rates={"scenario.churn": preempt_rate})
+    mask = np.ones((steps, num_nodes), np.float32)
+    for n in range(num_nodes):
+        down_until = -1
+        for t in range(steps):
+            if t <= down_until:
+                mask[t, n] = 0.0
+                continue
+            # One consult per up-step per node: the plan's call counter is
+            # what makes the schedule deterministic and rate-faithful.
+            if plan.fires("scenario.churn"):
+                mask[t, n] = 0.0
+                down_until = t + drain_steps - 1
+    dark = mask.sum(axis=1) == 0
+    mask[dark, 0] = 1.0
+    return mask
+
+
+def price_spike_tables(
+    steps: int = 100,
+    seed: int = 0,
+    spike_prob: float = 0.04,
+    spike_mult: float = 4.0,
+    decay: float = 0.7,
+) -> dict:
+    """Family 4 — spot-price spike regimes, generated through the repo's
+    own data pipeline: :func:`rl_scheduler_tpu.data.generate.
+    generate_price_spikes` synthesizes the raw dollar traces (rare
+    multiplicative anti-correlated spikes relaxing geometrically) and
+    :func:`rl_scheduler_tpu.data.normalize.normalize` MinMax-scales them
+    into the [0,1] table space — the exact path the shipped CSV takes, so
+    a scenario table is a drop-in replacement, not a parallel format.
+
+    Returns ``{"costs": [T,2], "latencies": [T,2], "raw_prices": [T,2]}``
+    (raw $/hr for the cluster-graph env's dollar-reward replay).
+    """
+    from rl_scheduler_tpu.data.generate import generate_price_spikes
+    from rl_scheduler_tpu.data.normalize import normalize
+
+    rng = np.random.RandomState(seed)
+    raw = generate_price_spikes(steps, seed=seed, spike_prob=spike_prob,
+                                spike_mult=spike_mult, decay=decay)
+    # Latency columns: the flat generator's shape (same anchors/jitter as
+    # data/generate.py), drawn from THIS family's stream so the whole
+    # table set is reproducible from one seed.
+    raw["latency_aws"] = 70.0 + rng.uniform(-10.0, 10.0, steps)
+    raw["latency_azure"] = 60.0 + rng.uniform(-10.0, 10.0, steps)
+    table = normalize(raw)
+    return {
+        "costs": table[["cost_aws", "cost_azure"]].to_numpy(np.float32),
+        "latencies": table[["latency_aws", "latency_azure"]
+                           ].to_numpy(np.float32),
+        "raw_prices": raw[["cost_aws", "cost_azure"]].to_numpy(np.float32),
+    }
+
+
+def heterogeneous_capacities(
+    num_nodes: int = 8,
+    num_resources: int = 3,
+    seed: int = 0,
+    acc_node_frac: float = 0.5,
+    cap_low: float = 0.5,
+    accless_cap: float = 0.05,
+) -> np.ndarray:
+    """Family 2 — per-node multi-resource capacities ``[N, R]``.
+
+    The first two resources (cpu, mem) draw continuous capacities in
+    ``[cap_low, 1]`` — a mixed fleet of machine sizes. Resources from
+    index 2 up model accelerators: a seeded ``acc_node_frac`` of nodes
+    carry full capacity, the rest ``accless_cap`` (effectively none —
+    placing an accelerator pod there blows the overload term, the
+    bin-packing pressure this family exists to create). At least one
+    node always carries each accelerator resource.
+    """
+    rng = np.random.RandomState(seed)
+    caps = rng.uniform(cap_low, 1.0, (num_nodes, num_resources))
+    for r in range(2, num_resources):
+        has = rng.uniform(size=num_nodes) < acc_node_frac
+        if not has.any():
+            has[int(rng.randint(num_nodes))] = True
+        caps[:, r] = np.where(has, 1.0, accless_cap)
+    return caps.astype(np.float32)

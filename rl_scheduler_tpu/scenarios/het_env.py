@@ -1,0 +1,269 @@
+"""Heterogeneous multi-resource pod/node-set simulator (scenario family 2).
+
+The cluster_set env (``env/cluster_set.py``) tracks ONE resource per node;
+real pods request cpu AND memory AND accelerators, and real fleets are
+heterogeneous — some nodes have no accelerator at all. This env widens the
+set simulator to ``R`` resources with per-node capacities, which widens
+the observation (and with it the set policy's score inputs): the embed
+layer infers its width from the obs, so the SAME
+``SetTransformerPolicy`` trains on it unchanged through the existing
+vmapped fleet path (it is a training-distribution change, not an
+architecture change — but checkpoints bake the width into the embed
+kernel, so scenario meta records ``node_feat`` and serving refuses a
+mismatch, ``scheduler/extender.py``).
+
+Per-node features (``NODE_FEAT = 4 + 3R`` columns, fixed order):
+
+  0        cost       — cloud cost from the replayed table + static premium
+  1        latency    — same construction
+  2..2+R   used_r     — utilization of resource r as a FRACTION of this
+                        node's capacity (placements add req/cap, completions
+                        drain geometrically)
+  2+R..2+2R cap_r     — the node's capacity in [0, 1] (static per episode;
+                        accelerator-less nodes show ~0, so the policy can
+                        see where an accelerator pod cannot fit)
+  2+2R     cloud_id   — 0 aws, 1 azure
+  3+2R..3+3R req_r    — the arriving pod's per-resource request (broadcast)
+  3+3R     step_frac  — episode progress
+
+Reward for placing on node ``a``:
+    -reward_scale * (w_c*cost[a] + w_l*lat[a]
+                     + overload_penalty * sum_r relu(used'[a, r] - 1))
+— the cluster_set trade-off with the overload term summed across
+resources: overloading ANY axis (including requesting an accelerator a
+node does not have) is punished, so bin-packing over the full request
+vector is what the optimal policy must learn.
+
+Pure-functional, seeded, jit/vmap/scan-safe — same contract as every env
+in ``env/`` (vmap parity and per-seed determinism pinned in
+``tests/test_scenarios.py``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+RESOURCES = ("cpu", "mem", "acc")
+
+
+def node_feat(num_resources: int) -> int:
+    """Observation width for an R-resource fleet (module docstring)."""
+    return 4 + 3 * num_resources
+
+
+class HetSetParams(NamedTuple):
+    costs: jnp.ndarray          # [T, 2] normalized cloud costs
+    latencies: jnp.ndarray      # [T, 2]
+    cloud_of_node: jnp.ndarray  # [N] int32
+    capacity: jnp.ndarray       # [N, R] per-node resource capacities
+    cost_weight: jnp.ndarray
+    latency_weight: jnp.ndarray
+    reward_scale: jnp.ndarray
+    overload_penalty: jnp.ndarray
+    node_jitter: jnp.ndarray
+    req_low: jnp.ndarray        # [R] per-resource request range
+    req_high: jnp.ndarray       # [R]
+    acc_request_prob: jnp.ndarray  # P(pod requests each accelerator resource)
+    drain_rate: jnp.ndarray
+    max_steps: jnp.ndarray
+
+    @property
+    def num_nodes(self) -> int:
+        return self.cloud_of_node.shape[0]
+
+    @property
+    def num_resources(self) -> int:
+        return self.capacity.shape[1]
+
+    @property
+    def node_feat(self) -> int:
+        return node_feat(self.num_resources)
+
+
+class HetSetState(NamedTuple):
+    step_idx: jnp.ndarray       # scalar int32
+    res_used: jnp.ndarray       # [N, R] fraction-of-capacity utilization
+    node_premium: jnp.ndarray   # [N, 2] static per-episode (cost, lat)
+    pod_req: jnp.ndarray        # [R] the pod awaiting placement
+    key: jnp.ndarray
+
+
+class TimeStep(NamedTuple):
+    obs: jnp.ndarray            # [N, node_feat]
+    reward: jnp.ndarray
+    done: jnp.ndarray
+    chosen_cloud: jnp.ndarray
+    step: jnp.ndarray
+
+
+def make_params(
+    num_nodes: int = 8,
+    num_resources: int = 3,
+    seed: int = 0,
+    cost_weight: float = 0.6,
+    latency_weight: float = 0.4,
+    reward_scale: float = 100.0,
+    overload_penalty: float = 2.0,
+    node_jitter: float = 0.1,
+    acc_node_frac: float = 0.5,
+    acc_request_prob: float = 0.35,
+    drain_rate: float = 0.85,
+    table=None,
+    data_path: str | None = None,
+    max_steps: int | None = None,
+) -> HetSetParams:
+    """Build params; capacities come from the seeded heterogeneous-fleet
+    generator (``families.heterogeneous_capacities``), tables from the
+    shipped CSV or a scenario's compiled tables (``table=``)."""
+    from rl_scheduler_tpu.data.loader import load_table
+    from rl_scheduler_tpu.scenarios.families import heterogeneous_capacities
+
+    if num_resources < 1:
+        raise ValueError(f"num_resources={num_resources}: must be >= 1")
+    if table is None:
+        table = load_table(data_path)
+    t = table.costs.shape[0]
+    f32 = lambda x: jnp.asarray(x, jnp.float32)
+    cloud = (jnp.arange(num_nodes) >= num_nodes // 2).astype(jnp.int32)
+    caps = heterogeneous_capacities(num_nodes, num_resources, seed,
+                                    acc_node_frac)
+    # Per-resource request ranges: cpu-like, memory-like, accelerator-like
+    # (cycled past R=3) — accelerator requests are chunky when they happen.
+    base_ranges = [(0.1, 0.4), (0.05, 0.3), (0.2, 0.6)]
+    lo, hi = zip(*(base_ranges[min(r, 2)] for r in range(num_resources)))
+    return HetSetParams(
+        costs=f32(table.costs),
+        latencies=f32(table.latencies),
+        cloud_of_node=cloud,
+        capacity=f32(caps),
+        cost_weight=f32(cost_weight),
+        latency_weight=f32(latency_weight),
+        reward_scale=f32(reward_scale),
+        overload_penalty=f32(overload_penalty),
+        node_jitter=f32(node_jitter),
+        req_low=f32(np.asarray(lo)),
+        req_high=f32(np.asarray(hi)),
+        acc_request_prob=f32(acc_request_prob),
+        drain_rate=f32(drain_rate),
+        max_steps=jnp.asarray(
+            max_steps if max_steps is not None else t - 1, jnp.int32),
+    )
+
+
+def _draw_req(params: HetSetParams, key: jnp.ndarray) -> jnp.ndarray:
+    """One pod's ``[R]`` request vector: continuous draws for cpu/mem,
+    Bernoulli-gated for accelerator resources (most pods want none)."""
+    r = params.req_low.shape[0]
+    ukey, gkey = jax.random.split(key)
+    base = jax.random.uniform(ukey, (r,), jnp.float32,
+                              minval=params.req_low, maxval=params.req_high)
+    gate = jax.random.bernoulli(gkey, params.acc_request_prob, (r,))
+    always = jnp.arange(r) < 2          # cpu/mem always requested
+    return jnp.where(always | gate, base, 0.0)
+
+
+def _observe(params: HetSetParams, state: HetSetState) -> jnp.ndarray:
+    n, r = params.capacity.shape
+    row_costs = jax.lax.dynamic_index_in_dim(
+        params.costs, state.step_idx, keepdims=False)
+    row_lats = jax.lax.dynamic_index_in_dim(
+        params.latencies, state.step_idx, keepdims=False)
+    cost = jnp.clip(
+        row_costs[params.cloud_of_node] + state.node_premium[:, 0], 0.0, 1.0)
+    lat = jnp.clip(
+        row_lats[params.cloud_of_node] + state.node_premium[:, 1], 0.0, 1.0)
+    step_frac = state.step_idx.astype(jnp.float32) / params.max_steps.astype(
+        jnp.float32)
+    cols = (
+        [cost, lat]
+        + [state.res_used[:, i] for i in range(r)]
+        + [params.capacity[:, i] for i in range(r)]
+        + [params.cloud_of_node.astype(jnp.float32)]
+        + [jnp.full((n,), state.pod_req[i]) for i in range(r)]
+        + [jnp.full((n,), step_frac)]
+    )
+    return jnp.stack(cols, axis=-1).astype(jnp.float32)
+
+
+def reset(params: HetSetParams, key: jnp.ndarray) -> tuple[HetSetState, jnp.ndarray]:
+    carry_key, prem_key, req_key = jax.random.split(key, 3)
+    premium = params.node_jitter * jax.random.uniform(
+        prem_key, (params.num_nodes, 2), jnp.float32)
+    state = HetSetState(
+        step_idx=jnp.zeros((), jnp.int32),
+        res_used=jnp.zeros(params.capacity.shape, jnp.float32),
+        node_premium=premium,
+        pod_req=_draw_req(params, req_key),
+        key=carry_key,
+    )
+    return state, _observe(params, state)
+
+
+def step(
+    params: HetSetParams, state: HetSetState, action: jnp.ndarray
+) -> tuple[HetSetState, TimeStep]:
+    """Place the pending pod on node ``action``; pure, jit/vmap/scan-safe."""
+    action = jnp.asarray(action, jnp.int32)
+    carry_key, req_key = jax.random.split(state.key)
+
+    row_costs = jax.lax.dynamic_index_in_dim(
+        params.costs, state.step_idx, keepdims=False)
+    row_lats = jax.lax.dynamic_index_in_dim(
+        params.latencies, state.step_idx, keepdims=False)
+    cost = jnp.clip(
+        row_costs[params.cloud_of_node] + state.node_premium[:, 0], 0.0, 1.0)
+    lat = jnp.clip(
+        row_lats[params.cloud_of_node] + state.node_premium[:, 1], 0.0, 1.0)
+
+    # Utilization is tracked as a fraction of THIS node's capacity, so the
+    # same request overloads a small node sooner — and an accelerator pod
+    # on an accelerator-less node (capacity ~0) blows up immediately.
+    cap_a = params.capacity[action]                       # [R]
+    add = state.pod_req / jnp.maximum(cap_a, 1e-3)
+    new_used = state.res_used.at[action].add(add)
+    overload = jnp.sum(jnp.maximum(new_used[action] - 1.0, 0.0))
+    reward = -params.reward_scale * (
+        params.cost_weight * cost[action]
+        + params.latency_weight * lat[action]
+        + params.overload_penalty * overload
+    )
+
+    new_step = state.step_idx + 1
+    done = new_step >= params.max_steps
+    new_state = HetSetState(
+        step_idx=new_step,
+        res_used=new_used * params.drain_rate,
+        node_premium=state.node_premium,
+        pod_req=_draw_req(params, req_key),
+        key=carry_key,
+    )
+    ts = TimeStep(
+        obs=_observe(params, new_state),
+        reward=reward.astype(jnp.float32),
+        done=done,
+        chosen_cloud=params.cloud_of_node[action],
+        step=new_step,
+    )
+    return new_state, ts
+
+
+def het_bundle(params: HetSetParams | None = None):
+    """The heterogeneous env as an :class:`~rl_scheduler_tpu.env.bundle.
+    EnvBundle` — trains through the same vmapped/auto-reset path as every
+    other family."""
+    from rl_scheduler_tpu.env.bundle import bundle_from_single
+
+    if params is None:
+        params = make_params()
+    return bundle_from_single(
+        lambda key: reset(params, key),
+        lambda state, action: step(params, state, action),
+        obs_shape=(params.num_nodes, params.node_feat),
+        num_actions=params.num_nodes,
+        name="cluster_set_het",
+        episode_steps=int(params.max_steps),
+    )

@@ -1,0 +1,269 @@
+"""The ``Scenario`` spec and registry: one object names a workload.
+
+A :class:`Scenario` is a frozen, hashable description — family, seed,
+knobs — that compiles deterministically into env-ready tables
+(``families.py``) and per-episode randomization fields the env layer
+draws from its own vmapped ``jax.random`` keys (``env/cluster_set.py``
+scenario fields, ``scenarios/het_env.py``). Nothing here holds state:
+the same Scenario builds the same params bit-for-bit every time
+(``tests/test_scenarios.py`` pins it), and the training/eval/serving
+layers pass the *name* around (CLI ``--scenario``, checkpoint meta,
+extender conformance) with the seed recorded alongside.
+
+Layer map:
+
+- **env**: :func:`cluster_set_params` / :func:`scenario_bundle` build the
+  structured-env params+bundle a scenario trains on;
+  :func:`cloud_table` / :func:`raw_prices` feed the flat multi-cloud and
+  graph envs the same compiled tables.
+- **agent**: ``train_ppo --scenario`` / ``train_dqn --scenario`` train on
+  the bundle and record :func:`scenario_meta` in every checkpoint;
+  ``agent/evaluate.py --matrix`` sweeps the registry × policy families.
+- **serving**: the extender reads the meta back and refuses a serve
+  config whose scenario disagrees (``scheduler/extender.py``);
+  :func:`baseline_columns` keeps the hand-coded baselines reading the
+  right feature columns on widened observations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+FAMILIES = ("bursty_diurnal", "heterogeneous", "churn", "price_spike")
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """A named, seeded workload-scenario spec (module docstring).
+
+    ``knobs`` is a sorted tuple of ``(name, value)`` pairs so the spec
+    stays hashable/frozen; use :meth:`knob` to read one.
+    """
+
+    name: str
+    family: str
+    seed: int = 0
+    steps: int = 100
+    knobs: tuple = ()
+
+    def __post_init__(self):
+        if self.family not in FAMILIES:
+            raise ValueError(
+                f"unknown scenario family {self.family!r}; choose from "
+                f"{list(FAMILIES)}")
+        if self.steps < 2:
+            raise ValueError(f"steps={self.steps}: a scenario table needs "
+                             "at least 2 rows (episode length >= 1)")
+
+    def knob(self, name: str, default: Any = None) -> Any:
+        for k, v in self.knobs:
+            if k == name:
+                return v
+        return default
+
+    def with_seed(self, seed: int) -> "Scenario":
+        """Same workload shape, different draw — the eval matrix and
+        determinism tests re-seed through this."""
+        return dataclasses.replace(self, seed=seed)
+
+
+def _knobs(**kw) -> tuple:
+    return tuple(sorted(kw.items()))
+
+
+# The registry: four production-shaped presets, one per family. Knobs are
+# the documented randomization surface (docs/scenarios.md); anything not
+# named here keeps the env default.
+SCENARIOS = {
+    "bursty": Scenario(
+        name="bursty", family="bursty_diurnal",
+        knobs=_knobs(period=24.0, spike_rate=0.06, spike_mag=0.8,
+                     jitter_range=(0.05, 0.2), random_phase=True),
+    ),
+    "heterogeneous": Scenario(
+        name="heterogeneous", family="heterogeneous",
+        knobs=_knobs(num_resources=3, acc_node_frac=0.5,
+                     acc_request_prob=0.35),
+    ),
+    "churn": Scenario(
+        name="churn", family="churn",
+        knobs=_knobs(preempt_rate=0.02, drain_steps=8, churn_penalty=1.0,
+                     drain_range=(0.75, 0.95), random_phase=True),
+    ),
+    "price_spike": Scenario(
+        name="price_spike", family="price_spike",
+        knobs=_knobs(spike_prob=0.04, spike_mult=4.0, decay=0.7,
+                     jitter_range=(0.05, 0.2), overload_range=(1.0, 4.0)),
+    ),
+}
+
+
+def list_scenarios() -> list:
+    return sorted(SCENARIOS)
+
+
+def get_scenario(name: str, seed: int | None = None) -> Scenario:
+    """Registry lookup; ``seed`` re-seeds the preset's table generation."""
+    if name not in SCENARIOS:
+        raise ValueError(
+            f"unknown scenario {name!r}; registered: {list_scenarios()}")
+    scn = SCENARIOS[name]
+    return scn if seed is None else scn.with_seed(seed)
+
+
+def _compiled(scenario: Scenario) -> dict:
+    """Family dispatch: the host-side compiled tables for this spec."""
+    from rl_scheduler_tpu.scenarios import families as fam
+
+    if scenario.family == "bursty_diurnal":
+        return fam.bursty_diurnal_tables(
+            steps=scenario.steps, seed=scenario.seed,
+            period=scenario.knob("period", 24.0),
+            spike_rate=scenario.knob("spike_rate", 0.06),
+            spike_mag=scenario.knob("spike_mag", 0.8),
+        )
+    if scenario.family == "price_spike":
+        return fam.price_spike_tables(
+            steps=scenario.steps, seed=scenario.seed,
+            spike_prob=scenario.knob("spike_prob", 0.04),
+            spike_mult=scenario.knob("spike_mult", 4.0),
+            decay=scenario.knob("decay", 0.7),
+        )
+    raise ValueError(
+        f"family {scenario.family!r} compiles no tables (churn compiles a "
+        "mask per node count; heterogeneous compiles capacities)")
+
+
+class _TableView:
+    """Duck-typed ``CloudTable`` (costs/latencies) over compiled arrays.
+
+    Leaves are device arrays: ``env/core.make_params`` stores the table
+    as-is, and numpy leaves would reject traced gather indices inside
+    the open-loop horizon."""
+
+    def __init__(self, costs, latencies):
+        import jax.numpy as jnp
+
+        self.costs = jnp.asarray(costs, jnp.float32)
+        self.latencies = jnp.asarray(latencies, jnp.float32)
+
+
+def cloud_table(scenario: Scenario):
+    """Compiled cost/latency tables for the FLAT multi-cloud env
+    (``env/core.make_params(table=...)``) — the bursty-diurnal and
+    price-spike families; the node-level families have no cloud-level
+    story to tell a 2-action policy."""
+    if scenario.family not in ("bursty_diurnal", "price_spike"):
+        raise ValueError(
+            f"scenario {scenario.name!r} (family {scenario.family}) has no "
+            "cloud-level tables; multi_cloud training takes the "
+            "bursty_diurnal and price_spike families")
+    t = _compiled(scenario)
+    return _TableView(t["costs"], t["latencies"])
+
+
+def raw_prices(scenario: Scenario):
+    """Raw ``[T, 2]`` $/hr for the cluster-graph env's dollar-reward
+    replay (price-spike family only — the one with a dollar story)."""
+    if scenario.family != "price_spike":
+        raise ValueError(
+            f"scenario {scenario.name!r} has no raw dollar prices; the "
+            "price_spike family drives cluster_graph")
+    return _compiled(scenario)["raw_prices"]
+
+
+def cluster_set_params(scenario: Scenario, num_nodes: int = 8):
+    """Env params for the structured set family this scenario shapes:
+    :class:`~rl_scheduler_tpu.env.cluster_set.ClusterSetParams` (bursty /
+    churn / price_spike) or the heterogeneous env's
+    :class:`~rl_scheduler_tpu.scenarios.het_env.HetSetParams`."""
+    from rl_scheduler_tpu.env import cluster_set as cs
+
+    randomization = dict(
+        jitter_range=scenario.knob("jitter_range"),
+        drain_range=scenario.knob("drain_range"),
+        overload_range=scenario.knob("overload_range"),
+        random_phase=bool(scenario.knob("random_phase", False)),
+    )
+    if scenario.family == "heterogeneous":
+        from rl_scheduler_tpu.scenarios import het_env
+
+        return het_env.make_params(
+            num_nodes=num_nodes,
+            num_resources=int(scenario.knob("num_resources", 3)),
+            seed=scenario.seed,
+            acc_node_frac=scenario.knob("acc_node_frac", 0.5),
+            acc_request_prob=scenario.knob("acc_request_prob", 0.35),
+        )
+    if scenario.family == "churn":
+        from rl_scheduler_tpu.scenarios.families import churn_mask
+
+        # The mask is compiled at the shipped table's length so the
+        # episode stays table-shaped; it is node-count-specific.
+        table = _default_table()
+        mask = churn_mask(
+            steps=table.costs.shape[0], num_nodes=num_nodes,
+            seed=scenario.seed,
+            preempt_rate=scenario.knob("preempt_rate", 0.02),
+            drain_steps=int(scenario.knob("drain_steps", 8)),
+        )
+        return cs.make_params(
+            num_nodes=num_nodes, table=table, avail_mask=mask,
+            churn_penalty=scenario.knob("churn_penalty", 1.0),
+            **randomization)
+    t = _compiled(scenario)
+    return cs.make_params(
+        num_nodes=num_nodes,
+        table=_TableView(t["costs"], t["latencies"]),
+        pod_scale=t.get("pod_scale"),
+        **randomization)
+
+
+def _default_table():
+    from rl_scheduler_tpu.data.loader import load_table
+
+    return load_table()
+
+
+def scenario_bundle(scenario: Scenario, num_nodes: int = 8):
+    """The scenario's structured env as an
+    :class:`~rl_scheduler_tpu.env.bundle.EnvBundle` — same vmapped
+    auto-reset fleet path every other env family trains through."""
+    if scenario.family == "heterogeneous":
+        from rl_scheduler_tpu.scenarios.het_env import het_bundle
+
+        return het_bundle(cluster_set_params(scenario, num_nodes))
+    from rl_scheduler_tpu.env.bundle import cluster_set_bundle
+
+    return cluster_set_bundle(cluster_set_params(scenario, num_nodes))
+
+
+def node_feat_for(scenario: Scenario) -> int:
+    """Observation width the scenario trains (and must serve) with."""
+    if scenario.family == "heterogeneous":
+        from rl_scheduler_tpu.scenarios.het_env import node_feat
+
+        return node_feat(int(scenario.knob("num_resources", 3)))
+    from rl_scheduler_tpu.env.cluster_set import NODE_FEAT
+
+    return NODE_FEAT
+
+
+def baseline_columns(scenario: Scenario) -> dict:
+    """The ``{feature: column}`` map the hand-coded node baselines read on
+    this scenario's observation layout (``env/baselines.py``)."""
+    # Every current family keeps cost at 0 and the first utilization
+    # column at 2 (cluster_set layout; het_env pins the same prefix).
+    return {"cost": 0, "cpu": 2}
+
+
+def scenario_meta(scenario: Scenario) -> dict:
+    """The checkpoint-meta record: enough to rebuild the bundle at
+    eval/serve time and to refuse a mismatched serve config."""
+    return {
+        "scenario": scenario.name,
+        "scenario_seed": scenario.seed,
+        "scenario_family": scenario.family,
+        "node_feat": node_feat_for(scenario),
+    }

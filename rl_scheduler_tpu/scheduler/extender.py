@@ -68,8 +68,21 @@ MAX_EXTENDER_SCORE = 100
 # parseable resources.requests.cpu.
 DEFAULT_POD_CPU = 0.25
 DEFAULT_NODE_CAPACITY_CORES = 4.0
+# Heterogeneous-scenario serving defaults (scenarios/het_env.py): node
+# memory and accelerator capacity for normalizing a pod's requests into
+# [0, 1] fractions, mirroring the cpu-cores default above.
+DEFAULT_NODE_MEMORY_BYTES = 16 * 1024 ** 3
+DEFAULT_NODE_GPUS = 1.0
+# Training draws the mem/acc midpoints when the pod carries no request
+# (env req ranges: mem U[0.05, 0.3]; acc gated, often 0).
+DEFAULT_POD_MEM = 0.15
+DEFAULT_POD_ACC = 0.0
 
 _CPU_QTY = re.compile(r"^\s*(\d+(?:\.\d+)?)(m?)\s*$")
+_MEM_QTY = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*(Ki|Mi|Gi|Ti|K|M|G|T|k)?\s*$")
+_MEM_MULT = {None: 1.0, "k": 1e3, "K": 1e3, "M": 1e6, "G": 1e9, "T": 1e12,
+             "Ki": 2 ** 10, "Mi": 2 ** 20, "Gi": 2 ** 30, "Ti": 2 ** 40}
+_GPU_KEYS = ("nvidia.com/gpu", "amd.com/gpu", "google.com/tpu")
 
 
 def pod_cpu_fraction(pod: dict | None,
@@ -103,6 +116,58 @@ def pod_cpu_fraction(pod: dict | None,
     except Exception:  # noqa: BLE001 - malformed manifest: fail open
         logger.debug("unparseable pod cpu request; using default", exc_info=True)
         return DEFAULT_POD_CPU
+
+
+def pod_resource_fractions(
+    pod: dict | None,
+    capacity_cores: float = DEFAULT_NODE_CAPACITY_CORES,
+    capacity_bytes: float = DEFAULT_NODE_MEMORY_BYTES,
+    capacity_gpus: float = DEFAULT_NODE_GPUS,
+) -> list:
+    """``[cpu, mem, acc]`` request fractions for heterogeneous-scenario
+    serving (``scenarios/het_env.py`` feature order).
+
+    cpu reuses :func:`pod_cpu_fraction`; memory sums
+    ``resources.requests.memory`` k8s quantities (``128Mi``/``1Gi``/
+    decimal suffixes); accelerator sums the extended-resource GPU/TPU
+    keys (``nvidia.com/gpu`` etc., integer counts). Unparseable/missing
+    requests fall back to the training distribution's defaults — serving
+    must never wedge on a weird manifest (same fail-open contract as the
+    cpu path).
+    """
+    cpu = pod_cpu_fraction(pod, capacity_cores)
+    mem = acc = None
+    try:
+        containers = ((pod or {}).get("spec") or {}).get("containers") or []
+        mem_total = acc_total = 0.0
+        mem_seen = acc_seen = False
+        for c in containers:
+            requests = ((c.get("resources") or {}).get("requests") or {})
+            q = requests.get("memory")
+            if q is not None:
+                m = _MEM_QTY.match(str(q))
+                if m is not None:
+                    mem_total += float(m.group(1)) * _MEM_MULT[m.group(2)]
+                    mem_seen = True
+            for key in _GPU_KEYS:
+                q = requests.get(key)
+                if q is None:
+                    continue
+                try:
+                    acc_total += float(q)
+                    acc_seen = True
+                except (TypeError, ValueError):
+                    pass
+        if mem_seen:
+            mem = min(max(mem_total / capacity_bytes, 0.0), 1.0)
+        if acc_seen:
+            acc = min(max(acc_total / capacity_gpus, 0.0), 1.0)
+    except Exception:  # noqa: BLE001 - malformed manifest: fail open
+        logger.debug("unparseable pod resource requests; using defaults",
+                     exc_info=True)
+    return [cpu,
+            DEFAULT_POD_MEM if mem is None else mem,
+            DEFAULT_POD_ACC if acc is None else acc]
 
 
 def node_cloud(node: dict | str) -> str | None:
@@ -290,11 +355,21 @@ class ExtenderPolicy:
                  price_replay: str = "counter",
                  price_replay_period_s: float = 300.0,
                  max_score_nodes: int = 0,
-                 price_counter=None):
+                 price_counter=None,
+                 num_resources: int = 0,
+                 scenario: str | None = None):
         self.backend = backend
         self.family = getattr(backend, "family", "cloud")
         self.telemetry = telemetry
         self.node_capacity_cores = node_capacity_cores
+        # Heterogeneous-scenario serving (scenarios/het_env.py): R > 0
+        # switches the set family's observation to the widened
+        # multi-resource layout (observe_nodes_het) and parses the pod's
+        # full request vector. `scenario` is provenance from checkpoint
+        # meta, surfaced on /healthz and matched against the serve
+        # config's --scenario (build_policy refuses a disagreement).
+        self.num_resources = int(num_resources)
+        self.scenario = scenario
         # graftserve (scheduler/pool.py) sets this on pool workers so
         # /healthz reports pool membership; None keeps the single-process
         # health body byte-identical.
@@ -378,11 +453,20 @@ class ExtenderPolicy:
             self._decisions[CLOUDS[action]] += 1
         return action, probs, obs
 
-    def decide_set(self, clouds: list, pod_cpu: float) -> tuple[int, np.ndarray, np.ndarray]:
+    def decide_set(self, clouds: list, pod_cpu: float,
+                   pod_reqs: list | None = None) -> tuple[int, np.ndarray, np.ndarray]:
         """One set-family pointer decision over the request's nodes; timed
-        like :meth:`decide`. ``clouds`` has one aws/azure/None per node."""
+        like :meth:`decide`. ``clouds`` has one aws/azure/None per node;
+        ``pod_reqs`` is the parsed ``[R]`` request vector when this
+        policy serves a heterogeneous-scenario checkpoint."""
         t0 = time.perf_counter()
-        obs = self.telemetry.observe_nodes(clouds, pod_cpu)
+        if self.num_resources:
+            reqs = (pod_reqs if pod_reqs is not None
+                    else [pod_cpu, DEFAULT_POD_MEM, DEFAULT_POD_ACC])
+            obs = self.telemetry.observe_nodes_het(clouds, reqs,
+                                                   self.num_resources)
+        else:
+            obs = self.telemetry.observe_nodes(clouds, pod_cpu)
         action, logits = self._backend_call(self.backend.decide_nodes, obs)
         self.stats.record(time.perf_counter() - t0)
         z = logits - logits.max()
@@ -443,7 +527,9 @@ class ExtenderPolicy:
         else:
             sub_clouds, sub_display = clouds, display
         if self.family == "set":
-            action, probs, _ = self.decide_set(sub_clouds, pod_cpu)
+            pod_reqs = (pod_resource_fractions(pod, self.node_capacity_cores)
+                        if self.num_resources else None)
+            action, probs, _ = self.decide_set(sub_clouds, pod_cpu, pod_reqs)
         else:
             action, probs, _ = self.decide_graph(sub_clouds, sub_display,
                                                  pod, pod_cpu)
@@ -623,6 +709,8 @@ class ExtenderPolicy:
     def health(self) -> dict:
         out = {"status": "ok", "backend": self.backend.name,
                "family": self.family}
+        if self.scenario is not None:
+            out["scenario"] = self.scenario
         if self.pool_info is not None:
             out.update(self.pool_info)
         return out
@@ -862,8 +950,19 @@ def build_policy(
     max_score_nodes: int = 0,
     price_counter=None,
     table_counter=None,
+    scenario: str | None = None,
 ) -> ExtenderPolicy:
     """Assemble the serving stack: checkpoint -> backend -> telemetry.
+
+    ``scenario`` is the serve config's conformance demand (``--scenario``):
+    the checkpoint's recorded scenario meta must MATCH it or the build
+    refuses — serving a churn-trained policy where the operator deployed
+    for the heterogeneous workload (or vice versa) is a silent
+    distribution mismatch, and for the heterogeneous family an outright
+    observation-width mismatch. A scenario-trained cluster_set checkpoint
+    also auto-configures the widened observation path from its
+    ``node_feat`` meta (no flag needed); the demand flag exists so a
+    DEPLOYMENT can pin what it expects.
 
     ``price_counter``/``table_counter`` are graftserve's pool seams
     (``scheduler/pool.SharedCounter``): cross-process replay positions so
@@ -881,8 +980,11 @@ def build_policy(
     hidden = (256, 256)
     algo = "ppo"
     backend_obj = None
+    ckpt_scenario = None
+    num_resources = 0
+    meta = None
     if backend != "greedy":
-        tree = meta = run_dir = None
+        tree = run_dir = None
         try:
             from rl_scheduler_tpu.config import RuntimeConfig
             from rl_scheduler_tpu.utils.checkpoint import (
@@ -900,6 +1002,18 @@ def build_policy(
             logger.exception("checkpoint load failed; serving cost-greedy fallback")
         if meta is not None:
             ckpt_env = meta.get("env", "multi_cloud")
+            ckpt_scenario = meta.get("scenario")
+            node_feat = meta.get("node_feat")
+            if (ckpt_env == "cluster_set" and node_feat
+                    and node_feat != 6):
+                # Heterogeneous-scenario checkpoint: the embed kernel
+                # bakes the widened layout (4 + 3R features,
+                # scenarios/het_env.py) — serve the matching observation.
+                num_resources = (int(node_feat) - 4) // 3
+                logger.info(
+                    "scenario checkpoint (%s): serving the widened "
+                    "%d-feature observation (%d resources)",
+                    ckpt_scenario, node_feat, num_resources)
             if ckpt_env == "cluster_set":
                 # The set policy's pointer logits score candidate nodes
                 # directly — exactly the /prioritize contract. Both the
@@ -918,6 +1032,7 @@ def build_policy(
                 backend_obj, _ = make_set_backend(
                     backend, tree, num_heads=meta.get("num_heads") or 1,
                     device=serve_device, warm_counts=tuple(warm_nodes),
+                    node_feat=node_feat,
                 )
             elif ckpt_env == "cluster_graph":
                 # The GNN's pointer head also scores nodes directly; its
@@ -974,6 +1089,17 @@ def build_policy(
                         "malformed checkpoint meta at %s; serving cost-greedy "
                         "fallback", run_dir,
                     )
+    if scenario is not None and ckpt_scenario != scenario:
+        # The serve config demanded a scenario this checkpoint was not
+        # trained for (or no checkpoint loaded at all, so nothing vouches
+        # for it): refuse to start rather than serve a silently mismatched
+        # distribution — for the heterogeneous family, a mismatched
+        # observation WIDTH (docs/scenarios.md conformance contract).
+        trained = (f"scenario {ckpt_scenario!r}" if ckpt_scenario
+                   else "the CSV replay (no scenario meta)")
+        raise ValueError(
+            f"--scenario {scenario}: the loaded checkpoint was trained on "
+            f"{trained}; serve a matching checkpoint or drop the demand")
     if backend_obj is None:
         backend_obj, _ = make_backend(backend, params_tree, hidden,
                                       serve_device, algo)
@@ -991,6 +1117,14 @@ def build_policy(
                             price_replay_period_s=price_replay_period_s,
                             max_score_nodes=max_score_nodes,
                             price_counter=price_counter)
+    # Scenario provenance set post-construction (the attributes default to
+    # off in __init__): policy stand-ins that mimic the historical ctor
+    # signature keep working, and only checkpoint-meta-driven builds flip
+    # them.
+    if num_resources:
+        policy.num_resources = num_resources
+    if ckpt_scenario is not None:
+        policy.scenario = ckpt_scenario
     if max_score_nodes and policy.family not in ExtenderPolicy.STRUCTURED:
         # Same refuse-before-traffic rule as price_replay below: the flat
         # family scores per CLOUD (two logits however long the node list
@@ -1093,6 +1227,13 @@ def main(argv: list[str] | None = None) -> None:
                         "fleet's actual candidate-list sizes so no first "
                         "request is served by the overflow forward while "
                         "a background compile runs")
+    p.add_argument("--scenario", default=None,
+                   help="conformance demand: refuse to start unless the "
+                        "loaded checkpoint's scenario meta matches this "
+                        "name (docs/scenarios.md). Scenario checkpoints "
+                        "auto-configure their observation width either "
+                        "way; this flag pins what the DEPLOYMENT expects "
+                        "so a mismatched checkpoint cannot silently serve")
     p.add_argument("--max-score-nodes", type=int, default=0, metavar="K",
                    help="structured families: score at most K candidate "
                         "nodes per request (a uniform per-request sample; "
@@ -1179,6 +1320,7 @@ def main(argv: list[str] | None = None) -> None:
         price_replay_period_s=args.price_replay_period,
         warm_nodes=warm_nodes,
         max_score_nodes=args.max_score_nodes,
+        scenario=args.scenario,
     )
     if args.workers is not None:
         # graftserve: the supervisor never builds a policy (workers each

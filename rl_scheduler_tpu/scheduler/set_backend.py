@@ -261,7 +261,8 @@ class JaxSetAOTBackend:
 
     def __init__(self, params_tree: dict, num_heads: int = 1,
                  depth: int = SET_DEPTH, device: str = "cpu",
-                 warm_counts: tuple = (8,), max_cached: int = 16):
+                 warm_counts: tuple = (8,), max_cached: int = 16,
+                 node_feat: int | None = None):
         import collections
 
         import jax
@@ -270,7 +271,11 @@ class JaxSetAOTBackend:
         from rl_scheduler_tpu.models.transformer import SetTransformerPolicy
 
         self._jax = jax
-        self._node_feat = NODE_FEAT
+        # Scenario-trained checkpoints can widen the observation (the
+        # heterogeneous family's multi-resource features); the AOT
+        # executable's obs spec must match the trained width or the
+        # warm compile raises at startup (checkpoint meta `node_feat`).
+        self._node_feat = NODE_FEAT if node_feat is None else int(node_feat)
         self._net = SetTransformerPolicy(dim=SET_DIM, depth=depth,
                                          num_heads=num_heads)
         try:
@@ -409,9 +414,10 @@ class LoadAwareSetBackend:
 
     def __init__(self, params_tree: dict, num_heads: int = 1,
                  device: str = "cpu", max_concurrent_jax: int = 2,
-                 warm_counts: tuple = (8,)):
+                 warm_counts: tuple = (8,), node_feat: int | None = None):
         self._jax = JaxSetAOTBackend(params_tree, num_heads, device=device,
-                                     warm_counts=warm_counts)
+                                     warm_counts=warm_counts,
+                                     node_feat=node_feat)
         if device != "cpu":
             logger.info(
                 "load-aware shedding disabled for serve device %r (the host "
@@ -649,7 +655,8 @@ class LoadAwareSetBackend:
 
 
 def make_set_backend(backend: str, params_tree: dict, num_heads: int = 1,
-                     device: str = "cpu", warm_counts: tuple = (8,)):
+                     device: str = "cpu", warm_counts: tuple = (8,),
+                     node_feat: int | None = None):
     """Build a set-family backend for the extender's ``--backend`` flag.
 
     ``jax`` -> load-aware AOT (per-N executable cache, native/numpy
@@ -678,7 +685,8 @@ def make_set_backend(backend: str, params_tree: dict, num_heads: int = 1,
     try:
         if backend == "jax":
             return LoadAwareSetBackend(params_tree, num_heads, device=device,
-                                       warm_counts=warm_counts), False
+                                       warm_counts=warm_counts,
+                                       node_feat=node_feat), False
         return NumpySetBackend(params_tree, num_heads), False
     except Exception:
         from rl_scheduler_tpu.scheduler.policy_backend import GreedyBackend

@@ -236,3 +236,31 @@ class TableTelemetry:
         rows[:, 4] = pod_cpu
         rows[:, 5] = step_frac
         return rows
+
+    def observe_nodes_het(self, clouds: list, pod_reqs,
+                          num_resources: int) -> np.ndarray:
+        """Widened per-node observation for heterogeneous-scenario
+        checkpoints: ``[N, 4 + 3R]`` matching the training layout
+        (``scenarios/het_env.py``): cost, lat, used_0..R-1, cap_0..R-1,
+        cloud_id, req_0..R-1, step_frac.
+
+        Serving proxies, documented like the classic path's: utilization
+        of EVERY resource is the node's cloud cpu telemetry (the one live
+        meter — per-resource node meters slot in here), capacities are
+        1.0 (unknown at serve time; a real inventory source slots in),
+        and ``pod_reqs`` is the ``[R]`` request vector parsed from the
+        pod manifest (``extender.pod_resource_fractions``).
+        """
+        base = self.observe_nodes(clouds, 0.0)     # shared cost/lat/cpu/cloud
+        n, r = len(clouds), int(num_resources)
+        reqs = np.zeros(r, np.float32)
+        reqs[: len(pod_reqs)] = np.asarray(pod_reqs, np.float32)[:r]
+        rows = np.empty((n, 4 + 3 * r), np.float32)
+        rows[:, 0] = base[:, 0]                     # cost
+        rows[:, 1] = base[:, 1]                     # latency
+        rows[:, 2:2 + r] = base[:, 2:3]             # used_r (cpu proxy)
+        rows[:, 2 + r:2 + 2 * r] = 1.0              # cap_r (neutral)
+        rows[:, 2 + 2 * r] = base[:, 3]             # cloud_id
+        rows[:, 3 + 2 * r:3 + 3 * r] = reqs         # req_r
+        rows[:, 3 + 3 * r] = base[:, 5]             # step_frac
+        return rows

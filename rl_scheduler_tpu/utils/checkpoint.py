@@ -434,6 +434,23 @@ class CheckpointManager:
         self._verified.clear()
         self._prune_manifests()
 
+    def delete_steps_after(self, step: int) -> None:
+        """Delete every checkpoint step NEWER than ``step``.
+
+        The ``--resume-best`` salvage semantics: training onward from the
+        peak ABANDONS the degraded tail past it — and those step numbers
+        must be free, or the continuation's periodic/final saves at them
+        would be refused by Orbax (the same already-exists refusal the
+        reseed path clears for) and silently swallowed as non-fatal save
+        failures, leaving the continued run persisted nowhere."""
+        self._finalize_pending()
+        for s in list(self._mgr.all_steps()):
+            if s > step:
+                self._mgr.delete(s)
+                self._verified.discard(s)
+        self._mgr.wait_until_finished()
+        self._prune_manifests()
+
     def close(self) -> None:
         """Finalize the in-flight save (manifest included) and release
         Orbax's resources. Always call this — an unfinalized final save

@@ -45,8 +45,13 @@ logger = logging.getLogger(__name__)
 #                      backend stub; the extender's breaker absorbs it)
 #   preempt            PreemptionGuard.should_stop() reports a simulated
 #                      SIGTERM at the next dispatch boundary
+#   scenario.churn     consulted per (node, step) by the scenario layer's
+#                      node-pool churn generator (scenarios/families.py) to
+#                      decide which nodes get preempted when — the same
+#                      seeded per-site stream discipline, reused so a churn
+#                      schedule is reproducible from (seed, rate) alone
 SITES = ("checkpoint.save", "checkpoint.partial", "telemetry.scrape",
-         "k8s.place", "backend.decide", "preempt")
+         "k8s.place", "backend.decide", "preempt", "scenario.churn")
 
 
 class FaultInjected(RuntimeError):

@@ -867,3 +867,91 @@ def test_chaos_soak_random_rates(tmp_path):
     fresh = CheckpointManager(tmp_path / "soak")
     assert fresh.latest_verified_step() is not None
     fresh.close()
+
+
+# ----------------------------------------------- best-eval checkpoint (3a)
+
+
+class TestBestEvalCheckpoint:
+    """ROADMAP item 3a: the best-in-training-eval keeper salvages the
+    measured late-degrade failure mode — a run whose eval peaks mid-
+    training and ends below it must leave its PEAK weights in best/."""
+
+    def test_late_degrade_run_is_salvaged(self, tmp_path):
+        from rl_scheduler_tpu.agent.loop import make_best_checkpoint_hook
+        from rl_scheduler_tpu.utils.checkpoint import load_policy_params
+
+        best = CheckpointManager(tmp_path / "best", keep=1)
+        # Runner stand-in: a float whose value IS the weights, so the
+        # restored params identify which iteration's runner was kept.
+        tree_fn = lambda r: {"params": {
+            "w": np.full(3, float(r), np.float32)}}
+        hook = make_best_checkpoint_hook(best, tree_fn,
+                                         extras={"env": "sim"})
+        # The measured late-degrade shape (docs/scaling.md §1b, seeds
+        # 5/8): healthy early, PEAK mid-run, final eval collapsed.
+        for i, value in [(0, -80.0), (7, -12.0), (15, -65.0)]:
+            hook(i, float(i), {"eval_episode_reward_mean": value})
+        best.close()
+        assert hook.best_value() == -12.0
+        params, meta = load_policy_params(tmp_path / "best")
+        assert meta["best_eval"] == -12.0
+        # The PEAK iteration's weights survive — not the degraded tail's.
+        np.testing.assert_array_equal(params["w"],
+                                      np.full(3, 7.0, np.float32))
+
+    def test_best_save_failure_is_nonfatal(self, tmp_path):
+        from rl_scheduler_tpu.agent.loop import make_best_checkpoint_hook
+
+        plan = FaultPlan(schedule={"checkpoint.save": (1,)})
+        best = CheckpointManager(tmp_path / "best", keep=1,
+                                 fault_plan=plan)
+        hook = make_best_checkpoint_hook(
+            best, lambda r: {"params": {"w": np.zeros(2, np.float32)}},
+            extras={})
+        hook(0, 0.0, {"eval_episode_reward_mean": 1.0})  # save fails
+        assert plan.fired.get("checkpoint.save") == 1
+        assert len(hook.failures) == 1
+        # The tracker still advanced: a better eval later saves normally.
+        hook(1, 1.0, {"eval_episode_reward_mean": 2.0})
+        best.close()
+        fresh = CheckpointManager(tmp_path / "best")
+        assert fresh.latest_verified_step() == 2
+        fresh.close()
+
+    def test_cli_keeps_best_and_resume_best_continues(self, tmp_path):
+        """Through the real CLI: --eval-every arms the keeper, best/
+        holds the peak eval, --resume-best trains onward from it, and
+        the degraded tail PAST the peak is abandoned (its step numbers
+        freed — otherwise the continuation's saves at them are refused
+        by Orbax and swallowed, and a stale newer step keeps winning
+        --resume/evaluate selection)."""
+        from rl_scheduler_tpu.agent import train_ppo
+        from rl_scheduler_tpu.agent.loop import BEST_DIR
+        from rl_scheduler_tpu.utils.checkpoint import (
+            CheckpointManager as Mgr,
+            load_policy_params,
+        )
+
+        base = ["--preset", "quick", "--num-envs", "4",
+                "--rollout-steps", "8", "--minibatch-size", "32",
+                "--eval-every", "1", "--eval-episodes", "2",
+                "--checkpoint-every", "1",
+                "--run-name", "BEST", "--run-root", str(tmp_path)]
+        run_dir = train_ppo.main(base + ["--iterations", "3"])
+        _, meta = load_policy_params(run_dir / BEST_DIR)
+        evals = [json.loads(line)["eval_episode_reward_mean"]
+                 for line in (run_dir / "metrics.jsonl").read_text().splitlines()
+                 if '"eval": true' in line]
+        assert meta["best_eval"] == pytest.approx(max(evals))
+        best_step = 1 + evals.index(max(evals))
+        # Resume from the best checkpoint, train one iteration past it.
+        run_dir = train_ppo.main(base + ["--iterations", str(best_step + 1),
+                                         "--resume-best"])
+        lines = (run_dir / "metrics.jsonl").read_text().splitlines()
+        assert any('"resume_source": "best"' in line for line in lines)
+        # The continuation's save is the NEWEST step: any degraded-tail
+        # step beyond it was deleted, not left to shadow the salvage.
+        mgr = Mgr(run_dir)
+        assert mgr.latest_verified_step() == best_step + 1
+        mgr.close()

@@ -1,0 +1,530 @@
+"""graftscenario tests: the workload-scenario subsystem (docs/scenarios.md).
+
+Covers the subsystem's contracts layer by layer:
+
+- packaging: ``rl_scheduler_tpu.scenarios`` is a REAL package (the seed
+  shipped a ``__pycache__``-only directory — a namespace-package trap
+  where stale ``.pyc`` names looked importable and nothing was).
+- per-family determinism: same ``(family, knobs, seed)`` ⇒ bitwise-
+  identical compiled tables; different seed ⇒ different tables.
+- vmap/jit parity: a batched ``reset_batch``/``step_batch`` scenario draw
+  equals the single-env functions applied per key.
+- churn-mask reward invariants: an all-ones mask is a bitwise no-op; a
+  down node costs exactly ``reward_scale * churn_penalty`` extra.
+- per-episode randomization: the domain-randomized fields re-draw per
+  episode from the env's own keys; the legacy path keeps its values.
+- CLI round-trip: a scenario trained through the REAL train_ppo CLI pins
+  its scenario meta through checkpoint save → evaluate rebuild → resume
+  guards.
+- serving conformance: the extender serves a scenario-trained checkpoint
+  end-to-end over HTTP and refuses a mismatched --scenario demand.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_scheduler_tpu.env import cluster_set as cs
+from rl_scheduler_tpu.scenarios import (
+    FAMILIES,
+    SCENARIOS,
+    Scenario,
+    baseline_columns,
+    cloud_table,
+    cluster_set_params,
+    get_scenario,
+    list_scenarios,
+    node_feat_for,
+    raw_prices,
+    scenario_bundle,
+    scenario_meta,
+)
+from rl_scheduler_tpu.scenarios import het_env
+from rl_scheduler_tpu.scenarios.families import (
+    bursty_diurnal_tables,
+    churn_mask,
+    heterogeneous_capacities,
+    price_spike_tables,
+)
+
+
+# ------------------------------------------------------------- packaging
+
+
+def test_scenarios_is_a_real_package():
+    """The seed's scenarios/ held only a __pycache__: importable as an
+    empty namespace package, submodules dead. A real package has
+    __file__ and its registry populated."""
+    import rl_scheduler_tpu.scenarios as pkg
+
+    assert pkg.__file__ is not None and pkg.__file__.endswith("__init__.py")
+    assert set(SCENARIOS) == {"bursty", "heterogeneous", "churn",
+                              "price_spike"}
+    assert len(FAMILIES) == 4
+
+
+def test_stale_pycache_modules_do_not_import():
+    # The orphaned .pyc names from the seed's stale __pycache__ must not
+    # resolve (sourceless bytecode inside __pycache__ is not importable).
+    for phantom in ("distribution", "gauntlet", "randomize"):
+        with pytest.raises(ImportError):
+            __import__(f"rl_scheduler_tpu.scenarios.{phantom}")
+
+
+# ----------------------------------------------------------- determinism
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scenario_params_bitwise_deterministic(name):
+    a = cluster_set_params(get_scenario(name), num_nodes=8)
+    b = cluster_set_params(get_scenario(name), num_nodes=8)
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_generators_reseed_differently():
+    t0 = bursty_diurnal_tables(steps=50, seed=0)
+    t1 = bursty_diurnal_tables(steps=50, seed=1)
+    assert not np.array_equal(t0["costs"], t1["costs"])
+    m0 = churn_mask(steps=50, num_nodes=6, seed=0)
+    m1 = churn_mask(steps=50, num_nodes=6, seed=1)
+    assert m0.shape == (50, 6) and not np.array_equal(m0, m1)
+    p0 = price_spike_tables(steps=50, seed=0)
+    p1 = price_spike_tables(steps=50, seed=3)
+    assert not np.array_equal(p0["raw_prices"], p1["raw_prices"])
+    c0 = heterogeneous_capacities(8, 3, seed=0)
+    c1 = heterogeneous_capacities(8, 3, seed=9)
+    assert not np.array_equal(c0, c1)
+
+
+def test_churn_mask_uses_faultplan_stream_and_never_goes_dark():
+    mask = churn_mask(steps=99, num_nodes=8, seed=7, preempt_rate=0.2,
+                      drain_steps=5)
+    assert mask.min() == 0.0  # the rate actually fired
+    assert (mask.sum(axis=1) >= 1.0).all()  # >= one node up per step
+    # Byte-reproducible from (seed, rate): the FaultPlan stream contract.
+    assert np.array_equal(
+        mask, churn_mask(steps=99, num_nodes=8, seed=7, preempt_rate=0.2,
+                         drain_steps=5))
+
+
+def test_price_spike_raw_prices_spike_and_normalize():
+    t = price_spike_tables(steps=100, seed=0, spike_prob=0.1, spike_mult=4.0)
+    raw = t["raw_prices"]
+    assert raw.max() > 2.0 * np.median(raw)  # regimes actually spike
+    assert t["costs"].min() >= 0.0 and t["costs"].max() <= 1.0
+
+
+def test_cloud_table_and_raw_prices_family_gating():
+    assert cloud_table(get_scenario("bursty")).costs.shape[1] == 2
+    assert raw_prices(get_scenario("price_spike")).shape[1] == 2
+    with pytest.raises(ValueError):
+        cloud_table(get_scenario("churn"))
+    with pytest.raises(ValueError):
+        raw_prices(get_scenario("bursty"))
+
+
+def test_scenario_spec_validation():
+    with pytest.raises(ValueError):
+        Scenario(name="x", family="not_a_family")
+    with pytest.raises(ValueError):
+        get_scenario("nope")
+    s = get_scenario("bursty", seed=11)
+    assert s.seed == 11 and s.knob("period") == 24.0
+    meta = scenario_meta(s)
+    assert meta["scenario"] == "bursty" and meta["node_feat"] == 6
+    assert node_feat_for(get_scenario("heterogeneous")) == 13
+    assert baseline_columns(s) == {"cost": 0, "cpu": 2}
+    assert list_scenarios() == sorted(SCENARIOS)
+
+
+# ------------------------------------------------------ vmap/jit parity
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_batched_scenario_draws_match_single_env(name):
+    """reset_batch/step_batch (the fleet path) == the single-env pure
+    functions per key — vmap must not change any scenario draw."""
+    scn = get_scenario(name)
+    params = cluster_set_params(scn, num_nodes=8)
+    bundle = scenario_bundle(scn, num_nodes=8)
+    env = het_env if name == "heterogeneous" else cs
+
+    key = jax.random.PRNGKey(3)
+    keys = jax.random.split(key, 4)
+    bstate, bobs = bundle.reset_batch(key, 4)
+    actions = jnp.arange(4, dtype=jnp.int32) % 8
+    bstate2, bts = bundle.step_batch(bstate, actions)
+    for i in range(4):
+        sstate, sobs = env.reset(params, keys[i])
+        np.testing.assert_array_equal(np.asarray(bobs[i]), np.asarray(sobs))
+        _, sts = env.step(params, sstate, actions[i])
+        np.testing.assert_array_equal(np.asarray(bts.reward[i]),
+                                      np.asarray(sts.reward))
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scenario_trains_one_ppo_update(name):
+    """Every family runs through the real jitted PPO update (the fleet
+    path acceptance: scenario envs are a drop-in for the CSV replay)."""
+    from rl_scheduler_tpu.agent.ppo import PPOTrainConfig, make_ppo_bundle
+    from rl_scheduler_tpu.models import SetTransformerPolicy
+
+    bundle = scenario_bundle(get_scenario(name), num_nodes=4)
+    cfg = PPOTrainConfig(num_envs=4, rollout_steps=8, minibatch_size=32,
+                         num_epochs=1)
+    init_fn, update_fn, _ = make_ppo_bundle(
+        bundle, cfg, net=SetTransformerPolicy(dim=16, depth=1))
+    runner = jax.jit(init_fn)(jax.random.PRNGKey(0))
+    runner, metrics = jax.jit(update_fn)(runner)
+    assert np.isfinite(float(metrics["reward_mean"]))
+
+
+# ------------------------------------------------- churn reward invariants
+
+
+def test_churn_all_ones_mask_is_bitwise_noop():
+    base = cs.make_params(num_nodes=6)
+    ones = cs.make_params(
+        num_nodes=6,
+        avail_mask=np.ones((base.costs.shape[0], 6), np.float32),
+        churn_penalty=5.0)
+    key = jax.random.PRNGKey(0)
+    s0, o0 = cs.reset(base, key)
+    s1, o1 = cs.reset(ones, key)
+    np.testing.assert_array_equal(np.asarray(o0), np.asarray(o1))
+    for t in range(5):
+        a = jnp.asarray(t % 6)
+        s0, ts0 = cs.step(base, s0, a)
+        s1, ts1 = cs.step(ones, s1, a)
+        np.testing.assert_array_equal(np.asarray(ts0.reward),
+                                      np.asarray(ts1.reward))
+        np.testing.assert_array_equal(np.asarray(ts0.obs),
+                                      np.asarray(ts1.obs))
+
+
+def test_churn_down_node_pays_exact_penalty_and_observes_saturated():
+    t_rows = cs.make_params(num_nodes=4).costs.shape[0]
+    mask = np.ones((t_rows, 4), np.float32)
+    mask[0, 2] = 0.0  # node 2 down at row 0
+    up = cs.make_params(num_nodes=4,
+                        avail_mask=np.ones((t_rows, 4), np.float32),
+                        churn_penalty=3.0)
+    down = cs.make_params(num_nodes=4, avail_mask=mask, churn_penalty=3.0)
+    key = jax.random.PRNGKey(1)
+    su, ou = cs.reset(up, key)
+    sd, od = cs.reset(down, key)
+    # Down node observes maximally expensive/slow/loaded...
+    np.testing.assert_array_equal(np.asarray(od[2, :3]), [1.0, 1.0, 1.0])
+    # ...and placing on it costs exactly reward_scale * churn_penalty more.
+    _, ts_u = cs.step(up, su, jnp.asarray(2))
+    _, ts_d = cs.step(down, sd, jnp.asarray(2))
+    delta = float(ts_u.reward) - float(ts_d.reward)
+    assert delta == pytest.approx(float(up.reward_scale) * 3.0, rel=1e-5)
+    # An up node at the same row is unaffected.
+    _, ts_u0 = cs.step(up, su, jnp.asarray(0))
+    _, ts_d0 = cs.step(down, sd, jnp.asarray(0))
+    np.testing.assert_array_equal(np.asarray(ts_u0.reward),
+                                  np.asarray(ts_d0.reward))
+
+
+# ------------------------------------------- per-episode randomization
+
+
+def test_per_episode_randomization_redraws_and_legacy_keeps_statics():
+    rand = cs.make_params(num_nodes=4, jitter_range=(0.0, 0.5),
+                          drain_range=(0.5, 0.99),
+                          overload_range=(1.0, 4.0), random_phase=True)
+    s1, _ = cs.reset(rand, jax.random.PRNGKey(0))
+    s2, _ = cs.reset(rand, jax.random.PRNGKey(1))
+    assert float(s1.ep_drain) != float(s2.ep_drain)
+    assert float(s1.ep_overload) != float(s2.ep_overload)
+    assert int(s1.phase) != int(s2.phase)
+    lo, hi = 0.5, 0.99
+    assert lo <= float(s1.ep_drain) <= hi
+    # Legacy params: the per-episode fields carry the static values.
+    legacy = cs.make_params(num_nodes=4)
+    s, _ = cs.reset(legacy, jax.random.PRNGKey(0))
+    assert float(s.ep_drain) == float(legacy.drain_rate)
+    assert float(s.ep_overload) == float(legacy.overload_penalty)
+    assert int(s.phase) == 0
+
+
+def test_random_phase_shifts_table_replay():
+    rand = cs.make_params(num_nodes=4, random_phase=True)
+    # Two different episode keys land on different table rows at t=0.
+    obs = [np.asarray(cs.reset(rand, jax.random.PRNGKey(k))[1])
+           for k in range(6)]
+    costs_at_t0 = {round(float(o[:, 0].mean()), 6) for o in obs}
+    assert len(costs_at_t0) > 1
+
+
+def test_multi_cloud_random_start_disables_open_loop():
+    from rl_scheduler_tpu.env import core as env_core
+    from rl_scheduler_tpu.env.bundle import multi_cloud_bundle
+
+    params = env_core.make_params()
+    plain = multi_cloud_bundle(params)
+    assert plain.horizon_fn is not None
+    randomized = multi_cloud_bundle(params, random_start=True)
+    assert randomized.horizon_fn is None  # falls back to the scan rollout
+    # reset_random_start actually draws different starting rows — and
+    # stays jit/vmap-safe with params passed as a traced ARGUMENT (the
+    # regression shape: a flag leaf in the params pytree would trace).
+    starts = {
+        int(env_core.reset_random_start(params,
+                                        jax.random.PRNGKey(k))[0].step_idx)
+        for k in range(8)
+    }
+    assert len(starts) > 1
+    state, obs = jax.jit(env_core.reset_random_start)(
+        params, jax.random.PRNGKey(0))
+    assert obs.shape == (env_core.OBS_DIM,)
+    # The batched randomized bundle draws per-env phases.
+    bstate, _ = randomized.reset_batch(jax.random.PRNGKey(0), 16)
+    assert len(set(np.asarray(bstate.step_idx).tolist())) > 1
+
+
+def test_bursty_pod_scale_modulates_arrivals():
+    scn = get_scenario("bursty")
+    params = cluster_set_params(scn, num_nodes=4)
+    assert params.pod_scale is not None
+    t = bursty_diurnal_tables(steps=scn.steps, seed=scn.seed)
+    assert t["pod_scale"].min() < t["pod_scale"].max()
+    # Pods drawn at a high-intensity row are larger than the same draw at
+    # a low-intensity row (the scale multiplies the same uniform draw).
+    hi_row = int(np.argmax(t["pod_scale"]))
+    lo_row = int(np.argmin(t["pod_scale"]))
+    key = jax.random.PRNGKey(0)
+    hi = cs._draw_pod(params, key, jnp.asarray(hi_row))
+    lo = cs._draw_pod(params, key, jnp.asarray(lo_row))
+    assert float(hi) > float(lo)
+
+
+# ------------------------------------------------------ heterogeneous env
+
+
+def test_het_env_shapes_and_feature_layout():
+    params = het_env.make_params(num_nodes=6, num_resources=3, seed=0)
+    assert isinstance(params, het_env.HetSetParams)
+    assert params.node_feat == het_env.node_feat(3) == 13
+    state, obs = het_env.reset(params, jax.random.PRNGKey(0))
+    assert isinstance(state, het_env.HetSetState)
+    assert obs.shape == (6, 13)
+    _, ts = het_env.step(params, state, jnp.asarray(0))
+    assert isinstance(ts, het_env.TimeStep) and ts.obs.shape == (6, 13)
+    # Columns 2+R..2+2R are the static capacities.
+    np.testing.assert_allclose(np.asarray(obs[:, 5:8]),
+                               np.asarray(params.capacity), rtol=1e-6)
+    assert het_env.RESOURCES == ("cpu", "mem", "acc")
+    b = het_env.het_bundle(params)
+    assert b.obs_shape == (6, 13) and b.name == "cluster_set_het"
+
+
+def test_het_accelerator_bin_packing_pressure():
+    """Placing an accelerator-requesting pod on an accelerator-less node
+    must be punished dramatically harder than on an accelerator node —
+    the bin-packing signal this family exists to create."""
+    params = het_env.make_params(num_nodes=8, num_resources=3, seed=0,
+                                 acc_node_frac=0.5)
+    caps = np.asarray(params.capacity)
+    acc_node = int(np.argmax(caps[:, 2]))
+    no_acc_node = int(np.argmin(caps[:, 2]))
+    assert caps[acc_node, 2] > 0.9 and caps[no_acc_node, 2] < 0.1
+    state, _ = het_env.reset(params, jax.random.PRNGKey(0))
+    state = state._replace(pod_req=jnp.asarray([0.1, 0.1, 0.5], jnp.float32))
+    _, ts_acc = het_env.step(params, state, jnp.asarray(acc_node))
+    _, ts_no = het_env.step(params, state, jnp.asarray(no_acc_node))
+    assert float(ts_no.reward) < 5 * float(ts_acc.reward)  # rewards < 0
+
+
+def test_het_requests_gate_accelerator():
+    params = het_env.make_params(num_nodes=4, num_resources=3, seed=0,
+                                 acc_request_prob=0.3)
+    reqs = np.stack([
+        np.asarray(het_env._draw_req(params, jax.random.PRNGKey(k)))
+        for k in range(64)
+    ])
+    assert (reqs[:, :2] > 0).all()          # cpu/mem always requested
+    zero_acc = (reqs[:, 2] == 0).mean()
+    assert 0.3 < zero_acc < 0.95            # acc mostly absent, sometimes big
+
+
+def test_het_determinism_same_seed_same_capacities():
+    a = het_env.make_params(num_nodes=8, seed=4)
+    b = het_env.make_params(num_nodes=8, seed=4)
+    np.testing.assert_array_equal(np.asarray(a.capacity),
+                                  np.asarray(b.capacity))
+
+
+# --------------------------------------------------------- eval matrix
+
+
+def test_scenario_policy_matrix_cells_and_summary():
+    from rl_scheduler_tpu.agent.evaluate import (
+        matrix_summary,
+        scenario_policy_matrix,
+    )
+
+    rows = scenario_policy_matrix(["csv", "churn"], num_nodes=4,
+                                  episodes=2, seed=0)
+    assert len(rows) == 6  # 2 scenarios x 3 baseline policies
+    for r in rows:
+        assert r["schema_version"] == 1
+        assert r["metric"] == "scenario_matrix_cell"
+        assert np.isfinite(r["reward_mean"])
+    grid = matrix_summary(rows)
+    assert "csv" in grid and "churn" in grid and "cheapest_node" in grid
+
+
+def test_matrix_checkpoint_width_mismatch_is_reported_not_scored():
+    from rl_scheduler_tpu.agent.evaluate import scenario_policy_matrix
+    from rl_scheduler_tpu.models import SetTransformerPolicy
+
+    net = SetTransformerPolicy(dim=16, depth=1)
+    params = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 4, 6)))
+    rows = scenario_policy_matrix(
+        ["heterogeneous"], num_nodes=4, episodes=2,
+        checkpoint=(net, params, 6))
+    cell = next(r for r in rows if r["policy"] == "checkpoint")
+    assert cell["incompatible"] is True and "reward_mean" not in cell
+
+
+def test_structured_baselines_column_override():
+    from rl_scheduler_tpu.env.baselines import structured_baselines
+
+    fns = structured_baselines("cluster_set", columns={"cost": 1, "cpu": 0})
+    obs = jnp.asarray([[[0.9, 0.1, 0.5], [0.1, 0.9, 0.2]]])
+    # cost col overridden to 1: node 0 (0.1) is "cheapest".
+    assert int(fns["cheapest_node"](obs, None)[0]) == 0
+    assert int(fns["load_spread"](obs, None)[0]) == 1
+
+
+# --------------------------------------- CLI round-trip + serving (HTTP)
+
+
+@pytest.fixture(scope="module")
+def churn_run(tmp_path_factory):
+    """One tiny scenario run through the REAL train_ppo CLI, shared by
+    the round-trip, evaluate, and serving tests."""
+    from rl_scheduler_tpu.agent import train_ppo
+
+    root = tmp_path_factory.mktemp("scn_cli")
+    run_dir = train_ppo.main([
+        "--scenario", "churn", "--scenario-seed", "3",
+        "--preset", "quick", "--num-envs", "4", "--rollout-steps", "8",
+        "--minibatch-size", "32", "--iterations", "1",
+        "--run-name", "CHURN", "--run-root", str(root),
+    ])
+    return run_dir
+
+
+def test_cli_records_scenario_meta(churn_run):
+    from rl_scheduler_tpu.utils.checkpoint import load_policy_params
+
+    _, meta = load_policy_params(churn_run)
+    assert meta["scenario"] == "churn"
+    assert meta["scenario_seed"] == 3
+    assert meta["scenario_family"] == "churn"
+    assert meta["node_feat"] == 6
+    assert meta["env"] == "cluster_set"
+
+
+def test_cli_resume_guards_pin_scenario(churn_run):
+    from rl_scheduler_tpu.agent import train_ppo
+
+    base = ["--preset", "quick", "--num-envs", "4", "--rollout-steps", "8",
+            "--minibatch-size", "32", "--iterations", "2",
+            "--run-name", "CHURN", "--run-root", str(churn_run.parent),
+            "--resume"]
+    with pytest.raises(SystemExit, match="scenario"):
+        train_ppo.main(base)  # CSV resume of a scenario run
+    with pytest.raises(SystemExit, match="scenario"):
+        train_ppo.main(base + ["--scenario", "bursty"])
+    with pytest.raises(SystemExit, match="scenario-seed"):
+        train_ppo.main(base + ["--scenario", "churn", "--scenario-seed", "9"])
+
+
+def test_evaluate_rebuilds_scenario_from_meta(churn_run, tmp_path, capsys):
+    from rl_scheduler_tpu.agent import evaluate
+
+    report = evaluate.main(["--run", str(churn_run), "--episodes", "2",
+                            "--results-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "Rebuilding scenario 'churn'" in out
+    assert report.env == "cluster_set"
+    assert np.isfinite(report.avg_episode_reward)
+
+
+def test_extender_serves_scenario_checkpoint_over_http(churn_run):
+    """Acceptance: a scenario-trained checkpoint serves end-to-end over
+    the real HTTP extender, and the conformance demand works both ways."""
+    from rl_scheduler_tpu.scheduler.extender import build_policy, make_server
+
+    with pytest.raises(ValueError, match="scenario"):
+        build_policy(backend="cpu", run=str(churn_run),
+                     scenario="heterogeneous")
+    policy = build_policy(backend="cpu", run=str(churn_run),
+                          scenario="churn")
+    assert policy.scenario == "churn" and policy.family == "set"
+    server = make_server(policy, "127.0.0.1", 0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        payload = json.dumps({
+            "pod": {"metadata": {"name": "p"}},
+            "nodenames": ["aws-1", "aws-2", "azure-1"],
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/filter", payload,
+            {"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert len(out["nodenames"]) == 1
+        assert len(out["failedNodes"]) == 2
+        hz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+        assert hz["scenario"] == "churn"
+    finally:
+        server.shutdown()
+
+
+def test_extender_het_observation_and_pod_parsing():
+    """The widened serving path: multi-resource pod parsing + the het
+    observation builder match the training layout without a checkpoint."""
+    from rl_scheduler_tpu.scheduler.extender import pod_resource_fractions
+    from rl_scheduler_tpu.scheduler.telemetry import RandomCpu, TableTelemetry
+
+    pod = {"spec": {"containers": [{"resources": {"requests": {
+        "cpu": "2", "memory": "4Gi", "nvidia.com/gpu": "1"}}}]}}
+    cpu, mem, acc = pod_resource_fractions(pod)
+    assert cpu == pytest.approx(0.5)       # 2 cores / 4
+    assert mem == pytest.approx(0.25)      # 4Gi / 16Gi
+    assert acc == pytest.approx(1.0)
+    # Fail-open on junk manifests: the training-distribution defaults.
+    assert pod_resource_fractions({"spec": {"containers": [
+        {"resources": {"requests": {"memory": "lots"}}}]}})[1] == 0.15
+    tele = TableTelemetry.from_table(cpu_source=RandomCpu(seed=0))
+    rows = tele.observe_nodes_het(["aws", "azure", None], [cpu, mem, acc], 3)
+    assert rows.shape == (3, 13)
+    np.testing.assert_allclose(rows[:, 5:8], 1.0)        # neutral caps
+    np.testing.assert_allclose(rows[0, 9:12], [0.5, 0.25, 1.0])
+
+
+def test_scenario_bench_functions_exist_and_run_tiny():
+    """The bench entry points compile and measure at a toy size (the
+    checked-in BENCH_scenario JSON is the real container measurement)."""
+    import bench
+
+    out = bench.scenario_env_step_bench(num_nodes=4, num_envs=4, steps=5,
+                                        repeats=1)
+    assert out["schema_version"] == 1
+    assert set(out["scenarios"]) == set(SCENARIOS)
+    for cell in out["scenarios"].values():
+        assert cell["steps_per_sec"] > 0

@@ -8,6 +8,12 @@ for exactly that). A public function there with NO reference anywhere in
 ``tests/`` has zero parity coverage on either side — historically how
 "correct" kernels shipped with 10x roofline gaps (docs/roofline.md).
 
+``scenarios/`` joined the covered set with the graftscenario subsystem:
+its generators compile seeded tables whose determinism/vmap-parity
+contract is exactly the kind of cross-environment invariant that only a
+test reference proves, and its env variant has the same CPU-vs-TPU
+surface as everything in ``ops/``.
+
 The check is a name-reference scan of the configured test corpus, not a
 coverage run: pure-AST/text, so it is identical on both JAX versions and
 costs milliseconds. Underscore-prefixed functions, dunders, and
@@ -25,7 +31,7 @@ from tools.graftlint.engine import LintContext, Module
 from tools.graftlint.rules import Rule, register
 
 # Path segments whose public functions must be referenced from tests.
-OP_DIRS = frozenset({"ops", "parallel"})
+OP_DIRS = frozenset({"ops", "parallel", "scenarios"})
 
 
 @register
@@ -56,7 +62,8 @@ class UntestedPublicOp(Rule):
             yield self.finding(
                 module, node.lineno,
                 f"public {kind} `{name}` has no reference in the test "
-                "corpus — ops/parallel code is where CPU-interpret and "
-                "TPU-Mosaic behavior diverge; add at least a parity or "
-                "shape test",
+                "corpus — ops/parallel/scenarios code is where "
+                "CPU-vs-TPU behavior and seeded-determinism contracts "
+                "diverge; add at least a parity, shape, or determinism "
+                "test",
             )
