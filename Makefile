@@ -9,7 +9,7 @@ PY ?= python
 TRACE ?= tests/fixtures/traceview/fixture.trace.json.gz
 
 .PHONY: lint lint-json test tier1 trace-summary obs chaos chaos-soak \
-        serve-pool serve-soak eval-matrix scenario-bench
+        serve-pool serve-soak eval-matrix scenario-bench study study-list
 
 lint:
 	$(PY) -m tools.graftlint --check
@@ -66,6 +66,21 @@ EPISODES ?= 32
 eval-matrix:
 	JAX_PLATFORMS=cpu $(PY) -m rl_scheduler_tpu.agent.evaluate --matrix \
 		--episodes $(EPISODES) $(if $(RUN),--run $(RUN)) $(MATRIX_ARGS)
+
+# graftstudy (docs/studies.md): resumable (seed x variant) studies with
+# statistical verdicts. STUDY names a protocol from studies/presets.py;
+# the fleet64 anti-latch sweep (ROADMAP 3b) is the chip one-command:
+#   make study STUDY=fleet64_antilatch JOBS=1
+# JOBS>1 forks BLAS-pinned worker processes (CPU hosts only — on a chip
+# trials share the accelerator, keep JOBS=1). Re-running resumes from
+# the study ledger.
+STUDY ?= study_smoke
+JOBS ?= 1
+study:
+	$(PY) -m rl_scheduler_tpu.studies --study $(STUDY) --jobs $(JOBS)
+
+study-list:
+	$(PY) -m rl_scheduler_tpu.studies --list
 
 # Scenario throughput A/B vs the CSV replay (training path + env-step
 # microbench; BLAS pinned — the container's 2-thread default is measured

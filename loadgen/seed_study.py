@@ -1,19 +1,19 @@
 """Multi-seed convergence study for the structured fleet recipes.
 
-Round 5 found the fleet recipes' greedy eval is seed-fragile (seed 2
-fails at N=64 AND N=256 while its stochastic training reward looks
-healthy — docs/scaling.md §1b) and built the detection rule into
-``train_ppo --reseed-on-stall``: a bad seed's in-training eval has not
-crossed the best node baseline by iteration ~16. This tool measures
-that rule over a seed range so the claim rests on more than the seeds
-it was discovered with: for each seed it trains the recipe (no guard —
-the point is to observe failures, not skip them), records the eval@8/16
-readings the guard would have acted on, runs the 100-episode paired
-greedy evaluation, and prints one row per seed plus a verdict on the
-detection rule (were all final failures already separated from the
-baseline threshold at the deadline?).
+Since round 11 this is a thin compatibility wrapper over **graftstudy**
+(``rl_scheduler_tpu/studies/``, docs/studies.md) — the same CLI that
+measured the round-5 fleet fragility (docs/scaling.md §1b) now compiles
+to a single-variant :class:`StudySpec` and runs through the resumable
+study runner, so this protocol and the subsystem cannot drift: the
+per-seed rows below are printed from the SAME ledger records the study
+analysis consumes, a killed study resumes instead of restarting, and
+the detection-rule verdict (were all final failures flagged by the
+deadline or the final acceptance?) is computed from the same fields.
 
-Usage::
+For intervention sweeps, Wilson intervals, and paired-variant verdicts,
+use the full CLI: ``python -m rl_scheduler_tpu.studies``.
+
+Usage (unchanged)::
 
     python loadgen/seed_study.py --env cluster_set --num-nodes 64 \
         --seeds 0-5                  # the set_fleet64 recipe
@@ -24,27 +24,72 @@ Usage::
 from __future__ import annotations
 
 import argparse
-import dataclasses
-import json
 import pathlib
 import sys
-import time
+import tempfile
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
-def parse_seeds(spec: str) -> list[int]:
-    out: list[int] = []
-    for part in spec.split(","):
-        if "-" in part:
-            lo, hi = part.split("-")
-            out.extend(range(int(lo), int(hi) + 1))
-        else:
-            out.append(int(part))
-    return out
+def build_spec(env: str, num_nodes: int, seeds, iterations: int,
+               eval_episodes: int, deadline: int):
+    """The docs/scaling.md §1b protocol as a single-variant StudySpec
+    (no guard — the point is to OBSERVE failures, not skip them)."""
+    from rl_scheduler_tpu.studies import StudySpec
+
+    # Historical preset rule: the set family scales the preset with N;
+    # cluster_graph always used set_fleet64's scale knobs ("same scale
+    # knobs" — the original script), at ANY node count.
+    preset = ("set_fleet64" if env == "cluster_graph" or num_nodes <= 64
+              else "set_fleet256")
+    return StudySpec(
+        name=f"seed_study_{env}_n{num_nodes}",
+        env=env, preset=preset, num_nodes=num_nodes,
+        seeds=tuple(seeds), iterations=iterations,
+        eval_every=8, eval_episodes=64,
+        final_eval_episodes=eval_episodes,
+        stall_deadline=deadline,
+    )
 
 
-def main(argv: list[str] | None = None):
+def print_rows(records: list, deadline: int) -> list:
+    """The historical per-seed row format + guard verdict, from study
+    ledger records."""
+    import json
+
+    rows = []
+    for r in records:
+        if r.get("status") != "ok":
+            print(json.dumps({"seed": r["seed"], "status": r["status"]}))
+            continue
+        rows.append({
+            "seed": r["seed"],
+            "eval_at_deadline": r["eval_at_deadline"],
+            "eval_final": r["eval_final"],
+            "flagged_early": r["flagged_early"],
+            "flagged_final": r["flagged_final"],
+            "improvement_pct": r["improvement_pct"],
+            "failed_final": r["failed"],
+            "wall_s": r["wall_s"],
+        })
+        print(json.dumps(rows[-1]))
+    flagged = {r["seed"] for r in rows
+               if r["flagged_early"] or r["flagged_final"]}
+    failed = {r["seed"] for r in rows if r["failed_final"]}
+    print(f"# failed finally: {sorted(failed)}; flagged by the guard "
+          f"(deadline {deadline} OR final acceptance): {sorted(flagged)}")
+    if failed <= flagged:
+        print("# guard: NO false negatives (every final failure was "
+              "flagged at the deadline or the final acceptance)")
+    else:
+        print(f"# guard MISSED: {sorted(failed - flagged)}")
+    if flagged - failed:
+        print(f"# false positives (flagged but converged): "
+              f"{sorted(flagged - failed)}")
+    return rows
+
+
+def main(argv: list | None = None):
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--env", default="cluster_set",
                    choices=("cluster_set", "cluster_graph"))
@@ -57,81 +102,39 @@ def main(argv: list[str] | None = None):
     p.add_argument("--deadline", type=int, default=16,
                    help="the detection-rule iteration (reseed-on-stall "
                         "default)")
+    p.add_argument("--study-dir", default=None,
+                   help="persistent study dir (resumable ledger); default "
+                        "a fresh temp dir — the historical run-once "
+                        "behavior")
+    p.add_argument("--dry-run", action="store_true",
+                   help="print the compiled trial list and exit")
     args = p.parse_args(argv)
 
-    from rl_scheduler_tpu.agent.evaluate import (
-        best_node_baseline_reward,
-        structured_evaluate,
+    from rl_scheduler_tpu.studies import (
+        StudyRunner,
+        configure_jax_cache,
+        parse_seeds,
     )
-    from rl_scheduler_tpu.agent.ppo import ppo_train
-    from rl_scheduler_tpu.agent.presets import PPO_PRESETS
-    from rl_scheduler_tpu.agent.train_ppo import make_bundle_and_net
 
-    if args.env == "cluster_set":
-        cfg = PPO_PRESETS["set_fleet64" if args.num_nodes <= 64
-                          else "set_fleet256"]
+    spec = build_spec(args.env, args.num_nodes, parse_seeds(args.seeds),
+                      args.iterations, args.eval_episodes, args.deadline)
+    if args.dry_run:
+        import json
+
+        for t in spec.trials():
+            print(json.dumps({"trial_id": t.trial_id, "seed": t.seed}))
+        return []
+    print(f"# {args.env} N={args.num_nodes}: graftstudy "
+          f"{spec.name} ({len(spec.seeds)} seeds x {spec.iterations} "
+          "iters; node-baseline threshold computed per trial — the "
+          "reseed-on-stall bar)")
+    configure_jax_cache()  # trials re-trace per seed; pay compiles once
+    if args.study_dir is not None:
+        records = StudyRunner(spec, args.study_dir, jobs=0).run()
     else:
-        # The measured graph fleet recipe (docs/scaling.md §1b): flax
-        # GNN, bf16, 1 epoch, 1024 envs.
-        cfg = dataclasses.replace(
-            PPO_PRESETS["set_fleet64"])  # same scale knobs
-    cfg = dataclasses.replace(cfg, eval_every=8, eval_episodes=64)
-    bundle, net = make_bundle_and_net(args.env, cfg,
-                                      num_nodes=args.num_nodes)
-
-    threshold = best_node_baseline_reward(args.env, bundle,
-                                          cfg.eval_episodes, seed=0)
-    print(f"# {args.env} N={args.num_nodes}: node-baseline threshold "
-          f"{threshold:.1f} (the reseed-on-stall bar)")
-
-    rows = []
-    for seed in parse_seeds(args.seeds):
-        evals: dict[int, float] = {}
-
-        def eval_log(i, metrics, _evals=evals):
-            _evals[i + 1] = metrics["eval_episode_reward_mean"]
-
-        t0 = time.time()
-        runner, history = ppo_train(bundle, cfg, args.iterations,
-                                    seed=seed, net=net,
-                                    eval_log_fn=eval_log)
-        wall = time.time() - t0
-        rep = structured_evaluate(args.env, bundle, net, runner.params,
-                                  num_episodes=args.eval_episodes, seed=0)
-        by_deadline = max(
-            (v for i, v in evals.items() if i <= args.deadline),
-            default=float("-inf"),
-        )
-        final_eval = evals[max(evals)] if evals else float("-inf")
-        rows.append({
-            "seed": seed,
-            "eval_at_deadline": round(by_deadline, 1),
-            "eval_final": round(final_eval, 1),
-            "flagged_early": by_deadline < threshold,
-            # The guard's second checkpoint (--reseed-on-stall final
-            # acceptance): the run's last eval must beat the bar too.
-            "flagged_final": final_eval < threshold,
-            "improvement_pct": round(rep.improvement_vs_best_baseline_pct, 1),
-            "failed_final": rep.improvement_vs_best_baseline_pct < 0,
-            "wall_s": round(wall),
-        })
-        print(json.dumps(rows[-1]))
-
-    flagged = {r["seed"] for r in rows
-               if r["flagged_early"] or r["flagged_final"]}
-    failed = {r["seed"] for r in rows if r["failed_final"]}
-    print(f"# failed finally: {sorted(failed)}; flagged by the guard "
-          f"(deadline {args.deadline} OR final acceptance): "
-          f"{sorted(flagged)}")
-    if failed <= flagged:
-        print("# guard: NO false negatives (every final failure was "
-              "flagged at the deadline or the final acceptance)")
-    else:
-        print(f"# guard MISSED: {sorted(failed - flagged)}")
-    if flagged - failed:
-        print(f"# false positives (flagged but converged): "
-              f"{sorted(flagged - failed)}")
-    return rows
+        with tempfile.TemporaryDirectory(prefix="seed_study_") as d:
+            records = StudyRunner(spec, d, jobs=0).run()
+    return print_rows(records, args.deadline)
 
 
 if __name__ == "__main__":

@@ -73,6 +73,27 @@ class PPOTrainConfig:
     # eval_episode_reward_mean. 0 disables.
     eval_every: int = 0
     eval_episodes: int = 20
+    # Anti-latch interventions (ROADMAP 3b, docs/studies.md), both off by
+    # default (byte-identical update when inactive):
+    #
+    # Sampling-temperature annealing: the rollout's action sampling (and,
+    # consistently, the behavior log-probs and the loss's policy) uses
+    # softmax(logits / tau), tau annealed linearly from 1.0 at iteration
+    # 0 to sample_temp_end at iteration sample_temp_iters (held there
+    # after; sample_temp_iters=0 holds sample_temp_end from the start).
+    # tau < 1 moves the TRAINING distribution toward the argmax the
+    # greedy eval will score — the measured failure mode is a
+    # near-uniform sampler earning the spread bonus "for free" while its
+    # argmax latched onto one static node premium. The same tau is used
+    # everywhere within one iteration, so each iteration is exact PPO on
+    # the tempered policy. Active iff sample_temp_end != 1.0.
+    sample_temp_end: float = 1.0
+    sample_temp_iters: int = 0
+    # Argmax-concentration auxiliary penalty: coeff on
+    # ops/losses.argmax_concentration (collision probability of the
+    # batch-pooled sharpened policy). See PPOLossConfig.
+    argmax_penalty_coeff: float = 0.0
+    argmax_penalty_sharpness: float = 16.0
     # Epoch-shuffle granularity: permute contiguous blocks of this many
     # samples instead of single rows. Blocks are adjacent envs at one
     # timestep (iid rollouts), so statistics are indistinguishable for
@@ -94,6 +115,28 @@ class PPOTrainConfig:
                 f"num_epochs={self.num_epochs}: must be >= 1 (each update "
                 "needs at least one SGD pass over the rollout)"
             )
+        if self.sample_temp_end <= 0:
+            raise ValueError(
+                f"sample_temp_end={self.sample_temp_end}: the sampling "
+                "temperature must stay positive (tau -> 0 is the argmax "
+                "limit; reach toward it, never at it)"
+            )
+        if self.sample_temp_iters < 0:
+            raise ValueError(
+                f"sample_temp_iters={self.sample_temp_iters}: the anneal "
+                "span is an iteration count >= 0 (0 holds the end "
+                "temperature from the start)"
+            )
+        if self.argmax_penalty_coeff < 0:
+            raise ValueError(
+                f"argmax_penalty_coeff={self.argmax_penalty_coeff}: the "
+                "concentration penalty is a loss weight >= 0 (0 disables)"
+            )
+        if self.argmax_penalty_sharpness <= 0:
+            raise ValueError(
+                f"argmax_penalty_sharpness={self.argmax_penalty_sharpness}: "
+                "the soft-argmax logit multiplier must be positive"
+            )
 
     @property
     def batch_size(self) -> int:
@@ -109,7 +152,30 @@ class PPOTrainConfig:
             vf_clip=self.vf_clip,
             vf_coeff=self.vf_coeff,
             entropy_coeff=self.entropy_coeff,
+            argmax_penalty_coeff=self.argmax_penalty_coeff,
+            argmax_penalty_sharpness=self.argmax_penalty_sharpness,
         )
+
+
+def sample_temperature(cfg: PPOTrainConfig, update_idx) -> jnp.ndarray | None:
+    """The rollout sampling temperature for the iteration at ``update_idx``
+    (a traced scalar), or ``None`` when annealing is inactive
+    (``sample_temp_end == 1.0`` — the None path leaves the update
+    byte-identical to the un-instrumented build).
+
+    Linear ramp 1.0 -> ``sample_temp_end`` over ``sample_temp_iters``
+    iterations, held at the end value after (``sample_temp_iters == 0``
+    holds the end value from iteration 0).
+    """
+    if cfg.sample_temp_end == 1.0:
+        return None
+    end = jnp.float32(cfg.sample_temp_end)
+    if cfg.sample_temp_iters <= 0:
+        return end
+    frac = jnp.clip(
+        jnp.asarray(update_idx, jnp.float32) / cfg.sample_temp_iters,
+        0.0, 1.0)
+    return 1.0 + (end - 1.0) * frac
 
 
 def effective_shuffle_block(cfg: PPOTrainConfig) -> int:
@@ -248,11 +314,17 @@ def make_ppo_bundle(
 
     def rollout(runner: RunnerState):
         """Collect [T, N] transitions with the current policy via lax.scan."""
+        temp = sample_temperature(cfg, runner.update_idx)
 
         def env_step(carry, _):
             env_state, obs, key, ep_ret = carry
             key, akey = jax.random.split(key)
             logits, value = net.apply(runner.params, obs)
+            if temp is not None:
+                # Tempered BEHAVIOR policy: sampling and the stored
+                # log-probs use the same softmax(logits / tau) the loss
+                # recomputes, so the PPO ratio stays exactly on-policy.
+                logits = logits / temp
             action = jax.random.categorical(akey, logits)
             log_prob = categorical_log_prob(logits, action)
             env_state, ts = bundle.step_batch(env_state, action)
@@ -299,8 +371,10 @@ def make_ppo_bundle(
         )
         logits = logits.reshape(t + 1, n, -1)
         values = values.reshape(t + 1, n)
-        action = jax.random.categorical(akey, logits[:t])
-        log_prob = categorical_log_prob(logits[:t], action)
+        temp = sample_temperature(cfg, runner.update_idx)
+        behavior_logits = logits[:t] if temp is None else logits[:t] / temp
+        action = jax.random.categorical(akey, behavior_logits)
+        log_prob = categorical_log_prob(behavior_logits, action)
         reward = bundle.horizon_reward_fn(aux, action)
         done = aux["dones"]
 
@@ -403,9 +477,15 @@ def make_ppo_bundle(
         # a fresh random subset of num_minibatches*minibatch_size samples —
         # the per-epoch reshuffle covers the tail in expectation.
         mb_size = min(cfg.minibatch_size, cfg.batch_size)
+        # One temperature per ITERATION (computed from the pre-increment
+        # update_idx, same value the rollout used): the loss optimizes the
+        # identical tempered policy the behavior log-probs came from.
+        loss_temp = sample_temperature(cfg, runner.update_idx)
 
         def loss_fn(params, mb):
             logits, values = net.apply(params, mb["obs"])
+            if loss_temp is not None:
+                logits = logits / loss_temp
             return ppo_loss(
                 logits, values, mb["action"], mb["log_prob"], mb["value"],
                 mb["advantage"], mb["target"], loss_cfg,

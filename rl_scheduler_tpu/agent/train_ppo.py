@@ -277,6 +277,37 @@ def main(argv: list[str] | None = None) -> Path:
                    help="seed for the scenario's table compilation "
                         "(independent of --seed, so a reseeded training "
                         "attempt keeps the SAME workload)")
+    p.add_argument("--sample-temp-anneal", type=float, default=None,
+                   metavar="T_END",
+                   help="anti-latch intervention (ROADMAP 3b, "
+                        "docs/studies.md): anneal the rollout SAMPLING "
+                        "temperature linearly from 1.0 to T_END over "
+                        "--sample-temp-iters iterations (default: the "
+                        "whole run), held there after. The iteration's "
+                        "tempered policy is used consistently for "
+                        "sampling, behavior log-probs, and the loss, so "
+                        "each iteration is exact PPO on the tempered "
+                        "policy. T_END < 1 moves training toward the "
+                        "argmax the greedy eval scores; recorded in "
+                        "checkpoint meta and pinned by --resume. "
+                        "Composable with --scenario and "
+                        "--reseed-on-stall; measure it with "
+                        "`python -m rl_scheduler_tpu.studies`")
+    p.add_argument("--sample-temp-iters", type=int, default=None,
+                   metavar="N",
+                   help="iterations over which --sample-temp-anneal ramps "
+                        "(0 holds T_END from the start; default: "
+                        "--iterations)")
+    p.add_argument("--argmax-penalty", type=float, default=None,
+                   metavar="COEFF",
+                   help="anti-latch intervention (ROADMAP 3b): add COEFF x "
+                        "argmax-concentration to the PPO loss "
+                        "(ops/losses.py argmax_concentration — collision "
+                        "probability of the batch-pooled soft-argmax "
+                        "policy; penalizes an argmax latched onto one "
+                        "static node premium, which per-state entropy "
+                        "cannot see). 0 disables; recorded in checkpoint "
+                        "meta and pinned by --resume")
     p.add_argument("--run-name", default=None)
     p.add_argument("--run-root", default=RuntimeConfig().checkpoint_dir)
     p.add_argument("--checkpoint-every", type=int, default=None,
@@ -467,7 +498,7 @@ def main(argv: list[str] | None = None) -> Path:
         env_families = {
             "multi_cloud": ("bursty_diurnal", "price_spike"),
             "cluster_set": ("bursty_diurnal", "heterogeneous", "churn",
-                            "price_spike"),
+                            "price_spike", "domain_random"),
             "cluster_graph": ("price_spike",),
         }
         allowed = env_families.get(args.env, ())
@@ -541,6 +572,38 @@ def main(argv: list[str] | None = None) -> Path:
             # --num-epochs 0 would scan over zero SGD passes); surface it
             # as the CLI's actionable exit, before the run dir exists.
             raise SystemExit(str(e).replace("num_epochs", "--num-epochs", 1))
+    if args.sample_temp_iters is not None and args.sample_temp_anneal is None:
+        raise SystemExit(
+            "--sample-temp-iters shapes the --sample-temp-anneal schedule; "
+            "pass both (or drop --sample-temp-iters)")
+    if (args.sample_temp_anneal is not None
+            or args.argmax_penalty is not None) and args.tp > 1:
+        raise SystemExit(
+            "--sample-temp-anneal/--argmax-penalty instrument the shared "
+            "PPO collect/loss path; the tensor-parallel trainer builds its "
+            "own update (drop --tp — the anti-latch target is the "
+            "structured fleet recipes anyway)")
+    if args.sample_temp_anneal is not None:
+        if args.sample_temp_anneal <= 0:
+            raise SystemExit(
+                f"--sample-temp-anneal {args.sample_temp_anneal}: the "
+                "sampling temperature must stay positive (anneal TOWARD "
+                "determinism, e.g. 0.5; tau=0 is the argmax limit)")
+        temp_iters = (args.sample_temp_iters
+                      if args.sample_temp_iters is not None
+                      else args.iterations)
+        if temp_iters < 0:
+            raise SystemExit(
+                f"--sample-temp-iters {temp_iters}: pass an iteration "
+                "count >= 0 (0 holds T_END from the start)")
+        cfg = dataclasses.replace(cfg, sample_temp_end=args.sample_temp_anneal,
+                                  sample_temp_iters=temp_iters)
+    if args.argmax_penalty is not None:
+        if args.argmax_penalty < 0:
+            raise SystemExit(
+                f"--argmax-penalty {args.argmax_penalty}: the "
+                "concentration penalty is a loss weight >= 0 (0 disables)")
+        cfg = dataclasses.replace(cfg, argmax_penalty_coeff=args.argmax_penalty)
     if args.legacy_reward_sign and args.env != "multi_cloud":
         raise SystemExit(
             "--legacy-reward-sign reproduces the multi-cloud reference "
@@ -1017,6 +1080,26 @@ def main(argv: list[str] | None = None) -> Path:
                 f"opposite sign would silently negate rewards mid-run "
                 f"({'add' if ckpt_legacy else 'drop'} --legacy-reward-sign)"
             )
+        # Anti-latch flags are part of the training objective: a resumed
+        # run must keep the recorded schedule/penalty (checkpoints from
+        # before the flags existed recorded nothing -> the off defaults).
+        for meta_key, flag, configured, off in (
+                ("sample_temp_end", "--sample-temp-anneal",
+                 cfg.sample_temp_end, 1.0),
+                ("sample_temp_iters", "--sample-temp-iters",
+                 cfg.sample_temp_iters, 0),
+                ("argmax_penalty", "--argmax-penalty",
+                 cfg.argmax_penalty_coeff, 0.0)):
+            recorded = meta.get(meta_key)
+            recorded = off if recorded is None else recorded
+            if recorded != configured:
+                raise SystemExit(
+                    f"{resume_flag}: run was trained with "
+                    f"{meta_key}={recorded}; resuming with {configured} "
+                    "would silently change the training objective mid-run "
+                    f"({'pass' if recorded != off else 'drop'} {flag}"
+                    f"{' ' + str(recorded) if recorded != off else ''})"
+                )
         ckpt_tp = meta.get("tp") or 1
         if ckpt_tp != args.tp:
             # The PARAM tree differs (TPActorCritic col/row pairs vs
@@ -1177,7 +1260,15 @@ def main(argv: list[str] | None = None) -> Path:
                 # degrades to params-only when they differ.
                 "num_envs": cfg.num_envs,
                 "rollout_steps": cfg.rollout_steps,
-                "legacy_reward_sign": args.legacy_reward_sign}
+                "legacy_reward_sign": args.legacy_reward_sign,
+                # Anti-latch interventions (ROADMAP 3b): part of the
+                # training objective, so the resume guard pins them —
+                # silently switching the temperature schedule or the
+                # concentration penalty mid-run would make the run's
+                # verdict unattributable (docs/studies.md).
+                "sample_temp_end": cfg.sample_temp_end,
+                "sample_temp_iters": cfg.sample_temp_iters,
+                "argmax_penalty": cfg.argmax_penalty_coeff}
     if scenario is not None:
         # Scenario provenance: evaluation rebuilds the same workload from
         # this record, the resume guard refuses a mismatch, and serving

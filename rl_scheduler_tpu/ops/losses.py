@@ -28,6 +28,20 @@ class PPOLossConfig(NamedTuple):
     # instead of stacking through the SGD scan. None (the default) leaves
     # the loss byte-identical to the un-instrumented build.
     ratio_hist_edges: tuple | None = None
+    # Anti-latch auxiliary penalty (ROADMAP 3b, docs/studies.md): weight
+    # on :func:`argmax_concentration` — the collision probability of the
+    # batch-pooled near-argmax policy. The measured fleet failure mode is
+    # a near-uniform policy whose argmax latches onto ONE static node
+    # premium across every state; per-state entropy cannot see it (the
+    # distribution is already near-uniform), but the pooled sharpened
+    # policy concentrates on the latched node, so this term does.
+    # 0.0 (the default) leaves the loss byte-identical.
+    argmax_penalty_coeff: float = 0.0
+    # Logit multiplier for the penalty's soft argmax: softmax(beta *
+    # logits) approaches the one-hot argmax as beta grows, keeping the
+    # term differentiable. Gradients exist at any beta; 16 separates the
+    # measured near-uniform fleet logits well.
+    argmax_penalty_sharpness: float = 16.0
 
 
 def categorical_log_prob(logits: jnp.ndarray, actions: jnp.ndarray) -> jnp.ndarray:
@@ -38,6 +52,25 @@ def categorical_log_prob(logits: jnp.ndarray, actions: jnp.ndarray) -> jnp.ndarr
 def categorical_entropy(logits: jnp.ndarray) -> jnp.ndarray:
     logp = jax.nn.log_softmax(logits)
     return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+def argmax_concentration(logits: jnp.ndarray,
+                         sharpness: float = 16.0) -> jnp.ndarray:
+    """Collision probability of the batch-pooled soft-argmax policy.
+
+    ``softmax(sharpness * logits)`` per state approximates the one-hot
+    argmax (differentiably); pooling it over every leading axis and
+    summing the squares gives the probability that two states' argmaxes
+    collide. A policy whose argmax latches onto one static node scores
+    near 1.0 regardless of its per-state entropy — the measured fleet
+    failure signature (docs/scaling.md §1b: 52% of placements on one
+    favorite node) — while an argmax that rotates over k nodes scores
+    ~1/k. Range ``[1/num_actions, 1]``. The PPO auxiliary penalty
+    (``PPOLossConfig.argmax_penalty_coeff``) minimizes this directly.
+    """
+    sharp = jax.nn.softmax(logits * sharpness, axis=-1)
+    pooled = jnp.mean(sharp.reshape(-1, sharp.shape[-1]), axis=0)
+    return jnp.sum(jnp.square(pooled))
 
 
 def ppo_loss(
@@ -68,6 +101,11 @@ def ppo_loss(
 
     entropy = jnp.mean(categorical_entropy(logits))
     total = policy_loss + cfg.vf_coeff * value_loss - cfg.entropy_coeff * entropy
+    concentration = None
+    if cfg.argmax_penalty_coeff:
+        concentration = argmax_concentration(
+            logits, cfg.argmax_penalty_sharpness)
+        total = total + cfg.argmax_penalty_coeff * concentration
 
     approx_kl = jnp.mean(old_log_probs - log_probs)
     clip_frac = jnp.mean((jnp.abs(ratio - 1.0) > cfg.clip_eps).astype(jnp.float32))
@@ -78,6 +116,8 @@ def ppo_loss(
         "approx_kl": approx_kl,
         "clip_fraction": clip_frac,
     }
+    if concentration is not None:
+        metrics["argmax_concentration"] = concentration
     if cfg.ratio_hist_edges is not None:
         from rl_scheduler_tpu.utils.metrics import hist_observe
 

@@ -30,7 +30,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Any
 
-FAMILIES = ("bursty_diurnal", "heterogeneous", "churn", "price_spike")
+FAMILIES = ("bursty_diurnal", "heterogeneous", "churn", "price_spike",
+            "domain_random")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,9 +73,10 @@ def _knobs(**kw) -> tuple:
     return tuple(sorted(kw.items()))
 
 
-# The registry: four production-shaped presets, one per family. Knobs are
-# the documented randomization surface (docs/scenarios.md); anything not
-# named here keeps the env default.
+# The registry: one production-shaped preset per family (plus
+# 'randomized', the domain-randomization-only variant the fleet seed
+# studies measure). Knobs are the documented randomization surface
+# (docs/scenarios.md); anything not named here keeps the env default.
 SCENARIOS = {
     "bursty": Scenario(
         name="bursty", family="bursty_diurnal",
@@ -95,6 +97,18 @@ SCENARIOS = {
         name="price_spike", family="price_spike",
         knobs=_knobs(spike_prob=0.04, spike_mult=4.0, decay=0.7,
                      jitter_range=(0.05, 0.2), overload_range=(1.0, 4.0)),
+    ),
+    # Domain randomization over the env dynamics ONLY (ROADMAP 3b: the
+    # anti-latch intervention the fleet seed studies measure,
+    # docs/studies.md): the workload stays the shipped CSV replay —
+    # identical to the un-scenarioed control — while every episode
+    # redraws node_jitter / drain_rate / overload_penalty from these
+    # ranges and starts at a random table phase, so a static per-node
+    # premium is no longer a stable thing for the argmax to latch onto.
+    "randomized": Scenario(
+        name="randomized", family="domain_random",
+        knobs=_knobs(jitter_range=(0.05, 0.25), drain_range=(0.7, 0.95),
+                     overload_range=(1.0, 3.0), random_phase=True),
     ),
 }
 
@@ -196,6 +210,11 @@ def cluster_set_params(scenario: Scenario, num_nodes: int = 8):
             acc_node_frac=scenario.knob("acc_node_frac", 0.5),
             acc_request_prob=scenario.knob("acc_request_prob", 0.35),
         )
+    if scenario.family == "domain_random":
+        # No compiled tables: the shipped CSV replay, shaped only by the
+        # per-episode randomization fields — the control workload with
+        # the latch target jittered away.
+        return cs.make_params(num_nodes=num_nodes, **randomization)
     if scenario.family == "churn":
         from rl_scheduler_tpu.scenarios.families import churn_mask
 

@@ -1,0 +1,54 @@
+"""graftstudy — resumable seed studies & intervention sweeps with
+statistical verdicts (docs/studies.md).
+
+A :class:`StudySpec` compiles a frozen ``(variant x seed)`` protocol
+into a deterministic trial list; :class:`StudyRunner` executes it over
+real training runs (resumable through the atomic :class:`StudyLedger`);
+``analysis`` turns the ledger into Wilson-interval failure rates,
+paired-seed deltas vs control, and an acceptance verdict.
+
+CLI: ``python -m rl_scheduler_tpu.studies --study fleet64_antilatch``.
+"""
+
+from rl_scheduler_tpu.studies.analysis import (
+    analyze_study,
+    render_grid,
+    sign_test_pvalue,
+    summary_json_line,
+    wilson_interval,
+)
+from rl_scheduler_tpu.studies.ledger import (
+    LedgerMismatch,
+    StudyLedger,
+    load_spec,
+)
+from rl_scheduler_tpu.studies.presets import STUDIES, get_study, list_studies
+from rl_scheduler_tpu.studies.runner import (
+    StudyRunner,
+    acquire_runner_lock,
+    atomic_write_json,
+    build_trial_config,
+    configure_jax_cache,
+    limit_blas_threads,
+    run_trial,
+    write_result,
+)
+from rl_scheduler_tpu.studies.spec import (
+    OVERLAY_KEYS,
+    StudySpec,
+    TrialSpec,
+    overlay,
+    parse_seeds,
+    spec_from_json,
+)
+
+__all__ = [
+    "OVERLAY_KEYS", "STUDIES", "LedgerMismatch", "StudyLedger",
+    "StudyRunner", "StudySpec", "TrialSpec", "acquire_runner_lock",
+    "analyze_study",
+    "atomic_write_json", "build_trial_config", "configure_jax_cache",
+    "get_study", "limit_blas_threads", "list_studies", "load_spec",
+    "overlay", "parse_seeds",
+    "render_grid", "run_trial", "sign_test_pvalue", "spec_from_json",
+    "summary_json_line", "wilson_interval", "write_result",
+]

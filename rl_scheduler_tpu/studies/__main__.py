@@ -1,0 +1,146 @@
+"""graftstudy CLI: run a named study to a complete, analyzed ledger.
+
+Usage::
+
+    python -m rl_scheduler_tpu.studies --list
+    python -m rl_scheduler_tpu.studies --study study_smoke --jobs 2
+    python -m rl_scheduler_tpu.studies --study fleet64_antilatch   # chip
+
+Resume is automatic: re-running the same command continues from the
+study dir's ledger (completed trials skipped, the in-flight one
+restarted). ``--fresh`` wipes the study dir first. The final summary is
+printed as the human grid AND one ``schema_version``-tagged JSON line
+(driver-tracked, bench.py convention), and written to
+``<study_dir>/summary.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import shutil
+import sys
+from pathlib import Path
+
+# Runnable from a source checkout without an install, like bench.py.
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+
+def main(argv: list | None = None) -> dict | None:
+    from rl_scheduler_tpu.config import RuntimeConfig
+    from rl_scheduler_tpu.studies import (
+        StudyRunner,
+        analyze_study,
+        get_study,
+        list_studies,
+        parse_seeds,
+        render_grid,
+        summary_json_line,
+    )
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--study", default=None,
+                   help=f"named study protocol ({', '.join(list_studies())})")
+    p.add_argument("--list", action="store_true",
+                   help="list the registered studies and exit")
+    p.add_argument("--study-root",
+                   default=str(Path(RuntimeConfig().checkpoint_dir)
+                               / "studies"),
+                   help="parent dir; the study runs (and resumes) under "
+                        "<root>/<study-name>")
+    p.add_argument("--jobs", type=int, default=1,
+                   help="concurrent trial worker processes (each trial is "
+                        "one fresh process, BLAS pinned to cores/jobs). "
+                        "0 runs trials sequentially IN-process. On a chip "
+                        "keep 1: trials share the accelerator")
+    p.add_argument("--blas-threads", type=int, default=None,
+                   help="BLAS threads per worker (default cores//jobs; "
+                        "the graftserve oversubscription finding, "
+                        "docs/serving.md)")
+    p.add_argument("--seeds", default=None,
+                   help="override the study's seed set (e.g. 0-8 or "
+                        "0,2,7) — a DIFFERENT protocol, so a different "
+                        "ledger fingerprint")
+    p.add_argument("--iterations", type=int, default=None,
+                   help="override the study's per-trial iteration count "
+                        "(different protocol -> different fingerprint)")
+    p.add_argument("--fresh", action="store_true",
+                   help="wipe the study dir first instead of resuming")
+    p.add_argument("--dry-run", action="store_true",
+                   help="print the compiled trial list and exit (no "
+                        "training, no ledger)")
+    args = p.parse_args(argv)
+
+    if args.list:
+        for name in list_studies():
+            spec = get_study(name)
+            print(f"{name}: {spec.env} N={spec.num_nodes} preset="
+                  f"{spec.preset}, {len(spec.seeds)} seeds x "
+                  f"{len(spec.variants)} variants x {spec.iterations} iters")
+        return None
+    if args.study is None:
+        raise SystemExit("pass --study <name> (or --list)")
+    try:
+        spec = get_study(args.study)
+    except ValueError as e:
+        raise SystemExit(str(e))
+    if args.seeds is not None:
+        spec = dataclasses.replace(spec, seeds=tuple(parse_seeds(args.seeds)))
+    if args.iterations is not None:
+        spec = dataclasses.replace(spec, iterations=args.iterations)
+
+    if args.dry_run:
+        for t in spec.trials():
+            print(json.dumps({"trial_id": t.trial_id, "variant": t.variant,
+                              "seed": t.seed, "overlay": t.overlay},
+                             sort_keys=True))
+        return None
+
+    if args.jobs == 0:
+        # In-process trials recompile the same tiny programs per trial;
+        # the shared persistent cache pays each compile once per STUDY
+        # (workers configure their own copy, studies/worker.py).
+        from rl_scheduler_tpu.studies.runner import configure_jax_cache
+
+        configure_jax_cache()
+
+    dir_name = spec.name
+    if args.seeds is not None or args.iterations is not None:
+        # An overridden protocol is a DIFFERENT study: give it its own
+        # dir keyed by fingerprint, so a quick --seeds 0-2 check can
+        # never LedgerMismatch against (and --fresh can never destroy)
+        # the canonical completed study's ledger.
+        dir_name = f"{spec.name}-{spec.fingerprint()[:8]}"
+        print(f"# overridden protocol -> study dir {dir_name}")
+    study_dir = Path(args.study_root) / dir_name
+    if args.fresh and study_dir.exists():
+        # Never rmtree a LIVE study out from under its runner: HOLD the
+        # single-writer lock while deleting (check-then-rmtree would
+        # leave a window for a runner to start and lose its ledger).
+        from rl_scheduler_tpu.studies.runner import acquire_runner_lock
+
+        try:
+            acquire_runner_lock(study_dir)
+        except RuntimeError as e:
+            raise SystemExit(f"--fresh: {e} (deleting a live study's dir "
+                             "would corrupt it)")
+        shutil.rmtree(study_dir)  # takes the held lock down with it
+    runner = StudyRunner(spec, study_dir, jobs=args.jobs,
+                         blas_threads=args.blas_threads)
+    print(f"# study {spec.name}: {len(spec.trials())} trials "
+          f"({len(spec.variants)} variants x {len(spec.seeds)} seeds), "
+          f"jobs={args.jobs}, ledger {runner.ledger.path}")
+    records = runner.run()
+
+    summary = analyze_study(spec, records)
+    from rl_scheduler_tpu.studies.runner import atomic_write_json
+
+    atomic_write_json(study_dir / "summary.json", summary, indent=1)
+    print(render_grid(summary))
+    print(summary_json_line(summary))
+    return summary
+
+
+if __name__ == "__main__":
+    main()

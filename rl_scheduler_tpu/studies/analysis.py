@@ -1,0 +1,184 @@
+"""graftstudy statistics: per-variant verdicts from the trial ledger.
+
+Pure stdlib (``math``), deliberately: the analysis must produce the
+same verdict on the container, the driver, and anyone's laptop reading
+a copied ledger.
+
+Per variant: the failure count over completed trials with a **Wilson
+score interval** (the right small-n interval for 9-seed studies — a
+normal approximation at n=9, p~0.4 is garbage), the mean greedy
+improvement, and the mean argmax-collision diagnostic. Against the
+control variant: **paired-seed deltas** (same seed, two variants —
+the pairing removes the dominant seed-to-seed variance), the
+fixed/broken counts, and a two-sided **sign test** p-value on them.
+Against the acceptance bar (``spec.target_failure_rate``): the variant
+``verdict`` is graded —
+
+- ``confirmed_below``: the Wilson UPPER bound clears the bar (the
+  strong claim; at n=9 even 0 failures cannot make it — hi(0/9)=0.30 —
+  which is the honest arithmetic of a thin seed set, ROADMAP 3c),
+- ``point_below`` / ``point_above``: the point estimate is on that
+  side but the interval straddles the bar,
+- ``confirmed_above``: the Wilson LOWER bound exceeds the bar (the
+  variant measurably fails the target).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+STUDY_SCHEMA_VERSION = 1
+
+
+def wilson_interval(failures: int, n: int, z: float = 1.96) -> tuple:
+    """Wilson score interval for a binomial proportion: ``(lo, hi)``."""
+    if n <= 0:
+        return (0.0, 1.0)
+    p = failures / n
+    z2 = z * z
+    denom = 1.0 + z2 / n
+    center = (p + z2 / (2 * n)) / denom
+    half = z * math.sqrt(p * (1 - p) / n + z2 / (4 * n * n)) / denom
+    return (max(0.0, center - half), min(1.0, center + half))
+
+
+def sign_test_pvalue(wins: int, losses: int) -> float:
+    """Two-sided sign test on paired outcomes (ties dropped by the
+    caller): P(this lopsided or worse | fair coin)."""
+    n = wins + losses
+    if n == 0:
+        return 1.0
+    k = min(wins, losses)
+    tail = sum(math.comb(n, i) for i in range(k + 1)) / 2.0 ** n
+    return min(1.0, 2.0 * tail)
+
+
+def _mean(xs: list) -> float | None:
+    return round(sum(xs) / len(xs), 3) if xs else None
+
+
+def analyze_study(spec, records: list) -> dict:
+    """The ``schema_version``-tagged study summary (module docstring):
+    one dict the CLI emits as the driver-tracked JSON line and renders
+    as the human grid. ``spec`` is a :class:`~rl_scheduler_tpu.studies.
+    spec.StudySpec`; ``records`` the ledger's trial entries."""
+    by_variant: dict = {v: [] for v in spec.variant_names()}
+    for r in records:
+        if r.get("variant") in by_variant:
+            by_variant[r["variant"]].append(r)
+
+    control_ok = {r["seed"]: r for r in by_variant.get(spec.control, ())
+                  if r.get("status") == "ok"}
+    variants: dict = {}
+    for vname, rows in by_variant.items():
+        ok = [r for r in rows if r.get("status") == "ok"]
+        errors = len(rows) - len(ok)
+        failures = sum(1 for r in ok if r["failed"])
+        n = len(ok)
+        lo, hi = wilson_interval(failures, n)
+        entry = {
+            "trials": n,
+            "errors": errors,
+            "failures": failures,
+            "failure_rate": round(failures / n, 3) if n else None,
+            "wilson95": [round(lo, 3), round(hi, 3)],
+            "mean_improvement_pct": _mean(
+                [r["improvement_pct"] for r in ok]),
+            "mean_improvement_converged_pct": _mean(
+                [r["improvement_pct"] for r in ok if not r["failed"]]),
+            "mean_argmax_collision": _mean(
+                [r["argmax_collision"] for r in ok
+                 if r.get("argmax_collision") is not None]),
+            "reseeds": sum(r.get("attempts", 1) - 1 for r in ok),
+        }
+        if spec.target_failure_rate is not None and n:
+            target = spec.target_failure_rate
+            if hi < target:
+                entry["verdict"] = "confirmed_below"
+            elif lo > target:
+                entry["verdict"] = "confirmed_above"
+            elif failures / n < target:
+                entry["verdict"] = "point_below"
+            else:
+                entry["verdict"] = "point_above"
+        if vname != spec.control and control_ok:
+            paired = [(r, control_ok[r["seed"]]) for r in ok
+                      if r["seed"] in control_ok]
+            deltas = [r["improvement_pct"] - c["improvement_pct"]
+                      for r, c in paired]
+            fixed = sum(1 for r, c in paired
+                        if c["failed"] and not r["failed"])
+            broken = sum(1 for r, c in paired
+                         if not c["failed"] and r["failed"])
+            entry["vs_control"] = {
+                "paired_seeds": len(paired),
+                "mean_delta_pct": _mean(deltas),
+                "seeds_fixed": fixed,
+                "seeds_broken": broken,
+                "sign_test_p": round(sign_test_pvalue(fixed, broken), 4),
+            }
+        variants[vname] = entry
+
+    return {
+        "schema_version": STUDY_SCHEMA_VERSION,
+        "metric": "study_summary",
+        "study": spec.name,
+        "spec_sha": spec.fingerprint(),
+        "env": spec.env,
+        "preset": spec.preset,
+        "num_nodes": spec.num_nodes,
+        "seeds": len(spec.seeds),
+        "iterations": spec.iterations,
+        "control": spec.control,
+        "target_failure_rate": spec.target_failure_rate,
+        "completed_trials": sum(v["trials"] + v["errors"]
+                                for v in variants.values()),
+        "total_trials": len(spec.trials()),
+        "variants": variants,
+    }
+
+
+def render_grid(summary: dict) -> str:
+    """The human study grid for one summary dict."""
+    cols = ("variant", "n", "fail", "rate [wilson95]", "impr%", "argmaxP2",
+            "d-ctrl%", "fix/brk", "p", "verdict")
+    rows = [cols]
+    for vname, v in summary["variants"].items():
+        vs = v.get("vs_control") or {}
+        rate = ("-" if v["failure_rate"] is None else
+                f"{v['failure_rate']:.2f} [{v['wilson95'][0]:.2f},"
+                f"{v['wilson95'][1]:.2f}]")
+        rows.append((
+            vname + (" (ctrl)" if vname == summary["control"] else ""),
+            str(v["trials"]) + (f"+{v['errors']}E" if v["errors"] else ""),
+            str(v["failures"]),
+            rate,
+            "-" if v["mean_improvement_pct"] is None
+            else f"{v['mean_improvement_pct']:+.1f}",
+            "-" if v["mean_argmax_collision"] is None
+            else f"{v['mean_argmax_collision']:.3f}",
+            "-" if vs.get("mean_delta_pct") is None
+            else f"{vs['mean_delta_pct']:+.1f}",
+            f"{vs['seeds_fixed']}/{vs['seeds_broken']}" if vs else "-",
+            f"{vs['sign_test_p']:.3f}" if vs else "-",
+            v.get("verdict", "-"),
+        ))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(cols))]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+             for r in rows]
+    lines.insert(1, "  ".join("-" * w for w in widths))
+    header = (f"study {summary['study']} ({summary['env']} "
+              f"N={summary['num_nodes']}, preset {summary['preset']}, "
+              f"{summary['seeds']} seeds x {summary['iterations']} iters; "
+              f"{summary['completed_trials']}/{summary['total_trials']} "
+              "trials)")
+    if summary.get("target_failure_rate") is not None:
+        header += f"; target failure rate < {summary['target_failure_rate']}"
+    return header + "\n" + "\n".join(lines)
+
+
+def summary_json_line(summary: dict) -> str:
+    """The one driver-tracked line (bench.py convention: a single
+    ``schema_version``-tagged JSON object on its own stdout line)."""
+    return json.dumps(summary, sort_keys=True)

@@ -1,0 +1,120 @@
+"""The graftstudy ledger: an atomic, append-only JSONL study journal.
+
+One file per study dir (``ledger.jsonl``): a header line binding the
+ledger to its spec fingerprint, then one line per finished trial. Every
+append rewrites the file **tmp-then-rename** (the graftguard manifest
+discipline, ``utils/checkpoint.py``): the prior bytes are carried over
+verbatim and ``os.replace`` is atomic, so a SIGKILL at any instant
+leaves either the old complete ledger or the new complete ledger —
+never a torn line. That is what makes resume exact: completed-trial
+entries survive a mid-study kill **bitwise** (chaos-pinned,
+``tests/test_graftguard.py``), and the runner re-executes only trials
+with no ledger line.
+
+Records are serialized with sorted keys so a record's bytes are a pure
+function of its content — the bitwise-resume contract does not depend
+on dict insertion order across processes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+from rl_scheduler_tpu.studies.spec import StudySpec, spec_from_json
+
+LEDGER_SCHEMA_VERSION = 1
+LEDGER_NAME = "ledger.jsonl"
+
+
+class LedgerMismatch(RuntimeError):
+    """The study dir's ledger was written under a DIFFERENT spec
+    fingerprint: continuing would silently mix two protocols' trials
+    into one statistics table. Start a fresh study dir (or ``--fresh``)."""
+
+
+def _dumps(record: dict) -> str:
+    return json.dumps(record, sort_keys=True, separators=(", ", ": "))
+
+
+class StudyLedger:
+    """Open-or-create the ledger for ``study_dir`` under ``spec``.
+
+    On open of an existing ledger the header's fingerprint must match
+    ``spec.fingerprint()`` (:class:`LedgerMismatch` otherwise). A missing
+    or empty file is initialized with the header line.
+    """
+
+    def __init__(self, study_dir: str | Path, spec: StudySpec):
+        self.path = Path(study_dir) / LEDGER_NAME
+        self.spec = spec
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        if self.path.exists() and self.path.stat().st_size:
+            header = self.header()
+            if header.get("spec_sha") != spec.fingerprint():
+                raise LedgerMismatch(
+                    f"{self.path} was written for spec "
+                    f"{header.get('spec_sha')} (study "
+                    f"{header.get('study')!r}); this run's spec is "
+                    f"{spec.fingerprint()} — a changed protocol cannot "
+                    "resume into the same ledger (new study dir, or "
+                    "--fresh to discard)")
+        else:
+            self._rewrite([_dumps({
+                "kind": "header",
+                "schema_version": LEDGER_SCHEMA_VERSION,
+                "study": spec.name,
+                "spec_sha": spec.fingerprint(),
+                "spec": spec.to_json(),
+            })])
+
+    # -------------------------------------------------------------- io
+
+    def _rewrite(self, lines: list) -> None:
+        # Whole-file tmp-then-rename: prior lines ride over as the exact
+        # bytes read back (bitwise resume), the replace is atomic.
+        tmp = self.path.with_suffix(".jsonl.tmp")
+        data = "".join(line + "\n" for line in lines)
+        with open(tmp, "w") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def _raw_lines(self) -> list:
+        if not self.path.exists():
+            return []
+        return self.path.read_text().splitlines()
+
+    def append(self, record: dict) -> None:
+        """Append one trial record atomically (sorted keys, schema tag)."""
+        record = {"kind": "trial",
+                  "schema_version": LEDGER_SCHEMA_VERSION, **record}
+        self._rewrite(self._raw_lines() + [_dumps(record)])
+
+    # ----------------------------------------------------------- reads
+
+    def header(self) -> dict:
+        lines = self._raw_lines()
+        if not lines:
+            raise FileNotFoundError(f"{self.path}: empty ledger")
+        head = json.loads(lines[0])
+        if head.get("kind") != "header":
+            raise ValueError(f"{self.path}: first line is not a header")
+        return head
+
+    def records(self) -> list:
+        return [json.loads(l) for l in self._raw_lines()[1:]]
+
+    def completed_ids(self) -> set:
+        return {r["trial_id"] for r in self.records()}
+
+
+def load_spec(study_dir: str | Path) -> StudySpec:
+    """The spec a study dir's ledger was written under — what a worker
+    subprocess (and a bare resume) runs from, so the executed protocol
+    is the LEDGER's, never a drifted caller's."""
+    path = Path(study_dir) / LEDGER_NAME
+    head = json.loads(path.read_text().splitlines()[0])
+    return spec_from_json(head["spec"])

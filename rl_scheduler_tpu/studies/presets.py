@@ -1,0 +1,101 @@
+"""Named graftstudy protocols — the chip harvest is one command each.
+
+``fleet64_antilatch`` is the ROADMAP item 3(b) instrument: control vs
+the three measured root-cause attempts (sampling-temperature annealing,
+the argmax-concentration penalty, domain randomization) at the full
+9-seed evidence standard, judged against the <20% failure-rate bar.
+The ``*_seeds9`` studies raise the thin regimes item 3(c) names to the
+same standard. ``study_smoke`` is the tier-1 gate: 2 seeds x 2 variants
+on a preset tiny enough for container CPU.
+
+Run: ``python -m rl_scheduler_tpu.studies --study <name>`` (or
+``make study STUDY=<name>``). On a chip keep ``--jobs 1`` — trials
+share the accelerator; the multi-process fold is for CPU hosts.
+"""
+
+from __future__ import annotations
+
+from rl_scheduler_tpu.studies.spec import StudySpec, overlay
+
+NINE_SEEDS = tuple(range(9))
+
+STUDIES = {
+    # The anti-latch intervention sweep (ROADMAP 3b): each variant is one
+    # measured attempt at the root cause the rollout diagnostic pinned
+    # (argmax latched onto static node premiums, docs/scaling.md §1b).
+    "fleet64_antilatch": StudySpec(
+        name="fleet64_antilatch",
+        env="cluster_set", preset="set_fleet64", num_nodes=64,
+        seeds=NINE_SEEDS, iterations=80,
+        eval_every=8, eval_episodes=64, final_eval_episodes=100,
+        stall_deadline=16, target_failure_rate=0.20,
+        variants=(
+            ("control", ()),
+            # Anneal sampling toward determinism over the run: training
+            # reward starts seeing what the argmax does instead of
+            # collecting the spread bonus from near-uniform sampling.
+            ("anneal", overlay(sample_temp_anneal=0.5)),
+            # Differentiable penalty on the batch-pooled soft-argmax
+            # collision probability (ops/losses.argmax_concentration).
+            ("argmax_penalty", overlay(argmax_penalty=0.05)),
+            # Domain randomization over node_jitter/drain/overload +
+            # random table phase (scenario 'randomized'): no static
+            # premium left to latch onto.
+            ("randomized", overlay(scenario="randomized")),
+        ),
+    ),
+    # Item 3(c): thin regimes raised to the 9-seed evidence standard.
+    "fleet256_seeds9": StudySpec(
+        name="fleet256_seeds9",
+        env="cluster_set", preset="set_fleet256", num_nodes=256,
+        seeds=NINE_SEEDS, iterations=80,
+        eval_every=8, eval_episodes=64, final_eval_episodes=100,
+        stall_deadline=16, target_failure_rate=0.20,
+    ),
+    "graph_seeds9": StudySpec(
+        name="graph_seeds9",
+        env="cluster_graph", preset="set_fleet64", num_nodes=64,
+        seeds=NINE_SEEDS, iterations=80,
+        eval_every=8, eval_episodes=64, final_eval_episodes=100,
+        stall_deadline=16, target_failure_rate=0.20,
+    ),
+    # The flash-attention fleet-giant regime had ONE recorded seed.
+    # Smaller env fold + fewer final episodes: each trial is a N=1024
+    # memory-wall run (docs/scaling.md §3).
+    "flash1024_seeds9": StudySpec(
+        name="flash1024_seeds9",
+        env="cluster_set", preset="set_fleet256", num_nodes=1024,
+        seeds=NINE_SEEDS, iterations=80,
+        eval_every=8, eval_episodes=32, final_eval_episodes=64,
+        stall_deadline=16, target_failure_rate=0.20,
+        base_overlay=overlay(flash_attn=True, num_envs=64,
+                             minibatch_size=800),
+    ),
+    # Tier-1 smoke: the full machinery (spec -> trials -> runner ->
+    # ledger -> verdicts) on a seconds-scale config. 2 seeds x 2
+    # variants, 2 iterations, eval every iteration.
+    "study_smoke": StudySpec(
+        name="study_smoke",
+        env="cluster_set", preset="quick", num_nodes=4,
+        seeds=(0, 1), iterations=2,
+        eval_every=1, eval_episodes=4, final_eval_episodes=8,
+        stall_deadline=1,
+        variants=(
+            ("control", ()),
+            ("anneal", overlay(sample_temp_anneal=0.5)),
+        ),
+        base_overlay=overlay(num_envs=8, rollout_steps=8,
+                             minibatch_size=64, num_epochs=1),
+    ),
+}
+
+
+def get_study(name: str) -> StudySpec:
+    if name not in STUDIES:
+        raise ValueError(
+            f"unknown study {name!r}; registered: {sorted(STUDIES)}")
+    return STUDIES[name]
+
+
+def list_studies() -> list:
+    return sorted(STUDIES)

@@ -1,0 +1,569 @@
+"""graftstudy trial execution: one trial in-process, a study across jobs.
+
+:func:`run_trial` is the single-trial recipe — build the variant's
+config/bundle, train with the study's eval protocol (optionally under
+the reseed guard, each attempt keeping its OWN ``best_attempt<k>/``
+lineage), score the deliverable checkpoint with the paired greedy
+evaluation, and return the ledger record. :class:`StudyRunner` drives
+the ``(variant x seed)`` matrix over it: ``jobs=0`` runs trials
+sequentially in this process (tests, the seed_study compat wrapper);
+``jobs >= 1`` forks one worker subprocess per trial
+(``studies/worker.py``) with BLAS pinned per trial via environment —
+the graftserve finding (docs/serving.md): default OpenBLAS pools
+oversubscribe the host the moment two trials share it, and lose even
+single-stream.
+
+Resume is ledger-driven (``studies/ledger.py``): completed trials are
+skipped (their entries untouched — bitwise), an orphaned
+``result.json`` from a kill between result write and ledger append is
+adopted without re-running, and an in-flight trial dir with no result
+is wiped and restarted.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from rl_scheduler_tpu.studies.ledger import StudyLedger
+from rl_scheduler_tpu.studies.spec import StudySpec, TrialSpec
+
+logger = logging.getLogger(__name__)
+
+RESULT_NAME = "result.json"
+TRIALS_DIR = "trials"
+WORKER_PID_NAME = "worker.pid"
+RUNNER_PID_NAME = "runner.pid"
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def _read_live_pid(path: Path) -> int | None:
+    """The pid recorded in a lock/pid file, IF that process is alive —
+    the one parse+liveness implementation behind the runner lock, the
+    orphaned-worker guard, and the CLI's --fresh refusal."""
+    if not path.exists():
+        return None
+    try:
+        pid = int(path.read_text().strip() or 0)
+    except (ValueError, OSError):
+        return None
+    return pid if pid and _pid_alive(pid) else None
+
+
+def acquire_runner_lock(study_dir: str | Path) -> Path:
+    """Take the study dir's single-writer lock via exclusive create
+    (stale locks from dead pids are cleared and retried). Raises
+    RuntimeError naming the live holder otherwise. The one acquisition
+    path for both ``StudyRunner.run`` and the CLI's ``--fresh`` (which
+    must hold the lock BEFORE deleting the dir, or a runner started in
+    the check-to-rmtree window loses its ledger mid-run)."""
+    lock = Path(study_dir) / RUNNER_PID_NAME
+    while True:
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, str(os.getpid()).encode())
+            os.close(fd)
+            return lock
+        except FileExistsError:
+            pid = _read_live_pid(lock)
+            if pid is not None:
+                raise RuntimeError(
+                    f"study dir {study_dir} is already being run by pid "
+                    f"{pid} ({lock}); a second writer would corrupt its "
+                    "in-flight trial dirs — wait for it or kill it first")
+            # Stale (dead pid / unreadable): clear and retry the
+            # exclusive create.
+            lock.unlink(missing_ok=True)
+
+_CFG_KEYS = ("num_envs", "rollout_steps", "minibatch_size", "num_epochs",
+             "lr", "gamma", "entropy_coeff", "clip_eps", "compute_dtype",
+             "argmax_penalty_sharpness")
+
+
+def build_trial_config(spec: StudySpec, trial: TrialSpec):
+    """``(PPOTrainConfig, bundle_kwargs, reseed_budget)`` for one trial:
+    the study preset + eval protocol with the variant overlay applied
+    (the same knob semantics as the train_ppo CLI flags)."""
+    import dataclasses
+
+    from rl_scheduler_tpu.agent.presets import PPO_PRESETS
+
+    ov = dict(trial.overlay)
+    cfg = dataclasses.replace(
+        PPO_PRESETS[spec.preset],
+        eval_every=spec.eval_every,
+        eval_episodes=spec.eval_episodes,
+        **{k: ov[k] for k in _CFG_KEYS if k in ov})
+    if "sample_temp_anneal" in ov:
+        cfg = dataclasses.replace(
+            cfg,
+            sample_temp_end=float(ov["sample_temp_anneal"]),
+            # Same default as the CLI: anneal across the whole run.
+            sample_temp_iters=int(ov.get("sample_temp_iters",
+                                         spec.iterations)))
+    if "argmax_penalty" in ov:
+        cfg = dataclasses.replace(
+            cfg, argmax_penalty_coeff=float(ov["argmax_penalty"]))
+    bundle_kwargs = {"num_nodes": spec.num_nodes}
+    if ov.get("flash_attn"):
+        bundle_kwargs["flash_attn"] = True
+    if ov.get("num_heads") is not None:
+        bundle_kwargs["num_heads"] = int(ov["num_heads"])
+    if ov.get("scenario"):
+        from rl_scheduler_tpu.scenarios import get_scenario
+
+        bundle_kwargs["scenario"] = get_scenario(
+            ov["scenario"], seed=int(ov.get("scenario_seed", 0)))
+    return cfg, bundle_kwargs, int(ov.get("reseed_on_stall", 0))
+
+
+def _argmax_collision(bundle, net, params, episodes: int, seed: int) -> float:
+    """Collision probability of the GREEDY action distribution over a
+    seeded rollout batch — the study's measured latch diagnostic: a
+    policy funneling placements onto one favorite node scores near 1,
+    an argmax rotating over k nodes scores ~1/k (the differentiable
+    training-time proxy is ``ops/losses.argmax_concentration``)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(key):
+        state, obs = bundle.reset_batch(key, episodes)
+
+        def step(carry, _):
+            state, obs = carry
+            logits, _ = net.apply(params, obs)
+            action = jnp.argmax(logits, axis=-1)
+            counts = jnp.sum(
+                jax.nn.one_hot(action, bundle.num_actions), axis=0)
+            state, ts = bundle.step_batch(state, action)
+            return (state, ts.obs), counts
+
+        _, counts = jax.lax.scan(step, (state, obs), None,
+                                 length=bundle.episode_steps)
+        total = counts.sum()
+        p = counts.sum(axis=0) / jnp.maximum(total, 1.0)
+        return jnp.sum(p * p)
+
+    return float(run(jax.random.PRNGKey(seed)))
+
+
+def run_trial(spec: StudySpec, trial: TrialSpec, trial_dir: str | Path,
+              baseline_threshold: float | None = None) -> dict:
+    """Execute one trial end-to-end in this process; returns the ledger
+    record (also written to ``<trial_dir>/result.json`` tmp-then-rename).
+
+    ``baseline_threshold`` overrides the computed node-baseline bar —
+    the tests' seam for forcing the stall guard deterministically (the
+    same monkeypatch point ``tests/test_reseed.py`` uses on the CLI).
+    """
+    import jax
+
+    from rl_scheduler_tpu.agent.evaluate import (
+        best_node_baseline_reward,
+        structured_evaluate,
+    )
+    from rl_scheduler_tpu.agent.ppo import ppo_train
+    from rl_scheduler_tpu.agent.train_ppo import (
+        EvalStall,
+        make_bundle_and_net,
+        make_stall_guard,
+    )
+    from rl_scheduler_tpu.agent.loop import make_best_checkpoint_hook
+    from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+    trial_dir = Path(trial_dir)
+    trial_dir.mkdir(parents=True, exist_ok=True)
+    t0 = time.time()
+    cfg, bundle_kwargs, reseed_budget = build_trial_config(spec, trial)
+    bundle, net = make_bundle_and_net(spec.env, cfg, **bundle_kwargs)
+    if baseline_threshold is not None:
+        threshold = baseline_threshold
+    else:
+        # The node-baseline bar is a constant of the VARIANT (seeded
+        # rollouts on that variant's bundle — seeds only change the
+        # policy init), so the first trial of each variant memoizes it
+        # in the study dir and the other 8 seeds (and every resumed
+        # worker process) read it back instead of re-running the
+        # baseline evaluation. Concurrent writers race benignly: the
+        # value is deterministic and the write atomic.
+        cache = trial_dir.parent / f"threshold_{trial.variant}.json"
+        threshold = None
+        if cache.exists():
+            try:
+                threshold = json.loads(cache.read_text())["threshold"]
+            except (ValueError, KeyError):
+                # Unreadable cache (e.g. torn by a pre-atomic-write
+                # kill): recompute and overwrite rather than poisoning
+                # every later trial of the variant.
+                threshold = None
+        if threshold is None:
+            threshold = best_node_baseline_reward(
+                spec.env, bundle, cfg.eval_episodes, seed=0)
+            atomic_write_json(cache, {"variant": trial.variant,
+                                      "threshold": threshold})
+
+    # Eval firings land on multiples of eval_every; the guard's two
+    # checkpoints are the last firing at/before the deadline and the
+    # run's final firing (train_ppo CLI semantics).
+    decision_iter = final_iter = 0
+    if cfg.eval_every > 0:
+        decision_iter = (spec.stall_deadline // cfg.eval_every) * cfg.eval_every
+        final_iter = (spec.iterations // cfg.eval_every) * cfg.eval_every
+
+    def tree_fn(runner):
+        return {"params": runner.params, "opt_state": runner.opt_state}
+
+    attempt = 0
+    attempt_log: list = []
+    evals: dict = {}
+    while True:
+        evals.clear()
+        attempt_seed = trial.seed + attempt
+
+        def eval_log(i, metrics, _evals=evals):
+            _evals[i + 1] = metrics["eval_episode_reward_mean"]
+
+        sink = eval_log
+        if reseed_budget > 0 and decision_iter > 0:
+            sink = make_stall_guard(
+                eval_log, decision_iter, final_iter, threshold,
+                raise_on_stall=attempt < reseed_budget)
+        # Satellite fix (ISSUE 9): each reseed attempt keeps its OWN
+        # best-eval lineage. The train CLI clears best/ on reseed (its
+        # deliverable is one run dir); a study is evidence — an
+        # abandoned attempt's peak checkpoint is part of the record,
+        # and the ledger names the attempt the verdict was scored from.
+        best_mgr = on_eval = None
+        if cfg.eval_every > 0:
+            best_mgr = CheckpointManager(
+                trial_dir / f"best_attempt{attempt}", keep=1)
+            on_eval = make_best_checkpoint_hook(
+                best_mgr, tree_fn,
+                extras={"trial_id": trial.trial_id, "variant": trial.variant,
+                        "seed": attempt_seed, "attempt": attempt,
+                        "env": spec.env, "preset": spec.preset,
+                        "num_nodes": spec.num_nodes})
+        try:
+            runner, _ = ppo_train(
+                bundle, cfg, spec.iterations, seed=attempt_seed, net=net,
+                log_fn=lambda *a: None, eval_log_fn=sink, on_eval=on_eval)
+            if best_mgr is not None:
+                best_mgr.close()
+            break
+        except EvalStall as stall:
+            if best_mgr is not None:
+                best_mgr.close()  # finalize; the lineage dir STAYS
+            attempt_log.append({
+                "attempt": attempt, "seed": attempt_seed,
+                "stall_iteration": stall.iteration,
+                "best_eval": stall.best_eval,
+                "evals": {str(k): round(v, 3) for k, v in evals.items()},
+            })
+            attempt += 1
+
+    # ------------------------------------------------ verdict scoring
+    # spec.score_source picks the weights the verdict measures: "final"
+    # (the run's last params — the §1b protocol the recorded baselines
+    # used) or "best" (the surviving attempt's best-eval keeper, item
+    # 3a's deliverable). The ledger records which attempt and source the
+    # verdict actually came from either way.
+    scored_source, scored_step = "final", None
+    score_params = runner.params
+    if spec.score_source == "best" and cfg.eval_every > 0:
+        best_mgr = CheckpointManager(
+            trial_dir / f"best_attempt{attempt}", keep=1)
+        step = best_mgr.latest_verified_step()
+        if step is not None:
+            tree, _ = best_mgr.restore(step)
+            score_params = tree["params"]
+            scored_source, scored_step = "best", step
+        best_mgr.close()
+
+    report = structured_evaluate(
+        spec.env, bundle, net, score_params,
+        num_episodes=spec.final_eval_episodes, seed=0)
+    concentration = _argmax_collision(
+        bundle, net, score_params,
+        episodes=min(32, spec.final_eval_episodes), seed=1)
+
+    by_deadline = max(
+        (v for i, v in evals.items() if i <= spec.stall_deadline),
+        default=None)
+    eval_final = evals[max(evals)] if evals else None
+    record = {
+        "trial_id": trial.trial_id,
+        "variant": trial.variant,
+        "seed": trial.seed,
+        "status": "ok",
+        "attempts": attempt + 1,
+        "scored_attempt": attempt,
+        "scored_seed": trial.seed + attempt,
+        "scored_source": scored_source,
+        "scored_step": scored_step,
+        "attempt_log": attempt_log,
+        "threshold": round(threshold, 3),
+        "eval_at_deadline": (None if by_deadline is None
+                             else round(by_deadline, 3)),
+        "eval_final": None if eval_final is None else round(eval_final, 3),
+        "flagged_early": (None if by_deadline is None
+                          else bool(by_deadline < threshold)),
+        "flagged_final": (None if eval_final is None
+                          else bool(eval_final < threshold)),
+        "improvement_pct": round(report.improvement_vs_best_baseline_pct, 2),
+        "failed": bool(report.improvement_vs_best_baseline_pct < 0),
+        "avg_episode_reward": round(report.avg_episode_reward, 3),
+        "argmax_collision": round(concentration, 4),
+        "wall_s": round(time.time() - t0, 1),
+        "backend": jax.devices()[0].platform,
+    }
+    write_result(trial_dir, record)
+    return record
+
+
+def atomic_write_json(path: str | Path, obj, indent: int | None = None) -> None:
+    """tmp-then-rename JSON write — the one implementation of the
+    graftguard atomicity discipline for study artifacts (results,
+    summaries, threshold caches); a kill leaves either nothing or a
+    complete file. The tmp name is per-writer-unique (pid): concurrent
+    writers of the same target (e.g. same-variant workers racing on the
+    threshold cache) each rename their OWN complete file, last one
+    wins — never a shared tmp renamed out from under a mid-write peer."""
+    path = Path(path)
+    tmp = path.parent / f".{path.name}.{os.getpid()}.tmp"
+    tmp.write_text(json.dumps(obj, sort_keys=True, indent=indent))
+    os.replace(tmp, path)
+
+
+def write_result(trial_dir: str | Path, record: dict) -> None:
+    """Atomic ``result.json`` — the worker->runner handoff file the
+    resumed study adopts without re-running."""
+    atomic_write_json(Path(trial_dir) / RESULT_NAME, record)
+
+
+def limit_blas_threads(threads: int) -> bool:
+    """Best-effort threadpoolctl clamp of the ALREADY-LIVE BLAS pools
+    (the in-process path; fresh workers pin via environment instead,
+    which is the reliable window). Returns whether the clamp applied."""
+    try:
+        from threadpoolctl import threadpool_limits
+
+        threadpool_limits(limits=threads)
+        return True
+    except Exception:  # noqa: BLE001 — pinning is an optimization; the
+        # study still runs correct (just slower) on library defaults
+        logger.warning("threadpoolctl unavailable; BLAS pools keep "
+                       "library defaults (wanted %d threads)", threads)
+        return False
+
+
+def configure_jax_cache() -> None:
+    """Point jax at the shared persistent compilation cache (env
+    override ``GRAFTSTUDY_JAX_CACHE``) so a study's repeated tiny-trial
+    compiles are paid once per STUDY, not once per worker/trial — the
+    one implementation behind the worker, the in-process CLI path, and
+    the chaos driver."""
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("GRAFTSTUDY_JAX_CACHE",
+                                         "/tmp/jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    except Exception:  # noqa: BLE001 — cache config is version-
+        pass           # dependent; purely an optimization
+
+
+class StudyRunner:
+    """Drive a study's trial matrix to a complete ledger (module
+    docstring). ``jobs=0``: in-process sequential; ``jobs >= 1``: up to
+    ``jobs`` concurrent worker subprocesses, each BLAS-pinned to
+    ``blas_threads`` threads (default ``max(1, cores // jobs)``)."""
+
+    def __init__(self, spec: StudySpec, study_dir: str | Path,
+                 jobs: int = 1, blas_threads: int | None = None):
+        if jobs < 0:
+            raise ValueError(f"jobs={jobs}: 0 (in-process) or a worker count")
+        self.spec = spec
+        self.study_dir = Path(study_dir)
+        self.jobs = jobs
+        if blas_threads is None and jobs > 0:
+            blas_threads = max(1, (os.cpu_count() or 1) // jobs)
+        self.blas_threads = blas_threads
+        if jobs == 0 and blas_threads:
+            # In-process trials can't be pinned via environment (numpy
+            # is long imported); clamp the live pools best-effort so
+            # --blas-threads is never silently ignored.
+            limit_blas_threads(blas_threads)
+        self.ledger = StudyLedger(self.study_dir, spec)
+
+    def trial_dir(self, trial_id: str) -> Path:
+        return self.study_dir / TRIALS_DIR / trial_id
+
+    def _prepare_resume(self) -> list:
+        """Adopt orphaned results, wipe in-flight dirs, return the trials
+        still to run (spec order)."""
+        done = self.ledger.completed_ids()
+        remaining = []
+        for trial in self.spec.trials():
+            if trial.trial_id in done:
+                continue
+            tdir = self.trial_dir(trial.trial_id)
+            result = tdir / RESULT_NAME
+            if result.exists():
+                # Killed between result write and ledger append: the
+                # result is complete (atomic rename) — adopt it.
+                self.ledger.append(json.loads(result.read_text()))
+                logger.info("adopted orphaned result for %s", trial.trial_id)
+                continue
+            if tdir.exists():
+                # In-flight when the study died: partial checkpoints,
+                # no verdict — restart it from scratch. UNLESS a live
+                # orphaned worker (runner killed without its process
+                # group) is still writing there: wiping under it would
+                # interleave two trainers into one trial dir.
+                wpid_file = tdir / WORKER_PID_NAME
+                wpid = _read_live_pid(wpid_file)
+                if wpid is not None:
+                    raise RuntimeError(
+                        f"trial {trial.trial_id!r} has a live worker "
+                        f"(pid {wpid}, {wpid_file}) from a previous "
+                        "runner — wait for it or kill it before "
+                        "resuming (if the pid was recycled by an "
+                        "unrelated process, delete the pid file)")
+                shutil.rmtree(tdir)
+                logger.info("restarting in-flight trial %s", trial.trial_id)
+            remaining.append(trial)
+        return remaining
+
+    def run(self, progress=print) -> list:
+        """Execute every remaining trial; returns the full record list
+        (ledger order). Idempotent: a completed study returns instantly.
+
+        Single-writer lock: the study dir carries a ``runner.pid`` while
+        a runner is live, so a concurrent ``run()`` refuses instead of
+        wiping the first runner's in-flight trial dirs; a stale lock
+        (dead pid) is overridden. Workers orphaned by a killed runner
+        are covered separately: each trial dir carries the worker's
+        ``worker.pid`` and ``_prepare_resume`` refuses to wipe a dir
+        whose worker is still alive."""
+        lock = acquire_runner_lock(self.study_dir)
+        try:
+            return self._run_locked(progress)
+        finally:
+            lock.unlink(missing_ok=True)
+
+    def _run_locked(self, progress) -> list:
+        remaining = self._prepare_resume()
+        total = len(self.spec.trials())
+        if progress is not None and not remaining:
+            progress(f"# study {self.spec.name}: all {total} trials "
+                     "already in the ledger")
+        if self.jobs == 0:
+            for trial in remaining:
+                record = run_trial(self.spec, trial,
+                                   self.trial_dir(trial.trial_id))
+                self.ledger.append(record)
+                if progress is not None:
+                    progress(f"# [{len(self.ledger.records())}/{total}] "
+                             + json.dumps(record, sort_keys=True))
+        else:
+            self._run_subprocess(remaining, total, progress)
+        return self.ledger.records()
+
+    # --------------------------------------------------- subprocess pool
+
+    def _worker_env(self) -> dict:
+        env = dict(os.environ)
+        # The package is run from a source tree (no install): workers
+        # must resolve rl_scheduler_tpu the same way this process did.
+        repo_root = str(Path(__file__).resolve().parents[2])
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        if self.blas_threads:
+            # Per-trial BLAS pinning, the graftserve finding: env vars
+            # land BEFORE numpy/jax import in a fresh process (the one
+            # window where they reliably size the pools); the worker
+            # adds a best-effort threadpoolctl clamp on top.
+            for var in ("OPENBLAS_NUM_THREADS", "OMP_NUM_THREADS",
+                        "MKL_NUM_THREADS"):
+                env[var] = str(self.blas_threads)
+            env["GRAFTSTUDY_BLAS_THREADS"] = str(self.blas_threads)
+        return env
+
+    def _run_subprocess(self, remaining: list, total: int, progress) -> None:
+        env = self._worker_env()
+        queue = list(remaining)
+        live: dict = {}
+        try:
+            while queue or live:
+                while queue and len(live) < self.jobs:
+                    trial = queue.pop(0)
+                    tdir = self.trial_dir(trial.trial_id)
+                    tdir.mkdir(parents=True, exist_ok=True)
+                    log = open(tdir / "worker.log", "w")
+                    proc = subprocess.Popen(
+                        [sys.executable, "-m",
+                         "rl_scheduler_tpu.studies.worker",
+                         "--study-dir", str(self.study_dir),
+                         "--trial-id", trial.trial_id],
+                        stdout=log, stderr=subprocess.STDOUT, env=env)
+                    # Orphan evidence for _prepare_resume: if THIS
+                    # runner dies without its process group, a resume
+                    # must not wipe the dir while the worker lives.
+                    (tdir / WORKER_PID_NAME).write_text(str(proc.pid))
+                    live[trial.trial_id] = (trial, proc, log)
+                time.sleep(0.2)
+                for tid in list(live):
+                    trial, proc, log = live[tid]
+                    rc = proc.poll()
+                    if rc is None:
+                        continue
+                    log.close()
+                    del live[tid]
+                    self._collect(trial, rc, total, progress)
+        finally:
+            for _, proc, log in live.values():
+                proc.kill()
+                log.close()
+
+    def _collect(self, trial: TrialSpec, rc: int, total: int,
+                 progress) -> None:
+        tdir = self.trial_dir(trial.trial_id)
+        # The worker exited: its pid file is no longer orphan evidence
+        # (and a recycled pid must not block a later resume).
+        (tdir / WORKER_PID_NAME).unlink(missing_ok=True)
+        result = tdir / RESULT_NAME
+        if rc == 0 and result.exists():
+            record = json.loads(result.read_text())
+        else:
+            # A crashed trial is evidence too: recorded (and skipped on
+            # resume — --fresh re-runs), excluded from the rates, and
+            # surfaced in the grid's error column.
+            tail = ""
+            log = tdir / "worker.log"
+            if log.exists():
+                tail = "\n".join(log.read_text().splitlines()[-5:])
+            record = {"trial_id": trial.trial_id, "variant": trial.variant,
+                      "seed": trial.seed, "status": "error",
+                      "returncode": rc, "log_tail": tail}
+            logger.error("trial %s failed (rc=%s): %s",
+                         trial.trial_id, rc, tail)
+        self.ledger.append(record)
+        if progress is not None:
+            progress(f"# [{len(self.ledger.records())}/{total}] "
+                     + json.dumps(record, sort_keys=True))

@@ -1,0 +1,65 @@
+"""graftstudy worker: run ONE trial in a fresh process.
+
+Launched by :class:`~rl_scheduler_tpu.studies.runner.StudyRunner` with
+BLAS pools already pinned through the environment (set before this
+process imported numpy/jax — the window where the env vars actually
+size the pools). The trial's protocol comes from the study dir's LEDGER
+header, not from argv: a worker can never execute a spec that drifted
+from the one the ledger's completed trials ran under.
+
+Exit 0 with ``<trial_dir>/result.json`` written (atomically) on
+success; any failure exits nonzero and the runner records an error
+entry from the log tail.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from pathlib import Path
+
+
+def _pin_runtime() -> None:
+    """Best-effort threadpoolctl clamp on top of the env-var pinning,
+    plus the shared persistent compilation cache so repeated tiny-trial
+    compiles are paid once per study, not once per worker."""
+    from rl_scheduler_tpu.studies.runner import (
+        configure_jax_cache,
+        limit_blas_threads,
+    )
+
+    threads = int(os.environ.get("GRAFTSTUDY_BLAS_THREADS", "0") or 0)
+    if threads > 0:
+        # On top of the env-var pinning the runner already applied
+        # before this process imported numpy/jax.
+        limit_blas_threads(threads)
+    configure_jax_cache()
+
+
+def main(argv: list | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--study-dir", required=True)
+    p.add_argument("--trial-id", required=True)
+    args = p.parse_args(argv)
+
+    _pin_runtime()
+
+    from rl_scheduler_tpu.studies.ledger import load_spec
+    from rl_scheduler_tpu.studies.runner import TRIALS_DIR, run_trial
+
+    spec = load_spec(args.study_dir)
+    matching = [t for t in spec.trials() if t.trial_id == args.trial_id]
+    if not matching:
+        raise SystemExit(
+            f"trial {args.trial_id!r} is not in study {spec.name!r} "
+            f"({[t.trial_id for t in spec.trials()]})")
+    record = run_trial(
+        spec, matching[0],
+        Path(args.study_dir) / TRIALS_DIR / args.trial_id)
+    print(f"worker done: {record['trial_id']} status={record['status']}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -955,3 +955,117 @@ class TestBestEvalCheckpoint:
         mgr = Mgr(run_dir)
         assert mgr.latest_verified_step() == best_step + 1
         mgr.close()
+
+
+class TestStudyChaos:
+    """graftstudy under SIGKILL (docs/studies.md): a killed mid-study
+    run resumes from the atomic tmp-then-rename ledger — completed-trial
+    entries bitwise intact and not re-run, the in-flight trial restarted
+    from scratch."""
+
+    # The study driver runs as its own process group so SIGKILL takes
+    # the in-flight trial's work down with it, exactly like a lost VM.
+    # The acceptance shape — 2 variants x 3 seeds — is DERIVED from the
+    # registry's study_smoke preset (not hand-copied) so the trial
+    # config can never silently diverge from the tier-1 smoke's, and
+    # every XLA program is shared with it via the persistent cache.
+    DRIVER = """
+import dataclasses
+import sys
+sys.path.insert(0, {root!r})
+from rl_scheduler_tpu.studies import StudyRunner, configure_jax_cache, get_study
+configure_jax_cache()
+spec = dataclasses.replace(
+    get_study("study_smoke"), name="chaos", seeds=(0, 1, 2),
+    target_failure_rate=0.2)
+StudyRunner(spec, {study_dir!r}, jobs=0).run()
+"""
+    TRIAL_IDS = ["control-seed0", "control-seed1", "control-seed2",
+                 "anneal-seed0", "anneal-seed1", "anneal-seed2"]
+
+    def _launch(self, script):
+        import os
+        import subprocess
+        import sys
+
+        return subprocess.Popen(
+            [sys.executable, "-c", script],
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+            start_new_session=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+    def test_sigkill_mid_study_resumes_bitwise(self, tmp_path):
+        import os
+        import signal
+        import time
+
+        study_dir = tmp_path / "study"
+        script = self.DRIVER.format(
+            root=str(Path(__file__).resolve().parents[1]),
+            study_dir=str(study_dir))
+        ledger = study_dir / "ledger.jsonl"
+
+        proc = self._launch(script)
+        try:
+            # Wait for the FIRST completed trial to land in the ledger,
+            # then SIGKILL the whole group mid-trial-2.
+            deadline = time.time() + 420
+            while time.time() < deadline:
+                if ledger.exists() and len(ledger.read_bytes().splitlines()) >= 2:
+                    break
+                if proc.poll() is not None:
+                    raise AssertionError("study finished before the kill")
+                time.sleep(0.25)
+            else:
+                raise AssertionError("no trial completed before deadline")
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+        killed_bytes = ledger.read_bytes()
+        killed_lines = killed_bytes.splitlines()
+        assert len(killed_lines) >= 2  # header + >= 1 completed trial
+        done_ids = [json.loads(l)["trial_id"] for l in killed_lines[1:]]
+        # Evidence of the in-flight trial: its dir exists, result absent
+        # (may be absent if the kill landed between trials — both fine).
+        mtimes = {
+            tid: (study_dir / "trials" / tid / "result.json").stat().st_mtime
+            for tid in done_ids
+        }
+
+        # Resume: same driver, runs to completion.
+        rc = self._launch(script).wait(timeout=600)
+        assert rc == 0
+        after_bytes = ledger.read_bytes()
+        # Bitwise: the killed run's ledger is an exact PREFIX — completed
+        # entries were neither rewritten nor re-run.
+        assert after_bytes.startswith(killed_bytes)
+        records = [json.loads(l) for l in after_bytes.splitlines()[1:]]
+        assert [r["trial_id"] for r in records] == self.TRIAL_IDS
+        assert all(r["status"] == "ok" for r in records)
+        # Completed trials untouched on disk (result.json not rewritten).
+        for tid, mtime in mtimes.items():
+            assert (study_dir / "trials" / tid
+                    / "result.json").stat().st_mtime == mtime
+        # The resumed run restarted the in-flight trial and produced its
+        # verdict (and every trial dir now holds an atomic result).
+        for r in records:
+            assert (study_dir / "trials" / r["trial_id"]
+                    / "result.json").exists()
+        # The completed ledger analyzes to per-variant Wilson-interval
+        # failure rates + graded verdicts (the acceptance summary the
+        # CLI emits as the driver line).
+        from rl_scheduler_tpu.studies import analyze_study, load_spec
+
+        summary = analyze_study(load_spec(study_dir), records)
+        assert summary["schema_version"] == 1
+        for v in ("control", "anneal"):
+            cell = summary["variants"][v]
+            assert cell["trials"] == 3
+            lo, hi = cell["wilson95"]
+            assert 0.0 <= lo <= cell["failure_rate"] <= hi <= 1.0
+            assert cell["verdict"] in (
+                "confirmed_below", "point_below", "point_above",
+                "confirmed_above")

@@ -66,8 +66,8 @@ def test_scenarios_is_a_real_package():
 
     assert pkg.__file__ is not None and pkg.__file__.endswith("__init__.py")
     assert set(SCENARIOS) == {"bursty", "heterogeneous", "churn",
-                              "price_spike"}
-    assert len(FAMILIES) == 4
+                              "price_spike", "randomized"}
+    assert len(FAMILIES) == 5
 
 
 def test_stale_pycache_modules_do_not_import():

@@ -1,0 +1,688 @@
+"""graftstudy (rl_scheduler_tpu/studies/, docs/studies.md).
+
+Pins the subsystem's contracts: frozen specs compiling to deterministic
+trial lists, the atomic bitwise-resumable ledger, Wilson/sign-test
+verdicts, the reseed x best-keeper lineage fix, the anti-latch
+interventions (--sample-temp-anneal / --argmax-penalty) and their
+checkpoint-meta round-trip, and the tier-1 smoke: a real 2-seed x
+2-variant study through the multi-process CLI. The SIGKILL-mid-study
+chaos case lives with the chaos suite (tests/test_graftguard.py).
+"""
+
+import dataclasses
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from rl_scheduler_tpu.studies import (
+    OVERLAY_KEYS,
+    STUDIES,
+    LedgerMismatch,
+    StudyLedger,
+    StudyRunner,
+    StudySpec,
+    TrialSpec,
+    acquire_runner_lock,
+    analyze_study,
+    atomic_write_json,
+    build_trial_config,
+    configure_jax_cache,
+    get_study,
+    limit_blas_threads,
+    list_studies,
+    load_spec,
+    overlay,
+    parse_seeds,
+    render_grid,
+    run_trial,
+    sign_test_pvalue,
+    spec_from_json,
+    summary_json_line,
+    wilson_interval,
+    write_result,
+)
+
+# The tier-1-affordable trial shape (shared with the chaos suite and the
+# study_smoke preset so every XLA program is compiled once per session).
+TINY_BASE = overlay(num_envs=8, rollout_steps=8, minibatch_size=64,
+                    num_epochs=1)
+
+
+def tiny_spec(**kw) -> StudySpec:
+    base = dict(
+        name="t", env="cluster_set", preset="quick", num_nodes=4,
+        seeds=(0, 1), iterations=2, eval_every=1, eval_episodes=4,
+        final_eval_episodes=8, stall_deadline=1, base_overlay=TINY_BASE,
+    )
+    base.update(kw)
+    return StudySpec(**base)
+
+
+# ----------------------------------------------------------------- spec
+
+
+class TestStudySpec:
+    def test_trials_deterministic_and_ordered(self):
+        spec = tiny_spec(variants=(("control", ()),
+                                   ("anneal", overlay(sample_temp_anneal=0.5))))
+        ids = [t.trial_id for t in spec.trials()]
+        assert ids == ["control-seed0", "control-seed1",
+                       "anneal-seed0", "anneal-seed1"]
+        assert spec.trials() == spec.trials()
+        t = spec.trials()[2]
+        assert isinstance(t, TrialSpec)
+        assert t.variant == "anneal" and t.seed == 0
+        # base + variant overlays merge, variant wins
+        assert t.overlay["sample_temp_anneal"] == 0.5
+        assert t.overlay["num_envs"] == 8
+
+    def test_fingerprint_tracks_protocol(self):
+        a, b = tiny_spec(), tiny_spec()
+        assert a.fingerprint() == b.fingerprint()
+        c = tiny_spec(seeds=(0, 1, 2))
+        assert c.fingerprint() != a.fingerprint()
+
+    def test_json_roundtrip(self):
+        spec = tiny_spec(variants=(
+            ("control", ()), ("rand", overlay(scenario="randomized"))),
+            control="control")
+        back = spec_from_json(spec.to_json())
+        assert back == spec and back.fingerprint() == spec.fingerprint()
+
+    def test_unknown_overlay_key_refused(self):
+        with pytest.raises(ValueError, match="vocabulary"):
+            tiny_spec(variants=(("control", ()),
+                                ("bad", overlay(warp_drive=9))))
+        assert "sample_temp_anneal" in OVERLAY_KEYS
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="control"):
+            tiny_spec(variants=(("a", ()),), control="b")
+        with pytest.raises(ValueError, match="duplicate"):
+            tiny_spec(seeds=(0, 0))
+        with pytest.raises(ValueError, match="structured"):
+            tiny_spec(env="multi_cloud")
+        with pytest.raises(ValueError, match="preset"):
+            tiny_spec(preset="nope")
+        with pytest.raises(ValueError, match="score_source"):
+            tiny_spec(score_source="peak")
+        # best-keeper scoring without evals would silently degrade every
+        # verdict to final params — refused up front.
+        with pytest.raises(ValueError, match="no best-eval keeper"):
+            tiny_spec(score_source="best", eval_every=0)
+        # The verdict defaults to the §1b final-params protocol.
+        assert tiny_spec().score_source == "final"
+
+    def test_inert_companion_keys_refused(self):
+        """A spec-valid-but-inert knob would burn a chip arm on a
+        variant identical to control — refused at construction."""
+        with pytest.raises(ValueError, match="sample_temp_anneal"):
+            tiny_spec(variants=(("control", ()),
+                                ("v", overlay(sample_temp_iters=40))))
+        with pytest.raises(ValueError, match="inert"):
+            tiny_spec(variants=(("control", ()),
+                                ("v", overlay(scenario_seed=3))))
+        # Inert VALUES are the same defect class: identity temperature
+        # and a zero penalty both train byte-identical to control.
+        with pytest.raises(ValueError, match="identity temperature"):
+            tiny_spec(variants=(("control", ()),
+                                ("v", overlay(sample_temp_anneal=1.0))))
+        with pytest.raises(ValueError, match="disables the penalty"):
+            tiny_spec(variants=(("control", ()),
+                                ("v", overlay(argmax_penalty=0.0))))
+        with pytest.raises(ValueError, match="never reads the sharpness"):
+            tiny_spec(variants=(
+                ("control", ()),
+                ("v", overlay(argmax_penalty_sharpness=32.0))))
+
+    def test_scenario_overlay_resolved_at_construction(self):
+        """A typo'd scenario name or an env-incompatible family must
+        fail when the spec is built, not per-trial on the chip."""
+        with pytest.raises(ValueError, match="unknown scenario"):
+            tiny_spec(variants=(("control", ()),
+                                ("v", overlay(scenario="randomzied"))))
+        with pytest.raises(ValueError, match="does not shape env"):
+            tiny_spec(env="cluster_graph",
+                      variants=(("control", ()),
+                                ("v", overlay(scenario="randomized"))))
+        tiny_spec(variants=(("control", ()),
+                            ("v", overlay(scenario="randomized"))))
+        # With the companion present, both are fine.
+        tiny_spec(variants=(
+            ("control", ()),
+            ("v", overlay(sample_temp_anneal=0.5, sample_temp_iters=40)),
+            ("r", overlay(scenario="randomized", scenario_seed=3))))
+
+    def test_reseed_guard_eligibility_validated(self):
+        """A guard the eval schedule can never fire is refused (the
+        runner would otherwise silently skip it — same arithmetic as
+        the train CLI's refusal)."""
+        with pytest.raises(ValueError, match="silently disabled"):
+            tiny_spec(eval_every=8, stall_deadline=4,
+                      variants=(("control", overlay(reseed_on_stall=1)),))
+        with pytest.raises(ValueError, match="eval signal"):
+            tiny_spec(eval_every=0, stall_deadline=4,
+                      variants=(("control", overlay(reseed_on_stall=1)),))
+
+    def test_parse_seeds(self):
+        assert parse_seeds("0-3") == [0, 1, 2, 3]
+        assert parse_seeds("0,2,7") == [0, 2, 7]
+        assert parse_seeds("1-2,9") == [1, 2, 9]
+
+    def test_registry(self):
+        assert "fleet64_antilatch" in list_studies()
+        fleet = get_study("fleet64_antilatch")
+        assert set(fleet.variant_names()) == {
+            "control", "anneal", "argmax_penalty", "randomized"}
+        assert len(fleet.seeds) == 9
+        assert fleet.target_failure_rate == 0.20
+        # Every registered study compiles (spec validation runs in
+        # __post_init__; trials() exercises the overlay merge).
+        for name in STUDIES:
+            assert get_study(name).trials()
+        with pytest.raises(ValueError, match="unknown study"):
+            get_study("nope")
+
+
+# --------------------------------------------------------------- ledger
+
+
+class TestLedger:
+    def test_append_preserves_prior_bytes(self, tmp_path):
+        spec = tiny_spec()
+        led = StudyLedger(tmp_path, spec)
+        led.append({"trial_id": "control-seed0", "variant": "control",
+                    "seed": 0, "status": "ok", "failed": False,
+                    "improvement_pct": 1.0})
+        before = led.path.read_bytes()
+        led.append({"trial_id": "control-seed1", "variant": "control",
+                    "seed": 1, "status": "ok", "failed": True,
+                    "improvement_pct": -2.0})
+        after = led.path.read_bytes()
+        assert after.startswith(before)  # bitwise: appends never rewrite
+        assert led.completed_ids() == {"control-seed0", "control-seed1"}
+        assert len(led.records()) == 2
+        assert led.header()["spec_sha"] == spec.fingerprint()
+        assert not list(tmp_path.glob("*.tmp"))  # rename completed
+
+    def test_reopen_resumes_same_spec(self, tmp_path):
+        spec = tiny_spec()
+        StudyLedger(tmp_path, spec).append(
+            {"trial_id": "control-seed0", "variant": "control", "seed": 0,
+             "status": "ok", "failed": False, "improvement_pct": 0.0})
+        led2 = StudyLedger(tmp_path, spec)
+        assert led2.completed_ids() == {"control-seed0"}
+        assert load_spec(tmp_path) == spec
+
+    def test_changed_spec_refused(self, tmp_path):
+        StudyLedger(tmp_path, tiny_spec())
+        with pytest.raises(LedgerMismatch, match="changed protocol"):
+            StudyLedger(tmp_path, tiny_spec(seeds=(0, 1, 2)))
+
+    def test_runner_single_writer_lock(self, tmp_path):
+        """A live runner.pid refuses a second runner (it would wipe the
+        first's in-flight trial dirs); a stale lock (dead pid) is
+        overridden and resume proceeds."""
+        import os
+
+        spec = tiny_spec(seeds=(0,))
+        runner = StudyRunner(spec, tmp_path, jobs=0)
+        # Pre-complete the single trial so an unblocked run() returns
+        # instantly instead of training.
+        runner.ledger.append(_rec("control", 0, False, 10.0))
+        (tmp_path / "runner.pid").write_text(str(os.getpid()))  # alive
+        with pytest.raises(RuntimeError, match="already being run"):
+            runner.run(progress=None)
+        with pytest.raises(RuntimeError, match="already being run"):
+            # The CLI's --fresh path takes the same exclusive lock
+            # before deleting anything.
+            acquire_runner_lock(tmp_path)
+        # Max pid on Linux is < 2^22 by default; this one is dead.
+        (tmp_path / "runner.pid").write_text("4194000")
+        records = runner.run(progress=None)
+        assert len(records) == 1
+        assert not (tmp_path / "runner.pid").exists()  # released
+
+    def test_atomic_write_json(self, tmp_path):
+        """The one atomic-JSON implementation behind result.json and
+        summary.json: complete file, no .tmp left behind."""
+        path = tmp_path / "summary.json"
+        atomic_write_json(path, {"b": 2, "a": 1})
+        assert json.loads(path.read_text()) == {"a": 1, "b": 2}
+        atomic_write_json(path, {"a": 3}, indent=1)
+        assert json.loads(path.read_text()) == {"a": 3}
+        assert not list(tmp_path.glob("*.tmp"))
+        # configure_jax_cache / limit_blas_threads are the shared
+        # best-effort runtime knobs behind the worker, the in-process
+        # CLI path, and the chaos driver (never-raise contract).
+        configure_jax_cache()
+        assert limit_blas_threads(1) in (True, False)
+
+
+# ------------------------------------------------------------- analysis
+
+
+def _rec(variant, seed, failed, impr, status="ok", **kw):
+    base = {"trial_id": f"{variant}-seed{seed}", "variant": variant,
+            "seed": seed, "status": status, "failed": failed,
+            "improvement_pct": impr, "argmax_collision": 0.5 if failed
+            else 0.1, "attempts": 1}
+    base.update(kw)
+    return base
+
+
+class TestAnalysis:
+    def test_wilson_interval_known_values(self):
+        lo, hi = wilson_interval(4, 9)
+        # 4/9 at z=1.96: the standard Wilson values.
+        assert lo == pytest.approx(0.1888, abs=1e-3)
+        assert hi == pytest.approx(0.7334, abs=1e-3)
+        assert wilson_interval(0, 0) == (0.0, 1.0)
+        lo0, hi0 = wilson_interval(0, 9)
+        assert lo0 == 0.0 and 0.0 < hi0 < 0.35
+
+    def test_sign_test(self):
+        assert sign_test_pvalue(0, 0) == 1.0
+        assert sign_test_pvalue(5, 0) == pytest.approx(2 * 0.5**5)
+        assert sign_test_pvalue(3, 3) == 1.0
+
+    def test_verdicts_and_paired_deltas(self):
+        spec = tiny_spec(
+            seeds=tuple(range(9)), target_failure_rate=0.20,
+            variants=(("control", ()),
+                      ("fix", overlay(argmax_penalty=0.05)),
+                      ("worse", overlay(sample_temp_anneal=0.5))))
+        control_failed = {2, 4, 5, 8}  # the measured 4/9 pattern
+        records = []
+        for s in range(9):
+            records.append(_rec("control", s, s in control_failed,
+                                -20.0 if s in control_failed else 20.0))
+            # 'fix' converges everywhere: 4 seeds fixed, 0 broken.
+            records.append(_rec("fix", s, False, 22.0))
+            # 'worse' fails everything.
+            records.append(_rec("worse", s, True, -30.0))
+        summary = analyze_study(spec, records)
+        assert summary["schema_version"] == 1
+        assert summary["metric"] == "study_summary"
+        v = summary["variants"]
+        assert v["control"]["failures"] == 4
+        # 4/9 = 0.44 over the bar, but wilson lo (0.19) is under it.
+        assert v["control"]["verdict"] == "point_above"
+        assert v["fix"]["failures"] == 0
+        # 0/9's wilson hi is 0.30: n=9 cannot CONFIRM <0.2 — the honest
+        # graded verdict (docstring arithmetic).
+        assert v["fix"]["verdict"] == "point_below"
+        assert v["fix"]["wilson95"][1] == pytest.approx(0.299, abs=1e-2)
+        assert v["worse"]["verdict"] == "confirmed_above"
+        vs = v["fix"]["vs_control"]
+        assert vs["paired_seeds"] == 9
+        assert vs["seeds_fixed"] == 4 and vs["seeds_broken"] == 0
+        assert vs["sign_test_p"] == pytest.approx(2 * 0.5**4)
+        assert vs["mean_delta_pct"] > 0
+        grid = render_grid(summary)
+        assert "point_below" in grid and "control (ctrl)" in grid
+        line = summary_json_line(summary)
+        assert json.loads(line)["study"] == spec.name
+
+    def test_errors_excluded_from_rates(self):
+        spec = tiny_spec(variants=(("control", ()),))
+        records = [_rec("control", 0, False, 10.0),
+                   _rec("control", 1, None, None, status="error")]
+        v = analyze_study(spec, records)["variants"]["control"]
+        assert v["trials"] == 1 and v["errors"] == 1
+        assert v["failure_rate"] == 0.0
+
+
+# -------------------------------------------------- trial config overlay
+
+
+class TestBuildTrialConfig:
+    def test_intervention_and_scenario_overlays(self):
+        spec = tiny_spec(variants=(
+            ("control", ()),
+            ("anneal", overlay(sample_temp_anneal=0.5)),
+            ("pen", overlay(argmax_penalty=0.05)),
+            ("rand", overlay(scenario="randomized", scenario_seed=3)),
+            ("guard", overlay(reseed_on_stall=2))))
+        trials = {t.variant: t for t in spec.trials() if t.seed == 0}
+        cfg, bk, budget = build_trial_config(spec, trials["control"])
+        assert cfg.num_envs == 8 and cfg.eval_every == 1
+        assert cfg.sample_temp_end == 1.0 and budget == 0
+        assert bk == {"num_nodes": 4}
+        cfg, _, _ = build_trial_config(spec, trials["anneal"])
+        assert cfg.sample_temp_end == 0.5
+        assert cfg.sample_temp_iters == spec.iterations  # CLI default
+        cfg, _, _ = build_trial_config(spec, trials["pen"])
+        assert cfg.argmax_penalty_coeff == 0.05
+        _, bk, _ = build_trial_config(spec, trials["rand"])
+        assert bk["scenario"].name == "randomized"
+        assert bk["scenario"].seed == 3
+        _, _, budget = build_trial_config(spec, trials["guard"])
+        assert budget == 2
+
+
+# ------------------------------------------- reseed x best-keeper lineage
+
+
+class TestReseedBestLineage:
+    def test_each_attempt_keeps_its_own_best(self, tmp_path):
+        """Satellite fix (ISSUE 9): with the reseed guard tripping, the
+        abandoned attempt's best_attempt0/ lineage SURVIVES (the train
+        CLI clears best/ on reseed; a study keeps the evidence) and the
+        ledger record names the attempt the verdict was scored from."""
+        # stall_deadline=2 with eval_every=1: attempt 0's eval@1 SAVES a
+        # best checkpoint before the guard trips at the deadline eval@2 —
+        # the lineage under test needs an abandoned attempt that got far
+        # enough to have a peak. score_source="best" opts the verdict
+        # into the keeper (the default is the §1b final-params protocol).
+        spec = tiny_spec(variants=(
+            ("guard", overlay(reseed_on_stall=1)),), control="guard",
+            stall_deadline=2, score_source="best")
+        trial = spec.trials()[0]
+        # An unreachable bar forces exactly one reseed (budget 1: the
+        # final attempt runs to completion with the warn-only guard).
+        record = run_trial(spec, trial, tmp_path / "trial",
+                           baseline_threshold=float("inf"))
+        assert record["status"] == "ok"
+        assert record["attempts"] == 2
+        assert record["scored_attempt"] == 1
+        assert record["scored_seed"] == trial.seed + 1
+        assert record["scored_source"] == "best"
+        assert record["scored_step"] is not None
+        # BOTH lineages on disk, each with a saved best checkpoint.
+        for attempt in (0, 1):
+            d = tmp_path / "trial" / f"best_attempt{attempt}"
+            assert d.is_dir(), d
+            from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+            mgr = CheckpointManager(d, keep=1)
+            step = mgr.latest_verified_step()
+            assert step is not None
+            assert mgr.restore_meta(step)["attempt"] == attempt
+            mgr.close()
+        assert record["attempt_log"][0]["attempt"] == 0
+        assert record["attempt_log"][0]["seed"] == trial.seed
+        # result.json is the atomic worker handoff.
+        on_disk = json.loads((tmp_path / "trial" / "result.json").read_text())
+        assert on_disk == record
+        write_result(tmp_path / "trial", record)  # idempotent rewrite
+
+
+# ----------------------------------------------------- tier-1 study smoke
+
+
+class TestStudySmoke:
+    def test_smoke_study_through_multiprocess_cli(self, tmp_path):
+        """The satellite tier-1 smoke: 2 seeds x 2 variants on the tiny
+        preset, through the REAL CLI with 2 worker subprocesses — spec
+        -> ledger -> workers -> verdict grid -> driver JSON line."""
+        out = subprocess.run(
+            [sys.executable, "-m", "rl_scheduler_tpu.studies",
+             "--study", "study_smoke", "--study-root", str(tmp_path),
+             "--jobs", "2"],
+            capture_output=True, text=True, timeout=540,
+            cwd=Path(__file__).resolve().parents[1])
+        assert out.returncode == 0, out.stdout + out.stderr
+        study_dir = tmp_path / "study_smoke"
+        led = StudyLedger(study_dir, get_study("study_smoke"))
+        records = led.records()
+        assert len(records) == 4
+        assert all(r["status"] == "ok" for r in records), records
+        # Driver line: last stdout line is the schema-tagged summary.
+        line = json.loads(out.stdout.strip().splitlines()[-1])
+        assert line["schema_version"] == 1
+        assert line["metric"] == "study_summary"
+        assert set(line["variants"]) == {"control", "anneal"}
+        for v in line["variants"].values():
+            assert v["trials"] == 2
+            assert v["wilson95"][0] <= (v["failure_rate"] or 0)
+        assert (study_dir / "summary.json").exists()
+        # Idempotent resume: a second run re-runs nothing and leaves the
+        # ledger byte-identical.
+        before = led.path.read_bytes()
+        again = subprocess.run(
+            [sys.executable, "-m", "rl_scheduler_tpu.studies",
+             "--study", "study_smoke", "--study-root", str(tmp_path),
+             "--jobs", "2"],
+            capture_output=True, text=True, timeout=120,
+            cwd=Path(__file__).resolve().parents[1])
+        assert again.returncode == 0, again.stdout + again.stderr
+        assert "already in the ledger" in again.stdout
+        assert led.path.read_bytes() == before
+
+
+# -------------------------------------------------- seed_study migration
+
+
+class TestSeedStudyCompat:
+    def test_same_cli_compiles_to_study(self):
+        """loadgen/seed_study.py keeps its CLI but compiles to a
+        graftstudy spec (the docs/scaling.md §1b protocol cannot drift
+        from the subsystem)."""
+        sys.path.insert(0, str(Path(__file__).resolve().parents[1]
+                              / "loadgen"))
+        import seed_study
+
+        spec = seed_study.build_spec("cluster_set", 64, [0, 1, 2], 80,
+                                     100, 16)
+        assert spec.preset == "set_fleet64"
+        assert spec.seeds == (0, 1, 2)
+        assert spec.final_eval_episodes == 100
+        assert spec.variant_names() == ["control"]
+        assert spec.stall_deadline == 16
+        big = seed_study.build_spec("cluster_set", 256, [0], 80, 100, 16)
+        assert big.preset == "set_fleet256"
+        # Final-params scoring: the docs/scaling.md §1b protocol the
+        # recorded 4/9 baseline was measured under.
+        assert spec.score_source == "final"
+        # cluster_graph historically used set_fleet64's scale knobs at
+        # ANY node count ("same scale knobs", the original script).
+        graph = seed_study.build_spec("cluster_graph", 256, [0], 80, 100, 16)
+        assert graph.preset == "set_fleet64"
+
+    def test_dry_run_cli_and_row_format(self, capsys):
+        import seed_study
+
+        rows = seed_study.main(["--seeds", "0-2", "--dry-run"])
+        assert rows == []
+        out = capsys.readouterr().out
+        assert out.count("trial_id") == 3
+        # The historical row/verdict printer from ledger records.
+        records = [
+            {"status": "ok", "seed": 0, "eval_at_deadline": -5.0,
+             "eval_final": -4.0, "flagged_early": True,
+             "flagged_final": False, "improvement_pct": -9.7,
+             "failed": True, "wall_s": 1.0},
+            {"status": "ok", "seed": 1, "eval_at_deadline": -1.0,
+             "eval_final": -1.0, "flagged_early": False,
+             "flagged_final": False, "improvement_pct": 20.0,
+             "failed": False, "wall_s": 1.0},
+        ]
+        rows = seed_study.print_rows(records, 16)
+        out = capsys.readouterr().out
+        assert "NO false negatives" in out
+        assert rows[0]["failed_final"] is True
+        assert rows[0]["flagged_early"] is True
+
+
+# ------------------------------------------------- interventions (3b)
+
+
+class TestSampleTemperature:
+    def test_schedule(self):
+        import jax.numpy as jnp
+
+        from rl_scheduler_tpu.agent.ppo import (
+            PPOTrainConfig,
+            sample_temperature,
+        )
+
+        assert sample_temperature(PPOTrainConfig(), jnp.int32(5)) is None
+        cfg = PPOTrainConfig(sample_temp_end=0.5, sample_temp_iters=10)
+        assert float(sample_temperature(cfg, jnp.int32(0))) == 1.0
+        assert float(sample_temperature(cfg, jnp.int32(5))) == pytest.approx(0.75)
+        assert float(sample_temperature(cfg, jnp.int32(10))) == 0.5
+        assert float(sample_temperature(cfg, jnp.int32(99))) == 0.5  # held
+        hold = PPOTrainConfig(sample_temp_end=0.7, sample_temp_iters=0)
+        assert float(sample_temperature(hold, jnp.int32(0))) == pytest.approx(0.7)
+
+    def test_config_validation(self):
+        from rl_scheduler_tpu.agent.ppo import PPOTrainConfig
+
+        with pytest.raises(ValueError, match="temperature"):
+            PPOTrainConfig(sample_temp_end=0.0)
+        with pytest.raises(ValueError, match="anneal span"):
+            PPOTrainConfig(sample_temp_end=0.5, sample_temp_iters=-1)
+        with pytest.raises(ValueError, match="penalty"):
+            PPOTrainConfig(argmax_penalty_coeff=-0.1)
+
+
+class TestArgmaxPenalty:
+    def test_concentration_bounds_and_latch_signature(self):
+        import jax.numpy as jnp
+
+        from rl_scheduler_tpu.ops.losses import argmax_concentration
+
+        # A latched policy (every state's argmax = node 3) scores ~1
+        # even though each state's distribution is near-uniform.
+        latched = 0.1 * np.random.RandomState(0).randn(64, 16)
+        latched[:, 3] += 0.5
+        c_latched = float(argmax_concentration(jnp.asarray(latched)))
+        # A rotating argmax spreads the pooled mass.
+        rotating = 0.1 * np.random.RandomState(1).randn(64, 16)
+        rotating[np.arange(64), np.arange(64) % 16] += 0.5
+        c_rotating = float(argmax_concentration(jnp.asarray(rotating)))
+        assert c_latched > 0.5
+        assert c_rotating < 0.2
+        assert 1.0 / 16 <= c_rotating <= c_latched <= 1.0
+
+    def test_penalty_gradient_lowers_concentration(self):
+        """The satellite pin: optimizing the penalty term measurably
+        lowers the policy-concentration metric — gradient descent on a
+        latched logit table de-latches it."""
+        import jax
+        import jax.numpy as jnp
+
+        from rl_scheduler_tpu.ops.losses import argmax_concentration
+
+        logits = 0.05 * np.random.RandomState(0).randn(64, 16)
+        logits[:, 3] += 0.3  # the static-premium latch
+        logits = jnp.asarray(logits, jnp.float32)
+        before = float(argmax_concentration(logits))
+        grad_fn = jax.jit(jax.grad(argmax_concentration))
+        for _ in range(50):
+            logits = logits - 0.5 * grad_fn(logits)
+        after = float(argmax_concentration(logits))
+        assert before > 0.5
+        assert after < before * 0.5, (before, after)
+
+    def test_ppo_loss_carries_penalty_and_metric(self):
+        import jax.numpy as jnp
+
+        from rl_scheduler_tpu.ops.losses import PPOLossConfig, ppo_loss
+
+        rng = np.random.RandomState(0)
+        b, a = 32, 8
+        logits = jnp.asarray(rng.randn(b, a), jnp.float32)
+        args = (logits, jnp.zeros(b), jnp.zeros(b, jnp.int32),
+                jnp.asarray(rng.randn(b) * 0.01, jnp.float32),
+                jnp.zeros(b), jnp.asarray(rng.randn(b), jnp.float32),
+                jnp.zeros(b))
+        base, m0 = ppo_loss(*args, PPOLossConfig())
+        pen, m1 = ppo_loss(*args, PPOLossConfig(argmax_penalty_coeff=1.0))
+        assert "argmax_concentration" not in m0
+        conc = float(m1["argmax_concentration"])
+        assert 1.0 / a <= conc <= 1.0
+        # total = base + coeff * concentration, exactly.
+        assert float(pen) == pytest.approx(float(base) + conc, rel=1e-5)
+
+
+class TestInterventionCLIRoundTrip:
+    """Satellite pin: penalty/temperature flags round-trip through
+    checkpoint meta and the --resume guards."""
+
+    TINY = ["--env", "cluster_set", "--num-nodes", "4", "--num-envs", "4",
+            "--rollout-steps", "8", "--minibatch-size", "16",
+            "--num-epochs", "1"]
+
+    def _run(self, tmp_path, extra):
+        from rl_scheduler_tpu.agent import train_ppo as cli
+
+        return cli.main(self.TINY + ["--run-root", str(tmp_path),
+                                     "--run-name", "r"] + extra)
+
+    def test_meta_roundtrip_and_resume_guard(self, tmp_path):
+        from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+        self._run(tmp_path, ["--iterations", "1", "--checkpoint-every", "1",
+                             "--sample-temp-anneal", "0.5",
+                             "--sample-temp-iters", "4",
+                             "--argmax-penalty", "0.05"])
+        mgr = CheckpointManager(tmp_path / "r")
+        meta = mgr.restore_meta(1)
+        mgr.close()
+        assert meta["sample_temp_end"] == 0.5
+        assert meta["sample_temp_iters"] == 4
+        assert meta["argmax_penalty"] == 0.05
+        # Resume WITHOUT the flags: the guard refuses (objective switch).
+        with pytest.raises(SystemExit, match="sample_temp_end"):
+            self._run(tmp_path, ["--iterations", "2",
+                                 "--checkpoint-every", "1", "--resume"])
+        # Mismatched penalty: refused with the recorded value named.
+        with pytest.raises(SystemExit, match="argmax_penalty=0.05"):
+            self._run(tmp_path, ["--iterations", "2",
+                                 "--checkpoint-every", "1", "--resume",
+                                 "--sample-temp-anneal", "0.5",
+                                 "--sample-temp-iters", "4",
+                                 "--argmax-penalty", "0.1"])
+        # Matching flags: resumes and carries the meta forward.
+        self._run(tmp_path, ["--iterations", "2", "--checkpoint-every", "1",
+                             "--resume", "--sample-temp-anneal", "0.5",
+                             "--sample-temp-iters", "4",
+                             "--argmax-penalty", "0.05"])
+        mgr = CheckpointManager(tmp_path / "r")
+        meta = mgr.restore_meta(2)
+        mgr.close()
+        assert meta["sample_temp_end"] == 0.5
+        assert meta["argmax_penalty"] == 0.05
+
+    def test_legacy_checkpoint_resumes_with_flags_off(self, tmp_path):
+        """Pre-intervention checkpoints (no keys) resume fine without
+        flags — and refuse a resume that tries to TURN THEM ON."""
+        self._run(tmp_path, ["--iterations", "1", "--checkpoint-every", "1"])
+        with pytest.raises(SystemExit, match="sample_temp_end"):
+            self._run(tmp_path, ["--iterations", "2",
+                                 "--checkpoint-every", "1", "--resume",
+                                 "--sample-temp-anneal", "0.5"])
+        self._run(tmp_path, ["--iterations", "2", "--checkpoint-every", "1",
+                             "--resume"])
+
+    def test_flag_validation(self, tmp_path):
+        with pytest.raises(SystemExit, match="positive"):
+            self._run(tmp_path, ["--iterations", "1",
+                                 "--sample-temp-anneal", "0"])
+        with pytest.raises(SystemExit, match="pass both"):
+            self._run(tmp_path, ["--iterations", "1",
+                                 "--sample-temp-iters", "4"])
+        with pytest.raises(SystemExit, match=">= 0"):
+            self._run(tmp_path, ["--iterations", "1",
+                                 "--argmax-penalty", "-1"])
+
+    def test_domain_random_scenario_trains_and_records_meta(self, tmp_path):
+        """The randomization variant's substrate: the 'randomized'
+        scenario (family domain_random) keeps the CSV workload, adds
+        per-episode randomization, and rides the normal scenario meta."""
+        from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+        self._run(tmp_path, ["--iterations", "1", "--checkpoint-every", "1",
+                             "--scenario", "randomized"])
+        mgr = CheckpointManager(tmp_path / "r")
+        meta = mgr.restore_meta(1)
+        mgr.close()
+        assert meta["scenario"] == "randomized"
+        assert meta["scenario_family"] == "domain_random"
+        assert meta["node_feat"] == 6  # classic layout: same policy/serving

@@ -14,6 +14,11 @@ contract is exactly the kind of cross-environment invariant that only a
 test reference proves, and its env variant has the same CPU-vs-TPU
 surface as everything in ``ops/``.
 
+``studies/`` joined with graftstudy: its public surface IS a
+reproducibility contract (frozen specs, deterministic trial lists,
+bitwise-resumable ledgers, statistical verdicts) — an untested public
+study op is an unverified claim about what the chip harvest will do.
+
 The check is a name-reference scan of the configured test corpus, not a
 coverage run: pure-AST/text, so it is identical on both JAX versions and
 costs milliseconds. Underscore-prefixed functions, dunders, and
@@ -31,7 +36,7 @@ from tools.graftlint.engine import LintContext, Module
 from tools.graftlint.rules import Rule, register
 
 # Path segments whose public functions must be referenced from tests.
-OP_DIRS = frozenset({"ops", "parallel", "scenarios"})
+OP_DIRS = frozenset({"ops", "parallel", "scenarios", "studies"})
 
 
 @register
@@ -62,7 +67,7 @@ class UntestedPublicOp(Rule):
             yield self.finding(
                 module, node.lineno,
                 f"public {kind} `{name}` has no reference in the test "
-                "corpus — ops/parallel/scenarios code is where "
+                "corpus — ops/parallel/scenarios/studies code is where "
                 "CPU-vs-TPU behavior and seeded-determinism contracts "
                 "diverge; add at least a parity, shape, or determinism "
                 "test",
