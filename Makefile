@@ -9,7 +9,8 @@ PY ?= python
 TRACE ?= tests/fixtures/traceview/fixture.trace.json.gz
 
 .PHONY: lint lint-json test tier1 trace-summary obs chaos chaos-soak \
-        serve-pool serve-soak eval-matrix scenario-bench study study-list
+        serve-pool serve-soak eval-matrix scenario-bench study study-list \
+        overlap-bench
 
 lint:
 	$(PY) -m tools.graftlint --check
@@ -88,3 +89,13 @@ study-list:
 scenario-bench:
 	OPENBLAS_NUM_THREADS=1 OMP_NUM_THREADS=1 JAX_PLATFORMS=cpu \
 		$(PY) bench.py --scenario-bench
+
+# graftpipe CPU A/B (docs/roofline.md): baseline vs pipelined-collect vs
+# fused-prologue vs both, interleaved fetch-synced windows with the
+# per-variant intercept decomposition, BLAS pinned (graftserve finding:
+# the 2-thread default is slower AND noisier). The measured container
+# line is checked in as BENCH_overlap_cpu.json; the chip decomposition
+# is the one-command recipe in docs/roofline.md.
+overlap-bench:
+	OPENBLAS_NUM_THREADS=1 OMP_NUM_THREADS=1 JAX_PLATFORMS=cpu \
+		$(PY) bench.py --overlap-bench

@@ -14,7 +14,7 @@ that depends on the computation is the only trustworthy sync).
 Baseline: the reference's Ray RLlib pipeline sustains ~60 env-steps/s on
 its documented hardware (SURVEY.md §6: 640k steps in ~3h).
 
-Prints TWO JSON lines:
+Prints FOUR JSON lines:
 
 1. the config-3 headline {"metric", "value", "unit", "vs_baseline"} —
    unchanged schema, always first;
@@ -22,7 +22,19 @@ Prints TWO JSON lines:
    perf work remains — docs/roofline.md fleet rows), same window/sync
    methodology, with a "policy_path" key recording which cluster_set
    policy ran: the whole-network fused Pallas kernel on TPU (the fleet
-   preset's auto-selected path) or the dense flax bf16 policy elsewhere.
+   preset's auto-selected path) or the dense flax bf16 policy elsewhere;
+3. the set_fleet64_scenario line (same recipe on a scenario env,
+   docs/scenarios.md) — {"metric", "scenario", "value", "unit",
+   "policy_path"};
+4. the set_fleet64_overlap line (graftpipe, docs/roofline.md): the SAME
+   fleet recipe with `--overlap-collect` semantics — pipelined
+   collect/learn (1-iteration-stale behavior policy) + the fused update
+   prologue — so the driver tracks the pipelined update's steady state
+   next to the unpipelined one. Schema matches line 2 plus
+   {"overlap_collect": true, "fused_prologue": true} and the same
+   "policy_path" key; each 20-update window is ONE lax.scan dispatch,
+   which is exactly the program shape where rollout k+1 can overlap
+   SGD k.
 """
 
 from __future__ import annotations
@@ -89,69 +101,18 @@ def headline_metric() -> dict:
     }
 
 
-def fleet_metric() -> dict:
-    """set_fleet64 steady-state env-steps/s — the axis where perf work
-    remains (round-5 VERDICT): same recipe the preset trains (1024 envs x
-    64 nodes, 1 epoch, bf16), same fetch-synced window methodology as the
-    headline number."""
+def _fleet_window(cfg, scenario=None) -> tuple[float, str]:
+    """Shared scaffold for every set_fleet64-family BENCH line:
+    ``(steps_per_sec, policy_path)`` under the fetch-synced window
+    methodology. Builds the exact policy the preset trains — the
+    whole-network fused kernel on TPU (the auto-selected path), the dense
+    flax bf16 policy off-chip (there the kernel would run interpret mode,
+    correct but meaningless to time) — and on a chip-compile surprise in
+    the fused kernel falls back to the dense recipe and says so in
+    ``policy_path`` rather than losing the BENCH line."""
     from rl_scheduler_tpu.agent.ppo import make_ppo_bundle
-    from rl_scheduler_tpu.agent.presets import PPO_PRESETS
     from rl_scheduler_tpu.agent.train_ppo import make_bundle_and_net
     from rl_scheduler_tpu.ops.gae import default_platform
-
-    cfg = PPO_PRESETS["set_fleet64"]
-
-    def build(fused: bool):
-        # The exact policy the preset trains (agent/train_ppo.py builds
-        # it from the same cfg): the whole-network fused kernel on TPU
-        # (the auto-selected path), the dense flax bf16 policy off-chip —
-        # there the kernel would run interpret mode, correct but
-        # meaningless to time.
-        bundle, net = make_bundle_and_net(
-            "cluster_set", cfg, num_nodes=FLEET_NODES,
-            fused_set_block=fused)
-        return make_ppo_bundle(bundle, cfg, net=net)
-
-    on_tpu = default_platform() == "tpu"
-    policy_path = "fused_block" if on_tpu else "flax_bf16"
-    init_fn, update_fn, _ = build(fused=on_tpu)
-    try:
-        steps_per_sec = _window_steps_per_sec(init_fn, update_fn,
-                                              cfg.batch_size)
-    except Exception as e:  # noqa: BLE001 — the metric must not vanish
-        if not on_tpu:
-            raise
-        # A chip-compile surprise in the fused kernel must not cost the
-        # BENCH line: fall back to the dense recipe and say so.
-        policy_path = f"flax_bf16 (fused_block failed: {type(e).__name__})"
-        init_fn, update_fn, _ = build(fused=False)
-        steps_per_sec = _window_steps_per_sec(init_fn, update_fn,
-                                              cfg.batch_size)
-    return {
-        "metric": "set_fleet64 env-steps/sec/chip "
-                  "(1024 envs x 64 nodes, fused PPO update)",
-        "value": round(steps_per_sec, 1),
-        "unit": "env-steps/sec/chip",
-        "policy_path": policy_path,
-    }
-
-
-def fleet_scenario_metric(scenario_name: str = "bursty") -> dict:
-    """set_fleet64 steady-state on a SCENARIO env (graftscenario,
-    docs/scenarios.md) — the driver-tracked line proving scenario
-    workloads ride the same fused fleet path at the same speed: identical
-    recipe and window/sync methodology as :func:`fleet_metric`, with the
-    CSV replay swapped for the scenario's compiled tables + per-episode
-    randomization. The classic-layout families (bursty/churn/price_spike)
-    keep the fleet policy path, fused kernel included."""
-    from rl_scheduler_tpu.agent.ppo import make_ppo_bundle
-    from rl_scheduler_tpu.agent.presets import PPO_PRESETS
-    from rl_scheduler_tpu.agent.train_ppo import make_bundle_and_net
-    from rl_scheduler_tpu.ops.gae import default_platform
-    from rl_scheduler_tpu.scenarios import get_scenario
-
-    cfg = PPO_PRESETS["set_fleet64"]
-    scenario = get_scenario(scenario_name)
 
     def build(fused: bool):
         bundle, net = make_bundle_and_net(
@@ -165,13 +126,69 @@ def fleet_scenario_metric(scenario_name: str = "bursty") -> dict:
     try:
         steps_per_sec = _window_steps_per_sec(init_fn, update_fn,
                                               cfg.batch_size)
-    except Exception as e:  # noqa: BLE001 — same fallback as fleet_metric
+    except Exception as e:  # noqa: BLE001 — the metric must not vanish
         if not on_tpu:
             raise
         policy_path = f"flax_bf16 (fused_block failed: {type(e).__name__})"
         init_fn, update_fn, _ = build(fused=False)
         steps_per_sec = _window_steps_per_sec(init_fn, update_fn,
                                               cfg.batch_size)
+    return steps_per_sec, policy_path
+
+
+def fleet_metric() -> dict:
+    """set_fleet64 steady-state env-steps/s — the axis where perf work
+    remains (round-5 VERDICT): same recipe the preset trains (1024 envs x
+    64 nodes, 1 epoch, bf16), same fetch-synced window methodology as the
+    headline number."""
+    from rl_scheduler_tpu.agent.presets import PPO_PRESETS
+
+    steps_per_sec, policy_path = _fleet_window(PPO_PRESETS["set_fleet64"])
+    return {
+        "metric": "set_fleet64 env-steps/sec/chip "
+                  "(1024 envs x 64 nodes, fused PPO update)",
+        "value": round(steps_per_sec, 1),
+        "unit": "env-steps/sec/chip",
+        "policy_path": policy_path,
+    }
+
+
+def fleet_overlap_metric() -> dict:
+    """set_fleet64 steady-state with graftpipe on (docs/roofline.md):
+    overlapped collect/learn + fused update prologue, same recipe and
+    fetch-synced window methodology as :func:`fleet_metric` — the
+    driver-tracked line for the pipelined update."""
+    import dataclasses
+
+    from rl_scheduler_tpu.agent.presets import PPO_PRESETS
+
+    cfg = dataclasses.replace(PPO_PRESETS["set_fleet64"],
+                              overlap_collect=True)
+    steps_per_sec, policy_path = _fleet_window(cfg)
+    return {
+        "metric": "set_fleet64_overlap env-steps/sec/chip "
+                  "(1024 envs x 64 nodes, pipelined PPO update)",
+        "value": round(steps_per_sec, 1),
+        "unit": "env-steps/sec/chip",
+        "policy_path": policy_path,
+        "overlap_collect": True,
+        "fused_prologue": cfg.prologue_enabled,
+    }
+
+
+def fleet_scenario_metric(scenario_name: str = "bursty") -> dict:
+    """set_fleet64 steady-state on a SCENARIO env (graftscenario,
+    docs/scenarios.md) — the driver-tracked line proving scenario
+    workloads ride the same fused fleet path at the same speed: identical
+    recipe and window/sync methodology as :func:`fleet_metric`, with the
+    CSV replay swapped for the scenario's compiled tables + per-episode
+    randomization. The classic-layout families (bursty/churn/price_spike)
+    keep the fleet policy path, fused kernel included."""
+    from rl_scheduler_tpu.agent.presets import PPO_PRESETS
+    from rl_scheduler_tpu.scenarios import get_scenario
+
+    steps_per_sec, policy_path = _fleet_window(
+        PPO_PRESETS["set_fleet64"], scenario=get_scenario(scenario_name))
     return {
         "metric": "set_fleet64_scenario env-steps/sec/chip "
                   "(1024 envs x 64 nodes, fused PPO update, scenario env)",
@@ -337,6 +354,101 @@ def scenario_env_step_bench(num_nodes: int = FLEET_NODES,
     }
 
 
+def overlap_train_bench(num_nodes: int = FLEET_NODES,
+                        num_envs: int = 32, rollout_steps: int = 25,
+                        iters: int = 2, repeats: int = 6,
+                        epochs_list: tuple = (1, 4)) -> dict:
+    """graftpipe CPU A/B (the `make overlap-bench` acceptance number):
+    end-to-end update time of the two prongs — pipelined collect
+    (`pipeline`), fused prologue (`prologue`), both (`overlap`) — against
+    the unpipelined `baseline`, at a container-CPU-tractable slice of the
+    set_fleet64 recipe (flax bf16 set policy at N=64, minibatch = B/4 so
+    the epoch shuffle is a real multi-minibatch path, window of ``iters``
+    updates in ONE `lax.scan` dispatch — the program shape where the
+    pipeline's broken dependency is visible to the scheduler). Interleaved
+    best-of-N timing, fetch-synced (the repo's measurement discipline).
+
+    ``epochs_list`` with two points also fits the intercept decomposition
+    per variant: per-update time = sgd_ms_per_epoch * epochs +
+    intercept_ms — the intercept (rollout + GAE + shuffle + fixed work)
+    is the term graftpipe exists to erase, so the A/B reports it
+    directly. Read the CPU result for what it is: XLA:CPU has no
+    latency-hiding scheduler, so the `pipeline` prong's win is a CHIP
+    claim (one-command recipe in docs/roofline.md); the CPU line pins
+    composition and the prologue's op-count delta honestly.
+    """
+    import dataclasses
+
+    import jax
+
+    from rl_scheduler_tpu.agent.ppo import make_ppo_bundle
+    from rl_scheduler_tpu.agent.presets import PPO_PRESETS
+    from rl_scheduler_tpu.agent.train_ppo import make_bundle_and_net
+    from rl_scheduler_tpu.utils.profiling import fetch_sync
+
+    variants = {
+        "baseline": dict(overlap_collect=False, fused_prologue="off"),
+        "pipeline": dict(overlap_collect=True, fused_prologue="off"),
+        "prologue": dict(overlap_collect=False, fused_prologue="on"),
+        "overlap": dict(overlap_collect=True, fused_prologue="auto"),
+    }
+    cells = {}
+    for epochs in epochs_list:
+        cfg0 = dataclasses.replace(
+            PPO_PRESETS["set_fleet64"], num_envs=num_envs,
+            rollout_steps=rollout_steps,
+            minibatch_size=max(1, num_envs * rollout_steps // 4),
+            num_epochs=epochs)
+        for name, overlay in variants.items():
+            cfg = dataclasses.replace(cfg0, **overlay)
+            bundle, net = make_bundle_and_net("cluster_set", cfg,
+                                              num_nodes=num_nodes)
+            init_fn, update_fn, _ = make_ppo_bundle(bundle, cfg, net=net)
+            runner = jax.jit(init_fn)(jax.random.PRNGKey(0))
+            update = jax.jit(
+                lambda r, _u=update_fn: jax.lax.scan(
+                    lambda rr, _: _u(rr), r, None, length=iters),
+                donate_argnums=0)
+            runner, _ = update(runner)      # compile + one warm window
+            fetch_sync(runner.params)
+            cells[(name, epochs)] = [runner, update, float("inf")]
+    for _ in range(repeats):
+        for key, cell in cells.items():
+            runner, update, best = cell
+            t0 = time.perf_counter()
+            runner, _ = update(runner)
+            fetch_sync(runner.params)
+            cell[0] = runner
+            cell[2] = min(best, time.perf_counter() - t0)
+    per_update = {k: cell[2] / iters * 1e3 for k, cell in cells.items()}
+    e_lo, e_hi = min(epochs_list), max(epochs_list)
+    out_variants = {}
+    for name in variants:
+        row = {f"per_update_ms_{e}ep": round(per_update[(name, e)], 1)
+               for e in epochs_list}
+        row["vs_baseline_1ep"] = round(
+            per_update[("baseline", e_lo)] / per_update[(name, e_lo)], 3)
+        if e_hi > e_lo:
+            slope = (per_update[(name, e_hi)] - per_update[(name, e_lo)]) \
+                / (e_hi - e_lo)
+            row["sgd_ms_per_epoch"] = round(slope, 1)
+            row["intercept_ms"] = round(
+                per_update[(name, e_lo)] - slope * e_lo, 1)
+        out_variants[name] = row
+    return {
+        "schema_version": 1,
+        "metric": "overlap_train_bench",
+        "num_nodes": num_nodes,
+        "num_envs": num_envs,
+        "rollout_steps": rollout_steps,
+        "epochs_list": list(epochs_list),
+        "window_iters": iters,
+        "interleaved_repeats": repeats,
+        "variants": out_variants,
+        "backend": jax.devices()[0].platform,
+    }
+
+
 def graftscope_ab(preset: str = "tpu4096") -> dict:
     """Same-process A/B (ISSUE 4 acceptance): the graftscope-instrumented
     train window vs the uninstrumented one, identical fetch-synced window
@@ -395,6 +507,13 @@ def main(argv: list | None = None) -> None:
                         "CSV-replay baseline (the acceptance A/B) plus "
                         "the isolated env-step microbench, both at fleet "
                         "N (CPU-container-tractable; docs/scenarios.md)")
+    p.add_argument("--overlap-bench", action="store_true",
+                   help="print ONE JSON line instead: the graftpipe "
+                        "baseline/pipeline/prologue/overlap update-time "
+                        "A/B with per-variant intercept decomposition, "
+                        "at a CPU-container-tractable slice of the "
+                        "set_fleet64 recipe (docs/roofline.md; "
+                        "`make overlap-bench` runs this BLAS-pinned)")
     args = p.parse_args(argv)
     if args.graftscope_ab:
         print(json.dumps(graftscope_ab(args.ab_preset)), flush=True)
@@ -403,9 +522,13 @@ def main(argv: list | None = None) -> None:
         print(json.dumps(scenario_train_bench()), flush=True)
         print(json.dumps(scenario_env_step_bench()), flush=True)
         return
+    if args.overlap_bench:
+        print(json.dumps(overlap_train_bench()), flush=True)
+        return
     print(json.dumps(headline_metric()), flush=True)
     print(json.dumps(fleet_metric()), flush=True)
     print(json.dumps(fleet_scenario_metric()), flush=True)
+    print(json.dumps(fleet_overlap_metric()), flush=True)
 
 
 if __name__ == "__main__":

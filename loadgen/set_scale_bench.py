@@ -24,6 +24,15 @@ Usage::
         --minibatch 12800 --variants flax_bf16,fused_block
         # the fused-block A/B at the set_fleet64 recipe (run ON TPU:
         # off-chip the kernel interprets and the timing is meaningless)
+    python loadgen/set_scale_bench.py --nodes 64 --envs 1024 \
+        --minibatch 12800 --epochs 1,4 \
+        --variants flax_bf16,pipeline,prologue,overlap
+        # the graftpipe chip decomposition (docs/roofline.md): per-prong
+        # update time AND, via the epochs sweep's slope/intercept fit,
+        # how much of the non-SGD intercept each prong erased. The
+        # update-path variants: overlap (both prongs), pipeline
+        # (1-stale collect only), prologue (fused prologue only),
+        # fused_block_overlap (pipeline composed with the fused kernel)
 
 Prints one JSON line per (nodes, variant): per-update ms, env-steps/s,
 and the window times it derives from.
@@ -51,6 +60,24 @@ def build_update(nodes: int, envs: int, minibatch: int, epochs: int,
     from rl_scheduler_tpu.env import cluster_set as cs
     from rl_scheduler_tpu.env.bundle import cluster_set_bundle
 
+    # graftpipe update-path variants (docs/roofline.md): `overlap` = both
+    # prongs on the flax bf16 policy, `pipeline`/`prologue` pin one prong
+    # each for the per-prong decomposition, `fused_block_overlap`
+    # composes the pipeline with the whole-network fused kernel (the
+    # fleet presets' TPU path). The policy is orthogonal to the update
+    # pipeline, so these reuse the policy variants below; an --epochs
+    # 1,4 sweep then separates each variant's SGD slope from the
+    # intercept graftpipe attacks.
+    graftpipe = {
+        "overlap": ("flax_bf16", dict(overlap_collect=True)),
+        "pipeline": ("flax_bf16",
+                     dict(overlap_collect=True, fused_prologue="off")),
+        "prologue": ("flax_bf16", dict(fused_prologue="on")),
+        "fused_block_overlap": ("fused_block", dict(overlap_collect=True)),
+    }
+    cfg_overlay = {}
+    if variant in graftpipe:
+        variant, cfg_overlay = graftpipe[variant]
     # NOTE: every variant below passes an explicit net, so
     # cfg.compute_dtype is inert (it only shapes the default ActorCritic
     # — agent/ppo.py:191-206); the net's own dtype field carries the
@@ -59,6 +86,7 @@ def build_update(nodes: int, envs: int, minibatch: int, epochs: int,
         num_envs=envs, rollout_steps=rollout_steps,
         minibatch_size=minibatch, num_epochs=epochs, lr=1e-3, gamma=0.99,
         compute_dtype="float32" if variant == "flax_f32" else "bfloat16",
+        **cfg_overlay,
     )
     bundle = cluster_set_bundle(cs.make_params(num_nodes=nodes))
     fused_impls = {"fused": None, "fused_chunked": "chunked",

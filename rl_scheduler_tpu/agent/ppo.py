@@ -17,6 +17,7 @@ per minibatch, epoch-wise reshuffling.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import math
 import warnings
@@ -31,6 +32,10 @@ from rl_scheduler_tpu.env.bundle import EnvBundle, multi_cloud_bundle
 from rl_scheduler_tpu.models import ActorCritic
 from rl_scheduler_tpu.ops import gae as gae_op
 from rl_scheduler_tpu.ops.gae import resolve_impl as resolve_gae_impl
+from rl_scheduler_tpu.ops.indexing import (
+    gather_shuffled_minibatch,
+    shuffle_block_perm,
+)
 from rl_scheduler_tpu.ops.losses import PPOLossConfig, ppo_loss, categorical_log_prob
 
 
@@ -94,6 +99,31 @@ class PPOTrainConfig:
     # batch-pooled sharpened policy). See PPOLossConfig.
     argmax_penalty_coeff: float = 0.0
     argmax_penalty_sharpness: float = 16.0
+    # graftpipe (docs/roofline.md): pipeline collect against learn. The
+    # rollout of iteration k+1 is collected with the PRE-update params of
+    # iteration k (a 1-iteration-stale behavior policy — PPO's off-policy
+    # correction is exact because behavior log-probs are recorded at
+    # collect time), so inside a lax.scan-over-updates program the
+    # rollout of k+1 has NO data dependency on SGD k and XLA's
+    # latency-hiding scheduler can overlap them. Off (the default) leaves
+    # the update byte-identical to the unpipelined build; on, the runner
+    # carries the in-flight stale-params slot (RunnerState.collect_params,
+    # checkpoint-meta-recorded and --resume-guard-pinned).
+    overlap_collect: bool = False
+    # The fused update prologue (second graftpipe prong): collapse the
+    # between-rollout-and-SGD op chain — the epoch-shuffle permutation
+    # (argsort over one draw of random bits, ops/indexing.py
+    # shuffle_block_perm) fused with the per-minibatch gather
+    # (gather_shuffled_minibatch) — into the head of the SGD scan, so the
+    # full shuffled [B, K] batch is never materialized (one HBM write +
+    # read per epoch gone) and GAE at fleet env counts routes through the
+    # one-launch Pallas kernel (ops/pallas_gae.py; interpret-mode
+    # fallback keeps the same path correct on CPU). "auto" follows
+    # overlap_collect; "on"/"off" pin it for per-prong A/Bs
+    # (loadgen/set_scale_bench.py). The permutation VALUES differ from
+    # jax.random.permutation's, so this must stay off for the
+    # byte-identical default path.
+    fused_prologue: str = "auto"     # auto | on | off
     # Epoch-shuffle granularity: permute contiguous blocks of this many
     # samples instead of single rows. Blocks are adjacent envs at one
     # timestep (iid rollouts), so statistics are indistinguishable for
@@ -137,10 +167,21 @@ class PPOTrainConfig:
                 f"argmax_penalty_sharpness={self.argmax_penalty_sharpness}: "
                 "the soft-argmax logit multiplier must be positive"
             )
+        if self.fused_prologue not in ("auto", "on", "off"):
+            raise ValueError(
+                f"fused_prologue={self.fused_prologue!r}: choose "
+                "auto|on|off (auto follows overlap_collect)"
+            )
 
     @property
     def batch_size(self) -> int:
         return self.num_envs * self.rollout_steps
+
+    @property
+    def prologue_enabled(self) -> bool:
+        if self.fused_prologue == "auto":
+            return self.overlap_collect
+        return self.fused_prologue == "on"
 
     @property
     def num_minibatches(self) -> int:
@@ -200,8 +241,37 @@ def effective_shuffle_block(cfg: PPOTrainConfig) -> int:
     return blk
 
 
+# Env count above which the fused prologue routes an "auto" GAE through
+# the one-launch Pallas kernel even when the default device is not TPU
+# (interpret mode keeps it correct on CPU): at fleet env counts the
+# reverse scan's T tiny loop bodies are the term the prologue exists to
+# collapse, and the kernel's 512-lane column blocks are full.
+PROLOGUE_GAE_MIN_ENVS = 512
+
+
+def resolve_prologue_gae_impl(cfg: PPOTrainConfig) -> str:
+    """GAE impl for the fused-prologue path: an explicit ``cfg.gae_impl``
+    is respected; ``"auto"`` routes fleet shapes (``num_envs >=
+    PROLOGUE_GAE_MIN_ENVS``) through ``ops/pallas_gae.py`` — on CPU via
+    its interpret fallback — and keeps the scan elsewhere (small column
+    counts underfill the kernel's blocks)."""
+    if cfg.gae_impl != "auto":
+        return resolve_gae_impl(cfg.gae_impl)
+    if cfg.num_envs >= PROLOGUE_GAE_MIN_ENVS:
+        return "pallas"
+    return resolve_gae_impl("auto")
+
+
 class RunnerState(NamedTuple):
-    """Everything carried across training iterations (a single pytree)."""
+    """Everything carried across training iterations (a single pytree).
+
+    ``collect_params`` is graftpipe's in-flight stale-params slot
+    (``PPOTrainConfig.overlap_collect``): the params the NEXT rollout will
+    sample with — one iteration staler than ``params`` once the pipeline
+    is warm. ``None`` when overlap is off, which is an EMPTY pytree node:
+    the runner's leaves (and therefore checkpoints, donation, and the
+    sharded-path specs) are unchanged from the pre-graftpipe layout.
+    """
 
     params: Any
     opt_state: Any
@@ -210,6 +280,7 @@ class RunnerState(NamedTuple):
     key: jnp.ndarray
     ep_return: jnp.ndarray    # [N] running episode return accumulator
     update_idx: jnp.ndarray   # scalar int32
+    collect_params: Any = None  # graftpipe 1-iteration-stale behavior slot
 
 
 def make_optimizer(cfg: PPOTrainConfig) -> optax.GradientTransformation:
@@ -302,6 +373,12 @@ def make_ppo_bundle(
         params = net.init(pkey, dummy)
         opt_state = tx.init(params)
         env_state, obs = bundle.reset_batch(ekey, cfg.num_envs)
+        collect_params = None
+        if cfg.overlap_collect:
+            # Pipeline warm-up: iteration 0 collects on-policy (slot ==
+            # params); staleness starts at iteration 1. Copied leaves so
+            # the donated runner never hands XLA the same buffer twice.
+            collect_params = jax.tree.map(jnp.copy, params)
         return RunnerState(
             params=params,
             opt_state=opt_state,
@@ -310,16 +387,17 @@ def make_ppo_bundle(
             key=rkey,
             ep_return=jnp.zeros(cfg.num_envs, jnp.float32),
             update_idx=jnp.zeros((), jnp.int32),
+            collect_params=collect_params,
         )
 
-    def rollout(runner: RunnerState):
-        """Collect [T, N] transitions with the current policy via lax.scan."""
+    def rollout(runner: RunnerState, behavior_params):
+        """Collect [T, N] transitions with the behavior policy via lax.scan."""
         temp = sample_temperature(cfg, runner.update_idx)
 
         def env_step(carry, _):
             env_state, obs, key, ep_ret = carry
             key, akey = jax.random.split(key)
-            logits, value = net.apply(runner.params, obs)
+            logits, value = net.apply(behavior_params, obs)
             if temp is not None:
                 # Tempered BEHAVIOR policy: sampling and the stored
                 # log-probs use the same softmax(logits / tau) the loss
@@ -349,10 +427,10 @@ def make_ppo_bundle(
             None,
             length=cfg.rollout_steps,
         )
-        _, last_value = net.apply(runner.params, obs)
+        _, last_value = net.apply(behavior_params, obs)
         return env_state, obs, key, ep_ret, traj, last_value
 
-    def rollout_open_loop(runner: RunnerState):
+    def rollout_open_loop(runner: RunnerState, behavior_params):
         """Whole-horizon rollout without a scan (open-loop envs only).
 
         Obs for all T+1 steps come from one ``horizon_fn`` call; the policy
@@ -367,7 +445,7 @@ def make_ppo_bundle(
         )
         n = obs_all.shape[1]
         logits, values = net.apply(
-            runner.params, obs_all.reshape((t + 1) * n, *obs_shape)
+            behavior_params, obs_all.reshape((t + 1) * n, *obs_shape)
         )
         logits = logits.reshape(t + 1, n, -1)
         values = values.reshape(t + 1, n)
@@ -423,13 +501,32 @@ def make_ppo_bundle(
     def update_fn(runner: RunnerState):
         # named_scope: zero-cost trace annotations that let
         # tools/traceview attribute profiler events to training phases.
-        with jax.named_scope("rollout"):
-            env_state, obs, key, ep_ret, traj, last_value = collect(runner)
+        # graftpipe: the pipelined rollout samples with the 1-iteration-
+        # stale collect_params slot instead of the post-SGD params, so
+        # inside a scan-over-updates program iteration k+1's rollout has
+        # no data dependency on SGD k (its own scope name keeps traceview
+        # attribution honest about which path ran).
+        if cfg.overlap_collect:
+            with jax.named_scope("overlap_collect"):
+                env_state, obs, key, ep_ret, traj, last_value = collect(
+                    runner, runner.collect_params)
+        else:
+            with jax.named_scope("rollout"):
+                env_state, obs, key, ep_ret, traj, last_value = collect(
+                    runner, runner.params)
 
-        with jax.named_scope("gae"):
+        # The fused prologue owns the whole between-rollout-and-SGD chain
+        # under one trace phase ("prologue": GAE + pack here, permutation
+        # + minibatch gather in the scan head below); the classic path
+        # keeps its historical scopes (gae around GAE only) so baseline
+        # trace attribution is unchanged.
+        gae_scope = "prologue" if cfg.prologue_enabled else "gae"
+        with jax.named_scope(gae_scope):
             advantages, targets = gae_op(
                 traj["reward"], traj["value"], traj["done"], last_value,
-                cfg.gamma, cfg.gae_lambda, impl=cfg.gae_impl,
+                cfg.gamma, cfg.gae_lambda,
+                impl=(resolve_prologue_gae_impl(cfg)
+                      if cfg.prologue_enabled else cfg.gae_impl),
             )
 
         # Pack every per-sample field into ONE [B, K] f32 matrix. The epoch
@@ -440,17 +537,19 @@ def make_ppo_bundle(
         # tile-efficient. The action column round-trips through f32
         # exactly (action indices are tiny integers).
         flat_obs_dim = math.prod(obs_shape)
-        packed = jnp.concatenate(
-            [
-                traj["obs"].reshape(-1, flat_obs_dim).astype(jnp.float32),
-                traj["action"].reshape(-1, 1).astype(jnp.float32),
-                traj["log_prob"].reshape(-1, 1),
-                traj["value"].reshape(-1, 1),
-                advantages.reshape(-1, 1),
-                targets.reshape(-1, 1),
-            ],
-            axis=1,
-        )
+        with (jax.named_scope("prologue") if cfg.prologue_enabled
+              else contextlib.nullcontext()):
+            packed = jnp.concatenate(
+                [
+                    traj["obs"].reshape(-1, flat_obs_dim).astype(jnp.float32),
+                    traj["action"].reshape(-1, 1).astype(jnp.float32),
+                    traj["log_prob"].reshape(-1, 1),
+                    traj["value"].reshape(-1, 1),
+                    advantages.reshape(-1, 1),
+                    targets.reshape(-1, 1),
+                ],
+                axis=1,
+            )
 
         def unpack(rows):
             return {
@@ -512,6 +611,7 @@ def make_ppo_bundle(
         num_blocks = cfg.batch_size // blk
         k_cols = packed.shape[1]
         packed_blocks = packed.reshape(num_blocks, blk * k_cols)
+        blocks_per_mb = mb_size // blk
 
         def sgd_epoch(carry, epoch_key):
             params, opt_state = carry
@@ -525,6 +625,35 @@ def make_ppo_bundle(
                 unroll=cfg.sgd_unroll,
             )
             return (params, opt_state), metrics
+
+        def sgd_epoch_fused(carry, epoch_key):
+            # Fused-prologue epoch: the permutation is one argsort over
+            # random bits, and each minibatch gathers its own rows from
+            # the UNSHUFFLED packed batch inside the scan head — the full
+            # shuffled [B, K] copy (an HBM write + read per epoch) never
+            # materializes. Same minibatch content for the same perm
+            # (ops/indexing.py, equivalence-tested); the perm VALUES
+            # differ from jax.random.permutation's, hence prologue != the
+            # byte-identical default path.
+            params, opt_state = carry
+            with jax.named_scope("prologue"):
+                perm = shuffle_block_perm(epoch_key, num_blocks)
+
+            def sgd_minibatch_fused(carry2, mb_index):
+                with jax.named_scope("prologue"):
+                    rows = gather_shuffled_minibatch(
+                        packed_blocks, perm, mb_index, blocks_per_mb
+                    ).reshape(mb_size, k_cols)
+                return sgd_minibatch(carry2, rows)
+
+            (params, opt_state), metrics = jax.lax.scan(
+                sgd_minibatch_fused, (params, opt_state),
+                jnp.arange(cfg.num_minibatches), unroll=cfg.sgd_unroll,
+            )
+            return (params, opt_state), metrics
+
+        if cfg.prologue_enabled:
+            sgd_epoch = sgd_epoch_fused
 
         key, shuffle_key = jax.random.split(key)
         with jax.named_scope("sgd"):
@@ -579,9 +708,20 @@ def make_ppo_bundle(
             key=key,
             ep_return=ep_ret,
             update_idx=runner.update_idx + 1,
+            # Pipeline advance: the NEXT rollout samples with THIS
+            # iteration's pre-SGD params — available before the SGD above
+            # completes, which is exactly the broken dependency that lets
+            # XLA overlap rollout k+1 with SGD k in a fused dispatch.
+            collect_params=(runner.params if cfg.overlap_collect else None),
         )
         return new_runner, metrics
 
+    # Test seams (tests/test_graftpipe.py): the raw collect closure —
+    # deterministic in (runner, behavior_params) — lets the ratio pin
+    # recompute the recorded behavior log-probs outside the jitted update.
+    update_fn.collect = collect
+    update_fn.overlap_collect = cfg.overlap_collect
+    update_fn.prologue_enabled = cfg.prologue_enabled
     return init_fn, update_fn, net
 
 
@@ -723,6 +863,13 @@ def ppo_train(
                 make_tensor_parallel_ppo,
             )
 
+            if cfg.overlap_collect or cfg.prologue_enabled:
+                raise ValueError(
+                    "overlap_collect/fused_prologue instrument the shared "
+                    "PPO update (make_ppo_bundle); the tensor-parallel "
+                    "trainer builds its own — drop the tp axis or the "
+                    "graftpipe knobs"
+                )
             if net is not None:
                 raise ValueError(
                     "the tensor-parallel path builds its own TPActorCritic "
@@ -795,12 +942,38 @@ def ppo_train(
                 ep_return=loop_state["ep_return"],
                 update_idx=loop_state["update_idx"],
             )
+            if "collect_params" in loop_state and cfg.overlap_collect:
+                # graftpipe pipelined runner: the in-flight stale-params
+                # slot rides the full-state checkpoint so a resumed
+                # overlap run replays the uninterrupted stream bitwise
+                # (the CLI's resume guard pins the overlap flag to the
+                # recorded one; an API caller restoring an overlap tree
+                # with overlap OFF falls through and the slot is simply
+                # dropped — installing it would hand the unpipelined
+                # update a carry whose structure it cannot return).
+                runner = runner._replace(
+                    collect_params=loop_state["collect_params"])
+            elif cfg.overlap_collect:
+                # Full-state tree without a slot (API caller resuming a
+                # pre-graftpipe checkpoint with overlap newly on): warm
+                # restart — collect with the restored params, exactly
+                # like iteration 0 of a fresh pipelined run.
+                runner = runner._replace(
+                    collect_params=jax.tree.map(jnp.copy, tree["params"]))
         else:
             runner = runner._replace(
                 params=tree["params"],
                 opt_state=tree["opt_state"],
                 update_idx=jnp.asarray(start_iteration, jnp.int32),
             )
+            if cfg.overlap_collect:
+                # Learning-state-only resume (sharded paths, changed env
+                # shape): the pipeline restarts warm from the RESTORED
+                # params — leaving the fresh init's random weights in the
+                # slot would collect one rollout with an untrained
+                # policy.
+                runner = runner._replace(
+                    collect_params=jax.tree.map(jnp.copy, tree["params"]))
     from rl_scheduler_tpu.agent.loop import make_update, run_train_loop
 
     update = make_update(update_fn, debug_checks, updates_per_dispatch)

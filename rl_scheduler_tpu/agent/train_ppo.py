@@ -308,6 +308,24 @@ def main(argv: list[str] | None = None) -> Path:
                         "static node premium, which per-state entropy "
                         "cannot see). 0 disables; recorded in checkpoint "
                         "meta and pinned by --resume")
+    p.add_argument("--overlap-collect", action="store_true",
+                   help="graftpipe (docs/roofline.md): pipeline collect "
+                        "against learn — iteration k+1's rollout is "
+                        "collected with the PRE-update params of "
+                        "iteration k (a 1-iteration-stale behavior "
+                        "policy; exact PPO off-policy correction holds "
+                        "because behavior log-probs are recorded at "
+                        "collect time), so inside a fused "
+                        "--updates-per-dispatch program the rollout of "
+                        "k+1 has no data dependency on SGD k and XLA "
+                        "can overlap them. Also fuses the update "
+                        "prologue (GAE routed through the Pallas kernel "
+                        "at fleet shapes, epoch shuffle fused with the "
+                        "minibatch gather). Off: byte-identical to the "
+                        "unpipelined update. Recorded in checkpoint "
+                        "meta and pinned by --resume; composes with "
+                        "--dp/--sp and --sample-temp-anneal (the "
+                        "collecting iteration's tau); refused with --tp")
     p.add_argument("--run-name", default=None)
     p.add_argument("--run-root", default=RuntimeConfig().checkpoint_dir)
     p.add_argument("--checkpoint-every", type=int, default=None,
@@ -604,6 +622,17 @@ def main(argv: list[str] | None = None) -> Path:
                 f"--argmax-penalty {args.argmax_penalty}: the "
                 "concentration penalty is a loss weight >= 0 (0 disables)")
         cfg = dataclasses.replace(cfg, argmax_penalty_coeff=args.argmax_penalty)
+    if args.overlap_collect:
+        if args.tp > 1:
+            # Same boundary as the anti-latch flags: the tensor-parallel
+            # trainer builds its own update, so a silently-unpipelined
+            # run would misattribute its throughput to graftpipe.
+            raise SystemExit(
+                "--overlap-collect pipelines the shared PPO update "
+                "(make_ppo_bundle); the tensor-parallel trainer builds "
+                "its own update — drop --tp (the fleet structured "
+                "recipes graftpipe targets never shard over tp)")
+        cfg = dataclasses.replace(cfg, overlap_collect=True)
     if args.legacy_reward_sign and args.env != "multi_cloud":
         raise SystemExit(
             "--legacy-reward-sign reproduces the multi-cloud reference "
@@ -1100,6 +1129,19 @@ def main(argv: list[str] | None = None) -> Path:
                     f"({'pass' if recorded != off else 'drop'} {flag}"
                     f"{' ' + str(recorded) if recorded != off else ''})"
                 )
+        # graftpipe: the overlap flag changes behavior-policy staleness
+        # (and the full-state tree's shape), so a resumed run must keep
+        # the recorded setting. Checkpoints from before the flag existed
+        # recorded nothing -> the off default.
+        recorded_overlap = bool(meta.get("overlap_collect"))
+        if recorded_overlap != cfg.overlap_collect:
+            raise SystemExit(
+                f"{resume_flag}: run was trained with "
+                f"{'--overlap-collect' if recorded_overlap else 'the unpipelined update'}; "
+                f"{'pass' if recorded_overlap else 'drop'} --overlap-collect "
+                "to keep the recorded pipeline semantics (the behavior "
+                "policy's staleness must not switch silently mid-run)"
+            )
         ckpt_tp = meta.get("tp") or 1
         if ckpt_tp != args.tp:
             # The PARAM tree differs (TPActorCritic col/row pairs vs
@@ -1149,6 +1191,12 @@ def main(argv: list[str] | None = None) -> Path:
                     "ep_return": abstract.ep_return,
                     "update_idx": abstract.update_idx,
                 }
+                if cfg.overlap_collect:
+                    # graftpipe pipelined runner (the guard above pinned
+                    # the flag to the checkpoint's record, so the slot is
+                    # present exactly when configured).
+                    target["loop"]["collect_params"] = \
+                        abstract.collect_params
             tree, _ = resume_mgr.restore(latest, target=target)
             if ckpt_full and not ckpt_env_shape_ok:
                 # Orbax needs the 'loop' item in the target at all (the
@@ -1268,7 +1316,14 @@ def main(argv: list[str] | None = None) -> Path:
                 # verdict unattributable (docs/studies.md).
                 "sample_temp_end": cfg.sample_temp_end,
                 "sample_temp_iters": cfg.sample_temp_iters,
-                "argmax_penalty": cfg.argmax_penalty_coeff}
+                "argmax_penalty": cfg.argmax_penalty_coeff,
+                # graftpipe: the pipelined update's behavior policy is
+                # one iteration stale, so the flag is part of the
+                # training semantics (resume guard pins it) AND shapes
+                # the full-state tree (the in-flight collect_params
+                # slot below). Legacy checkpoints (no key) restore as
+                # overlap-off.
+                "overlap_collect": cfg.overlap_collect}
     if scenario is not None:
         # Scenario provenance: evaluation rebuilds the same workload from
         # this record, the resume guard refuses a mismatch, and serving
@@ -1288,6 +1343,12 @@ def main(argv: list[str] | None = None) -> Path:
                             "key": runner.key,
                             "ep_return": runner.ep_return,
                             "update_idx": runner.update_idx}
+            if cfg.overlap_collect:
+                # The pipelined runner's in-flight stale-params slot:
+                # without it a resumed overlap run would restart the
+                # pipeline warm (collect == params) and diverge from
+                # the uninterrupted stream.
+                tree["loop"]["collect_params"] = runner.collect_params
         return tree
 
     def make_checkpoint_fn(attempt_seed: int):

@@ -27,3 +27,43 @@ def select_along_last(values: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
     """
     one_hot = jax.nn.one_hot(indices, values.shape[-1], dtype=jnp.bool_)
     return jnp.sum(jnp.where(one_hot, values, 0), axis=-1)
+
+
+def shuffle_block_perm(key: jnp.ndarray, num_blocks: int) -> jnp.ndarray:
+    """Epoch-shuffle permutation as ONE argsort over random bits.
+
+    ``jax.random.permutation`` runs multiple bit-draw + sort rounds to make
+    the permutation exactly uniform under key collisions; for minibatch
+    shuffling that exactness buys nothing, so the graftpipe fused prologue
+    (``agent/ppo.py``) draws one uint32 word per block and argsorts it —
+    one fused sort, no extra rounds. Ties (~``num_blocks^2 / 2^33``
+    probability — <2% even at the set_fleet64 block count of 12800)
+    resolve by the stable sort's index order: statistically immaterial for
+    minibatch mixing, and deterministic per key either way.
+    """
+    bits = jax.random.bits(key, (num_blocks,), jnp.uint32)
+    return jnp.argsort(bits)
+
+
+def gather_shuffled_minibatch(
+    packed_blocks: jnp.ndarray,   # [num_blocks, blk * K] packed sample rows
+    perm: jnp.ndarray,            # [num_blocks] epoch permutation
+    minibatch_index: jnp.ndarray, # scalar int (traced: the SGD scan index)
+    blocks_per_minibatch: int,
+) -> jnp.ndarray:
+    """The fused shuffle-gather: minibatch ``i`` of a shuffled epoch,
+    gathered straight from the UNSHUFFLED packed batch.
+
+    The classic formulation materializes the whole shuffled batch
+    (``packed_blocks[perm]`` — a full [B, K] HBM write + read per epoch)
+    and then slices minibatches out of the copy. Here each minibatch
+    dynamic-slices its own ``blocks_per_minibatch`` window of ``perm`` and
+    gathers exactly those rows — same minibatch content for the same
+    ``perm`` (equivalence-tested), with the full-batch shuffled
+    materialization gone. Returns ``[blocks_per_minibatch, blk * K]``;
+    the caller reshapes rows to samples.
+    """
+    idx = jax.lax.dynamic_slice_in_dim(
+        perm, minibatch_index * blocks_per_minibatch, blocks_per_minibatch
+    )
+    return jnp.take(packed_blocks, idx, axis=0)

@@ -36,7 +36,13 @@ from rl_scheduler_tpu.parallel.mesh import make_mesh
 
 
 def _runner_specs(axis: str) -> RunnerState:
-    """PartitionSpec pytree-prefix for RunnerState."""
+    """PartitionSpec pytree-prefix for RunnerState.
+
+    ``collect_params`` (graftpipe's stale behavior-params slot,
+    ``PPOTrainConfig.overlap_collect``) replicates like ``params``; with
+    overlap off the slot is ``None`` — an empty pytree node the replicated
+    spec matches vacuously, so the unpipelined layout is untouched.
+    """
     return RunnerState(
         params=P(),
         opt_state=P(),
@@ -45,6 +51,7 @@ def _runner_specs(axis: str) -> RunnerState:
         key=P(axis),
         ep_return=P(axis),
         update_idx=P(),
+        collect_params=P(),
     )
 
 
@@ -83,6 +90,35 @@ def make_data_parallel_ppo_bundle(
     local_cfg = dataclasses.replace(
         cfg, num_envs=cfg.num_envs // ndev, minibatch_size=local_mb
     )
+    local_init, local_update, specs, net = make_local_ppo(
+        bundle, local_cfg, axis, net=net, sp_axis=sp_axis
+    )
+    sharded_init = jax.shard_map(
+        local_init, mesh=mesh, in_specs=P(), out_specs=specs, check_vma=False
+    )
+    sharded_update = jax.shard_map(
+        local_update,
+        mesh=mesh,
+        in_specs=(specs,),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )
+    return sharded_init, sharded_update, net
+
+
+def make_local_ppo(
+    bundle: EnvBundle,
+    local_cfg: PPOTrainConfig,
+    axis: str = "dp",
+    net=None,
+    sp_axis: str | None = None,
+):
+    """The per-member ``(local_init, local_update, specs, net)`` that
+    :func:`make_data_parallel_ppo_bundle` wraps in ``jax.shard_map`` —
+    exposed so version-compat tests can wrap the SAME functions through
+    ``parallel/mesh.shard_map_compat`` on older-JAX containers instead of
+    re-deriving them (``local_cfg`` is already the per-member config).
+    """
     # Gradient/metric sync spans every parallel axis: dp shards the batch,
     # sp (when present) shards the policy's node compute — pmean over both
     # is the exact global gradient (derivation at make_seq_parallel_ppo).
@@ -96,8 +132,19 @@ def make_data_parallel_ppo_bundle(
         # Fold by the dp coordinate only: each dp shard gets distinct env
         # resets/rollout RNG, while sp members (which must step identical
         # replicated envs) share the stream.
-        key = jax.random.fold_in(key, jax.lax.axis_index(axis))
-        r = init_fn(key)
+        dp_key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+        r = init_fn(dp_key)
+        # The replicated leaves (params, optimizer state, graftpipe's
+        # collect_params slot) must be IDENTICAL on every member, so they
+        # come from the UNFOLDED key: the folded init above seeds each
+        # member with different weights, which pmean'd-gradient training
+        # never re-syncs — every member would train its own divergent
+        # replica while the layout claims replication (the tp path's
+        # sync_replicated broadcast exists for exactly this; XLA dead-
+        # code-eliminates the unused halves of the two init calls).
+        shared = init_fn(key)
+        r = r._replace(params=shared.params, opt_state=shared.opt_state,
+                       collect_params=shared.collect_params)
         return r._replace(key=r.key[None])  # leading device axis
 
     def local_update(runner: RunnerState):
@@ -105,17 +152,7 @@ def make_data_parallel_ppo_bundle(
         r, metrics = update_fn(r)
         return r._replace(key=r.key[None]), metrics
 
-    sharded_init = jax.shard_map(
-        local_init, mesh=mesh, in_specs=P(), out_specs=specs, check_vma=False
-    )
-    sharded_update = jax.shard_map(
-        local_update,
-        mesh=mesh,
-        in_specs=(specs,),
-        out_specs=(specs, P()),
-        check_vma=False,
-    )
-    return sharded_init, sharded_update, net
+    return local_init, local_update, specs, net
 
 
 def make_data_parallel_ppo(

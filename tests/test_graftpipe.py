@@ -1,0 +1,589 @@
+"""graftpipe: pipelined collect/learn + fused update prologue (agent/ppo.py).
+
+The contract under test (ISSUE 10 / docs/roofline.md):
+
+- ``overlap_collect`` OFF is byte-identical to the unpipelined update —
+  same RNG draw order and values, same runner pytree leaves (the
+  ``collect_params`` slot is ``None``, an empty node).
+- ON, iteration k's rollout samples with the 1-iteration-stale
+  ``collect_params`` slot, the recorded behavior log-probs come from that
+  stale policy, and the loss's ratio is computed against them — exact PPO
+  on the recorded behavior policy (the ratio/approx_kl pin below).
+- The fused prologue's argsort-permutation + per-minibatch gather
+  produces the same minibatch content as the materialized shuffle for the
+  same permutation, and GAE at fleet env counts routes through the Pallas
+  kernel with the CPU interpret fallback agreeing with the scan.
+- Both compose with dp and dp x sp (trajectory equivalence + replicated
+  param sync, sharded via the version-compat helper so the numerics run
+  on the container's JAX too), ride the full-state checkpoint, and are
+  resume-guard-pinned through the real CLI.
+"""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_scheduler_tpu.agent.ppo import (
+    PPOTrainConfig,
+    RunnerState,
+    make_ppo_bundle,
+    ppo_train,
+    resolve_prologue_gae_impl,
+)
+from rl_scheduler_tpu.env.bundle import multi_cloud_bundle
+from rl_scheduler_tpu.ops.indexing import (
+    gather_shuffled_minibatch,
+    shuffle_block_perm,
+)
+from rl_scheduler_tpu.ops.losses import categorical_log_prob
+
+SMALL = PPOTrainConfig(
+    num_envs=4, rollout_steps=8, minibatch_size=16, num_epochs=2,
+    hidden=(16, 16), rollout_impl="scan",
+)
+
+
+def _leaves_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+def _snapshot(tree):
+    """Host copy that survives buffer donation: on the CPU backend
+    ``device_get`` can be zero-copy, so a donated update would mutate the
+    fetched arrays in place under the comparison."""
+    return jax.tree.map(lambda x: np.array(x, copy=True),
+                        jax.device_get(tree))
+
+
+def _run(bundle, cfg, n, seed=0):
+    init_fn, update_fn, net = make_ppo_bundle(bundle, cfg)
+    update = jax.jit(update_fn, donate_argnums=0)
+    runner = jax.jit(init_fn)(jax.random.PRNGKey(seed))
+    history = []
+    for _ in range(n):
+        runner, metrics = update(runner)
+        history.append(jax.device_get(metrics))
+    return runner, history, net
+
+
+# ------------------------------------------------- byte-identity pins
+
+
+def test_off_leaves_runner_layout_and_update_byte_identical():
+    """overlap off: the collect slot is an EMPTY pytree node (leaf count
+    unchanged from the pre-graftpipe layout — old checkpoints and the
+    sharded specs see the same tree), and the default config IS the off
+    config."""
+    bundle = multi_cloud_bundle()
+    assert not SMALL.overlap_collect and not SMALL.prologue_enabled
+    init_fn, _, _ = make_ppo_bundle(bundle, SMALL)
+    runner = jax.jit(init_fn)(jax.random.PRNGKey(0))
+    assert runner.collect_params is None
+    # None is an empty node: flattening must see exactly the historical
+    # leaves, nothing for the slot.
+    without = RunnerState(*runner[:7])
+    assert len(jax.tree.leaves(runner)) == len(jax.tree.leaves(without))
+
+
+@pytest.mark.parametrize("rollout_impl", ["scan", "open_loop"])
+def test_first_update_bitwise_matches_off_then_diverges(rollout_impl):
+    """Pipeline warm-up: iteration 0 collects with collect_params ==
+    params (on-policy), so ONE update is bitwise identical to the
+    unpipelined path — same RNG draw order and values. From iteration 1
+    the behavior policy is one update stale and params diverge."""
+    bundle = multi_cloud_bundle()
+    base = dataclasses.replace(SMALL, rollout_impl=rollout_impl)
+    on = dataclasses.replace(base, overlap_collect=True,
+                             fused_prologue="off")
+    r_off1, _, _ = _run(bundle, base, 1)
+    r_on1, _, _ = _run(bundle, on, 1)
+    assert _leaves_equal(r_off1.params, r_on1.params)
+    assert _leaves_equal(r_off1.opt_state, r_on1.opt_state)
+    assert _leaves_equal(r_off1.key, r_on1.key)
+
+    r_off2, _, _ = _run(bundle, base, 2)
+    r_on2, _, _ = _run(bundle, on, 2)
+    assert not _leaves_equal(r_off2.params, r_on2.params), (
+        "two pipelined updates matched the on-policy path bitwise — the "
+        "rollout is not using the stale slot"
+    )
+
+
+def test_collect_slot_carries_entry_params():
+    """The pipeline advance: after update k the slot holds update k's
+    ENTRY params — available before SGD k completes, which is the broken
+    dependency the overlap exists for."""
+    bundle = multi_cloud_bundle()
+    cfg = dataclasses.replace(SMALL, overlap_collect=True)
+    init_fn, update_fn, _ = make_ppo_bundle(bundle, cfg)
+    update = jax.jit(update_fn, donate_argnums=0)
+    runner = jax.jit(init_fn)(jax.random.PRNGKey(3))
+    p0 = _snapshot(runner.params)
+    assert _leaves_equal(runner.params, runner.collect_params)  # warm-up
+    runner1, _ = update(runner)
+    assert _leaves_equal(runner1.collect_params, p0)
+    p1 = _snapshot(runner1.params)
+    runner2, _ = update(runner1)
+    assert _leaves_equal(runner2.collect_params, p1)
+
+
+# --------------------------------------- exact-PPO-on-behavior pins
+
+
+def test_behavior_logprobs_recorded_from_stale_params():
+    """The recorded log-probs ARE the stale policy's: recomputing them
+    under collect_params reproduces the trajectory's log_prob field, and
+    recomputing under the fresh params does NOT (the staleness is real)."""
+    bundle = multi_cloud_bundle()
+    cfg = dataclasses.replace(SMALL, overlap_collect=True,
+                              fused_prologue="off")
+    init_fn, update_fn, net = make_ppo_bundle(bundle, cfg)
+    update = jax.jit(update_fn, donate_argnums=0)
+    runner1, _ = update(jax.jit(init_fn)(jax.random.PRNGKey(1)))
+    # The collect seam is deterministic in (runner, behavior_params):
+    # this re-runs exactly the rollout update 2 will consume.
+    _, _, _, _, traj, _ = update_fn.collect(runner1, runner1.collect_params)
+    obs = traj["obs"].reshape(-1, *bundle.obs_shape)
+    act = traj["action"].reshape(-1)
+    stale_logits, _ = net.apply(runner1.collect_params, obs)
+    fresh_logits, _ = net.apply(runner1.params, obs)
+    stale_lp = categorical_log_prob(stale_logits, act)
+    fresh_lp = categorical_log_prob(fresh_logits, act)
+    np.testing.assert_allclose(np.asarray(traj["log_prob"]).reshape(-1),
+                               np.asarray(stale_lp), rtol=1e-5, atol=1e-6)
+    assert not np.allclose(np.asarray(traj["log_prob"]).reshape(-1),
+                           np.asarray(fresh_lp), rtol=1e-5, atol=1e-6)
+
+
+def test_ratio_is_exact_ppo_on_recorded_behavior():
+    """The acceptance ratio pin: with one epoch and one whole-batch
+    minibatch, the update's approx_kl equals mean(recorded behavior
+    log-prob - fresh-params log-prob) computed independently — i.e. the
+    loss's ratio is exp(log pi_current - log pi_behavior) on the RECORDED
+    behavior policy, nothing resampled or recomputed."""
+    bundle = multi_cloud_bundle()
+    cfg = dataclasses.replace(
+        SMALL, overlap_collect=True, fused_prologue="off",
+        num_epochs=1, minibatch_size=SMALL.num_envs * SMALL.rollout_steps,
+    )
+    init_fn, update_fn, net = make_ppo_bundle(bundle, cfg)
+    update = jax.jit(update_fn, donate_argnums=0)
+    runner1, _ = update(jax.jit(init_fn)(jax.random.PRNGKey(5)))
+    _, _, _, _, traj, _ = update_fn.collect(runner1, runner1.collect_params)
+    obs = traj["obs"].reshape(-1, *bundle.obs_shape)
+    act = traj["action"].reshape(-1)
+    fresh_logits, _ = net.apply(runner1.params, obs)
+    expected_kl = float(jnp.mean(
+        traj["log_prob"].reshape(-1)
+        - categorical_log_prob(fresh_logits, act)))
+    _, metrics = update(runner1)
+    assert float(metrics["approx_kl"]) == pytest.approx(expected_kl,
+                                                        rel=1e-4, abs=1e-6)
+
+
+def test_overlap_composes_with_sample_temp_anneal():
+    """tau comes from the collecting iteration's index and is applied to
+    the STALE params consistently (sampling, stored log-probs, loss) —
+    the first update stays bitwise identical to the unpipelined tempered
+    path, and the stale recompute must use the same tau."""
+    bundle = multi_cloud_bundle()
+    tempered = dataclasses.replace(SMALL, sample_temp_end=0.5,
+                                   sample_temp_iters=4)
+    on = dataclasses.replace(tempered, overlap_collect=True,
+                             fused_prologue="off")
+    r_off1, _, _ = _run(bundle, tempered, 1, seed=9)
+    r_on1, _, _ = _run(bundle, on, 1, seed=9)
+    assert _leaves_equal(r_off1.params, r_on1.params)
+
+    from rl_scheduler_tpu.agent.ppo import sample_temperature
+
+    init_fn, update_fn, net = make_ppo_bundle(bundle, on)
+    runner1, _ = jax.jit(update_fn, donate_argnums=0)(
+        jax.jit(init_fn)(jax.random.PRNGKey(9)))
+    _, _, _, _, traj, _ = update_fn.collect(runner1, runner1.collect_params)
+    obs = traj["obs"].reshape(-1, *bundle.obs_shape)
+    act = traj["action"].reshape(-1)
+    tau = sample_temperature(on, runner1.update_idx)
+    logits, _ = net.apply(runner1.collect_params, obs)
+    np.testing.assert_allclose(
+        np.asarray(traj["log_prob"]).reshape(-1),
+        np.asarray(categorical_log_prob(logits / tau, act)),
+        rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------- fused prologue
+
+
+def test_shuffle_block_perm_is_a_deterministic_permutation():
+    key = jax.random.PRNGKey(0)
+    perm = shuffle_block_perm(key, 257)
+    assert np.array_equal(np.sort(np.asarray(perm)), np.arange(257))
+    assert np.array_equal(np.asarray(shuffle_block_perm(key, 257)),
+                          np.asarray(perm))
+    assert not np.array_equal(
+        np.asarray(shuffle_block_perm(jax.random.PRNGKey(1), 257)),
+        np.asarray(perm))
+
+
+def test_gather_shuffled_minibatch_matches_materialized_shuffle():
+    """The fused shuffle-gather equivalence: for the same permutation,
+    per-minibatch gathers from the unshuffled batch reproduce the
+    materialized ``packed[perm]`` minibatches exactly."""
+    num_blocks, row_width, mb_blocks = 24, 6, 4
+    packed_blocks = jnp.arange(num_blocks * row_width, dtype=jnp.float32)
+    packed_blocks = packed_blocks.reshape(num_blocks, row_width)
+    perm = shuffle_block_perm(jax.random.PRNGKey(7), num_blocks)
+    materialized = np.asarray(packed_blocks)[np.asarray(perm)]
+    for i in range(num_blocks // mb_blocks):
+        fused = gather_shuffled_minibatch(packed_blocks, perm,
+                                          jnp.int32(i), mb_blocks)
+        np.testing.assert_array_equal(
+            np.asarray(fused), materialized[i * mb_blocks:(i + 1) * mb_blocks])
+
+
+def test_prologue_update_matches_unfused_on_single_minibatch():
+    """With one whole-batch minibatch the permutation only reorders rows
+    inside the same normalization/reduction set, so the fused prologue
+    must reproduce the unfused update up to summation order."""
+    bundle = multi_cloud_bundle()
+    base = dataclasses.replace(
+        SMALL, num_epochs=1,
+        minibatch_size=SMALL.num_envs * SMALL.rollout_steps)
+    fused = dataclasses.replace(base, fused_prologue="on")
+    r_a, h_a, _ = _run(bundle, base, 2, seed=11)
+    r_b, h_b, _ = _run(bundle, fused, 2, seed=11)
+    for a, b in zip(jax.tree.leaves(r_a.params), jax.tree.leaves(r_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+    assert h_a[-1]["reward_mean"] == pytest.approx(h_b[-1]["reward_mean"],
+                                                   rel=1e-5)
+
+
+def test_prologue_gae_routing_and_interpret_parity():
+    """Fleet env counts route an "auto" GAE through the Pallas kernel
+    (CPU: interpret fallback); small counts keep the scan; an explicit
+    impl is respected. The interpret kernel agrees with the scan across
+    a block boundary."""
+    from rl_scheduler_tpu.ops.gae import gae
+    from rl_scheduler_tpu.ops.pallas_gae import gae_pallas
+
+    small = dataclasses.replace(SMALL, fused_prologue="on")
+    fleet = dataclasses.replace(small, num_envs=512)
+    pinned = dataclasses.replace(fleet, gae_impl="scan")
+    assert resolve_prologue_gae_impl(fleet) == "pallas"
+    assert resolve_prologue_gae_impl(pinned) == "scan"
+    if jax.default_backend() != "tpu":
+        assert resolve_prologue_gae_impl(small) == "scan"
+
+    t, n = 7, 600  # crosses the kernel's 512-lane column block boundary
+    key = jax.random.PRNGKey(0)
+    kr, kv, kd, kl = jax.random.split(key, 4)
+    rewards = jax.random.normal(kr, (t, n))
+    values = jax.random.normal(kv, (t, n))
+    dones = (jax.random.uniform(kd, (t, n)) < 0.1).astype(jnp.float32)
+    last = jax.random.normal(kl, (n,))
+    adv_s, tgt_s = gae(rewards, values, dones, last, 0.99, 0.95, impl="scan")
+    adv_p, tgt_p = gae_pallas(rewards, values, dones, last, 0.99, 0.95,
+                              interpret=True)
+    np.testing.assert_allclose(np.asarray(adv_p), np.asarray(adv_s),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(tgt_p), np.asarray(tgt_s),
+                               rtol=1e-6, atol=1e-6)
+
+
+# ------------------------------------------- learning + dispatch
+
+
+def test_fused_dispatch_overlap_matches_sequential():
+    """updates_per_dispatch over the pipelined update is pure dispatch
+    plumbing — the scan-over-updates program (the overlap substrate) must
+    reproduce the one-by-one pipelined metrics."""
+    bundle = multi_cloud_bundle()
+    cfg = dataclasses.replace(SMALL, overlap_collect=True)
+    _, h_seq = ppo_train(bundle, cfg, 4, seed=7)
+    _, h_fused = ppo_train(bundle, cfg, 4, seed=7, updates_per_dispatch=2)
+    assert len(h_fused) == 4
+    for a, b in zip(h_seq, h_fused):
+        assert a["policy_loss"] == pytest.approx(b["policy_loss"], rel=1e-5)
+        assert a["reward_mean"] == pytest.approx(b["reward_mean"], rel=1e-6)
+
+
+def test_overlap_learning_progress():
+    """The 1-iteration-stale behavior policy still learns the flagship
+    table. Measured honestly: at this smoke recipe's aggressive lr
+    (3e-3, 4 epochs) staleness costs a little sample efficiency — 30
+    iterations reach 0.81-0.91 greedy row accuracy across seeds where the
+    on-policy run reaches 0.95 (tests/test_ppo.py) — so the bar here is
+    substantial learning (far above the 0.5 chance level) plus a large
+    reward gain, and the sample-efficiency note lives in docs/scaling.md
+    §1b next to the staleness semantics."""
+    from rl_scheduler_tpu.config import EnvConfig
+    from rl_scheduler_tpu.env import core as env_core
+    from tests.test_ppo import SMOKE_CFG, greedy_row_accuracy
+
+    env_params = env_core.make_params(EnvConfig())
+    cfg = dataclasses.replace(SMOKE_CFG, rollout_impl="scan",
+                              overlap_collect=True)
+    runner, history = ppo_train(env_params, cfg, 30, seed=0)
+    accuracy = greedy_row_accuracy(runner, env_params, SMOKE_CFG.hidden)
+    assert accuracy >= 0.75, (
+        f"pipelined greedy policy only matches the optimum on "
+        f"{accuracy:.0%} of rows — staleness should cost a little sample "
+        "efficiency, not learning")
+    first, last = (history[0]["episode_reward_mean"],
+                   history[-1]["episode_reward_mean"])
+    assert last - first > 0.15 * abs(first), (
+        f"no learning progress under overlap: {first:.1f} -> {last:.1f}")
+
+
+# --------------------------------------------------- dp / dp x sp
+
+
+def _compat_sharded(bundle, cfg, mesh, net=None, axes=("dp",)):
+    """The LIBRARY's per-member wrappers (parallel/sharding.py
+    make_local_ppo), sharded through the version-compat helper so the
+    numerics run on the container's JAX too (the library call sites keep
+    jax.shard_map — tests/test_sharding.py covers them where it
+    exists)."""
+    from jax.sharding import PartitionSpec as P
+
+    from rl_scheduler_tpu.parallel.mesh import shard_map_compat
+    from rl_scheduler_tpu.parallel.sharding import make_local_ppo
+
+    dp = mesh.shape["dp"]
+    local_cfg = dataclasses.replace(
+        cfg, num_envs=cfg.num_envs // dp,
+        minibatch_size=cfg.minibatch_size // dp)
+    sp_axis = "sp" if "sp" in axes else None
+    local_init, local_update, specs, net = make_local_ppo(
+        bundle, local_cfg, "dp", net=net, sp_axis=sp_axis)
+    sharded_init = jax.jit(shard_map_compat(
+        local_init, mesh, in_specs=P(), out_specs=specs))
+    sharded_update = jax.jit(shard_map_compat(
+        local_update, mesh, in_specs=(specs,), out_specs=(specs, P())))
+    return sharded_init, sharded_update, local_cfg, net
+
+
+def test_dp_overlap_trajectory_equivalence_and_sync():
+    """dp-sharded pipelined update: each shard's env trajectory equals the
+    single-device pipelined run with that shard's folded key, bitwise,
+    across TWO updates (the second consumes the stale slot — both runs
+    share it because the warm-up slot is the replicated init params), and
+    params stay replicated bit-identical (pmean sync)."""
+    from rl_scheduler_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    bundle = multi_cloud_bundle()
+    cfg = dataclasses.replace(SMALL, num_envs=8, minibatch_size=16,
+                              overlap_collect=True)
+    mesh = make_mesh({"dp": 2})
+    sh_init, sh_update, local_cfg, _ = _compat_sharded(bundle, cfg, mesh)
+    rs = sh_init(jax.random.PRNGKey(0))
+    rs, _ = sh_update(rs)
+    rs, _ = sh_update(rs)
+
+    # Per-shard reference: the single-device pipelined update, seeded the
+    # way the library's local_init does — env/rollout streams from the
+    # dp-folded key, the replicated leaves (params, optimizer state, the
+    # stale slot) from the unfolded one.
+    init_l, update_l, _ = make_ppo_bundle(bundle, local_cfg)
+    shared = jax.jit(init_l)(jax.random.PRNGKey(0))
+    for d in range(2):
+        key = jax.random.fold_in(jax.random.PRNGKey(0), d)
+        r = jax.jit(init_l)(key)
+        r = r._replace(params=shared.params, opt_state=shared.opt_state,
+                       collect_params=shared.collect_params)
+        r, _ = jax.jit(update_l)(r)
+        r, _ = jax.jit(update_l)(r)
+        sharded_obs = np.asarray(
+            jax.device_get(rs.obs))[d * local_cfg.num_envs:(d + 1)
+                                    * local_cfg.num_envs]
+        np.testing.assert_array_equal(sharded_obs,
+                                      np.asarray(jax.device_get(r.obs)))
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(rs.ep_return))[
+                d * local_cfg.num_envs:(d + 1) * local_cfg.num_envs],
+            np.asarray(jax.device_get(r.ep_return)))
+
+    for leaf in jax.tree.leaves(rs.params) + jax.tree.leaves(
+            rs.collect_params):
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        assert all(np.array_equal(shards[0], s) for s in shards[1:]), (
+            "replicated leaves diverged across dp shards")
+
+
+def test_dp_sp_overlap_update_finite_and_synced():
+    """dp x sp composition at a fleet node count: the pipelined update
+    through the node-axis-sharded flax policy (SeqParallelNet ring
+    machinery) stays finite, keeps params AND the stale slot replicated,
+    and advances the slot to the entry params."""
+    from rl_scheduler_tpu.env import cluster_set as cs
+    from rl_scheduler_tpu.env.bundle import cluster_set_bundle
+    from rl_scheduler_tpu.models import SetTransformerPolicy
+    from rl_scheduler_tpu.parallel.mesh import make_mesh
+    from rl_scheduler_tpu.parallel.sharding import SeqParallelNet
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    num_nodes = 32
+    bundle = cluster_set_bundle(cs.make_params(num_nodes=num_nodes))
+    cfg = PPOTrainConfig(num_envs=4, rollout_steps=8, minibatch_size=8,
+                         num_epochs=2, overlap_collect=True)
+    mesh = make_mesh({"dp": 2, "sp": 2})
+    net = SeqParallelNet(
+        SetTransformerPolicy(dim=16, depth=1, axis_name="sp"), "sp", 2)
+    sh_init, sh_update, _, _ = _compat_sharded(
+        bundle, cfg, mesh, net=net, axes=("dp", "sp"))
+    rs = sh_init(jax.random.PRNGKey(2))
+    p0 = jax.device_get(rs.params)
+    rs, metrics = sh_update(rs)
+    assert np.isfinite(float(metrics["policy_loss"]))
+    assert np.isfinite(float(metrics["value_loss"]))
+    assert _leaves_equal(rs.collect_params, p0)
+    for leaf in jax.tree.leaves(rs.params):
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        assert all(np.array_equal(shards[0], s) for s in shards[1:])
+
+
+# ------------------------------------------------------ CLI + resume
+
+
+def _cli_args(root, name, extra=()):
+    return ["--preset", "quick", "--env", "multi_cloud", "--num-envs", "4",
+            "--rollout-steps", "8", "--minibatch-size", "16",
+            "--num-epochs", "2", "--hidden", "8,8", "--run-root", str(root),
+            "--run-name", name, "--checkpoint-every", "2", *extra]
+
+
+def test_cli_overlap_meta_resume_guard_and_legacy(tmp_path):
+    """--overlap-collect is meta-recorded; --resume refuses a flag flip in
+    BOTH directions (a run without the key — legacy — counts as off)."""
+    from rl_scheduler_tpu.agent import train_ppo as cli
+    from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+    on = _cli_args(tmp_path, "on_run", ("--overlap-collect",))
+    run_dir = cli.main(on + ["--iterations", "2"])
+    meta = CheckpointManager(run_dir).restore_meta(2)
+    assert meta["overlap_collect"] is True
+    assert meta["full_state"] is True
+
+    with pytest.raises(SystemExit, match="overlap-collect"):
+        cli.main(_cli_args(tmp_path, "on_run") + ["--iterations", "4",
+                                                  "--resume"])
+
+    off = _cli_args(tmp_path, "off_run")
+    run_dir = cli.main(off + ["--iterations", "2"])
+    assert CheckpointManager(run_dir).restore_meta(2)[
+        "overlap_collect"] is False
+    with pytest.raises(SystemExit, match="unpipelined"):
+        cli.main(_cli_args(tmp_path, "off_run",
+                           ("--overlap-collect",)) + ["--iterations", "4",
+                                                      "--resume"])
+
+
+def test_cli_overlap_interrupt_resume_bitwise(tmp_path):
+    """The graftguard deterministic-resume guarantee extends to the
+    pipelined runner: a 2+2 resumed run replays iterations 3-4 of the
+    straight 4-iteration run exactly (the in-flight collect_params slot
+    rides the full-state checkpoint; without it the resumed pipeline
+    would restart warm and diverge)."""
+    from rl_scheduler_tpu.agent import train_ppo as cli
+
+    def rewards(run_dir):
+        out = {}
+        for line in (run_dir / "metrics.jsonl").read_text().splitlines():
+            row = json.loads(line)
+            if "iteration" in row and "reward_mean" in row:
+                out[row["iteration"]] = row["reward_mean"]
+        return out
+
+    straight = cli.main(_cli_args(tmp_path, "straight",
+                                  ("--overlap-collect",))
+                        + ["--iterations", "4"])
+    cli.main(_cli_args(tmp_path, "resumed", ("--overlap-collect",))
+             + ["--iterations", "2"])
+    resumed = cli.main(_cli_args(tmp_path, "resumed", ("--overlap-collect",))
+                       + ["--iterations", "4", "--resume"])
+    a, b = rewards(straight), rewards(resumed)
+    for i in (3, 4):
+        assert a[i] == b[i], (
+            f"iteration {i} diverged after resume: {a[i]} != {b[i]} — the "
+            "stale-params slot did not survive the checkpoint round-trip")
+
+
+def test_learning_state_only_resume_restarts_pipeline_warm():
+    """A params-only restore (sharded paths, changed env shape, legacy
+    trees) must seed the slot with the RESTORED params — not leave the
+    fresh init's random weights collecting one rollout."""
+    from rl_scheduler_tpu.config import EnvConfig
+    from rl_scheduler_tpu.env import core as env_core
+
+    env_params = env_core.make_params(EnvConfig())
+    cfg = dataclasses.replace(SMALL, hidden=(8, 8), overlap_collect=True)
+    runner_a, _ = ppo_train(env_params, cfg, 2, seed=7)
+    tree = {"params": _snapshot(runner_a.params),
+            "opt_state": _snapshot(runner_a.opt_state)}
+    runner_b, history = ppo_train(env_params, cfg, 3, seed=7,
+                                  restore=(dict(tree), 2))
+    assert len(history) == 1
+    # After ONE continued update the slot holds that update's entry
+    # params == the restored params (warm restart).
+    assert _leaves_equal(runner_b.collect_params, tree["params"])
+
+
+def test_full_state_overlap_tree_restored_with_overlap_off_drops_slot():
+    """API callers bypass the CLI's resume guard: restoring an
+    overlap-trained FULL-STATE tree with overlap off must drop the slot
+    (collect_params stays None) instead of installing a carry the
+    unpipelined update cannot return — which crashed the fused-dispatch
+    scan with a pytree-structure mismatch before the guard here."""
+    from rl_scheduler_tpu.config import EnvConfig
+    from rl_scheduler_tpu.env import core as env_core
+
+    env_params = env_core.make_params(EnvConfig())
+    on_cfg = dataclasses.replace(SMALL, hidden=(8, 8), overlap_collect=True)
+    runner_a, _ = ppo_train(env_params, on_cfg, 2, seed=3)
+    tree = {"params": _snapshot(runner_a.params),
+            "opt_state": _snapshot(runner_a.opt_state),
+            "loop": {"env_state": _snapshot(runner_a.env_state),
+                     "obs": _snapshot(runner_a.obs),
+                     "key": _snapshot(runner_a.key),
+                     "ep_return": _snapshot(runner_a.ep_return),
+                     "update_idx": _snapshot(runner_a.update_idx),
+                     "collect_params": _snapshot(runner_a.collect_params)}}
+    off_cfg = dataclasses.replace(SMALL, hidden=(8, 8))
+    runner_b, history = ppo_train(env_params, off_cfg, 4, seed=3,
+                                  restore=(tree, 2), updates_per_dispatch=2)
+    assert runner_b.collect_params is None
+    assert len(history) == 2
+    assert np.isfinite(history[-1]["policy_loss"])
+
+
+def test_cli_overlap_refused_with_tp():
+    from rl_scheduler_tpu.agent import train_ppo as cli
+
+    with pytest.raises(SystemExit, match="tensor-parallel"):
+        cli.main(["--preset", "quick", "--iterations", "1", "--hidden",
+                  "8,8", "--overlap-collect", "--tp", "2"])
+
+
+def test_ppo_train_refuses_overlap_with_tp_mesh():
+    """The library-level guard (API callers, not just the CLI)."""
+    from rl_scheduler_tpu.config import EnvConfig
+    from rl_scheduler_tpu.env import core as env_core
+    from rl_scheduler_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    cfg = dataclasses.replace(SMALL, hidden=(8, 8), overlap_collect=True)
+    with pytest.raises(ValueError, match="tensor-parallel"):
+        ppo_train(env_core.make_params(EnvConfig()), cfg, 1,
+                  mesh=make_mesh({"tp": 2}))

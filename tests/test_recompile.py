@@ -67,6 +67,29 @@ def test_ppo_open_loop_update_does_not_retrace():
     assert _cache_size(update) == first == 1
 
 
+def test_ppo_overlap_update_does_not_retrace():
+    """The graftpipe pipelined update (stale collect_params slot + fused
+    prologue) must not key compilation on values either — the slot is a
+    pytree of arrays, and the prologue's per-minibatch gather indexes
+    with a traced scan counter, not a Python int."""
+    bundle = multi_cloud_bundle()
+    cfg = PPOTrainConfig(
+        num_envs=4, rollout_steps=8, minibatch_size=16, num_epochs=2,
+        rollout_impl="scan", overlap_collect=True,
+    )
+    assert cfg.prologue_enabled  # auto follows overlap_collect
+    init_fn, update_fn, _ = make_ppo_bundle(bundle, cfg)
+    update = jax.jit(update_fn, donate_argnums=0)
+    runner = jax.jit(init_fn)(jax.random.PRNGKey(2))
+    runner, _ = update(runner)
+    first = _cache_size(update)
+    runner, _ = update(runner)
+    runner, _ = update(runner)
+    assert _cache_size(update) == first == 1, (
+        "pipelined PPO update retraced on same-shaped inputs"
+    )
+
+
 def test_dqn_update_does_not_retrace():
     bundle = single_cluster_bundle()
     cfg = DQNConfig(

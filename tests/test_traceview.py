@@ -5,8 +5,11 @@ The checked-in fixture (``tests/fixtures/traceview/fixture.trace.json.gz``)
 is a hand-built Perfetto trace with exactly-known self-times: a 50 ms
 ``jit(update_fn)`` span containing rollout (10 compute + 2 copy), gae (3),
 sgd (25 compute + 5 copy) children — so the parent's SELF time is 5 ms —
-plus a 1 ms host python frame. ``tools/traceview/budgets.json`` records the
-phase totals; this file is the pytest gate behind ``make obs``.
+plus a 1 ms host python frame, plus a second 30 ms graftpipe
+``jit(update_fn)`` span (overlap_collect 8, prologue 4 at the head + 1
+nested INSIDE the sgd scan — pinning that "prologue" outranks "sgd" in
+phase order — sgd 15, parent self 2). ``tools/traceview/budgets.json``
+records the phase totals; this file is the pytest gate behind ``make obs``.
 """
 
 import gzip
@@ -47,18 +50,25 @@ def test_fixture_roundtrips_documented_schema(fixture_summary):
     assert s["source"].endswith("fixture.trace.json.gz")
     # Self-time accounting: child durations subtracted from the enclosing
     # jit span, every microsecond attributed exactly once.
-    assert s["total_ms"] == pytest.approx(51.0)
+    assert s["total_ms"] == pytest.approx(81.0)
     phases = s["phases"]
-    assert set(phases) == {"rollout", "gae", "sgd", "other"}
+    assert set(phases) == {"rollout", "gae", "sgd", "overlap", "prologue",
+                           "other"}
     assert phases["rollout"]["total_ms"] == pytest.approx(12.0)
     assert phases["rollout"]["categories"]["compute"] == pytest.approx(10.0)
     assert phases["rollout"]["categories"]["transfer"] == pytest.approx(2.0)
     assert phases["gae"]["total_ms"] == pytest.approx(3.0)
-    assert phases["sgd"]["total_ms"] == pytest.approx(30.0)
+    assert phases["sgd"]["total_ms"] == pytest.approx(45.0)
     assert phases["sgd"]["categories"]["transfer"] == pytest.approx(5.0)
-    # The jit parent's SELF time (50 - 45 of children) plus the 1 ms
-    # host frame land in "other": 5 compute + 1 host.
-    assert phases["other"]["total_ms"] == pytest.approx(6.0)
+    # graftpipe span: the pipelined rollout's own scope ("overlap_collect"
+    # must not be swallowed by the generic collect/rollout markers) and
+    # the fused prologue — including the gather nested INSIDE the sgd
+    # scan, which classifies as prologue because its marker outranks sgd.
+    assert phases["overlap"]["total_ms"] == pytest.approx(8.0)
+    assert phases["prologue"]["total_ms"] == pytest.approx(5.0)
+    # The jit parents' SELF times (5 + 2) plus the 1 ms host frame land
+    # in "other".
+    assert phases["other"]["total_ms"] == pytest.approx(8.0)
     assert phases["other"]["categories"]["host"] == pytest.approx(1.0)
     for entry in phases.values():
         assert entry["fraction"] == pytest.approx(
@@ -148,8 +158,9 @@ def test_absent_budgeted_phase_is_a_violation(fixture_summary):
 def test_budgets_from_summary_excludes_other(fixture_summary):
     budgets = budgets_from_summary(fixture_summary, tolerance_pct=20.0)
     assert budgets["tolerance_pct"] == 20.0
-    assert set(budgets["phases"]) == {"rollout", "gae", "sgd"}
-    assert budgets["phases"]["sgd"] == pytest.approx(30.0)
+    assert set(budgets["phases"]) == {"rollout", "gae", "sgd", "overlap",
+                                      "prologue"}
+    assert budgets["phases"]["sgd"] == pytest.approx(45.0)
     # And the freshly-recorded baseline accepts the trace it came from.
     assert check_budgets(fixture_summary, budgets) == []
 

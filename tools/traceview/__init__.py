@@ -42,9 +42,14 @@ SCHEMA_VERSION = 1
 
 # Phase markers: substrings searched in each event's name + argument
 # strings. Ordered — first hit wins (longer/rarer markers first so e.g.
-# "scope_metrics" is not swallowed by a hypothetical "metrics" phase).
+# "scope_metrics" is not swallowed by a hypothetical "metrics" phase, and
+# graftpipe's "overlap_collect"/"prologue" scopes are claimed before the
+# generic "collect"/"sgd" markers could swallow them — prologue events
+# nest INSIDE the sgd scan, so "prologue" must outrank "sgd").
 DEFAULT_PHASES = (
     ("scope_metrics", ("scope_metrics",)),
+    ("overlap", ("overlap_collect",)),
+    ("prologue", ("prologue",)),
     ("rollout", ("rollout",)),
     ("gae", ("/gae/", "gae/", "(gae)")),
     ("sgd", ("sgd",)),
