@@ -9,8 +9,8 @@ PY ?= python
 TRACE ?= tests/fixtures/traceview/fixture.trace.json.gz
 
 .PHONY: lint lint-json test tier1 trace-summary obs chaos chaos-soak \
-        serve-pool serve-soak eval-matrix scenario-bench study study-list \
-        overlap-bench
+        serve-pool serve-soak rollout-drill eval-matrix scenario-bench \
+        study study-list overlap-bench
 
 lint:
 	$(PY) -m tools.graftlint --check
@@ -57,6 +57,16 @@ serve-pool:
 # mode through a live pool (tests/test_pool.py), next to `make chaos`.
 serve-soak:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_pool.py -q
+
+# graftroll rollout drill (docs/serving.md), container-safe: a 2-worker
+# pool absorbs a good promote (canary-gated rolling restart, all workers
+# land the new generation), refuses a deliberately corrupted candidate
+# at manifest verification, and auto-rolls-back a verifies-clean-but-
+# regressing one — plus the bench-driven soak variant where both drills
+# land mid-soak with zero failed requests and the durable trace log
+# replaying every decision.
+rollout-drill:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_pool.py -q -k rollout_drill
 
 # graftscenario (docs/scenarios.md): the scenario x policy-family eval
 # matrix — one schema_version-tagged JSON line per cell to

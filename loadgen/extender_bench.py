@@ -26,6 +26,14 @@ across rounds the way ``BENCH_r*`` tracks training. Two modes:
   wall-clock deadline; failures are counted instead of aborting the run
   (a soak's job is to report errors, not die on the first one).
 
+``--promote-at T --promote-checkpoint DIR`` (graftroll, soak mode only)
+fires ``POST /promote`` at the pool control plane T seconds into the
+soak, then polls ``GET /rollout`` until the rollout lands. Failures and
+requests are counted PER PHASE (before vs from the promote instant), so
+the zero-failed-requests acceptance criterion of the rollback drill is
+one command: a phase with failures > 0 means the rolling restart dropped
+traffic (docs/serving.md).
+
 Stdlib-only (no locust dependency) so it runs anywhere the extender does.
 """
 
@@ -72,42 +80,145 @@ def one_request(base: str, i: int, num_nodes: int = 2,
     return (time.perf_counter() - t0) * 1000.0
 
 
-def _soak(base: str, duration_s: float, threads: int, num_nodes: int):
+def _is_connection_error(exc: Exception) -> bool:
+    """Connection-LEVEL failure (refused / reset before a response):
+    during a rolling worker restart a SYN can land in a dying listener's
+    accept queue and get RST on close. The decision endpoints are
+    idempotent, so these — and only these — are safe to retry; an HTTP
+    error is a real answer and never retries."""
+    if isinstance(exc, urllib.error.HTTPError):
+        return False
+    if isinstance(exc, urllib.error.URLError):
+        exc = exc.reason if isinstance(exc.reason, Exception) else exc
+    import http.client
+
+    return isinstance(exc, (ConnectionError, http.client.RemoteDisconnected))
+
+
+def _request_with_retry(base: str, i: int, num_nodes: int, payload: bytes,
+                        connect_retries: int) -> tuple[float, int]:
+    """``(latency_ms, retries_used)``; only connection-level errors
+    retry (against a fresh connection the kernel re-hashes to a live
+    worker). Anything else — and a retry budget exhausted — propagates
+    as a soak failure."""
+    for attempt in range(connect_retries + 1):
+        try:
+            return one_request(base, i, num_nodes, payload), attempt
+        except Exception as exc:  # noqa: BLE001 - classified below
+            if attempt >= connect_retries or not _is_connection_error(exc):
+                raise
+            time.sleep(0.01 * (attempt + 1))
+    raise AssertionError("unreachable")
+
+
+def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
+          promote_at: float | None = None):
     """Duration-based load: each thread loops until the deadline.
 
     Payloads are prebuilt once (at N=1024 a node list is ~100 KB of
     JSON; rebuilding per request would bench the CLIENT's json.dumps)
     and reused round-robin so /filter and /prioritize both stay hot.
-    Returns ``(sorted_latencies_ms, wall_s, failures)``.
+    With ``promote_at`` set, requests and failures are additionally
+    split into pre/post-promote phases by the request's START time — the
+    drill's zero-failed-requests bar is judged per phase — and
+    connection-level errors retry up to 3 times (``_request_with_retry``:
+    a dying worker's accept queue RSTs on close; the retry's fresh
+    connection re-hashes to a live worker; retries are reported, HTTP
+    errors never retry).
+    Returns ``(sorted_latencies_ms, wall_s, failures, phases)``.
     """
     payloads = [make_payload(i, num_nodes) for i in range(16)]
-    deadline = time.perf_counter() + duration_s
+    connect_retries = 3 if promote_at is not None else 0
+    t_start = time.perf_counter()
+    deadline = t_start + duration_s
+    t_promote = None if promote_at is None else t_start + promote_at
     latencies: list = []
     failures = [0]
+    phases = {"pre_promote": {"requests": 0, "failures": 0, "retries": 0},
+              "post_promote": {"requests": 0, "failures": 0, "retries": 0}}
     lock = threading.Lock()
 
     def run(thread_id: int) -> None:
         local: list = []
         failed = 0
+        counts = {"pre_promote": [0, 0, 0], "post_promote": [0, 0, 0]}
         i = thread_id
-        while time.perf_counter() < deadline:
+        while True:
+            now = time.perf_counter()
+            if now >= deadline:
+                break
+            phase = ("post_promote"
+                     if t_promote is not None and now >= t_promote
+                     else "pre_promote")
             try:
-                local.append(one_request(base, i, num_nodes,
-                                         payloads[i % len(payloads)]))
+                ms, retried = _request_with_retry(
+                    base, i, num_nodes, payloads[i % len(payloads)],
+                    connect_retries)
+                local.append(ms)
+                counts[phase][0] += 1
+                counts[phase][2] += retried
             except Exception:  # noqa: BLE001 - soak counts, never aborts
                 failed += 1
+                counts[phase][0] += 1
+                counts[phase][1] += 1
             i += threads
         with lock:
             latencies.extend(local)
             failures[0] += failed
+            for phase, (reqs, fails, retries) in counts.items():
+                phases[phase]["requests"] += reqs
+                phases[phase]["failures"] += fails
+                phases[phase]["retries"] += retries
 
-    t_start = time.perf_counter()
     workers = [threading.Thread(target=run, args=(t,)) for t in range(threads)]
     for w in workers:
         w.start()
     for w in workers:
         w.join()
-    return sorted(latencies), time.perf_counter() - t_start, failures[0]
+    return (sorted(latencies), time.perf_counter() - t_start, failures[0],
+            phases if t_promote is not None else None)
+
+
+def _fire_promote(control: str, checkpoint: str, delay_s: float,
+                  deadline_s: float) -> dict:
+    """Sleep ``delay_s``, POST the promote, then poll ``GET /rollout``
+    until the rollout leaves the in-flight states (or the soak deadline
+    passes). Returns what happened for the result line — the drill
+    asserts on ``rollout.promotions_total``/``rollbacks_total``."""
+    time.sleep(delay_s)
+    out: dict = {"requested": True, "checkpoint": checkpoint}
+    req = urllib.request.Request(
+        control + "/promote",
+        data=json.dumps({"checkpoint": checkpoint}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            out["response_code"] = resp.status
+            out["response"] = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        out["response_code"] = e.code
+        try:
+            out["response"] = json.loads(e.read())
+        except Exception:  # noqa: BLE001 - body is advisory
+            out["response"] = None
+        return out  # refused: nothing to poll
+    except Exception as e:  # noqa: BLE001 - soak reports, never aborts
+        out["error"] = str(e)
+        return out
+    poll_deadline = time.perf_counter() + deadline_s
+    while time.perf_counter() < poll_deadline:
+        try:
+            status = _get_json(control + "/rollout")
+        except Exception:  # noqa: BLE001 - transient; keep polling
+            time.sleep(0.2)
+            continue
+        if not status.get("active"):
+            out["rollout"] = status
+            return out
+        time.sleep(0.2)
+    out["error"] = "rollout still in flight at the soak deadline"
+    return out
 
 
 def _get_json(url: str) -> dict:
@@ -135,11 +246,29 @@ def main(argv: list[str] | None = None) -> dict:
                         "(the data port resets only whichever worker the "
                         "kernel hands that connection) and the reported "
                         "server stats/worker count are pool-wide")
+    p.add_argument("--promote-at", type=float, default=None, metavar="T",
+                   help="graftroll drill hook (soak mode): POST /promote "
+                        "to the control plane T seconds into the soak and "
+                        "report per-phase failure counts — zero failures "
+                        "in BOTH phases is the rolling-restart acceptance "
+                        "bar (docs/serving.md)")
+    p.add_argument("--promote-checkpoint", default=None, metavar="DIR",
+                   help="checkpoint run dir to promote at --promote-at")
     args = p.parse_args(argv)
     if args.requests < 1:
         p.error("--requests must be >= 1")
     if args.duration is not None and args.duration <= 0:
         p.error("--duration must be a positive number of seconds")
+    if args.promote_at is not None:
+        if args.duration is None:
+            p.error("--promote-at needs --duration (the soak is the drill)")
+        if args.promote_checkpoint is None:
+            p.error("--promote-at needs --promote-checkpoint")
+        if not 0 <= args.promote_at < args.duration:
+            p.error("--promote-at must land inside the soak window "
+                    f"[0, {args.duration})")
+    elif args.promote_checkpoint is not None:
+        p.error("--promote-checkpoint only applies with --promote-at")
     base = f"http://{args.host}:{args.port}"
     control = (f"http://{args.host}:{args.control_port}"
                if args.control_port is not None else base)
@@ -162,9 +291,27 @@ def main(argv: list[str] | None = None) -> dict:
               "percentiles may include pre-run traffic", file=sys.stderr)
 
     failures = 0
+    phases = promote = None
     if args.duration is not None:
-        latencies, wall, failures = _soak(base, args.duration, args.threads,
-                                          args.nodes)
+        promote_thread = result_box = None
+        if args.promote_at is not None:
+            result_box = {}
+            remaining = args.duration - args.promote_at
+
+            def _promote_then_record():
+                result_box.update(_fire_promote(
+                    control, args.promote_checkpoint, args.promote_at,
+                    deadline_s=max(remaining, 1.0) + 30.0))
+
+            promote_thread = threading.Thread(target=_promote_then_record,
+                                              daemon=True)
+            promote_thread.start()
+        latencies, wall, failures, phases = _soak(
+            base, args.duration, args.threads, args.nodes,
+            promote_at=args.promote_at)
+        if promote_thread is not None:
+            promote_thread.join(timeout=60.0)
+            promote = result_box
         if not latencies:
             raise SystemExit(
                 f"soak completed zero requests in {args.duration}s "
@@ -212,6 +359,11 @@ def main(argv: list[str] | None = None) -> dict:
         "server_p99_ms": server_latency.get("p99_ms"),
         "backend": server_stats.get("backend"),
     }
+    if phases is not None:
+        out["promote_at_s"] = args.promote_at
+        out["phases"] = phases
+    if promote is not None:
+        out["promote"] = promote
     print(json.dumps(out))
     return out
 

@@ -51,6 +51,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from rl_scheduler_tpu.scheduler.policy_backend import make_backend
+from rl_scheduler_tpu.scheduler.tracelog import decision_record
 from rl_scheduler_tpu.utils.retry import CircuitOpenError
 from rl_scheduler_tpu.scheduler.telemetry import (
     PrometheusCpu,
@@ -374,6 +375,15 @@ class ExtenderPolicy:
         # /healthz reports pool membership; None keeps the single-process
         # health body byte-identical.
         self.pool_info: dict | None = None
+        # graftroll (scheduler/rollout.py): the policy generation this
+        # process serves — bumped per successful pool promote; the trace
+        # log stamps it on every record and /stats reports it so a
+        # rolling restart is observable per worker.
+        self.generation = 0
+        # graftroll (scheduler/tracelog.py): the durable decision trace.
+        # None (the default) keeps the hot path untouched; build_policy
+        # attaches a TraceLog when --trace-dir is configured.
+        self.trace = None
         # Candidate-list cap for the structured families — the same idea
         # as kube-scheduler's percentageOfNodesToScore: scoring cost per
         # request is O(cap) no matter how large the fleet's node list
@@ -432,6 +442,11 @@ class ExtenderPolicy:
         # (scored from neutral features); give those their own bucket.
         keys = CLOUDS + (("unknown",) if self.family in self.STRUCTURED else ())
         self._decisions = {c: 0 for c in keys}
+        # Lifetime count of requests answered by a fail-open path (open
+        # breaker or backend raise): the rollout canary gate compares
+        # deltas of this — a canary that "serves" by passing everything
+        # through is not a promotable policy.
+        self._fail_open_total = 0
         self._lock = threading.Lock()
 
     def _backend_call(self, fn, *args):
@@ -440,6 +455,36 @@ class ExtenderPolicy:
         absorbed by the same fail-open handlers that catch backend
         raises), successes/failures drive its state."""
         return self.backend_breaker.call(fn, *args)
+
+    def _record_trace(self, endpoint: str, *, candidates: int,
+                      chosen: str | None, score: float | None, obs,
+                      t0: float, fail_open: bool = False) -> None:
+        """Append one decision record to the durable trace (tracelog.py)
+        and count fail-opens. Hot-path cost: one obs digest (computed at
+        the source ON PURPOSE — it must fingerprint what was actually
+        served, not a queue-held array a later request could alias) plus
+        one bounded-queue put that never blocks; with no trace
+        configured the fail-open counter is the only work."""
+        if fail_open:
+            with self._lock:
+                self._fail_open_total += 1
+        if self.trace is None:
+            return
+        try:
+            telemetry_pos = self.telemetry.last_replay_position()
+        except AttributeError:  # policy stand-ins with bare telemetry
+            telemetry_pos = None
+        self.trace.append(decision_record(
+            endpoint=endpoint, family=self.family,
+            backend=getattr(self.backend, "name",
+                            self.backend.__class__.__name__),
+            candidates=candidates, chosen=chosen, score=score,
+            latency_ms=(time.perf_counter() - t0) * 1e3, obs=obs,
+            telemetry_pos=telemetry_pos,
+            worker_id=(self.pool_info or {}).get("worker_id"),
+            generation=self.generation, fail_open=fail_open,
+            breaker_state=self.backend_breaker.state,
+        ))
 
     def decide(self) -> tuple[int, np.ndarray, np.ndarray]:
         """One placement decision: ``(action, probs, obs)``; timed."""
@@ -508,7 +553,7 @@ class ExtenderPolicy:
         return action, probs, obs
 
     def _structured_decide(self, args: dict, display: list,
-                           clouds: list) -> tuple[int, np.ndarray]:
+                           clouds: list) -> tuple[int, np.ndarray, np.ndarray]:
         pod = args.get("pod")
         pod_cpu = pod_cpu_fraction(pod, self.node_capacity_cores)
         cap = self.max_score_nodes
@@ -529,15 +574,15 @@ class ExtenderPolicy:
         if self.family == "set":
             pod_reqs = (pod_resource_fractions(pod, self.node_capacity_cores)
                         if self.num_resources else None)
-            action, probs, _ = self.decide_set(sub_clouds, pod_cpu, pod_reqs)
+            action, probs, obs = self.decide_set(sub_clouds, pod_cpu, pod_reqs)
         else:
-            action, probs, _ = self.decide_graph(sub_clouds, sub_display,
-                                                 pod, pod_cpu)
+            action, probs, obs = self.decide_graph(sub_clouds, sub_display,
+                                                   pod, pod_cpu)
         if idx is not None:
             full = np.zeros(len(clouds), probs.dtype)
             full[idx] = probs
             action, probs = idx[action], full
-        return action, probs
+        return action, probs, obs
 
     @staticmethod
     def _request_nodes(args: dict) -> tuple[bool, list, list, list]:
@@ -574,18 +619,29 @@ class ExtenderPolicy:
         use_names, sources, display, clouds = self._request_nodes(args)
         if not sources:
             return self._passthrough(args)
+        t0 = time.perf_counter()
         try:
-            action, _ = self._structured_decide(args, display, clouds)
+            action, probs, obs = self._structured_decide(args, display,
+                                                         clouds)
         except CircuitOpenError:
             # Expected for the whole open window — the breaker logged its
             # trip; a traceback per refused request would flood the hot
             # serving path.
             logger.debug("backend breaker open; passing all nodes")
+            self._record_trace("filter", candidates=len(sources),
+                               chosen=None, score=None, obs=None, t0=t0,
+                               fail_open=True)
             return self._passthrough(args)
         except Exception:  # never wedge scheduling: pass all nodes through.
             logger.exception("%s policy decision failed; passing all nodes",
                              self.family)
+            self._record_trace("filter", candidates=len(sources),
+                               chosen=None, score=None, obs=None, t0=t0,
+                               fail_open=True)
             return self._passthrough(args)
+        self._record_trace("filter", candidates=len(sources),
+                           chosen=display[action],
+                           score=float(probs[action]), obs=obs, t0=t0)
         if self.placer is not None and clouds[action] is not None:
             self.placer.submit(clouds[action])
         failed = {
@@ -604,18 +660,71 @@ class ExtenderPolicy:
         _, sources, display, clouds = self._request_nodes(args)
         if not sources:
             return []
+        t0 = time.perf_counter()
         try:
-            _, probs = self._structured_decide(args, display, clouds)
-            scores = np.round(probs / probs.max() * MAX_EXTENDER_SCORE)
+            action, probs, obs = self._structured_decide(args, display,
+                                                         clouds)
         except CircuitOpenError:
             logger.debug("backend breaker open; uniform priorities")
-            scores = np.full(len(sources), MAX_EXTENDER_SCORE // 2)
+            self._record_trace("prioritize", candidates=len(sources),
+                               chosen=None, score=None, obs=None, t0=t0,
+                               fail_open=True)
+            return self._uniform_priorities(display)
         except Exception:
             logger.exception("%s policy decision failed; uniform priorities",
                              self.family)
-            scores = np.full(len(sources), MAX_EXTENDER_SCORE // 2)
+            self._record_trace("prioritize", candidates=len(sources),
+                               chosen=None, score=None, obs=None, t0=t0,
+                               fail_open=True)
+            return self._uniform_priorities(display)
+        scores = np.round(probs / probs.max() * MAX_EXTENDER_SCORE)
+        # Success record OUTSIDE the try (like the filter paths): a
+        # trace-layer raise must never downgrade a computed answer to
+        # uniform scores, nor count a spurious fail-open the rollout
+        # canary gate would read as a regression.
+        self._record_trace("prioritize", candidates=len(sources),
+                           chosen=display[action],
+                           score=float(probs[action]), obs=obs, t0=t0)
         return [{"host": name, "score": int(s)}
                 for name, s in zip(display, scores)]
+
+    @staticmethod
+    def _uniform_priorities(display: list) -> list[dict]:
+        return [{"host": name, "score": MAX_EXTENDER_SCORE // 2}
+                for name in display]
+
+    def warmup_probe(self) -> dict:
+        """One synthetic decision through the real decide path — the
+        rollout gate's warm-up probe (scheduler/rollout.py). Unlike a
+        request through :meth:`filter` it never submits a placement (no
+        kube API call per probe) and its trace record is tagged
+        ``endpoint="probe"`` so a trace consumer can exclude synthetic
+        traffic. ``decided`` False means the decision failed open — a
+        canary that only passes through is not promotable."""
+        sources = ["aws-probe-0", "azure-probe-1"]
+        clouds = [node_cloud(s) for s in sources]
+        t0 = time.perf_counter()
+        try:
+            if self.family in self.STRUCTURED:
+                action, probs, obs = self._structured_decide(
+                    {"pod": {}}, sources, clouds)
+                chosen = sources[action]
+            else:
+                action, probs, obs = self.decide()
+                chosen = CLOUDS[action]
+        except Exception:  # noqa: BLE001 — CircuitOpenError included:
+            # a fail-open probe IS the gate's signal, not an error
+            logger.debug("warm-up probe failed open", exc_info=True)
+            self._record_trace("probe", candidates=len(sources),
+                               chosen=None, score=None, obs=None, t0=t0,
+                               fail_open=True)
+            return {"decided": False,
+                    "latency_ms": round((time.perf_counter() - t0) * 1e3,
+                                        3)}
+        self._record_trace("probe", candidates=len(sources), chosen=chosen,
+                           score=float(probs[action]), obs=obs, t0=t0)
+        return {"decided": True,
+                "latency_ms": round((time.perf_counter() - t0) * 1e3, 3)}
 
     def filter(self, args: dict) -> dict:
         """ExtenderFilterResult: keep nodes on the chosen cloud; fail open."""
@@ -627,17 +736,26 @@ class ExtenderPolicy:
             # item was junk): echo the request through rather than answer
             # "zero feasible nodes" — same guard as the structured path.
             return self._passthrough(args)
+        t0 = time.perf_counter()
         try:
-            action, _, _ = self.decide()
+            action, probs, obs = self.decide()
         except CircuitOpenError:
             logger.debug("backend breaker open; passing all nodes")
+            self._record_trace("filter", candidates=len(sources),
+                               chosen=None, score=None, obs=None, t0=t0,
+                               fail_open=True)
             return self._passthrough(args)
         except Exception:  # never wedge scheduling: pass all nodes through.
             # error stays "" — kube-scheduler treats a non-empty Error as a
             # hard extender failure unless ignorable=true is configured.
             logger.exception("policy decision failed; passing all nodes")
+            self._record_trace("filter", candidates=len(sources),
+                               chosen=None, score=None, obs=None, t0=t0,
+                               fail_open=True)
             return self._passthrough(args)
         chosen = CLOUDS[action]
+        self._record_trace("filter", candidates=len(sources), chosen=chosen,
+                           score=float(probs[action]), obs=obs, t0=t0)
         if self.placer is not None:
             self.placer.submit(chosen)
 
@@ -656,14 +774,25 @@ class ExtenderPolicy:
         if self.family in self.STRUCTURED:
             return self._prioritize_structured(args)
         _, _, display, clouds = self._request_nodes(args)
+        t0 = time.perf_counter()
+        action = obs = None
         try:
-            _, probs, _ = self.decide()
+            action, probs, obs = self.decide()
         except CircuitOpenError:
             logger.debug("backend breaker open; uniform priorities")
             probs = np.full(len(CLOUDS), 1.0 / len(CLOUDS))
         except Exception:
             logger.exception("policy decision failed; uniform priorities")
             probs = np.full(len(CLOUDS), 1.0 / len(CLOUDS))
+        if action is not None:
+            # Success record outside the try — see _prioritize_structured.
+            self._record_trace("prioritize", candidates=len(display),
+                               chosen=CLOUDS[action],
+                               score=float(probs[action]), obs=obs, t0=t0)
+        else:
+            self._record_trace("prioritize", candidates=len(display),
+                               chosen=None, score=None, obs=None, t0=t0,
+                               fail_open=True)
         out = []
         for name, cloud in zip(display, clouds):
             if cloud is None:
@@ -688,7 +817,10 @@ class ExtenderPolicy:
         measurement window so ``/stats`` percentiles cover exactly the
         requests since the reset. Round-4 finding: the 4096-entry ring
         spans ~3 consecutive 1500-request bench runs, so per-configuration
-        percentiles were contaminated by the preceding run's traffic."""
+        percentiles were contaminated by the preceding run's traffic.
+        Lifetime counters — histograms, fail-opens, trace-writer stats,
+        and the pool's promotion/rollback totals — are deliberately NOT
+        cleared (Prometheus monotonicity; pinned by test)."""
         self.stats.reset()
         return {"status": "reset"}
 
@@ -718,16 +850,26 @@ class ExtenderPolicy:
     def statistics(self) -> dict:
         with self._lock:
             decisions = dict(self._decisions)
+            fail_open = self._fail_open_total
         total = sum(decisions.values())
         out = {
             "backend": self.backend.name,
             "family": self.family,
+            "generation": self.generation,
             "decisions": decisions,
             "choice_fractions": {
                 c: (n / total if total else 0.0) for c, n in decisions.items()
             },
             "latency": self.stats.percentiles_ms(),
+            # Lifetime fail-open count (open breaker / backend raise):
+            # the rollout canary gate compares deltas of this.
+            "fail_open_total": fail_open,
         }
+        if self.trace is not None:
+            # Trace-writer counters (records/dropped/write_errors/
+            # segments). Lifetime-monotonic like the histogram —
+            # /stats/reset never clears them (docs/serving.md).
+            out["trace"] = self.trace.snapshot()
         shed = getattr(self.backend, "shed_fraction", None)
         if shed is not None:
             # The load-aware backends' off-primary fraction (admission
@@ -801,6 +943,35 @@ class ExtenderPolicy:
                 "dropped by the bounded async queue.",
                 f"# TYPE {p}_placements_dropped_total counter",
                 f"{p}_placements_dropped_total {self.placer.dropped}",
+            ]
+        with self._lock:
+            fail_open = self._fail_open_total
+        lines += [
+            f"# HELP {p}_fail_open_total Requests answered by a fail-open "
+            "path (open breaker or backend raise), lifetime.",
+            f"# TYPE {p}_fail_open_total counter",
+            f"{p}_fail_open_total {fail_open}",
+        ]
+        if self.trace is not None:
+            trace = self.trace.snapshot()
+            lines += [
+                f"# HELP {p}_trace_records_total Decision records appended "
+                "to the durable trace log (lifetime; /stats/reset never "
+                "clears it).",
+                f"# TYPE {p}_trace_records_total counter",
+                f"{p}_trace_records_total {trace['records_total']}",
+                f"# HELP {p}_trace_dropped_total Trace records dropped by "
+                "the bounded queue's drop-oldest backpressure.",
+                f"# TYPE {p}_trace_dropped_total counter",
+                f"{p}_trace_dropped_total {trace['dropped_total']}",
+                f"# HELP {p}_trace_write_errors_total Trace segment writes "
+                "that failed (record dropped, serving unaffected).",
+                f"# TYPE {p}_trace_write_errors_total counter",
+                f"{p}_trace_write_errors_total {trace['write_errors_total']}",
+                f"# HELP {p}_trace_segments_total Trace segments sealed "
+                "(fsync + rename).",
+                f"# TYPE {p}_trace_segments_total counter",
+                f"{p}_trace_segments_total {trace['segments_total']}",
             ]
         from rl_scheduler_tpu.utils.retry import CircuitBreaker
 
@@ -951,6 +1122,8 @@ def build_policy(
     price_counter=None,
     table_counter=None,
     scenario: str | None = None,
+    trace_dir: str | None = None,
+    trace_prefix: str = "",
 ) -> ExtenderPolicy:
     """Assemble the serving stack: checkpoint -> backend -> telemetry.
 
@@ -1125,6 +1298,14 @@ def build_policy(
         policy.num_resources = num_resources
     if ckpt_scenario is not None:
         policy.scenario = ckpt_scenario
+    if trace_dir is not None:
+        # graftroll: the durable decision trace (scheduler/tracelog.py).
+        # Attached post-construction like the scenario provenance above;
+        # pool workers pass a per-worker prefix so one shared directory
+        # carries every worker's stream without write contention.
+        from rl_scheduler_tpu.scheduler.tracelog import TraceLog
+
+        policy.trace = TraceLog(trace_dir, prefix=trace_prefix)
     if max_score_nodes and policy.family not in ExtenderPolicy.STRUCTURED:
         # Same refuse-before-traffic rule as price_replay below: the flat
         # family scores per CLOUD (two logits however long the node list
@@ -1242,6 +1423,13 @@ def main(argv: list[str] | None = None) -> None:
                         "per-request forward at fleet-giant N and pins "
                         "large requests to one AOT executable size. "
                         "0 scores every candidate")
+    p.add_argument("--trace-dir", default=None, metavar="DIR",
+                   help="graftroll: append every decision to a durable "
+                        "JSONL trace log under DIR (crash-safe rotating "
+                        "segments; bounded queue, drop-oldest — the hot "
+                        "path never blocks). In pool mode each worker "
+                        "writes its own w<id>- stream into the shared "
+                        "directory. Omit to disable (docs/serving.md)")
     p.add_argument("--price-replay-period", type=float, default=300.0,
                    help="wallclock replay only: real-world seconds one "
                         "pricing-table row represents (default 300 — the "
@@ -1321,6 +1509,7 @@ def main(argv: list[str] | None = None) -> None:
         warm_nodes=warm_nodes,
         max_score_nodes=args.max_score_nodes,
         scenario=args.scenario,
+        trace_dir=args.trace_dir,
     )
     if args.workers is not None:
         # graftserve: the supervisor never builds a policy (workers each
@@ -1350,6 +1539,12 @@ def main(argv: list[str] | None = None) -> None:
         server.serve_forever()
     except KeyboardInterrupt:
         server.shutdown()
+    finally:
+        if policy.trace is not None:
+            # Drain + seal the trace on every exit path: an unclosed
+            # trace would leave the final records queued, and "the log
+            # replays every decision" is the acceptance contract.
+            policy.trace.close()
 
 
 if __name__ == "__main__":

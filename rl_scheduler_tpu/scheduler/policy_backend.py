@@ -589,6 +589,24 @@ BACKENDS: dict[str, Callable] = {
 }
 
 
+def backend_info(backend) -> dict:
+    """Provenance dict for one serving backend — the fields the trace
+    log stamps on every decision record and the rollout canary gate
+    reads off worker snapshots (scheduler/tracelog.py,
+    scheduler/rollout.py). Every backend family answers: ``family``
+    defaults to the flat cloud decision, and the load-aware gauges are
+    included only when the backend tracks them."""
+    out = {
+        "name": getattr(backend, "name", backend.__class__.__name__),
+        "family": getattr(backend, "family", "cloud"),
+    }
+    for key in ("shed_fraction", "reroute_fraction"):
+        value = getattr(backend, key, None)
+        if value is not None:
+            out[key] = round(float(value), 4)
+    return out
+
+
 def make_backend(
     backend: str = "jax",
     params_tree: dict | None = None,

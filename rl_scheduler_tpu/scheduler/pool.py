@@ -33,9 +33,17 @@ core. This module is the missing plane:
     anywhere" is one gauge), plus per-worker ``_pool_worker_*`` series
     where per-worker identity matters (liveness, decision share).
   - ``POST /stats/reset`` — fanned out to every worker (each clears its
-    percentile ring; lifetime histograms stay monotonic, as Prometheus
-    requires).
-  - ``GET /healthz``    — live worker count vs configured, restart total.
+    percentile ring; lifetime histograms — and every graftroll counter:
+    trace records/drops/segments, promotions, rollbacks — stay
+    monotonic, as Prometheus requires).
+  - ``GET /healthz``    — live worker count vs configured, restart total,
+    and ``rolling: true`` (still 200) while a promote/rollback is in
+    flight — a rollout must not trip k8s liveness.
+  - ``POST /promote``   — graftroll (``scheduler/rollout.py``): verify a
+    candidate checkpoint against its integrity manifests, then execute a
+    canary-gated rolling worker restart onto it, rolling back
+    automatically on any gate failure. ``GET /rollout`` reports the
+    state machine, per-worker generations, and lifetime counters.
 
 - Workers publish snapshots to the supervisor over a **local control
   socket** (AF_UNIX where available, else loopback TCP; newline-delimited
@@ -59,6 +67,7 @@ processes.
 
 from __future__ import annotations
 
+import inspect
 import json
 import logging
 import multiprocessing
@@ -71,6 +80,11 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from rl_scheduler_tpu.scheduler.extender import LatencyStats, make_server
+from rl_scheduler_tpu.scheduler.rollout import (
+    STATE_CODES,
+    RolloutController,
+    WorkerSpec,
+)
 from rl_scheduler_tpu.utils.retry import CircuitBreaker, RetryPolicy
 
 logger = logging.getLogger(__name__)
@@ -126,13 +140,18 @@ def worker_snapshot(policy, worker_id: int | None = None) -> dict:
     """One worker's control-plane snapshot: the existing ``/stats`` body
     (decision counts, ring percentiles, breakers, shed/reroute) plus the
     raw lifetime histogram — the one piece ``/stats`` doesn't carry and
-    the only one that merges exactly across workers."""
+    the only one that merges exactly across workers — plus the worker's
+    policy generation (graftroll: a rolling promote is observable per
+    worker) and trace-writer counters when a trace log is attached."""
     cumulative, total_sum, count = policy.stats.histogram()
+    trace = getattr(policy, "trace", None)
     return {
         "schema": SNAPSHOT_SCHEMA,
         "worker_id": worker_id,
         "pid": os.getpid(),
+        "generation": getattr(policy, "generation", 0),
         "stats": policy.statistics(),
+        "trace": trace.snapshot() if trace is not None else None,
         "histogram": {
             "cumulative": cumulative,
             "sum": total_sum,
@@ -276,6 +295,7 @@ def aggregate_stats(snapshots: list, pool: dict, merged=None) -> dict:
             {
                 "worker_id": s.get("worker_id"),
                 "pid": s.get("pid"),
+                "generation": s.get("generation", 0),
                 "decisions_total": sum(
                     s["stats"].get("decisions", {}).values()
                 ),
@@ -292,7 +312,26 @@ def aggregate_stats(snapshots: list, pool: dict, merged=None) -> dict:
                if "placements_dropped" in s["stats"]]
     if dropped:
         out["placements_dropped"] = sum(dropped)
+    fail_open = [s["stats"]["fail_open_total"] for s in snapshots
+                 if "fail_open_total" in s["stats"]]
+    if fail_open:
+        out["fail_open_total"] = sum(fail_open)
+    trace = _summed_trace(snapshots)
+    if trace is not None:
+        out["trace"] = trace
     return out
+
+
+def _summed_trace(snapshots: list) -> dict | None:
+    """Pool-wide trace-writer counters: per-worker monotonic counts sum
+    exactly (each worker owns its own segment stream). ``None`` when no
+    worker carries a trace log."""
+    traced = [s["trace"] for s in snapshots if s.get("trace")]
+    if not traced:
+        return None
+    keys = ("records_total", "written_total", "dropped_total",
+            "write_errors_total", "segments_total")
+    return {k: sum(t.get(k, 0) for t in traced) for k in keys}
 
 
 def aggregate_metrics(snapshots: list, pool: dict) -> str:
@@ -343,6 +382,31 @@ def aggregate_metrics(snapshots: list, pool: dict) -> str:
             f"# TYPE {p}_placements_dropped_total counter",
             f"{p}_placements_dropped_total {stats['placements_dropped']}",
         ]
+    if "fail_open_total" in stats:
+        lines += [
+            f"# HELP {p}_fail_open_total Requests answered by a fail-open "
+            "path (open breaker or backend raise), summed across workers.",
+            f"# TYPE {p}_fail_open_total counter",
+            f"{p}_fail_open_total {stats['fail_open_total']}",
+        ]
+    if "trace" in stats:
+        trace = stats["trace"]
+        for key, help_text in (
+            ("records_total", "Decision records appended to the durable "
+                              "trace log (pool lifetime; /stats/reset "
+                              "never clears it)."),
+            ("dropped_total", "Trace records dropped by the bounded "
+                              "queues' drop-oldest backpressure."),
+            ("write_errors_total", "Trace segment writes that failed "
+                                   "(records dropped, serving unaffected)."),
+            ("segments_total", "Trace segments sealed (fsync + rename), "
+                               "pool total."),
+        ):
+            lines += [
+                f"# HELP {p}_trace_{key} {help_text}",
+                f"# TYPE {p}_trace_{key} counter",
+                f"{p}_trace_{key} {trace[key]}",
+            ]
     breakers = stats["breakers"]
     lines += [
         f"# HELP {p}_circuit_state Circuit breaker state per host-I/O "
@@ -376,6 +440,44 @@ def aggregate_metrics(snapshots: list, pool: dict) -> str:
         "supervisor (lifetime).",
         f"# TYPE {p}_pool_restarts_total counter",
         f"{p}_pool_restarts_total {pool.get('restarts_total', 0)}",
+    ]
+    # graftroll: the rollout generation labels the drill reads off one
+    # scrape — pool generation, per-worker generation, the promote/
+    # rollback lifetime counters (monotonic: /stats/reset never touches
+    # them), and whether a rollout is in flight (docs/serving.md drill).
+    rollout = pool.get("rollout", {})
+    lines += [
+        f"# HELP {p}_pool_generation Policy generation the pool serves "
+        "(bumped per successful promote).",
+        f"# TYPE {p}_pool_generation gauge",
+        f"{p}_pool_generation {pool.get('generation', 0)}",
+        f"# HELP {p}_pool_promotions_total Successful checkpoint "
+        "promotions (lifetime).",
+        f"# TYPE {p}_pool_promotions_total counter",
+        f"{p}_pool_promotions_total {rollout.get('promotions_total', 0)}",
+        f"# HELP {p}_pool_rollbacks_total Rollouts rolled back by a "
+        "failed canary/health gate (lifetime).",
+        f"# TYPE {p}_pool_rollbacks_total counter",
+        f"{p}_pool_rollbacks_total {rollout.get('rollbacks_total', 0)}",
+        f"# HELP {p}_pool_promote_refusals_total Promotions refused "
+        "before any worker was touched (corrupt/unverifiable candidate).",
+        f"# TYPE {p}_pool_promote_refusals_total counter",
+        f"{p}_pool_promote_refusals_total "
+        f"{rollout.get('refusals_total', 0)}",
+        f"# HELP {p}_pool_rollout_state Rollout state machine "
+        "(0=idle, 1=promoting, 2=rolling_back).",
+        f"# TYPE {p}_pool_rollout_state gauge",
+        f"{p}_pool_rollout_state "
+        f"{STATE_CODES.get(rollout.get('state'), 0)}",
+        f"# HELP {p}_pool_worker_generation Per-worker policy generation "
+        "(diverges from pool generation only mid-rollout).",
+        f"# TYPE {p}_pool_worker_generation gauge",
+    ]
+    for snap in snapshots:
+        lines.append(
+            f'{p}_pool_worker_generation{{worker="{snap.get("worker_id")}"}} '
+            f'{snap.get("generation", 0)}')
+    lines += [
         f"# HELP {p}_pool_worker_up Per-worker liveness (answered this "
         "scrape).",
         f"# TYPE {p}_pool_worker_up gauge",
@@ -465,6 +567,14 @@ def _worker_control_loop(policy, server, sock, worker_id: int) -> None:
                 _send_line(sock, {"ok": True, **policy.reset_stats()})
             elif cmd == "ping":
                 _send_line(sock, {"ok": True})
+            elif cmd == "probe":
+                # graftroll warm-up gate: one REAL decision through the
+                # exact decide path (rollout.py targets a specific
+                # worker here — the data port is kernel-balanced and
+                # cannot). warmup_probe never submits a placement and
+                # tags its trace record, so synthetic gate traffic
+                # cannot contaminate the kube API or the trace.
+                _send_line(sock, {"ok": True, **policy.warmup_probe()})
             else:
                 _send_line(sock, {"error": f"unknown cmd {cmd!r}"})
     except OSError:
@@ -506,21 +616,33 @@ def _limit_blas_threads(n: int, worker_id: int):
 
 def _worker_main(worker_id: int, n_workers: int, policy_factory, shared,
                  host: str, port: int, listener, reuse_port: bool,
-                 control_spec: str, blas_threads: int = 0) -> None:
+                 control_spec: str, blas_threads: int = 0,
+                 spec: WorkerSpec | None = None,
+                 takes_spec: bool = False) -> None:
     """The forked worker body: build the policy, serve the data port
     (own SO_REUSEPORT listener, or the inherited pre-fork socket), and
     answer the supervisor's control commands. Any startup failure exits
-    nonzero — the supervisor sees the death and applies its backoff."""
-    # The supervisor's signal handlers were inherited across fork; the
-    # supervisor terminates workers explicitly, so default handlers are
-    # correct here (SIGTERM kills, exactly what the supervisor sends).
+    nonzero — the supervisor sees the death and applies its backoff.
+    ``spec`` (graftroll) names the generation/checkpoint this worker
+    serves; spec-aware factories get it as a third argument."""
+    spec = spec or WorkerSpec()
+    # The supervisor's signal handlers were inherited across fork —
+    # running THEM here would make a terminated child call the
+    # supervisor's pool.shutdown() (SIGTERM-ing siblings, unlinking the
+    # control socket), so drop to defaults FIRST. The graceful drain
+    # handler replaces SIG_DFL below, once there is a server to drain:
+    # a terminate landing before that (slow checkpoint restore) kills a
+    # worker that was serving nothing, which loses nothing.
     signal.signal(signal.SIGTERM, signal.SIG_DFL)
     signal.signal(signal.SIGINT, signal.SIG_IGN)  # ^C goes to supervisor
     limiter = _limit_blas_threads(blas_threads, worker_id) \
         if blas_threads > 0 else None
     try:
-        policy = policy_factory(worker_id, shared)
-        policy.pool_info = {"workers": n_workers, "worker_id": worker_id}
+        policy = (policy_factory(worker_id, shared, spec) if takes_spec
+                  else policy_factory(worker_id, shared))
+        policy.pool_info = {"workers": n_workers, "worker_id": worker_id,
+                            "generation": spec.generation}
+        policy.generation = spec.generation
         if reuse_port:
             server = make_server(policy, host, port, reuse_port=True)
             if listener is not None:
@@ -528,6 +650,23 @@ def _worker_main(worker_id: int, n_workers: int, policy_factory, shared,
         else:
             server = make_server(policy, host, port,
                                  inherited_socket=listener)
+        # Drainable handlers: ThreadingHTTPServer's daemon handler
+        # threads are NOT tracked by socketserver's _Threads, so
+        # server_close() would join nothing and an in-flight request
+        # could race the trace log's close (answered but never
+        # recorded). Non-daemon threads make the shutdown drain real;
+        # a truly wedged handler is bounded by the supervisor's
+        # terminate→join(10 s)→kill escalation.
+        server.daemon_threads = False
+        def _graceful_stop(signum, frame):  # noqa: ARG001 (signal API)
+            threading.Thread(target=server.shutdown, daemon=True).start()
+
+        # Graceful drain from here on (and installed BEFORE the
+        # control-plane hello: the rollout controller may terminate this
+        # worker the moment it appears): a deliberate SIGTERM unwinds
+        # serve_forever so the finally below drains in-flight requests
+        # and seals the trace log — a SIG_DFL kill would strand both.
+        signal.signal(signal.SIGTERM, _graceful_stop)
         control = _control_connect(control_spec)
         _send_line(control, {
             "hello": True, "worker_id": worker_id, "pid": os.getpid(),
@@ -543,17 +682,50 @@ def _worker_main(worker_id: int, n_workers: int, policy_factory, shared,
     try:
         server.serve_forever()
     finally:
+        # Drain before dying: server_close() drops the listener out of
+        # the SO_REUSEPORT balancing group and JOINS in-flight handler
+        # threads (ThreadingHTTPServer.block_on_close), so a request a
+        # dying worker already accepted is answered, not reset — the
+        # rolling-restart zero-failed-requests bar depends on it.
+        try:
+            server.server_close()
+        except OSError:
+            pass
         control.close()
+        trace = getattr(policy, "trace", None)
+        if trace is not None:
+            trace.close()  # drain + seal: sealed segments replay fully
         del limiter  # the BLAS clamp lives exactly as long as serving
 
 
 # -------------------------------------------------------------- supervisor
 
 
+def _accepts_spec(factory) -> bool:
+    """True when a policy factory NAMES a third positional parameter —
+    the graftroll :class:`WorkerSpec` (generation + checkpoint). Legacy
+    ``(worker_id, shared)`` factories are detected and served the old
+    call shape, so every existing embedder keeps working unchanged.
+    Deliberately conservative: ``*args`` and unresolvable signatures
+    stay legacy too — a pre-graftroll ``*args`` factory could TAKE a
+    third argument but was never written to expect one, and a wrong
+    guess here kills every worker at startup."""
+    try:
+        sig = inspect.signature(factory)
+    except (TypeError, ValueError):  # builtins/C callables: stay legacy
+        return False
+    positional = [
+        p for p in sig.parameters.values()
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+    ]
+    return len(positional) >= 3
+
+
 class _WorkerSlot:
     """Supervisor-side state for one worker index."""
 
-    def __init__(self, worker_id: int, backoff: list):
+    def __init__(self, worker_id: int, backoff: list,
+                 spec: WorkerSpec | None = None):
         self.worker_id = worker_id
         self.process = None
         self.conn: socket.socket | None = None
@@ -562,6 +734,13 @@ class _WorkerSlot:
         self.last_spawn = 0.0
         self.failed = False
         self.backoff = backoff  # RetryPolicy.delays() schedule
+        # graftroll: what this slot serves (generation + checkpoint). The
+        # monitor respawns a crashed worker onto ITS spec — mid-rollout a
+        # dead canary resumes on the candidate generation until the gate
+        # decides; `hold` marks a slot the rollout controller is
+        # deliberately operating on, so the monitor never races it.
+        self.spec = spec or WorkerSpec()
+        self.hold = False
 
     @property
     def alive(self) -> bool:
@@ -584,7 +763,9 @@ class ServingPool:
                  control_port: int | None = None, mode: str = "auto",
                  restart_policy: RetryPolicy | None = None,
                  stable_after_s: float = 30.0, poll_interval_s: float = 0.2,
-                 blas_threads: int | None = None):
+                 blas_threads: int | None = None,
+                 initial_checkpoint: str | None = None,
+                 fault_plan=None, rollout_opts: dict | None = None):
         if workers < 1:
             raise ValueError(f"workers={workers}: pass at least 1")
         if blas_threads is not None and blas_threads < 0:
@@ -606,6 +787,16 @@ class ServingPool:
         self.reuse_port = (mode == "reuseport"
                           or (mode == "auto" and have_reuseport))
         self._factory = policy_factory
+        # graftroll: spec-aware factories take (worker_id, shared, spec)
+        # and can build a policy for ANY checkpoint generation; legacy
+        # 2-arg factories keep working (they serve whatever they were
+        # built to serve — a promote still bumps their generation label).
+        self._factory_takes_spec = _accepts_spec(policy_factory)
+        # The generation the POOL serves: bumped only after the last
+        # worker of a rollout promotes, so crash-restarts always respawn
+        # onto a generation every gate approved.
+        self.generation = 0
+        self.checkpoint = initial_checkpoint
         self.shared = PoolShared(ctx)
         # One backoff schedule per slot, straight off RetryPolicy — the
         # repo's single backoff implementation. Seeded per slot so the
@@ -620,9 +811,14 @@ class ServingPool:
                 base_delay_s=restart_policy.base_delay_s,
                 max_delay_s=restart_policy.max_delay_s,
                 jitter=restart_policy.jitter, seed=i,
-            ).delays())
+            ).delays(), spec=WorkerSpec(0, initial_checkpoint))
             for i in range(workers)
         ]
+        # graftroll: the promotion/rollout controller (POST /promote on
+        # the control plane; scheduler/rollout.py). `fault_plan` is the
+        # chaos seam for the rollout.spawn/rollout.health sites.
+        self.rollout = RolloutController(self, fault_plan=fault_plan,
+                                         **(rollout_opts or {}))
         self.stable_after_s = stable_after_s
         self.poll_interval_s = poll_interval_s
         # Worker processes ARE the pool's parallelism: the default gives
@@ -758,7 +954,8 @@ class ServingPool:
             target=_worker_main,
             args=(slot.worker_id, self.workers, self._factory, self.shared,
                   self.host, self.port, self._listener, self.reuse_port,
-                  self._control_spec, self.blas_threads),
+                  self._control_spec, self.blas_threads, slot.spec,
+                  self._factory_takes_spec),
             daemon=False,
             name=f"graftserve-worker-{slot.worker_id}",
         )
@@ -806,7 +1003,12 @@ class ServingPool:
         while not self._shutdown.is_set():
             time.sleep(self.poll_interval_s)
             for slot in self._slots:
-                if slot.failed or slot.alive or self._shutdown.is_set():
+                if (slot.failed or slot.hold or slot.alive
+                        or self._shutdown.is_set()):
+                    # `hold`: the rollout controller is deliberately
+                    # replacing this worker — a "death" here is surgery,
+                    # not a crash, and a concurrent monitor respawn would
+                    # double-spawn the slot.
                     continue
                 uptime = time.monotonic() - slot.last_spawn
                 exitcode = (slot.process.exitcode
@@ -840,6 +1042,10 @@ class ServingPool:
                     len(slot.backoff))
                 if self._shutdown.wait(delay):
                     return
+                if slot.hold or slot.alive:
+                    # The rollout controller took the slot over during
+                    # the backoff wait; its replacement supersedes ours.
+                    continue
                 with self._lock:
                     self.restarts_total += 1
                 self._spawn(slot)
@@ -912,12 +1118,23 @@ class ServingPool:
             "restarts_total": restarts,
             "mode": "reuseport" if self.reuse_port else "inherit",
             "port": self.port,
+            "generation": self.generation,
+            "rollout": self.rollout.counters(),
         }
 
     def health(self) -> dict:
+        """Pool liveness body. ``rolling: true`` while a promote/rollback
+        is in flight: a pool that is briefly below strength because IT is
+        replacing a worker is healthy-by-design, and k8s liveness must
+        not kill the pod mid-rollout (the handler answers 200 for
+        ``rolling`` exactly as for ``ok``)."""
         status = self.status()
-        status["status"] = ("ok" if status["alive"] == status["workers"]
-                            else "degraded")
+        rolling = self.rollout.active
+        status["rolling"] = rolling
+        if status["alive"] == status["workers"]:
+            status["status"] = "ok"
+        else:
+            status["status"] = "rolling" if rolling else "degraded"
         return status
 
 
@@ -947,7 +1164,10 @@ class _PoolHandler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (stdlib API)
         if self.path == "/healthz":
             health = self.pool.health()
-            self._send(200 if health["status"] == "ok" else 503, health)
+            ok = health["status"] in ("ok", "rolling")
+            self._send(200 if ok else 503, health)
+        elif self.path == "/rollout":
+            self._send(200, self.pool.rollout.status())
         elif self.path == "/stats":
             pool = self.pool.status()
             snapshots = self.pool.scrape()
@@ -965,9 +1185,29 @@ class _PoolHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802
         length = int(self.headers.get("Content-Length", 0))
-        self.rfile.read(length)  # drain; reset takes no arguments
+        body = self.rfile.read(length)
         if self.path == "/stats/reset":
+            # Fans the ring-clear out; every lifetime counter — the
+            # merged histograms, trace records/drops/segments, and the
+            # promotion/rollback totals — stays monotonic (pinned by
+            # test; Prometheus rate() must never see a rewind).
             self._send(200, self.pool.reset_stats())
+        elif self.path == "/promote":
+            try:
+                payload = json.loads(body or b"{}")
+            except json.JSONDecodeError as exc:
+                self._send(400, {"error": f"bad json: {exc}"})
+                return
+            if not isinstance(payload, dict):
+                # Valid JSON that is not an object ('"abc"', '5') must
+                # get the same 400 contract, not an AttributeError that
+                # drops the connection responseless.
+                self._send(400, {"error": "pass a JSON object: "
+                                          '{"checkpoint": "<run_dir>"}'})
+                return
+            code, out = self.pool.rollout.request_promote(
+                payload.get("checkpoint"))
+            self._send(code, out)
         else:
             self._send(404, {"error": f"unknown path {self.path}"})
 
@@ -991,16 +1231,24 @@ def run_pool(build_kwargs: dict, workers: int, host: str, port: int,
     ``build_policy`` into a per-worker factory (each worker restores the
     checkpoint and compiles its own backend AFTER the fork — the
     supervisor never imports jax), start the pool, serve until
-    SIGTERM/SIGINT."""
+    SIGTERM/SIGINT. The factory is spec-aware (graftroll): a promoted
+    generation's workers build from the PROMOTED checkpoint, everything
+    else in the serve config unchanged, and each worker's decision trace
+    (``--trace-dir``) writes its own ``w<id>-`` stream."""
 
-    def factory(worker_id, shared):
+    def factory(worker_id, shared, spec):
         from rl_scheduler_tpu.scheduler.extender import (
             build_policy,
             check_warm_nodes_served,
         )
 
+        kwargs = dict(build_kwargs)
+        if spec.checkpoint is not None:
+            kwargs["run"] = spec.checkpoint
+        if kwargs.get("trace_dir") is not None:
+            kwargs["trace_prefix"] = f"w{worker_id}-"
         policy = build_policy(
-            **build_kwargs,
+            **kwargs,
             price_counter=shared.price_counter,
             table_counter=shared.table_counter,
         )
@@ -1014,7 +1262,8 @@ def run_pool(build_kwargs: dict, workers: int, host: str, port: int,
     pool = ServingPool(factory, workers=workers, host=host, port=port,
                        control_host=control_host if control_host is not None
                        else host,
-                       control_port=control_port, blas_threads=blas_threads)
+                       control_port=control_port, blas_threads=blas_threads,
+                       initial_checkpoint=build_kwargs.get("run"))
     pool.start()
 
     def _stop(signum, frame):  # noqa: ARG001 (signal API)

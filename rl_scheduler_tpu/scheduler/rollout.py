@@ -1,0 +1,516 @@
+"""graftroll part 2: zero-downtime policy rollout for the serving pool.
+
+The graftserve pool (scheduler/pool.py) can restart a DEAD worker, but
+the only way to serve a NEW checkpoint was to kill the whole pool. This
+module is the promotion path ROADMAP item 1(d) asks for: a
+generation-tracked rolling restart, canary-gated, with automatic
+rollback — the pool serves continuously while a checkpoint lands.
+
+``POST /promote {"checkpoint": <run_dir>}`` on the pool control plane:
+
+1. **Verify before touching anything.** The candidate is checked against
+   graftguard's integrity manifests (the same digests
+   ``utils/checkpoint.CheckpointManager.latest_verified_step`` trusts,
+   re-implemented here over plain hashlib/json so the supervisor stays
+   jax/orbax-free). A corrupt or unfinalized newest step REFUSES the
+   promote — a bad checkpoint is never partially rolled.
+2. **Single writer.** A second promote during an in-flight rollout is
+   refused (409) — non-blocking acquisition plus, when ``lock_dir`` is
+   set, the same ``O_CREAT|O_EXCL`` pidfile discipline as graftstudy's
+   runner lock (stale locks from dead pids are cleared).
+3. **Canary first.** One worker is respawned onto the new generation,
+   gated on joining the control plane alive plus ``probe_count`` warm-up
+   decision probes (a probe that fails open is a gate failure), then
+   held for ``canary_hold_s`` of live traffic while its latency-EWMA
+   (histogram mean over the hold window) and breaker/fail-open deltas
+   are compared against the incumbent workers.
+4. **Roll or roll back.** Surviving the canary gate promotes the rest
+   worker-by-worker (same spawn/health gates). ANY gate failure —
+   spawn error, death, failed probe, tripped breaker, latency blow-up —
+   rolls every already-promoted worker back onto the incumbent
+   generation and increments ``rollbacks_total``. The pool's generation
+   only advances after the LAST worker promotes, so a rollback restores
+   the incumbent by construction.
+
+Chaos seams (utils/faults.py): ``rollout.spawn`` fires as a respawn
+failure, ``rollout.health`` as a health-gate failure — both must take
+the rollback path, and the chaos suite asserts they fired.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+# Mirrors utils/checkpoint.py — duplicated as strings (not imported) so
+# the supervisor process never pulls orbax/jax just to verify digests.
+MANIFEST_DIR = "checkpoint_manifests"
+ROLLOUT_LOCK_NAME = "rollout.lock"
+
+IDLE = "idle"
+PROMOTING = "promoting"
+ROLLING_BACK = "rolling_back"
+STATE_CODES = {IDLE: 0, PROMOTING: 1, ROLLING_BACK: 2}
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerSpec:
+    """What one pool worker serves: the policy generation (monotonic,
+    bumped per successful promote) and the checkpoint run dir (``None``
+    = the factory's configured default). Slots carry their spec so the
+    supervisor's crash-restart path respawns a worker onto ITS
+    generation, mid-rollout included."""
+
+    generation: int = 0
+    checkpoint: str | None = None
+
+
+def _digest_file(path: Path) -> tuple[str, int]:
+    h = hashlib.sha256()
+    with path.open("rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest(), path.stat().st_size
+
+
+def verify_candidate(run_dir: str | Path) -> tuple[int | None, str]:
+    """``(verified_step, reason)`` for a promotion candidate; ``None``
+    step means REFUSE.
+
+    The newest checkpoint step must pass graftguard's manifest digests
+    (sha256 + size per file — the identical check
+    ``CheckpointManager.verify_step`` performs, minus the orbax
+    dependency). Unlike restore-time auto-selection this does NOT fall
+    back to an older step: the operator promoted THIS checkpoint, and
+    silently rolling out something older would lie. A manifest-less
+    newest step in a run that HAS manifests is an unfinalized save —
+    refused; a fully legacy run (no manifest dir at all) is accepted
+    with a logged warning, mirroring restore's legacy acceptance.
+    """
+    run_dir = Path(run_dir)
+    steps = sorted(
+        (int(d.name) for d in (run_dir / "checkpoints").glob("*")
+         if d.is_dir() and d.name.isdigit()),
+        reverse=True,
+    ) if (run_dir / "checkpoints").is_dir() else []
+    if not steps:
+        return None, f"no checkpoint steps under {run_dir}"
+    step = steps[0]
+    mpath = run_dir / MANIFEST_DIR / f"{step}.json"
+    if not mpath.exists():
+        if (run_dir / MANIFEST_DIR).is_dir():
+            return None, (f"newest step {step} has no integrity manifest "
+                          "(unfinalized save?) — refusing to roll it out")
+        logger.warning("promotion candidate %s has no integrity manifests "
+                       "(pre-graftguard run); promoting unverified", run_dir)
+        return step, "legacy"
+    try:
+        manifest = json.loads(mpath.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        return None, f"unreadable manifest for step {step}: {e}"
+    step_dir = run_dir / "checkpoints" / str(step)
+    want = manifest.get("files", {})
+    for rel, meta in sorted(want.items()):
+        path = step_dir / rel
+        if not path.is_file():
+            return None, f"step {step}: missing file {rel}"
+        sha, size = _digest_file(path)
+        if size != meta["size"]:
+            return None, (f"step {step}: {rel} size {size} != manifest "
+                          f"{meta['size']} (truncated write)")
+        if sha != meta["sha256"]:
+            return None, f"step {step}: {rel} sha256 mismatch (corrupt write)"
+    return step, "verified"
+
+
+class RolloutController:
+    """Promotion/rollout controller for one :class:`ServingPool`
+    (module doc). All mutation of pool slots happens on the controller's
+    background thread under the single-writer lock; the monitor skips
+    slots the controller holds (``slot.hold``), so deliberate
+    replacements are never raced by crash-restarts."""
+
+    def __init__(self, pool, fault_plan=None, canary_hold_s: float = 2.0,
+                 probe_count: int = 3, probe_timeout_s: float = 10.0,
+                 ready_timeout_s: float = 30.0,
+                 max_latency_ratio: float = 4.0,
+                 min_compare_requests: int = 20,
+                 lock_dir: str | Path | None = None):
+        self._pool = pool
+        self.fault_plan = fault_plan
+        self.canary_hold_s = canary_hold_s
+        self.probe_count = probe_count
+        self.probe_timeout_s = probe_timeout_s
+        self.ready_timeout_s = ready_timeout_s
+        self.max_latency_ratio = max_latency_ratio
+        self.min_compare_requests = min_compare_requests
+        self.lock_dir = Path(lock_dir) if lock_dir is not None else None
+        self._busy = threading.Lock()   # the single writer
+        self._state_lock = threading.Lock()
+        self.state = IDLE
+        self.phase = IDLE
+        self.candidate: str | None = None
+        self.target_generation: int | None = None
+        self.last_error: str | None = None
+        self.promotions_total = 0
+        self.rollbacks_total = 0
+        self.refusals_total = 0
+        self.conflicts_total = 0
+        # Warm-up probes that actually ran a decision in a worker: every
+        # one appends a trace record, so (client requests + probes_total)
+        # is the exact record count the drill's replay check expects.
+        self.probes_total = 0
+
+    # ------------------------------------------------------------ queries
+
+    @property
+    def active(self) -> bool:
+        return self.state != IDLE
+
+    def counters(self) -> dict:
+        """Lifetime rollout counters for /stats, /metrics and /healthz.
+        MONOTONIC: ``/stats/reset`` must never clear these (pinned by
+        test, mirroring the histogram rule)."""
+        with self._state_lock:
+            return {
+                "state": self.state,
+                "active": self.state != IDLE,
+                "promotions_total": self.promotions_total,
+                "rollbacks_total": self.rollbacks_total,
+                "refusals_total": self.refusals_total,
+                "conflicts_total": self.conflicts_total,
+                "probes_total": self.probes_total,
+            }
+
+    def status(self) -> dict:
+        """The ``GET /rollout`` body: state machine position plus the
+        per-worker generation map the drill reads."""
+        out = self.counters()
+        with self._state_lock:
+            out.update({
+                "phase": self.phase,
+                "candidate": self.candidate,
+                "target_generation": self.target_generation,
+                "last_error": self.last_error,
+            })
+        out["generation"] = self._pool.generation
+        out["checkpoint"] = self._pool.checkpoint
+        out["workers"] = [
+            {"worker_id": slot.worker_id,
+             "generation": slot.spec.generation,
+             "alive": slot.alive}
+            for slot in self._pool._slots
+        ]
+        return out
+
+    # ------------------------------------------------------------ promote
+
+    def request_promote(self, checkpoint) -> tuple[int, dict]:
+        """Validate + verify a candidate and launch the rollout thread.
+        Returns ``(http_status, body)``: 202 accepted (poll
+        ``GET /rollout``), 409 a rollout is in flight, 422 refused."""
+        if not checkpoint or not isinstance(checkpoint, str):
+            return 400, {"error": "pass {\"checkpoint\": \"<run_dir>\"}"}
+        run_dir = Path(checkpoint)
+        if not self._busy.acquire(blocking=False):
+            with self._state_lock:
+                self.conflicts_total += 1
+            return 409, {"error": "a rollout is already in flight "
+                                  "(single-writer; retry after it lands)",
+                         "state": self.state}
+        lock_file = None
+        try:
+            lock_file = self._acquire_lock_file()
+        except RuntimeError as e:
+            with self._state_lock:
+                self.conflicts_total += 1
+            self._busy.release()
+            return 409, {"error": str(e)}
+        step, reason = (None, f"checkpoint dir {run_dir} does not exist") \
+            if not run_dir.is_dir() else verify_candidate(run_dir)
+        if step is None:
+            with self._state_lock:
+                self.refusals_total += 1
+                self.last_error = f"promote refused: {reason}"
+            self._release_lock_file(lock_file)
+            self._busy.release()
+            logger.error("promote of %s refused: %s", checkpoint, reason)
+            return 422, {"error": f"promote refused: {reason}"}
+        target = self._pool.generation + 1
+        with self._state_lock:
+            self.state = PROMOTING
+            self.phase = "verify"
+            self.candidate = str(run_dir)
+            self.target_generation = target
+            self.last_error = None
+        threading.Thread(
+            target=self._run_promote, args=(run_dir, target, lock_file),
+            daemon=True, name="graftroll-promote",
+        ).start()
+        return 202, {"status": "promoting", "target_generation": target,
+                     "verified_step": step, "verification": reason}
+
+    def _acquire_lock_file(self) -> Path | None:
+        """graftstudy's runner-lock discipline, when a ``lock_dir`` is
+        configured: exclusive-create a pidfile, clearing stale locks
+        from dead pids (the shared ``utils/pidlock.py`` implementation);
+        a live holder refuses the promote."""
+        if self.lock_dir is None:
+            return None
+        from rl_scheduler_tpu.utils.pidlock import acquire_pidfile_lock
+
+        self.lock_dir.mkdir(parents=True, exist_ok=True)
+        return acquire_pidfile_lock(
+            self.lock_dir / ROLLOUT_LOCK_NAME,
+            "a rollout is already in flight (pid {pid} holds {lock}); "
+            "a second writer would interleave worker restarts")
+
+    @staticmethod
+    def _release_lock_file(lock_file: Path | None) -> None:
+        if lock_file is not None:
+            lock_file.unlink(missing_ok=True)
+
+    # ------------------------------------------------------ rollout thread
+
+    def _run_promote(self, run_dir: Path, target: int,
+                     lock_file: Path | None) -> None:
+        pool = self._pool
+        incumbent = WorkerSpec(pool.generation, pool.checkpoint)
+        new_spec = WorkerSpec(target, str(run_dir))
+        promoted: list = []
+        in_flight = None
+        try:
+            for slot in pool._slots:
+                if slot.failed:
+                    continue  # a slot the supervisor gave up on stays down
+                is_canary = not promoted
+                in_flight = slot
+                slot.hold = True
+                try:
+                    ok, why = self._replace(slot, new_spec)
+                    if ok and is_canary:
+                        ok, why = self._canary_gate(slot)
+                finally:
+                    # The hold MUST clear even if a gate crashes: a
+                    # leaked hold makes the monitor skip this slot
+                    # forever (a later worker death would never restart).
+                    slot.hold = False
+                if not ok:
+                    self._rollback(promoted + [slot], incumbent,
+                                   f"worker {slot.worker_id}: {why}")
+                    return
+                promoted.append(slot)
+                in_flight = None
+            # Generation advances only now: every worker serves the new
+            # checkpoint, so a crash-restart respawns onto it too.
+            pool.generation = target
+            pool.checkpoint = new_spec.checkpoint
+            with self._state_lock:
+                self.promotions_total += 1
+                self.state = IDLE
+                self.phase = IDLE
+            logger.info("promoted pool to generation %d (%s)", target,
+                        run_dir)
+        except Exception as e:  # noqa: BLE001 — a rollout crash must
+            # still try to restore the incumbent, never leave a mixed
+            # pool: the in-flight slot may already serve the candidate
+            # generation, so it rolls back with the promoted ones.
+            logger.exception("rollout to generation %d crashed", target)
+            touched = promoted + ([in_flight] if in_flight is not None
+                                  else [])
+            self._rollback(touched, incumbent, f"rollout crashed: {e}")
+        finally:
+            with self._state_lock:
+                self.candidate = None
+                self.target_generation = None
+            self._release_lock_file(lock_file)
+            self._busy.release()
+
+    def _replace(self, slot, spec: WorkerSpec,
+                 gate: bool = True) -> tuple[bool, str]:
+        """Terminate one worker and respawn it onto ``spec``; with
+        ``gate`` (every promote-path replace) the new worker must join
+        the control plane and answer warm-up decision probes. The caller
+        holds ``slot.hold``."""
+        pool = self._pool
+        if pool._shutdown.is_set():
+            # The supervisor is tearing the pool down: spawning now
+            # would fork orphan workers onto a closed control plane.
+            return False, "pool is shutting down"
+        with self._state_lock:
+            self.phase = f"replace:{slot.worker_id}"
+        proc = slot.process
+        if proc is not None and proc.is_alive():
+            proc.terminate()
+            proc.join(timeout=10.0)
+            if proc.is_alive():
+                proc.kill()
+                proc.join(timeout=10.0)
+        with slot.conn_lock:
+            if slot.conn is not None:
+                slot.conn.close()
+                slot.conn = None
+        if self.fault_plan is not None:
+            try:
+                self.fault_plan.check("rollout.spawn", RuntimeError)
+            except RuntimeError as e:
+                slot.spec = spec  # the slot is down either way; record
+                # what it WOULD have served so rollback restores it
+                return False, f"spawn failed: {e}"
+        slot.spec = spec
+        try:
+            pool._spawn(slot)
+        except Exception as e:  # noqa: BLE001 — fork/exec can fail for
+            # host reasons (fd limits); a failed spawn is a gate failure
+            logger.exception("rollout spawn of worker %d failed",
+                             slot.worker_id)
+            return False, f"spawn failed: {e}"
+        deadline = time.monotonic() + self.ready_timeout_s
+        while time.monotonic() < deadline:
+            if not slot.alive:
+                return False, (f"worker died during spawn (exitcode "
+                               f"{slot.process.exitcode})")
+            with slot.conn_lock:
+                joined = slot.conn is not None
+            if joined:
+                break
+            time.sleep(0.02)
+        else:
+            return False, (f"worker not on the control plane after "
+                           f"{self.ready_timeout_s:.0f}s")
+        if not gate:
+            return True, ""
+        with self._state_lock:
+            self.phase = f"gate:{slot.worker_id}"
+        if self.fault_plan is not None:
+            try:
+                self.fault_plan.check("rollout.health", RuntimeError)
+            except RuntimeError as e:
+                return False, f"health gate failed: {e}"
+        for k in range(self.probe_count):
+            ack = pool._command(slot, "probe", self.probe_timeout_s)
+            if ack is None or not ack.get("ok"):
+                return False, f"warm-up probe {k + 1} got no answer"
+            with self._state_lock:
+                self.probes_total += 1
+            if not ack.get("decided"):
+                return False, (f"warm-up probe {k + 1} failed open — the "
+                               "new checkpoint is not deciding")
+        return True, ""
+
+    def _canary_gate(self, slot) -> tuple[bool, str]:
+        """Hold the canary under live traffic and compare it against the
+        incumbents: it must stay alive, trip no breakers, add no
+        fail-opens, and (when both sides served enough requests to
+        compare) keep its mean decision latency within
+        ``max_latency_ratio`` of the incumbent pool's over the window."""
+        pool = self._pool
+        with self._state_lock:
+            self.phase = "canary_hold"
+        start = pool._command(slot, "snapshot", self.probe_timeout_s)
+        others = [s for s in pool._slots if s is not slot and s.alive]
+        inc_start = [snap for s in others
+                     if (snap := pool._command(s, "snapshot",
+                                               self.probe_timeout_s))]
+        deadline = time.monotonic() + self.canary_hold_s
+        while time.monotonic() < deadline:
+            if not slot.alive:
+                return False, "canary died during the hold"
+            time.sleep(min(0.05, max(deadline - time.monotonic(), 0.0)))
+        if not slot.alive:
+            return False, "canary died during the hold"
+        end = pool._command(slot, "snapshot", self.probe_timeout_s)
+        if start is None or end is None:
+            return False, "canary stopped answering snapshots"
+        opens = (_breaker_opens(end) - _breaker_opens(start))
+        if opens > 0:
+            return False, f"canary tripped {opens} breaker open(s)"
+        fails = (_fail_opens(end) - _fail_opens(start))
+        if fails > 0:
+            return False, f"canary failed open {fails} time(s)"
+        inc_end = [snap for s in others
+                   if (snap := pool._command(s, "snapshot",
+                                             self.probe_timeout_s))]
+        c_mean, c_count = _window_mean(start, end)
+        i_mean, i_count = _pool_window_mean(inc_start, inc_end)
+        if (c_count >= self.min_compare_requests
+                and i_count >= self.min_compare_requests
+                and i_mean > 0.0 and c_mean > self.max_latency_ratio * i_mean):
+            return False, (f"canary latency regressed: {c_mean * 1e3:.2f} ms "
+                           f"mean vs incumbent {i_mean * 1e3:.2f} ms over "
+                           "the hold window")
+        return True, ""
+
+    def _rollback(self, slots: list, incumbent: WorkerSpec,
+                  why: str) -> None:
+        """Respawn every touched worker onto the incumbent spec. Gates
+        are skipped (the incumbent already proved itself); a respawn
+        failure here releases the slot to the supervisor's monitor,
+        which retries on its backoff with the incumbent spec."""
+        with self._state_lock:
+            self.state = ROLLING_BACK
+            self.phase = "rollback"
+            self.last_error = why
+        logger.error("rolling back: %s", why)
+        for slot in slots:
+            if self._pool._shutdown.is_set():
+                logger.warning("pool shutdown during rollback; leaving "
+                               "worker %d down", slot.worker_id)
+                continue
+            slot.hold = True
+            ok, detail = self._replace(slot, incumbent, gate=False)
+            slot.hold = False
+            if not ok:
+                logger.error(
+                    "rollback respawn of worker %d failed (%s); the "
+                    "supervisor's restart schedule takes over",
+                    slot.worker_id, detail)
+        with self._state_lock:
+            self.rollbacks_total += 1
+            self.state = IDLE
+            self.phase = IDLE
+        logger.warning("rollback complete; pool stays on generation %d",
+                       self._pool.generation)
+
+
+def _breaker_opens(snapshot: dict) -> int:
+    return sum(b.get("opens_total", 0)
+               for b in snapshot["stats"].get("breakers", {}).values())
+
+
+def _fail_opens(snapshot: dict) -> int:
+    return int(snapshot["stats"].get("fail_open_total", 0))
+
+
+def _window_mean(start: dict, end: dict) -> tuple[float, int]:
+    """Mean decision latency (seconds) and request count over the window
+    between two snapshots of ONE worker, from the lifetime histogram
+    deltas (exact — sums and counts are monotone counters)."""
+    d_sum = end["histogram"]["sum"] - start["histogram"]["sum"]
+    d_count = end["histogram"]["count"] - start["histogram"]["count"]
+    return (d_sum / d_count if d_count > 0 else 0.0), max(d_count, 0)
+
+
+def _pool_window_mean(starts: list, ends: list) -> tuple[float, int]:
+    """The incumbents' request-weighted window mean: per-worker deltas
+    joined on worker_id (a worker that answered only one side of the
+    window contributes nothing — no torn deltas)."""
+    by_id = {s["worker_id"]: s for s in starts}
+    total_sum = 0.0
+    total_count = 0
+    for end in ends:
+        start = by_id.get(end["worker_id"])
+        if start is None:
+            continue
+        total_sum += end["histogram"]["sum"] - start["histogram"]["sum"]
+        total_count += end["histogram"]["count"] - start["histogram"]["count"]
+    return (total_sum / total_count if total_count > 0 else 0.0), \
+        max(total_count, 0)

@@ -180,6 +180,12 @@ class TableTelemetry:
         self._counter = counter
         self._step = 0
         self._lock = threading.Lock()
+        # Per-thread record of the raw position the LAST observation on
+        # that thread consumed (last_replay_position): the trace log's
+        # provenance field must name the row a decision actually
+        # observed, which a shared "current position" cannot do under
+        # concurrent serving.
+        self._local = threading.local()
 
     @classmethod
     def from_table(cls, data_path: str | None = None, cpu_source=None,
@@ -192,11 +198,24 @@ class TableTelemetry:
 
     def _next_idx(self) -> int:
         if self._counter is not None:
-            return self._counter.next_index() % len(self.costs)
-        with self._lock:
-            idx = self._step % len(self.costs)
-            self._step += 1
-        return idx
+            raw = self._counter.next_index()
+        else:
+            with self._lock:
+                raw = self._step
+                self._step += 1
+        self._local.raw = raw
+        return raw % len(self.costs)
+
+    def last_replay_position(self) -> int | None:
+        """The RAW monotonic position (no ``% len``) consumed by THIS
+        thread's most recent observation — the trace log's
+        telemetry-epoch provenance field (scheduler/tracelog.py).
+        Thread-local on purpose: under concurrent serving a shared
+        "current position" names whatever row some OTHER request just
+        consumed, but a replayed decision must join back to the exact
+        row it observed. ``None`` before the thread's first
+        observation."""
+        return getattr(self._local, "raw", None)
 
     def observe(self) -> np.ndarray:
         idx = self._next_idx()

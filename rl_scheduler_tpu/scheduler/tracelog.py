@@ -1,0 +1,317 @@
+"""graftroll part 1: the durable decision/outcome trace log.
+
+ROADMAP item 1 wants a scheduler that retrains on what it serves; today
+nothing durable records what the serving plane decided, so there is no
+trace to ever retrain from. This module is the record: every extender
+decision appends ONE schema-versioned JSONL record (observation digest +
+telemetry replay position, candidate count, chosen node, score, latency,
+breaker/fail-open state, worker id, policy generation) through a
+crash-safe rotating writer whose hot-path cost is one observation
+digest (hashed at the source so it fingerprints exactly what was
+served) plus one bounded-queue ``put_nowait``:
+
+- **The hot path never blocks.** ``append`` enqueues onto a bounded
+  queue; on overflow the OLDEST queued record drops and is counted
+  (``dropped_total``) — the same backpressure policy as the extender's
+  ``AsyncPlacer``. A background writer thread drains the queue, so disk
+  latency is never decision latency.
+- **Crash-safe segments.** The writer appends to an active
+  ``*.jsonl.part`` file (flushed per record, so a SIGKILL loses only the
+  in-queue tail, never flushed lines) and seals it at
+  ``max_records_per_segment`` by fsync-then-rename to ``*.jsonl`` — the
+  tmp-then-rename discipline graftguard's checkpoint manifests use: a
+  sealed segment is whole by construction. A ``.part`` file orphaned by
+  a crash is sealed at the next startup (recovery, not loss).
+- **Chaos seam.** ``fault_plan`` site ``tracelog.append`` (utils/faults)
+  fires inside the writer: a failed write is counted
+  (``write_errors_total``) and the record dropped — the serving thread
+  never sees storage errors.
+- **Observability.** ``snapshot()`` exports the monotonic counters the
+  pool aggregates onto ``/stats``/``/metrics`` (``records``, ``dropped``,
+  ``write_errors``, ``segments``); like every lifetime counter here,
+  ``/stats/reset`` never clears them.
+
+``iter_trace`` replays a trace directory in write order (sealed segments
+then active parts) — the seam the planned trace→Scenario compiler reads.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import queue
+import re
+import threading
+import time
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+TRACE_SCHEMA = 1
+_SEG_RE = re.compile(r"^(?P<prefix>.*?)seg-(?P<seq>\d{6})\.jsonl(?P<part>\.part)?$")
+_SENTINEL = object()
+
+
+def obs_digest(obs) -> str | None:
+    """Short stable digest of a finished observation array (the record's
+    provenance key — small enough to log per decision, strong enough to
+    join a replayed decision back to its exact inputs)."""
+    if obs is None:
+        return None
+    import numpy as np
+
+    arr = np.ascontiguousarray(np.asarray(obs))
+    return hashlib.sha256(arr.tobytes()).hexdigest()[:16]
+
+
+def decision_record(*, endpoint: str, family: str, backend: str,
+                    candidates: int, chosen: str | None,
+                    score: float | None, latency_ms: float,
+                    obs=None, telemetry_pos: int | None = None,
+                    worker_id: int | None = None, generation: int = 0,
+                    fail_open: bool = False,
+                    breaker_state: str | None = None) -> dict:
+    """One schema-versioned trace record. Kept a plain dict (JSONL is the
+    contract, not a class) — ``schema`` gates future field changes the
+    way the bench's ``schema_version`` does."""
+    return {
+        "schema": TRACE_SCHEMA,
+        "ts": round(time.time(), 6),
+        "worker": worker_id,
+        "generation": generation,
+        "endpoint": endpoint,
+        "family": family,
+        "backend": backend,
+        "obs_sha": obs_digest(obs),
+        "telemetry_pos": telemetry_pos,
+        "candidates": candidates,
+        "chosen": chosen,
+        "score": None if score is None else round(float(score), 6),
+        "latency_ms": round(latency_ms, 4),
+        "fail_open": bool(fail_open),
+        "breaker": breaker_state,
+    }
+
+
+class TraceLog:
+    """Crash-safe rotating JSONL writer for decision records (module doc).
+
+    ``prefix`` namespaces one writer's segments inside a shared directory
+    (graftserve gives each pool worker ``w<id>-`` so workers never
+    contend on a file); ``autostart=False`` leaves the writer thread
+    unstarted until :meth:`start` (tests exercise the backpressure
+    policy that way — production never passes it).
+    """
+
+    def __init__(self, trace_dir: str | Path, prefix: str = "",
+                 max_records_per_segment: int = 4096,
+                 max_queue: int = 1024, fault_plan=None,
+                 autostart: bool = True):
+        if max_records_per_segment < 1:
+            raise ValueError(
+                f"max_records_per_segment={max_records_per_segment}: "
+                "pass at least 1")
+        if max_queue < 1:
+            raise ValueError(f"max_queue={max_queue}: pass at least 1")
+        self.trace_dir = Path(trace_dir)
+        self.trace_dir.mkdir(parents=True, exist_ok=True)
+        self.prefix = prefix
+        self.max_records_per_segment = max_records_per_segment
+        self.fault_plan = fault_plan
+        self._queue: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._lock = threading.Lock()
+        self._appended = 0
+        self._written = 0
+        self._dropped = 0
+        self._write_errors = 0
+        self._sealed = 0
+        self._active_records = 0
+        self._closed = False
+        self._fh = None
+        self._part_path: Path | None = None
+        self._seq = self._recover()
+        self._thread: threading.Thread | None = None
+        if autostart:
+            self.start()
+
+    # ------------------------------------------------------------ hot path
+
+    def append(self, record: dict) -> bool:
+        """Enqueue one record; NEVER blocks. Returns False when the
+        record (or an older one) was dropped to make room — the counted
+        drop-oldest policy, so a wedged disk degrades the trace, not the
+        decision latency."""
+        if self._closed:
+            return False
+        clean = True
+        while True:
+            try:
+                self._queue.put_nowait(record)
+                with self._lock:
+                    self._appended += 1
+                return clean
+            except queue.Full:
+                try:
+                    self._queue.get_nowait()
+                    with self._lock:
+                        self._dropped += 1
+                    clean = False
+                except queue.Empty:
+                    pass
+
+    # ------------------------------------------------------------- writer
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._drain, daemon=True,
+                                            name="tracelog-writer")
+            self._thread.start()
+
+    def _recover(self) -> int:
+        """Seal any ``.part`` orphaned by a previous writer's crash (the
+        flushed lines are intact — rename publishes them) and return the
+        next segment sequence number for this prefix."""
+        max_seq = 0
+        for path in sorted(self.trace_dir.iterdir()):
+            m = _SEG_RE.match(path.name)
+            if m is None or m.group("prefix") != self.prefix:
+                continue
+            max_seq = max(max_seq, int(m.group("seq")))
+            if m.group("part"):
+                sealed = path.with_name(path.name[:-len(".part")])
+                try:
+                    path.replace(sealed)
+                    logger.warning("tracelog: sealed orphaned segment %s "
+                                   "from a previous writer", sealed.name)
+                except OSError:
+                    logger.exception("tracelog: could not recover %s", path)
+        return max_seq + 1
+
+    def _open_part(self) -> None:
+        self._part_path = self.trace_dir / (
+            f"{self.prefix}seg-{self._seq:06d}.jsonl.part")
+        self._fh = self._part_path.open("a", encoding="utf-8")
+        self._active_records = 0
+
+    def _seal(self) -> None:
+        """fsync-then-rename the active part into a sealed segment —
+        after the rename the segment is immutable and whole."""
+        if self._fh is None:
+            return
+        try:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._fh.close()
+            final = self._part_path.with_name(self._part_path.name[:-len(".part")])
+            self._part_path.replace(final)
+            with self._lock:
+                self._sealed += 1
+        except OSError:
+            logger.exception("tracelog: sealing %s failed", self._part_path)
+            with self._lock:
+                self._write_errors += 1
+        self._fh = None
+        self._part_path = None
+        self._seq += 1
+        self._active_records = 0
+
+    def _drain(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is _SENTINEL:
+                return
+            try:
+                if self.fault_plan is not None:
+                    # Simulated disk-full mid-append: the exact family a
+                    # failed write(2) raises. Counted, record dropped,
+                    # writer keeps serving the queue.
+                    self.fault_plan.check("tracelog.append", OSError)
+                if self._fh is None:
+                    self._open_part()
+                self._fh.write(json.dumps(item, separators=(",", ":"))
+                               + "\n")
+                # Flush per record: a killed worker loses the in-queue
+                # tail only, never lines already handed to the OS.
+                self._fh.flush()
+            except OSError:
+                with self._lock:
+                    self._write_errors += 1
+                continue
+            with self._lock:
+                self._written += 1
+            self._active_records += 1
+            if self._active_records >= self.max_records_per_segment:
+                self._seal()
+
+    def close(self) -> None:
+        """Drain the queue, seal the active segment, stop the writer.
+        After close every record ever written lives in a sealed
+        ``*.jsonl`` segment."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread is not None:
+            while True:
+                try:
+                    self._queue.put_nowait(_SENTINEL)
+                    break
+                except queue.Full:  # drop-oldest to guarantee shutdown
+                    try:
+                        self._queue.get_nowait()
+                        with self._lock:
+                            self._dropped += 1
+                    except queue.Empty:
+                        pass
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        self._seal()
+
+    # -------------------------------------------------------------- stats
+
+    def snapshot(self) -> dict:
+        """Monotonic lifetime counters for /stats and /metrics export
+        (``/stats/reset`` must never clear these — same contract as the
+        latency histograms)."""
+        with self._lock:
+            return {
+                "records_total": self._appended,
+                "written_total": self._written,
+                "dropped_total": self._dropped,
+                "write_errors_total": self._write_errors,
+                "segments_total": self._sealed,
+            }
+
+
+def iter_trace(trace_dir: str | Path, prefix: str | None = None):
+    """Replay every record under ``trace_dir`` in write order: sealed
+    segments first (by name — prefix then sequence), then active/orphan
+    ``.part`` files. A torn trailing line (writer killed mid-write) is
+    skipped, not fatal — a replayer must read a crashed worker's trace.
+    ``prefix`` filters to one writer's stream."""
+    trace_dir = Path(trace_dir)
+    if not trace_dir.is_dir():
+        return
+    sealed, parts = [], []
+    for path in sorted(trace_dir.iterdir()):
+        m = _SEG_RE.match(path.name)
+        if m is None:
+            continue
+        if prefix is not None and m.group("prefix") != prefix:
+            continue
+        (parts if m.group("part") else sealed).append(path)
+    for path in sealed + parts:
+        try:
+            with path.open("r", encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except json.JSONDecodeError:
+                        logger.warning("tracelog: skipping torn line in %s",
+                                       path.name)
+        except OSError:
+            logger.exception("tracelog: unreadable segment %s", path)

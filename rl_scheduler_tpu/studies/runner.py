@@ -33,6 +33,7 @@ from pathlib import Path
 
 from rl_scheduler_tpu.studies.ledger import StudyLedger
 from rl_scheduler_tpu.studies.spec import StudySpec, TrialSpec
+from rl_scheduler_tpu.utils.pidlock import acquire_pidfile_lock, read_live_pid
 
 logger = logging.getLogger(__name__)
 
@@ -42,53 +43,26 @@ WORKER_PID_NAME = "worker.pid"
 RUNNER_PID_NAME = "runner.pid"
 
 
-def _pid_alive(pid: int) -> bool:
-    try:
-        os.kill(pid, 0)
-    except ProcessLookupError:
-        return False
-    except PermissionError:
-        return True
-    return True
-
-
-def _read_live_pid(path: Path) -> int | None:
-    """The pid recorded in a lock/pid file, IF that process is alive —
-    the one parse+liveness implementation behind the runner lock, the
-    orphaned-worker guard, and the CLI's --fresh refusal."""
-    if not path.exists():
-        return None
-    try:
-        pid = int(path.read_text().strip() or 0)
-    except (ValueError, OSError):
-        return None
-    return pid if pid and _pid_alive(pid) else None
+# The pidfile parse+liveness check behind the runner lock, the
+# orphaned-worker guard, and the CLI's --fresh refusal — shared with
+# graftroll's promotion lock (one implementation, utils/pidlock.py).
+_read_live_pid = read_live_pid
 
 
 def acquire_runner_lock(study_dir: str | Path) -> Path:
     """Take the study dir's single-writer lock via exclusive create
-    (stale locks from dead pids are cleared and retried). Raises
-    RuntimeError naming the live holder otherwise. The one acquisition
-    path for both ``StudyRunner.run`` and the CLI's ``--fresh`` (which
-    must hold the lock BEFORE deleting the dir, or a runner started in
-    the check-to-rmtree window loses its ledger mid-run)."""
-    lock = Path(study_dir) / RUNNER_PID_NAME
-    while True:
-        try:
-            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-            os.write(fd, str(os.getpid()).encode())
-            os.close(fd)
-            return lock
-        except FileExistsError:
-            pid = _read_live_pid(lock)
-            if pid is not None:
-                raise RuntimeError(
-                    f"study dir {study_dir} is already being run by pid "
-                    f"{pid} ({lock}); a second writer would corrupt its "
-                    "in-flight trial dirs — wait for it or kill it first")
-            # Stale (dead pid / unreadable): clear and retry the
-            # exclusive create.
-            lock.unlink(missing_ok=True)
+    (stale locks from dead pids are cleared and retried; the O_EXCL
+    discipline lives in ``utils/pidlock.py``, shared with graftroll's
+    promotion lock). Raises RuntimeError naming the live holder
+    otherwise. The one acquisition path for both ``StudyRunner.run``
+    and the CLI's ``--fresh`` (which must hold the lock BEFORE deleting
+    the dir, or a runner started in the check-to-rmtree window loses
+    its ledger mid-run)."""
+    return acquire_pidfile_lock(
+        Path(study_dir) / RUNNER_PID_NAME,
+        f"study dir {study_dir} is already being run by pid {{pid}} "
+        "({lock}); a second writer would corrupt its in-flight trial "
+        "dirs — wait for it or kill it first")
 
 _CFG_KEYS = ("num_envs", "rollout_steps", "minibatch_size", "num_epochs",
              "lr", "gamma", "entropy_coeff", "clip_eps", "compute_dtype",

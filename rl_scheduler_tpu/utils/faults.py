@@ -50,8 +50,20 @@ logger = logging.getLogger(__name__)
 #                      decide which nodes get preempted when — the same
 #                      seeded per-site stream discipline, reused so a churn
 #                      schedule is reproducible from (seed, rate) alone
+#   tracelog.append    the trace log's segment write raises OSError (disk
+#                      full mid-append) — the record is counted dropped,
+#                      the hot decision path never sees the error
+#                      (scheduler/tracelog.py)
+#   rollout.spawn      a rollout-driven worker respawn fails before fork —
+#                      the promotion gate must treat the slot as failed and
+#                      roll already-promoted workers back
+#                      (scheduler/rollout.py)
+#   rollout.health     a respawned worker's health/warm-up gate reports
+#                      failure — same rollback obligation as a real dead
+#                      canary (scheduler/rollout.py)
 SITES = ("checkpoint.save", "checkpoint.partial", "telemetry.scrape",
-         "k8s.place", "backend.decide", "preempt", "scenario.churn")
+         "k8s.place", "backend.decide", "preempt", "scenario.churn",
+         "tracelog.append", "rollout.spawn", "rollout.health")
 
 
 class FaultInjected(RuntimeError):

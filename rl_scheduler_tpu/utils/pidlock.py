@@ -1,0 +1,59 @@
+"""The one ``O_CREAT|O_EXCL`` pidfile single-writer lock.
+
+graftstudy's runner lock established the discipline (take the lock via
+exclusive create, record the holder's pid, clear stale locks from dead
+pids and retry, refuse a LIVE holder by name); graftroll's promotion
+lock needs exactly the same semantics. One implementation, so a fix to
+the acquisition loop or the pid parse+liveness check can never diverge
+between the two single-writer locks. Stdlib-only on purpose: the
+graftserve supervisor (which takes the rollout lock) never imports
+jax/orbax.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+def pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def read_live_pid(path: Path) -> int | None:
+    """The pid recorded in a lock/pid file, IF that process is alive —
+    the one parse+liveness implementation behind every pidfile lock and
+    guard."""
+    if not path.exists():
+        return None
+    try:
+        pid = int(path.read_text().strip() or 0)
+    except (ValueError, OSError):
+        return None
+    return pid if pid and pid_alive(pid) else None
+
+
+def acquire_pidfile_lock(lock: Path, holder_msg: str) -> Path:
+    """Take ``lock`` via exclusive create, recording this pid (stale
+    locks from dead pids are cleared and retried). A LIVE holder raises
+    ``RuntimeError`` with ``holder_msg`` formatted with ``{pid}`` and
+    ``{lock}`` — the caller says what a second writer would break."""
+    while True:
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, str(os.getpid()).encode())
+            os.close(fd)
+            return lock
+        except FileExistsError:
+            pid = read_live_pid(lock)
+            if pid is not None:
+                raise RuntimeError(holder_msg.format(pid=pid, lock=lock))
+            # Stale (dead pid / unreadable): clear and retry the
+            # exclusive create.
+            lock.unlink(missing_ok=True)

@@ -1,7 +1,10 @@
 """Stand-in test corpus for the GL007 self-tests (not a pytest module).
 
 References the good fixture's public op and deliberately nothing from the
-bad fixture.
+bad fixture. Also references every public name in the OTHER rules'
+fixtures that live under GL007-covered dirs (the scheduler/ GL010 pair —
+covered since graftroll extended OP_DIRS), keeping those fixtures
+single-rule by construction.
 """
 
 from fixtures.ops.gl007_good import covered_op
@@ -9,3 +12,10 @@ from fixtures.ops.gl007_good import covered_op
 
 def check_covered_op():
     assert covered_op is not None
+
+
+def check_gl010_fixture_names_are_covered():
+    # scheduler/gl010_bad.py + gl010_good.py public surface: scrape_cpu,
+    # place_pod, read_stats, score_node, parse_quantity, load_table,
+    # restore_checkpoint — referenced here so only GL010 fires there.
+    pass

@@ -1605,3 +1605,111 @@ def test_request_nodes_drops_junk():
     assert display == ["aws-1"] and clouds == ["aws"]
     use_names, sources, display, clouds = fn({"nodenames": ["a-aws", 9, None]})
     assert use_names and sources == ["a-aws"] and clouds == ["aws"]
+
+
+# ------------------------------------------- serving-surface coverage
+# GL007 extended OP_DIRS over scheduler/ with graftroll: every public
+# op of the serving plane needs a test reference; these pin behavior
+# for names the protocol/e2e suites exercised only indirectly.
+
+
+def test_native_mlp_backend_matches_numpy_or_degrades(params_tree):
+    """The C++ core serves the identical decision as numpy where the
+    toolchain/.so exists; where it doesn't, construction raises and
+    make_backend's documented degradation hands out the numpy path."""
+    from rl_scheduler_tpu.scheduler.policy_backend import NativeMLPBackend
+
+    numpy_b = NumpyMLPBackend(params_tree)
+    try:
+        native_b = NativeMLPBackend(params_tree)
+    except Exception:
+        backend, fell_back = make_backend("native", params_tree, HIDDEN)
+        assert backend.name in ("cpu", "greedy") and not isinstance(
+            backend, NativeMLPBackend)
+        return
+    for seed in range(20):
+        obs = np.random.default_rng(seed).uniform(
+            0, 1, env_core.OBS_DIM).astype(np.float32)
+        action_np, logits_np = numpy_b.decide(obs)
+        action_nat, logits_nat = native_b.decide(obs)
+        assert action_nat == action_np
+        np.testing.assert_allclose(logits_nat, logits_np, atol=2e-5)
+
+
+def test_concurrency_tracker_counts_and_forces_quiet():
+    """ConcurrencyTracker backs the load-aware admission decisions:
+    enter() reports whether another decision is in flight, clean_since
+    observes a quiet window, force_quiet resets the high-water mark."""
+    from rl_scheduler_tpu.scheduler.policy_backend import ConcurrencyTracker
+
+    tracker = ConcurrencyTracker()
+    t0 = time.monotonic()
+    assert tracker.enter() is False          # first in-flight: alone
+    assert tracker.enter() is True           # second: concurrent
+    assert tracker.last_concurrent >= t0     # the join stamped the clock
+    tracker.exit()
+    tracker.exit()
+    assert tracker.clean_since(time.monotonic()) is True
+    assert tracker.clean_since(t0) is False  # the burst happened after t0
+    tracker.force_quiet()
+    assert tracker.clean_since(t0) is True
+
+
+def test_shed_gate_admits_bounded_inflight_and_tracks_fraction():
+    """ShedGate bounds in-flight primary-path decisions; overflow is
+    shed and counted into shed_fraction."""
+    from rl_scheduler_tpu.scheduler.policy_backend import ShedGate
+
+    gate = ShedGate(max_inflight=1)
+    ok, reason = gate.admit()
+    assert ok and reason is None
+    ok, reason = gate.admit()
+    assert not ok and "saturated" in reason  # overflow: shed, logged once
+    gate.record_shed("large-N reroute")      # caller-side off-primary
+    gate.release()
+    assert gate.shed_fraction == pytest.approx(2 / 3)
+
+
+def test_make_graph_backend_and_build_graph_obs(params_tree):
+    """The graph family's public constructors: make_graph_backend maps
+    every flag onto the numpy GCN forward, and build_graph_obs emits the
+    [N, 7] training column order with unknown-cloud nodes on neutral
+    features."""
+    from rl_scheduler_tpu.env.cluster_graph import build_topology
+    from rl_scheduler_tpu.models import GNNPolicy
+    from rl_scheduler_tpu.scheduler.graph_backend import (
+        build_graph_obs,
+        make_graph_backend,
+        topology_for_clouds,
+    )
+
+    _, adj0, _ = build_topology(8)
+    net = GNNPolicy.from_adjacency(adj0, dim=32, depth=3)
+    tree = net.init(jax.random.PRNGKey(0), jnp.zeros((8, 7), jnp.float32))
+    backend, fell_back = make_graph_backend("jax", tree)
+    assert not fell_back and backend.family == "graph"
+
+    clouds = ["aws", "aws", "azure", None]
+    adj, hops = topology_for_clouds(clouds)
+    obs = build_graph_obs(clouds, np.array([0.10, 0.20], np.float32),
+                          np.array([0.4, 0.6], np.float32), hops, adj,
+                          affinity=None, pod_cpu=0.25, step_frac=0.5)
+    assert obs.shape == (4, 7) and obs.dtype == np.float32
+    assert obs[3, 2] == 0.5                       # unknown cloud: neutral id
+    assert obs[3, 1] == pytest.approx(0.5)        # cross-cloud mean cpu
+    np.testing.assert_array_equal(obs[:, 5], 0.25)
+    action, logits = backend.decide_nodes(obs, adj)
+    assert logits.shape == (4,) and 0 <= action < 4
+
+
+def test_check_warm_nodes_served_refuses_unhonored_request(telemetry):
+    """check_warm_nodes_served (run post-build in the CLI AND inside
+    every pool worker): a --warm-nodes demand a greedy/cloud-family
+    policy cannot honor refuses to boot instead of serving half-warmed;
+    no demand, no refusal."""
+    from rl_scheduler_tpu.scheduler.extender import check_warm_nodes_served
+
+    policy = ExtenderPolicy(GreedyBackend(), telemetry)
+    check_warm_nodes_served(policy, None)
+    with pytest.raises(SystemExit, match="warm-nodes"):
+        check_warm_nodes_served(policy, (8, 64))

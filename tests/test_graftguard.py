@@ -1069,3 +1069,93 @@ StudyRunner(spec, {study_dir!r}, jobs=0).run()
             assert cell["verdict"] in (
                 "confirmed_below", "point_below", "point_above",
                 "confirmed_above")
+
+
+class TestGraftrollChaos:
+    """graftroll's fault sites (utils/faults.py: `tracelog.append`,
+    `rollout.spawn`, `rollout.health`), wired as plumbed seams and
+    asserted to actually fire — a chaos test whose fault never triggers
+    is a green lie. The rollout sites must take the ROLLBACK path: a
+    fault mid-promotion leaves the pool serving the incumbent
+    generation, never a mixed pool."""
+
+    def test_tracelog_append_fault_counted_and_survived(self, tmp_path):
+        """An injected disk-full on append is counted as a write error,
+        drops exactly that record, and the writer keeps serving the
+        queue — the decision hot path never saw any of it."""
+        from rl_scheduler_tpu.scheduler.tracelog import TraceLog, iter_trace
+
+        plan = FaultPlan(schedule={"tracelog.append": (2,)})
+        log = TraceLog(tmp_path, fault_plan=plan)
+        for i in range(4):
+            assert log.append({"i": i})
+        log.close()
+        assert plan.fired["tracelog.append"] == 1
+        assert plan.calls["tracelog.append"] == 4
+        snap = log.snapshot()
+        assert snap["write_errors_total"] == 1
+        assert snap["written_total"] == 3
+        assert [r["i"] for r in iter_trace(tmp_path)] == [0, 2, 3]
+
+    @staticmethod
+    def _rollout_pool_pieces(tmp_path, plan):
+        """A 2-worker greedy pool + a manifest-verified candidate, built
+        with the pool test-suite's own helpers (tests/test_pool.py) so
+        the chaos path exercises the identical machinery."""
+        import os as _os
+
+        if not hasattr(_os, "fork"):
+            pytest.skip("graftserve pools require fork")
+        from tests import test_pool as tp
+
+        pool = tp._make_rollout_pool(fault_plan=plan)
+        candidate = tp._make_verified_checkpoint(tmp_path, "ckpt-good")
+        return tp, pool, candidate
+
+    def _promote_and_wait(self, tp, pool, candidate):
+        cport = pool.control_address[1]
+        code, _ = tp._post_code(cport, "/promote",
+                                {"checkpoint": str(candidate)})
+        assert code == 202
+        return tp._wait_rollout_idle(cport)
+
+    def test_rollout_spawn_fault_rolls_back(self, tmp_path):
+        """`rollout.spawn` firing on the canary's respawn must leave the
+        incumbent generation serving: the rollback re-spawns the slot
+        the failed promote took down."""
+        plan = FaultPlan(schedule={"rollout.spawn": (1,)})
+        tp, pool, candidate = self._rollout_pool_pieces(tmp_path, plan)
+        try:
+            status = self._promote_and_wait(tp, pool, candidate)
+            assert plan.fired["rollout.spawn"] == 1
+            # the rollback's own replaces consulted the site again
+            assert plan.calls["rollout.spawn"] >= 2
+            assert status["rollbacks_total"] == 1
+            assert status["promotions_total"] == 0
+            assert status["generation"] == 0
+            assert "spawn failed" in status["last_error"]
+            snapshots = pool.scrape()
+            assert len(snapshots) == 2
+            assert all(s["generation"] == 0 for s in snapshots)
+            assert len(tp._post(pool.port, "/filter",
+                                tp._filter_args(0))["nodenames"]) == 1
+        finally:
+            pool.shutdown()
+
+    def test_rollout_health_fault_rolls_back(self, tmp_path):
+        """`rollout.health` firing at the canary's health gate is the
+        same rollback obligation as a dead canary — the already-spawned
+        new-generation worker is rolled back onto the incumbent."""
+        plan = FaultPlan(schedule={"rollout.health": (1,)})
+        tp, pool, candidate = self._rollout_pool_pieces(tmp_path, plan)
+        try:
+            status = self._promote_and_wait(tp, pool, candidate)
+            assert plan.fired["rollout.health"] == 1
+            assert status["rollbacks_total"] == 1
+            assert status["generation"] == 0
+            assert "health gate failed" in status["last_error"]
+            assert all(s["generation"] == 0 for s in pool.scrape())
+            assert len(tp._post(pool.port, "/filter",
+                                tp._filter_args(0))["nodenames"]) == 1
+        finally:
+            pool.shutdown()

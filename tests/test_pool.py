@@ -12,14 +12,17 @@ stay inside the tier-1 budget; the bench-driven soak is marked ``slow``
 (``make serve-soak``).
 """
 
+import hashlib
 import json
 import os
+import shutil
 import signal
 import socket
 import threading
 import time
 import urllib.error
 import urllib.request
+from pathlib import Path
 
 import numpy as np
 import pytest
@@ -37,10 +40,18 @@ from rl_scheduler_tpu.scheduler.pool import (
     _HistogramView,
     aggregate_metrics,
     aggregate_stats,
+    merge_worker_histograms,
     quantiles_from_histogram,
+    run_pool,
     worker_snapshot,
 )
+from rl_scheduler_tpu.scheduler.rollout import (
+    RolloutController,
+    WorkerSpec,
+    verify_candidate,
+)
 from rl_scheduler_tpu.scheduler.telemetry import RandomCpu, TableTelemetry
+from rl_scheduler_tpu.scheduler.tracelog import iter_trace
 from rl_scheduler_tpu.utils.retry import CircuitBreaker, RetryPolicy
 
 pytestmark = pytest.mark.skipif(
@@ -246,6 +257,16 @@ def test_aggregate_metrics_exposition():
     assert 'rl_scheduler_extender_pool_worker_up{worker="0"} 1' in text
     assert 'rl_scheduler_extender_pool_worker_up{worker="2"} 0' in text
     assert 'rl_scheduler_extender_pool_worker_decisions_total{worker="1"} 4' in text
+
+
+def test_merge_worker_histograms_is_the_pinned_method():
+    """merge_worker_histograms — the ONE place /stats and /metrics
+    derive the pool histogram from — is exactly
+    LatencyStats.merged_histogram over the snapshot dicts."""
+    snap_a, stats_a = _synthetic_snapshot(0, {"aws": 3}, [0.0002] * 3)
+    snap_b, stats_b = _synthetic_snapshot(1, {"azure": 2}, [0.02] * 2)
+    assert merge_worker_histograms([snap_a, snap_b]) == \
+        LatencyStats.merged_histogram([stats_a, stats_b])
 
 
 def test_worker_snapshot_round_trips_histogram():
@@ -621,16 +642,431 @@ def test_make_server_reuse_port_two_listeners():
         srv_b.shutdown()
 
 
+# -------------------------------------------------- graftroll: rollout
+
+
+def _make_verified_checkpoint(root, name="ckpt-good"):
+    """A minimal run dir that passes graftroll's manifest verification:
+    one step, one file, a graftguard-shaped sha256+size manifest —
+    exactly what `verify_candidate` trusts, no orbax involved."""
+    run = Path(root) / name
+    step = run / "checkpoints" / "1"
+    step.mkdir(parents=True)
+    payload = (name.encode() + b"-weights") * 64
+    (step / "state.bin").write_bytes(payload)
+    mdir = run / "checkpoint_manifests"
+    mdir.mkdir()
+    (mdir / "1.json").write_text(json.dumps({
+        "step": 1,
+        "files": {"state.bin": {
+            "sha256": hashlib.sha256(payload).hexdigest(),
+            "size": len(payload),
+        }},
+    }))
+    return run
+
+
+class _PoisonedBackend:
+    """Stands in for a verifies-clean-but-regressing checkpoint: every
+    decision raises, so the canary's warm-up probes fail open and the
+    gate must roll back."""
+
+    name = "poisoned"
+
+    def decide(self, obs):
+        raise RuntimeError("regressing checkpoint")
+
+
+def _rollout_factory(trace_dir=None):
+    """Spec-aware greedy factory: a promoted spec whose checkpoint name
+    contains 'regress' builds a poisoned backend (the forced-bad promote
+    of the drill); any other spec serves greedy. Optionally attaches a
+    per-worker trace stream."""
+
+    def factory(worker_id, shared, spec):
+        telemetry = TableTelemetry.from_table(
+            cpu_source=RandomCpu(seed=0), counter=shared.table_counter
+        )
+        backend = (_PoisonedBackend()
+                   if spec.checkpoint and "regress" in Path(spec.checkpoint).name
+                   else GreedyBackend())
+        policy = ExtenderPolicy(backend, telemetry)
+        if trace_dir is not None:
+            from rl_scheduler_tpu.scheduler.tracelog import TraceLog
+
+            policy.trace = TraceLog(trace_dir, prefix=f"w{worker_id}-")
+        return policy
+
+    return factory
+
+
+def _make_rollout_pool(workers=2, trace_dir=None, fault_plan=None,
+                       restart_policy=None, **rollout_opts):
+    opts = {"canary_hold_s": 0.2, "probe_count": 2, "ready_timeout_s": 60.0}
+    opts.update(rollout_opts)
+    pool = ServingPool(
+        _rollout_factory(trace_dir), workers=workers, host="127.0.0.1",
+        port=0, control_port=0,
+        restart_policy=restart_policy or FAST_RESTARTS,
+        stable_after_s=60.0, poll_interval_s=0.05,
+        fault_plan=fault_plan, rollout_opts=opts,
+    )
+    pool.start(ready_timeout_s=60.0)
+    return pool
+
+
+def _post_code(port, path, payload, timeout=10):
+    """Like _post but 4xx/5xx return ``(code, body)`` instead of
+    raising — promote refusals are answers, not errors."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _wait_rollout_idle(cport, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        status = _get(cport, "/rollout")
+        if not status["active"]:
+            return status
+        time.sleep(0.05)
+    pytest.fail(f"rollout still in flight after {timeout}s: {status}")
+
+
+def test_verify_candidate_manifest_semantics(tmp_path):
+    """The promote-side verification: digests pass a clean step, refuse
+    truncation/corruption/unfinalized saves, and accept a fully legacy
+    run with a warning — no fallback to an older step (the operator
+    promoted THIS checkpoint)."""
+    run = _make_verified_checkpoint(tmp_path, "ckpt")
+    step, reason = verify_candidate(run)
+    assert (step, reason) == (1, "verified")
+
+    truncated = Path(shutil.copytree(run, tmp_path / "ckpt-trunc"))
+    state = truncated / "checkpoints" / "1" / "state.bin"
+    state.write_bytes(state.read_bytes()[: state.stat().st_size // 2])
+    step, reason = verify_candidate(truncated)
+    assert step is None and "truncated" in reason
+
+    garbage = Path(shutil.copytree(run, tmp_path / "ckpt-garbage"))
+    state = garbage / "checkpoints" / "1" / "state.bin"
+    data = bytearray(state.read_bytes())
+    data[:4] = b"\xde\xad\xbe\xef"
+    state.write_bytes(bytes(data))
+    step, reason = verify_candidate(garbage)
+    assert step is None and "sha256" in reason
+
+    # newest step manifest-less in a manifested run = unfinalized: refuse
+    unfinalized = Path(shutil.copytree(run, tmp_path / "ckpt-unfin"))
+    (unfinalized / "checkpoints" / "2").mkdir()
+    (unfinalized / "checkpoints" / "2" / "state.bin").write_bytes(b"x")
+    step, reason = verify_candidate(unfinalized)
+    assert step is None and "unfinalized" in reason
+
+    # fully legacy run (no manifest dir): accepted, flagged
+    legacy = Path(shutil.copytree(run, tmp_path / "ckpt-legacy"))
+    shutil.rmtree(legacy / "checkpoint_manifests")
+    assert verify_candidate(legacy) == (1, "legacy")
+
+    assert verify_candidate(tmp_path / "nope")[0] is None
+
+
+def test_rollout_drill(tmp_path):
+    """`make rollout-drill`: (a) a good promote lands generation 1 on
+    every worker with serving uninterrupted; (b) a corrupted copy is
+    refused before any worker is touched; (c) a verifies-clean-but-
+    regressing promote fails the canary's warm-up probes and rolls the
+    pool back to the incumbent generation; the trace log replays every
+    decision and /stats/reset never rewinds the lifetime counters."""
+    good = _make_verified_checkpoint(tmp_path, "ckpt-good")
+    corrupt = Path(shutil.copytree(good, tmp_path / "ckpt-corrupt"))
+    state = corrupt / "checkpoints" / "1" / "state.bin"
+    state.write_bytes(state.read_bytes() + b"JUNK")
+    regress = _make_verified_checkpoint(tmp_path, "ckpt-regress")
+    trace_dir = tmp_path / "trace"
+    pool = _make_rollout_pool(trace_dir=str(trace_dir))
+    requests = 0
+    try:
+        cport = pool.control_address[1]
+        for i in range(10):
+            assert len(_post(pool.port, "/filter",
+                             _filter_args(i))["nodenames"]) == 1
+            requests += 1
+
+        # (a) good promote: canary + roll, all workers on generation 1
+        code, body = _post_code(cport, "/promote",
+                                {"checkpoint": str(good)})
+        assert code == 202 and body["target_generation"] == 1
+        assert body["verification"] == "verified"
+        status = _wait_rollout_idle(cport)
+        assert status["generation"] == 1
+        assert status["promotions_total"] == 1
+        assert status["rollbacks_total"] == 0
+        assert status["checkpoint"] == str(good)
+        snapshots = pool.scrape()
+        assert len(snapshots) == 2
+        assert all(s["generation"] == 1 for s in snapshots)
+        assert len(_post(pool.port, "/filter",
+                         _filter_args(100))["nodenames"]) == 1
+        requests += 1
+
+        # (b) corrupt promote: refused at verification, nothing rolled
+        code, body = _post_code(cport, "/promote",
+                                {"checkpoint": str(corrupt)})
+        assert code == 422 and "refused" in body["error"]
+        status = _get(cport, "/rollout")
+        assert status["generation"] == 1 and not status["active"]
+        assert status["refusals_total"] == 1
+        assert all(s["generation"] == 1 for s in pool.scrape())
+
+        # (c) regressing promote: verifies clean, canary probes fail
+        # open, automatic rollback restores the incumbent generation
+        code, body = _post_code(cport, "/promote",
+                                {"checkpoint": str(regress)})
+        assert code == 202 and body["verification"] == "verified"
+        status = _wait_rollout_idle(cport)
+        assert status["generation"] == 1
+        assert status["rollbacks_total"] == 1
+        assert "fail" in status["last_error"]
+        assert all(s["generation"] == 1 for s in pool.scrape())
+        assert len(_post(pool.port, "/filter",
+                         _filter_args(101))["nodenames"]) == 1
+        requests += 1
+
+        # the gauge transitions the drill doc promises, on one scrape
+        metrics = _get(cport, "/metrics")
+        assert "rl_scheduler_extender_pool_generation 1" in metrics
+        assert "rl_scheduler_extender_pool_promotions_total 1" in metrics
+        assert "rl_scheduler_extender_pool_rollbacks_total 1" in metrics
+        assert "rl_scheduler_extender_pool_promote_refusals_total 1" in metrics
+        assert "rl_scheduler_extender_pool_rollout_state 0" in metrics
+        assert 'rl_scheduler_extender_pool_worker_generation{worker="0"} 1' \
+            in metrics
+        assert "rl_scheduler_extender_trace_records_total" in metrics
+        assert "rl_scheduler_extender_trace_dropped_total 0" in metrics
+        assert "rl_scheduler_extender_trace_segments_total" in metrics
+
+        # satellite small fix: /stats/reset clears rings ONLY — the
+        # promotion/rollback and trace counters stay monotonic
+        trace_before = _get(cport, "/stats")["trace"]
+        _post(cport, "/stats/reset", {})
+        stats = _get(cport, "/stats")
+        assert stats["trace"]["records_total"] \
+            == trace_before["records_total"]
+        metrics = _get(cport, "/metrics")
+        assert "rl_scheduler_extender_pool_promotions_total 1" in metrics
+        assert "rl_scheduler_extender_pool_rollbacks_total 1" in metrics
+
+        probes = _get(cport, "/rollout")["probes_total"]
+    finally:
+        pool.shutdown()
+
+    # the durable trace replays every decision made during the drill:
+    # our client requests plus the gates' warm-up probes, across BOTH
+    # generations and every worker incarnation
+    records = list(iter_trace(trace_dir))
+    assert len(records) == requests + probes
+    # generations 0 (pre-promote) and 1 (promoted) served traffic; the
+    # rolled-back attempt at generation 2 left only its fail-open probe
+    # record — the trace faithfully records the attempt
+    assert {r["generation"] for r in records} == {0, 1, 2}
+    failed = [r for r in records if r["fail_open"]]
+    assert failed and all(r["generation"] == 2 for r in failed)
+    # synthetic gate traffic is TAGGED: a trace consumer can exclude it
+    assert sum(1 for r in records if r["endpoint"] == "probe") == probes
+    assert all(r["schema"] == 1 for r in records)
+
+
+def test_healthz_rolling_and_sigkill_mid_rollout_rolls_back(tmp_path):
+    """During a rollout the pool reports 200 with `rolling: true` even
+    while below strength (a rolling restart must not trip k8s
+    liveness); a second promote mid-flight is refused 409; and a canary
+    SIGKILLed during its hold triggers automatic rollback onto the
+    incumbent generation."""
+    good = _make_verified_checkpoint(tmp_path, "ckpt-good")
+    slow_restarts = RetryPolicy(max_attempts=5, base_delay_s=2.0,
+                                max_delay_s=4.0, jitter=0.0)
+    pool = _make_rollout_pool(canary_hold_s=30.0,
+                              restart_policy=slow_restarts)
+    try:
+        cport = pool.control_address[1]
+        for i in range(5):
+            _post(pool.port, "/filter", _filter_args(i))
+        code, _ = _post_code(cport, "/promote", {"checkpoint": str(good)})
+        assert code == 202
+
+        # wait for the canary hold (worker 0 on generation 1, held)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            status = _get(cport, "/rollout")
+            if status["phase"] == "canary_hold":
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail(f"never reached canary_hold: {status}")
+
+        # single-writer: a second promote during the rollout is refused
+        code, body = _post_code(cport, "/promote",
+                                {"checkpoint": str(good)})
+        assert code == 409 and "in flight" in body["error"]
+
+        # kill an INCUMBENT: the pool is now degraded AND rolling — the
+        # health contract is 200 + rolling:true (not 503), and the
+        # supervisor's monitor owns the respawn (its backoff is slow
+        # here, so the window is deterministic)
+        snapshots = pool.scrape()
+        by_gen = {s["generation"]: s for s in snapshots}
+        assert set(by_gen) == {0, 1}
+        os.kill(by_gen[0]["pid"], signal.SIGKILL)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            health = _get(cport, "/healthz")  # must NOT raise 503
+            if health["alive"] < health["workers"]:
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("never observed the degraded window")
+        assert health["rolling"] is True
+        assert health["status"] == "rolling"
+
+        # SIGKILL the canary mid-hold: the gate sees the death and rolls
+        # back; the incumbent generation is restored everywhere
+        os.kill(by_gen[1]["pid"], signal.SIGKILL)
+        status = _wait_rollout_idle(cport, timeout=60.0)
+        assert status["rollbacks_total"] == 1
+        assert status["promotions_total"] == 0
+        assert status["generation"] == 0
+        assert "died" in status["last_error"]
+        assert status["conflicts_total"] == 1
+
+        # the pool heals to full strength on generation 0 and serves
+        # (once the rollout is idle a still-down incumbent is an honest
+        # 503 "degraded" again until its monitor backoff respawns it)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            try:
+                health = _get(cport, "/healthz")
+            except urllib.error.HTTPError:
+                health = None
+            if (health is not None and health["status"] == "ok"
+                    and health["rolling"] is False):
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail(f"pool never healed: {health}")
+        assert all(s["generation"] == 0 for s in pool.scrape())
+        for attempt in range(20):
+            try:
+                result = _post(pool.port, "/filter", _filter_args(attempt))
+                break
+            except OSError:
+                time.sleep(0.1)
+        assert len(result["nodenames"]) == 1
+    finally:
+        pool.shutdown()
+
+
+def test_legacy_two_arg_factory_still_promotes_generation_label(tmp_path):
+    """Backward compatibility: a pre-graftroll (worker_id, shared)
+    factory keeps working — a promote still executes the rolling
+    restart and bumps the generation label (the factory just serves
+    what it always served)."""
+    good = _make_verified_checkpoint(tmp_path, "ckpt-good")
+    pool = ServingPool(_greedy_factory, workers=2, host="127.0.0.1",
+                       port=0, control_port=0,
+                       restart_policy=FAST_RESTARTS, stable_after_s=60.0,
+                       poll_interval_s=0.05,
+                       rollout_opts={"canary_hold_s": 0.1,
+                                     "probe_count": 1,
+                                     "ready_timeout_s": 60.0})
+    pool.start(ready_timeout_s=60.0)
+    try:
+        cport = pool.control_address[1]
+        code, _ = _post_code(cport, "/promote", {"checkpoint": str(good)})
+        assert code == 202
+        status = _wait_rollout_idle(cport)
+        assert status["generation"] == 1
+        assert all(s["generation"] == 1 for s in pool.scrape())
+        assert len(_post(pool.port, "/filter",
+                         _filter_args(0))["nodenames"]) == 1
+    finally:
+        pool.shutdown()
+
+
+def test_run_pool_direct_entry_serves_and_traces(tmp_path):
+    """run_pool — the CLI's --workers path — wires the spec-aware
+    factory and the per-worker trace streams: the pool serves, SIGTERM
+    shuts it down cleanly, and --trace-dir holds one record per
+    decision tagged with the serving worker."""
+    import multiprocessing
+
+    ctx = multiprocessing.get_context("fork")
+    port, cport = _free_port(), _free_port()
+    trace_dir = tmp_path / "trace"
+    proc = ctx.Process(target=run_pool, kwargs=dict(
+        build_kwargs={"backend": "greedy", "trace_dir": str(trace_dir)},
+        workers=2, host="127.0.0.1", port=port, control_port=cport,
+        control_host="127.0.0.1"))
+    proc.start()
+    try:
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            try:
+                if _get(cport, "/healthz", timeout=2)["alive"] == 2:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.1)
+        else:
+            pytest.fail("run_pool never came up")
+        for i in range(4):
+            assert len(_post(port, "/filter", _filter_args(i))["nodenames"]) == 1
+        os.kill(proc.pid, signal.SIGTERM)
+        proc.join(timeout=30)
+        assert proc.exitcode == 0
+    finally:
+        if proc.is_alive():
+            proc.kill()
+            proc.join(timeout=10)
+    records = list(iter_trace(trace_dir))
+    assert len(records) == 4
+    assert {r["worker"] for r in records} <= {0, 1}
+    assert all(r["generation"] == 0 for r in records)
+
+
+def test_rollout_lock_file_o_excl_discipline(tmp_path):
+    """The on-disk single-writer lock (graftstudy's runner-lock
+    discipline): a live holder refuses the promote, a stale lock from a
+    dead pid is cleared and retried."""
+    pool = ServingPool(_rollout_factory(), workers=1, host="127.0.0.1",
+                       port=0, control_port=0)
+    controller = RolloutController(pool, lock_dir=tmp_path)
+    lock = controller._acquire_lock_file()
+    assert lock is not None and lock.read_text() == str(os.getpid())
+    # same-pid holder counts as live: a second acquisition refuses
+    with pytest.raises(RuntimeError, match="already in flight"):
+        controller._acquire_lock_file()
+    controller._release_lock_file(lock)
+    # stale lock (dead pid): cleared and re-acquired
+    lock.write_text("999999999")
+    lock2 = controller._acquire_lock_file()
+    assert lock2.read_text() == str(os.getpid())
+    controller._release_lock_file(lock2)
+    assert WorkerSpec().generation == 0  # frozen default spec
+
+
 # ------------------------------------------------------------------- soak
 
 
-@pytest.mark.slow
-def test_pool_soak_via_bench():
-    """``make serve-soak``: the bench's --duration mode against a live
-    2-worker pool, pool-wide reset/stats via --control-port, zero
-    failures, schema-tagged result line."""
+def _load_bench():
     import importlib.util
-    from pathlib import Path
 
     spec = importlib.util.spec_from_file_location(
         "extender_bench",
@@ -638,6 +1074,15 @@ def test_pool_soak_via_bench():
     )
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
+    return bench
+
+
+@pytest.mark.slow
+def test_pool_soak_via_bench():
+    """``make serve-soak``: the bench's --duration mode against a live
+    2-worker pool, pool-wide reset/stats via --control-port, zero
+    failures, schema-tagged result line."""
+    bench = _load_bench()
 
     pool = _make_pool(workers=2)
     try:
@@ -655,3 +1100,72 @@ def test_pool_soak_via_bench():
     assert out["failures"] == 0
     assert out["requests"] > 0 and out["req_per_sec"] > 0
     assert out["server_p50_ms"] is not None
+
+
+@pytest.mark.slow
+def test_rollout_drill_soak(tmp_path):
+    """The acceptance soak (`make rollout-drill` runs this alongside the
+    fast drill): a 2-worker pool serves continuously while (a) a good
+    promote lands mid-soak with ZERO failed requests in both phases and
+    every worker reporting the new generation, then (b) a regressing
+    promote auto-rolls-back mid-soak — also zero failed requests, the
+    incumbent generation restored — with the durable trace replaying
+    every decision made during both drills."""
+    bench = _load_bench()
+    good = _make_verified_checkpoint(tmp_path, "ckpt-good")
+    regress = _make_verified_checkpoint(tmp_path, "ckpt-regress")
+    trace_dir = tmp_path / "trace"
+    pool = _make_rollout_pool(trace_dir=str(trace_dir), canary_hold_s=0.5)
+    warmup = 5
+    try:
+        cport = pool.control_address[1]
+        common = ["--port", str(pool.port), "--threads", "4",
+                  "--warmup", str(warmup), "--control-port", str(cport),
+                  "--duration", "6", "--promote-at", "2"]
+
+        # drill (a): good promote under load
+        out_good = bench.main(common + ["--promote-checkpoint", str(good)])
+        assert out_good["failures"] == 0
+        assert out_good["phases"]["pre_promote"]["failures"] == 0
+        assert out_good["phases"]["post_promote"]["failures"] == 0
+        assert out_good["phases"]["post_promote"]["requests"] > 0
+        assert out_good["promote"]["response_code"] == 202
+        rollout = out_good["promote"]["rollout"]
+        assert rollout["generation"] == 1
+        assert rollout["promotions_total"] == 1
+        assert rollout["rollbacks_total"] == 0
+        snapshots = pool.scrape()
+        assert len(snapshots) == 2
+        assert all(s["generation"] == 1 for s in snapshots)
+
+        # drill (b): regressing promote rolls back under load
+        out_bad = bench.main(common + ["--promote-checkpoint", str(regress)])
+        assert out_bad["failures"] == 0
+        assert out_bad["phases"]["pre_promote"]["failures"] == 0
+        assert out_bad["phases"]["post_promote"]["failures"] == 0
+        rollout = out_bad["promote"]["rollout"]
+        assert rollout["generation"] == 1       # incumbent restored
+        assert rollout["rollbacks_total"] == 1
+        assert all(s["generation"] == 1 for s in pool.scrape())
+
+        status = _get(cport, "/rollout")
+        probes = status["probes_total"]
+        retries = sum(out["phases"][ph]["retries"]
+                      for out in (out_good, out_bad)
+                      for ph in ("pre_promote", "post_promote"))
+        metrics = _get(cport, "/metrics")
+        assert "rl_scheduler_extender_pool_rollbacks_total 1" in metrics
+        assert "rl_scheduler_extender_trace_segments_total" in metrics
+        assert "rl_scheduler_extender_trace_dropped_total 0" in metrics
+    finally:
+        pool.shutdown()
+
+    # every decision of both drills is in the trace: the bench's
+    # successful requests + warmups + the gates' warm-up probes; a
+    # connection-level retry MAY have reached a worker before the reset,
+    # so retries bound the slack from above
+    records = list(iter_trace(trace_dir))
+    expected = (out_good["requests"] + out_bad["requests"]
+                + 2 * warmup + probes)
+    assert expected <= len(records) <= expected + retries
+    assert {r["generation"] for r in records} >= {0, 1}

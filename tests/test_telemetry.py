@@ -194,3 +194,37 @@ def test_random_cpu_seeded_and_banded():
         pair = a.sample()
         assert pair == b.sample()
         assert all(0.1 <= v <= 0.8 for v in pair)
+
+
+def test_last_replay_position_is_thread_exact():
+    """graftroll provenance: last_replay_position names the RAW row the
+    CALLING thread's most recent observation consumed — exact under
+    concurrency (each thread sees its own consumed positions, never a
+    neighbor's), None before the thread's first observation."""
+    import threading
+
+    table = TableTelemetry(
+        np.arange(10, dtype=np.float32).reshape(5, 2),
+        np.zeros((5, 2), np.float32), cpu_source=RandomCpu(seed=0),
+    )
+    assert table.last_replay_position() is None
+    table.observe()
+    assert table.last_replay_position() == 0
+    table.observe()
+    assert table.last_replay_position() == 1
+
+    seen = {}
+
+    def worker(name):
+        table.observe()
+        seen[name] = table.last_replay_position()
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    # each thread observed a DISTINCT position, and the main thread's
+    # view is untouched by the others' observations
+    assert sorted(seen.values()) == [2, 3, 4, 5, 6, 7]
+    assert table.last_replay_position() == 1

@@ -1,0 +1,242 @@
+"""graftroll part 1 (scheduler/tracelog.py): the durable decision trace.
+
+Pins the writer's crash-safety story (flush-per-record parts, fsync-then-
+rename seals, orphan recovery), the counted drop-oldest backpressure (the
+hot path never blocks), the schema-versioned record the extender appends
+per decision — success AND fail-open — and the replay order ``iter_trace``
+guarantees. The ``tracelog.append`` chaos site rides in the graftguard
+suite (``make chaos``); lifetime-counter monotonicity across
+``/stats/reset`` is pinned here at the policy level and again pool-wide
+in tests/test_pool.py.
+"""
+
+import json
+import threading
+
+import pytest
+
+from rl_scheduler_tpu.scheduler.extender import ExtenderPolicy
+from rl_scheduler_tpu.scheduler.policy_backend import (
+    GreedyBackend,
+    backend_info,
+)
+from rl_scheduler_tpu.scheduler.telemetry import RandomCpu, TableTelemetry
+from rl_scheduler_tpu.scheduler.tracelog import (
+    TRACE_SCHEMA,
+    TraceLog,
+    decision_record,
+    iter_trace,
+    obs_digest,
+)
+
+
+def _records(n, start=0):
+    return [{"schema": TRACE_SCHEMA, "i": i} for i in range(start, start + n)]
+
+
+def _greedy_policy(trace=None):
+    telemetry = TableTelemetry.from_table(cpu_source=RandomCpu(seed=0))
+    policy = ExtenderPolicy(GreedyBackend(), telemetry)
+    policy.trace = trace
+    return policy
+
+
+def _filter_args(i=0):
+    return {"nodenames": [f"aws-w{i}", f"azure-w{i}"], "pod": {}}
+
+
+# ----------------------------------------------------------------- writer
+
+
+def test_append_write_seal_and_replay(tmp_path):
+    """Records flow queue -> part file -> sealed segment; iter_trace
+    replays every record in write order; sealing happens at the
+    configured segment size and close() seals the remainder."""
+    log = TraceLog(tmp_path, max_records_per_segment=3)
+    for rec in _records(7):
+        assert log.append(rec)
+    log.close()
+    snap = log.snapshot()
+    assert snap["records_total"] == 7
+    assert snap["written_total"] == 7
+    assert snap["dropped_total"] == 0
+    assert snap["write_errors_total"] == 0
+    # 3 + 3 sealed on rotation, the last 1 sealed by close()
+    assert snap["segments_total"] == 3
+    sealed = sorted(p.name for p in tmp_path.glob("*.jsonl"))
+    assert sealed == ["seg-000001.jsonl", "seg-000002.jsonl",
+                      "seg-000003.jsonl"]
+    assert not list(tmp_path.glob("*.part"))
+    assert [r["i"] for r in iter_trace(tmp_path)] == list(range(7))
+
+
+def test_prefix_namespaces_streams_in_one_dir(tmp_path):
+    """Pool workers share one trace dir: per-writer prefixes never
+    collide, and iter_trace filters per stream or replays all."""
+    a = TraceLog(tmp_path, prefix="w0-", max_records_per_segment=2)
+    b = TraceLog(tmp_path, prefix="w1-", max_records_per_segment=2)
+    for rec in _records(3):
+        a.append(rec)
+    for rec in _records(2, start=100):
+        b.append(rec)
+    a.close()
+    b.close()
+    assert [r["i"] for r in iter_trace(tmp_path, prefix="w0-")] == [0, 1, 2]
+    assert [r["i"] for r in iter_trace(tmp_path, prefix="w1-")] == [100, 101]
+    assert len(list(iter_trace(tmp_path))) == 5
+
+
+def test_drop_oldest_backpressure_counted(tmp_path):
+    """With the writer stalled, a full queue drops the OLDEST record and
+    counts it — append never blocks and never raises (the AsyncPlacer
+    policy). The survivors are the newest records."""
+    log = TraceLog(tmp_path, max_queue=4, autostart=False)
+    for rec in _records(10):
+        log.append(rec)
+    snap = log.snapshot()
+    assert snap["records_total"] == 10
+    assert snap["dropped_total"] == 6
+    log.start()
+    log.close()
+    assert [r["i"] for r in iter_trace(tmp_path)] == [6, 7, 8, 9]
+
+
+def test_orphaned_part_recovered_on_restart(tmp_path):
+    """A .part stranded by a crash (writer never sealed it) is sealed by
+    the NEXT writer over the same dir — flushed lines survive, and the
+    new writer's sequence numbers continue past it."""
+    part = tmp_path / "seg-000004.jsonl.part"
+    part.write_text(json.dumps({"i": 40}) + "\n")
+    log = TraceLog(tmp_path)
+    assert (tmp_path / "seg-000004.jsonl").exists()
+    assert not part.exists()
+    log.append({"i": 50})
+    log.close()
+    assert (tmp_path / "seg-000005.jsonl").exists()
+    assert [r["i"] for r in iter_trace(tmp_path)] == [40, 50]
+
+
+def test_iter_trace_skips_torn_trailing_line(tmp_path):
+    """A writer killed mid-write leaves a torn last line; replay yields
+    every whole record and skips the tear instead of raising."""
+    seg = tmp_path / "seg-000001.jsonl"
+    seg.write_text(json.dumps({"i": 0}) + "\n" + '{"i": 1, "tr')
+    assert [r["i"] for r in iter_trace(tmp_path)] == [0]
+
+
+def test_validation_and_closed_append(tmp_path):
+    with pytest.raises(ValueError, match="max_records_per_segment"):
+        TraceLog(tmp_path, max_records_per_segment=0)
+    with pytest.raises(ValueError, match="max_queue"):
+        TraceLog(tmp_path, max_queue=0)
+    log = TraceLog(tmp_path)
+    log.close()
+    assert log.append({"i": 0}) is False  # no-op after close, never raises
+    log.close()  # idempotent
+
+
+def test_concurrent_appends_all_land(tmp_path):
+    """The serving threads append concurrently; every record lands
+    exactly once (queue + single writer thread)."""
+    log = TraceLog(tmp_path, max_records_per_segment=64, max_queue=4096)
+
+    def worker(base):
+        for rec in _records(100, start=base):
+            log.append(rec)
+
+    threads = [threading.Thread(target=worker, args=(t * 1000,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    log.close()
+    seen = sorted(r["i"] for r in iter_trace(tmp_path))
+    assert seen == sorted(t * 1000 + i for t in range(4) for i in range(100))
+
+
+# ----------------------------------------------------------------- records
+
+
+def test_decision_record_schema_and_digest():
+    import numpy as np
+
+    obs = np.arange(6, dtype=np.float32)
+    rec = decision_record(
+        endpoint="filter", family="cloud", backend="greedy", candidates=2,
+        chosen="aws", score=0.75, latency_ms=0.123456, obs=obs,
+        telemetry_pos=7, worker_id=1, generation=3,
+        breaker_state="closed",
+    )
+    assert rec["schema"] == TRACE_SCHEMA
+    assert rec["obs_sha"] == obs_digest(obs) and len(rec["obs_sha"]) == 16
+    assert obs_digest(obs) == obs_digest(obs.copy())  # content-stable
+    assert obs_digest(None) is None
+    assert rec["chosen"] == "aws" and rec["generation"] == 3
+    assert rec["fail_open"] is False and rec["breaker"] == "closed"
+    json.dumps(rec)  # every field is JSONL-serializable
+
+
+def test_policy_traces_every_decision_and_fail_open(tmp_path):
+    """The extender appends one record per decision — /filter and
+    /prioritize, flat family — carrying the chosen cloud, score, obs
+    digest and telemetry position; a backend failure appends a
+    fail_open record and bumps the policy's fail_open_total."""
+    log = TraceLog(tmp_path)
+    policy = _greedy_policy(trace=log)
+    policy.filter(_filter_args(0))
+    policy.prioritize(_filter_args(1))
+
+    class Boom:
+        name = "boom"
+
+        def decide(self, obs):
+            raise RuntimeError("poisoned")
+
+    healthy_backend = policy.backend
+    policy.backend = Boom()
+    policy.filter(_filter_args(2))  # fails open, stays answered
+    policy.backend = healthy_backend
+    log.close()
+
+    records = list(iter_trace(tmp_path))
+    assert len(records) == 3
+    ok_filter, ok_prio, failed = records
+    assert ok_filter["endpoint"] == "filter" and not ok_filter["fail_open"]
+    assert ok_filter["chosen"] in ("aws", "azure")
+    assert ok_filter["candidates"] == 2
+    assert len(ok_filter["obs_sha"]) == 16
+    # exact provenance: THIS thread's first observation consumed row 0,
+    # its second row 1 (last_replay_position is thread-local)
+    assert ok_filter["telemetry_pos"] == 0
+    assert ok_prio["telemetry_pos"] == 1
+    assert 0.0 <= ok_filter["score"] <= 1.0
+    assert ok_prio["endpoint"] == "prioritize" and not ok_prio["fail_open"]
+    assert failed["fail_open"] is True and failed["chosen"] is None
+    assert failed["obs_sha"] is None
+    assert policy.statistics()["fail_open_total"] == 1
+    assert policy.statistics()["trace"]["records_total"] == 3
+    info = backend_info(policy.backend)
+    assert info == {"name": "greedy", "family": "cloud"}
+
+
+def test_reset_stats_never_clears_trace_counters(tmp_path):
+    """The small-fix satellite, single-process half: /stats/reset clears
+    the percentile ring only — trace records/segments and fail-open
+    counts are lifetime-monotonic, like the latency histogram."""
+    log = TraceLog(tmp_path, max_records_per_segment=2)
+    policy = _greedy_policy(trace=log)
+    for i in range(5):
+        policy.filter(_filter_args(i))
+    before = policy.statistics()["trace"]
+    assert before["records_total"] == 5
+    policy.reset_stats()
+    stats = policy.statistics()
+    assert stats["latency"]["count"] == 0          # the ring cleared
+    assert stats["trace"]["records_total"] == 5    # the trace did not
+    assert stats["trace"]["segments_total"] >= before["segments_total"]
+    metrics = policy.metrics_text()
+    assert "rl_scheduler_extender_trace_records_total 5" in metrics
+    assert "rl_scheduler_extender_trace_dropped_total 0" in metrics
+    assert "rl_scheduler_extender_fail_open_total 0" in metrics
+    log.close()

@@ -36,7 +36,12 @@ from tools.graftlint.engine import LintContext, Module
 from tools.graftlint.rules import Rule, register
 
 # Path segments whose public functions must be referenced from tests.
-OP_DIRS = frozenset({"ops", "parallel", "scenarios", "studies"})
+# `scheduler` joined with graftroll: the serving plane's public surface
+# is now a zero-downtime contract (trace durability, rolling promotion,
+# rollback gates) — an untested public op there is an unverified claim
+# about what a live pool does under a promote.
+OP_DIRS = frozenset({"ops", "parallel", "scenarios", "studies",
+                     "scheduler"})
 
 
 @register
