@@ -10,7 +10,7 @@ TRACE ?= tests/fixtures/traceview/fixture.trace.json.gz
 
 .PHONY: lint lint-json test tier1 trace-summary obs chaos chaos-soak \
         serve-pool serve-soak rollout-drill eval-matrix scenario-bench \
-        study study-list overlap-bench
+        study study-list overlap-bench serve-report slo-check span-ab
 
 lint:
 	$(PY) -m tools.graftlint --check
@@ -67,6 +67,36 @@ serve-soak:
 # replaying every decision.
 rollout-drill:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_pool.py -q -k rollout_drill
+
+# graftlens (docs/observability.md): the serving perf report with
+# regression gating — phase decomposition, per-generation latency, SLO
+# attainment, budget + bench-history gates (exit 2 on a violation).
+# Defaults to the checked-in fixture so the gate is self-contained
+# off-network; point SERVE_STATS at a live pool's control plane
+# (`make serve-report SERVE_STATS=http://127.0.0.1:8788/stats
+# SERVE_TRACE=/var/trace SERVE_BENCH=BENCH_serving.jsonl`).
+SERVE_STATS ?= tests/fixtures/decisionview/stats.json
+SERVE_TRACE ?= tests/fixtures/decisionview/trace
+SERVE_BENCH ?= tests/fixtures/decisionview/bench.jsonl
+serve-report:
+	$(PY) -m tools.decisionview --stats $(SERVE_STATS) \
+		--trace $(SERVE_TRACE) --bench $(SERVE_BENCH) \
+		--check --budgets tools/decisionview/budgets.json --check-history
+
+# The SLO gate alone: exit 2 while any objective burns (wire it at the
+# end of a soak/drill; serves the fixture off-network by default).
+slo-check:
+	$(PY) -m tools.decisionview --stats $(SERVE_STATS) --slo-check
+
+# graftlens span-overhead A/B (docs/serving.md acceptance: spans-on
+# within 2% of spans-off req/s and p50 at 8-way N=1024, interleaved).
+SPAN_NODES ?= 1024
+SPAN_ROUNDS ?= 2
+SPAN_DURATION ?= 10
+span-ab:
+	JAX_PLATFORMS=cpu $(PY) loadgen/span_ab.py --nodes $(SPAN_NODES) \
+		--threads 8 --workers 2 --rounds $(SPAN_ROUNDS) \
+		--duration $(SPAN_DURATION)
 
 # graftscenario (docs/scenarios.md): the scenario x policy-family eval
 # matrix — one schema_version-tagged JSON line per cell to

@@ -254,6 +254,13 @@ def main(argv: list[str] | None = None) -> dict:
                         "bar (docs/serving.md)")
     p.add_argument("--promote-checkpoint", default=None, metavar="DIR",
                    help="checkpoint run dir to promote at --promote-at")
+    p.add_argument("--history", default=None, metavar="FILE",
+                   help="graftlens serving bench ledger: append this "
+                        "run's schema_version:1 JSON line to FILE "
+                        "(convention: BENCH_serving.jsonl at the repo "
+                        "root) so rounds accumulate a durable "
+                        "trajectory; `tools/decisionview --check-history`"
+                        " gates the newest round against the priors")
     args = p.parse_args(argv)
     if args.requests < 1:
         p.error("--requests must be >= 1")
@@ -365,6 +372,12 @@ def main(argv: list[str] | None = None) -> dict:
     if promote is not None:
         out["promote"] = promote
     print(json.dumps(out))
+    if args.history is not None:
+        # Durable append-only ledger (one JSON line per round). Plain
+        # append: a torn final line from a killed bench is tolerated by
+        # the decisionview reader, like the trace log's torn-line rule.
+        with open(args.history, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(out) + "\n")
     return out
 
 

@@ -1,0 +1,169 @@
+"""graftlens span-overhead A/B: spans-on vs spans-off on a live pool.
+
+The per-phase decision spans (scheduler/extender.py PHASES) ride the
+serving hot path, so they carry a measured-overhead obligation: at the
+ROADMAP-item-2 regime (8-way concurrency, N=1024 candidates) spans-on
+must stay within 2% of spans-off req/s and p50 (docs/serving.md). This
+driver measures exactly that, interleaved:
+
+- one pool per variant per round (``--workers`` numpy-set workers on a
+  fresh port, BLAS pinned by the pool's cores//workers default), the
+  variants alternating inside every round so host drift lands on both
+  sides — the same interleaving discipline as ``bench.py
+  --scenario-bench`` (sequential per-variant runs measured 0.5-1.35x
+  drift on identical code);
+- the policy is a randomly-initialized ``cluster_set`` transformer
+  served by the numpy backend — the A/B needs the real forward COST,
+  not a trained argmax — driven by ``extender_bench``'s soak loop;
+- best-of-rounds per variant, plus the on/off ratios and the 2% verdict
+  in ONE ``schema_version: 1`` JSON line.
+
+One command (the recipe docs/serving.md quotes)::
+
+    make span-ab            # 8-way, N=1024, 2 rounds x 10 s per variant
+    python loadgen/span_ab.py --nodes 1024 --threads 8 --workers 2 \
+        --rounds 2 --duration 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import statistics
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import extender_bench
+
+SCHEMA_VERSION = 1
+
+
+def _make_factory(np_tree: dict, spans: bool):
+    """Pool worker factory: numpy set backend over the pre-converted
+    params tree (pure numpy crosses fork cleanly; workers never touch
+    jax), table telemetry on the shared counter, spans per variant."""
+
+    def factory(worker_id, shared):
+        from rl_scheduler_tpu.scheduler.extender import ExtenderPolicy
+        from rl_scheduler_tpu.scheduler.set_backend import NumpySetBackend
+        from rl_scheduler_tpu.scheduler.telemetry import (
+            RandomCpu,
+            TableTelemetry,
+        )
+
+        telemetry = TableTelemetry.from_table(
+            cpu_source=RandomCpu(seed=worker_id),
+            counter=shared.table_counter)
+        return ExtenderPolicy(NumpySetBackend(np_tree), telemetry,
+                              spans=spans)
+
+    return factory
+
+
+def _run_variant(np_tree: dict, spans: bool, workers: int, nodes: int,
+                 threads: int, duration_s: float) -> dict:
+    from rl_scheduler_tpu.scheduler.pool import ServingPool
+
+    pool = ServingPool(_make_factory(np_tree, spans), workers=workers,
+                       host="127.0.0.1", port=0, control_port=0)
+    pool.start(ready_timeout_s=120.0)
+    try:
+        base = f"http://127.0.0.1:{pool.port}"
+        for i in range(2 * workers + 4):  # warm every worker's caches
+            extender_bench.one_request(base, i, nodes)
+        latencies, wall, failures, _ = extender_bench._soak(
+            base, duration_s, threads, nodes)
+    finally:
+        pool.shutdown()
+    latencies.sort()
+    p50 = latencies[len(latencies) // 2] if latencies else float("nan")
+    return {
+        "spans": spans,
+        "requests": len(latencies),
+        "failures": failures,
+        "req_per_sec": round(len(latencies) / wall, 2),
+        "p50_ms": round(p50, 3),
+    }
+
+
+def main(argv: list | None = None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--nodes", type=int, default=1024)
+    p.add_argument("--threads", type=int, default=8)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--rounds", type=int, default=2)
+    p.add_argument("--duration", type=float, default=10.0,
+                   help="seconds per variant per round")
+    p.add_argument("--dim", type=int, default=64)
+    args = p.parse_args(argv)
+
+    # Init the set transformer ONCE in the parent and hand workers a
+    # pure-numpy tree (same params both variants — the A/B compares the
+    # instrumentation, nothing else).
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rl_scheduler_tpu.models.transformer import SetTransformerPolicy
+
+    net = SetTransformerPolicy(dim=args.dim, depth=2)
+    tree = net.init(jax.random.PRNGKey(0), jnp.zeros((8, 6), jnp.float32))
+    np_tree = jax.tree_util.tree_map(np.asarray, tree)
+
+    rows = {True: [], False: []}
+    for r in range(args.rounds):
+        # Alternate which variant goes first per round so warm-host bias
+        # lands on both sides of the comparison.
+        order = (True, False) if r % 2 == 0 else (False, True)
+        for spans in order:
+            row = _run_variant(np_tree, spans, args.workers, args.nodes,
+                               args.threads, args.duration)
+            rows[spans].append(row)
+            print(f"round {r} spans={'on' if spans else 'off'}: "
+                  f"{row['req_per_sec']} req/s p50 {row['p50_ms']} ms "
+                  f"({row['requests']} reqs, {row['failures']} failures)",
+                  file=sys.stderr)
+
+    def best(variant_rows, key, lo_is_better):
+        vals = [row[key] for row in variant_rows]
+        return min(vals) if lo_is_better else max(vals)
+
+    on_rps = best(rows[True], "req_per_sec", False)
+    off_rps = best(rows[False], "req_per_sec", False)
+    on_p50 = best(rows[True], "p50_ms", True)
+    off_p50 = best(rows[False], "p50_ms", True)
+    rps_ratio = round(on_rps / off_rps, 4) if off_rps else None
+    p50_ratio = round(on_p50 / off_p50, 4) if off_p50 else None
+    out = {
+        "schema_version": SCHEMA_VERSION,
+        "bench": "span_ab",
+        "nodes": args.nodes,
+        "workers": args.workers,
+        "concurrency": args.threads,
+        "rounds": args.rounds,
+        "duration_s": args.duration,
+        "spans_on": {"req_per_sec": on_rps, "p50_ms": on_p50,
+                     "rounds_rps": [row["req_per_sec"]
+                                    for row in rows[True]]},
+        "spans_off": {"req_per_sec": off_rps, "p50_ms": off_p50,
+                      "rounds_rps": [row["req_per_sec"]
+                                     for row in rows[False]]},
+        "rps_ratio_on_over_off": rps_ratio,
+        "p50_ratio_on_over_off": p50_ratio,
+        "median_rps_ratio": round(
+            statistics.median(r["req_per_sec"] for r in rows[True])
+            / statistics.median(r["req_per_sec"] for r in rows[False]), 4),
+        # The acceptance bound: spans-on within 2% of spans-off on both
+        # axes (best-of-rounds — the noise floor estimator the repo's
+        # interleaved benches use).
+        "within_2pct": bool(rps_ratio is not None and rps_ratio >= 0.98
+                            and p50_ratio is not None and p50_ratio <= 1.02),
+    }
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    main()

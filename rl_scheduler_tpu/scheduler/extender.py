@@ -24,6 +24,16 @@ the extender protocol, answering
   the serving path is scrapeable by the stack the framework already
   reads telemetry from (``telemetry.PrometheusCpu``).
 
+graftlens (docs/observability.md): the decision hot path is additionally
+instrumented with cheap monotonic per-phase spans — request-parse,
+telemetry-observe, backend-forward, priority-marshal, trace-append —
+feeding one :class:`LatencyStats` per phase (``/stats`` percentiles,
+``/metrics`` lifetime histograms, span breakdown on every trace record),
+plus an optional SLO engine (``scheduler/slo.py``: ``--slo-p99-ms`` /
+``--slo-avail`` burn-rate gauges, ``/healthz`` degradation). Synthetic
+``warmup_probe`` traffic is excluded from every client-facing histogram
+and SLO counter at record time.
+
 Node -> cloud mapping uses the ``cloud: aws|azure`` node labels that the
 kind cluster configs apply (reference ``aws-cluster-config.yaml:12-14``),
 falling back to substring matching on node names. Unknown-cloud nodes pass
@@ -51,7 +61,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from rl_scheduler_tpu.scheduler.policy_backend import make_backend
-from rl_scheduler_tpu.scheduler.tracelog import decision_record
+from rl_scheduler_tpu.scheduler.tracelog import decision_record, obs_digest
 from rl_scheduler_tpu.utils.retry import CircuitOpenError
 from rl_scheduler_tpu.scheduler.telemetry import (
     PrometheusCpu,
@@ -63,6 +73,17 @@ logger = logging.getLogger(__name__)
 
 CLOUDS = ("aws", "azure")
 MAX_EXTENDER_SCORE = 100
+# graftlens decision-path phases, in hot-path order (docs/observability.md):
+#   parse    — request-parse: node/pod extraction + the candidate cap draw
+#   observe  — telemetry-observe/obs-build: table replay + cpu sample into
+#              the finished observation array (graph: topology + raw-price
+#              row + graph obs build)
+#   forward  — backend-forward: the policy forward through the breaker
+#   marshal  — priority-marshal: softmax/score mapping + response body
+#   trace    — trace-append: obs digest + replay position + record build
+# Each phase feeds its own LatencyStats; sums reconcile against the
+# end-to-end decide histogram (pinned by test, read by tools/decisionview).
+PHASES = ("parse", "observe", "forward", "marshal", "trace")
 # Serving-time default for the arriving pod's cpu request as a fraction of
 # node capacity: the midpoint of the training distribution
 # (env/cluster_set.py pod_cpu ~ U[0.1, 0.4]) when the request carries no
@@ -285,6 +306,73 @@ class LatencyStats:
         return totals, total_sum, total_count
 
 
+def phase_metric_lines(prefix: str, histograms: dict) -> list:
+    """Prometheus exposition for the graftlens per-phase latency
+    histograms. ``histograms`` maps phase name to the
+    ``LatencyStats.histogram()`` tuple — the single-process plane passes
+    its own stats, the pool passes per-phase merged histograms, so both
+    planes export the identical metric shape (one scrape config)."""
+    lines = [
+        f"# HELP {prefix}_phase_latency_seconds Decision-path time per "
+        "graftlens phase (parse/observe/forward/marshal/trace; lifetime "
+        "histogram, /stats/reset does not clear it).",
+        f"# TYPE {prefix}_phase_latency_seconds histogram",
+    ]
+    bounds = [f"{b:g}" for b in LatencyStats.BUCKETS] + ["+Inf"]
+    for phase in sorted(histograms):
+        cumulative, total_sum, count = histograms[phase]
+        for bound, c in zip(bounds, cumulative):
+            lines.append(
+                f'{prefix}_phase_latency_seconds_bucket'
+                f'{{phase="{phase}",le="{bound}"}} {c}')
+        lines.append(f'{prefix}_phase_latency_seconds_sum'
+                     f'{{phase="{phase}"}} {total_sum:.9g}')
+        lines.append(f'{prefix}_phase_latency_seconds_count'
+                     f'{{phase="{phase}"}} {count}')
+    return lines
+
+
+def slo_metric_lines(prefix: str, snapshot: dict) -> list:
+    """Prometheus exposition for an SLO snapshot (scheduler/slo.py) —
+    shared by the single-process plane and the pool's merged snapshot."""
+    lines = [
+        f"# HELP {prefix}_slo_burn_rate Error-budget burn rate per "
+        "objective and window (1.0 = burning exactly the budget).",
+        f"# TYPE {prefix}_slo_burn_rate gauge",
+    ]
+    for name, objective in sorted(snapshot["objectives"].items()):
+        for wname, window in sorted(objective["windows"].items()):
+            lines.append(
+                f'{prefix}_slo_burn_rate{{objective="{name}",'
+                f'window="{wname}"}} {window["burn_rate"]:.9g}')
+    lines += [
+        f"# HELP {prefix}_slo_burning Objective is burning (both "
+        "windows over threshold).",
+        f"# TYPE {prefix}_slo_burning gauge",
+    ]
+    for name, objective in sorted(snapshot["objectives"].items()):
+        lines.append(f'{prefix}_slo_burning{{objective="{name}"}} '
+                     f'{1 if objective["burning"] else 0}')
+    lifetime = snapshot.get("lifetime", {})
+    lines += [
+        f"# HELP {prefix}_slo_degraded Any objective burning (the "
+        "/healthz degradation signal).",
+        f"# TYPE {prefix}_slo_degraded gauge",
+        f"{prefix}_slo_degraded {1 if snapshot['degraded'] else 0}",
+        f"# HELP {prefix}_slo_requests_total Requests observed by the "
+        "SLO tracker (probe traffic excluded), lifetime.",
+        f"# TYPE {prefix}_slo_requests_total counter",
+        f"{prefix}_slo_requests_total "
+        f"{lifetime.get('requests_total', 0)}",
+        f"# HELP {prefix}_slo_latency_bad_total Decided requests over "
+        "the latency threshold, lifetime.",
+        f"# TYPE {prefix}_slo_latency_bad_total counter",
+        f"{prefix}_slo_latency_bad_total "
+        f"{lifetime.get('latency_bad_total', 0)}",
+    ]
+    return lines
+
+
 class AsyncPlacer:
     """Bounded async wrapper around a pod placer.
 
@@ -358,7 +446,9 @@ class ExtenderPolicy:
                  max_score_nodes: int = 0,
                  price_counter=None,
                  num_resources: int = 0,
-                 scenario: str | None = None):
+                 scenario: str | None = None,
+                 spans: bool = True,
+                 slo=None):
         self.backend = backend
         self.family = getattr(backend, "family", "cloud")
         self.telemetry = telemetry
@@ -438,6 +528,19 @@ class ExtenderPolicy:
             name="backend", failure_threshold=5, reset_timeout_s=10.0,
         )
         self.stats = LatencyStats()
+        # graftlens: one LatencyStats per decision-path phase (PHASES).
+        # `spans` off skips all recording (the A/B knob, --no-spans);
+        # the stats objects exist either way so readers never branch.
+        self.spans_enabled = bool(spans)
+        self.phase_stats = {phase: LatencyStats() for phase in PHASES}
+        # graftlens: optional SLO tracker (scheduler/slo.py). None keeps
+        # every path byte-identical; build_policy arms it from
+        # --slo-p99-ms / --slo-avail.
+        self.slo = slo
+        # Per-REQUEST span accumulator + the synthetic-traffic flag, both
+        # thread-local (ThreadingHTTPServer serves one request per
+        # thread; the pool's control loop runs probes on its own thread).
+        self._req_local = threading.local()
         # Structured-family decisions can land on an unknown-cloud node
         # (scored from neutral features); give those their own bucket.
         keys = CLOUDS + (("unknown",) if self.family in self.STRUCTURED else ())
@@ -456,46 +559,119 @@ class ExtenderPolicy:
         raises), successes/failures drive its state."""
         return self.backend_breaker.call(fn, *args)
 
+    # ------------------------------------------------------ graftlens spans
+
+    def _span_begin(self) -> None:
+        """Open a fresh per-request span accumulator on this thread
+        (request entry: filter/prioritize/warmup_probe). Replaces any
+        stale dict a direct decide() call may have left behind."""
+        self._req_local.spans = {} if self.spans_enabled else None
+
+    def _span_add(self, phase: str, seconds: float) -> None:
+        """Charge ``seconds`` to ``phase`` for the current request; a
+        no-op outside a request context or with spans disabled. Multiple
+        charges to one phase accumulate (e.g. ``parse`` spans both the
+        node extraction and the pod parse)."""
+        spans = getattr(self._req_local, "spans", None)
+        if spans is not None:
+            spans[phase] = spans.get(phase, 0.0) + seconds
+
+    def _span_finish(self, drop: bool = False) -> dict | None:
+        """Close the request's span accumulator: record each phase into
+        its lifetime LatencyStats (unless ``drop`` — synthetic probes
+        and fail-open requests must not land in client-facing
+        histograms) and return the span dict in milliseconds for the
+        trace record."""
+        spans = getattr(self._req_local, "spans", None)
+        self._req_local.spans = None
+        if spans is None:
+            return None
+        if not drop and not self._synthetic:
+            for phase, seconds in spans.items():
+                self.phase_stats[phase].record(seconds)
+        return {phase: round(seconds * 1e3, 4)
+                for phase, seconds in spans.items()}
+
+    @property
+    def _synthetic(self) -> bool:
+        """True while this thread serves a warmup_probe: synthetic
+        traffic is excluded from the latency/phase histograms and SLO
+        counters the canary gates and dashboards read (tagged
+        ``endpoint=probe`` in the trace instead)."""
+        return getattr(self._req_local, "synthetic", False)
+
+    def _record_latency(self, seconds: float) -> None:
+        """One successful decision's end-to-end latency: the lifetime
+        histogram + ring, and the SLO latency objective — both skipped
+        for synthetic probe traffic (pinned by test)."""
+        if self._synthetic:
+            return
+        self.stats.record(seconds)
+        if self.slo is not None:
+            self.slo.observe(seconds)
+
     def _record_trace(self, endpoint: str, *, candidates: int,
                       chosen: str | None, score: float | None, obs,
                       t0: float, fail_open: bool = False) -> None:
-        """Append one decision record to the durable trace (tracelog.py)
-        and count fail-opens. Hot-path cost: one obs digest (computed at
-        the source ON PURPOSE — it must fingerprint what was actually
-        served, not a queue-held array a later request could alias) plus
-        one bounded-queue put that never blocks; with no trace
-        configured the fail-open counter is the only work."""
+        """Append one decision record to the durable trace (tracelog.py),
+        count fail-opens, and close out the request's graftlens spans.
+        Hot-path cost: one obs digest (computed at the source ON PURPOSE
+        — it must fingerprint what was actually served, not a queue-held
+        array a later request could alias) plus one bounded-queue put
+        that never blocks; with no trace configured the fail-open/SLO
+        counters and the span close-out are the only work."""
         if fail_open:
             with self._lock:
                 self._fail_open_total += 1
+            if self.slo is not None and not self._synthetic:
+                self.slo.observe_failure()
         if self.trace is None:
+            # Still close the span accumulator: phase stats are recorded
+            # with or without a trace log attached (fail-open requests
+            # drop their partial spans, like the end-to-end histogram).
+            # The trace phase charges its true cost — zero — so every
+            # phase histogram carries one sample per served decision
+            # (the count-uniformity invariant decisionview relies on).
+            self._span_add("trace", 0.0)
+            self._span_finish(drop=fail_open)
             return
+        t_trace = time.perf_counter()
         try:
             telemetry_pos = self.telemetry.last_replay_position()
         except AttributeError:  # policy stand-ins with bare telemetry
             telemetry_pos = None
+        digest = obs_digest(obs)
+        # The digest + provenance lookup are the measurable trace-append
+        # cost; the remaining bounded-queue put never blocks.
+        self._span_add("trace", time.perf_counter() - t_trace)
+        spans_ms = self._span_finish(drop=fail_open)
         self.trace.append(decision_record(
             endpoint=endpoint, family=self.family,
             backend=getattr(self.backend, "name",
                             self.backend.__class__.__name__),
             candidates=candidates, chosen=chosen, score=score,
-            latency_ms=(time.perf_counter() - t0) * 1e3, obs=obs,
+            latency_ms=(time.perf_counter() - t0) * 1e3, obs_sha=digest,
             telemetry_pos=telemetry_pos,
             worker_id=(self.pool_info or {}).get("worker_id"),
             generation=self.generation, fail_open=fail_open,
-            breaker_state=self.backend_breaker.state,
+            breaker_state=self.backend_breaker.state, spans=spans_ms,
         ))
 
     def decide(self) -> tuple[int, np.ndarray, np.ndarray]:
         """One placement decision: ``(action, probs, obs)``; timed."""
         t0 = time.perf_counter()
         obs = self.telemetry.observe()
+        t_obs = time.perf_counter()
         action, logits = self._backend_call(self.backend.decide, obs)
-        self.stats.record(time.perf_counter() - t0)
+        t_fwd = time.perf_counter()
+        self._record_latency(t_fwd - t0)
+        self._span_add("observe", t_obs - t0)
+        self._span_add("forward", t_fwd - t_obs)
         z = logits - logits.max()
         probs = np.exp(z) / np.exp(z).sum()
         with self._lock:
             self._decisions[CLOUDS[action]] += 1
+        self._span_add("marshal", time.perf_counter() - t_fwd)
         return action, probs, obs
 
     def decide_set(self, clouds: list, pod_cpu: float,
@@ -512,12 +688,17 @@ class ExtenderPolicy:
                                                    self.num_resources)
         else:
             obs = self.telemetry.observe_nodes(clouds, pod_cpu)
+        t_obs = time.perf_counter()
         action, logits = self._backend_call(self.backend.decide_nodes, obs)
-        self.stats.record(time.perf_counter() - t0)
+        t_fwd = time.perf_counter()
+        self._record_latency(t_fwd - t0)
+        self._span_add("observe", t_obs - t0)
+        self._span_add("forward", t_fwd - t_obs)
         z = logits - logits.max()
         probs = np.exp(z) / np.exp(z).sum()
         with self._lock:
             self._decisions[clouds[action] or "unknown"] += 1
+        self._span_add("marshal", time.perf_counter() - t_fwd)
         return action, probs, obs
 
     def decide_graph(self, clouds: list, display: list,
@@ -544,16 +725,22 @@ class ExtenderPolicy:
             affinity = display.index(aff_name)
         obs = build_graph_obs(clouds, price_row, cpus, hops, adj,
                               affinity, pod_cpu, step_frac)
+        t_obs = time.perf_counter()
         action, logits = self._backend_call(self.backend.decide_nodes, obs, adj)
-        self.stats.record(time.perf_counter() - t0)
+        t_fwd = time.perf_counter()
+        self._record_latency(t_fwd - t0)
+        self._span_add("observe", t_obs - t0)
+        self._span_add("forward", t_fwd - t_obs)
         z = logits - logits.max()
         probs = np.exp(z) / np.exp(z).sum()
         with self._lock:
             self._decisions[clouds[action] or "unknown"] += 1
+        self._span_add("marshal", time.perf_counter() - t_fwd)
         return action, probs, obs
 
     def _structured_decide(self, args: dict, display: list,
                            clouds: list) -> tuple[int, np.ndarray, np.ndarray]:
+        t_parse = time.perf_counter()
         pod = args.get("pod")
         pod_cpu = pod_cpu_fraction(pod, self.node_capacity_cores)
         cap = self.max_score_nodes
@@ -574,14 +761,18 @@ class ExtenderPolicy:
         if self.family == "set":
             pod_reqs = (pod_resource_fractions(pod, self.node_capacity_cores)
                         if self.num_resources else None)
+            self._span_add("parse", time.perf_counter() - t_parse)
             action, probs, obs = self.decide_set(sub_clouds, pod_cpu, pod_reqs)
         else:
+            self._span_add("parse", time.perf_counter() - t_parse)
             action, probs, obs = self.decide_graph(sub_clouds, sub_display,
                                                    pod, pod_cpu)
         if idx is not None:
+            t_m = time.perf_counter()
             full = np.zeros(len(clouds), probs.dtype)
             full[idx] = probs
             action, probs = idx[action], full
+            self._span_add("marshal", time.perf_counter() - t_m)
         return action, probs, obs
 
     @staticmethod
@@ -616,7 +807,10 @@ class ExtenderPolicy:
     def _filter_structured(self, args: dict) -> dict:
         """Structured-family (set/graph) ExtenderFilterResult: keep the
         argmax node; fail open."""
+        self._span_begin()
+        t_parse = time.perf_counter()
         use_names, sources, display, clouds = self._request_nodes(args)
+        self._span_add("parse", time.perf_counter() - t_parse)
         if not sources:
             return self._passthrough(args)
         t0 = time.perf_counter()
@@ -639,25 +833,35 @@ class ExtenderPolicy:
                                chosen=None, score=None, obs=None, t0=t0,
                                fail_open=True)
             return self._passthrough(args)
-        self._record_trace("filter", candidates=len(sources),
-                           chosen=display[action],
-                           score=float(probs[action]), obs=obs, t0=t0)
-        if self.placer is not None and clouds[action] is not None:
-            self.placer.submit(clouds[action])
+        t_marshal = time.perf_counter()
         failed = {
             name: f"{self.family} policy ranked {display[action]} first"
             for i, name in enumerate(display) if i != action
         }
         if use_names:
-            return {"nodenames": [sources[action]], "failedNodes": failed,
-                    "error": ""}
-        return {"nodes": {"items": [sources[action]]}, "failedNodes": failed,
-                "error": ""}
+            result = {"nodenames": [sources[action]], "failedNodes": failed,
+                      "error": ""}
+        else:
+            result = {"nodes": {"items": [sources[action]]},
+                      "failedNodes": failed, "error": ""}
+        self._span_add("marshal", time.perf_counter() - t_marshal)
+        if self.placer is not None and clouds[action] is not None:
+            self.placer.submit(clouds[action])
+        # Trace record LAST (the trace-append phase closes the span
+        # breakdown): its latency_ms now covers marshaling too — the
+        # record describes the whole answered request.
+        self._record_trace("filter", candidates=len(sources),
+                           chosen=display[action],
+                           score=float(probs[action]), obs=obs, t0=t0)
+        return result
 
     def _prioritize_structured(self, args: dict) -> list[dict]:
         """Structured-family HostPriorityList: per-node softmax -> 0-100
         scores (rank-preserving; the argmax node always scores 100)."""
+        self._span_begin()
+        t_parse = time.perf_counter()
         _, sources, display, clouds = self._request_nodes(args)
+        self._span_add("parse", time.perf_counter() - t_parse)
         if not sources:
             return []
         t0 = time.perf_counter()
@@ -677,16 +881,21 @@ class ExtenderPolicy:
                                chosen=None, score=None, obs=None, t0=t0,
                                fail_open=True)
             return self._uniform_priorities(display)
+        t_marshal = time.perf_counter()
         scores = np.round(probs / probs.max() * MAX_EXTENDER_SCORE)
+        result = [{"host": name, "score": int(s)}
+                  for name, s in zip(display, scores)]
+        self._span_add("marshal", time.perf_counter() - t_marshal)
         # Success record OUTSIDE the try (like the filter paths): a
         # trace-layer raise must never downgrade a computed answer to
         # uniform scores, nor count a spurious fail-open the rollout
-        # canary gate would read as a regression.
+        # canary gate would read as a regression. Recorded after the
+        # marshal so the span breakdown (and latency_ms) covers the
+        # whole answered request.
         self._record_trace("prioritize", candidates=len(sources),
                            chosen=display[action],
                            score=float(probs[action]), obs=obs, t0=t0)
-        return [{"host": name, "score": int(s)}
-                for name, s in zip(display, scores)]
+        return result
 
     @staticmethod
     def _uniform_priorities(display: list) -> list[dict]:
@@ -703,34 +912,47 @@ class ExtenderPolicy:
         canary that only passes through is not promotable."""
         sources = ["aws-probe-0", "azure-probe-1"]
         clouds = [node_cloud(s) for s in sources]
+        # Synthetic-traffic flag for the whole probe: the decide path
+        # must not land this in the latency/phase histograms or SLO
+        # counters client-facing scrapes and canary gates read (the
+        # trace record's endpoint=probe tag is the replay-side filter).
+        self._req_local.synthetic = True
+        self._span_begin()
         t0 = time.perf_counter()
         try:
-            if self.family in self.STRUCTURED:
-                action, probs, obs = self._structured_decide(
-                    {"pod": {}}, sources, clouds)
-                chosen = sources[action]
-            else:
-                action, probs, obs = self.decide()
-                chosen = CLOUDS[action]
-        except Exception:  # noqa: BLE001 — CircuitOpenError included:
-            # a fail-open probe IS the gate's signal, not an error
-            logger.debug("warm-up probe failed open", exc_info=True)
+            try:
+                if self.family in self.STRUCTURED:
+                    action, probs, obs = self._structured_decide(
+                        {"pod": {}}, sources, clouds)
+                    chosen = sources[action]
+                else:
+                    action, probs, obs = self.decide()
+                    chosen = CLOUDS[action]
+            except Exception:  # noqa: BLE001 — CircuitOpenError included:
+                # a fail-open probe IS the gate's signal, not an error
+                logger.debug("warm-up probe failed open", exc_info=True)
+                self._record_trace("probe", candidates=len(sources),
+                                   chosen=None, score=None, obs=None, t0=t0,
+                                   fail_open=True)
+                return {"decided": False,
+                        "latency_ms": round((time.perf_counter() - t0) * 1e3,
+                                            3)}
             self._record_trace("probe", candidates=len(sources),
-                               chosen=None, score=None, obs=None, t0=t0,
-                               fail_open=True)
-            return {"decided": False,
-                    "latency_ms": round((time.perf_counter() - t0) * 1e3,
-                                        3)}
-        self._record_trace("probe", candidates=len(sources), chosen=chosen,
-                           score=float(probs[action]), obs=obs, t0=t0)
-        return {"decided": True,
-                "latency_ms": round((time.perf_counter() - t0) * 1e3, 3)}
+                               chosen=chosen,
+                               score=float(probs[action]), obs=obs, t0=t0)
+            return {"decided": True,
+                    "latency_ms": round((time.perf_counter() - t0) * 1e3, 3)}
+        finally:
+            self._req_local.synthetic = False
 
     def filter(self, args: dict) -> dict:
         """ExtenderFilterResult: keep nodes on the chosen cloud; fail open."""
         if self.family in self.STRUCTURED:
             return self._filter_structured(args)
+        self._span_begin()
+        t_parse = time.perf_counter()
         use_names, sources, display, clouds = self._request_nodes(args)
+        self._span_add("parse", time.perf_counter() - t_parse)
         if not sources:
             # Nothing parseable to score (empty request, or every field/
             # item was junk): echo the request through rather than answer
@@ -754,11 +976,10 @@ class ExtenderPolicy:
                                fail_open=True)
             return self._passthrough(args)
         chosen = CLOUDS[action]
-        self._record_trace("filter", candidates=len(sources), chosen=chosen,
-                           score=float(probs[action]), obs=obs, t0=t0)
         if self.placer is not None:
             self.placer.submit(chosen)
 
+        t_marshal = time.perf_counter()
         kept, failed = [], {}
         for src, name, cloud in zip(sources, display, clouds):
             if cloud is None or cloud == chosen:
@@ -766,14 +987,23 @@ class ExtenderPolicy:
             else:
                 failed[name] = f"policy selected {chosen}"
         if use_names:
-            return {"nodenames": kept, "failedNodes": failed, "error": ""}
-        return {"nodes": {"items": kept}, "failedNodes": failed, "error": ""}
+            result = {"nodenames": kept, "failedNodes": failed, "error": ""}
+        else:
+            result = {"nodes": {"items": kept}, "failedNodes": failed,
+                      "error": ""}
+        self._span_add("marshal", time.perf_counter() - t_marshal)
+        self._record_trace("filter", candidates=len(sources), chosen=chosen,
+                           score=float(probs[action]), obs=obs, t0=t0)
+        return result
 
     def prioritize(self, args: dict) -> list[dict]:
         """HostPriorityList: score = policy probability of the node's cloud."""
         if self.family in self.STRUCTURED:
             return self._prioritize_structured(args)
+        self._span_begin()
+        t_parse = time.perf_counter()
         _, _, display, clouds = self._request_nodes(args)
+        self._span_add("parse", time.perf_counter() - t_parse)
         t0 = time.perf_counter()
         action = obs = None
         try:
@@ -784,6 +1014,15 @@ class ExtenderPolicy:
         except Exception:
             logger.exception("policy decision failed; uniform priorities")
             probs = np.full(len(CLOUDS), 1.0 / len(CLOUDS))
+        t_marshal = time.perf_counter()
+        out = []
+        for name, cloud in zip(display, clouds):
+            if cloud is None:
+                score = MAX_EXTENDER_SCORE // 2
+            else:
+                score = int(round(float(probs[CLOUDS.index(cloud)]) * MAX_EXTENDER_SCORE))
+            out.append({"host": name, "score": score})
+        self._span_add("marshal", time.perf_counter() - t_marshal)
         if action is not None:
             # Success record outside the try — see _prioritize_structured.
             self._record_trace("prioritize", candidates=len(display),
@@ -793,13 +1032,6 @@ class ExtenderPolicy:
             self._record_trace("prioritize", candidates=len(display),
                                chosen=None, score=None, obs=None, t0=t0,
                                fail_open=True)
-        out = []
-        for name, cloud in zip(display, clouds):
-            if cloud is None:
-                score = MAX_EXTENDER_SCORE // 2
-            else:
-                score = int(round(float(probs[CLOUDS.index(cloud)]) * MAX_EXTENDER_SCORE))
-            out.append({"host": name, "score": score})
         return out
 
     @staticmethod
@@ -818,10 +1050,13 @@ class ExtenderPolicy:
         requests since the reset. Round-4 finding: the 4096-entry ring
         spans ~3 consecutive 1500-request bench runs, so per-configuration
         percentiles were contaminated by the preceding run's traffic.
-        Lifetime counters — histograms, fail-opens, trace-writer stats,
-        and the pool's promotion/rollback totals — are deliberately NOT
-        cleared (Prometheus monotonicity; pinned by test)."""
+        Lifetime counters — histograms (end-to-end AND per-phase),
+        fail-opens, SLO counters, trace-writer stats, and the pool's
+        promotion/rollback totals — are deliberately NOT cleared
+        (Prometheus monotonicity; pinned by test)."""
         self.stats.reset()
+        for stats in self.phase_stats.values():
+            stats.reset()
         return {"status": "reset"}
 
     def breakers(self) -> dict:
@@ -841,6 +1076,21 @@ class ExtenderPolicy:
     def health(self) -> dict:
         out = {"status": "ok", "backend": self.backend.name,
                "family": self.family}
+        if self.slo is not None:
+            # Fast-burn degradation is VISIBLE on the data-plane health
+            # body but stays HTTP 200 there: k8s liveness must not
+            # restart-storm a process that is merely slow. The pool
+            # control plane (the readiness probe) answers 503 while
+            # degraded (scheduler/pool.py).
+            snap = self.slo.snapshot()
+            out["slo"] = {
+                "degraded": snap["degraded"],
+                "burning": sorted(name for name, o in
+                                  snap["objectives"].items()
+                                  if o["burning"]),
+            }
+            if snap["degraded"]:
+                out["status"] = "degraded"
         if self.scenario is not None:
             out["scenario"] = self.scenario
         if self.pool_info is not None:
@@ -865,6 +1115,20 @@ class ExtenderPolicy:
             # the rollout canary gate compares deltas of this.
             "fail_open_total": fail_open,
         }
+        if self.spans_enabled:
+            # graftlens: per-phase percentiles (reset-scoped ring) plus
+            # lifetime mean/count from the monotonic histogram — the
+            # merge-safe numbers tools/decisionview's phase table reads.
+            out["phases"] = {
+                phase: self._phase_entry(stats)
+                for phase, stats in self.phase_stats.items()
+            }
+            cumulative, total_sum, count = self.stats.histogram()
+            out["latency"]["lifetime_mean_ms"] = (
+                round(total_sum / count * 1e3, 4) if count else None)
+            out["latency"]["lifetime_count"] = count
+        if self.slo is not None:
+            out["slo"] = self.slo.snapshot()
         if self.trace is not None:
             # Trace-writer counters (records/dropped/write_errors/
             # segments). Lifetime-monotonic like the histogram —
@@ -889,6 +1153,18 @@ class ExtenderPolicy:
         # read, not a log dive (docs/robustness.md).
         out["breakers"] = self.breakers()
         return out
+
+    @staticmethod
+    def _phase_entry(stats: "LatencyStats") -> dict:
+        """One phase's ``/stats`` body: ring percentiles + lifetime
+        mean/count (lifetime numbers merge exactly across workers; ring
+        percentiles are this process's reset-scoped window)."""
+        entry = stats.percentiles_ms()
+        _, total_sum, count = stats.histogram()
+        entry["lifetime_mean_ms"] = (round(total_sum / count * 1e3, 4)
+                                     if count else None)
+        entry["lifetime_count"] = count
+        return entry
 
     def metrics_text(self) -> str:
         """Prometheus text exposition (``GET /metrics``): decision
@@ -920,6 +1196,12 @@ class ExtenderPolicy:
             )
         lines.append(f"{p}_decision_latency_seconds_sum {total_sum:.9g}")
         lines.append(f"{p}_decision_latency_seconds_count {count}")
+        if self.spans_enabled:
+            lines += phase_metric_lines(
+                p, {phase: stats.histogram()
+                    for phase, stats in self.phase_stats.items()})
+        if self.slo is not None:
+            lines += slo_metric_lines(p, self.slo.snapshot())
         shed = getattr(self.backend, "shed_fraction", None)
         if shed is not None:
             lines += [
@@ -1124,6 +1406,9 @@ def build_policy(
     scenario: str | None = None,
     trace_dir: str | None = None,
     trace_prefix: str = "",
+    spans: bool = True,
+    slo_p99_ms: float | None = None,
+    slo_avail: float | None = None,
 ) -> ExtenderPolicy:
     """Assemble the serving stack: checkpoint -> backend -> telemetry.
 
@@ -1284,16 +1569,29 @@ def build_policy(
         from rl_scheduler_tpu.scheduler.k8s_client import DryRunPodPlacer
 
         placer = DryRunPodPlacer()
+    slo = None
+    if slo_p99_ms is not None or slo_avail is not None:
+        # graftlens SLO engine (scheduler/slo.py): SloConfig validates
+        # the objectives up front — a bad threshold refuses before
+        # traffic, like every other serve-config knob.
+        from rl_scheduler_tpu.scheduler.slo import SloConfig, SloTracker
+
+        slo = SloTracker(SloConfig(p99_ms=slo_p99_ms,
+                                   availability=slo_avail))
     policy = ExtenderPolicy(backend_obj, telemetry, placer,
                             node_capacity_cores=node_capacity_cores,
                             price_replay=price_replay,
                             price_replay_period_s=price_replay_period_s,
                             max_score_nodes=max_score_nodes,
                             price_counter=price_counter)
-    # Scenario provenance set post-construction (the attributes default to
-    # off in __init__): policy stand-ins that mimic the historical ctor
-    # signature keep working, and only checkpoint-meta-driven builds flip
-    # them.
+    # Scenario provenance (and the graftlens knobs below) set
+    # post-construction (the attributes default in __init__): policy
+    # stand-ins that mimic the historical ctor signature keep working,
+    # and only checkpoint-meta/serve-config-driven builds flip them.
+    if not spans:
+        policy.spans_enabled = False
+    if slo is not None:
+        policy.slo = slo
     if num_resources:
         policy.num_resources = num_resources
     if ckpt_scenario is not None:
@@ -1430,6 +1728,24 @@ def main(argv: list[str] | None = None) -> None:
                         "path never blocks). In pool mode each worker "
                         "writes its own w<id>- stream into the shared "
                         "directory. Omit to disable (docs/serving.md)")
+    p.add_argument("--no-spans", action="store_true",
+                   help="graftlens: disable the per-phase decision-path "
+                        "spans (parse/observe/forward/marshal/trace). "
+                        "The A/B knob for the measured span-overhead "
+                        "bound (docs/serving.md); leave spans ON in "
+                        "production — they are what makes the latency "
+                        "decomposable")
+    p.add_argument("--slo-p99-ms", type=float, default=None, metavar="MS",
+                   help="graftlens SLO: arm the latency objective — 99%% "
+                        "of decisions under MS milliseconds. Burn-rate "
+                        "gauges on /metrics, degraded /healthz on "
+                        "fast+slow-window burn, and (pool mode) a canary "
+                        "gate for POST /promote (docs/observability.md)")
+    p.add_argument("--slo-avail", type=float, default=None, metavar="F",
+                   help="graftlens SLO: arm the availability objective — "
+                        "at least fraction F of requests answered by a "
+                        "real policy decision (fail-open passthroughs "
+                        "are the error budget), e.g. 0.999")
     p.add_argument("--price-replay-period", type=float, default=300.0,
                    help="wallclock replay only: real-world seconds one "
                         "pricing-table row represents (default 300 — the "
@@ -1510,6 +1826,9 @@ def main(argv: list[str] | None = None) -> None:
         max_score_nodes=args.max_score_nodes,
         scenario=args.scenario,
         trace_dir=args.trace_dir,
+        spans=not args.no_spans,
+        slo_p99_ms=args.slo_p99_ms,
+        slo_avail=args.slo_avail,
     )
     if args.workers is not None:
         # graftserve: the supervisor never builds a policy (workers each

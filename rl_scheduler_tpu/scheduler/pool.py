@@ -79,12 +79,18 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from rl_scheduler_tpu.scheduler.extender import LatencyStats, make_server
+from rl_scheduler_tpu.scheduler.extender import (
+    LatencyStats,
+    make_server,
+    phase_metric_lines,
+    slo_metric_lines,
+)
 from rl_scheduler_tpu.scheduler.rollout import (
     STATE_CODES,
     RolloutController,
     WorkerSpec,
 )
+from rl_scheduler_tpu.scheduler import slo as slo_mod
 from rl_scheduler_tpu.utils.retry import CircuitBreaker, RetryPolicy
 
 logger = logging.getLogger(__name__)
@@ -145,6 +151,18 @@ def worker_snapshot(policy, worker_id: int | None = None) -> dict:
     worker) and trace-writer counters when a trace log is attached."""
     cumulative, total_sum, count = policy.stats.histogram()
     trace = getattr(policy, "trace", None)
+    # graftlens: raw per-phase lifetime histograms (the one shape that
+    # merges exactly across workers) and the SLO snapshot (window counts
+    # merge via slo.merge_snapshots). Both None on pre-graftlens or
+    # spans-off policies — aggregation tolerates the gap.
+    phases = None
+    if getattr(policy, "spans_enabled", False):
+        phases = {}
+        for phase, stats in policy.phase_stats.items():
+            p_cum, p_sum, p_count = stats.histogram()
+            phases[phase] = {"cumulative": p_cum, "sum": p_sum,
+                             "count": p_count}
+    tracker = getattr(policy, "slo", None)
     return {
         "schema": SNAPSHOT_SCHEMA,
         "worker_id": worker_id,
@@ -157,6 +175,8 @@ def worker_snapshot(policy, worker_id: int | None = None) -> dict:
             "sum": total_sum,
             "count": count,
         },
+        "phases": phases,
+        "slo": tracker.snapshot() if tracker is not None else None,
     }
 
 
@@ -259,7 +279,34 @@ def merge_worker_histograms(snapshots: list) -> tuple[list, float, int]:
     )
 
 
-def aggregate_stats(snapshots: list, pool: dict, merged=None) -> dict:
+def merge_phase_histograms(snapshots: list) -> dict:
+    """graftlens: the pool's per-phase union histograms —
+    ``{phase: (cumulative, sum, count)}`` via the SAME
+    ``merged_histogram`` machinery as the end-to-end latency (bucket
+    sums of per-worker cumulative counts ARE the union stream's
+    buckets). Workers without spans (pre-graftlens, ``--no-spans``)
+    simply contribute nothing; empty result when no worker spans."""
+    by_phase: dict = {}
+    for snap in snapshots:
+        for phase, hist in (snap.get("phases") or {}).items():
+            by_phase.setdefault(phase, []).append(_HistogramView(hist))
+    return {
+        phase: LatencyStats.merged_histogram(views)
+        for phase, views in sorted(by_phase.items())
+    }
+
+
+def merge_worker_slo(snapshots: list) -> dict | None:
+    """Pool-wide SLO snapshot (``slo.merge_snapshots``): window counts
+    and lifetime counters sum, burn rates recompute from the sums.
+    ``None`` when no worker tracks SLOs."""
+    return slo_mod.merge_snapshots(
+        [s.get("slo") for s in snapshots if s.get("slo")]
+    )
+
+
+def aggregate_stats(snapshots: list, pool: dict, merged=None,
+                    phase_hists=None) -> dict:
     """The pool-wide ``GET /stats`` body from per-worker snapshots.
 
     Decision counts sum; latency percentiles come from
@@ -267,8 +314,9 @@ def aggregate_stats(snapshots: list, pool: dict, merged=None) -> dict:
     merge that is exact; each worker's reset-scoped ring percentiles ride
     in ``workers[]``); shed/reroute fractions are request-weighted;
     breakers merge per boundary via ``CircuitBreaker.merge_snapshots``.
-    ``merged`` lets a caller that already merged the histograms (the
-    ``/metrics`` exposition) share the computation.
+    ``merged``/``phase_hists`` let a caller that already merged the
+    (end-to-end / per-phase) histograms — the ``/metrics`` exposition —
+    share the computation.
     """
     merged_cum, merged_sum, merged_count = (
         merged if merged is not None else merge_worker_histograms(snapshots)
@@ -281,6 +329,11 @@ def aggregate_stats(snapshots: list, pool: dict, merged=None) -> dict:
     latency = quantiles_from_histogram(merged_cum)
     latency["source"] = "merged_histogram"
     latency["sum_seconds"] = round(merged_sum, 6)
+    # Same lifetime keys as the single-process /stats body, so
+    # tools/decisionview reads one shape from either plane.
+    latency["lifetime_mean_ms"] = (round(merged_sum / merged_count * 1e3, 4)
+                                   if merged_count else None)
+    latency["lifetime_count"] = merged_count
     out = {
         "pool": dict(pool),
         "backend": _consensus(snapshots, "backend") if snapshots else None,
@@ -316,6 +369,24 @@ def aggregate_stats(snapshots: list, pool: dict, merged=None) -> dict:
                  if "fail_open_total" in s["stats"]]
     if fail_open:
         out["fail_open_total"] = sum(fail_open)
+    # graftlens: per-phase pool quantiles + lifetime means from the
+    # merged phase histograms (exact across workers), and the merged
+    # SLO snapshot.
+    if phase_hists is None:
+        phase_hists = merge_phase_histograms(snapshots)
+    if phase_hists:
+        phases = {}
+        for phase, (cum, p_sum, p_count) in phase_hists.items():
+            entry = quantiles_from_histogram(cum)
+            entry["source"] = "merged_histogram"
+            entry["lifetime_mean_ms"] = (round(p_sum / p_count * 1e3, 4)
+                                         if p_count else None)
+            entry["lifetime_count"] = p_count
+            phases[phase] = entry
+        out["phases"] = phases
+    merged_slo = merge_worker_slo(snapshots)
+    if merged_slo is not None:
+        out["slo"] = merged_slo
     trace = _summed_trace(snapshots)
     if trace is not None:
         out["trace"] = trace
@@ -342,8 +413,10 @@ def aggregate_metrics(snapshots: list, pool: dict) -> str:
     labels that matter (liveness, decision share, restarts)."""
     p = METRIC_PREFIX
     merged_cum, merged_sum, merged_count = merge_worker_histograms(snapshots)
+    phase_hists = merge_phase_histograms(snapshots)
     stats = aggregate_stats(snapshots, pool,
-                            merged=(merged_cum, merged_sum, merged_count))
+                            merged=(merged_cum, merged_sum, merged_count),
+                            phase_hists=phase_hists)
     lines = [
         f"# HELP {p}_decisions_total Placement decisions by cloud "
         "(summed across pool workers).",
@@ -363,6 +436,14 @@ def aggregate_metrics(snapshots: list, pool: dict) -> str:
         )
     lines.append(f"{p}_decision_latency_seconds_sum {merged_sum:.9g}")
     lines.append(f"{p}_decision_latency_seconds_count {merged_count}")
+    # graftlens: one merged histogram per phase and the merged SLO
+    # gauges — the SAME exposition helpers as the single-process plane
+    # (extender.phase_metric_lines/slo_metric_lines), so the two planes
+    # cannot drift.
+    if phase_hists:
+        lines += phase_metric_lines(p, phase_hists)
+    if "slo" in stats:
+        lines += slo_metric_lines(p, stats["slo"])
     for key, help_text in (
         ("shed_fraction", "Pool request-weighted fraction served off the "
                           "primary path by the load-aware backends."),
@@ -765,7 +846,8 @@ class ServingPool:
                  stable_after_s: float = 30.0, poll_interval_s: float = 0.2,
                  blas_threads: int | None = None,
                  initial_checkpoint: str | None = None,
-                 fault_plan=None, rollout_opts: dict | None = None):
+                 fault_plan=None, rollout_opts: dict | None = None,
+                 slo_enabled: bool = False):
         if workers < 1:
             raise ValueError(f"workers={workers}: pass at least 1")
         if blas_threads is not None and blas_threads < 0:
@@ -819,6 +901,11 @@ class ServingPool:
         # chaos seam for the rollout.spawn/rollout.health sites.
         self.rollout = RolloutController(self, fault_plan=fault_plan,
                                          **(rollout_opts or {}))
+        # graftlens: when the workers run an SLO tracker, the pool's
+        # /healthz folds their merged burn state in (503 while degraded
+        # — the control plane is the READINESS probe, so a burning pool
+        # drains from endpoints instead of being liveness-killed).
+        self.slo_enabled = slo_enabled
         self.stable_after_s = stable_after_s
         self.poll_interval_s = poll_interval_s
         # Worker processes ARE the pool's parallelism: the default gives
@@ -1135,6 +1222,20 @@ class ServingPool:
             status["status"] = "ok"
         else:
             status["status"] = "rolling" if rolling else "degraded"
+        if self.slo_enabled:
+            merged = merge_worker_slo(self.scrape(timeout_s=1.0))
+            if merged is not None:
+                status["slo"] = {
+                    "degraded": merged["degraded"],
+                    "burning": sorted(
+                        name for name, o in merged["objectives"].items()
+                        if o["burning"]),
+                }
+                if merged["degraded"] and status["status"] == "ok":
+                    # SLO burn degrades a structurally-healthy pool; a
+                    # mid-rollout pool keeps "rolling" (the rollout's
+                    # own gate holds the canary to the SLO).
+                    status["status"] = "degraded"
         return status
 
 
@@ -1255,6 +1356,16 @@ def run_pool(build_kwargs: dict, workers: int, host: str, port: int,
         check_warm_nodes_served(policy, build_kwargs.get("warm_nodes"))
         return policy
 
+    # graftlens: an armed SLO threads three ways — each worker's tracker
+    # (build_policy), the pool /healthz degrade, and the rollout's
+    # principled canary gate (the canary must not burn the budget the
+    # incumbents are keeping).
+    slo_cfg = None
+    if (build_kwargs.get("slo_p99_ms") is not None
+            or build_kwargs.get("slo_avail") is not None):
+        slo_cfg = slo_mod.SloConfig(
+            p99_ms=build_kwargs.get("slo_p99_ms"),
+            availability=build_kwargs.get("slo_avail"))
     # The control plane follows the data plane's bind address by default:
     # k8s probes and Prometheus reach both through the pod IP
     # (k8s_manifests/extender-deployment.yaml) — a loopback-only control
@@ -1263,7 +1374,9 @@ def run_pool(build_kwargs: dict, workers: int, host: str, port: int,
                        control_host=control_host if control_host is not None
                        else host,
                        control_port=control_port, blas_threads=blas_threads,
-                       initial_checkpoint=build_kwargs.get("run"))
+                       initial_checkpoint=build_kwargs.get("run"),
+                       slo_enabled=slo_cfg is not None,
+                       rollout_opts={"slo": slo_cfg} if slo_cfg else None)
     pool.start()
 
     def _stop(signum, frame):  # noqa: ARG001 (signal API)

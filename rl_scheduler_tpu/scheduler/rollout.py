@@ -143,7 +143,8 @@ class RolloutController:
                  ready_timeout_s: float = 30.0,
                  max_latency_ratio: float = 4.0,
                  min_compare_requests: int = 20,
-                 lock_dir: str | Path | None = None):
+                 lock_dir: str | Path | None = None,
+                 slo=None):
         self._pool = pool
         self.fault_plan = fault_plan
         self.canary_hold_s = canary_hold_s
@@ -153,6 +154,12 @@ class RolloutController:
         self.max_latency_ratio = max_latency_ratio
         self.min_compare_requests = min_compare_requests
         self.lock_dir = Path(lock_dir) if lock_dir is not None else None
+        # graftlens (scheduler/slo.py): with an SloConfig carrying a
+        # latency objective, the canary gate additionally judges the
+        # hold window's over-threshold fraction against the error
+        # budget — a principled bound (the SLO the pool is actually
+        # held to) next to the relative latency-ratio heuristic.
+        self.slo = slo
         self._busy = threading.Lock()   # the single writer
         self._state_lock = threading.Lock()
         self.state = IDLE
@@ -447,6 +454,54 @@ class RolloutController:
             return False, (f"canary latency regressed: {c_mean * 1e3:.2f} ms "
                            f"mean vs incumbent {i_mean * 1e3:.2f} ms over "
                            "the hold window")
+        if self.slo is not None and self.slo.p99_ms is not None:
+            ok, why = self._slo_gate(start, end, inc_start, inc_end)
+            if not ok:
+                return False, why
+        return True, ""
+
+    def _slo_gate(self, start: dict, end: dict, inc_start: list,
+                  inc_end: list) -> tuple[bool, str]:
+        """graftlens SLO canary gate: over the hold window the canary's
+        fraction of decisions above the SLO latency threshold must not
+        exceed the fast-burn budget (``budget * fast_burn`` — the rate a
+        page fires at) WHILE the incumbents keep theirs under it — a
+        pool-wide slowdown (hot telemetry source, noisy neighbor) is not
+        the canary's fault and must not block every promote. Exact
+        monotone-counter deltas of the lifetime histogram, bucket-
+        granular via ``slo.histogram_bad_fraction``."""
+        from rl_scheduler_tpu.scheduler.extender import LatencyStats
+        from rl_scheduler_tpu.scheduler.slo import (
+            LATENCY_TARGET,
+            histogram_bad_fraction,
+        )
+
+        threshold_ms = self.slo.p99_ms
+        budget = 1.0 - LATENCY_TARGET
+        limit = budget * self.slo.fast_burn
+        c_frac, c_count = histogram_bad_fraction(
+            start["histogram"], end["histogram"], threshold_ms,
+            LatencyStats.BUCKETS)
+        by_id = {s["worker_id"]: s for s in inc_start}
+        i_bad = i_count = 0
+        for inc in inc_end:
+            s = by_id.get(inc["worker_id"])
+            if s is None:
+                continue
+            frac, count = histogram_bad_fraction(
+                s["histogram"], inc["histogram"], threshold_ms,
+                LatencyStats.BUCKETS)
+            i_bad += frac * count
+            i_count += count
+        i_frac = i_bad / i_count if i_count else 0.0
+        if (c_count >= self.min_compare_requests
+                and i_count >= self.min_compare_requests
+                and c_frac > limit and i_frac <= limit):
+            return False, (
+                f"canary burns the SLO: {c_frac * 100:.1f}% of hold-window "
+                f"decisions over {threshold_ms:g} ms (budget x fast-burn "
+                f"allows {limit * 100:.1f}%; incumbents at "
+                f"{i_frac * 100:.1f}%)")
         return True, ""
 
     def _rollback(self, slots: list, incumbent: WorkerSpec,

@@ -1,0 +1,291 @@
+"""graftlens part 2: serving SLOs and multi-window burn-rate tracking.
+
+The serving plane had latency *measurements* (the `/stats` percentiles,
+the `/metrics` histograms) but no *objectives*: nothing said what good
+looks like, so nothing could say "we are eating the error budget faster
+than we can afford" — the signal an operator pages on and a rollout
+canary gate should hold against. This module is the objective layer:
+
+- :class:`SloConfig` declares up to two objectives: **latency** ("99% of
+  decisions complete under ``p99_ms`` milliseconds") and **availability**
+  ("at least ``availability`` of requests are answered by a real policy
+  decision, not a fail-open passthrough"). Either alone is valid.
+- :class:`SloTracker` records one outcome per served decision into a
+  1-second-bucketed ring and computes **multi-window burn rates** (the
+  SRE-workbook construction): ``burn = bad_fraction / error_budget`` over
+  a fast and a slow window. An objective is *burning* when BOTH windows
+  exceed their thresholds — the fast window gives detection latency, the
+  slow window keeps a 2-second blip from paging — and the tracker is
+  *degraded* when any objective burns. The defaults (60 s @ 14.4x /
+  600 s @ 6x) are the classic page-worthy burn pair scaled to a serving
+  process you watch live; every knob is a flag.
+- Synthetic traffic never lands here: the extender's ``warmup_probe``
+  decisions (tagged ``endpoint=probe`` in the trace) are excluded at
+  record time, so a rollout's own gate probes cannot burn the budget
+  they gate on.
+- :func:`merge_snapshots` sums per-worker window counts and recomputes
+  burn rates pool-wide (counts are linear, rates are not), the same
+  discipline as ``LatencyStats.merged_histogram``.
+- :func:`histogram_bad_fraction` derives the over-threshold request
+  fraction from two lifetime-histogram snapshots — the seam graftroll's
+  canary gate uses to judge a canary's SLO burn over the hold window
+  without a tracker on the supervisor side (bucket-granular: the
+  threshold rounds up to the nearest histogram bound).
+
+Surfaced on ``/stats`` (``slo`` section), ``/metrics``
+(``*_slo_burn_rate{objective=,window=}``, ``*_slo_degraded``) and
+``/healthz`` (status ``degraded`` while burning) — docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import threading
+import time
+
+LATENCY = "latency"
+AVAILABILITY = "availability"
+# The latency objective is named by its percentile: "p99 under X ms"
+# means 99% of decisions under X, i.e. a 1% error budget.
+LATENCY_TARGET = 0.99
+WINDOWS = ("fast", "slow")
+
+
+@dataclasses.dataclass(frozen=True)
+class SloConfig:
+    """Serving objectives (module doc). ``p99_ms`` arms the latency
+    objective, ``availability`` the availability objective; at least one
+    must be set. Windows/thresholds are the multi-window burn pair."""
+
+    p99_ms: float | None = None
+    availability: float | None = None
+    fast_window_s: float = 60.0
+    slow_window_s: float = 600.0
+    fast_burn: float = 14.4
+    slow_burn: float = 6.0
+
+    def __post_init__(self):
+        if self.p99_ms is None and self.availability is None:
+            raise ValueError(
+                "SloConfig: arm at least one objective (p99_ms for "
+                "latency, availability for fail-open fraction)")
+        if self.p99_ms is not None and self.p99_ms <= 0:
+            raise ValueError(f"p99_ms={self.p99_ms}: pass a positive "
+                             "millisecond threshold")
+        if self.availability is not None and not 0.0 < self.availability < 1.0:
+            raise ValueError(
+                f"availability={self.availability}: pass a fraction in "
+                "(0, 1), e.g. 0.999")
+        if not 0 < self.fast_window_s < self.slow_window_s:
+            raise ValueError(
+                f"windows fast={self.fast_window_s}s slow="
+                f"{self.slow_window_s}s: fast must be positive and "
+                "shorter than slow")
+        if self.fast_burn <= 0 or self.slow_burn <= 0:
+            raise ValueError("burn thresholds must be positive")
+
+    def objectives(self) -> dict:
+        """``{objective_name: (target, budget)}`` for the armed set."""
+        out = {}
+        if self.p99_ms is not None:
+            out[LATENCY] = (LATENCY_TARGET, 1.0 - LATENCY_TARGET)
+        if self.availability is not None:
+            out[AVAILABILITY] = (self.availability, 1.0 - self.availability)
+        return out
+
+
+class SloTracker:
+    """Per-process SLO outcome recorder + burn-rate computer (module
+    doc). Thread-safe: the extender's serving threads record, the
+    control-plane thread snapshots. ``clock`` is injectable for tests
+    (monotonic seconds)."""
+
+    BUCKET_S = 1.0
+
+    def __init__(self, config: SloConfig, clock=time.monotonic):
+        self.config = config
+        self._clock = clock
+        self._lock = threading.Lock()
+        n = int(config.slow_window_s / self.BUCKET_S) + 2
+        self._n = n
+        self._ids = [-1] * n           # bucket id occupying each slot
+        self._total = [0] * n          # requests (decided + fail-open)
+        self._lat_bad = [0] * n        # decided requests over threshold
+        self._avail_bad = [0] * n      # fail-open requests
+        # Lifetime counters (monotonic — /stats/reset never clears them,
+        # same contract as the latency histograms).
+        self.requests_total = 0
+        self.latency_bad_total = 0
+        self.fail_open_total = 0
+
+    # ------------------------------------------------------------ recording
+
+    def _slot(self, now: float) -> int:
+        bucket_id = int(now / self.BUCKET_S)
+        slot = bucket_id % self._n
+        if self._ids[slot] != bucket_id:
+            self._ids[slot] = bucket_id
+            self._total[slot] = self._lat_bad[slot] = self._avail_bad[slot] = 0
+        return slot
+
+    def observe(self, seconds: float) -> None:
+        """One decided request with its decision latency."""
+        over = (self.config.p99_ms is not None
+                and seconds * 1e3 > self.config.p99_ms)
+        with self._lock:
+            slot = self._slot(self._clock())
+            self._total[slot] += 1
+            self.requests_total += 1
+            if over:
+                self._lat_bad[slot] += 1
+                self.latency_bad_total += 1
+
+    def observe_failure(self) -> None:
+        """One fail-open request (open breaker / backend raise): bad for
+        availability; excluded from the latency objective's denominator
+        (a passthrough's latency says nothing about the decide path)."""
+        with self._lock:
+            slot = self._slot(self._clock())
+            self._total[slot] += 1
+            self._avail_bad[slot] += 1
+            self.requests_total += 1
+            self.fail_open_total += 1
+
+    # ------------------------------------------------------------ snapshots
+
+    def _window_counts(self, now: float, window_s: float) -> tuple[int, int, int]:
+        """``(total, latency_bad, avail_bad)`` over the trailing window.
+        Caller holds the lock."""
+        now_id = int(now / self.BUCKET_S)
+        first = now_id - int(window_s / self.BUCKET_S) + 1
+        total = lat_bad = avail_bad = 0
+        for bucket_id in range(first, now_id + 1):
+            slot = bucket_id % self._n
+            if self._ids[slot] != bucket_id:
+                continue
+            total += self._total[slot]
+            lat_bad += self._lat_bad[slot]
+            avail_bad += self._avail_bad[slot]
+        return total, lat_bad, avail_bad
+
+    def snapshot(self) -> dict:
+        cfg = self.config
+        with self._lock:
+            now = self._clock()
+            windows = {
+                "fast": (cfg.fast_window_s,
+                         *self._window_counts(now, cfg.fast_window_s)),
+                "slow": (cfg.slow_window_s,
+                         *self._window_counts(now, cfg.slow_window_s)),
+            }
+            lifetime = {
+                "requests_total": self.requests_total,
+                "latency_bad_total": self.latency_bad_total,
+                "fail_open_total": self.fail_open_total,
+            }
+        return compute_burn(cfg, windows, lifetime)
+
+
+def compute_burn(config: SloConfig, windows: dict, lifetime: dict) -> dict:
+    """The snapshot body from raw window counts — shared by the tracker
+    and the pool merge so per-worker and pool-wide snapshots can never
+    disagree on the math. ``windows`` maps window name to
+    ``(seconds, total, latency_bad, avail_bad)``."""
+    thresholds = {"fast": config.fast_burn, "slow": config.slow_burn}
+    objectives = {}
+    for name, (target, budget) in config.objectives().items():
+        per_window = {}
+        burning = True
+        for wname, (seconds, total, lat_bad, avail_bad) in windows.items():
+            if name == LATENCY:
+                bad, denom = lat_bad, max(total - avail_bad, 0)
+            else:
+                bad, denom = avail_bad, total
+            frac = bad / denom if denom else 0.0
+            burn = frac / budget if budget else 0.0
+            per_window[wname] = {
+                "seconds": seconds,
+                "total": denom,
+                "bad": bad,
+                "bad_fraction": round(frac, 6),
+                "burn_rate": round(burn, 4),
+                "threshold": thresholds[wname],
+            }
+            burning = burning and burn >= thresholds[wname]
+        objectives[name] = {
+            "target": target,
+            "budget": round(budget, 6),
+            "windows": per_window,
+            "burning": burning,
+        }
+        if name == LATENCY:
+            objectives[name]["threshold_ms"] = config.p99_ms
+    return {
+        "objectives": objectives,
+        "degraded": any(o["burning"] for o in objectives.values()),
+        "windows_raw": {k: list(v) for k, v in windows.items()},
+        "lifetime": dict(lifetime),
+        "config": {
+            "p99_ms": config.p99_ms,
+            "availability": config.availability,
+            "fast_window_s": config.fast_window_s,
+            "slow_window_s": config.slow_window_s,
+            "fast_burn": config.fast_burn,
+            "slow_burn": config.slow_burn,
+        },
+    }
+
+
+def config_from_snapshot(snapshot: dict) -> SloConfig:
+    """Rebuild the config a snapshot was computed under (the pool merge's
+    source of truth — workers of one pool share one serve config)."""
+    return SloConfig(**snapshot["config"])
+
+
+def merge_snapshots(snapshots: list) -> dict | None:
+    """Pool-wide SLO snapshot: window counts and lifetime counters sum
+    across workers (each worker owns its own stream), burn rates are
+    recomputed from the sums — rates are NOT linear, counts are (the
+    ``merged_histogram`` discipline). ``None`` when no worker tracks
+    SLOs."""
+    snapshots = [s for s in snapshots if s]
+    if not snapshots:
+        return None
+    config = config_from_snapshot(snapshots[0])
+    windows: dict = {}
+    for wname in WINDOWS:
+        seconds = snapshots[0]["windows_raw"][wname][0]
+        sums = [0, 0, 0]
+        for snap in snapshots:
+            raw = snap.get("windows_raw", {}).get(wname)
+            if raw is None:
+                continue
+            for i in range(3):
+                sums[i] += raw[1 + i]
+        windows[wname] = (seconds, *sums)
+    lifetime: dict = {}
+    for snap in snapshots:
+        for key, value in snap.get("lifetime", {}).items():
+            lifetime[key] = lifetime.get(key, 0) + value
+    return compute_burn(config, windows, lifetime)
+
+
+def histogram_bad_fraction(start: dict, end: dict, threshold_ms: float,
+                           bounds) -> tuple[float, int]:
+    """``(over_threshold_fraction, window_count)`` between two lifetime
+    histogram snapshots (``{"cumulative": [...], "count": n}`` — the
+    worker-snapshot shape). Bucket-granular: ``threshold_ms`` rounds UP
+    to the nearest histogram bound, so the fraction is conservative
+    (never over-reports a violation). The rollout canary gate judges a
+    hold window with this — exact deltas of monotone counters, no
+    tracker needed on the supervisor."""
+    idx = bisect.bisect_left([b * 1e3 for b in bounds], threshold_ms)
+    d_count = end["count"] - start["count"]
+    if d_count <= 0:
+        return 0.0, 0
+    if idx >= len(bounds):
+        return 0.0, d_count  # beyond the last finite bound: no signal
+    d_under = end["cumulative"][idx] - start["cumulative"][idx]
+    over = max(d_count - d_under, 0)
+    return over / d_count, d_count

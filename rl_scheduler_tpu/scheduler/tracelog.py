@@ -69,13 +69,21 @@ def obs_digest(obs) -> str | None:
 def decision_record(*, endpoint: str, family: str, backend: str,
                     candidates: int, chosen: str | None,
                     score: float | None, latency_ms: float,
-                    obs=None, telemetry_pos: int | None = None,
+                    obs=None, obs_sha: str | None = None,
+                    telemetry_pos: int | None = None,
                     worker_id: int | None = None, generation: int = 0,
                     fail_open: bool = False,
-                    breaker_state: str | None = None) -> dict:
+                    breaker_state: str | None = None,
+                    spans: dict | None = None) -> dict:
     """One schema-versioned trace record. Kept a plain dict (JSONL is the
     contract, not a class) — ``schema`` gates future field changes the
-    way the bench's ``schema_version`` does."""
+    way the bench's ``schema_version`` does. ``obs_sha`` short-circuits
+    the digest when the caller already hashed the observation (the
+    extender times the digest as its trace-append span); ``spans`` is
+    graftlens' per-phase millisecond breakdown
+    (parse/observe/forward/marshal/trace), so every logged decision is
+    attributable after the fact — ``None`` on pre-graftlens records and
+    with spans disabled, which replayers must tolerate."""
     return {
         "schema": TRACE_SCHEMA,
         "ts": round(time.time(), 6),
@@ -84,7 +92,7 @@ def decision_record(*, endpoint: str, family: str, backend: str,
         "endpoint": endpoint,
         "family": family,
         "backend": backend,
-        "obs_sha": obs_digest(obs),
+        "obs_sha": obs_sha if obs_sha is not None else obs_digest(obs),
         "telemetry_pos": telemetry_pos,
         "candidates": candidates,
         "chosen": chosen,
@@ -92,6 +100,7 @@ def decision_record(*, endpoint: str, family: str, backend: str,
         "latency_ms": round(latency_ms, 4),
         "fail_open": bool(fail_open),
         "breaker": breaker_state,
+        "spans": spans,
     }
 
 

@@ -163,7 +163,9 @@ def run_trial(spec: StudySpec, trial: TrialSpec, trial_dir: str | Path,
 
     trial_dir = Path(trial_dir)
     trial_dir.mkdir(parents=True, exist_ok=True)
-    t0 = time.time()
+    # perf_counter, not time.time(): wall_s is a DURATION and a mid-trial
+    # NTP step must not corrupt the ledger's wall times (GL011).
+    t0 = time.perf_counter()
     cfg, bundle_kwargs, reseed_budget = build_trial_config(spec, trial)
     bundle, net = make_bundle_and_net(spec.env, cfg, **bundle_kwargs)
     if baseline_threshold is not None:
@@ -303,7 +305,7 @@ def run_trial(spec: StudySpec, trial: TrialSpec, trial_dir: str | Path,
         "failed": bool(report.improvement_vs_best_baseline_pct < 0),
         "avg_episode_reward": round(report.avg_episode_reward, 3),
         "argmax_collision": round(concentration, 4),
-        "wall_s": round(time.time() - t0, 1),
+        "wall_s": round(time.perf_counter() - t0, 1),
         "backend": jax.devices()[0].platform,
     }
     write_result(trial_dir, record)

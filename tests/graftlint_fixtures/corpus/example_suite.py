@@ -19,3 +19,11 @@ def check_gl010_fixture_names_are_covered():
     # place_pod, read_stats, score_node, parse_quantity, load_table,
     # restore_checkpoint — referenced here so only GL010 fires there.
     pass
+
+
+def check_gl011_fixture_names_are_covered():
+    # scheduler/gl011_bad.py + gl011_good.py public surface:
+    # measure_decide, record_request, trial_wall_seconds,
+    # measure_decide_monotonic, cache_age_seconds, stamp_record,
+    # one_hour_ago — referenced here so only GL011 fires there.
+    pass

@@ -1,0 +1,23 @@
+"""GL011 positive fixture: wall-clock deltas used as durations in a
+(fixture) scheduler/ path. Expected findings: 3."""
+
+import time
+from time import time as now
+
+
+def measure_decide(backend, obs):
+    t0 = time.time()
+    action = backend.decide(obs)
+    latency_s = time.time() - t0  # finding 1: wall-clock duration
+    return action, latency_s
+
+
+def record_request(stats, start_ts):
+    # finding 2: direct time.time() call on one side of the delta
+    stats.record(time.time() - start_ts)
+
+
+def trial_wall_seconds():
+    t_start = now()
+    run_trial = sum(range(100))
+    return now() - t_start, run_trial  # finding 3: from-import variant

@@ -1,0 +1,23 @@
+"""GL011 negative fixture: monotonic clocks for durations, wall clock
+only as a timestamp or epoch arithmetic. Expected findings: 0."""
+
+import time
+
+
+def measure_decide_monotonic(backend, obs):
+    t0 = time.perf_counter()
+    action = backend.decide(obs)
+    return action, time.perf_counter() - t0  # monotonic: correct
+
+
+def cache_age_seconds(cached_at):
+    return time.monotonic() - cached_at  # monotonic: correct
+
+
+def stamp_record(record):
+    record["ts"] = round(time.time(), 6)  # timestamp, not a duration
+    return record
+
+
+def one_hour_ago():
+    return time.time() - 3600  # epoch arithmetic: a point in time

@@ -1,0 +1,239 @@
+"""tools/decisionview: the graftlens serving perf report and its
+regression gates, exercised off-network against the checked-in fixture
+(a REAL numpy-set policy's /stats body, trace segments, and a 3-round
+bench ledger — tests/fixtures/decisionview/)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tools.decisionview import (
+    MIN_PHASE_COVERAGE,
+    build_report,
+    check_budgets,
+    check_history,
+    check_slo,
+    format_report,
+    load_bench_history,
+    load_stats,
+    load_trace_records,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+FIXTURE = REPO_ROOT / "tests" / "fixtures" / "decisionview"
+BUDGETS = REPO_ROOT / "tools" / "decisionview" / "budgets.json"
+
+
+@pytest.fixture(scope="module")
+def report():
+    return build_report(
+        stats=load_stats(str(FIXTURE / "stats.json")),
+        records=load_trace_records(FIXTURE / "trace"),
+        history=load_bench_history(FIXTURE / "bench.jsonl"),
+    )
+
+
+# ------------------------------------------------------------------ report
+
+
+def test_phase_table_and_reconciliation(report):
+    phases = report["phases"]
+    assert set(phases) == {"parse", "observe", "forward", "marshal",
+                           "trace"}
+    for entry in phases.values():
+        assert entry["count"] == 80
+        assert entry["mean_ms"] > 0
+    rec = report["reconciliation"]
+    assert rec["coverage"] >= MIN_PHASE_COVERAGE
+    assert rec["phase_sum_ms"] == pytest.approx(
+        sum(e["mean_ms"] for e in phases.values()), abs=1e-3)
+    # The e2e decide window is explained by observe+forward alone too.
+    inner = phases["observe"]["mean_ms"] + phases["forward"]["mean_ms"]
+    assert inner >= 0.9 * rec["e2e_mean_ms"]
+
+
+def test_probe_traffic_excluded_from_report():
+    all_records = load_trace_records(FIXTURE / "trace",
+                                     include_probes=True)
+    client = load_trace_records(FIXTURE / "trace")
+    probes = [r for r in all_records if r["endpoint"] == "probe"]
+    assert probes, "fixture must contain synthetic probe records"
+    assert len(client) == len(all_records) - len(probes)
+    # And the per-generation table only counts client traffic.
+    report = build_report(records=client)
+    assert report["trace_records"] == len(client)
+    assert sum(e["count"] for e in report["generations"].values()) \
+        == len(client)
+
+
+def test_per_generation_comparison(report):
+    gens = report["generations"]
+    assert set(gens) == {"0", "1"}
+    for entry in gens.values():
+        assert entry["count"] == 40
+        assert entry["fail_open_fraction"] == 0.0
+        assert entry["mean_ms"] > 0 and entry["p95_ms"] >= entry["mean_ms"]
+
+
+def test_slo_attainment_section(report):
+    slo = report["slo"]
+    assert slo["latency"]["attainment"] == 1.0
+    assert slo["availability"]["attainment"] == 1.0
+    assert not slo["latency"]["burning"]
+
+
+def test_format_report_renders_every_section(report):
+    text = format_report(report)
+    for needle in ("Phase decomposition", "SLO attainment",
+                   "Per-generation latency", "Bench history", "forward",
+                   "coverage"):
+        assert needle in text
+
+
+# ------------------------------------------------------------------- gates
+
+
+def test_checked_in_budgets_pass(report):
+    assert check_budgets(report,
+                         json.loads(BUDGETS.read_text())) == []
+
+
+def test_over_budget_and_absent_phase_violate(report):
+    tiny = {"tolerance_pct": 0.0,
+            "phases": {"forward": 0.0001, "missing_phase": 1.0}}
+    violations = check_budgets(report, tiny)
+    assert any("forward" in v and "exceeds budget" in v
+               for v in violations)
+    assert any("missing_phase" in v and "absent" in v for v in violations)
+
+
+def test_coverage_gap_violates():
+    """A report whose spans lost time (sum < 90% of e2e) fails the
+    reconciliation gate even with every budgeted phase under budget."""
+    stats = load_stats(str(FIXTURE / "stats.json"))
+    stats["phases"] = {"forward": stats["phases"]["forward"]}
+    broken = build_report(stats=stats)
+    violations = check_budgets(broken, {"phases": {}})
+    assert any("coverage" in v for v in violations)
+
+
+def test_history_gate_passes_then_catches_regression():
+    history = load_bench_history(FIXTURE / "bench.jsonl")
+    assert check_history(history) == []
+    regressed = dict(history[-1])
+    regressed["req_per_sec"] = history[-1]["req_per_sec"] * 0.5
+    regressed["client_p50_ms"] = history[-1]["client_p50_ms"] * 2.0
+    violations = check_history(history + [regressed])
+    assert len(violations) == 2
+    assert any("req_per_sec regressed" in v for v in violations)
+    assert any("client_p50_ms regressed" in v for v in violations)
+    # A different shape never compares (N=2048 vs the N=64 priors).
+    other_shape = dict(regressed, nodes=2048)
+    assert check_history(history + [other_shape]) == []
+    # A just-starting ledger passes vacuously.
+    assert check_history(history[:1]) == []
+
+
+def test_slo_gate_flags_burning_objective(report):
+    assert check_slo(report) == []
+    burning = json.loads(json.dumps(report))
+    burning["slo"]["latency"]["burning"] = True
+    assert len(check_slo(burning)) == 1
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.decisionview", *args],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+
+
+def test_cli_full_report_and_gates_exit_zero():
+    proc = _run_cli("--stats", str(FIXTURE / "stats.json"),
+                    "--trace", str(FIXTURE / "trace"),
+                    "--bench", str(FIXTURE / "bench.jsonl"),
+                    "--check", "--budgets", str(BUDGETS),
+                    "--check-history", "--slo-check")
+    assert proc.returncode == 0, proc.stderr
+    line = proc.stdout.strip().splitlines()[-1]
+    payload = json.loads(line)
+    assert payload["schema_version"] == 1
+    assert payload["reconciliation"]["coverage"] >= MIN_PHASE_COVERAGE
+    assert "all gates OK" in proc.stderr
+
+
+def test_cli_exits_2_on_injected_over_budget_phase(tmp_path):
+    bad = tmp_path / "budgets.json"
+    bad.write_text(json.dumps(
+        {"tolerance_pct": 0.0, "phases": {"forward": 0.0001}}))
+    proc = _run_cli("--stats", str(FIXTURE / "stats.json"),
+                    "--check", "--budgets", str(bad), "--json")
+    assert proc.returncode == 2
+    assert "REGRESSION" in proc.stderr and "forward" in proc.stderr
+
+
+def test_cli_exits_2_on_history_regression(tmp_path):
+    history = load_bench_history(FIXTURE / "bench.jsonl")
+    regressed = dict(history[-1], req_per_sec=1.0)
+    ledger = tmp_path / "BENCH_serving.jsonl"
+    ledger.write_text("".join(json.dumps(r) + "\n"
+                              for r in history + [regressed]))
+    proc = _run_cli("--bench", str(ledger), "--check-history", "--json")
+    assert proc.returncode == 2
+    assert "req_per_sec regressed" in proc.stderr
+
+
+def test_cli_refuses_gate_without_input():
+    proc = _run_cli("--check")
+    assert proc.returncode == 2  # argparse error
+    assert "pass at least one input" in proc.stderr
+    proc = _run_cli("--check", "--bench",
+                    str(FIXTURE / "bench.jsonl"))
+    assert proc.returncode == 2
+    assert "--check needs --stats" in proc.stderr
+
+
+def test_bench_history_flag_appends_ledger(tmp_path):
+    """extender_bench --history appends its JSON line (satellite 1) —
+    exercised through the arg parser path by reusing a canned line; the
+    live-append itself is covered by the slow pool soak."""
+    sys.path.insert(0, str(REPO_ROOT / "loadgen"))
+    try:
+        import importlib
+
+        bench = importlib.import_module("extender_bench")
+    finally:
+        sys.path.pop(0)
+    # The flag exists and the writer tolerates append-after-append.
+    ledger = tmp_path / "ledger.jsonl"
+    line = {"schema_version": 1, "req_per_sec": 10.0}
+    for _ in range(2):
+        with open(ledger, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(line) + "\n")
+    assert len(load_bench_history(ledger)) == 2
+    assert any(a.option_strings == ["--history"]
+               for a in _bench_parser_actions(bench))
+
+
+def _bench_parser_actions(bench):
+    import argparse
+    import unittest.mock as mock
+
+    captured = {}
+    real_parse = argparse.ArgumentParser.parse_args
+
+    def capture(self, argv=None):
+        captured["parser"] = self
+        raise SystemExit(0)
+
+    with mock.patch.object(argparse.ArgumentParser, "parse_args", capture):
+        try:
+            bench.main(["--help"])
+        except SystemExit:
+            pass
+    return captured["parser"]._actions

@@ -1,0 +1,275 @@
+"""graftlens (PR 12): per-phase decision-path spans, SLO wiring, and the
+synthetic-traffic exclusion — at the ExtenderPolicy level and over real
+HTTP. Pool-wide aggregation is pinned in tests/test_pool.py, the SLO
+math in tests/test_slo.py, and the report in tests/test_decisionview.py.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from rl_scheduler_tpu.scheduler.extender import (
+    PHASES,
+    ExtenderPolicy,
+    LatencyStats,
+    build_policy,
+    make_server,
+    phase_metric_lines,
+    slo_metric_lines,
+)
+from rl_scheduler_tpu.scheduler.policy_backend import GreedyBackend
+from rl_scheduler_tpu.scheduler.slo import SloConfig, SloTracker
+from rl_scheduler_tpu.scheduler.telemetry import RandomCpu, TableTelemetry
+from rl_scheduler_tpu.scheduler.tracelog import TraceLog, iter_trace
+from rl_scheduler_tpu.utils.faults import FaultPlan
+
+
+def _policy(spans=True, slo=None, trace=None, backend=None):
+    telemetry = TableTelemetry.from_table(cpu_source=RandomCpu(seed=0))
+    policy = ExtenderPolicy(backend or GreedyBackend(), telemetry,
+                            spans=spans, slo=slo)
+    policy.trace = trace
+    return policy
+
+
+def _args(i=0, n=4):
+    return {"nodenames": [f"{'aws' if j % 2 else 'azure'}-n{i}-{j}"
+                          for j in range(n)], "pod": {}}
+
+
+class _FaultableBackend:
+    """The chaos-suite idiom: a backend whose decide consults the
+    backend.decide fault site (utils/faults.py)."""
+
+    name = "faultable"
+
+    def __init__(self, plan):
+        self.plan = plan
+
+    def decide(self, obs):
+        self.plan.check("backend.decide", RuntimeError)
+        return 0, __import__("numpy").zeros(2, "float32")
+
+
+class _SlowBackend:
+    name = "slow"
+
+    def __init__(self, sleep_s=0.02):
+        self.sleep_s = sleep_s
+
+    def decide(self, obs):
+        time.sleep(self.sleep_s)
+        return 0, __import__("numpy").zeros(2, "float32")
+
+
+# ------------------------------------------------------------------- spans
+
+
+def test_phases_recorded_per_request_and_reconcile():
+    """Every served request lands one sample in each phase's histogram,
+    and observe+forward explain >=90% of the end-to-end decide mean (the
+    decomposition acceptance bar)."""
+    policy = _policy()
+    for i in range(20):
+        policy.filter(_args(i)) if i % 2 else policy.prioritize(_args(i))
+    stats = policy.statistics()
+    assert set(stats["phases"]) == set(PHASES)
+    for phase in PHASES:
+        assert stats["phases"][phase]["lifetime_count"] == 20
+    e2e = stats["latency"]["lifetime_mean_ms"]
+    inner = (stats["phases"]["observe"]["lifetime_mean_ms"]
+             + stats["phases"]["forward"]["lifetime_mean_ms"])
+    assert inner >= 0.9 * e2e
+    # The full phase sum covers the decide window and the handler edges.
+    total = sum(stats["phases"][p]["lifetime_mean_ms"] for p in PHASES)
+    assert total >= 0.9 * e2e
+
+
+def test_spans_off_records_nothing_and_omits_stats_section():
+    policy = _policy(spans=False)
+    for i in range(5):
+        policy.filter(_args(i))
+    stats = policy.statistics()
+    assert "phases" not in stats
+    assert all(s.histogram()[2] == 0 for s in policy.phase_stats.values())
+    # The end-to-end histogram still records (spans are additive only).
+    assert policy.stats.histogram()[2] == 5
+    assert "_phase_latency_seconds" not in policy.metrics_text()
+
+
+def test_fail_open_drops_partial_spans():
+    """A failing decide keeps the phase histograms aligned with the
+    end-to-end histogram: neither records the fail-open request."""
+    plan = FaultPlan(rates={"backend.decide": 1.0})
+    policy = _policy(backend=_FaultableBackend(plan))
+    policy.filter(_args(0))
+    assert plan.fired["backend.decide"] == 1
+    assert policy.stats.histogram()[2] == 0
+    assert all(s.histogram()[2] == 0 for s in policy.phase_stats.values())
+
+
+def test_stats_reset_never_rewinds_phase_lifetime_counters():
+    policy = _policy()
+    for i in range(6):
+        policy.filter(_args(i))
+    before = {p: policy.phase_stats[p].histogram()[2] for p in PHASES}
+    policy.reset_stats()
+    stats = policy.statistics()
+    for phase in PHASES:
+        assert stats["phases"][phase]["count"] == 0  # ring cleared
+        assert stats["phases"][phase]["lifetime_count"] == before[phase]
+
+
+def test_trace_records_carry_span_breakdown(tmp_path):
+    policy = _policy(trace=TraceLog(tmp_path))
+    policy.filter(_args(0))
+    policy.prioritize(_args(1))
+    policy.trace.close()
+    records = list(iter_trace(tmp_path))
+    assert len(records) == 2
+    for record in records:
+        spans = record["spans"]
+        assert set(spans) <= set(PHASES)
+        for phase in ("parse", "observe", "forward", "marshal", "trace"):
+            assert spans[phase] >= 0.0
+        # The span sum is consistent with the record's own latency.
+        assert sum(spans.values()) <= record["latency_ms"] + 1.0
+
+
+def test_phase_metric_lines_exposition():
+    policy = _policy()
+    for i in range(4):
+        policy.filter(_args(i))
+    text = policy.metrics_text()
+    assert "# TYPE rl_scheduler_extender_phase_latency_seconds histogram" \
+        in text
+    for phase in PHASES:
+        assert (f'rl_scheduler_extender_phase_latency_seconds_count'
+                f'{{phase="{phase}"}} 4') in text
+    # The shared helper is what produced those lines.
+    hists = {p: s.histogram() for p, s in policy.phase_stats.items()}
+    for line in phase_metric_lines("rl_scheduler_extender", hists):
+        assert line in text
+
+
+# ------------------------------------------------------ probe exclusion
+
+
+def test_warmup_probe_excluded_from_histograms_and_slo(tmp_path):
+    """The satellite pin: probe decisions appear ONLY in the trace
+    (endpoint=probe) — never in the end-to-end histogram, the phase
+    histograms, or the SLO counters a canary gate reads."""
+    slo = SloTracker(SloConfig(p99_ms=10.0, availability=0.999))
+    policy = _policy(slo=slo, trace=TraceLog(tmp_path))
+    for i in range(3):
+        policy.filter(_args(i))
+    for _ in range(5):
+        out = policy.warmup_probe()
+        assert out["decided"]
+    assert policy.stats.histogram()[2] == 3
+    for phase in PHASES:
+        assert policy.phase_stats[phase].histogram()[2] == 3
+    assert slo.snapshot()["lifetime"]["requests_total"] == 3
+    policy.trace.close()
+    records = list(iter_trace(tmp_path))
+    assert sum(1 for r in records if r["endpoint"] == "probe") == 5
+    assert len(records) == 8  # every decision still traced
+
+
+def test_failed_probe_does_not_burn_availability():
+    plan = FaultPlan(rates={"backend.decide": 1.0})
+    slo = SloTracker(SloConfig(availability=0.999))
+    policy = _policy(slo=slo, backend=_FaultableBackend(plan))
+    out = policy.warmup_probe()
+    assert not out["decided"]
+    assert slo.snapshot()["lifetime"] == {
+        "requests_total": 0, "latency_bad_total": 0, "fail_open_total": 0}
+    # The gate still sees the fail-open through the policy counter.
+    assert policy.statistics()["fail_open_total"] == 1
+
+
+# --------------------------------------------------------------- SLO wiring
+
+
+def test_latency_fault_burns_slo_and_degrades_health():
+    """The acceptance drill: a latency fault (slow backend vs a tight
+    objective) flips the burn gauge on /metrics and degrades /healthz."""
+    slo = SloTracker(SloConfig(p99_ms=1.0))  # 1 ms bar, 20 ms backend
+    policy = _policy(slo=slo, backend=_SlowBackend(0.02))
+    assert policy.health()["status"] == "ok"
+    for i in range(20):
+        policy.filter(_args(i))
+    health = policy.health()
+    assert health["status"] == "degraded"
+    assert health["slo"] == {"degraded": True, "burning": ["latency"]}
+    text = policy.metrics_text()
+    assert "rl_scheduler_extender_slo_degraded 1" in text
+    assert 'rl_scheduler_extender_slo_burning{objective="latency"} 1' \
+        in text
+    assert "rl_scheduler_extender_slo_latency_bad_total 20" in text
+    for line in slo_metric_lines("rl_scheduler_extender", slo.snapshot()):
+        assert line in text
+
+
+def test_injected_backend_fault_burns_availability():
+    """The existing utils/faults.py site drives the availability burn:
+    every decide fails open, the objective burns, /healthz degrades."""
+    plan = FaultPlan(rates={"backend.decide": 1.0})
+    slo = SloTracker(SloConfig(availability=0.999))
+    policy = _policy(slo=slo, backend=_FaultableBackend(plan))
+    for i in range(20):
+        policy.filter(_args(i))
+    assert plan.fired["backend.decide"] >= 1
+    snap = slo.snapshot()
+    assert snap["objectives"]["availability"]["burning"]
+    assert policy.health()["status"] == "degraded"
+
+
+def test_build_policy_arms_slo_and_no_spans(tmp_path):
+    policy = build_policy(backend="greedy", spans=False, slo_p99_ms=5.0,
+                          slo_avail=0.999)
+    assert not policy.spans_enabled
+    assert policy.slo is not None
+    assert policy.slo.config.p99_ms == 5.0
+    with pytest.raises(ValueError):
+        build_policy(backend="greedy", slo_avail=2.0)  # refused pre-traffic
+
+
+# ------------------------------------------------------------------ HTTP
+
+
+def test_http_stats_and_metrics_carry_phases_and_slo():
+    slo = SloTracker(SloConfig(p99_ms=1000.0, availability=0.999))
+    policy = _policy(slo=slo)
+    srv = make_server(policy, host="127.0.0.1", port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        port = srv.server_address[1]
+        for i in range(4):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/filter",
+                data=json.dumps(_args(i)).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                resp.read()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/stats", timeout=5) as resp:
+            stats = json.loads(resp.read())
+        assert set(stats["phases"]) == set(PHASES)
+        assert stats["phases"]["forward"]["lifetime_count"] == 4
+        assert not stats["slo"]["degraded"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+            text = resp.read().decode()
+        assert 'phase_latency_seconds_count{phase="forward"} 4' in text
+        assert "slo_degraded 0" in text
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5) as resp:
+            assert json.loads(resp.read())["slo"] == {
+                "degraded": False, "burning": []}
+    finally:
+        srv.shutdown()

@@ -97,6 +97,8 @@ CASES = [
     ("gl009_good.py", "GL009", 0),
     ("scheduler/gl010_bad.py", "GL010", 4),
     ("scheduler/gl010_good.py", "GL010", 0),
+    ("scheduler/gl011_bad.py", "GL011", 3),
+    ("scheduler/gl011_good.py", "GL011", 0),
 ]
 
 
@@ -209,6 +211,6 @@ def test_cli_json_and_exit_code_on_bad_fixture():
 def test_cli_list_rules_covers_registry():
     proc = _run_cli("--list-rules")
     assert proc.returncode == 0
-    for rid in ["GL000"] + [f"GL{i:03d}" for i in range(1, 11)]:
+    for rid in ["GL000"] + [f"GL{i:03d}" for i in range(1, 12)]:
         assert rid in proc.stdout
-    assert len(load_rules()) == 10
+    assert len(load_rules()) == 11

@@ -490,6 +490,170 @@ def test_pool_end_to_end_aggregation_reset_and_health():
         pool.shutdown()
 
 
+def _slo_factory(worker_id, shared):
+    """Greedy policy with graftlens armed: spans (the default) plus an
+    SLO tracker with unburnable thresholds — the aggregation test wants
+    counters, not a degrade."""
+    from rl_scheduler_tpu.scheduler.slo import SloConfig, SloTracker
+
+    policy = _greedy_factory(worker_id, shared)
+    policy.slo = SloTracker(SloConfig(p99_ms=1000.0, availability=0.999))
+    return policy
+
+
+def test_merge_phase_histograms_and_slo_from_real_snapshots():
+    """Pure-function pin, mirroring the LatencyStats.merged_histogram
+    one: per-phase pool histograms == bucket-wise union of per-worker
+    snapshots, and merge_worker_slo sums window counts."""
+    from rl_scheduler_tpu.scheduler.extender import PHASES
+    from rl_scheduler_tpu.scheduler.pool import (
+        merge_phase_histograms,
+        merge_worker_slo,
+    )
+    from rl_scheduler_tpu.scheduler.slo import SloConfig, SloTracker
+
+    shared = PoolShared()
+    snapshots = []
+    per_worker = (3, 5, 7)
+    for worker_id, n in enumerate(per_worker):
+        policy = _greedy_factory(worker_id, shared)
+        policy.slo = SloTracker(SloConfig(p99_ms=1000.0))
+        for i in range(n):
+            policy.filter(_filter_args(i))
+        snapshots.append(worker_snapshot(policy, worker_id))
+    merged = merge_phase_histograms(snapshots)
+    assert set(merged) == set(PHASES)
+    for phase, (cumulative, total_sum, count) in merged.items():
+        assert count == sum(per_worker)
+        assert cumulative[-1] == sum(per_worker)
+        assert total_sum == pytest.approx(sum(
+            s["phases"][phase]["sum"] for s in snapshots))
+    slo = merge_worker_slo(snapshots)
+    assert slo["lifetime"]["requests_total"] == sum(per_worker)
+    assert not slo["degraded"]
+    # Workers without spans/slo (pre-graftlens snapshots) merge cleanly.
+    bare = dict(snapshots[0])
+    bare["phases"] = None
+    bare["slo"] = None
+    assert merge_phase_histograms([bare]) == {}
+    assert merge_worker_slo([bare]) is None
+
+
+def test_pool_phase_aggregation_reset_and_slo_e2e():
+    """The satellite pin, pool-wide: merged /metrics phase histograms ==
+    union of per-worker scrapes, /stats/reset never rewinds the phase
+    lifetime counters, phase sums reconcile with the end-to-end decide
+    latency, and the merged SLO section rides /stats."""
+    from rl_scheduler_tpu.scheduler.extender import PHASES
+    from rl_scheduler_tpu.scheduler.pool import merge_phase_histograms
+
+    pool = ServingPool(_slo_factory, workers=2, host="127.0.0.1",
+                       port=0, control_port=0,
+                       restart_policy=FAST_RESTARTS,
+                       stable_after_s=60.0, poll_interval_s=0.05,
+                       slo_enabled=True)
+    pool.start(ready_timeout_s=60.0)
+    try:
+        cport = pool.control_address[1]
+        n_requests = 30
+        for i in range(n_requests):
+            _post(pool.port, "/filter", _filter_args(i))
+
+        snapshots = pool.scrape()
+        ref = merge_phase_histograms(snapshots)
+        assert {phase: c for phase, (_, _, c) in ref.items()} == {
+            phase: n_requests for phase in PHASES}
+
+        stats = _get(cport, "/stats")
+        assert set(stats["phases"]) == set(PHASES)
+        for phase in PHASES:
+            assert stats["phases"][phase]["lifetime_count"] == n_requests
+        # Reconciliation: observe+forward >= 90% of the e2e decide mean.
+        e2e = stats["latency"]["lifetime_mean_ms"]
+        inner = (stats["phases"]["observe"]["lifetime_mean_ms"]
+                 + stats["phases"]["forward"]["lifetime_mean_ms"])
+        assert inner >= 0.9 * e2e
+        # Merged SLO: counts summed across workers, nothing burning.
+        assert stats["slo"]["lifetime"]["requests_total"] == n_requests
+        assert not stats["slo"]["degraded"]
+
+        metrics = _get(cport, "/metrics")
+        for phase, (cumulative, _, count) in ref.items():
+            got = [
+                int(line.rsplit(" ", 1)[1])
+                for line in metrics.splitlines()
+                if line.startswith(
+                    f'rl_scheduler_extender_phase_latency_seconds_bucket'
+                    f'{{phase="{phase}"')
+            ]
+            assert got == cumulative, f"phase {phase} bucket drift"
+            assert (f'rl_scheduler_extender_phase_latency_seconds_count'
+                    f'{{phase="{phase}"}} {count}') in metrics
+        assert ('rl_scheduler_extender_slo_requests_total '
+                f'{n_requests}') in metrics
+        assert "rl_scheduler_extender_slo_degraded 0" in metrics
+
+        # /healthz folds the merged SLO state in (still ok here).
+        health = _get(cport, "/healthz")
+        assert health["status"] == "ok"
+        assert health["slo"] == {"degraded": False, "burning": []}
+
+        # Reset fans out: phase rings clear, lifetime histograms do not.
+        _post(cport, "/stats/reset", {})
+        stats_after = _get(cport, "/stats")
+        for phase in PHASES:
+            entry = stats_after["phases"][phase]
+            assert entry["lifetime_count"] == n_requests
+        assert stats_after["slo"]["lifetime"]["requests_total"] \
+            == n_requests
+        after = pool.scrape()
+        for snap in after:
+            for phase in PHASES:
+                assert snap["stats"]["phases"][phase]["count"] == 0
+        for phase in PHASES:  # per-worker lifetime shares still sum
+            assert sum(s["phases"][phase]["count"] for s in after) \
+                == n_requests
+    finally:
+        pool.shutdown()
+
+
+def test_rollout_slo_canary_gate_judgement():
+    """graftlens canary gate unit: a canary burning the latency SLO
+    while incumbents keep it fails the hold; a pool-wide slowdown (both
+    sides over) passes — not the canary's fault."""
+    from rl_scheduler_tpu.scheduler.slo import SloConfig
+
+    def hist_snap(worker_id, latencies_s):
+        stats = LatencyStats()
+        for v in latencies_s:
+            stats.record(v)
+        cumulative, total_sum, count = stats.histogram()
+        return {"worker_id": worker_id,
+                "histogram": {"cumulative": cumulative, "sum": total_sum,
+                              "count": count}}
+
+    controller = RolloutController.__new__(RolloutController)
+    controller.slo = SloConfig(p99_ms=100.0, fast_burn=14.4)
+    controller.min_compare_requests = 20
+    empty = hist_snap(0, [])
+    # Canary: 50% of 40 requests over 100 ms (budget x fast-burn allows
+    # 14.4%); incumbents: all fast -> gate failure.
+    canary_end = hist_snap(0, [0.2] * 20 + [0.001] * 20)
+    inc_start, inc_end = [hist_snap(1, [])], [hist_snap(1, [0.001] * 40)]
+    ok, why = controller._slo_gate(empty, canary_end, inc_start, inc_end)
+    assert not ok and "burns the SLO" in why
+    # Pool-wide slowdown: incumbents over the limit too -> pass.
+    slow_inc_end = [hist_snap(1, [0.2] * 40)]
+    ok, _ = controller._slo_gate(empty, canary_end, inc_start,
+                                 slow_inc_end)
+    assert ok
+    # Too few requests to judge -> pass (the latency-ratio gate and
+    # breaker/fail-open deltas still stand guard).
+    tiny_end = hist_snap(0, [0.2] * 5)
+    ok, _ = controller._slo_gate(empty, tiny_end, inc_start, inc_end)
+    assert ok
+
+
 def test_pool_restarts_dead_worker():
     """The supervisor notices a SIGKILLed worker, restarts it on the
     RetryPolicy backoff, and the control plane heals: /healthz reports

@@ -1,0 +1,202 @@
+"""graftlens SLO engine (scheduler/slo.py): burn-rate math, multi-window
+semantics, pool merging, and the histogram-delta seam the rollout canary
+gate uses. Pure-unit — an injectable clock drives the windows."""
+
+import pytest
+
+from rl_scheduler_tpu.scheduler.extender import LatencyStats
+from rl_scheduler_tpu.scheduler.slo import (
+    SloConfig,
+    SloTracker,
+    compute_burn,
+    config_from_snapshot,
+    histogram_bad_fraction,
+    merge_snapshots,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_tracker(clock=None, **kwargs):
+    kwargs.setdefault("p99_ms", 10.0)
+    kwargs.setdefault("availability", 0.999)
+    return SloTracker(SloConfig(**kwargs), clock=clock or FakeClock())
+
+
+# ------------------------------------------------------------------ config
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        SloConfig()  # no objective armed
+    with pytest.raises(ValueError):
+        SloConfig(p99_ms=-1.0)
+    with pytest.raises(ValueError):
+        SloConfig(availability=1.5)
+    with pytest.raises(ValueError):
+        SloConfig(p99_ms=5.0, fast_window_s=600.0, slow_window_s=60.0)
+    # Single-objective configs are valid.
+    assert SloConfig(p99_ms=5.0).objectives().keys() == {"latency"}
+    assert SloConfig(availability=0.99).objectives().keys() == {
+        "availability"}
+
+
+def test_config_round_trips_through_snapshot():
+    tracker = make_tracker()
+    assert config_from_snapshot(tracker.snapshot()) == tracker.config
+
+
+# ------------------------------------------------------------- burn rates
+
+
+def test_latency_burn_rate_math():
+    """100 decided requests, 5 over the 10 ms threshold: bad fraction
+    5%, latency budget 1% -> burn rate 5.0 in both windows."""
+    clock = FakeClock()
+    tracker = make_tracker(clock)
+    for i in range(100):
+        tracker.observe(0.002 if i % 20 else 0.02)  # 5 of 100 over
+    snap = tracker.snapshot()
+    lat = snap["objectives"]["latency"]
+    assert lat["windows"]["fast"]["total"] == 100
+    assert lat["windows"]["fast"]["bad"] == 5
+    assert lat["windows"]["fast"]["burn_rate"] == pytest.approx(5.0)
+    assert lat["windows"]["slow"]["burn_rate"] == pytest.approx(5.0)
+    # 5x burn is below the 14.4x fast threshold: not burning.
+    assert not lat["burning"]
+    assert not snap["degraded"]
+
+
+def test_total_outage_burns_and_degrades():
+    """All requests failing open: availability bad fraction 1.0 against
+    a 0.1% budget -> burn ~1000x, far over both thresholds."""
+    tracker = make_tracker()
+    for _ in range(50):
+        tracker.observe_failure()
+    snap = tracker.snapshot()
+    avail = snap["objectives"]["availability"]
+    assert avail["windows"]["fast"]["bad_fraction"] == 1.0
+    assert avail["burning"]
+    assert snap["degraded"]
+    # Fail-opens are excluded from the latency objective's denominator.
+    assert snap["objectives"]["latency"]["windows"]["fast"]["total"] == 0
+
+
+def test_window_expiry_forgives_old_badness():
+    """Bad events older than the window stop burning it: the fast
+    window recovers first (multi-window = fast detection AND fast
+    recovery), the slow window still remembers."""
+    clock = FakeClock()
+    tracker = make_tracker(clock, fast_window_s=10.0, slow_window_s=100.0,
+                           fast_burn=2.0, slow_burn=1.0)
+    for _ in range(20):
+        tracker.observe(0.5)  # all over threshold: burn 100x
+    assert tracker.snapshot()["degraded"]
+    clock.t += 30.0  # past fast window, inside slow
+    snap = tracker.snapshot()
+    assert snap["objectives"]["latency"]["windows"]["fast"]["total"] == 0
+    assert snap["objectives"]["latency"]["windows"]["slow"]["bad"] == 20
+    # Fast window clean -> the AND rule stops paging (degraded clears).
+    assert not snap["degraded"]
+
+
+def test_lifetime_counters_are_monotonic():
+    clock = FakeClock()
+    tracker = make_tracker(clock)
+    for _ in range(10):
+        tracker.observe(0.5)
+    tracker.observe_failure()
+    life = tracker.snapshot()["lifetime"]
+    assert life == {"requests_total": 11, "latency_bad_total": 10,
+                    "fail_open_total": 1}
+    clock.t += 10_000.0  # windows all expire; lifetime never does
+    life2 = tracker.snapshot()["lifetime"]
+    assert life2 == life
+
+
+def test_ring_reuses_slots_across_wraps():
+    """A bucket slot reused after the ring wraps must forget its old
+    epoch's counts (stale counts would resurrect expired badness)."""
+    clock = FakeClock()
+    tracker = make_tracker(clock, fast_window_s=2.0, slow_window_s=5.0)
+    tracker.observe(0.5)
+    clock.t += 8.0  # beyond slow window: the ring index wraps onto the
+    tracker.observe(0.001)  # same arithmetic slots
+    snap = tracker.snapshot()
+    assert snap["objectives"]["latency"]["windows"]["slow"]["bad"] == 0
+    assert snap["objectives"]["latency"]["windows"]["slow"]["total"] == 1
+
+
+# ---------------------------------------------------------------- merging
+
+
+def test_merge_snapshots_sums_counts_and_recomputes_burn():
+    """Counts are linear, rates are not: two workers each at 5% bad
+    merge to 5% pool-wide, not to an average of per-worker burns."""
+    a, b = make_tracker(), make_tracker()
+    for i in range(100):
+        a.observe(0.02 if i < 5 else 0.001)
+    for i in range(300):
+        b.observe(0.02 if i < 15 else 0.001)
+    merged = merge_snapshots([a.snapshot(), b.snapshot()])
+    fast = merged["objectives"]["latency"]["windows"]["fast"]
+    assert fast["total"] == 400
+    assert fast["bad"] == 20
+    assert fast["burn_rate"] == pytest.approx(5.0)
+    assert merged["lifetime"]["requests_total"] == 400
+    assert merge_snapshots([]) is None
+    # One-sided: a worker without a tracker contributes nothing.
+    assert merge_snapshots([a.snapshot(), None])["lifetime"][
+        "requests_total"] == 100
+
+
+def test_compute_burn_is_the_shared_math():
+    """compute_burn over hand-built window counts equals the tracker's
+    own snapshot — per-worker and pool-wide snapshots share ONE
+    implementation."""
+    tracker = make_tracker()
+    for _ in range(10):
+        tracker.observe(0.02)
+    snap = tracker.snapshot()
+    rebuilt = compute_burn(
+        tracker.config,
+        {k: tuple(v) for k, v in snap["windows_raw"].items()},
+        snap["lifetime"])
+    assert rebuilt["objectives"] == snap["objectives"]
+
+
+# --------------------------------------------- histogram seam (canary gate)
+
+
+def _hist_snapshot(latencies_s):
+    stats = LatencyStats()
+    for v in latencies_s:
+        stats.record(v)
+    cumulative, total_sum, count = stats.histogram()
+    return {"cumulative": cumulative, "sum": total_sum, "count": count}
+
+
+def test_histogram_bad_fraction_from_deltas():
+    """Over-threshold fraction from lifetime-histogram deltas: exact at
+    bucket bounds, conservative (threshold rounds UP to a bound)."""
+    start = _hist_snapshot([])
+    end = _hist_snapshot([0.001] * 90 + [0.2] * 10)  # 10% over 100 ms
+    frac, count = histogram_bad_fraction(start, end, 100.0,
+                                         LatencyStats.BUCKETS)
+    assert count == 100
+    assert frac == pytest.approx(0.10)
+    # A threshold between bounds rounds up (conservative: 30 ms uses the
+    # 50 ms bucket boundary, so 40 ms samples do NOT count as bad).
+    end2 = _hist_snapshot([0.04] * 10 + [0.001] * 90)
+    frac2, _ = histogram_bad_fraction(_hist_snapshot([]), end2, 30.0,
+                                      LatencyStats.BUCKETS)
+    assert frac2 == 0.0
+    # Empty window: no signal, no division.
+    assert histogram_bad_fraction(end, end, 100.0,
+                                  LatencyStats.BUCKETS) == (0.0, 0)

@@ -1,0 +1,366 @@
+"""graftlens part 3: the serving perf report with regression gating.
+
+``tools/traceview`` turned TRAINING profiler traces into budget-checked
+numbers; nothing did the same for serving. decisionview is the serving
+sibling: a pure-stdlib joiner over the three artifacts the serving plane
+already produces —
+
+- a ``/stats`` **snapshot** (single-process or pool body; a JSON file or
+  a live ``http://`` URL) carrying the graftlens phase histograms and
+  the end-to-end latency lifetime numbers,
+- a **trace-log** directory (``scheduler/tracelog.py`` segments) whose
+  records carry per-decision span breakdowns, policy generations, and
+  the ``endpoint=probe`` tag that excludes synthetic traffic,
+- a serving **bench history** ledger (``extender_bench --history``
+  JSONL — one ``schema_version: 1`` line per round),
+
+— into one report:
+
+- **Phase decomposition**: per-phase lifetime mean (ms), share of the
+  end-to-end decide latency, and the reconciliation row (phases must sum
+  to >=90% of end-to-end — a broken span is visible as a gap).
+- **Per-generation comparison**: trace records grouped by policy
+  generation (probes excluded) with count, mean/max latency and
+  fail-open fraction — did the last promote actually get faster?
+- **SLO attainment**: lifetime good-fraction per objective from the
+  snapshot's SLO section, next to the current burn state.
+- **Regression gating**: ``--check`` compares phase means against
+  ``tools/decisionview/budgets.json`` (absent phase or over budget =
+  exit 2 — the traceview/graftlint fail-the-build contract);
+  ``--check-history`` compares the newest bench round against the best
+  prior round with a tolerance (throughput down or p50 up = exit 2),
+  which turns the serving bench trajectory into a gate instead of a
+  scrapbook.
+
+Every input is optional — pass what you have; the report prints the
+sections it can compute. ``make serve-report`` runs it against the
+checked-in fixture (off-network tier-1) or a live pool
+(``SERVE_STATS=http://host:port/stats``). docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+SCHEMA_VERSION = 1
+# The reconciliation bar: the instrumented phases must explain at least
+# this share of the end-to-end decide latency, else the decomposition is
+# lying by omission (a renamed/broken span must not pass silently).
+MIN_PHASE_COVERAGE = 0.90
+# Hot-path order for the decomposition table (extender.PHASES, not
+# imported: decisionview must stay stdlib-only and runnable anywhere).
+PHASE_ORDER = ("parse", "observe", "forward", "marshal", "trace")
+
+
+# ------------------------------------------------------------------ inputs
+
+
+def load_stats(source: str) -> dict:
+    """A ``/stats`` body from a JSON file or a live ``http://`` URL."""
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+
+        with urllib.request.urlopen(source, timeout=10) as resp:
+            return json.load(resp)
+    return json.loads(Path(source).read_text())
+
+
+def load_bench_history(path: str | Path) -> list:
+    """The serving bench ledger: one parsed JSON line per round, in file
+    order. Torn/blank lines are skipped (a killed bench must not poison
+    the ledger), unknown schema versions are kept — fields are read
+    defensively."""
+    path = Path(path)
+    if not path.is_file():
+        return []
+    rounds = []
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rounds.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return rounds
+
+
+def load_trace_records(trace_dir: str | Path,
+                       include_probes: bool = False) -> list:
+    """Replayed trace records, synthetic probe traffic EXCLUDED by
+    default (``endpoint=probe`` — the client-facing numbers must match
+    what clients experienced). Reuses the trace log's own replayer
+    (``scheduler/tracelog.iter_trace`` — a stdlib-only module: sealed
+    segments then parts, torn trailing lines skipped), so the report
+    can never disagree with the writer about segment order."""
+    from rl_scheduler_tpu.scheduler.tracelog import iter_trace
+
+    records = []
+    for record in iter_trace(trace_dir):
+        if not include_probes and record.get("endpoint") == "probe":
+            continue
+        records.append(record)
+    return records
+
+
+# ----------------------------------------------------------------- report
+
+
+def _phase_rows(stats: dict) -> tuple[list, dict]:
+    """``(rows, reconciliation)`` for the phase-decomposition table from
+    a /stats body (single-process and pool bodies share the lifetime
+    keys). Rows: ``(phase, mean_ms, count, fraction_of_e2e)``."""
+    phases = stats.get("phases") or {}
+    latency = stats.get("latency") or {}
+    e2e_mean = latency.get("lifetime_mean_ms")
+    rows = []
+    phase_sum = 0.0
+    ordered = [p for p in PHASE_ORDER if p in phases]
+    ordered += [p for p in sorted(phases) if p not in PHASE_ORDER]
+    for phase in ordered:
+        entry = phases[phase]
+        mean = entry.get("lifetime_mean_ms")
+        count = entry.get("lifetime_count", 0)
+        frac = (mean / e2e_mean if mean is not None and e2e_mean
+                else None)
+        if mean is not None:
+            phase_sum += mean
+        rows.append((phase, mean, count, frac))
+    reconciliation = {
+        "e2e_mean_ms": e2e_mean,
+        "phase_sum_ms": round(phase_sum, 4),
+        "coverage": (round(phase_sum / e2e_mean, 4)
+                     if e2e_mean else None),
+        "min_coverage": MIN_PHASE_COVERAGE,
+    }
+    return rows, reconciliation
+
+
+def _generation_rows(records: list) -> list:
+    """Per-policy-generation comparison from trace records (probes
+    already excluded): ``(generation, count, mean_ms, p95_ms,
+    fail_open_fraction)`` sorted by generation."""
+    by_gen: dict = {}
+    for record in records:
+        by_gen.setdefault(record.get("generation", 0), []).append(record)
+    rows = []
+    for gen in sorted(by_gen):
+        recs = by_gen[gen]
+        lats = sorted(r.get("latency_ms") for r in recs
+                      if r.get("latency_ms") is not None)
+        fails = sum(1 for r in recs if r.get("fail_open"))
+        mean = round(sum(lats) / len(lats), 3) if lats else None
+        p95 = (round(lats[min(len(lats) - 1, int(0.95 * len(lats)))], 3)
+               if lats else None)
+        rows.append((gen, len(recs), mean, p95,
+                     round(fails / len(recs), 4) if recs else 0.0))
+    return rows
+
+
+def _slo_rows(stats: dict) -> list:
+    """``(objective, target, lifetime_attainment, burning)`` from the
+    snapshot's SLO section. Attainment is lifetime good-fraction —
+    latency over decided requests, availability over all."""
+    slo = stats.get("slo")
+    if not slo:
+        return []
+    lifetime = slo.get("lifetime", {})
+    requests = lifetime.get("requests_total", 0)
+    fail_open = lifetime.get("fail_open_total", 0)
+    decided = max(requests - fail_open, 0)
+    rows = []
+    for name, objective in sorted(slo.get("objectives", {}).items()):
+        if name == "latency":
+            denom, bad = decided, lifetime.get("latency_bad_total", 0)
+        else:
+            denom, bad = requests, fail_open
+        attainment = round(1.0 - bad / denom, 6) if denom else None
+        rows.append((name, objective.get("target"), attainment,
+                     objective.get("burning", False)))
+    return rows
+
+
+def build_report(stats: dict | None = None, records: list | None = None,
+                 history: list | None = None) -> dict:
+    """The decisionview report body (one bench-style JSON line). Every
+    section is computed from whichever inputs were supplied."""
+    out: dict = {"metric": "decisionview-serve-report",
+                 "schema_version": SCHEMA_VERSION}
+    if stats is not None:
+        rows, reconciliation = _phase_rows(stats)
+        out["phases"] = {
+            phase: {"mean_ms": mean, "count": count, "fraction": frac}
+            for phase, mean, count, frac in rows
+        }
+        out["reconciliation"] = reconciliation
+        slo_rows = _slo_rows(stats)
+        if slo_rows:
+            out["slo"] = {
+                name: {"target": target, "attainment": attainment,
+                       "burning": burning}
+                for name, target, attainment, burning in slo_rows
+            }
+        latency = stats.get("latency") or {}
+        out["e2e"] = {
+            "mean_ms": latency.get("lifetime_mean_ms"),
+            "count": latency.get("lifetime_count"),
+            "p50_ms": latency.get("p50_ms"),
+            "p99_ms": latency.get("p99_ms"),
+        }
+    if records is not None:
+        out["generations"] = {
+            str(gen): {"count": count, "mean_ms": mean, "p95_ms": p95,
+                       "fail_open_fraction": fail_frac}
+            for gen, count, mean, p95, fail_frac in _generation_rows(records)
+        }
+        out["trace_records"] = len(records)
+    if history:
+        newest = history[-1]
+        out["bench"] = {
+            "rounds": len(history),
+            "newest": {k: newest.get(k) for k in
+                       ("req_per_sec", "client_p50_ms", "client_p99_ms",
+                        "workers", "nodes", "concurrency", "failures")},
+        }
+    return out
+
+
+def format_report(report: dict) -> str:
+    """Human-readable tables for the terminal (the JSON line is the
+    machine contract; this is the operator's view)."""
+    lines = ["decisionview serving report", "=" * 27]
+    phases = report.get("phases")
+    if phases:
+        lines += ["", "Phase decomposition (lifetime means, probe "
+                      "traffic excluded):",
+                  f"  {'phase':<10} {'mean ms':>10} {'count':>10} "
+                  f"{'share of e2e':>13}"]
+        for phase, entry in phases.items():
+            mean = entry.get("mean_ms")
+            frac = entry.get("fraction")
+            lines.append(
+                f"  {phase:<10} "
+                f"{mean if mean is not None else '-':>10} "
+                f"{entry.get('count', 0):>10} "
+                f"{f'{frac * 100:.1f}%' if frac is not None else '-':>13}")
+        rec = report.get("reconciliation", {})
+        cov = rec.get("coverage")
+        lines.append(
+            f"  phases sum {rec.get('phase_sum_ms')} ms vs end-to-end "
+            f"{rec.get('e2e_mean_ms')} ms "
+            f"({f'{cov * 100:.1f}%' if cov is not None else 'n/a'} "
+            f"coverage; bar {rec.get('min_coverage', MIN_PHASE_COVERAGE) * 100:.0f}%)")
+    slo = report.get("slo")
+    if slo:
+        lines += ["", "SLO attainment (lifetime):"]
+        for name, entry in slo.items():
+            att = entry.get("attainment")
+            lines.append(
+                f"  {name:<13} target {entry.get('target')}  attainment "
+                f"{f'{att:.6f}' if att is not None else 'n/a'}  "
+                f"{'BURNING' if entry.get('burning') else 'ok'}")
+    gens = report.get("generations")
+    if gens:
+        lines += ["", "Per-generation latency (trace records, probes "
+                      "excluded):",
+                  f"  {'gen':>4} {'count':>8} {'mean ms':>9} "
+                  f"{'p95 ms':>9} {'fail-open':>10}"]
+        for gen, entry in gens.items():
+            lines.append(
+                f"  {gen:>4} {entry['count']:>8} "
+                f"{entry['mean_ms'] if entry['mean_ms'] is not None else '-':>9} "
+                f"{entry['p95_ms'] if entry['p95_ms'] is not None else '-':>9} "
+                f"{entry['fail_open_fraction'] * 100:>9.1f}%")
+    bench = report.get("bench")
+    if bench:
+        newest = bench["newest"]
+        lines += ["", f"Bench history: {bench['rounds']} round(s); newest: "
+                      f"{newest.get('req_per_sec')} req/s, p50 "
+                      f"{newest.get('client_p50_ms')} ms "
+                      f"({newest.get('workers')}w x N="
+                      f"{newest.get('nodes')} x c="
+                      f"{newest.get('concurrency')})"]
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------------- checks
+
+
+def check_budgets(report: dict, budgets: dict) -> list:
+    """Violation strings for ``--check`` (empty = pass): a budgeted
+    phase over ``budget_ms * (1 + tolerance_pct/100)`` fails, an ABSENT
+    budgeted phase fails (a broken span must not pass silently), and a
+    phase-coverage reconciliation below the bar fails. Same exit-2
+    contract as traceview's budget check."""
+    tolerance = float(budgets.get("tolerance_pct", 25.0))
+    violations = []
+    phases = report.get("phases") or {}
+    for phase, budget_ms in sorted((budgets.get("phases") or {}).items()):
+        entry = phases.get(phase)
+        mean = entry.get("mean_ms") if entry else None
+        limit = float(budget_ms) * (1.0 + tolerance / 100.0)
+        if mean is None:
+            violations.append(
+                f"phase {phase!r}: absent from the report (budget "
+                f"{budget_ms} ms) — spans disabled or a renamed phase?")
+        elif mean > limit:
+            violations.append(
+                f"phase {phase!r}: {mean:.3f} ms mean exceeds budget "
+                f"{budget_ms} ms by more than {tolerance:.0f}% "
+                f"(limit {limit:.3f} ms)")
+    rec = report.get("reconciliation")
+    if rec and rec.get("coverage") is not None:
+        if rec["coverage"] < rec.get("min_coverage", MIN_PHASE_COVERAGE):
+            violations.append(
+                f"phase coverage {rec['coverage'] * 100:.1f}% of "
+                f"end-to-end is below the "
+                f"{rec.get('min_coverage', MIN_PHASE_COVERAGE) * 100:.0f}% "
+                "bar — a span is missing time")
+    return violations
+
+
+def check_history(history: list, tolerance_pct: float = 25.0) -> list:
+    """Violation strings for ``--check-history``: the newest bench round
+    must keep ``req_per_sec`` within ``tolerance_pct`` below — and
+    ``client_p50_ms`` within ``tolerance_pct`` above — the BEST prior
+    round at the same (workers, nodes, concurrency) shape. Fewer than
+    two comparable rounds passes vacuously (the ledger is just
+    starting)."""
+    if len(history) < 2:
+        return []
+    newest = history[-1]
+    shape = tuple(newest.get(k) for k in ("workers", "nodes", "concurrency"))
+    priors = [r for r in history[:-1]
+              if tuple(r.get(k) for k in ("workers", "nodes",
+                                          "concurrency")) == shape]
+    violations = []
+    tol = tolerance_pct / 100.0
+    best_rps = max((r.get("req_per_sec") for r in priors
+                    if r.get("req_per_sec") is not None), default=None)
+    rps = newest.get("req_per_sec")
+    if best_rps is not None and rps is not None and rps < best_rps * (1 - tol):
+        violations.append(
+            f"req_per_sec regressed: {rps} vs best prior {best_rps} "
+            f"(> {tolerance_pct:.0f}% down) at shape "
+            f"workers={shape[0]} nodes={shape[1]} concurrency={shape[2]}")
+    best_p50 = min((r.get("client_p50_ms") for r in priors
+                    if r.get("client_p50_ms") is not None), default=None)
+    p50 = newest.get("client_p50_ms")
+    if best_p50 is not None and p50 is not None and p50 > best_p50 * (1 + tol):
+        violations.append(
+            f"client_p50_ms regressed: {p50} vs best prior {best_p50} "
+            f"(> {tolerance_pct:.0f}% up) at shape "
+            f"workers={shape[0]} nodes={shape[1]} concurrency={shape[2]}")
+    return violations
+
+
+def check_slo(report: dict) -> list:
+    """Violation strings for ``--slo-check``: any burning objective
+    fails (the gate `make slo-check` runs)."""
+    return [
+        f"SLO objective {name!r} is burning (target {entry.get('target')}, "
+        f"lifetime attainment {entry.get('attainment')})"
+        for name, entry in (report.get("slo") or {}).items()
+        if entry.get("burning")
+    ]

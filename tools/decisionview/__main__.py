@@ -1,0 +1,151 @@
+"""decisionview CLI (graftlens part 3 — see the package docstring).
+
+Usage::
+
+    # full report against a live pool's control plane + its trace dir
+    python -m tools.decisionview --stats http://127.0.0.1:8788/stats \
+        --trace /var/trace --bench BENCH_serving.jsonl
+
+    # the regression gate (tier-1 runs this against the checked-in
+    # fixture; exit 2 on an over-budget/absent phase or coverage gap)
+    python -m tools.decisionview --stats tests/fixtures/decisionview/stats.json \
+        --check --budgets tools/decisionview/budgets.json
+
+    # serving bench trajectory gate (exit 2 when the newest round
+    # regressed vs the best prior round at the same shape)
+    python -m tools.decisionview --bench BENCH_serving.jsonl --check-history
+
+    # SLO gate: exit 2 while any objective burns (`make slo-check`)
+    python -m tools.decisionview --stats http://127.0.0.1:8788/stats --slo-check
+
+Prints the human tables to stdout plus ONE bench.py-style JSON line
+(the documented schema); all violations go to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tools.decisionview import (
+    build_report,
+    check_budgets,
+    check_history,
+    check_slo,
+    format_report,
+    load_bench_history,
+    load_stats,
+    load_trace_records,
+)
+
+
+def main(argv: list | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.decisionview",
+        description="Join a /stats snapshot, a decision-trace directory "
+                    "and the serving bench ledger into one phase/SLO/"
+                    "generation report, with budget + history regression "
+                    "gates.")
+    p.add_argument("--stats", default=None, metavar="FILE|URL",
+                   help="/stats body: a JSON file or a live http:// URL "
+                        "(pool control plane or single-process server)")
+    p.add_argument("--trace", default=None, metavar="DIR",
+                   help="decision trace-log directory (--trace-dir); "
+                        "probe records are excluded")
+    p.add_argument("--bench", default=None, metavar="FILE",
+                   help="serving bench ledger (extender_bench --history "
+                        "JSONL)")
+    p.add_argument("--budgets", default=None,
+                   help="phase-budget JSON (default with --check: "
+                        "tools/decisionview/budgets.json)")
+    p.add_argument("--check", action="store_true",
+                   help="exit 2 on an over-budget phase, an absent "
+                        "budgeted phase, or phase coverage below the bar")
+    p.add_argument("--check-history", action="store_true",
+                   help="exit 2 when the newest bench round regressed "
+                        "vs the best prior round at the same shape")
+    p.add_argument("--history-tolerance-pct", type=float, default=25.0,
+                   help="tolerance for --check-history (default 25)")
+    p.add_argument("--slo-check", action="store_true",
+                   help="exit 2 while any SLO objective is burning")
+    p.add_argument("--write-budgets", default=None, metavar="OUT",
+                   help="record this report's phase means as the new "
+                        "budget baseline (traceview's --write-budgets "
+                        "contract)")
+    p.add_argument("--tolerance-pct", type=float, default=50.0,
+                   help="tolerance recorded by --write-budgets "
+                        "(default 50)")
+    p.add_argument("--json", action="store_true",
+                   help="print only the JSON line (no human tables)")
+    args = p.parse_args(argv)
+
+    if args.stats is None and args.trace is None and args.bench is None:
+        p.error("pass at least one input (--stats / --trace / --bench)")
+    if args.check and args.stats is None:
+        p.error("--check needs --stats (the phase means live there)")
+    if args.check_history and args.bench is None:
+        p.error("--check-history needs --bench")
+    if args.slo_check and args.stats is None:
+        p.error("--slo-check needs --stats")
+
+    try:
+        stats = load_stats(args.stats) if args.stats else None
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"decisionview: cannot load stats {args.stats}: {e}",
+              file=sys.stderr)
+        return 1
+    records = (load_trace_records(args.trace)
+               if args.trace is not None else None)
+    history = (load_bench_history(args.bench)
+               if args.bench is not None else None)
+
+    report = build_report(stats=stats, records=records, history=history)
+    if not args.json:
+        print(format_report(report))
+        print()
+    print(json.dumps(report), flush=True)
+
+    if args.write_budgets:
+        budgets = {
+            "tolerance_pct": args.tolerance_pct,
+            "unit": "ms",
+            "phases": {
+                phase: entry["mean_ms"]
+                for phase, entry in (report.get("phases") or {}).items()
+                if entry.get("mean_ms") is not None
+            },
+        }
+        Path(args.write_budgets).write_text(
+            json.dumps(budgets, indent=2) + "\n")
+        print(f"decisionview: budgets written to {args.write_budgets}",
+              file=sys.stderr)
+
+    violations = []
+    if args.check:
+        budgets_path = Path(args.budgets
+                            or Path(__file__).parent / "budgets.json")
+        try:
+            budgets = json.loads(budgets_path.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"decisionview: cannot load budgets {budgets_path}: {e}",
+                  file=sys.stderr)
+            return 1
+        violations += check_budgets(report, budgets)
+    if args.check_history:
+        violations += check_history(history or [],
+                                    args.history_tolerance_pct)
+    if args.slo_check:
+        violations += check_slo(report)
+    for violation in violations:
+        print(f"decisionview: REGRESSION: {violation}", file=sys.stderr)
+    if violations:
+        return 2
+    if args.check or args.check_history or args.slo_check:
+        print("decisionview: all gates OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

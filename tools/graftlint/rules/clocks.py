@@ -1,0 +1,109 @@
+"""GL011 non-monotonic clock used for duration measurement.
+
+``time.time()`` is wall-clock: NTP slews and steps move it, VM
+suspend/resume jumps it, and a negative delta is a legal return value.
+Every latency number this repo publishes — the extender's per-phase
+spans and SLO burn windows (graftlens), the serving bench lines the
+history gate compares, the study ledger's wall times — is a DURATION,
+and durations must come from ``time.perf_counter()`` / ``time.monotonic()``
+(the serving plane's own convention since round 4). A wall-clock delta
+sneaking into one of these is a silent data-quality bug: the histogram
+records a clock adjustment as a 40 ms decision.
+
+The rule flags subtractions in ``scheduler/``, ``loadgen/`` and
+``studies/`` where either operand is ``time.time()`` (directly, or a
+name assigned from it in the same module). Wall-clock used as a
+TIMESTAMP (``"ts": time.time()``) or shifted by a literal (epoch
+arithmetic, ``time.time() - 3600``) stays unflagged — the clock is the
+right tool for points in time, just never for distances between them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.engine import LintContext, Module
+from tools.graftlint.rules import Rule, register
+
+
+def _bare_time_imported(tree: ast.AST) -> set:
+    """Local names that mean the wall clock: ``from time import time``
+    (with or without ``as``)."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "time":
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+def _is_wallclock_call(node: ast.AST, bare_names: set) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    if (isinstance(func, ast.Attribute) and func.attr == "time"
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "time"):
+        return True  # time.time()
+    return (isinstance(func, ast.Name) and func.id in bare_names)
+
+
+def _tainted_names(tree: ast.AST, bare_names: set) -> set:
+    """Names assigned from a wall-clock call anywhere in the module
+    (one pass, scope-agnostic on purpose: a start-time variable's name
+    is its identity here, and a false negative costs more than the
+    theoretical shadowing false positive)."""
+    tainted = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and _is_wallclock_call(
+                node.value, bare_names):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    tainted.add(target.id)
+        elif (isinstance(node, ast.AnnAssign) and node.value is not None
+              and _is_wallclock_call(node.value, bare_names)
+              and isinstance(node.target, ast.Name)):
+            tainted.add(node.target.id)
+    return tainted
+
+
+@register
+class NonMonotonicClockDelta(Rule):
+    id = "GL011"
+    name = "wallclock-latency"
+    summary = ("time.time() delta used as a duration in scheduler//"
+               "loadgen//studies/ — use time.perf_counter()/monotonic()")
+
+    # Directories publishing latency/duration numbers (the serving
+    # plane, its load generators, and the study ledger).
+    DIRS = frozenset({"scheduler", "loadgen", "studies"})
+
+    def check(self, module: Module, ctx: LintContext) -> Iterator:
+        if not (self.DIRS & set(module.rel.split("/")[:-1])):
+            return
+        bare_names = _bare_time_imported(module.tree)
+        tainted = _tainted_names(module.tree, bare_names)
+
+        def wallclock(side: ast.AST) -> bool:
+            return (_is_wallclock_call(side, bare_names)
+                    or (isinstance(side, ast.Name) and side.id in tainted))
+
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)):
+                continue
+            left, right = node.left, node.right
+            if not (wallclock(left) or wallclock(right)):
+                continue
+            if isinstance(left, ast.Constant) or isinstance(right,
+                                                            ast.Constant):
+                continue  # epoch arithmetic (now - 3600): a timestamp
+            yield self.finding(
+                module, node.lineno,
+                "time.time() delta measures a duration with the WALL "
+                "clock (NTP steps/slews corrupt it) — use "
+                "time.perf_counter() or time.monotonic() for intervals; "
+                "wall-clock is for timestamps only",
+            )
