@@ -10,7 +10,8 @@ TRACE ?= tests/fixtures/traceview/fixture.trace.json.gz
 
 .PHONY: lint lint-json test tier1 trace-summary obs chaos chaos-soak \
         serve-pool serve-soak rollout-drill eval-matrix scenario-bench \
-        study study-list overlap-bench serve-report slo-check span-ab
+        study study-list overlap-bench serve-report slo-check span-ab \
+        fastpath-ab
 
 lint:
 	$(PY) -m tools.graftlint --check
@@ -97,6 +98,19 @@ span-ab:
 	JAX_PLATFORMS=cpu $(PY) loadgen/span_ab.py --nodes $(SPAN_NODES) \
 		--threads 8 --workers 2 --rounds $(SPAN_ROUNDS) \
 		--duration $(SPAN_DURATION)
+
+# graftfwd lever matrix (docs/serving.md): off/batch/int8/cache/all,
+# interleaved pools at the ROADMAP-item-2 regime, one ledger line per
+# lever (BENCH_serving.jsonl; `make serve-report` gates the rows).
+FP_NODES ?= 1024
+FP_ROUNDS ?= 2
+FP_DURATION ?= 15
+FP_LEVERS ?= off,batch,int8,cache,all
+fastpath-ab:
+	JAX_PLATFORMS=cpu $(PY) loadgen/extender_bench.py \
+		--levers $(FP_LEVERS) --nodes $(FP_NODES) --threads 8 \
+		--workers 2 --rounds $(FP_ROUNDS) --duration $(FP_DURATION) \
+		--history BENCH_serving.jsonl
 
 # graftscenario (docs/scenarios.md): the scenario x policy-family eval
 # matrix — one schema_version-tagged JSON line per cell to

@@ -125,7 +125,10 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
     a dying worker's accept queue RSTs on close; the retry's fresh
     connection re-hashes to a live worker; retries are reported, HTTP
     errors never retry).
-    Returns ``(sorted_latencies_ms, wall_s, failures, phases)``.
+    Returns ``(sorted_latencies_ms, wall_s, failures, phases, retries)``
+    — ``retries`` is counted (and reported) UNCONDITIONALLY, so lever
+    A/B lines stay field-comparable with rollout-drill lines; ``phases``
+    is ``None`` without a promote.
     """
     payloads = [make_payload(i, num_nodes) for i in range(16)]
     connect_retries = 3 if promote_at is not None else 0
@@ -134,6 +137,7 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
     t_promote = None if promote_at is None else t_start + promote_at
     latencies: list = []
     failures = [0]
+    retries_total = [0]
     phases = {"pre_promote": {"requests": 0, "failures": 0, "retries": 0},
               "post_promote": {"requests": 0, "failures": 0, "retries": 0}}
     lock = threading.Lock()
@@ -169,6 +173,7 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
                 phases[phase]["requests"] += reqs
                 phases[phase]["failures"] += fails
                 phases[phase]["retries"] += retries
+                retries_total[0] += retries
 
     workers = [threading.Thread(target=run, args=(t,)) for t in range(threads)]
     for w in workers:
@@ -176,7 +181,7 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
     for w in workers:
         w.join()
     return (sorted(latencies), time.perf_counter() - t_start, failures[0],
-            phases if t_promote is not None else None)
+            phases if t_promote is not None else None, retries_total[0])
 
 
 def _fire_promote(control: str, checkpoint: str, delay_s: float,
@@ -226,6 +231,183 @@ def _get_json(url: str) -> dict:
         return json.loads(resp.read())
 
 
+# --------------------------------------------------------- graftfwd levers
+
+LEVERS = ("off", "batch", "int8", "cache", "all")
+
+
+def _lever_factory(np_tree: dict, lever: str, batch_window_ms: float,
+                   cache_epoch_s: float, nodes: int = 8):
+    """Pool worker factory for one lever configuration (the span_ab
+    pattern: a pure-numpy tree crosses fork cleanly; each worker builds
+    its own backend/levers). ``off`` is the PR-12 baseline — the plain
+    numpy set backend; ``int8`` goes through make_set_backend's
+    agreement gate, so an int8 row in the matrix IS a gated row."""
+
+    def factory(worker_id, shared):
+        from rl_scheduler_tpu.scheduler import set_backend as sb
+        from rl_scheduler_tpu.scheduler.extender import ExtenderPolicy
+        from rl_scheduler_tpu.scheduler.fastpath import (
+            MicroBatcher,
+            ScoreCache,
+        )
+        from rl_scheduler_tpu.scheduler.telemetry import (
+            RandomCpu,
+            TableTelemetry,
+        )
+
+        telemetry = TableTelemetry.from_table(
+            cpu_source=RandomCpu(seed=worker_id),
+            counter=shared.table_counter)
+        if lever in ("int8", "all"):
+            # warm_counts carries the N this bench serves, so the int8
+            # agreement gate measures the distribution the lever row
+            # claims (not just the small-set floor).
+            backend, _ = sb.make_set_backend("native-int8", np_tree,
+                                             warm_counts=(nodes,))
+        else:
+            backend = sb.NumpySetBackend(np_tree)
+        policy = ExtenderPolicy(backend, telemetry)
+        if lever in ("batch", "all"):
+            policy.batcher = MicroBatcher(
+                backend, window_s=batch_window_ms / 1e3)
+        if lever in ("cache", "all"):
+            policy.score_cache = ScoreCache(epoch_s=cache_epoch_s)
+        return policy
+
+    return factory
+
+
+def _run_lever_round(np_tree: dict, lever: str, args) -> dict:
+    """One lever x one round: fresh pool, warm-up, reset, soak, server
+    stats off the control plane. Raises on a pool that cannot start
+    (e.g. the int8 agreement gate refusing) — the matrix reports it as
+    a skipped lever."""
+    from rl_scheduler_tpu.scheduler.pool import ServingPool
+
+    pool = ServingPool(
+        _lever_factory(np_tree, lever, args.batch_window_ms,
+                       args.cache_epoch_s, nodes=args.nodes),
+        workers=args.workers, host="127.0.0.1", port=0, control_port=0)
+    pool.start(ready_timeout_s=120.0)
+    try:
+        base = f"http://127.0.0.1:{pool.port}"
+        control = "http://127.0.0.1:%d" % pool.control_address[1]
+        for i in range(2 * args.workers + 4):
+            one_request(base, i, args.nodes)
+        _get_json(control + "/healthz")
+        reset_req = urllib.request.Request(
+            control + "/stats/reset", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(reset_req, timeout=10) as resp:
+            resp.read()
+        latencies, wall, failures, _, retries = _soak(
+            base, args.duration, args.threads, args.nodes)
+        server_stats = _get_json(control + "/stats")
+    finally:
+        pool.shutdown()
+    if not latencies:
+        raise RuntimeError(f"lever {lever!r}: soak completed zero requests")
+    p50 = latencies[len(latencies) // 2]
+    out = {
+        "req_per_sec": round(len(latencies) / wall, 1),
+        "client_p50_ms": round(p50, 3),
+        "client_p99_ms": round(
+            latencies[min(len(latencies) - 1,
+                          int(0.99 * len(latencies)))], 3),
+        "requests": len(latencies),
+        "failures": failures,
+        "retries": retries,
+        "server_p50_ms": (server_stats.get("latency") or {}).get("p50_ms"),
+        "backend": server_stats.get("backend"),
+        "fastpath": server_stats.get("fastpath"),
+    }
+    return out
+
+
+def run_levers_matrix(args) -> list:
+    """The ``--levers`` matrix (graftfwd): one pool per lever per round,
+    levers INTERLEAVED inside every round (the bench.py/span_ab
+    discipline — sequential per-variant runs measured 0.5-1.35x host
+    drift on identical code), best-of-rounds per lever, ONE
+    ``schema_version`` JSON line per lever. With ``--history`` each
+    lever's line appends to the durable ledger carrying a ``lever``
+    field, so `tools/decisionview --check-history` gates every lever's
+    trajectory separately (shape = workers x nodes x concurrency x
+    lever)."""
+    import pathlib
+    import sys as _sys
+
+    _sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rl_scheduler_tpu.models.transformer import SetTransformerPolicy
+
+    levers = [lv.strip() for lv in args.levers.split(",") if lv.strip()]
+    unknown = [lv for lv in levers if lv not in LEVERS]
+    if unknown:
+        raise SystemExit(f"--levers: unknown lever(s) {unknown}; "
+                         f"choose from {list(LEVERS)}")
+    net = SetTransformerPolicy(dim=64, depth=2)
+    tree = net.init(jax.random.PRNGKey(0), jnp.zeros((8, 6), jnp.float32))
+    np_tree = jax.tree_util.tree_map(np.asarray, tree)
+
+    rows: dict = {lever: [] for lever in levers}
+    skipped: dict = {}
+    for r in range(args.rounds):
+        order = levers if r % 2 == 0 else list(reversed(levers))
+        for lever in order:
+            if lever in skipped:
+                continue
+            try:
+                row = _run_lever_round(np_tree, lever, args)
+            except Exception as e:  # noqa: BLE001 — a refused lever
+                # (int8 gate, missing toolchain) skips, never aborts
+                # the rest of the matrix
+                print(f"lever {lever!r} skipped: {e}", file=sys.stderr)
+                skipped[lever] = str(e)
+                continue
+            rows[lever].append(row)
+            print(f"round {r} lever={lever}: {row['req_per_sec']} req/s "
+                  f"p50 {row['client_p50_ms']} ms "
+                  f"({row['requests']} reqs, {row['failures']} failures)",
+                  file=sys.stderr)
+
+    lines = []
+    for lever in levers:
+        if not rows[lever]:
+            continue
+        best = max(rows[lever], key=lambda row: row["req_per_sec"])
+        line = {
+            "schema_version": SCHEMA_VERSION,
+            "bench": "extender_serving",
+            "mode": "levers",
+            "lever": lever,
+            "workers": args.workers,
+            "nodes": args.nodes,
+            "concurrency": args.threads,
+            "threads": args.threads,
+            "rounds": len(rows[lever]),
+            "duration_s": args.duration,
+            "rounds_rps": [row["req_per_sec"] for row in rows[lever]],
+            **best,
+        }
+        lines.append(line)
+        print(json.dumps(line))
+        if args.history is not None:
+            with open(args.history, "a", encoding="utf-8") as fh:
+                fh.write(json.dumps(line) + "\n")
+    off_rps = next((ln["req_per_sec"] for ln in lines
+                    if ln["lever"] == "off"), None)
+    for line in lines:
+        if off_rps and line["lever"] != "off":
+            print(f"{line['lever']}: {line['req_per_sec'] / off_rps:.2f}x "
+                  "off-lever req/s", file=sys.stderr)
+    return lines
+
+
 def main(argv: list[str] | None = None) -> dict:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--host", default="127.0.0.1")
@@ -261,7 +443,35 @@ def main(argv: list[str] | None = None) -> dict:
                         "root) so rounds accumulate a durable "
                         "trajectory; `tools/decisionview --check-history`"
                         " gates the newest round against the priors")
+    p.add_argument("--levers", default=None, metavar="L1,L2,...",
+                   help="graftfwd matrix mode: self-host one pool per "
+                        "lever per round (off/batch/int8/cache/all, "
+                        "interleaved — the span_ab discipline), soak "
+                        "each, and print/append ONE JSON line per lever "
+                        "carrying a `lever` field. Ignores --host/--port "
+                        "(pools bind ephemeral localhost ports); "
+                        "`make fastpath-ab` is the one-command recipe")
+    p.add_argument("--rounds", type=int, default=2,
+                   help="levers mode: interleaved rounds per lever "
+                        "(default 2)")
+    p.add_argument("--workers", type=int, default=2,
+                   help="levers mode: pool workers per lever pool "
+                        "(default 2)")
+    p.add_argument("--batch-window-ms", type=float, default=1.5,
+                   help="levers mode: admission window for the batch/"
+                        "all levers (default 1.5)")
+    p.add_argument("--cache-epoch-s", type=float, default=3600.0,
+                   help="levers mode: telemetry epoch for the cache/all "
+                        "levers (default 3600 — the bench's request "
+                        "stream repeats node sets, so one epoch shows "
+                        "the hit path; live serving uses ~15)")
     args = p.parse_args(argv)
+    if args.levers is not None:
+        if args.duration is None:
+            args.duration = 10.0
+        if args.promote_at is not None:
+            p.error("--levers and --promote-at are separate drills")
+        return run_levers_matrix(args)
     if args.requests < 1:
         p.error("--requests must be >= 1")
     if args.duration is not None and args.duration <= 0:
@@ -297,7 +507,7 @@ def main(argv: list[str] | None = None) -> dict:
         print("warning: server has no /stats/reset; server-side "
               "percentiles may include pre-run traffic", file=sys.stderr)
 
-    failures = 0
+    failures = retries = 0
     phases = promote = None
     if args.duration is not None:
         promote_thread = result_box = None
@@ -313,7 +523,7 @@ def main(argv: list[str] | None = None) -> dict:
             promote_thread = threading.Thread(target=_promote_then_record,
                                               daemon=True)
             promote_thread.start()
-        latencies, wall, failures, phases = _soak(
+        latencies, wall, failures, phases, retries = _soak(
             base, args.duration, args.threads, args.nodes,
             promote_at=args.promote_at)
         if promote_thread is not None:
@@ -358,6 +568,10 @@ def main(argv: list[str] | None = None) -> dict:
         "threads": args.threads,
         "duration_s": round(wall, 3),
         "failures": failures,
+        # Unconditional (round-13 fix): the retry counter used to ride
+        # only the --promote-at phase split, so lever A/B lines were not
+        # field-comparable with rollout-drill lines.
+        "retries": retries,
         "client_p50_ms": round(pct(0.50), 3),
         "client_p90_ms": round(pct(0.90), 3),
         "client_p99_ms": round(pct(0.99), 3),

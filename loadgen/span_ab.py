@@ -73,7 +73,7 @@ def _run_variant(np_tree: dict, spans: bool, workers: int, nodes: int,
         base = f"http://127.0.0.1:{pool.port}"
         for i in range(2 * workers + 4):  # warm every worker's caches
             extender_bench.one_request(base, i, nodes)
-        latencies, wall, failures, _ = extender_bench._soak(
+        latencies, wall, failures, _, _ = extender_bench._soak(
             base, duration_s, threads, nodes)
     finally:
         pool.shutdown()

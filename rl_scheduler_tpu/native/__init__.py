@@ -12,11 +12,12 @@ compute path for training.
 from rl_scheduler_tpu.native.build import (
     NativeMLP,
     NativeSetTransformer,
+    NativeSetTransformerInt8,
     ensure_built,
     ensure_built_set,
     pack_mlp,
     pack_set,
 )
 
-__all__ = ["NativeMLP", "NativeSetTransformer", "ensure_built",
-           "ensure_built_set", "pack_mlp", "pack_set"]
+__all__ = ["NativeMLP", "NativeSetTransformer", "NativeSetTransformerInt8",
+           "ensure_built", "ensure_built_set", "pack_mlp", "pack_set"]

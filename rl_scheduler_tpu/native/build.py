@@ -24,7 +24,7 @@ logger = logging.getLogger(__name__)
 _SRC = Path(__file__).with_name("mlp_infer.cpp")
 _SRC_SET = Path(__file__).with_name("set_infer.cpp")
 ABI_VERSION = 2
-SET_ABI_VERSION = 1
+SET_ABI_VERSION = 2
 ACTIVATIONS = {"tanh": 0, "relu": 1}
 
 
@@ -33,28 +33,66 @@ def _cache_dir() -> Path:
     return Path(root) / "rl_scheduler_tpu"
 
 
+def _host_isa_tag() -> str:
+    """Short tag identifying the build host's ISA — part of the .so
+    cache key, because the first build attempt targets -march=native: a
+    cache dir on a network home shared across heterogeneous hosts must
+    not hand an AVX-512 binary to a machine without it (the load would
+    SIGILL mid-decide; the portable-retry only covers COMPILE failures,
+    not foreign-ISA loads)."""
+    machine = getattr(os, "uname", lambda: None)()
+    machine = machine.machine if machine is not None else "unknown"
+    flags = ""
+    try:
+        with open("/proc/cpuinfo", encoding="utf-8") as fh:
+            for line in fh:
+                if line.startswith("flags"):
+                    flags = line
+                    break
+    except OSError:
+        pass
+    return f"{machine}-{hashlib.sha256(flags.encode()).hexdigest()[:8]}"
+
+
 def _build(src: Path, stem: str, force: bool = False) -> Path | None:
-    """Compile one source into the cache dir, keyed on its hash."""
+    """Compile one source into the cache dir, keyed on its hash + the
+    host ISA (see :func:`_host_isa_tag`)."""
     if not src.exists():
         return None
     digest = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
-    out = _cache_dir() / f"lib{stem}_{digest}.so"
+    out = _cache_dir() / f"lib{stem}_{digest}_{_host_isa_tag()}.so"
     if out.exists() and not force:
         return out
     out.parent.mkdir(parents=True, exist_ok=True)
     # Compile to a temp name + atomic rename: concurrent builders race safely.
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=out.parent)
     os.close(fd)
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-           str(src), "-o", tmp]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, out)
-        return out
-    except (subprocess.SubprocessError, OSError) as e:
-        logger.warning("native build failed (%s); using numpy fallback", e)
-        Path(tmp).unlink(missing_ok=True)
-        return None
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            str(src), "-o", tmp]
+    # First attempt targets the build host's ISA: the int8 fleet forward
+    # (graftfwd) autovectorizes its dot products only as wide as the
+    # target allows, and the .so cache key carries the host ISA. The
+    # portable build is the retry (compile failure) and the only attempt
+    # on machines where -march=native is not known-good. Guarded getattr
+    # like _host_isa_tag: a platform without os.uname must fall through
+    # to the numpy fallback, not crash construction.
+    uname = getattr(os, "uname", lambda: None)()
+    machine = uname.machine if uname is not None else ""
+    attempts = ([base[:1] + ["-march=native"] + base[1:]]
+                if machine in ("x86_64", "aarch64") else [])
+    attempts.append(base)
+    last_error: Exception | None = None
+    for cmd in attempts:
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, out)
+            return out
+        except (subprocess.SubprocessError, OSError) as e:
+            last_error = e
+    logger.warning("native build failed (%s); using numpy fallback",
+                   last_error)
+    Path(tmp).unlink(missing_ok=True)
+    return None
 
 
 def ensure_built(force: bool = False) -> Path | None:
@@ -262,4 +300,93 @@ class NativeSetTransformer:
         handle = getattr(self, "_handle", None)
         if handle:
             self._lib.set_destroy(handle)
+            self._handle = None
+
+
+class NativeSetTransformerInt8:
+    """graftfwd: the int8-quantized C++ set forward (``set_decide_int8``).
+
+    Same packed-weight layout as :class:`NativeSetTransformer`;
+    quantization happens once at create time inside the core (symmetric
+    per-tensor int8 for every dense kernel), and the recorded per-tensor
+    scales are exposed as :attr:`scales` — checkpoint-load-time
+    quantization with an auditable record, the graftfwd contract.
+    ``decide`` is thread-safe and GIL-free like the fp32 core. Serving
+    activation is gated on measured top-1 agreement vs fp32
+    (``scheduler/fastpath.check_int8_agreement``) — this class only does
+    the math."""
+
+    # Per-tensor scale count: embed + (q, k, v, out, w1, w2) per block.
+    SCALES_PER_BLOCK = 6
+
+    def __init__(self, params: dict, depth: int = 2,
+                 lib_path: Path | None = None):
+        lib_path = lib_path or ensure_built_set()
+        if lib_path is None:
+            raise RuntimeError("native set library unavailable")
+        lib = ctypes.CDLL(str(lib_path))
+        lib.set_create_int8.restype = ctypes.c_void_p
+        lib.set_create_int8.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int32,
+        ]
+        lib.set_decide_int8.restype = ctypes.c_int32
+        lib.set_decide_int8.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.set_int8_scales.restype = ctypes.c_int32
+        lib.set_int8_scales.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int32,
+        ]
+        lib.set_destroy_int8.argtypes = [ctypes.c_void_p]
+        lib.set_abi_version.restype = ctypes.c_int32
+        if lib.set_abi_version() != SET_ABI_VERSION:
+            raise RuntimeError("native set library ABI mismatch; rebuild")
+        self._lib = lib
+        weights, dims = pack_set(params, depth)
+        self._feat = int(dims[0])
+        n_scales = 1 + self.SCALES_PER_BLOCK * int(dims[2])
+        scales = np.zeros(n_scales, np.float32)
+        handle = lib.set_create_int8(
+            weights.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            dims.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(dims),
+            scales.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n_scales,
+        )
+        if not handle:
+            raise RuntimeError("set_create_int8 rejected the packed weights")
+        self._handle = handle
+        self.scales = [float(s) for s in scales]
+
+    def decide(self, obs: np.ndarray) -> tuple[int, np.ndarray]:
+        obs = np.ascontiguousarray(obs, np.float32)
+        if obs.ndim != 2 or obs.shape[1] != self._feat:
+            raise ValueError(
+                f"expected obs shape (N, {self._feat}), got {obs.shape}"
+            )
+        n = obs.shape[0]
+        logits = np.empty(n, np.float32)
+        action = self._lib.set_decide_int8(
+            self._handle,
+            obs.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n,
+            logits.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        if action < 0:
+            raise RuntimeError("set_decide_int8 failed")
+        return int(action), logits
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.set_destroy_int8(handle)
             self._handle = None

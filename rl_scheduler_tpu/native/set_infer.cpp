@@ -24,10 +24,28 @@
 //     bias [dim] (head axis folded, numpy [in, out] row-major), ln1
 //     scale+bias, mlp w1 [dim*2dim]+b1 [2dim], w2 [2dim*dim]+b2 [dim];
 //   then final_norm scale+bias [dim], score kernel [dim] + bias [1].
+//
+// graftfwd (int8 fleet forward): set_create_int8 takes the SAME packed
+// fp32 buffer and quantizes every dense kernel to int8 at create time —
+// symmetric per-tensor scale (max|w| / 127), recorded in creation order
+// (embed, then q/k/v/out/w1/w2 per block) and readable via
+// set_int8_scales. The int8 decide quantizes activations per row
+// (dynamic symmetric), runs every dense as an int8 dot / int32
+// accumulate over kernels stored TRANSPOSED [out][in] (contiguous dots
+// autovectorize to pmaddwd/vpdpbusd-class code), computes attention
+// scores as int8 q·k dots per head, and accumulates the softmax-
+// weighted v in fp32 over fixed j-blocks — the fleet-N crossover table
+// says this path is bandwidth/layout-bound, which is exactly what the
+// narrower weights and the blocked j-walk attack. LayerNorm, softmax,
+// gelu, residuals and the score head stay fp32: the accuracy-critical
+// nonlinearities cost O(n*dim), not O(n^2*dim). Serving activation is
+// gated on measured top-1 agreement vs fp32 (scheduler/fastpath.py).
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <initializer_list>
 #include <vector>
 
 namespace {
@@ -229,6 +247,410 @@ int32_t set_decide(const void* handle, const float* obs, int32_t n,
 
 void set_destroy(void* handle) { delete static_cast<SetNet*>(handle); }
 
-int32_t set_abi_version() { return 1; }
+int32_t set_abi_version() { return 2; }
+
+}  // extern "C"
+
+// ------------------------------------------------------------------ int8
+
+namespace {
+
+// Int8-quantized dense: TWO int8 planes per kernel, TRANSPOSED to
+// [out][in] so each output's dot product is one contiguous scan (the
+// layout the compiler widens to pmaddwd-class int16-multiply / int32-
+// accumulate vectors). The primary plane quantizes the kernel with
+// per-OUTPUT-CHANNEL symmetric scales; the residual plane quantizes
+// what the primary missed at ~1/127 the step — all-int8 weight storage
+// (2 bytes/weight, half of fp32) with effective ~14-bit precision,
+// which is what keeps measured top-1 agreement above the 99.5% gate
+// (single-plane per-channel int8 measured ~3-7% logit error on this
+// net; the dual plane measures ~5e-4 against fp32). Activations
+// quantize per row to int16 (the multiply path is int16 x int16 either
+// way — signed-int8 dots have no wider vector instruction to lose).
+// The RECORDED per-tensor scale (set_int8_scales) is the primary
+// plane's max channel scale: one auditable number per tensor, a
+// conservative bound on every channel's step size.
+struct QDense {
+  // The two int8 planes fold into ONE int16 operand at create time:
+  // w = (kResidStep*q1 + q2) * (s1/kResidStep), exactly. One
+  // pmaddwd-class GEMV instead of two, same quantized values.
+  std::vector<int16_t> kernel_t;  // [out * in], folded planes
+  std::vector<float> bias;        // [out]
+  std::vector<float> scale;       // [out], folded per-channel scales
+  float scale_max = 0.0f;         // recorded per-tensor primary scale
+  int act_max = 0;                // activation quant range (overflow-safe)
+  int in = 0;
+  int out = 0;
+};
+
+// Residual-plane step divisor: the folded weight range is
+// kResidStep*127 + 127, and the overflow budget 2^31 splits between
+// weight range and activation range per dot length. 64 balances the
+// two error terms (weight step s1/64 ~ activation step at the wired
+// lengths — measured logit error ~5e-4, comfortably inside the 99.5%
+// top-1 gate; 127 starved the activations to ~11 bits and tripled the
+// error for no agreement gain).
+constexpr int kResidStep = 64;
+constexpr int kFoldMax = kResidStep * 127 + 127;  // |fold| bound
+
+// Largest symmetric activation range whose int32 dot against operands
+// bounded by ``other_max`` cannot overflow at length ``len`` — the
+// int32-accumulate loop is what gcc turns into vpmaddwd vectors
+// (measured: a float-pair-accumulating dot stays scalar, ~3x slower
+// end to end), so overflow safety comes from the RANGE, not the
+// accumulator width.
+inline int safe_act_max(int other_max, int len) {
+  const long long budget = 2147483647LL / (static_cast<long long>(other_max)
+                                           * std::max(len, 1));
+  return static_cast<int>(std::min<long long>(32767, budget));
+}
+
+struct QBlock {
+  Norm ln0, ln1;
+  QDense q, k, v, out, w1, w2;
+};
+
+struct QSetNet {
+  QDense embed;
+  std::vector<QBlock> blocks;
+  Norm final_norm;
+  std::vector<float> score_kernel;
+  float score_bias = 0.0f;
+  std::vector<float> scales;  // creation-order per-tensor record
+  int feat = 0;
+  int dim = 0;
+  int heads = 1;
+};
+
+// Blocked-attention tile sizes. Queries process in blocks of kQueryBlock
+// rows so every key/value j-tile loaded into cache is reused across the
+// whole query block — the unblocked walk streams the full [n, hd] value
+// array once PER QUERY (512 MB of traffic per fleet-N decide, the
+// measured wall); blocking divides that by kQueryBlock. kAttnBlock is
+// the j-tile: one tile's fp32 values (128 * 64 * 4 = 32 KB at hd=64)
+// stay L1/L2-hot through the query block's weighted accumulation.
+constexpr int kQueryBlock = 32;
+constexpr int kAttnBlock = 128;
+
+// Round-half-away via add-and-truncate: std::lround is a libm call the
+// vectorizer cannot touch, and the row quantizers round ~3M values per
+// fleet-N decide — measured as a top-line cost before this. A half-ulp
+// rounding-mode difference is far below the quantization step.
+inline int fast_round(float x) {
+  return static_cast<int>(x + (x >= 0.0f ? 0.5f : -0.5f));
+}
+
+int8_t clamp_i8(float w, float inv) {
+  const int v = fast_round(w * inv);
+  return static_cast<int8_t>(std::max(-127, std::min(127, v)));
+}
+
+QDense quantize_dense(const Dense& d) {
+  QDense q;
+  q.in = d.in;
+  q.out = d.out;
+  q.bias = d.bias;
+  q.scale.assign(d.out, 1.0f);
+  q.act_max = safe_act_max(kFoldMax, d.in);
+  q.kernel_t.resize(d.kernel.size());
+  for (int j = 0; j < d.out; ++j) {
+    float mx = 0.0f;
+    for (int i = 0; i < d.in; ++i)
+      mx = std::max(mx, std::fabs(
+          d.kernel[static_cast<size_t>(i) * d.out + j]));
+    const float s1 = mx > 0.0f ? mx / 127.0f : 1.0f;
+    q.scale_max = std::max(q.scale_max, s1);
+    const float s2 = s1 / kResidStep;  // the residual plane's step
+    q.scale[j] = s2;
+    const float inv1 = 1.0f / s1;
+    const float inv2 = 1.0f / s2;
+    for (int i = 0; i < d.in; ++i) {
+      const float w = d.kernel[static_cast<size_t>(i) * d.out + j];
+      const int q1 = clamp_i8(w, inv1);
+      const int q2 = clamp_i8(w - static_cast<float>(q1) * s1, inv2);
+      q.kernel_t[static_cast<size_t>(j) * d.in + i] =
+          static_cast<int16_t>(kResidStep * q1 + q2);
+    }
+  }
+  return q;
+}
+
+// exp(x) for the softmax's shifted scores (x <= 0): exponent
+// bit-reconstruction + a degree-5 polynomial for the fraction — ~1e-4
+// relative error, far below the quantization noise it sits on, and a
+// dozen vectorizable ops where libm's expf was the measured hot spot
+// (2M calls per fleet-N decide). memcpy type-punning (not a union) so
+// the loop stays autovectorizable. Int8-path only: the fp32 core keeps
+// bit-for-bit libm softmax.
+inline float exp_approx(float x) {
+  x = std::max(x, -87.0f);
+  const float t = x * 1.4426950408889634f;  // log2(e)
+  const float fi = std::floor(t);
+  const float f = t - fi;
+  // exp(f * ln2) on [0, 1), Taylor in ln2.
+  const float p = 1.0f + f * (0.6931471805599453f + f * (0.2402265069591007f
+      + f * (0.0555041086648216f + f * (0.0096181291076285f
+      + f * 0.0013333558146428f))));
+  int32_t bits;
+  std::memcpy(&bits, &p, sizeof(bits));
+  bits += static_cast<int32_t>(fi) << 23;
+  float out;
+  std::memcpy(&out, &bits, sizeof(out));
+  return out;
+}
+
+// gelu via exp_approx-backed tanh: libm's tanh was the measured linear-
+// term hot spot (256k scalar calls per fleet-N decide, ~a third of the
+// decide). tanh(t) = 1 - 2/(exp(2t) + 1), t clamped where tanh has
+// saturated anyway; error ~1e-4, below the quantization noise.
+inline float gelu_approx(float x) {
+  constexpr float kSqrt2OverPi = 0.7978845608028654f;
+  float t = kSqrt2OverPi * (x + 0.044715f * x * x * x);
+  t = std::max(-9.0f, std::min(9.0f, t));
+  const float th = 1.0f - 2.0f / (exp_approx(2.0f * t) + 1.0f);
+  return 0.5f * x * (1.0f + th);
+}
+
+// Symmetric per-row activation quantization into [-max_q, max_q]
+// (int16 storage); returns the row scale. ``max_q`` comes from
+// safe_act_max so the downstream int32 dot cannot overflow.
+float quantize_row_i16(const float* x, int16_t* qx, int n, int max_q) {
+  float mx = 0.0f;
+  for (int i = 0; i < n; ++i) mx = std::max(mx, std::fabs(x[i]));
+  const float scale = mx > 0.0f ? mx / static_cast<float>(max_q) : 1.0f;
+  const float inv = 1.0f / scale;
+  for (int i = 0; i < n; ++i) {
+    const int v = fast_round(x[i] * inv);
+    qx[i] = static_cast<int16_t>(std::max(-max_q, std::min(max_q, v)));
+  }
+  return scale;
+}
+
+// int16 x int16 dot with an int32 accumulator — the exact shape gcc
+// vectorizes to vpmaddwd/vpaddd (measured: this form runs in zmm
+// vectors; a float-pair-accumulating variant stayed scalar). Operand
+// ranges are pre-bounded by safe_act_max so the accumulator cannot
+// overflow at any wired length.
+inline int32_t dot_i16(const int16_t* a, const int16_t* b, int n) {
+  int32_t acc = 0;
+  for (int c = 0; c < n; ++c)
+    acc += static_cast<int32_t>(a[c]) * static_cast<int32_t>(b[c]);
+  return acc;
+}
+
+// The apply half of the quantized dense, for callers that quantized the
+// activation row once and feed several kernels from it (the q/k/v
+// triple reads ONE LayerNormed row — re-quantizing it per kernel would
+// triple the rounding bill for bit-identical results).
+void qdense_apply(const QDense& d, const int16_t* qx, float sx, float* y) {
+  for (int j = 0; j < d.out; ++j)
+    y[j] = static_cast<float>(
+               dot_i16(qx, d.kernel_t.data() +
+                               static_cast<size_t>(j) * d.in, d.in)) *
+               (sx * d.scale[j]) +
+           d.bias[j];
+}
+
+// y[n] = dequant(qx . folded_kernel) for one activation row (scratch
+// qx provided by the caller so the per-row buffer is reused).
+void qdense_row(const QDense& d, const float* x, float* y, int16_t* qx) {
+  const float sx = quantize_row_i16(x, qx, d.in, d.act_max);
+  qdense_apply(d, qx, sx, y);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Quantize the packed fp32 weights into an int8 net. ``scales_out``
+// (nullable) receives the per-tensor scales in creation order, up to
+// ``scales_cap`` entries; set_int8_scales re-reads them later.
+void* set_create_int8(const float* weights, const int32_t* dims,
+                      int32_t n_dims, float* scales_out,
+                      int32_t scales_cap) {
+  void* fp = set_create(weights, dims, n_dims);
+  if (fp == nullptr) return nullptr;
+  const auto* net = static_cast<const SetNet*>(fp);
+  auto* q = new QSetNet();
+  q->feat = net->feat;
+  q->dim = net->dim;
+  q->heads = net->heads;
+  q->final_norm = net->final_norm;
+  q->score_kernel = net->score_kernel;
+  q->score_bias = net->score_bias;
+  q->embed = quantize_dense(net->embed);
+  q->scales.push_back(q->embed.scale_max);
+  q->blocks.reserve(net->blocks.size());
+  for (const auto& blk : net->blocks) {
+    QBlock qb;
+    qb.ln0 = blk.ln0;
+    qb.ln1 = blk.ln1;
+    qb.q = quantize_dense(blk.q);
+    qb.k = quantize_dense(blk.k);
+    qb.v = quantize_dense(blk.v);
+    qb.out = quantize_dense(blk.out);
+    qb.w1 = quantize_dense(blk.w1);
+    qb.w2 = quantize_dense(blk.w2);
+    for (const QDense* d : {&qb.q, &qb.k, &qb.v, &qb.out, &qb.w1, &qb.w2})
+      q->scales.push_back(d->scale_max);
+    q->blocks.push_back(std::move(qb));
+  }
+  set_destroy(fp);
+  if (scales_out != nullptr) {
+    const int n = std::min<int>(scales_cap,
+                                static_cast<int>(q->scales.size()));
+    for (int i = 0; i < n; ++i) scales_out[i] = q->scales[i];
+  }
+  return q;
+}
+
+int32_t set_int8_scales(const void* handle, float* out, int32_t cap) {
+  const auto* net = static_cast<const QSetNet*>(handle);
+  if (net == nullptr) return -1;
+  if (out != nullptr) {
+    const int n = std::min<int>(cap, static_cast<int>(net->scales.size()));
+    for (int i = 0; i < n; ++i) out[i] = net->scales[i];
+  }
+  return static_cast<int32_t>(net->scales.size());
+}
+
+// Int8 forward over obs [n * feat]; same contract as set_decide.
+// Thread-safe (per-call scratch only), GIL-free via ctypes.
+int32_t set_decide_int8(const void* handle, const float* obs, int32_t n,
+                        float* logits_out) {
+  const auto* net = static_cast<const QSetNet*>(handle);
+  if (net == nullptr || obs == nullptr || n <= 0) return -1;
+  const int dim = net->dim;
+  const int heads = net->heads;
+  const int hd = dim / heads;
+  const float scale = 1.0f / std::sqrt(static_cast<float>(hd));
+  const size_t nd = static_cast<size_t>(n) * dim;
+
+  std::vector<float> h(nd), hn(nd), q(nd), k(nd), v(nd), ctx(nd);
+  std::vector<float> scores(static_cast<size_t>(kQueryBlock) * n);
+  std::vector<float> mlp_mid(2 * dim), tmp(dim);
+  // Dense activation scratch: sized for the WIDEST dense input — the
+  // mlp mid (2*dim) or the raw feature row (a heterogeneous obs can be
+  // wider than 2*dim at small model dims; sizing on dim alone would
+  // overflow the embed quantization).
+  std::vector<int16_t> qx(std::max(2 * dim, net->feat));
+  std::vector<int16_t> qq(static_cast<size_t>(n) * hd);  // per-head q rows
+  std::vector<int16_t> qk(static_cast<size_t>(n) * hd);  // per-head k rows
+  std::vector<float> sq(n), sk(n);                 // per-row quant scales
+
+  for (int i = 0; i < n; ++i)
+    qdense_row(net->embed, obs + static_cast<size_t>(i) * net->feat,
+               h.data() + static_cast<size_t>(i) * dim, qx.data());
+
+  for (const auto& blk : net->blocks) {
+    for (int i = 0; i < n; ++i)
+      layer_norm_row(blk.ln0, h.data() + static_cast<size_t>(i) * dim,
+                     hn.data() + static_cast<size_t>(i) * dim, dim);
+    for (int i = 0; i < n; ++i) {
+      const float* row = hn.data() + static_cast<size_t>(i) * dim;
+      const float sx = quantize_row_i16(row, qx.data(), dim,
+                                        blk.q.act_max);
+      qdense_apply(blk.q, qx.data(), sx,
+                   q.data() + static_cast<size_t>(i) * dim);
+      qdense_apply(blk.k, qx.data(), sx,
+                   k.data() + static_cast<size_t>(i) * dim);
+      qdense_apply(blk.v, qx.data(), sx,
+                   v.data() + static_cast<size_t>(i) * dim);
+    }
+    for (int head = 0; head < heads; ++head) {
+      const int off = head * hd;
+      // Re-quantize this head's q/k rows once (the score dots read
+      // them n times each — the O(n^2) side of the bandwidth bill).
+      // Both sides get the widest overflow-safe symmetric range for an
+      // hd-length int32 dot (12-bit-class at hd=64 — score noise well
+      // under the dense planes').
+      const int attn_max = static_cast<int>(
+          std::sqrt(static_cast<double>(2147483647LL / std::max(hd, 1))));
+      for (int i = 0; i < n; ++i) {
+        sq[i] = quantize_row_i16(
+            q.data() + static_cast<size_t>(i) * dim + off,
+            qq.data() + static_cast<size_t>(i) * hd, hd, attn_max);
+        sk[i] = quantize_row_i16(
+            k.data() + static_cast<size_t>(i) * dim + off,
+            qk.data() + static_cast<size_t>(i) * hd, hd, attn_max);
+      }
+      for (int i0 = 0; i0 < n; i0 += kQueryBlock) {
+        const int i1 = std::min(n, i0 + kQueryBlock);
+        const int qb = i1 - i0;
+        // Pass 1: the query block's score rows (int16 q x int8 k dots;
+        // the int8 key stream is n*hd bytes and L2-resident, read once
+        // per query row), softmaxed in place via the approx exp.
+        for (int i = i0; i < i1; ++i) {
+          float* sc = scores.data() + static_cast<size_t>(i - i0) * n;
+          const int16_t* qi = qq.data() + static_cast<size_t>(i) * hd;
+          const float si = sq[i] * scale;
+          float mx = -1e30f;
+          for (int j = 0; j < n; ++j) {
+            sc[j] = static_cast<float>(dot_i16(
+                        qi, qk.data() + static_cast<size_t>(j) * hd,
+                        hd)) * si * sk[j];
+            if (sc[j] > mx) mx = sc[j];
+          }
+          float denom = 0.0f;
+          for (int j = 0; j < n; ++j) {
+            sc[j] = exp_approx(sc[j] - mx);
+            denom += sc[j];
+          }
+          const float inv = 1.0f / denom;
+          for (int j = 0; j < n; ++j) sc[j] *= inv;
+          float* ci = ctx.data() + static_cast<size_t>(i) * dim + off;
+          for (int c = 0; c < hd; ++c) ci[c] = 0.0f;
+        }
+        // Pass 2: weighted-v as a blocked mini-GEMM — each fp32 value
+        // j-tile loads once per QUERY BLOCK and feeds every row's
+        // hd-wide accumulation while cache-hot.
+        for (int j0 = 0; j0 < n; j0 += kAttnBlock) {
+          const int j1 = std::min(n, j0 + kAttnBlock);
+          for (int i = i0; i < i1; ++i) {
+            const float* sc = scores.data()
+                + static_cast<size_t>(i - i0) * n;
+            float* ci = ctx.data() + static_cast<size_t>(i) * dim + off;
+            for (int j = j0; j < j1; ++j) {
+              const float wj = sc[j];
+              const float* vj = v.data()
+                  + static_cast<size_t>(j) * dim + off;
+              for (int c = 0; c < hd; ++c) ci[c] += wj * vj[c];
+            }
+          }
+        }
+      }
+    }
+    for (int i = 0; i < n; ++i) {
+      qdense_row(blk.out, ctx.data() + static_cast<size_t>(i) * dim,
+                 tmp.data(), qx.data());
+      float* hi = h.data() + static_cast<size_t>(i) * dim;
+      for (int c = 0; c < dim; ++c) hi[c] += tmp[c];
+    }
+    for (int i = 0; i < n; ++i) {
+      float* hi = h.data() + static_cast<size_t>(i) * dim;
+      layer_norm_row(blk.ln1, hi, hn.data(), dim);
+      qdense_row(blk.w1, hn.data(), mlp_mid.data(), qx.data());
+      for (int c = 0; c < 2 * dim; ++c)
+        mlp_mid[c] = gelu_approx(mlp_mid[c]);
+      qdense_row(blk.w2, mlp_mid.data(), tmp.data(), qx.data());
+      for (int c = 0; c < dim; ++c) hi[c] += tmp[c];
+    }
+  }
+
+  int best = 0;
+  for (int i = 0; i < n; ++i) {
+    layer_norm_row(net->final_norm, h.data() + static_cast<size_t>(i) * dim,
+                   tmp.data(), dim);
+    float s = net->score_bias;
+    for (int c = 0; c < dim; ++c) s += tmp[c] * net->score_kernel[c];
+    logits_out[i] = s;
+    if (s > logits_out[best]) best = i;
+  }
+  return best;
+}
+
+void set_destroy_int8(void* handle) {
+  delete static_cast<QSetNet*>(handle);
+}
 
 }  // extern "C"

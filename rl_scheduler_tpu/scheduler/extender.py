@@ -74,16 +74,24 @@ logger = logging.getLogger(__name__)
 CLOUDS = ("aws", "azure")
 MAX_EXTENDER_SCORE = 100
 # graftlens decision-path phases, in hot-path order (docs/observability.md):
-#   parse    — request-parse: node/pod extraction + the candidate cap draw
-#   observe  — telemetry-observe/obs-build: table replay + cpu sample into
-#              the finished observation array (graph: topology + raw-price
-#              row + graph obs build)
-#   forward  — backend-forward: the policy forward through the breaker
-#   marshal  — priority-marshal: softmax/score mapping + response body
-#   trace    — trace-append: obs digest + replay position + record build
+#   parse      — request-parse: node/pod extraction + the candidate cap draw
+#   observe    — telemetry-observe/obs-build: table replay + cpu sample into
+#                the finished observation array (graph: topology + raw-price
+#                row + graph obs build); on a graftfwd score-cache HIT this
+#                phase carries the (much cheaper) cache lookup instead
+#   batch_wait — graftfwd micro-batching: time a request spent in the
+#                admission window before its batch's shared forward ran
+#                (0 with batching off, and 0 for cache hits — recorded
+#                unconditionally so every phase keeps exactly one sample
+#                per served decision, the count-uniformity invariant)
+#   forward    — backend-forward: the policy forward through the breaker
+#                (for a coalesced request: the batch's SHARED forward time;
+#                0 on a cache hit)
+#   marshal    — priority-marshal: softmax/score mapping + response body
+#   trace      — trace-append: obs digest + replay position + record build
 # Each phase feeds its own LatencyStats; sums reconcile against the
 # end-to-end decide histogram (pinned by test, read by tools/decisionview).
-PHASES = ("parse", "observe", "forward", "marshal", "trace")
+PHASES = ("parse", "observe", "batch_wait", "forward", "marshal", "trace")
 # Serving-time default for the arriving pod's cpu request as a fraction of
 # node capacity: the midpoint of the training distribution
 # (env/cluster_set.py pod_cpu ~ U[0.1, 0.4]) when the request carries no
@@ -373,6 +381,66 @@ def slo_metric_lines(prefix: str, snapshot: dict) -> list:
     return lines
 
 
+def fastpath_metric_lines(prefix: str, fastpath: dict) -> list:
+    """Prometheus exposition for the graftfwd fast-path counters —
+    shared by the single-process plane and the pool's summed section
+    (``pool.sum_fastpath``), so both export one metric shape. Empty
+    input -> no lines (levers off = byte-identical scrape)."""
+    lines: list = []
+    cache = fastpath.get("cache")
+    if cache:
+        lines += [
+            f"# HELP {prefix}_score_cache_hits_total Telemetry-epoch "
+            "score-cache hits (observe+forward skipped), lifetime.",
+            f"# TYPE {prefix}_score_cache_hits_total counter",
+            f"{prefix}_score_cache_hits_total {cache['hits_total']}",
+            f"# HELP {prefix}_score_cache_misses_total Score-cache "
+            "misses (full decide path ran), lifetime.",
+            f"# TYPE {prefix}_score_cache_misses_total counter",
+            f"{prefix}_score_cache_misses_total {cache['misses_total']}",
+            f"# HELP {prefix}_score_cache_invalidations_total Epoch "
+            "rollovers and explicit flushes (promote!) that dropped the "
+            "cache, lifetime.",
+            f"# TYPE {prefix}_score_cache_invalidations_total counter",
+            f"{prefix}_score_cache_invalidations_total "
+            f"{cache['invalidations_total']}",
+            f"# HELP {prefix}_score_cache_entries Live cache entries.",
+            f"# TYPE {prefix}_score_cache_entries gauge",
+            f"{prefix}_score_cache_entries {cache['entries']}",
+        ]
+    batch = fastpath.get("batch")
+    if batch:
+        lines += [
+            f"# HELP {prefix}_batch_requests_total Requests that went "
+            "through the micro-batch admission window, lifetime.",
+            f"# TYPE {prefix}_batch_requests_total counter",
+            f"{prefix}_batch_requests_total {batch['requests_total']}",
+            f"# HELP {prefix}_batch_forwards_total Coalesced [k, N, F] "
+            "forwards executed, lifetime.",
+            f"# TYPE {prefix}_batch_forwards_total counter",
+            f"{prefix}_batch_forwards_total {batch['batches_total']}",
+            f"# HELP {prefix}_batch_coalesced_total Requests served by "
+            "a k>=2 shared forward, lifetime.",
+            f"# TYPE {prefix}_batch_coalesced_total counter",
+            f"{prefix}_batch_coalesced_total {batch['coalesced_total']}",
+            f"# HELP {prefix}_batch_occupancy_mean Mean requests per "
+            "executed batch window.",
+            f"# TYPE {prefix}_batch_occupancy_mean gauge",
+            f"{prefix}_batch_occupancy_mean "
+            f"{batch['mean_occupancy'] if batch['mean_occupancy'] is not None else 0}",
+        ]
+    int8 = fastpath.get("int8")
+    if int8:
+        lines += [
+            f"# HELP {prefix}_int8_agreement Measured top-1 agreement of "
+            "the int8 native forward vs fp32 on the seeded corpus "
+            "(startup/promote gate; serving refuses below 0.995).",
+            f"# TYPE {prefix}_int8_agreement gauge",
+            f"{prefix}_int8_agreement {int8['agreement']:.9g}",
+        ]
+    return lines
+
+
 class AsyncPlacer:
     """Bounded async wrapper around a pod placer.
 
@@ -474,6 +542,13 @@ class ExtenderPolicy:
         # None (the default) keeps the hot path untouched; build_policy
         # attaches a TraceLog when --trace-dir is configured.
         self.trace = None
+        # graftfwd (scheduler/fastpath.py): the serving fast path's two
+        # policy-level levers, both None by default (hot path untouched);
+        # build_policy attaches them from --score-cache-epoch-s /
+        # --batch-window-ms. The third lever (the int8 native forward)
+        # lives in the backend (--backend native-int8).
+        self.score_cache = None
+        self.batcher = None
         # Candidate-list cap for the structured families — the same idea
         # as kube-scheduler's percentageOfNodesToScore: scoring cost per
         # request is O(cap) no matter how large the fleet's node list
@@ -666,6 +741,7 @@ class ExtenderPolicy:
         t_fwd = time.perf_counter()
         self._record_latency(t_fwd - t0)
         self._span_add("observe", t_obs - t0)
+        self._span_add("batch_wait", 0.0)  # count-uniformity (graftfwd)
         self._span_add("forward", t_fwd - t_obs)
         z = logits - logits.max()
         probs = np.exp(z) / np.exp(z).sum()
@@ -674,13 +750,72 @@ class ExtenderPolicy:
         self._span_add("marshal", time.perf_counter() - t_fwd)
         return action, probs, obs
 
+    def _fastpath_forward(self, obs):
+        """The set family's forward seam: through the micro-batcher when
+        one is armed, else the direct backend call. Returns ``(action,
+        logits, forward_s)`` — ``forward_s`` is the batch's SHARED
+        forward duration (None unbatched), so the caller can split its
+        blocked time into ``batch_wait`` + ``forward``. Runs INSIDE the
+        circuit breaker: a poisoned batch fans its exception out to
+        every member, and each member's breaker/fail-open accounting
+        sees its own failure."""
+        if self.batcher is not None:
+            return self.batcher.submit(obs, self.generation)
+        action, logits = self.backend.decide_nodes(obs)
+        return action, logits, None
+
+    def _cached_decide_set(self, entry, clouds: list,
+                           t0: float) -> tuple[int, np.ndarray, np.ndarray]:
+        """Serve one decide from a score-cache hit: the stored decision
+        bitwise-unchanged, the stored observation/replay position as
+        provenance, observe/forward skipped (the lookup IS the observe
+        phase's cost; batch_wait/forward charge their true zero so
+        every phase still carries one sample per decision)."""
+        action, logits, obs, replay_pos = entry
+        t_hit = time.perf_counter()
+        self._record_latency(t_hit - t0)
+        self._span_add("observe", t_hit - t0)
+        self._span_add("batch_wait", 0.0)
+        self._span_add("forward", 0.0)
+        if replay_pos is not None:
+            try:
+                # Trace provenance: the record must name the telemetry
+                # row the cached score actually observed, not whatever
+                # this thread last replayed.
+                self.telemetry.note_replay_position(replay_pos)
+            except AttributeError:  # bare-telemetry policy stand-ins
+                pass
+        z = logits - logits.max()
+        probs = np.exp(z) / np.exp(z).sum()
+        with self._lock:
+            self._decisions[clouds[action] or "unknown"] += 1
+        self._span_add("marshal", time.perf_counter() - t_hit)
+        return action, probs, obs
+
     def decide_set(self, clouds: list, pod_cpu: float,
                    pod_reqs: list | None = None) -> tuple[int, np.ndarray, np.ndarray]:
         """One set-family pointer decision over the request's nodes; timed
         like :meth:`decide`. ``clouds`` has one aws/azure/None per node;
         ``pod_reqs`` is the parsed ``[R]`` request vector when this
-        policy serves a heterogeneous-scenario checkpoint."""
+        policy serves a heterogeneous-scenario checkpoint.
+
+        graftfwd: with a score cache armed, an identical (generation,
+        node-set, pod-request) key inside the current telemetry epoch
+        answers from cache — skipping observe AND forward; with a
+        micro-batcher armed, the forward may be one row of a coalesced
+        ``[k, N, F]`` batch (``batch_wait`` carries the window time).
+        Synthetic probes bypass the cache both ways: a rollout gate
+        probe must exercise the real decide path, and must not seed the
+        cache with probe-shaped entries."""
         t0 = time.perf_counter()
+        cache = self.score_cache if not self._synthetic else None
+        cache_key = None
+        if cache is not None:
+            cache_key = cache.make_key(self.generation, clouds, pod_cpu,
+                                       pod_reqs)
+            entry = cache.get(cache_key)
+            if entry is not None:
+                return self._cached_decide_set(entry, clouds, t0)
         if self.num_resources:
             reqs = (pod_reqs if pod_reqs is not None
                     else [pod_cpu, DEFAULT_POD_MEM, DEFAULT_POD_ACC])
@@ -689,11 +824,26 @@ class ExtenderPolicy:
         else:
             obs = self.telemetry.observe_nodes(clouds, pod_cpu)
         t_obs = time.perf_counter()
-        action, logits = self._backend_call(self.backend.decide_nodes, obs)
+        action, logits, forward_s = self._backend_call(
+            self._fastpath_forward, obs)
         t_fwd = time.perf_counter()
         self._record_latency(t_fwd - t0)
         self._span_add("observe", t_obs - t0)
-        self._span_add("forward", t_fwd - t_obs)
+        if forward_s is None:
+            self._span_add("batch_wait", 0.0)
+            self._span_add("forward", t_fwd - t_obs)
+        else:
+            # Coalesced: the shared batch forward is this request's
+            # forward cost; the rest of its blocked time was the window.
+            shared = min(forward_s, t_fwd - t_obs)
+            self._span_add("batch_wait", (t_fwd - t_obs) - shared)
+            self._span_add("forward", shared)
+        if cache_key is not None:
+            try:
+                replay_pos = self.telemetry.last_replay_position()
+            except AttributeError:
+                replay_pos = None
+            cache.put(cache_key, action, logits, obs, replay_pos)
         z = logits - logits.max()
         probs = np.exp(z) / np.exp(z).sum()
         with self._lock:
@@ -730,6 +880,7 @@ class ExtenderPolicy:
         t_fwd = time.perf_counter()
         self._record_latency(t_fwd - t0)
         self._span_add("observe", t_obs - t0)
+        self._span_add("batch_wait", 0.0)  # count-uniformity (graftfwd)
         self._span_add("forward", t_fwd - t_obs)
         z = logits - logits.max()
         probs = np.exp(z) / np.exp(z).sum()
@@ -945,6 +1096,40 @@ class ExtenderPolicy:
         finally:
             self._req_local.synthetic = False
 
+    def fastpath_verify(self) -> dict:
+        """graftfwd flush-on-promote: drop every score-cache entry and,
+        when the int8 native forward is armed, RE-RUN the seeded-corpus
+        agreement check against the fp32 reference. The rollout gate
+        calls this per respawned worker (pool ``fastpath`` command)
+        before the canary serves: a stale-generation cache hit after a
+        rollout is a correctness bug, and a candidate checkpoint that
+        quantizes badly must fail the gate, not silently serve. ``ok``
+        False is a gate failure (chaos-tested via ``fastpath.agree``)."""
+        out: dict = {"ok": True}
+        if self.score_cache is not None:
+            out["cache_flushed"] = self.score_cache.flush(
+                "promote gate: generation boundary")
+        backend = self.backend
+        if getattr(backend, "name", "") == "native-int8" \
+                and getattr(backend, "reference", None) is not None:
+            from rl_scheduler_tpu.scheduler.fastpath import (
+                check_int8_agreement,
+            )
+
+            try:
+                agreement, ok = check_int8_agreement(
+                    backend, backend.reference, backend.node_feat,
+                    node_counts=getattr(backend, "agreement_node_counts",
+                                        (8, 64)))
+            except Exception as e:  # noqa: BLE001 — a check that cannot
+                # run must refuse the promote, never pass by default
+                logger.exception("int8 agreement re-check failed to run")
+                return {"ok": False, "error": str(e)}
+            backend.agreement = agreement
+            out["agreement"] = round(agreement, 4)
+            out["ok"] = bool(ok)
+        return out
+
     def filter(self, args: dict) -> dict:
         """ExtenderFilterResult: keep nodes on the chosen cloud; fail open."""
         if self.family in self.STRUCTURED:
@@ -1127,6 +1312,9 @@ class ExtenderPolicy:
             out["latency"]["lifetime_mean_ms"] = (
                 round(total_sum / count * 1e3, 4) if count else None)
             out["latency"]["lifetime_count"] = count
+        fastpath = self.fastpath_snapshot()
+        if fastpath:
+            out["fastpath"] = fastpath
         if self.slo is not None:
             out["slo"] = self.slo.snapshot()
         if self.trace is not None:
@@ -1152,6 +1340,26 @@ class ExtenderPolicy:
         # graftguard breaker states: "is a dependency down" is a /stats
         # read, not a log dive (docs/robustness.md).
         out["breakers"] = self.breakers()
+        return out
+
+    def fastpath_snapshot(self) -> dict:
+        """The ``/stats`` body's graftfwd section: per-lever counters
+        (score cache, micro-batcher, int8 agreement) — empty dict when
+        no lever is armed, so pre-graftfwd readers see an unchanged
+        body. Counters are lifetime-monotonic; the pool sums them
+        (pool.sum_fastpath)."""
+        out: dict = {}
+        if self.score_cache is not None:
+            out["cache"] = self.score_cache.snapshot()
+        if self.batcher is not None:
+            out["batch"] = self.batcher.snapshot()
+        agreement = getattr(self.backend, "agreement", None)
+        if agreement is not None:
+            out["int8"] = {
+                "agreement": round(float(agreement), 4),
+                "scales_recorded": len(getattr(
+                    self.backend, "quantization_scales", []) or []),
+            }
         return out
 
     @staticmethod
@@ -1202,6 +1410,7 @@ class ExtenderPolicy:
                     for phase, stats in self.phase_stats.items()})
         if self.slo is not None:
             lines += slo_metric_lines(p, self.slo.snapshot())
+        lines += fastpath_metric_lines(p, self.fastpath_snapshot())
         shed = getattr(self.backend, "shed_fraction", None)
         if shed is not None:
             lines += [
@@ -1409,6 +1618,10 @@ def build_policy(
     spans: bool = True,
     slo_p99_ms: float | None = None,
     slo_avail: float | None = None,
+    batch_window_ms: float = 0.0,
+    batch_max: int = 8,
+    score_cache_epoch_s: float = 0.0,
+    score_cache_entries: int = 256,
 ) -> ExtenderPolicy:
     """Assemble the serving stack: checkpoint -> backend -> telemetry.
 
@@ -1624,6 +1837,35 @@ def build_policy(
             f"{policy.family!r} (drop the flag or serve a cluster_graph "
             "checkpoint)"
         )
+    # graftfwd levers (scheduler/fastpath.py) — same refuse-before-
+    # traffic rule as max_score_nodes: both levers exist for the set
+    # family's per-node forward, and a greedy fallback (corrupt
+    # checkpoint) must not silently serve with a demanded lever off.
+    if batch_window_ms:
+        if policy.family != "set":
+            raise ValueError(
+                f"batch_window_ms={batch_window_ms}: cross-request "
+                f"micro-batching coalesces the set family's per-node "
+                f"forwards; the loaded checkpoint serves family "
+                f"{policy.family!r} (drop the flag or serve a "
+                "cluster_set checkpoint)")
+        from rl_scheduler_tpu.scheduler.fastpath import MicroBatcher
+
+        policy.batcher = MicroBatcher(policy.backend,
+                                      window_s=batch_window_ms / 1e3,
+                                      max_batch=batch_max)
+    if score_cache_epoch_s:
+        if policy.family != "set":
+            raise ValueError(
+                f"score_cache_epoch_s={score_cache_epoch_s}: the "
+                f"telemetry-epoch score cache keys the set family's "
+                f"node-set observations; the loaded checkpoint serves "
+                f"family {policy.family!r} (drop the flag or serve a "
+                "cluster_set checkpoint)")
+        from rl_scheduler_tpu.scheduler.fastpath import ScoreCache
+
+        policy.score_cache = ScoreCache(epoch_s=score_cache_epoch_s,
+                                        max_entries=score_cache_entries)
     return policy
 
 
@@ -1650,7 +1892,8 @@ def check_warm_nodes_served(policy: ExtenderPolicy,
 def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--backend", default="jax",
-                   choices=("jax", "cpu", "native", "torch", "greedy"))
+                   choices=("jax", "cpu", "native", "native-int8", "torch",
+                            "greedy"))
     p.add_argument("--run", default=None, help="checkpoint run dir")
     p.add_argument("--run-root", default=None)
     p.add_argument("--host", default="0.0.0.0")
@@ -1750,7 +1993,47 @@ def main(argv: list[str] | None = None) -> None:
                    help="wallclock replay only: real-world seconds one "
                         "pricing-table row represents (default 300 — the "
                         "5-minute cloud-pricing update cadence)")
+    p.add_argument("--batch-window-ms", type=float, default=0.0,
+                   metavar="MS",
+                   help="graftfwd lever (i): coalesce concurrent "
+                        "cluster_set decide requests for MS milliseconds "
+                        "into ONE [k, N, F] forward (same generation + "
+                        "obs spec; bitwise per-row agreement on the AOT "
+                        "path; the batch_wait phase carries the window "
+                        "time). 0 disables (docs/serving.md)")
+    p.add_argument("--batch-max", type=int, default=8, metavar="K",
+                   help="micro-batching: close an admission window early "
+                        "once K requests joined (default 8 — the 8-way "
+                        "regime the levers were measured at)")
+    p.add_argument("--score-cache-epoch-s", type=float, default=0.0,
+                   metavar="S",
+                   help="graftfwd lever (iii): cache cluster_set scores "
+                        "keyed on (telemetry epoch, node-set, pod "
+                        "request, generation) for S-second epochs "
+                        "(wallclock-derived like --price-replay "
+                        "wallclock; 15 matches the Prometheus scrape "
+                        "cadence). A hit skips observe AND forward; "
+                        "promote flushes; 0 disables")
+    p.add_argument("--score-cache-entries", type=int, default=256,
+                   metavar="N",
+                   help="score cache LRU bound (default 256)")
     args = p.parse_args(argv)
+    if args.batch_window_ms < 0:
+        raise SystemExit(
+            f"--batch-window-ms {args.batch_window_ms}: pass a positive "
+            "window (0 disables micro-batching)")
+    if args.batch_window_ms and args.batch_max < 2:
+        raise SystemExit(
+            f"--batch-max {args.batch_max}: a 1-request batch is the "
+            "unbatched path; pass at least 2")
+    if args.score_cache_epoch_s < 0:
+        raise SystemExit(
+            f"--score-cache-epoch-s {args.score_cache_epoch_s}: pass a "
+            "positive epoch (0 disables the score cache)")
+    if args.score_cache_epoch_s and args.score_cache_entries < 1:
+        raise SystemExit(
+            f"--score-cache-entries {args.score_cache_entries}: pass at "
+            "least 1")
     if args.max_score_nodes < 0 or args.max_score_nodes == 1:
         raise SystemExit(
             f"--max-score-nodes {args.max_score_nodes}: pass a cap >= 2 "
@@ -1829,6 +2112,10 @@ def main(argv: list[str] | None = None) -> None:
         spans=not args.no_spans,
         slo_p99_ms=args.slo_p99_ms,
         slo_avail=args.slo_avail,
+        batch_window_ms=args.batch_window_ms,
+        batch_max=args.batch_max,
+        score_cache_epoch_s=args.score_cache_epoch_s,
+        score_cache_entries=args.score_cache_entries,
     )
     if args.workers is not None:
         # graftserve: the supervisor never builds a policy (workers each

@@ -1,0 +1,379 @@
+"""graftfwd: the serving fast path — the three ROADMAP-item-2 levers.
+
+PR 12 (graftlens) measured the N=1024 decision budget precisely:
+``forward`` is 97.3% of the 15.2 ms mean on the best host path
+(docs/serving.md phase table), and the instrument — per-phase spans, SLO
+burn gauges, ``make serve-report`` — was built so the levers could be
+attacked one at a time. This module is the three levers, each
+independently toggleable and each shipping with an exact-agreement test
+against the unmodified path:
+
+- :class:`MicroBatcher` **(i) cross-request micro-batching**: a few-ms
+  admission window (``--batch-window-ms``, 0 = off) on the extender that
+  coalesces concurrent decide requests for the same (generation,
+  obs-spec) into ONE ``[k, N, F]`` forward. The set policy is vmappable
+  over requests, so the batched AOT executable is ``jax.vmap`` of the
+  very apply the single path runs — bitwise-identical logits per row
+  (pinned by test) — and the host fallbacks run one stacked BLAS/ATen
+  forward instead of k GIL-contending ones. 8-way fleet-N traffic is
+  exactly where graftserve's queueing collapsed; batch occupancy and the
+  window wait ride the graftlens span machinery as the ``batch_wait``
+  phase so decisionview's coverage-reconciliation row still closes.
+- :class:`ScoreCache` **(iii) telemetry-epoch score cache**: scores
+  keyed on (telemetry epoch, node-set hash, pod request vector, policy
+  generation). Telemetry advances on a ~15 s scrape cadence, so between
+  scrapes identical candidate lists answer from cache — a hit skips
+  ``observe`` AND ``forward`` and returns the stored decision
+  bitwise-unchanged, with the ORIGINAL observation and replay position
+  as trace provenance. Invalidation semantics are pinned like
+  ``--price-replay``'s wallclock mode (the epoch is
+  ``int(now / epoch_s)`` — all entries die at the epoch boundary), plus
+  a mandatory :meth:`ScoreCache.flush` on promote: a stale-generation
+  hit after a graftroll rollout is a correctness bug (the generation is
+  in the key AND the rollout gate flushes, chaos-tested via the
+  ``fastpath.agree`` site). Hit/miss/invalidation counters ride
+  ``/stats`` and ``/metrics``.
+- :func:`check_int8_agreement` **(ii) the int8 native gate**: the
+  C++ set core (``native/set_infer.cpp``) grew an int8-quantized,
+  blocked-attention fleet forward (``--backend native-int8``,
+  ``set_backend.Int8NativeSetBackend``). Quantization happens at
+  checkpoint-load time with a recorded scale per tensor; activation is
+  gated on a measured top-1-agreement threshold (>= 99.5% vs the fp32
+  forward on a seeded candidate corpus) checked at startup — the build
+  REFUSES to serve quantized otherwise. The same check re-runs per
+  worker on promote (``ExtenderPolicy.fastpath_verify`` via the pool's
+  ``fastpath`` control command), so a candidate checkpoint that
+  quantizes badly fails the canary gate instead of silently serving.
+
+Everything here is pure stdlib + numpy on the hot path; the jax/torch
+specializations live in the backends (``set_backend.py``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# The startup/promote gate: measured top-1 agreement between the int8
+# and fp32 forwards on the seeded corpus must meet this bar or the
+# quantized path refuses to serve (docs/serving.md).
+INT8_AGREEMENT_MIN = 0.995
+# Seeded-corpus size for the agreement check: the resolution must be
+# finer than the 0.5% error budget (1/256 = 0.39% — at 64 samples a
+# SINGLE flip read as 1.6% and failed an actually-99.6%-agreeing
+# forward), while a fleet-N startup check stays sub-second.
+AGREEMENT_SAMPLES = 256
+
+
+class ScoreCache:
+    """Telemetry-epoch score cache for the set family's decide path.
+
+    One entry per (generation, node-set, pod-request) key within the
+    current epoch: ``(action, logits, obs, replay_pos)`` — the stored
+    decision is returned bitwise-unchanged, and the stored observation/
+    replay position keep trace provenance exact (the record names the
+    inputs the score was actually computed from, not the row a recompute
+    would have consumed). Epoch semantics mirror ``--price-replay
+    wallclock``: ``epoch = int(now / epoch_s)``; crossing the boundary
+    invalidates every entry at once (lazily, on the next access).
+    Thread-safe; bounded LRU (``max_entries``) so candidate-list
+    diversity cannot grow memory without bound.
+    """
+
+    def __init__(self, epoch_s: float = 15.0, max_entries: int = 256,
+                 clock=time.time):
+        # clock defaults to WALLCLOCK (not monotonic) deliberately: the
+        # epoch construction mirrors --price-replay wallclock, so every
+        # worker of a pool — and a restarted worker — rolls its epoch at
+        # the SAME instant, aligned with the real scrape cadence the
+        # epoch length is tuned to. Injectable for tests.
+        if epoch_s <= 0:
+            raise ValueError(f"epoch_s={epoch_s}: pass a positive number "
+                             "of seconds (the telemetry scrape cadence)")
+        if max_entries < 1:
+            raise ValueError(f"max_entries={max_entries}: pass at least 1")
+        import collections
+
+        self.epoch_s = float(epoch_s)
+        self.max_entries = int(max_entries)
+        self._clock = clock
+        self._entries: "collections.OrderedDict" = collections.OrderedDict()
+        self._epoch = None
+        self._lock = threading.Lock()
+        # Lifetime counters (monotonic — /stats/reset never clears them,
+        # the same contract as every serving counter).
+        self.hits_total = 0
+        self.misses_total = 0
+        # Epoch rollovers + explicit flushes, each counted once however
+        # many entries died.
+        self.invalidations_total = 0
+
+    def epoch(self) -> int:
+        """The current telemetry epoch (wallclock-derived, like
+        ``--price-replay wallclock`` derives its row)."""
+        return int(self._clock() / self.epoch_s)
+
+    @staticmethod
+    def make_key(generation: int, clouds, pod_cpu: float,
+                 pod_reqs) -> tuple:
+        """The cache key for one decide: policy generation, the node
+        set's cloud layout (the only node input the observation reads),
+        and the pod's parsed request vector. Display names are NOT part
+        of the key — two requests with the same cloud layout score
+        identically by construction (``telemetry.observe_nodes``)."""
+        return (generation, tuple(clouds), float(pod_cpu),
+                None if pod_reqs is None else tuple(pod_reqs))
+
+    def _roll_epoch_locked(self) -> None:
+        now_epoch = self.epoch()
+        if self._epoch != now_epoch:
+            if self._entries:
+                self.invalidations_total += 1
+                self._entries.clear()
+            self._epoch = now_epoch
+
+    def get(self, key: tuple):
+        """``(action, logits, obs, replay_pos)`` or ``None``. A hit is
+        the stored tuple itself — bitwise the decision that was computed
+        (pinned by test)."""
+        with self._lock:
+            self._roll_epoch_locked()
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses_total += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits_total += 1
+            return entry
+
+    def put(self, key: tuple, action: int, logits, obs,
+            replay_pos) -> None:
+        with self._lock:
+            self._roll_epoch_locked()
+            self._entries[key] = (int(action), logits, obs, replay_pos)
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+
+    def flush(self, reason: str = "") -> int:
+        """Drop every entry NOW (mandatory on promote: a
+        stale-generation hit after a graftroll rollout is a correctness
+        bug even though the generation is in the key — flushing frees
+        the dead generation's memory and makes the invalidation
+        observable). Returns the number of entries dropped."""
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            if n:
+                self.invalidations_total += 1
+        if reason:
+            logger.info("score cache flushed (%d entries): %s", n, reason)
+        return n
+
+    def snapshot(self) -> dict:
+        """The ``/stats`` body's cache section (counters lifetime-
+        monotonic; ``entries`` is the instantaneous size)."""
+        with self._lock:
+            requests = self.hits_total + self.misses_total
+            return {
+                "epoch_s": self.epoch_s,
+                "epoch": self._epoch,
+                "entries": len(self._entries),
+                "max_entries": self.max_entries,
+                "hits_total": self.hits_total,
+                "misses_total": self.misses_total,
+                "invalidations_total": self.invalidations_total,
+                "hit_rate": (round(self.hits_total / requests, 4)
+                             if requests else None),
+            }
+
+
+class _Batch:
+    """One in-flight admission window: the leader's collection point."""
+
+    def __init__(self):
+        self.rows: list = []        # observation arrays, arrival order
+        self.results = None         # (actions [k], logits [k, N]) when done
+        self.error = None           # the leader's exception, fanned out
+        self.forward_s = 0.0        # the shared batched-forward duration
+        self.done = threading.Event()
+
+
+class MicroBatcher:
+    """Cross-request micro-batching for the set family's forward.
+
+    :meth:`submit` blocks the calling request thread until its row's
+    result is ready. The FIRST request for a given (shape, generation)
+    becomes the window's leader: it waits up to ``window_s`` (or until
+    ``max_batch`` rows arrive), stacks the window's observations into
+    one ``[k, N, F]`` array, runs ``backend.decide_nodes_batch`` once,
+    and fans the per-row results out. Followers just wait. A leader
+    exception fans out to every member — each request's own fail-open
+    handler (and the circuit breaker wrapping each ``submit``) sees it,
+    so a poisoned batch counts k failures, not one.
+
+    Window membership is keyed on (obs shape, generation): requests for
+    different candidate-list sizes, observation widths, or policy
+    generations never share a forward (the AOT executable and the
+    checkpoint must match every row).
+    """
+
+    def __init__(self, backend, window_s: float, max_batch: int = 8):
+        if window_s <= 0:
+            raise ValueError(f"window_s={window_s}: the batcher exists "
+                             "only for a positive admission window "
+                             "(0 = off is the caller's branch)")
+        if max_batch < 2:
+            raise ValueError(f"max_batch={max_batch}: a 1-row batch is "
+                             "the unbatched path; pass >= 2")
+        if not hasattr(backend, "decide_nodes_batch"):
+            raise ValueError(
+                f"backend {getattr(backend, 'name', backend)!r} has no "
+                "decide_nodes_batch — micro-batching needs a batched "
+                "set forward (set_backend.py)")
+        self._backend = backend
+        self.window_s = float(window_s)
+        self.max_batch = int(max_batch)
+        self._pending: dict = {}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # Lifetime counters for /stats + /metrics (monotonic).
+        self.batches_total = 0
+        self.requests_total = 0
+        self.coalesced_total = 0   # requests that shared a k>=2 forward
+        self.occupancy_sum = 0     # sum of k over batches (mean = /batches)
+        self.max_occupancy = 0
+
+    def submit(self, obs: np.ndarray,
+               generation: int) -> tuple[int, np.ndarray, float]:
+        """One request's forward through the admission window:
+        ``(action, logits, forward_s)`` where ``forward_s`` is the
+        shared batched-forward duration (the caller charges it to the
+        ``forward`` phase and the remaining blocked time to
+        ``batch_wait``)."""
+        key = (obs.shape, generation)
+        with self._lock:
+            self.requests_total += 1
+            batch = self._pending.get(key)
+            if batch is not None and len(batch.rows) < self.max_batch:
+                batch.rows.append(obs)
+                index = len(batch.rows) - 1
+                if len(batch.rows) >= self.max_batch:
+                    self._cond.notify_all()  # wake the leader early
+                leader = False
+            else:
+                batch = _Batch()
+                batch.rows.append(obs)
+                index = 0
+                self._pending[key] = batch
+                leader = True
+        if leader:
+            self._run_window(key, batch)
+        else:
+            batch.done.wait()
+        if batch.error is not None:
+            raise batch.error
+        actions, logits = batch.results
+        return int(actions[index]), logits[index], batch.forward_s
+
+    def _run_window(self, key, batch: _Batch) -> None:
+        deadline = time.monotonic() + self.window_s
+        with self._lock:
+            while (len(batch.rows) < self.max_batch
+                   and (remaining := deadline - time.monotonic()) > 0):
+                self._cond.wait(remaining)
+            # Close admission BEFORE forwarding: a request arriving now
+            # starts the next window instead of racing the stack below.
+            if self._pending.get(key) is batch:
+                del self._pending[key]
+            rows = list(batch.rows)
+        t0 = time.perf_counter()
+        try:
+            stacked = np.stack(rows)
+            actions, logits = self._backend.decide_nodes_batch(stacked)
+            batch.results = (np.asarray(actions), np.asarray(logits))
+        except Exception as e:  # noqa: BLE001 — fanned out to every member
+            # Not swallowed: every member's submit re-raises this into
+            # its own fail-open handler + breaker accounting; the log
+            # line keeps the batch-level event greppable (one line per
+            # batch, not per member).
+            logger.warning("batched forward failed; fanning out to %d "
+                           "member(s): %s", len(rows), e)
+            batch.error = e
+        finally:
+            batch.forward_s = time.perf_counter() - t0
+            with self._lock:
+                k = len(rows)
+                self.batches_total += 1
+                self.occupancy_sum += k
+                self.max_occupancy = max(self.max_occupancy, k)
+                if k >= 2:
+                    self.coalesced_total += k
+            batch.done.set()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "window_ms": round(self.window_s * 1e3, 3),
+                "max_batch": self.max_batch,
+                "requests_total": self.requests_total,
+                "batches_total": self.batches_total,
+                "coalesced_total": self.coalesced_total,
+                "max_occupancy": self.max_occupancy,
+                "mean_occupancy": (round(self.occupancy_sum
+                                         / self.batches_total, 3)
+                                   if self.batches_total else None),
+            }
+
+
+def agreement_corpus(node_feat: int, node_counts=(8, 64),
+                     samples: int = AGREEMENT_SAMPLES,
+                     seed: int = 0) -> list:
+    """The seeded candidate corpus the int8 gate scores: ``samples``
+    observation arrays cycling through ``node_counts``, drawn from the
+    serving observation's value ranges (costs/latencies/cpu in [0, 1],
+    cloud ids in {0, 0.5, 1}) — deterministic from the seed, so the
+    startup check and a test measure the SAME corpus."""
+    rng = np.random.default_rng(seed)
+    corpus = []
+    for i in range(samples):
+        n = int(node_counts[i % len(node_counts)])
+        obs = rng.uniform(0.0, 1.0, (n, node_feat)).astype(np.float32)
+        obs[:, min(3, node_feat - 1)] = rng.choice(
+            np.asarray([0.0, 0.5, 1.0], np.float32), n)
+        corpus.append(obs)
+    return corpus
+
+
+def check_int8_agreement(int8_backend, ref_backend, node_feat: int,
+                         node_counts=(8, 64),
+                         samples: int = AGREEMENT_SAMPLES, seed: int = 0,
+                         min_agreement: float = INT8_AGREEMENT_MIN,
+                         fault_plan=None) -> tuple[float, bool]:
+    """``(top1_agreement_fraction, ok)`` for the quantized forward vs
+    the fp32 reference on the seeded corpus. ``ok`` is the activation
+    gate: ``agreement >= min_agreement`` (99.5% by default — the bar
+    docs/serving.md publishes). ``fault_plan`` is the chaos seam (site
+    ``fastpath.agree``): a fired fault raises, and the caller — startup
+    or the rollout gate — must REFUSE the quantized path, never fall
+    through to serving it unverified."""
+    if fault_plan is not None:
+        fault_plan.check("fastpath.agree", RuntimeError)
+    corpus = agreement_corpus(node_feat, node_counts, samples, seed)
+    agree = 0
+    for obs in corpus:
+        a_q, _ = int8_backend.decide_nodes(obs)
+        a_f, ref_logits = ref_backend.decide_nodes(obs)
+        # An EXACT fp32 tie (the quantized argmax scores bit-identical
+        # to the reference argmax) is agreement: either choice is the
+        # same decision by the reference's own scoring, and argmax
+        # tie-breaking order is not a quantization error.
+        if a_q == a_f or ref_logits[a_q] == ref_logits[a_f]:
+            agree += 1
+    fraction = agree / len(corpus)
+    return fraction, fraction >= min_agreement

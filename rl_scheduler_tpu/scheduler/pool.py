@@ -81,6 +81,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from rl_scheduler_tpu.scheduler.extender import (
     LatencyStats,
+    fastpath_metric_lines,
     make_server,
     phase_metric_lines,
     slo_metric_lines,
@@ -387,9 +388,58 @@ def aggregate_stats(snapshots: list, pool: dict, merged=None,
     merged_slo = merge_worker_slo(snapshots)
     if merged_slo is not None:
         out["slo"] = merged_slo
+    fastpath = sum_fastpath(snapshots)
+    if fastpath is not None:
+        out["fastpath"] = fastpath
     trace = _summed_trace(snapshots)
     if trace is not None:
         out["trace"] = trace
+    return out
+
+
+def sum_fastpath(snapshots: list) -> dict | None:
+    """Pool-wide graftfwd section: lifetime counters sum exactly across
+    workers (each worker owns its cache/batcher); the cache hit rate and
+    batch occupancy recompute from the sums (rates are not linear — the
+    ``merged_histogram`` discipline). The int8 agreement reports the
+    MINIMUM across workers: the gate bar must hold for every worker, so
+    the pool gauge shows the worst one. ``None`` when no worker runs a
+    fast-path lever."""
+    sections = [s["stats"]["fastpath"] for s in snapshots
+                if s.get("stats", {}).get("fastpath")]
+    if not sections:
+        return None
+    out: dict = {}
+    caches = [sec["cache"] for sec in sections if "cache" in sec]
+    if caches:
+        cache = {key: sum(c.get(key, 0) for c in caches)
+                 for key in ("hits_total", "misses_total",
+                             "invalidations_total", "entries")}
+        requests = cache["hits_total"] + cache["misses_total"]
+        cache["hit_rate"] = (round(cache["hits_total"] / requests, 4)
+                             if requests else None)
+        out["cache"] = cache
+    batches = [sec["batch"] for sec in sections if "batch" in sec]
+    if batches:
+        batch = {key: sum(b.get(key, 0) for b in batches)
+                 for key in ("requests_total", "batches_total",
+                             "coalesced_total")}
+        batch["max_occupancy"] = max(b.get("max_occupancy", 0)
+                                     for b in batches)
+        occupancy_sum = sum(
+            (b.get("mean_occupancy") or 0) * b.get("batches_total", 0)
+            for b in batches)
+        batch["mean_occupancy"] = (
+            round(occupancy_sum / batch["batches_total"], 3)
+            if batch["batches_total"] else None)
+        out["batch"] = batch
+    int8 = [sec["int8"] for sec in sections if "int8" in sec]
+    if int8:
+        out["int8"] = {
+            "agreement": min(entry["agreement"] for entry in int8),
+            "scales_recorded": max(entry.get("scales_recorded", 0)
+                                   for entry in int8),
+        }
     return out
 
 
@@ -444,6 +494,10 @@ def aggregate_metrics(snapshots: list, pool: dict) -> str:
         lines += phase_metric_lines(p, phase_hists)
     if "slo" in stats:
         lines += slo_metric_lines(p, stats["slo"])
+    if "fastpath" in stats:
+        # graftfwd: the SAME exposition helper as the single-process
+        # plane, fed the pool-summed section (one scrape config).
+        lines += fastpath_metric_lines(p, stats["fastpath"])
     for key, help_text in (
         ("shed_fraction", "Pool request-weighted fraction served off the "
                           "primary path by the load-aware backends."),
@@ -656,6 +710,17 @@ def _worker_control_loop(policy, server, sock, worker_id: int) -> None:
                 # tags its trace record, so synthetic gate traffic
                 # cannot contaminate the kube API or the trace.
                 _send_line(sock, {"ok": True, **policy.warmup_probe()})
+            elif cmd == "fastpath":
+                # graftfwd promote gate: flush this worker's score
+                # cache and re-run the int8 agreement check (rollout.py
+                # calls it on every respawned worker BEFORE the canary
+                # serves; ok=False fails the gate -> rollback). Policy
+                # stand-ins without the method have no levers to
+                # verify — vacuously ok, like spans-less snapshots.
+                verify = getattr(policy, "fastpath_verify", None)
+                ack = verify() if verify is not None else {"ok": True}
+                ack.setdefault("ok", False)
+                _send_line(sock, ack)
             else:
                 _send_line(sock, {"error": f"unknown cmd {cmd!r}"})
     except OSError:

@@ -411,6 +411,31 @@ class RolloutController:
             if not ack.get("decided"):
                 return False, (f"warm-up probe {k + 1} failed open — the "
                                "new checkpoint is not deciding")
+        # graftfwd gate: flush the respawned worker's score cache and
+        # re-run the int8 agreement check on the candidate checkpoint
+        # BEFORE it takes traffic — a stale-generation cache hit after
+        # a rollout is a correctness bug, and a candidate that
+        # quantizes badly must refuse the promote, not silently serve
+        # (fp32 or otherwise). ``fastpath.agree`` is the chaos seam.
+        if self.fault_plan is not None:
+            try:
+                self.fault_plan.check("fastpath.agree", RuntimeError)
+            except RuntimeError as e:
+                return False, f"fastpath agreement check failed: {e}"
+        # Longer timeout than a probe: the verify re-runs the full
+        # seeded-corpus agreement check AT THE SERVING NODE COUNTS —
+        # fleet-N int8+fp32 forwards take seconds, not probe-milliseconds.
+        ack = pool._command(slot, "fastpath",
+                            max(self.probe_timeout_s, 30.0))
+        if ack is None:
+            return False, "fastpath verify got no answer"
+        if "error" in ack and "ok" not in ack:
+            # Pre-graftfwd worker build ("unknown cmd"): nothing to
+            # verify — the gate only binds where the levers exist.
+            pass
+        elif not ack.get("ok"):
+            why = ack.get("error") or "int8 agreement below the gate"
+            return False, f"fastpath verify failed: {why}"
         return True, ""
 
     def _canary_gate(self, slot) -> tuple[bool, str]:

@@ -88,28 +88,32 @@ def _gelu(x: np.ndarray) -> np.ndarray:
 
 
 def _mha(x: np.ndarray, p: dict) -> np.ndarray:
-    """flax MultiHeadDotProductAttention forward: x [N, dim] -> [N, dim].
+    """flax MultiHeadDotProductAttention forward: x [N, dim] -> [N, dim],
+    or batched ``[k, N, dim]`` (graftfwd micro-batching — every op below
+    is written on the trailing axes, so one code path serves both; the
+    2-D behavior is unchanged).
 
     qkv kernels are [dim, H, head_dim]; out kernel is [H, head_dim, dim].
     Kernels fold to 2-D so every matmul hits BLAS (generic ``np.einsum``
     paths measured ~10x slower on the request path); heads run as a short
-    Python loop over 2-D slices.
+    Python loop over trailing-axis slices.
     """
     wq, wk, wv = (p[n]["kernel"] for n in ("query", "key", "value"))
     dim, num_heads, head_dim = wq.shape
     fold = lambda w: w.reshape(dim, num_heads * head_dim)
-    q = x @ fold(wq) + p["query"]["bias"].reshape(-1)   # [N, H*hd]
+    q = x @ fold(wq) + p["query"]["bias"].reshape(-1)   # [..., N, H*hd]
     k = x @ fold(wk) + p["key"]["bias"].reshape(-1)
     v = x @ fold(wv) + p["value"]["bias"].reshape(-1)
     scale = 1.0 / np.sqrt(head_dim)
     ctx = np.empty_like(q)
     for h in range(num_heads):
         sl = slice(h * head_dim, (h + 1) * head_dim)
-        scores = (q[:, sl] @ k[:, sl].T) * scale        # [N, N]
+        scores = np.matmul(q[..., sl],
+                           np.swapaxes(k[..., sl], -1, -2)) * scale
         scores -= scores.max(-1, keepdims=True)
         weights = np.exp(scores)
         weights /= weights.sum(-1, keepdims=True)
-        ctx[:, sl] = weights @ v[:, sl]
+        ctx[..., sl] = np.matmul(weights, v[..., sl])
     return ctx @ p["out"]["kernel"].reshape(num_heads * head_dim, dim) \
         + p["out"]["bias"]
 
@@ -143,6 +147,17 @@ class NumpySetBackend:
     def decide_nodes(self, node_obs: np.ndarray) -> tuple[int, np.ndarray]:
         logits = self._forward(np.asarray(node_obs))
         return int(np.argmax(logits)), logits
+
+    def decide_nodes_batch(
+            self, batch_obs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """graftfwd micro-batching: ONE stacked ``[k, N, F]`` forward ->
+        ``(actions [k], logits [k, N])``. The forward is the same code
+        as :meth:`decide_nodes` broadcast over the leading axis — the
+        batched BLAS calls replace k GIL-contending single forwards
+        (per-row agreement vs sequential is tolerance-tested; the
+        bitwise batched guarantee lives on the AOT path)."""
+        logits = self._forward(np.asarray(batch_obs))
+        return np.argmax(logits, axis=-1), logits
 
 
 class TorchSetBackend:
@@ -183,6 +198,8 @@ class TorchSetBackend:
             + p["bias"]
 
     def _mha(self, x, p):
+        # Trailing-axis ops: one code path for [N, dim] and the
+        # micro-batched [k, N, dim] (graftfwd), like the numpy twin.
         torch = self._torch
         wq, wk, wv = (p[n]["kernel"] for n in ("query", "key", "value"))
         dim, num_heads, head_dim = wq.shape
@@ -194,8 +211,8 @@ class TorchSetBackend:
         ctx = torch.empty_like(q)
         for h in range(num_heads):
             sl = slice(h * head_dim, (h + 1) * head_dim)
-            weights = torch.softmax((q[:, sl] @ k[:, sl].T) * scale, dim=-1)
-            ctx[:, sl] = weights @ v[:, sl]
+            scores = (q[..., sl] @ k[..., sl].transpose(-1, -2)) * scale
+            ctx[..., sl] = torch.softmax(scores, dim=-1) @ v[..., sl]
         return ctx @ p["out"]["kernel"].reshape(num_heads * head_dim, dim) \
             + p["out"]["bias"]
 
@@ -220,6 +237,16 @@ class TorchSetBackend:
             logits = self._forward(obs).numpy()
         return int(np.argmax(logits)), logits
 
+    def decide_nodes_batch(
+            self, batch_obs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """graftfwd: one stacked ``[k, N, F]`` ATen forward (see the
+        numpy twin's docstring)."""
+        torch = self._torch
+        with torch.no_grad():
+            obs = torch.from_numpy(np.asarray(batch_obs, np.float32))
+            logits = self._forward(obs).numpy()
+        return np.argmax(logits, axis=-1), logits
+
 
 class NativeSetBackend:
     """Set-transformer pointer forward in the C++ core
@@ -240,6 +267,71 @@ class NativeSetBackend:
 
     def decide_nodes(self, node_obs: np.ndarray) -> tuple[int, np.ndarray]:
         return self._net.decide(np.asarray(node_obs, np.float32))
+
+    def decide_nodes_batch(
+            self, batch_obs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """graftfwd: the C++ core scores rows one ctypes hop each —
+        every hop GIL-free, so the loop still beats k threads contending
+        on the GIL-holding paths (a batched C++ entry point would save
+        only the per-hop microseconds)."""
+        return _native_batch_rows(self._net, batch_obs)
+
+
+def _native_batch_rows(net, batch_obs) -> tuple[np.ndarray, np.ndarray]:
+    """Shared per-row batch loop for the C++ cores (fp32 and int8): one
+    GIL-free ctypes hop per row into preallocated outputs."""
+    batch = np.asarray(batch_obs, np.float32)
+    actions = np.empty(batch.shape[0], np.int64)
+    logits = np.empty(batch.shape[:2], np.float32)
+    for i, obs in enumerate(batch):
+        actions[i], logits[i] = net.decide(obs)
+    return actions, logits
+
+
+class Int8NativeSetBackend:
+    """graftfwd lever (ii): the int8-quantized C++ fleet forward
+    (``native/set_infer.cpp set_decide_int8`` — int8 dual-plane weights
+    folded for the pmaddwd path, blocked attention, GIL-free). The fleet
+    crossover says large-N scoring is bandwidth/layout-bound, which is
+    what the narrower operands and the blocked j-walk attack: measured
+    1.25x the numpy forward at N=1024 single-stream on the 1-core
+    container (33.5 vs 41.9 ms), 3.3x the fp32 C++ core.
+
+    Construction only does the math. ACTIVATION is gated: callers go
+    through :func:`make_set_backend` (``--backend native-int8``), which
+    runs ``fastpath.check_int8_agreement`` on the seeded corpus and
+    REFUSES to serve below the 99.5% top-1 bar — a checkpoint that
+    quantizes badly must fail loudly at startup (and at the rollout
+    gate, ``ExtenderPolicy.fastpath_verify``), never degrade silently.
+    ``quantization_scales`` is the recorded per-tensor scale list;
+    ``agreement`` is stamped by the gate for /stats."""
+
+    name = "native-int8"
+    family = "set"
+
+    def __init__(self, params_tree: dict, num_heads: int = 1,
+                 depth: int = SET_DEPTH):
+        from rl_scheduler_tpu.native import NativeSetTransformerInt8
+
+        del num_heads  # read from the param tree's head axis by pack_set
+        self._net = NativeSetTransformerInt8(params_tree, depth)
+        self.quantization_scales = self._net.scales
+        # Stamped by the startup gate (make_set_backend): the measured
+        # agreement, plus the fp32 reference, obs width, and the gated
+        # node counts so the rollout gate can RE-RUN the identical check
+        # per promote (fastpath_verify).
+        self.agreement: float | None = None
+        self.reference = None
+        self.node_feat: int | None = None
+        self.agreement_node_counts: tuple = (8, 64)
+
+    def decide_nodes(self, node_obs: np.ndarray) -> tuple[int, np.ndarray]:
+        return self._net.decide(np.asarray(node_obs, np.float32))
+
+    def decide_nodes_batch(
+            self, batch_obs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """One GIL-free C++ hop per row (see NativeSetBackend)."""
+        return _native_batch_rows(self._net, batch_obs)
 
 
 class JaxSetAOTBackend:
@@ -262,7 +354,8 @@ class JaxSetAOTBackend:
     def __init__(self, params_tree: dict, num_heads: int = 1,
                  depth: int = SET_DEPTH, device: str = "cpu",
                  warm_counts: tuple = (8,), max_cached: int = 16,
-                 node_feat: int | None = None):
+                 node_feat: int | None = None,
+                 warm_batches: tuple = ()):
         import collections
 
         import jax
@@ -292,9 +385,20 @@ class JaxSetAOTBackend:
         )
         self._max_cached = max(max_cached, len(warm_counts) or 1)
         self._compiling: set[int] = set()
+        # graftfwd micro-batching: AOT executables for stacked
+        # [k, N, F] forwards, keyed (k, n) — jax.vmap of the SAME apply
+        # the single path runs, so per-row logits are bitwise-identical
+        # (pinned by test). Same bounded-LRU/background-compile
+        # discipline as the single-obs cache.
+        self._batch_compiled: collections.OrderedDict[tuple, object] = (
+            collections.OrderedDict()
+        )
+        self._batch_compiling: set[tuple] = set()
         self._lock = threading.Lock()
         for n in warm_counts:
             self._compiled[n] = self._compile(n)
+        for k, n in warm_batches:
+            self._batch_compiled[(k, n)] = self._compile_batch(k, n)
 
     def _compile(self, n: int):
         import jax.numpy as jnp
@@ -357,6 +461,87 @@ class JaxSetAOTBackend:
         # Uncached N: the numpy forward answers NOW (tolerance-tested same
         # function); the executable takes over once the compile lands.
         return self._fallback.decide_nodes(obs)
+
+    # ------------------------------------------------- graftfwd batching
+
+    def _compile_batch(self, k: int, n: int):
+        import jax.numpy as jnp
+
+        jax = self._jax
+
+        def apply(params, obs):
+            logits, _ = self._net.apply(params, obs)
+            return logits
+
+        obs_spec = jax.ShapeDtypeStruct((k, n, self._node_feat),
+                                        jnp.float32)
+        params_spec = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self._params
+        )
+        with jax.default_device(self._dev):
+            fn = (jax.jit(jax.vmap(apply, in_axes=(None, 0)))
+                  .lower(params_spec, obs_spec).compile())
+        np.asarray(fn(self._params,
+                      np.zeros((k, n, self._node_feat), np.float32)))
+        return fn
+
+    def _compile_batch_in_background(self, k: int, n: int) -> None:
+        try:
+            fn = self._compile_batch(k, n)
+            with self._lock:
+                self._batch_compiled[(k, n)] = fn
+                while len(self._batch_compiled) > self._max_cached:
+                    evicted, _ = self._batch_compiled.popitem(last=False)
+                    logger.info("evicted AOT batch executable for %s (LRU, "
+                                "cache cap %d)", evicted, self._max_cached)
+        except Exception:  # compile failure must not take serving down
+            logger.exception("background AOT batch compile for (%d, %d) "
+                             "failed; the host batch forward keeps serving "
+                             "that shape", k, n)
+        finally:
+            with self._lock:
+                self._batch_compiling.discard((k, n))
+
+    def warm_batch_async(self, k: int, n: int) -> None:
+        """Kick ONE background compile of the ``[k, n, F]`` batch
+        executable if it is neither live nor in flight — the seam the
+        load-aware router uses so host-served batch shapes graduate to
+        the AOT path without ever stalling a window."""
+        with self._lock:
+            if ((k, n) in self._batch_compiled
+                    or (k, n) in self._batch_compiling):
+                return
+            self._batch_compiling.add((k, n))
+        try:
+            threading.Thread(
+                target=self._compile_batch_in_background, args=(k, n),
+                daemon=True,
+            ).start()
+        except RuntimeError:  # thread exhaustion: retry on a later batch
+            with self._lock:
+                self._batch_compiling.discard((k, n))
+
+    def decide_nodes_batch(
+            self, batch_obs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """graftfwd: ONE ``[k, N, F]`` AOT forward — ``jax.vmap`` of the
+        single-request apply, bitwise-identical per row (pinned by
+        test). An uncompiled (k, n) answers from the numpy batch forward
+        while a background compile runs, like the single-obs path."""
+        batch = np.asarray(batch_obs, np.float32)
+        k, n = batch.shape[0], batch.shape[1]
+        with self._lock:
+            fn = self._batch_compiled.get((k, n))
+            if fn is not None:
+                self._batch_compiled.move_to_end((k, n))
+        if fn is not None:
+            logits = np.asarray(fn(self._params, batch))
+            return np.argmax(logits, axis=-1), logits
+        self.warm_batch_async(k, n)
+        return self._fallback.decide_nodes_batch(batch)
+
+    def has_batch_executable(self, k: int, n: int) -> bool:
+        with self._lock:
+            return self._batch_compiled.get((k, n)) is not None
 
     def has_executable(self, n: int) -> bool:
         """True when an AOT executable for this node count is live. The
@@ -653,6 +838,29 @@ class LoadAwareSetBackend:
         finally:
             self._tracker.exit()
 
+    def decide_nodes_batch(
+            self, batch_obs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """graftfwd micro-batching through the load-aware flag: the
+        batched AOT executable when it is live, else the host batch
+        forward (torch from the fleet-giant crossover, numpy below)
+        while a background compile graduates the shape — a batch exists
+        BECAUSE of concurrency, so the uniform host path is the right
+        fallback for exactly the reason single large-N requests shed
+        under load."""
+        batch = np.asarray(batch_obs, np.float32)
+        k, n = batch.shape[0], batch.shape[1]
+        if self._overflow_numpy is None:
+            # Accelerator serve device: no host paths, no routing.
+            return self._jax.decide_nodes_batch(batch)
+        if self._jax.has_batch_executable(k, n):
+            return self._jax.decide_nodes_batch(batch)
+        self._jax.warm_batch_async(k, n)
+        host = (self._overflow_torch
+                if (self._overflow_torch is not None
+                    and n >= self.TORCH_OVERFLOW_MIN_N)
+                else self._overflow_numpy)
+        return host.decide_nodes_batch(batch)
+
 
 def make_set_backend(backend: str, params_tree: dict, num_heads: int = 1,
                      device: str = "cpu", warm_counts: tuple = (8,),
@@ -662,14 +870,62 @@ def make_set_backend(backend: str, params_tree: dict, num_heads: int = 1,
     ``jax`` -> load-aware AOT (per-N executable cache, native/numpy
     overflow); ``native`` -> the C++ core (``native/set_infer.cpp``,
     GIL-free, degrades to numpy when the toolchain/.so is missing);
-    ``cpu`` -> numpy; ``torch`` -> the torch CPU mirror (degrades to
-    numpy if torch is unavailable). ``greedy`` is handled by the caller.
+    ``native-int8`` -> the quantized C++ fleet forward (graftfwd),
+    GATED: the seeded-corpus top-1 agreement vs fp32 must clear the
+    99.5% bar or construction RAISES — an operator who asked for the
+    quantized path must not silently serve something else (no fallback,
+    unlike ``native``); ``cpu`` -> numpy; ``torch`` -> the torch CPU
+    mirror (degrades to numpy if torch is unavailable). ``greedy`` is
+    handled by the caller.
     ``warm_counts`` pre-compiles the jax flag's AOT executables for
     those node counts at startup (``--warm-nodes``; fleet deployments
     warm their actual N so the first request is never answered by the
     overflow forward while a background compile runs). Returns
     ``(backend_obj, fallback_used: bool)`` like ``make_backend``.
     """
+    if backend == "native-int8":
+        from rl_scheduler_tpu.scheduler.fastpath import (
+            INT8_AGREEMENT_MIN,
+            check_int8_agreement,
+        )
+
+        if node_feat is None:
+            from rl_scheduler_tpu.env.cluster_set import NODE_FEAT
+
+            node_feat = NODE_FEAT
+        try:
+            q8 = Int8NativeSetBackend(params_tree, num_heads)
+        except Exception as e:  # toolchain/.so missing: the operator
+            # named the quantized path — refuse, never serve another one
+            raise ValueError(
+                f"--backend native-int8: the quantized C++ core is "
+                f"unavailable ({e}); build the native toolchain or drop "
+                "the flag") from e
+        reference = NumpySetBackend(params_tree, num_heads)
+        # The corpus must sample the node counts this deployment SERVES,
+        # not just small sets: quantization noise flips top-1 most among
+        # the near-tied candidates of a fleet-size N, and warm_counts is
+        # exactly the declared serving-N list (checkpoint training N /
+        # --warm-nodes). 8 and 64 stay as the small-set floor.
+        gate_counts = tuple(sorted(
+            {8, 64} | {int(n) for n in (warm_counts or ())}))
+        agreement, ok = check_int8_agreement(q8, reference, int(node_feat),
+                                             node_counts=gate_counts)
+        if not ok:
+            raise ValueError(
+                f"--backend native-int8: measured top-1 agreement "
+                f"{agreement:.4f} vs fp32 on the seeded corpus is below "
+                f"the {INT8_AGREEMENT_MIN:.3f} activation gate — this "
+                "checkpoint quantizes badly; refusing to serve the "
+                "quantized forward (docs/serving.md)")
+        q8.agreement = agreement
+        q8.reference = reference
+        q8.node_feat = int(node_feat)
+        q8.agreement_node_counts = gate_counts
+        logger.info("int8 native fleet forward armed: top-1 agreement "
+                    "%.4f on the seeded corpus at N=%s (gate %.3f)",
+                    agreement, list(gate_counts), INT8_AGREEMENT_MIN)
+        return q8, False
     if backend == "torch":
         try:
             return TorchSetBackend(params_tree, num_heads), False

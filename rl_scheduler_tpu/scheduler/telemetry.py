@@ -206,6 +206,14 @@ class TableTelemetry:
         self._local.raw = raw
         return raw % len(self.costs)
 
+    def note_replay_position(self, raw: int) -> None:
+        """Overwrite THIS thread's last-observed replay position
+        (graftfwd score cache): a cache hit serves a score computed from
+        an EARLIER observation, so the trace record's provenance field
+        must name the row that score actually consumed — not whatever
+        this thread last replayed for some other request."""
+        self._local.raw = raw
+
     def last_replay_position(self) -> int | None:
         """The RAW monotonic position (no ``% len``) consumed by THIS
         thread's most recent observation — the trace log's

@@ -61,9 +61,15 @@ logger = logging.getLogger(__name__)
 #   rollout.health     a respawned worker's health/warm-up gate reports
 #                      failure — same rollback obligation as a real dead
 #                      canary (scheduler/rollout.py)
+#   fastpath.agree     the graftfwd promote gate's int8 agreement
+#                      re-check fails — the rollout must refuse/roll
+#                      back rather than serve a badly-quantizing (or
+#                      unverifiable) candidate (scheduler/rollout.py,
+#                      scheduler/fastpath.check_int8_agreement)
 SITES = ("checkpoint.save", "checkpoint.partial", "telemetry.scrape",
          "k8s.place", "backend.decide", "preempt", "scenario.churn",
-         "tracelog.append", "rollout.spawn", "rollout.health")
+         "tracelog.append", "rollout.spawn", "rollout.health",
+         "fastpath.agree")
 
 
 class FaultInjected(RuntimeError):

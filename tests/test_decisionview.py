@@ -41,8 +41,8 @@ def report():
 
 def test_phase_table_and_reconciliation(report):
     phases = report["phases"]
-    assert set(phases) == {"parse", "observe", "forward", "marshal",
-                           "trace"}
+    assert set(phases) == {"parse", "observe", "batch_wait", "forward",
+                           "marshal", "trace"}
     for entry in phases.values():
         assert entry["count"] == 80
         assert entry["mean_ms"] > 0
@@ -50,8 +50,10 @@ def test_phase_table_and_reconciliation(report):
     assert rec["coverage"] >= MIN_PHASE_COVERAGE
     assert rec["phase_sum_ms"] == pytest.approx(
         sum(e["mean_ms"] for e in phases.values()), abs=1e-3)
-    # The e2e decide window is explained by observe+forward alone too.
-    inner = phases["observe"]["mean_ms"] + phases["forward"]["mean_ms"]
+    # The e2e decide window is explained by the decide-side phases alone
+    # (observe + the graftfwd admission window + forward).
+    inner = (phases["observe"]["mean_ms"] + phases["batch_wait"]["mean_ms"]
+             + phases["forward"]["mean_ms"])
     assert inner >= 0.9 * rec["e2e_mean_ms"]
 
 
@@ -108,6 +110,22 @@ def test_over_budget_and_absent_phase_violate(report):
     assert any("forward" in v and "exceeds budget" in v
                for v in violations)
     assert any("missing_phase" in v and "absent" in v for v in violations)
+
+
+def test_optional_phase_may_be_absent(report):
+    """`optional_phases` (graftfwd): a budgeted-but-optional phase may
+    be ABSENT without failing (version skew: `--check` against a
+    pre-batching pool), while a non-optional absence still violates."""
+    pre13 = dict(report)
+    pre13["phases"] = {k: v for k, v in report["phases"].items()
+                      if k != "batch_wait"}
+    budgets = {"tolerance_pct": 50.0,
+               "phases": {"batch_wait": 2.0, "forward": 3.0},
+               "optional_phases": ["batch_wait"]}
+    assert check_budgets(pre13, budgets) == []
+    budgets["optional_phases"] = []
+    assert any("batch_wait" in v and "absent" in v
+               for v in check_budgets(pre13, budgets))
 
 
 def test_coverage_gap_violates():

@@ -1,0 +1,595 @@
+"""graftfwd (PR 13): the serving fast path — exact-agreement suites per
+lever (telemetry-epoch score cache, cross-request micro-batching, the
+int8 native fleet forward), span-uniformity under batching, the
+flush-on-promote verify hook, and the bench's lever matrix. The
+fastpath.agree chaos test lives with the other rollout chaos tests in
+tests/test_graftguard.py; pool-wide fastpath aggregation is unit-tested
+here against worker-snapshot dicts (the pool suite's discipline)."""
+
+import json
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from rl_scheduler_tpu.scheduler.extender import (
+    PHASES,
+    ExtenderPolicy,
+    build_policy,
+    fastpath_metric_lines,
+)
+from rl_scheduler_tpu.scheduler.fastpath import (
+    INT8_AGREEMENT_MIN,
+    MicroBatcher,
+    ScoreCache,
+    agreement_corpus,
+    check_int8_agreement,
+)
+from rl_scheduler_tpu.scheduler.set_backend import (
+    Int8NativeSetBackend,
+    JaxSetAOTBackend,
+    NumpySetBackend,
+    make_set_backend,
+)
+from rl_scheduler_tpu.utils.faults import FaultPlan
+
+
+@pytest.fixture(scope="module")
+def set_tree():
+    import jax
+    import jax.numpy as jnp
+
+    from rl_scheduler_tpu.models.transformer import SetTransformerPolicy
+
+    net = SetTransformerPolicy(dim=64, depth=2)
+    return net.init(jax.random.PRNGKey(3), jnp.zeros((8, 6), jnp.float32))
+
+
+class FrozenTelemetry:
+    """Telemetry stub whose observation never changes — the setting the
+    score cache's exact-agreement contract is judged in (between scrapes
+    the real telemetry is constant too)."""
+
+    def __init__(self, n=8, feat=6, seed=0):
+        rng = np.random.default_rng(seed)
+        self.obs = rng.uniform(0, 1, (n, feat)).astype(np.float32)
+        self.observes = 0
+        self.noted = None
+        from rl_scheduler_tpu.scheduler.telemetry import RandomCpu
+
+        self.cpu = RandomCpu(seed=seed)
+
+    def observe_nodes(self, clouds, pod_cpu):
+        self.observes += 1
+        return self.obs[: len(clouds)].copy()
+
+    def last_replay_position(self):
+        return 42
+
+    def note_replay_position(self, raw):
+        self.noted = raw
+
+
+def _clouds(n=8):
+    return ["aws" if i % 2 == 0 else "azure" for i in range(n)]
+
+
+# ------------------------------------------------------------- score cache
+
+
+def test_cache_hit_is_bitwise_and_skips_observe(set_tree):
+    """Lever (iii) exact agreement: with telemetry frozen inside the
+    epoch, a cache hit returns the SAME decision a recompute would —
+    bitwise — while skipping the observe and forward phases entirely."""
+    telemetry = FrozenTelemetry()
+    policy = ExtenderPolicy(NumpySetBackend(set_tree), telemetry)
+    policy.score_cache = ScoreCache(epoch_s=3600.0)
+    clouds = _clouds()
+    a1, p1, o1 = policy.decide_set(clouds, 0.25)
+    observes_after_miss = telemetry.observes
+    a2, p2, o2 = policy.decide_set(clouds, 0.25)
+    assert telemetry.observes == observes_after_miss  # observe skipped
+    assert a2 == a1
+    assert np.array_equal(p2, p1)                     # bitwise
+    assert np.array_equal(o2, o1)                     # stored provenance
+    # The recompute (cache off) is bitwise-identical too: same obs,
+    # deterministic forward.
+    a3, logits3 = policy.backend.decide_nodes(o2)
+    assert a3 == a1
+    snap = policy.score_cache.snapshot()
+    assert snap["hits_total"] == 1 and snap["misses_total"] == 1
+    # The hit's trace provenance names the ORIGINAL replay position.
+    assert telemetry.noted == 42
+    stats = policy.statistics()
+    assert stats["fastpath"]["cache"]["hit_rate"] == 0.5
+
+
+def test_cache_hit_keeps_phase_count_uniformity(set_tree):
+    """A hit still records one sample per phase (the request-level span
+    accumulator closes out through the handlers), with forward charged
+    its true zero."""
+    telemetry = FrozenTelemetry()
+    policy = ExtenderPolicy(NumpySetBackend(set_tree), telemetry)
+    policy.score_cache = ScoreCache(epoch_s=3600.0)
+    args = {"nodenames": [f"{'aws' if i % 2 else 'azure'}-n{i}"
+                          for i in range(8)], "pod": {}}
+    for _ in range(4):
+        policy.filter(dict(args))
+    assert policy.score_cache.snapshot()["hits_total"] == 3
+    for phase in PHASES:
+        assert policy.phase_stats[phase].histogram()[2] == 4
+    # 3 hits charged 0 forward: the forward phase's lifetime sum is the
+    # single miss's forward alone, well under the e2e sum.
+    fwd_sum = policy.phase_stats["forward"].histogram()[1]
+    e2e_sum = policy.stats.histogram()[1]
+    assert fwd_sum < e2e_sum
+
+
+def test_cache_keys_generation_pod_and_nodeset():
+    key = ScoreCache.make_key(0, ["aws", None], 0.25, None)
+    assert ScoreCache.make_key(1, ["aws", None], 0.25, None) != key
+    assert ScoreCache.make_key(0, ["aws", "azure"], 0.25, None) != key
+    assert ScoreCache.make_key(0, ["aws", None], 0.5, None) != key
+    assert ScoreCache.make_key(0, ["aws", None], 0.25, [0.1, 0.2]) != key
+    assert ScoreCache.make_key(0, ["aws", None], 0.25, None) == key
+
+
+def test_cache_epoch_rollover_invalidates_like_price_replay():
+    """Epoch semantics pinned like --price-replay wallclock: the epoch
+    is int(now / epoch_s); crossing the boundary drops every entry and
+    counts ONE invalidation."""
+    now = [0.0]
+    cache = ScoreCache(epoch_s=15.0, clock=lambda: now[0])
+    key = cache.make_key(0, ["aws"], 0.25, None)
+    cache.put(key, 1, np.ones(1), np.ones((1, 6)), 7)
+    assert cache.get(key) is not None
+    now[0] = 14.9
+    assert cache.get(key) is not None          # same epoch: still live
+    now[0] = 15.1
+    assert cache.get(key) is None              # rolled: invalidated
+    snap = cache.snapshot()
+    assert snap["invalidations_total"] == 1
+    assert snap["entries"] == 0
+    assert snap["epoch"] == 1
+
+
+def test_cache_lru_bound_and_flush():
+    cache = ScoreCache(epoch_s=3600.0, max_entries=2)
+    for i in range(3):
+        cache.put((0, (f"n{i}",), 0.25, None), i, np.ones(1),
+                  np.ones((1, 6)), i)
+    assert cache.snapshot()["entries"] == 2
+    assert cache.get((0, ("n0",), 0.25, None)) is None  # LRU-evicted
+    assert cache.flush("test") == 2
+    snap = cache.snapshot()
+    assert snap["entries"] == 0
+    # two invalidations: none from LRU (bound, not epoch), one flush,
+    # plus the epoch init... flush counts exactly one.
+    assert snap["invalidations_total"] == 1
+
+
+def test_cache_validation():
+    with pytest.raises(ValueError):
+        ScoreCache(epoch_s=0)
+    with pytest.raises(ValueError):
+        ScoreCache(max_entries=0)
+
+
+def test_fastpath_verify_flushes_cache(set_tree):
+    """Flush-on-promote: the rollout gate's fastpath command must drop
+    every entry — a stale-generation hit after a rollout is a
+    correctness bug even with the generation in the key."""
+    policy = ExtenderPolicy(NumpySetBackend(set_tree), FrozenTelemetry())
+    policy.score_cache = ScoreCache(epoch_s=3600.0)
+    policy.decide_set(_clouds(), 0.25)
+    assert policy.score_cache.snapshot()["entries"] == 1
+    out = policy.fastpath_verify()
+    assert out["ok"] and out["cache_flushed"] == 1
+    assert policy.score_cache.snapshot()["entries"] == 0
+
+
+def test_probe_bypasses_cache(set_tree):
+    """A rollout warm-up probe must exercise the REAL decide path (a
+    cached answer is not a gate signal) and must not seed the cache."""
+    telemetry = FrozenTelemetry()
+    policy = ExtenderPolicy(NumpySetBackend(set_tree), telemetry)
+    policy.score_cache = ScoreCache(epoch_s=3600.0)
+    assert policy.warmup_probe()["decided"]
+    assert policy.warmup_probe()["decided"]
+    snap = policy.score_cache.snapshot()
+    assert snap["hits_total"] == 0 and snap["misses_total"] == 0
+    assert snap["entries"] == 0
+
+
+# ----------------------------------------------------------- micro-batcher
+
+
+def test_batcher_coalesces_and_agrees_with_sequential(set_tree):
+    """Lever (i): k concurrent same-shape submits share ONE [k, N, F]
+    forward, and every row's decision agrees with its own sequential
+    forward (tolerance on the numpy host batch; the bitwise guarantee
+    is the AOT test below)."""
+    backend = NumpySetBackend(set_tree)
+    batcher = MicroBatcher(backend, window_s=0.25, max_batch=4)
+    rng = np.random.default_rng(0)
+    obs = [rng.uniform(0, 1, (16, 6)).astype(np.float32) for _ in range(4)]
+    results = [None] * 4
+
+    def submit(i):
+        results[i] = batcher.submit(obs[i], generation=0)
+
+    threads = [threading.Thread(target=submit, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(4):
+        action, logits, forward_s = results[i]
+        ref_action, ref_logits = backend.decide_nodes(obs[i])
+        assert action == ref_action
+        np.testing.assert_allclose(logits, ref_logits, atol=1e-5)
+        assert forward_s > 0
+    snap = batcher.snapshot()
+    assert snap["requests_total"] == 4
+    assert snap["batches_total"] < 4          # at least one coalesce
+    assert snap["coalesced_total"] >= 2
+    assert snap["max_occupancy"] >= 2
+
+
+def test_batcher_keys_on_shape_and_generation(set_tree):
+    """Different obs specs (and generations) never share a forward —
+    the AOT executable and the checkpoint must match every row."""
+    backend = NumpySetBackend(set_tree)
+    batcher = MicroBatcher(backend, window_s=0.15, max_batch=4)
+    results = {}
+
+    def submit(name, obs, gen):
+        results[name] = batcher.submit(obs, generation=gen)
+
+    rng = np.random.default_rng(1)
+    o8 = rng.uniform(0, 1, (8, 6)).astype(np.float32)
+    o16 = rng.uniform(0, 1, (16, 6)).astype(np.float32)
+    threads = [threading.Thread(target=submit, args=(n, o, g))
+               for n, o, g in (("a", o8, 0), ("b", o16, 0), ("c", o8, 1))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert batcher.snapshot()["batches_total"] == 3  # nothing coalesced
+    for name, obs in (("a", o8), ("b", o16), ("c", o8)):
+        ref_action, _ = backend.decide_nodes(obs)
+        assert results[name][0] == ref_action
+
+
+def test_batcher_error_fans_out_to_every_member():
+    class Poisoned:
+        def decide_nodes_batch(self, batch):
+            raise RuntimeError("poisoned batch")
+
+    batcher = MicroBatcher(Poisoned(), window_s=0.15, max_batch=2)
+    obs = np.zeros((4, 6), np.float32)
+    errors = []
+
+    def submit():
+        try:
+            batcher.submit(obs, generation=0)
+        except RuntimeError as e:
+            errors.append(str(e))
+
+    threads = [threading.Thread(target=submit) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == ["poisoned batch", "poisoned batch"]
+
+
+def test_batcher_validation(set_tree):
+    backend = NumpySetBackend(set_tree)
+    with pytest.raises(ValueError):
+        MicroBatcher(backend, window_s=0.0)
+    with pytest.raises(ValueError):
+        MicroBatcher(backend, window_s=0.01, max_batch=1)
+    with pytest.raises(ValueError):
+        MicroBatcher(object(), window_s=0.01)  # no decide_nodes_batch
+
+
+def test_span_uniformity_under_batching(set_tree):
+    """graftlens invariant under lever (i): k coalesced requests each
+    still record exactly one sample per phase — batch_wait included —
+    and the batch_wait phase carries real window time while the shared
+    forward is charged once per member."""
+    policy = ExtenderPolicy(NumpySetBackend(set_tree), FrozenTelemetry())
+    policy.batcher = MicroBatcher(policy.backend, window_s=0.1,
+                                  max_batch=4)
+    args = {"nodenames": [f"{'aws' if i % 2 else 'azure'}-n{i}"
+                          for i in range(8)], "pod": {}}
+    k = 4
+    threads = [threading.Thread(target=policy.filter, args=(dict(args),))
+               for _ in range(k)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stats = policy.statistics()
+    assert set(stats["phases"]) == set(PHASES)
+    for phase in PHASES:
+        assert stats["phases"][phase]["lifetime_count"] == k
+    # Everyone waited some window time; the forward phase carries the
+    # shared batch forward, not k full windows.
+    assert stats["phases"]["batch_wait"]["lifetime_mean_ms"] > 0
+    assert stats["fastpath"]["batch"]["coalesced_total"] >= 2
+
+
+def test_batch_wait_records_zero_without_batching(set_tree):
+    """Count-uniformity with the lever OFF: batch_wait still records one
+    (zero-cost) sample per decision, so decisionview's reconciliation
+    row closes on pre-batching serve configs too."""
+    policy = ExtenderPolicy(NumpySetBackend(set_tree), FrozenTelemetry())
+    args = {"nodenames": ["aws-0", "azure-1"], "pod": {}}
+    for _ in range(3):
+        policy.filter(dict(args))
+    assert policy.phase_stats["batch_wait"].histogram()[2] == 3
+    assert policy.phase_stats["batch_wait"].histogram()[1] == 0.0
+
+
+def test_batched_aot_forward_is_bitwise(set_tree):
+    """THE lever-(i) exact-agreement bar: the batched AOT executable
+    (jax.vmap of the single-request apply) returns per-row logits
+    BITWISE-identical to the single-request AOT executable."""
+    backend = JaxSetAOTBackend(set_tree, warm_counts=(16,),
+                               warm_batches=((3, 16),))
+    rng = np.random.default_rng(2)
+    batch = rng.uniform(0, 1, (3, 16, 6)).astype(np.float32)
+    assert backend.has_batch_executable(3, 16)
+    actions, logits = backend.decide_nodes_batch(batch)
+    for i in range(3):
+        a_ref, l_ref = backend.decide_nodes(batch[i])
+        assert int(actions[i]) == a_ref
+        assert np.array_equal(logits[i], l_ref)  # bitwise
+
+
+def test_batched_aot_uncompiled_shape_serves_host_then_compiles(set_tree):
+    backend = JaxSetAOTBackend(set_tree, warm_counts=(8,))
+    rng = np.random.default_rng(3)
+    batch = rng.uniform(0, 1, (2, 8, 6)).astype(np.float32)
+    assert not backend.has_batch_executable(2, 8)
+    actions, logits = backend.decide_nodes_batch(batch)  # host fallback
+    for i in range(2):
+        a_ref, l_ref = backend._fallback.decide_nodes(batch[i])
+        assert int(actions[i]) == a_ref
+        np.testing.assert_allclose(logits[i], l_ref, atol=1e-5)
+    deadline = time.monotonic() + 60.0
+    while (not backend.has_batch_executable(2, 8)
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    assert backend.has_batch_executable(2, 8)  # background compile landed
+
+
+def test_torch_batch_agrees_with_sequential(set_tree):
+    torch = pytest.importorskip("torch")
+    del torch
+    from rl_scheduler_tpu.scheduler.set_backend import TorchSetBackend
+
+    backend = TorchSetBackend(set_tree)
+    rng = np.random.default_rng(4)
+    batch = rng.uniform(0, 1, (3, 12, 6)).astype(np.float32)
+    actions, logits = backend.decide_nodes_batch(batch)
+    for i in range(3):
+        a_ref, l_ref = backend.decide_nodes(batch[i])
+        assert int(actions[i]) == a_ref
+        np.testing.assert_allclose(logits[i], l_ref, atol=1e-5)
+
+
+# ------------------------------------------------------------- int8 native
+
+
+def _int8_backend(set_tree):
+    try:
+        return Int8NativeSetBackend(set_tree)
+    except Exception as e:  # noqa: BLE001 - no toolchain in this env
+        pytest.skip(f"native toolchain unavailable: {e}")
+
+
+def test_int8_agreement_corpus_clears_the_gate(set_tree):
+    """Lever (ii) exact-agreement bar: >= 99.5% top-1 agreement vs fp32
+    on the seeded candidate corpus (serving-size AND fleet-size Ns)."""
+    q8 = _int8_backend(set_tree)
+    reference = NumpySetBackend(set_tree)
+    agreement, ok = check_int8_agreement(q8, reference, node_feat=6,
+                                         node_counts=(8, 64, 256))
+    assert ok and agreement >= INT8_AGREEMENT_MIN
+
+
+def test_int8_scales_recorded_per_tensor(set_tree):
+    """Quantize-at-load contract: one recorded scale per dense tensor
+    (embed + 6 per block x depth 2 = 13), all positive."""
+    q8 = _int8_backend(set_tree)
+    assert len(q8.quantization_scales) == 13
+    assert all(s > 0 for s in q8.quantization_scales)
+
+
+def test_make_set_backend_int8_gates_and_stamps(set_tree):
+    try:
+        backend, fell_back = make_set_backend("native-int8", set_tree)
+    except ValueError as e:
+        pytest.skip(f"int8 backend unavailable: {e}")
+    assert not fell_back
+    assert backend.name == "native-int8"
+    assert backend.agreement >= INT8_AGREEMENT_MIN
+    assert backend.reference is not None and backend.node_feat == 6
+
+
+def test_make_set_backend_int8_refuses_low_agreement(set_tree, monkeypatch):
+    _int8_backend(set_tree)  # skip when no toolchain
+    import rl_scheduler_tpu.scheduler.fastpath as fastpath_mod
+
+    monkeypatch.setattr(fastpath_mod, "check_int8_agreement",
+                        lambda *a, **k: (0.5, False))
+    with pytest.raises(ValueError, match="below"):
+        make_set_backend("native-int8", set_tree)
+
+
+def test_fastpath_verify_reruns_int8_agreement(set_tree, monkeypatch):
+    """Flush-on-promote satellite: the gate RE-RUNS the agreement check
+    on the (possibly new) checkpoint; a failing re-check returns
+    ok=False — the rollout refuses the promote rather than silently
+    serving."""
+    try:
+        backend, _ = make_set_backend("native-int8", set_tree)
+    except ValueError as e:
+        pytest.skip(f"int8 backend unavailable: {e}")
+    policy = ExtenderPolicy(backend, FrozenTelemetry())
+    out = policy.fastpath_verify()
+    assert out["ok"] and out["agreement"] >= INT8_AGREEMENT_MIN
+    import rl_scheduler_tpu.scheduler.fastpath as fastpath_mod
+
+    monkeypatch.setattr(fastpath_mod, "check_int8_agreement",
+                        lambda *a, **k: (0.4, False))
+    out = policy.fastpath_verify()
+    assert not out["ok"] and out["agreement"] == 0.4
+
+
+def test_check_int8_agreement_fault_site():
+    """The fastpath.agree chaos seam fires INSIDE the check — a caller
+    that cannot verify must refuse, never default to passing."""
+    plan = FaultPlan(schedule={"fastpath.agree": (1,)})
+    with pytest.raises(RuntimeError):
+        check_int8_agreement(None, None, 6, fault_plan=plan)
+    assert plan.fired["fastpath.agree"] == 1
+
+
+def test_agreement_corpus_is_deterministic():
+    a = agreement_corpus(6, node_counts=(8, 64), samples=6, seed=3)
+    b = agreement_corpus(6, node_counts=(8, 64), samples=6, seed=3)
+    assert len(a) == 6 and [o.shape[0] for o in a] == [8, 64, 8, 64, 8, 64]
+    assert all(np.array_equal(x, y) for x, y in zip(a, b))
+    c = agreement_corpus(6, node_counts=(8, 64), samples=6, seed=4)
+    assert not np.array_equal(a[0], c[0])
+
+
+# --------------------------------------------------- build_policy / stats
+
+
+def test_build_policy_refuses_levers_on_wrong_family(tmp_path):
+    with pytest.raises(ValueError, match="micro-batching"):
+        build_policy(backend="greedy", run_root=str(tmp_path),
+                     batch_window_ms=2.0)
+    with pytest.raises(ValueError, match="score cache"):
+        build_policy(backend="greedy", run_root=str(tmp_path),
+                     score_cache_epoch_s=15.0)
+
+
+def test_fastpath_metric_lines_exposition(set_tree):
+    policy = ExtenderPolicy(NumpySetBackend(set_tree), FrozenTelemetry())
+    policy.score_cache = ScoreCache(epoch_s=3600.0)
+    policy.batcher = MicroBatcher(policy.backend, window_s=0.002)
+    policy.decide_set(_clouds(), 0.25)
+    policy.decide_set(_clouds(), 0.25)
+    lines = fastpath_metric_lines("rl_scheduler_extender",
+                                  policy.fastpath_snapshot())
+    text = "\n".join(lines)
+    assert "rl_scheduler_extender_score_cache_hits_total 1" in text
+    assert "rl_scheduler_extender_score_cache_misses_total 1" in text
+    assert "rl_scheduler_extender_batch_requests_total 1" in text
+    # Levers off -> no lines at all (byte-identical scrape).
+    bare = ExtenderPolicy(NumpySetBackend(set_tree), FrozenTelemetry())
+    assert fastpath_metric_lines("p", bare.fastpath_snapshot()) == []
+    assert "_score_cache_" not in bare.metrics_text()
+
+
+def test_pool_sum_fastpath_merges_counters():
+    from rl_scheduler_tpu.scheduler.pool import sum_fastpath
+
+    def snap(hits, misses, batches, occupancy, agreement):
+        return {"stats": {"fastpath": {
+            "cache": {"hits_total": hits, "misses_total": misses,
+                      "invalidations_total": 1, "entries": 2},
+            "batch": {"requests_total": batches * 2,
+                      "batches_total": batches, "coalesced_total": 2,
+                      "max_occupancy": 3, "mean_occupancy": occupancy},
+            "int8": {"agreement": agreement, "scales_recorded": 13},
+        }}}
+
+    merged = sum_fastpath([snap(8, 2, 4, 2.0, 0.999),
+                           snap(2, 8, 1, 1.0, 0.996)])
+    assert merged["cache"]["hits_total"] == 10
+    assert merged["cache"]["misses_total"] == 10
+    assert merged["cache"]["hit_rate"] == 0.5
+    assert merged["batch"]["batches_total"] == 5
+    assert merged["batch"]["mean_occupancy"] == pytest.approx(1.8)
+    assert merged["int8"]["agreement"] == 0.996  # pool shows the WORST
+    assert sum_fastpath([{"stats": {}}]) is None
+
+
+# ------------------------------------------------------------------- bench
+
+
+def test_bench_soak_emits_retries_unconditionally(set_tree):
+    """Round-13 small fix: the soak's JSON line carries the retry
+    counter with or without --promote-at, so lever A/B lines are
+    field-comparable with rollout-drill lines."""
+    import pathlib
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                           / "loadgen"))
+    import extender_bench
+
+    from rl_scheduler_tpu.scheduler.extender import make_server
+
+    policy = ExtenderPolicy(NumpySetBackend(set_tree), FrozenTelemetry())
+    server = make_server(policy, "127.0.0.1", 0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        port = server.server_address[1]
+        out = extender_bench.main(["--port", str(port), "--duration",
+                                   "0.4", "--threads", "2", "--nodes",
+                                   "4", "--warmup", "2"])
+        assert out["retries"] == 0 and "phases" not in out
+        out = extender_bench.main(["--port", str(port), "--requests",
+                                   "4", "--threads", "2", "--nodes",
+                                   "4", "--warmup", "1"])
+        assert out["retries"] == 0
+    finally:
+        server.shutdown()
+
+
+def test_levers_matrix_smoke(tmp_path):
+    """The --levers matrix: interleaved per-lever pools, one ledger line
+    per lever with the `lever` shape key, cache lever actually hitting."""
+    import os
+
+    if not hasattr(os, "fork"):
+        pytest.skip("graftserve pools require fork")
+    import pathlib
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                           / "loadgen"))
+    import extender_bench
+
+    history = tmp_path / "hist.jsonl"
+    args = types.SimpleNamespace(
+        levers="off,cache", nodes=8, threads=2, workers=1, rounds=1,
+        duration=1.0, batch_window_ms=1.5, cache_epoch_s=3600.0,
+        history=str(history))
+    lines = extender_bench.run_levers_matrix(args)
+    assert [ln["lever"] for ln in lines] == ["off", "cache"]
+    for line in lines:
+        assert line["mode"] == "levers"
+        assert line["failures"] == 0 and line["retries"] == 0
+        assert line["req_per_sec"] > 0
+    cache_line = lines[1]
+    assert cache_line["fastpath"]["cache"]["hits_total"] > 0
+    ledger = [json.loads(ln) for ln in
+              history.read_text().splitlines() if ln.strip()]
+    assert [ln["lever"] for ln in ledger] == ["off", "cache"]
+    # check-history gates per lever: a fast cache row is never the
+    # baseline an off row is judged against.
+    from tools.decisionview import check_history
+
+    assert check_history(ledger) == []

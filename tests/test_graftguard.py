@@ -1159,3 +1159,26 @@ class TestGraftrollChaos:
                                 tp._filter_args(0))["nodenames"]) == 1
         finally:
             pool.shutdown()
+
+    def test_fastpath_agree_fault_refuses_promote(self, tmp_path):
+        """graftfwd's `fastpath.agree` site: a failing int8-agreement
+        re-check at the promote gate must REFUSE the promote (rollback
+        to the incumbent), never fall through to serving the candidate
+        — quantized or silently-fp32 (docs/serving.md)."""
+        plan = FaultPlan(schedule={"fastpath.agree": (1,)})
+        tp, pool, candidate = self._rollout_pool_pieces(tmp_path, plan)
+        try:
+            status = self._promote_and_wait(tp, pool, candidate)
+            assert plan.fired["fastpath.agree"] == 1
+            # Rollback replaces run gate=False: the site is consulted
+            # exactly once — by the promote-path gate that failed.
+            assert plan.calls["fastpath.agree"] == 1
+            assert status["rollbacks_total"] == 1
+            assert status["promotions_total"] == 0
+            assert status["generation"] == 0
+            assert "fastpath agreement check failed" in status["last_error"]
+            assert all(s["generation"] == 0 for s in pool.scrape())
+            assert len(tp._post(pool.port, "/filter",
+                                tp._filter_args(0))["nodenames"]) == 1
+        finally:
+            pool.shutdown()

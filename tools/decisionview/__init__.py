@@ -50,7 +50,10 @@ SCHEMA_VERSION = 1
 MIN_PHASE_COVERAGE = 0.90
 # Hot-path order for the decomposition table (extender.PHASES, not
 # imported: decisionview must stay stdlib-only and runnable anywhere).
-PHASE_ORDER = ("parse", "observe", "forward", "marshal", "trace")
+# graftfwd added batch_wait (micro-batch admission window) between
+# observe and forward; pre-graftfwd snapshots simply lack the phase.
+PHASE_ORDER = ("parse", "observe", "batch_wait", "forward", "marshal",
+               "trace")
 
 
 # ------------------------------------------------------------------ inputs
@@ -208,6 +211,11 @@ def build_report(stats: dict | None = None, records: list | None = None,
             "p50_ms": latency.get("p50_ms"),
             "p99_ms": latency.get("p99_ms"),
         }
+        if stats.get("fastpath"):
+            # graftfwd lever counters (score cache / batcher / int8) —
+            # passed through for the report and the cache-hit-rate
+            # floor (check_budgets).
+            out["fastpath"] = stats["fastpath"]
     if records is not None:
         out["generations"] = {
             str(gen): {"count": count, "mean_ms": mean, "p95_ms": p95,
@@ -296,11 +304,18 @@ def check_budgets(report: dict, budgets: dict) -> list:
     tolerance = float(budgets.get("tolerance_pct", 25.0))
     violations = []
     phases = report.get("phases") or {}
+    # Phases a budget file marks optional may be ABSENT without failing
+    # (still budget-checked when present): batch_wait only exists on
+    # graftfwd-era builds, and `--check` against a still-deployed older
+    # pool mid-rollout must not read the version skew as a broken span.
+    optional = set(budgets.get("optional_phases") or ())
     for phase, budget_ms in sorted((budgets.get("phases") or {}).items()):
         entry = phases.get(phase)
         mean = entry.get("mean_ms") if entry else None
         limit = float(budget_ms) * (1.0 + tolerance / 100.0)
         if mean is None:
+            if phase in optional:
+                continue
             violations.append(
                 f"phase {phase!r}: absent from the report (budget "
                 f"{budget_ms} ms) — spans disabled or a renamed phase?")
@@ -317,6 +332,24 @@ def check_budgets(report: dict, budgets: dict) -> list:
                 f"end-to-end is below the "
                 f"{rec.get('min_coverage', MIN_PHASE_COVERAGE) * 100:.0f}% "
                 "bar — a span is missing time")
+    # graftfwd: the cache-hit-rate floor. Only binds when the snapshot
+    # actually ran a score cache with enough traffic to judge — a
+    # cache-off serve config is a legitimate deployment, not a
+    # regression; a cache-ON one whose hit rate collapsed (epoch
+    # misconfigured, keys churning) is.
+    floor = budgets.get("min_cache_hit_rate")
+    cache = (report.get("fastpath") or {}).get("cache")
+    if floor is not None and cache:
+        requests = (cache.get("hits_total", 0)
+                    + cache.get("misses_total", 0))
+        min_requests = int(budgets.get("cache_floor_min_requests", 20))
+        hit_rate = cache.get("hit_rate")
+        if requests >= min_requests and hit_rate is not None \
+                and hit_rate < float(floor):
+            violations.append(
+                f"score-cache hit rate {hit_rate:.3f} over {requests} "
+                f"requests is below the {float(floor):.3f} floor — "
+                "epoch/key churn is defeating the cache")
     return violations
 
 
@@ -324,16 +357,18 @@ def check_history(history: list, tolerance_pct: float = 25.0) -> list:
     """Violation strings for ``--check-history``: the newest bench round
     must keep ``req_per_sec`` within ``tolerance_pct`` below — and
     ``client_p50_ms`` within ``tolerance_pct`` above — the BEST prior
-    round at the same (workers, nodes, concurrency) shape. Fewer than
-    two comparable rounds passes vacuously (the ledger is just
-    starting)."""
+    round at the same (workers, nodes, concurrency, lever) shape (the
+    ``lever`` key is graftfwd's matrix dimension; rows without it gate
+    against each other as before — an off-lever row must not be judged
+    against a cache-hit row). Fewer than two comparable rounds passes
+    vacuously (the ledger is just starting)."""
     if len(history) < 2:
         return []
     newest = history[-1]
-    shape = tuple(newest.get(k) for k in ("workers", "nodes", "concurrency"))
+    shape_keys = ("workers", "nodes", "concurrency", "lever")
+    shape = tuple(newest.get(k) for k in shape_keys)
     priors = [r for r in history[:-1]
-              if tuple(r.get(k) for k in ("workers", "nodes",
-                                          "concurrency")) == shape]
+              if tuple(r.get(k) for k in shape_keys) == shape]
     violations = []
     tol = tolerance_pct / 100.0
     best_rps = max((r.get("req_per_sec") for r in priors
