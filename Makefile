@@ -11,7 +11,7 @@ TRACE ?= tests/fixtures/traceview/fixture.trace.json.gz
 .PHONY: lint lint-json test tier1 trace-summary obs chaos chaos-soak \
         serve-pool serve-soak rollout-drill eval-matrix scenario-bench \
         study study-list overlap-bench serve-report slo-check span-ab \
-        fastpath-ab
+        fastpath-ab loop-drill loop-soak
 
 lint:
 	$(PY) -m tools.graftlint --check
@@ -68,6 +68,21 @@ serve-soak:
 # replaying every decision.
 rollout-drill:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_pool.py -q -k rollout_drill
+
+# graftloop drill (docs/serving.md "closing the loop"), container-safe
+# and in tier-1: a 2-worker pool serves bench traffic continuously while
+# one loop iteration snapshots the live trace, compiles the trace_replay
+# scenario (round-trip pinned), retrains from the incumbent, wins the
+# paired-seed verdict, and hot-promotes through the canary gates with
+# zero failed requests — including a SIGKILLed loop resuming from its
+# ledger, a regressing candidate rolling back, and the refusal paths.
+# `loop-soak` adds the slow in-process retrain+verdict pass.
+loop-drill:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_loopback.py -q \
+		-m 'not slow' -k loop_drill
+
+loop-soak:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_loopback.py -q
 
 # graftlens (docs/observability.md): the serving perf report with
 # regression gating — phase decomposition, per-generation latency, SLO

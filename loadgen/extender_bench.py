@@ -34,7 +34,15 @@ the zero-failed-requests acceptance criterion of the rollback drill is
 one command: a phase with failures > 0 means the rolling restart dropped
 traffic (docs/serving.md).
 
-Stdlib-only (no locust dependency) so it runs anywhere the extender does.
+``--replay-trace DIR`` (graftloop) swaps the synthetic payloads for the
+recorded ones: one request per logged decision, candidate clouds and pod
+requests rebuilt from the trace's schema-2 fields, probes excluded — so
+a serving A/B measures the traffic the pool actually served. The result
+line carries a ``replay`` tag.
+
+Stdlib-only for the synthetic modes (no locust dependency) so it runs
+anywhere the extender does; ``--replay-trace`` imports the repo's
+trace-log reader.
 """
 
 from __future__ import annotations
@@ -65,6 +73,65 @@ def make_payload(i: int, num_nodes: int = 2) -> bytes:
             "nodes": {"items": items},
         }
     ).encode()
+
+
+def load_replay_payloads(trace_dir: str, node_capacity_cores: float = 4.0,
+                         limit: int | None = None) -> tuple:
+    """graftloop replay mode: ``(payloads, report)`` — one prebuilt
+    request body per RECORDED decision, rebuilt from the trace's
+    schema-2 replay fields (``clouds`` candidate layout + ``pod_cpu``
+    request fraction), probes excluded, in the merged timestamp order
+    the pool actually served them. Serving A/Bs then run against real
+    logged traffic instead of synthetic payloads. Records without the
+    ``clouds`` field (schema-1, flat-family fail-opens) are skipped and
+    counted — a replay must tolerate a mixed-era trace dir."""
+    from rl_scheduler_tpu.scheduler.tracelog import (
+        clouds_from_token,
+        iter_trace_merged,
+    )
+
+    payloads = []
+    skipped = probes = 0
+    counts: dict = {}
+    for record in iter_trace_merged(trace_dir):
+        if record.get("endpoint") == "probe":
+            probes += 1
+            continue
+        clouds = clouds_from_token(record.get("clouds"))
+        if not clouds:
+            skipped += 1
+            continue
+        items = [
+            {"metadata": {"name": f"{cloud or 'node'}-r{j}",
+                          **({"labels": {"cloud": cloud}} if cloud
+                             else {})}}
+            for j, cloud in enumerate(clouds)
+        ]
+        pod: dict = {"metadata": {"name": f"replay-pod-{len(payloads)}"}}
+        pod_cpu = record.get("pod_cpu")
+        if pod_cpu is not None:
+            # Reissue the recorded request fraction as the k8s quantity
+            # the extender will parse back to it (millicores of the
+            # serve config's node capacity).
+            millis = max(int(round(pod_cpu * node_capacity_cores * 1e3)), 1)
+            pod["spec"] = {"containers": [{"resources": {
+                "requests": {"cpu": f"{millis}m"}}}]}
+        payloads.append(json.dumps(
+            {"pod": pod, "nodes": {"items": items}}).encode())
+        counts[len(clouds)] = counts.get(len(clouds), 0) + 1
+        if limit is not None and len(payloads) >= limit:
+            break
+    if not payloads:
+        raise SystemExit(
+            f"--replay-trace {trace_dir}: no replayable decision records "
+            f"({skipped} without candidate-cloud fields, {probes} "
+            "probes) — the trace must carry schema-2 records "
+            "(clouds/pod_cpu; serve with a current extender)")
+    modal_nodes = max(counts, key=lambda k: counts[k])
+    report = {"trace_records": len(payloads), "skipped": skipped,
+              "probes_excluded": probes, "nodes": modal_nodes,
+              "capacity_cores": node_capacity_cores}
+    return payloads, report
 
 
 def one_request(base: str, i: int, num_nodes: int = 2,
@@ -112,7 +179,7 @@ def _request_with_retry(base: str, i: int, num_nodes: int, payload: bytes,
 
 
 def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
-          promote_at: float | None = None):
+          promote_at: float | None = None, payloads: list | None = None):
     """Duration-based load: each thread loops until the deadline.
 
     Payloads are prebuilt once (at N=1024 a node list is ~100 KB of
@@ -130,7 +197,8 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
     A/B lines stay field-comparable with rollout-drill lines; ``phases``
     is ``None`` without a promote.
     """
-    payloads = [make_payload(i, num_nodes) for i in range(16)]
+    if payloads is None:
+        payloads = [make_payload(i, num_nodes) for i in range(16)]
     connect_retries = 3 if promote_at is not None else 0
     t_start = time.perf_counter()
     deadline = t_start + duration_s
@@ -443,6 +511,29 @@ def main(argv: list[str] | None = None) -> dict:
                         "root) so rounds accumulate a durable "
                         "trajectory; `tools/decisionview --check-history`"
                         " gates the newest round against the priors")
+    p.add_argument("--replay-trace", default=None, metavar="DIR",
+                   help="graftloop replay mode: drive the bench from a "
+                        "recorded trace dir — one request per logged "
+                        "decision (candidate-cloud layout + pod request "
+                        "rebuilt from the schema-2 fields, probes "
+                        "excluded, merged timestamp order), cycled round-"
+                        "robin. Serving A/Bs run against real logged "
+                        "traffic instead of synthetic payloads; the "
+                        "result line carries a `replay` tag and ignores "
+                        "--nodes (the trace defines the node sets)")
+    p.add_argument("--replay-capacity-cores", type=float, default=None,
+                   metavar="CORES",
+                   help="replay mode: node capacity the SERVER was "
+                        "started with (--node-capacity-cores; default = "
+                        "the extender's default). Recorded pod fractions "
+                        "re-issue as millicore quantities of this "
+                        "capacity, so a mismatch silently distorts every "
+                        "replayed pod request")
+    p.add_argument("--replay-limit", type=int, default=0, metavar="N",
+                   help="replay mode: prebuild at most N payloads from "
+                        "the trace (0 = all). A long-serving pool's "
+                        "trace dir can hold millions of records; the "
+                        "bench cycles whatever is loaded round-robin")
     p.add_argument("--levers", default=None, metavar="L1,L2,...",
                    help="graftfwd matrix mode: self-host one pool per "
                         "lever per round (off/batch/int8/cache/all, "
@@ -471,7 +562,37 @@ def main(argv: list[str] | None = None) -> dict:
             args.duration = 10.0
         if args.promote_at is not None:
             p.error("--levers and --promote-at are separate drills")
+        if args.replay_trace is not None:
+            p.error("--levers self-hosts synthetic pools; --replay-trace "
+                    "drives an existing server from a recorded trace — "
+                    "separate modes")
         return run_levers_matrix(args)
+    replay_payloads = replay_report = None
+    if args.replay_trace is not None:
+        import pathlib
+        import sys as _sys
+
+        _sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+        capacity = args.replay_capacity_cores
+        if capacity is None:
+            from rl_scheduler_tpu.scheduler.extender import (
+                DEFAULT_NODE_CAPACITY_CORES,
+            )
+
+            capacity = DEFAULT_NODE_CAPACITY_CORES
+        replay_payloads, replay_report = load_replay_payloads(
+            args.replay_trace, node_capacity_cores=capacity,
+            limit=args.replay_limit or None)
+        args.nodes = replay_report["nodes"]
+        print(f"replay: {replay_report['trace_records']} recorded "
+              f"decisions from {args.replay_trace} "
+              f"(modal N={args.nodes}; {replay_report['skipped']} "
+              "skipped)", file=sys.stderr)
+        if args.replay_limit and \
+                replay_report["trace_records"] >= args.replay_limit:
+            print(f"replay: capped at --replay-limit {args.replay_limit} "
+                  "payloads; later trace records were not loaded",
+                  file=sys.stderr)
     if args.requests < 1:
         p.error("--requests must be >= 1")
     if args.duration is not None and args.duration <= 0:
@@ -491,7 +612,9 @@ def main(argv: list[str] | None = None) -> dict:
                if args.control_port is not None else base)
 
     for i in range(args.warmup):
-        one_request(base, i, args.nodes)
+        one_request(base, i, args.nodes,
+                    payload=replay_payloads[i % len(replay_payloads)]
+                    if replay_payloads else None)
     # Scope the server-side percentiles to THIS run: the latency ring
     # holds 4096 entries, so without a reset the reported p50/p99 mix in
     # the preceding run's traffic (a round-4 measurement bug). Against a
@@ -525,7 +648,7 @@ def main(argv: list[str] | None = None) -> dict:
             promote_thread.start()
         latencies, wall, failures, phases, retries = _soak(
             base, args.duration, args.threads, args.nodes,
-            promote_at=args.promote_at)
+            promote_at=args.promote_at, payloads=replay_payloads)
         if promote_thread is not None:
             promote_thread.join(timeout=60.0)
             promote = result_box
@@ -538,7 +661,10 @@ def main(argv: list[str] | None = None) -> dict:
         t_start = time.perf_counter()
         with concurrent.futures.ThreadPoolExecutor(args.threads) as pool:
             latencies = sorted(pool.map(
-                lambda i: one_request(base, i, args.nodes),
+                lambda i: one_request(
+                    base, i, args.nodes,
+                    payload=replay_payloads[i % len(replay_payloads)]
+                    if replay_payloads else None),
                 range(args.requests)))
         wall = time.perf_counter() - t_start
 
@@ -580,6 +706,11 @@ def main(argv: list[str] | None = None) -> dict:
         "server_p99_ms": server_latency.get("p99_ms"),
         "backend": server_stats.get("backend"),
     }
+    if replay_report is not None:
+        # The `replay` tag: this round's traffic was recorded, not
+        # synthetic — history gating treats it as its own shape via the
+        # modal `nodes` it already carries.
+        out["replay"] = replay_report
     if phases is not None:
         out["promote_at_s"] = args.promote_at
         out["phases"] = phases

@@ -757,6 +757,7 @@ def ppo_train(
     preemption: Any | None = None,
     on_preempt: Callable[[int, RunnerState], None] | None = None,
     on_eval: Callable[[int, RunnerState, dict], None] | None = None,
+    warm_start_params: Any | None = None,
 ):
     """Host-side training loop: jitted update per iteration + logging hooks.
 
@@ -835,6 +836,16 @@ def ppo_train(
     ``preemption``/``on_preempt``: see ``run_train_loop`` — a
     ``PreemptionGuard`` polled at dispatch boundaries; on a stop the loop
     flushes, force-checkpoints, fires ``on_preempt`` and returns.
+
+    ``warm_start_params`` (graftloop fine-tune-from-trace,
+    ``train_ppo --warm-start``): initialize the runner's PARAMS from
+    another run's checkpoint while everything else — optimizer state,
+    env state, RNG, iteration count — starts fresh at iteration 0. This
+    is deliberately NOT ``restore``: a fine-tune is a new run on a new
+    workload whose weights happen to start trained, so the optimizer
+    must not carry the incumbent's moments and the resume guards must
+    not demand the incumbent's scenario. Mutually exclusive with
+    ``restore`` (which would overwrite the warm start anyway).
     """
     bundle = env if isinstance(env, EnvBundle) else multi_cloud_bundle(env)
     if mesh is not None and scope is not None:
@@ -922,6 +933,38 @@ def ppo_train(
     if restore is not None and not full_state:
         key = jax.random.fold_in(key, restore[1])
     runner = init_fn(key)
+    if warm_start_params is not None:
+        if restore is not None:
+            raise ValueError(
+                "warm_start_params with restore: a resume already has "
+                "weights — pick one initialization source")
+        # Copy like the restore path: the jitted update donates buffers.
+        params = jax.tree.map(lambda x: jnp.array(x, copy=True),
+                              warm_start_params)
+        want = jax.tree_util.tree_structure(runner.params)
+        got = jax.tree_util.tree_structure(params)
+        if want != got:
+            raise ValueError(
+                "warm_start_params tree structure does not match this "
+                "run's network (different env family / policy "
+                f"architecture?): checkpoint {got} vs configured {want}")
+        mismatched = [
+            f"{jax.tree_util.keystr(path)}: {jnp.shape(w)} vs {v.shape}"
+            for (path, w), v in zip(
+                jax.tree_util.tree_leaves_with_path(params),
+                jax.tree_util.tree_leaves(runner.params))
+            if tuple(jnp.shape(w)) != tuple(v.shape)]
+        if mismatched:
+            raise ValueError(
+                "warm_start_params leaf shapes do not match this run's "
+                "network (different width/heads?): "
+                + "; ".join(mismatched[:4]))
+        runner = runner._replace(params=params)
+        if cfg.overlap_collect:
+            # The pipeline's behavior slot must start from the warm
+            # weights too, exactly like a warm restart on resume.
+            runner = runner._replace(
+                collect_params=jax.tree.map(jnp.copy, params))
     if restore is not None:
         tree, start_iteration = restore
         # Copy the restored leaves: the jitted update donates the runner's

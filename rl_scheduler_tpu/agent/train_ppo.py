@@ -348,6 +348,17 @@ def main(argv: list[str] | None = None) -> Path:
                    help="calibrate the simulator's fault_prob from the "
                         "Locust stats exports in data/ (failure fraction "
                         "across clouds; SURVEY.md §5.3)")
+    p.add_argument("--warm-start", default=None, metavar="RUN_DIR",
+                   help="graftloop fine-tune: initialize the policy "
+                        "PARAMS from another run's newest verified "
+                        "checkpoint (graftguard-verified restore), then "
+                        "train fresh from iteration 0 — new optimizer "
+                        "state, new env/scenario, new RNG. Unlike "
+                        "--resume this crosses scenarios on purpose "
+                        "(retrain-on-what-you-serve warm-starts the "
+                        "incumbent onto the compiled trace workload); "
+                        "the source run dir is recorded in checkpoint "
+                        "meta as warm_start provenance")
     p.add_argument("--resume", action="store_true",
                    help="continue from the latest checkpoint in the run dir "
                         "(requires --run-name of an existing run)")
@@ -504,6 +515,15 @@ def main(argv: list[str] | None = None) -> Path:
         raise SystemExit(
             "--resume and --resume-best name different restore sources "
             "(latest vs best-in-training-eval); pick one")
+    if args.warm_start is not None and (args.resume or args.resume_best):
+        raise SystemExit(
+            "--warm-start initializes a FRESH run from another run's "
+            "params; --resume/--resume-best continue THIS run — pick one")
+    if args.warm_start is not None and (args.dp != 1 or args.sp > 1
+                                        or args.tp > 1):
+        raise SystemExit(
+            "--warm-start is single-chip for now (the sharded init paths "
+            "own their param layout); drop --dp/--sp/--tp")
 
     scenario = None
     if args.scenario is not None:
@@ -516,7 +536,8 @@ def main(argv: list[str] | None = None) -> Path:
         env_families = {
             "multi_cloud": ("bursty_diurnal", "price_spike"),
             "cluster_set": ("bursty_diurnal", "heterogeneous", "churn",
-                            "price_spike", "domain_random"),
+                            "price_spike", "domain_random",
+                            "trace_replay"),
             "cluster_graph": ("price_spike",),
         }
         allowed = env_families.get(args.env, ())
@@ -1248,6 +1269,41 @@ def main(argv: list[str] | None = None) -> Path:
               f"({'best-eval checkpoint' if args.resume_best else 'latest'}; "
               f"checkpoints in {run_dir})")
 
+    warm_start_params = None
+    if args.warm_start is not None:
+        # graftloop fine-tune-from-trace: params-only init from another
+        # run's newest VERIFIED checkpoint (graftguard digests; corrupt
+        # steps quarantine + fall back inside the manager). Architecture
+        # mismatches fail with the meta-level message where possible;
+        # ppo_train's tree-structure/shape check backstops the rest.
+        from rl_scheduler_tpu.utils.checkpoint import load_policy_params
+
+        src = Path(args.warm_start)
+        if not src.is_dir():
+            raise SystemExit(f"--warm-start: {src} is not a run directory")
+        try:
+            warm_start_params, src_meta = load_policy_params(src)
+        except Exception as e:  # noqa: BLE001 — orbax raises its own zoo;
+            # every restore failure here means the same thing to the user
+            raise SystemExit(
+                f"--warm-start: could not restore verified params from "
+                f"{src}: {e}")
+        src_env = src_meta.get("env")
+        if src_env is not None and src_env != args.env:
+            raise SystemExit(
+                f"--warm-start: {src} was trained on --env {src_env}; "
+                f"its params cannot initialize an {args.env!r} policy")
+        src_heads = src_meta.get("num_heads")
+        net_heads = getattr(net, "num_heads", None)
+        if (src_heads is not None and net_heads is not None
+                and src_heads != net_heads):
+            raise SystemExit(
+                f"--warm-start: {src} uses num_heads={src_heads}; pass "
+                f"--num-heads {src_heads}")
+        print(f"Warm start: params from {src} "
+              f"(env {src_env}, scenario {src_meta.get('scenario')}) — "
+              "fresh optimizer/env/RNG from iteration 0")
+
     from rl_scheduler_tpu.agent.loop import (
         TensorBoardLogger,
         make_eval_log_fn,
@@ -1323,7 +1379,12 @@ def main(argv: list[str] | None = None) -> Path:
                 # the full-state tree (the in-flight collect_params
                 # slot below). Legacy checkpoints (no key) restore as
                 # overlap-off.
-                "overlap_collect": cfg.overlap_collect}
+                "overlap_collect": cfg.overlap_collect,
+                # graftloop provenance: which run's params initialized
+                # this one (None = random init). Not a resume guard —
+                # a fine-tune's continuation must not need the
+                # incumbent on disk.
+                "warm_start": args.warm_start}
     if scenario is not None:
         # Scenario provenance: evaluation rebuilds the same workload from
         # this record, the resume guard refuses a mismatch, and serving
@@ -1507,7 +1568,8 @@ def main(argv: list[str] | None = None) -> Path:
                           mesh=mesh, eval_net=eval_net,
                           scope=scope, observer=observer,
                           preemption=guard, on_preempt=on_preempt,
-                          on_eval=on_eval)
+                          on_eval=on_eval,
+                          warm_start_params=warm_start_params)
                 break
             except EvalStall as stall:
                 attempt += 1

@@ -127,6 +127,13 @@ class TimeStep(NamedTuple):
     step: jnp.ndarray
 
 
+# The default per-step pod request draw — named so reconstructions of
+# the base workload (the trace compiler's anti-forgetting mixture,
+# loopback/compile.py) reference the same range instead of restating it.
+DEFAULT_POD_CPU_LOW = 0.1
+DEFAULT_POD_CPU_HIGH = 0.4
+
+
 def make_params(
     num_nodes: int = 8,
     cost_weight: float = 0.6,
@@ -134,8 +141,8 @@ def make_params(
     reward_scale: float = 100.0,
     overload_penalty: float = 2.0,
     node_jitter: float = 0.1,
-    pod_cpu_low: float = 0.1,
-    pod_cpu_high: float = 0.4,
+    pod_cpu_low: float = DEFAULT_POD_CPU_LOW,
+    pod_cpu_high: float = DEFAULT_POD_CPU_HIGH,
     drain_rate: float = 0.85,
     data_path: str | None = None,
     max_steps: int | None = None,

@@ -1,0 +1,84 @@
+"""graftloop — the continual-learning subsystem that closes the
+decision loop: trace → scenario → retrain → promote (ROADMAP item 1).
+
+The serving plane's two ends already existed — graftroll's durable
+decision trace (1a) and canary-gated hot rollout (1d) — joined only by
+a checkpoint path a human had to carry. graftloop is the middle:
+
+- ``compile.py``  — the trace→Scenario compiler (1b): snapshot a live
+  pool's trace dir, merge the per-worker streams by timestamp, and
+  compile the served telemetry rows + pod sizes into the new
+  ``trace_replay`` scenario family — bitwise-deterministic per
+  (snapshot, seed), round-trip-pinned through the real env.
+- ``retrain.py``  — fine-tune-from-trace jobs (1c): warm-start from the
+  incumbent's verified checkpoint, train on the compiled trace with a
+  seeded anti-forgetting mixture of the original workload, keep
+  best-eval, and grade the candidate vs the incumbent with a
+  paired-seed Wilson/sign-test verdict (graftstudy's statistics).
+- ``orchestrator.py`` + ``python -m rl_scheduler_tpu.loopback`` — one
+  resumable command: snapshot, compile, retrain, evaluate, and on a
+  ``confirmed_above`` verdict POST ``/promote`` to the live pool,
+  riding graftroll's canary/SLO gates and automatic rollback; every
+  stage lands in a SIGKILL-safe atomic ledger.
+
+Drills: ``make loop-drill`` (fast, tier-1) / ``make loop-soak`` (slow
+serving soak). Design doc: docs/serving.md "closing the loop".
+"""
+
+from rl_scheduler_tpu.loopback.compile import (
+    CompiledTrace,
+    RoundTripError,
+    TraceCompileError,
+    compile_trace,
+    compiled_tables,
+    snapshot_digest,
+    snapshot_trace,
+    trace_scenario_name,
+    usable_records,
+    verify_roundtrip,
+)
+from rl_scheduler_tpu.loopback.orchestrator import (
+    LoopLedger,
+    LoopLedgerMismatch,
+    LoopRunner,
+    LoopSpec,
+    fault_plan_from_env,
+    loop_spec_from_json,
+)
+from rl_scheduler_tpu.loopback.retrain import (
+    VERDICTS,
+    FinetuneSpec,
+    finetune_spec_from_json,
+    grade_pairs,
+    incumbent_meta,
+    run_finetune,
+    score_candidate,
+    verdict_rank,
+)
+
+__all__ = [
+    "CompiledTrace",
+    "FinetuneSpec",
+    "LoopLedger",
+    "LoopLedgerMismatch",
+    "LoopRunner",
+    "LoopSpec",
+    "RoundTripError",
+    "TraceCompileError",
+    "VERDICTS",
+    "compile_trace",
+    "compiled_tables",
+    "fault_plan_from_env",
+    "finetune_spec_from_json",
+    "grade_pairs",
+    "incumbent_meta",
+    "loop_spec_from_json",
+    "run_finetune",
+    "score_candidate",
+    "snapshot_digest",
+    "snapshot_trace",
+    "trace_scenario_name",
+    "usable_records",
+    "verdict_rank",
+    "verify_roundtrip",
+]

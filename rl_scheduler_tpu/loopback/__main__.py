@@ -1,0 +1,168 @@
+"""graftloop CLI: one resumable command that closes the decision loop.
+
+Usage (docs/serving.md "closing the loop")::
+
+    # dry rehearsal: snapshot + compile + retrain + verdict, no promote
+    python -m rl_scheduler_tpu.loopback --trace-dir /var/trace \\
+        --incumbent runs/PPO_fleet --out /tmp/loop0 --dry-run
+
+    # the live loop against a serving pool's control plane
+    python -m rl_scheduler_tpu.loopback --trace-dir /var/trace \\
+        --incumbent runs/PPO_fleet --out /tmp/loop0 \\
+        --pool http://127.0.0.1:8788
+
+Re-running the same command over the same ``--out`` resumes from the
+loop ledger: completed stages are skipped bitwise (SIGKILL-safe — the
+graftstudy ledger discipline). ``GRAFTLOOP_FAULTS`` arms the
+``loopback.compile``/``loopback.promote`` chaos seams
+(docs/robustness.md). Prints ONE ``schema_version``-tagged JSON summary
+line (the driver convention).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import shutil
+import sys
+from pathlib import Path
+
+
+def main(argv: list | None = None) -> int:
+    from rl_scheduler_tpu.loopback.orchestrator import (
+        LOOP_LOCK_NAME,
+        LoopRunner,
+        LoopSpec,
+        fault_plan_from_env,
+    )
+    from rl_scheduler_tpu.studies.spec import parse_seeds
+
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--trace-dir", required=True,
+                   help="the pool's trace directory (extender "
+                        "--trace-dir): snapshotted, never mutated")
+    p.add_argument("--incumbent", required=True,
+                   help="run dir of the checkpoint the pool serves today "
+                        "— the warm-start source AND the verdict's "
+                        "control arm")
+    p.add_argument("--out", required=True,
+                   help="loop working dir: ledger, trace snapshot, "
+                        "candidate run. Re-running resumes from it")
+    p.add_argument("--pool", default=None, metavar="URL",
+                   help="pool control-plane base URL (e.g. "
+                        "http://127.0.0.1:8788) for the promote stage; "
+                        "required unless --dry-run")
+    p.add_argument("--steps", type=int, default=256,
+                   help="compiled scenario table length (a longer trace "
+                        "contributes a seeded window; default 256)")
+    p.add_argument("--mix", type=float, default=0.25,
+                   help="anti-forgetting mixture: share of base-workload "
+                        "rows interleaved into the TRAINING scenario "
+                        "(the pure replay scenario stays mix-free for "
+                        "the round-trip pin; default 0.25)")
+    p.add_argument("--iterations", type=int, default=8,
+                   help="fine-tune iterations (default 8)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="retrain seed (compile window/mixture draw from "
+                        "--compile-seed)")
+    p.add_argument("--compile-seed", type=int, default=0)
+    p.add_argument("--eval-every", type=int, default=2,
+                   help="in-training eval cadence — arms the best-eval "
+                        "keeper the candidate is scored from (default 2)")
+    p.add_argument("--eval-episodes", type=int, default=32)
+    p.add_argument("--verdict-seeds", default="0-4", metavar="SPEC",
+                   help="paired-verdict seeds, '0-4' / '0,2,7' style "
+                        "(default 0-4)")
+    p.add_argument("--verdict-episodes", type=int, default=64)
+    p.add_argument("--required-verdict", default="confirmed_above",
+                   choices=("point_above", "confirmed_above"),
+                   help="minimum graded verdict to promote (default "
+                        "confirmed_above — the robust bar)")
+    p.add_argument("--forgetting-tolerance", type=float, default=10.0,
+                   metavar="PCT",
+                   help="max mean regression vs the incumbent on its "
+                        "ORIGINAL workload before a passing verdict is "
+                        "demoted (default 10%%)")
+    p.add_argument("--num-nodes", type=int, default=None,
+                   help="node-set size (default: the incumbent's "
+                        "recorded N)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="run every stage but stop before the promote "
+                        "(recorded as a refusal; the candidate and "
+                        "verdict stay in the loop dir)")
+    p.add_argument("--rollout-timeout", type=float, default=120.0)
+    p.add_argument("--fresh", action="store_true",
+                   help="discard an existing loop dir's ledger/artifacts "
+                        "and start over (refused while another loop "
+                        "holds the dir's lock)")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    try:
+        spec = LoopSpec(
+            trace_dir=args.trace_dir,
+            incumbent=args.incumbent,
+            pool_url=args.pool,
+            steps=args.steps,
+            mix_frac=args.mix,
+            compile_seed=args.compile_seed,
+            iterations=args.iterations,
+            seed=args.seed,
+            eval_every=args.eval_every,
+            eval_episodes=args.eval_episodes,
+            verdict_seeds=tuple(parse_seeds(args.verdict_seeds)),
+            verdict_episodes=args.verdict_episodes,
+            required_verdict=args.required_verdict,
+            forgetting_tolerance_pct=args.forgetting_tolerance,
+            num_nodes=args.num_nodes,
+            dry_run=args.dry_run,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e))
+
+    loop_dir = Path(args.out)
+    loop_dir.mkdir(parents=True, exist_ok=True)
+    # Single-writer: two loops interleaving stages over one ledger would
+    # wipe each other's candidate dirs (the graftstudy runner-lock
+    # discipline, shared utils/pidlock.py). --fresh deletes WHILE
+    # holding the lock — the check-then-rmtree TOCTOU graftstudy fixed.
+    from rl_scheduler_tpu.utils.pidlock import acquire_pidfile_lock
+
+    try:
+        lock = acquire_pidfile_lock(
+            loop_dir / LOOP_LOCK_NAME,
+            "a loop is already running over this dir (pid {pid} holds "
+            "{lock}); two writers would interleave stages")
+    except RuntimeError as e:
+        raise SystemExit(str(e))
+    try:
+        if args.fresh:
+            for entry in list(loop_dir.iterdir()):
+                if entry.name == LOOP_LOCK_NAME:
+                    continue
+                shutil.rmtree(entry) if entry.is_dir() else entry.unlink()
+        fault_plan = fault_plan_from_env(os.environ.get("GRAFTLOOP_FAULTS"))
+        runner = LoopRunner(spec, loop_dir, fault_plan=fault_plan,
+                            rollout_timeout_s=args.rollout_timeout)
+        summary = runner.run()
+    finally:
+        lock.unlink(missing_ok=True)
+    print(json.dumps(summary, sort_keys=True))
+    if summary["promoted"]:
+        print(f"loopback: promoted {summary['candidate']} "
+              f"(verdict {summary['verdict']})", file=sys.stderr)
+        return 0
+    print(f"loopback: NOT promoted — {summary['promote_status']} "
+          f"(verdict {summary['verdict']})", file=sys.stderr)
+    # A completed-but-refused loop is a successful run of the loop
+    # program: exit 0 with promoted:false in the summary line (the
+    # drill asserts on the field, not the exit code).
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,373 @@
+"""graftloop part 1: the trace→Scenario compiler.
+
+The serving plane durably logs every decision (graftroll,
+``scheduler/tracelog.py``); nothing ever turned those logs back into
+training data. This module is the turn: it snapshots a live pool's trace
+directory, merges the per-worker streams into one timestamp-ordered
+decision sequence (``tracelog.iter_trace_merged``), and compiles the
+sequence into the table space the env layer already replays — the new
+``trace_replay`` scenario family (``scenarios/families.py``).
+
+**What is reconstructed, and from what.** A trace record carries the
+telemetry replay position (``telemetry_pos`` — the raw monotonic counter
+the worker's ``TableTelemetry`` consumed for that observation) and, since
+schema 2, the parsed pod request (``pod_cpu``) and candidate-cloud layout
+(``clouds``). The cost/latency half of every served observation is a
+pure function of ``telemetry_pos`` and the serving table (the same
+normalized CSV training replays), so the compiler rebuilds it exactly:
+``costs[t] = table.costs[pos_t % len(table)]``. The CPU half of a served
+observation comes from LIVE telemetry (RandomCpu / Prometheus) and is
+deliberately NOT reconstructible — that is the documented digest
+semantics: a record's ``obs_sha`` fingerprints the full served array
+(including the live half) for provenance joins, while the compiler's
+fidelity contract covers the deterministic half plus the pod sizes, and
+:func:`verify_roundtrip` pins THAT contract bit-exactly through the real
+env (``cluster_set`` reset/step on the compiled tables reproduces the
+trace's cost/latency/pod columns).
+
+**Determinism.** Same (trace snapshot, steps, seed, mix_frac) ⇒
+bitwise-identical tables (pinned by test): the merged replay order is
+deterministic (stable tie-break), the seed only places the episode
+window inside a longer trace and draws the anti-forgetting mixture
+interleave, and every draw comes from one ``np.random.RandomState`` with
+a fixed order — the ``data/generate.py`` discipline every family
+follows.
+
+**Tolerance.** The compiler must survive what a crashed pool leaves
+behind: orphaned ``.part`` segments (sealed into the snapshot), torn
+trailing lines (skipped by ``iter_trace``), counted queue drops (gaps in
+the sequence are fine — the table rows are self-describing), probe
+records (``endpoint=probe`` synthetic gate traffic, excluded), fail-open
+records (no decision was served — excluded, counted), and schema-1
+records without pod fields (the pod trace degrades to the env's default
+draw, counted).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import shutil
+from pathlib import Path
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+SNAPSHOT_META = "snapshot.json"
+
+
+class TraceCompileError(ValueError):
+    """The trace (snapshot) cannot compile into a scenario — too few
+    usable decision records, or no snapshot where one was named."""
+
+
+# ------------------------------------------------------------- snapshot
+
+
+def snapshot_trace(trace_dir: str | Path, dest: str | Path,
+                   fault_plan=None) -> dict:
+    """Copy a (possibly live) trace directory into a stable snapshot.
+
+    Sealed segments copy verbatim; active/orphan ``.part`` files copy
+    WITHOUT the suffix (sealing the copy — the flushed lines are whole,
+    and a torn trailing line in a mid-write copy is exactly what
+    ``iter_trace`` already tolerates). The source is never touched, so a
+    live pool keeps serving — and the ``--trace-max-segments`` retention
+    cap keeps pruning — while graftloop compiles from the frozen copy.
+
+    Writes ``snapshot.json`` (source, per-file sha256+size, record
+    count, content digest) atomically and returns it. Re-running over an
+    existing snapshot replaces it wholesale (the resume unit is the
+    ledger stage, not the copy).
+    """
+    from rl_scheduler_tpu.scheduler.tracelog import _SEG_RE, iter_trace
+
+    if fault_plan is not None:
+        fault_plan.check("loopback.compile", OSError)
+    trace_dir = Path(trace_dir)
+    if not trace_dir.is_dir():
+        raise TraceCompileError(
+            f"trace dir {trace_dir} does not exist — point --trace-dir at "
+            "the pool's trace directory")
+    dest = Path(dest)
+    if dest.exists():
+        shutil.rmtree(dest)
+    dest.mkdir(parents=True)
+    files = {}
+    for path in sorted(trace_dir.iterdir()):
+        m = _SEG_RE.match(path.name)
+        if m is None:
+            continue
+        out_name = path.name[:-len(".part")] if m.group("part") else path.name
+        out = dest / out_name
+        try:
+            shutil.copyfile(path, out)
+        except OSError:
+            # A segment pruned/renamed between listing and copy (live
+            # retention, a sealing writer): the snapshot simply carries
+            # the segments that held still — gaps are tolerated by
+            # construction.
+            logger.warning("snapshot: %s vanished mid-copy (live "
+                           "retention?); skipping", path.name)
+            continue
+        digest = hashlib.sha256(out.read_bytes()).hexdigest()
+        files[out_name] = {"sha256": digest, "size": out.stat().st_size}
+    records = sum(1 for _ in iter_trace(dest))
+    meta = {
+        "source": str(trace_dir),
+        "files": files,
+        "records": records,
+        "digest": snapshot_digest(dest),
+    }
+    from rl_scheduler_tpu.studies.runner import atomic_write_json
+
+    atomic_write_json(dest / SNAPSHOT_META, meta, indent=2)
+    return meta
+
+
+def snapshot_digest(snapshot_dir: str | Path) -> str:
+    """Content digest of a snapshot's segment bytes (sorted by name,
+    ``snapshot.json`` excluded) — the compile-provenance key the loop
+    ledger records, so "same snapshot" is checkable, not assumed."""
+    snapshot_dir = Path(snapshot_dir)
+    h = hashlib.sha256()
+    for path in sorted(snapshot_dir.iterdir()):
+        if path.name == SNAPSHOT_META or not path.is_file():
+            continue
+        h.update(path.name.encode())
+        h.update(path.read_bytes())
+    return h.hexdigest()[:16]
+
+
+# -------------------------------------------------------------- compile
+
+
+@dataclasses.dataclass(frozen=True)
+class CompiledTrace:
+    """The compiled replay: env-ready tables plus the compile report."""
+
+    costs: np.ndarray        # [T, 2] f32 — replayed normalized costs
+    latencies: np.ndarray    # [T, 2] f32
+    pod_scale: np.ndarray | None  # [T] f32 — recorded pod sizes (or None)
+    pod_from_trace: bool
+    stats: dict
+
+    @property
+    def steps(self) -> int:
+        return int(self.costs.shape[0])
+
+
+def usable_records(trace_dir: str | Path) -> tuple[list, dict]:
+    """``(records, exclusion_counts)``: the merged decision sequence a
+    compile consumes — probes and fail-opens out, a telemetry position
+    required (the one field the reconstruction is a function of)."""
+    from rl_scheduler_tpu.scheduler.tracelog import iter_trace_merged
+
+    used: list = []
+    stats = {"records_total": 0, "probes_excluded": 0,
+             "fail_open_excluded": 0, "missing_pos_excluded": 0,
+             "generations": set()}
+    for record in iter_trace_merged(trace_dir):
+        stats["records_total"] += 1
+        if record.get("endpoint") == "probe":
+            stats["probes_excluded"] += 1
+            continue
+        if record.get("fail_open"):
+            stats["fail_open_excluded"] += 1
+            continue
+        if record.get("telemetry_pos") is None:
+            stats["missing_pos_excluded"] += 1
+            continue
+        stats["generations"].add(record.get("generation", 0))
+        used.append(record)
+    stats["generations"] = sorted(stats["generations"])
+    return used, stats
+
+
+def compile_trace(trace_dir: str | Path, steps: int = 256, seed: int = 0,
+                  mix_frac: float = 0.0, data_path: str | None = None,
+                  fault_plan=None) -> CompiledTrace:
+    """Compile a trace snapshot into :class:`CompiledTrace` (module doc).
+
+    ``steps`` caps the table length: a longer trace contributes a
+    seeded contiguous window (the seed's first draw), a shorter one
+    compiles whole. ``mix_frac`` interleaves that share of base-workload
+    rows (the serving table walked cyclically from a seeded start, pod
+    sizes redrawn from the env's default range) — the anti-forgetting
+    mixture a fine-tune-from-trace job trains on. ``fault_plan`` is the
+    ``loopback.compile`` chaos seam."""
+    if fault_plan is not None:
+        fault_plan.check("loopback.compile", OSError)
+    if steps < 2:
+        raise TraceCompileError(f"steps={steps}: a compiled table needs "
+                                "at least 2 rows")
+    from rl_scheduler_tpu.data.loader import load_table
+
+    table = load_table(data_path)
+    costs_src = np.asarray(table.costs, np.float32)
+    lats_src = np.asarray(table.latencies, np.float32)
+    used, stats = usable_records(trace_dir)
+    if len(used) < 2:
+        raise TraceCompileError(
+            f"trace under {trace_dir} holds {len(used)} usable decision "
+            f"records (of {stats['records_total']} total; "
+            f"{stats['probes_excluded']} probes, "
+            f"{stats['fail_open_excluded']} fail-open, "
+            f"{stats['missing_pos_excluded']} without a telemetry "
+            "position) — a replay scenario needs at least 2")
+
+    rng = np.random.RandomState(seed)
+    t = min(steps, len(used))
+    # Draw order is FIXED (determinism contract): window offset first,
+    # then the mixture mask, then the mixture phase, then mixture pods.
+    offset = int(rng.randint(0, len(used) - t + 1))
+    window = used[offset:offset + t]
+    rows = np.array([r["telemetry_pos"] % len(costs_src) for r in window],
+                    np.int64)
+    costs = costs_src[rows]
+    lats = lats_src[rows]
+    pods = [r.get("pod_cpu") for r in window]
+    missing_pods = sum(1 for p in pods if p is None)
+    pod_from_trace = missing_pods == 0
+    # Clipped to the env's [0, 1] fraction space: the env clips its pod
+    # draw the same way, and the round-trip pin compares exactly.
+    pod_scale = (np.clip(np.asarray(pods, np.float32), 0.0, 1.0)
+                 if pod_from_trace else None)
+
+    mixed_rows = 0
+    if mix_frac > 0.0:
+        mask = rng.uniform(size=t) < mix_frac
+        phase = int(rng.randint(0, len(costs_src)))
+        base_rows = (phase + np.arange(t)) % len(costs_src)
+        costs = np.where(mask[:, None], costs_src[base_rows], costs)
+        lats = np.where(mask[:, None], lats_src[base_rows], lats)
+        if pod_from_trace:
+            # Mixture rows re-draw pod sizes from the env's default
+            # range: the base workload must look like the base workload,
+            # not like frozen trace pods on CSV prices.
+            from rl_scheduler_tpu.env.cluster_set import (
+                DEFAULT_POD_CPU_HIGH,
+                DEFAULT_POD_CPU_LOW,
+            )
+
+            base_pods = rng.uniform(DEFAULT_POD_CPU_LOW,
+                                    DEFAULT_POD_CPU_HIGH,
+                                    size=t).astype(np.float32)
+            pod_scale = np.where(mask, base_pods, pod_scale)
+        mixed_rows = int(mask.sum())
+
+    stats.update({
+        "usable_records": len(used),
+        "steps": t,
+        "window_offset": offset,
+        "seed": seed,
+        "mix_frac": mix_frac,
+        "mixed_rows": mixed_rows,
+        "pod_from_trace": pod_from_trace,
+        "records_without_pod": missing_pods,
+    })
+    return CompiledTrace(
+        costs=costs.astype(np.float32),
+        latencies=lats.astype(np.float32),
+        pod_scale=None if pod_scale is None
+        else pod_scale.astype(np.float32),
+        pod_from_trace=pod_from_trace,
+        stats=stats,
+    )
+
+
+def compiled_tables(trace_dir: str | Path, steps: int = 256, seed: int = 0,
+                    mix_frac: float = 0.0) -> dict:
+    """The family-dispatch entry (``scenarios/families.
+    trace_replay_tables``): :func:`compile_trace` as the plain table
+    dict the scenario layer compiles every family into."""
+    compiled = compile_trace(trace_dir, steps=steps, seed=seed,
+                             mix_frac=mix_frac)
+    return {
+        "costs": compiled.costs,
+        "latencies": compiled.latencies,
+        "pod_scale": compiled.pod_scale,
+        "pod_from_trace": compiled.pod_from_trace,
+    }
+
+
+def trace_scenario_name(snapshot_dir: str | Path, steps: int | None = None,
+                        mix_frac: float | None = None) -> str:
+    """The canonical ``trace_replay:<dir>[?steps=N&mix=F]`` scenario name
+    for a snapshot — the one string that round-trips through
+    ``--scenario``, checkpoint meta, resume guards, and the extender's
+    conformance demand (``scenarios/spec.get_scenario`` parses it)."""
+    name = f"trace_replay:{snapshot_dir}"
+    params = []
+    if steps is not None:
+        params.append(f"steps={steps}")
+    if mix_frac:
+        params.append(f"mix={mix_frac:g}")
+    return name + ("?" + "&".join(params) if params else "")
+
+
+# ------------------------------------------------------------ round trip
+
+
+class RoundTripError(AssertionError):
+    """The compiled scenario does NOT replay the trace through the env —
+    the compile is wrong, and training on it would not be training on
+    served traffic. Never promoted past."""
+
+
+def verify_roundtrip(scenario, num_nodes: int = 8,
+                     max_check_steps: int = 64) -> dict:
+    """Pin the compile: step the REAL env (``env/cluster_set``) over the
+    scenario's compiled tables and require the observation columns to
+    reproduce the trace-derived rows bit-exactly.
+
+    Checked per step t: every node's cost/latency columns equal the
+    compiled table row for its cloud (zero node premium by construction
+    — the trace_replay scenario params pin ``node_jitter=0``), and, when
+    the trace recorded pod sizes, the broadcast ``pod_cpu`` column
+    equals the recorded request. The live-CPU column is NOT checked —
+    the documented digest semantics (module doc): that half of the
+    served observation was live telemetry, reconstructible by nobody.
+
+    Raises :class:`RoundTripError` on the first mismatch; returns the
+    check report. A pure-mix row checks identically (its table row IS
+    the compiled row, wherever it came from)."""
+    import jax
+
+    from rl_scheduler_tpu.env import cluster_set as cs
+    from rl_scheduler_tpu.scenarios.spec import _compiled, cluster_set_params
+
+    tables = _compiled(scenario)
+    params = cluster_set_params(scenario, num_nodes=num_nodes)
+    costs = np.asarray(tables["costs"])
+    lats = np.asarray(tables["latencies"])
+    pod_scale = tables.get("pod_scale")
+    pod_from_trace = bool(tables.get("pod_from_trace"))
+    cloud = np.asarray(params.cloud_of_node)
+    state, obs = cs.reset(params, jax.random.PRNGKey(0))
+    steps_checked = 0
+    t_max = min(costs.shape[0] - 1, max_check_steps)
+    for t in range(t_max):
+        row = np.asarray(obs)
+        want_cost = costs[t][cloud]
+        want_lat = lats[t][cloud]
+        if not (np.allclose(row[:, 0], want_cost, atol=1e-6)
+                and np.allclose(row[:, 1], want_lat, atol=1e-6)):
+            raise RoundTripError(
+                f"step {t}: env observed cost/latency "
+                f"{row[:, 0]}/{row[:, 1]} != compiled trace rows "
+                f"{want_cost}/{want_lat}")
+        if pod_from_trace and pod_scale is not None:
+            want_pod = np.float32(pod_scale[t])
+            if not np.allclose(row[:, 4], want_pod, atol=1e-6):
+                raise RoundTripError(
+                    f"step {t}: env pod_cpu {row[0, 4]} != recorded "
+                    f"pod {want_pod}")
+        steps_checked += 1
+        state, ts = cs.step(params, state, 0)
+        obs = ts.obs
+    return {"steps_checked": steps_checked, "num_nodes": num_nodes,
+            "pod_checked": pod_from_trace}

@@ -1,0 +1,428 @@
+"""graftloop part 3: the loop orchestrator — one resumable command that
+closes trace → scenario → retrain → promote.
+
+``LoopRunner.run()`` drives five stages over one working directory::
+
+    snapshot  copy the live trace dir into <out>/trace_snapshot (stable
+              under serving + retention pruning)
+    compile   trace→Scenario (loopback/compile.py): pure-replay scenario
+              round-trip-PINNED through the real env, training scenario
+              with the anti-forgetting mixture
+    retrain   fine-tune-from-trace subprocess (loopback/retrain.py):
+              --warm-start incumbent, best-eval keeper armed
+    evaluate  the graded paired-seed verdict vs the incumbent (+ the
+              anti-forgetting gate)
+    promote   POST /promote to the live pool and poll GET /rollout —
+              riding graftroll's canary gates, SLO gate, and automatic
+              rollback unchanged
+
+Every finished stage appends one record to a graftstudy-style ledger
+(atomic tmp-then-rename whole-file rewrites, header bound to the
+``LoopSpec`` fingerprint): a SIGKILL at ANY instant leaves either the
+old or the new complete ledger, so a re-run skips completed stages and
+re-enters exactly the interrupted one. Stages are idempotent at stage
+granularity (retrain wipes its partial candidate dir; promote is
+at-least-once — re-promoting an already-landed candidate re-rolls the
+same checkpoint through the same gates, wasteful but safe).
+
+**Refusal is a recorded outcome, not an error.** A failing verdict
+records ``promote: false`` in the evaluate stage and the promote stage
+records ``refused`` — the loop completes with ``promoted: false`` and a
+re-run does NOT retry the refused candidate (a fresh loop dir does). A
+promote the POOL rolls back records ``rolled_back`` the same way. Only
+transient failures (HTTP errors, crashes) leave no record and re-run.
+
+Chaos seams (``utils/faults.py``): ``loopback.compile`` fires inside
+the snapshot/compile stages, ``loopback.promote`` before the POST —
+armed deterministically via ``GRAFTLOOP_FAULTS`` (e.g.
+``loopback.promote:1``) for the drill's refusal/rollback rehearsals.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+from rl_scheduler_tpu.loopback.retrain import (
+    FinetuneSpec,
+    run_finetune,
+    score_candidate,
+)
+
+logger = logging.getLogger(__name__)
+
+LOOP_SCHEMA_VERSION = 1
+LEDGER_NAME = "loop_ledger.jsonl"
+SNAPSHOT_DIR = "trace_snapshot"
+RETRAIN_DIR = "retrain"
+CANDIDATE_NAME = "candidate"
+LOOP_LOCK_NAME = "loop.lock"
+STAGES = ("snapshot", "compile", "retrain", "evaluate", "promote")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoopSpec:
+    """One loop iteration's frozen protocol. The fingerprint binds the
+    ledger: a changed protocol refuses to resume into the same loop dir
+    (the graftstudy rule — two protocols must not interleave stages)."""
+
+    trace_dir: str                   # the live pool's trace directory
+    incumbent: str                   # run dir serving today's generation
+    pool_url: str | None = None      # control plane, e.g. http://host:8788
+    steps: int = 256
+    mix_frac: float = 0.25
+    compile_seed: int = 0
+    iterations: int = 8
+    seed: int = 0
+    eval_every: int = 2
+    eval_episodes: int = 32
+    verdict_seeds: tuple = (0, 1, 2, 3, 4)
+    verdict_episodes: int = 64
+    required_verdict: str = "confirmed_above"
+    forgetting_tolerance_pct: float = 10.0
+    num_nodes: int | None = None
+    dry_run: bool = False
+
+    def __post_init__(self):
+        if not self.trace_dir:
+            raise ValueError("trace_dir: the loop compiles FROM a trace")
+        if not self.incumbent:
+            raise ValueError("incumbent: the loop warm-starts from (and "
+                             "verdicts against) the serving checkpoint")
+        if self.pool_url is None and not self.dry_run:
+            raise ValueError(
+                "pool_url: a live loop promotes through the pool control "
+                "plane — pass one, or --dry-run to stop before promote")
+        if self.steps < 2:
+            raise ValueError(f"steps={self.steps}: >= 2")
+        if not 0.0 <= self.mix_frac < 1.0:
+            raise ValueError(f"mix_frac={self.mix_frac}: [0, 1)")
+        self.finetune()  # validates the retrain/verdict knobs
+
+    def finetune(self, scenario: str | None = None) -> FinetuneSpec:
+        """The retrain job this loop runs (scenario filled at the
+        compile stage; the placeholder only validates knobs)."""
+        return FinetuneSpec(
+            incumbent=self.incumbent,
+            scenario=scenario or "trace_replay:<pending>",
+            scenario_seed=self.compile_seed,
+            iterations=self.iterations,
+            seed=self.seed,
+            eval_every=self.eval_every,
+            eval_episodes=self.eval_episodes,
+            verdict_seeds=tuple(self.verdict_seeds),
+            verdict_episodes=self.verdict_episodes,
+            required_verdict=self.required_verdict,
+            forgetting_tolerance_pct=self.forgetting_tolerance_pct,
+            num_nodes=self.num_nodes,
+        )
+
+    def to_json(self) -> dict:
+        return json.loads(json.dumps(dataclasses.asdict(self)))
+
+    def fingerprint(self) -> str:
+        blob = json.dumps(self.to_json(), sort_keys=True,
+                          separators=(",", ":"))
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def loop_spec_from_json(d: dict) -> LoopSpec:
+    kw = dict(d)
+    kw["verdict_seeds"] = tuple(kw["verdict_seeds"])
+    return LoopSpec(**kw)
+
+
+class LoopLedgerMismatch(RuntimeError):
+    """The loop dir's ledger was written under a different spec."""
+
+
+class LoopLedger:
+    """The loop's stage journal: the graftstudy ledger discipline
+    (whole-file tmp-then-rename appends, sorted-key records, header
+    bound to the spec fingerprint) applied to stages instead of trials.
+    A SIGKILL leaves a complete ledger; completed stage records survive
+    bitwise."""
+
+    def __init__(self, loop_dir: str | Path, spec: LoopSpec):
+        self.path = Path(loop_dir) / LEDGER_NAME
+        self.spec = spec
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        if self.path.exists() and self.path.stat().st_size:
+            header = json.loads(self.path.read_text().splitlines()[0])
+            if header.get("spec_sha") != spec.fingerprint():
+                raise LoopLedgerMismatch(
+                    f"{self.path} was written for spec "
+                    f"{header.get('spec_sha')}; this run's spec is "
+                    f"{spec.fingerprint()} — a changed loop protocol "
+                    "cannot resume into the same ledger (new loop dir, "
+                    "or --fresh to discard)")
+        else:
+            self._rewrite([self._dumps({
+                "kind": "header",
+                "schema_version": LOOP_SCHEMA_VERSION,
+                "spec_sha": spec.fingerprint(),
+                "spec": spec.to_json(),
+            })])
+
+    @staticmethod
+    def _dumps(record: dict) -> str:
+        return json.dumps(record, sort_keys=True, separators=(", ", ": "))
+
+    def _rewrite(self, lines: list) -> None:
+        tmp = self.path.with_suffix(f".tmp.{os.getpid()}")
+        data = "".join(line + "\n" for line in lines)
+        with open(tmp, "w") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def append_stage(self, stage: str, status: str, out: dict) -> None:
+        record = {"kind": "stage", "stage": stage, "status": status,
+                  "ts": round(time.time(), 3), "out": out}
+        lines = self.path.read_text().splitlines() if self.path.exists() \
+            else []
+        self._rewrite(lines + [self._dumps(record)])
+
+    def stages(self) -> dict:
+        """``{stage: record}`` for every recorded stage (newest wins —
+        there is at most one per stage in a healthy ledger)."""
+        out = {}
+        for line in self.path.read_text().splitlines()[1:]:
+            record = json.loads(line)
+            if record.get("kind") == "stage":
+                out[record["stage"]] = record
+        return out
+
+
+class LoopRunner:
+    """Execute (or resume) one loop iteration over ``loop_dir``."""
+
+    def __init__(self, spec: LoopSpec, loop_dir: str | Path,
+                 fault_plan=None, rollout_timeout_s: float = 120.0):
+        self.spec = spec
+        self.loop_dir = Path(loop_dir)
+        self.fault_plan = fault_plan
+        self.rollout_timeout_s = rollout_timeout_s
+        self.loop_dir.mkdir(parents=True, exist_ok=True)
+        self.ledger = LoopLedger(self.loop_dir, spec)
+
+    # --------------------------------------------------------- stages
+
+    def _stage_snapshot(self) -> dict:
+        from rl_scheduler_tpu.loopback.compile import snapshot_trace
+
+        meta = snapshot_trace(self.spec.trace_dir,
+                              self.loop_dir / SNAPSHOT_DIR,
+                              fault_plan=self.fault_plan)
+        return {"snapshot": str(self.loop_dir / SNAPSHOT_DIR),
+                "digest": meta["digest"], "records": meta["records"],
+                "segments": len(meta["files"])}
+
+    def _stage_compile(self, snapshot: str) -> dict:
+        from rl_scheduler_tpu.loopback.compile import (
+            compile_trace,
+            trace_scenario_name,
+            verify_roundtrip,
+        )
+        from rl_scheduler_tpu.scenarios import get_scenario
+
+        compiled = compile_trace(
+            snapshot, steps=self.spec.steps, seed=self.spec.compile_seed,
+            fault_plan=self.fault_plan)
+        # The round-trip pin runs on the PURE replay scenario (mix=0):
+        # the compiled tables must reproduce the trace's recorded
+        # observations through the real env before anything trains on
+        # them. The training scenario adds the anti-forgetting mixture
+        # on top of the SAME pinned reconstruction.
+        pure_name = trace_scenario_name(snapshot, steps=self.spec.steps)
+        roundtrip = verify_roundtrip(
+            get_scenario(pure_name, seed=self.spec.compile_seed),
+            num_nodes=self.spec.num_nodes or 8)
+        train_name = trace_scenario_name(
+            snapshot, steps=self.spec.steps, mix_frac=self.spec.mix_frac)
+        stats = dict(compiled.stats)
+        if self.spec.mix_frac:
+            # The ledger reports what the candidate will actually train
+            # on: the same compile with the anti-forgetting mixture
+            # drawn in (cheap — one more pass over the snapshot).
+            train = compile_trace(
+                snapshot, steps=self.spec.steps,
+                seed=self.spec.compile_seed, mix_frac=self.spec.mix_frac)
+            stats["mix_frac"] = train.stats["mix_frac"]
+            stats["mixed_rows"] = train.stats["mixed_rows"]
+        return {"scenario": pure_name, "train_scenario": train_name,
+                "stats": stats, "roundtrip": roundtrip}
+
+    def _stage_retrain(self, train_scenario: str) -> dict:
+        run_dir = run_finetune(
+            self.spec.finetune(train_scenario),
+            self.loop_dir / RETRAIN_DIR, run_name=CANDIDATE_NAME,
+            log_path=self.loop_dir / "retrain.log")
+        return {"candidate": str(run_dir)}
+
+    def _stage_evaluate(self, candidate: str, pure_scenario: str) -> dict:
+        # The verdict pairs on the PURE replay (mix=0): the promotion
+        # question is "better on the traffic we serve?", and the
+        # anti-forgetting mixture is a training-only device — the base
+        # workload already gets its own gate (original_workload pairing).
+        return score_candidate(candidate, self.spec.incumbent,
+                               self.spec.finetune(pure_scenario))
+
+    def _stage_promote(self, candidate: str, verdict: dict) -> tuple:
+        """``(status, out)``: ``ok`` (landed), ``refused`` (verdict /
+        dry-run / a pool 4xx that judges the candidate, e.g. 422 on a
+        failed verify), or ``rolled_back`` (the pool's gates refused it
+        live). Transient failures raise instead — transport errors,
+        5xx, and 409 rollout-in-flight — no record, so a resume
+        retries."""
+        if not verdict.get("promote"):
+            return "refused", {
+                "reason": f"verdict {verdict.get('verdict')!r} is below "
+                          f"required {self.spec.required_verdict!r}"}
+        if self.spec.dry_run:
+            return "refused", {"reason": "--dry-run stops before promote",
+                               "would_promote": candidate}
+        if self.fault_plan is not None:
+            # The chaos seam fires BEFORE the POST: a refused promote
+            # must leave the pool untouched on the incumbent generation.
+            self.fault_plan.check("loopback.promote", OSError)
+        url = self.spec.pool_url.rstrip("/")
+        req = urllib.request.Request(
+            url + "/promote",
+            data=json.dumps({"checkpoint": candidate}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                body = json.load(resp)
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read()).get("error", "")
+            except Exception:  # noqa: BLE001 — body is advisory
+                detail = ""
+            if e.code == 409 or e.code >= 500:
+                # Transient, not a verdict on the candidate: 409 means a
+                # rollout is already in flight (possibly OUR earlier POST
+                # whose polling was interrupted), 5xx is a control-plane
+                # hiccup. Raise so no ledger record lands and a resume
+                # retries once the pool is idle — recording `refused`
+                # here would permanently mislabel a promote the pool may
+                # actually be landing.
+                why = detail or "rollout in flight / server error"
+                raise RuntimeError(
+                    f"pool answered {e.code} on /promote ({why}) — "
+                    "transient; re-run to resume once the pool is "
+                    "idle") from e
+            return "refused", {"reason": f"pool refused the promote "
+                                         f"({e.code}): {detail}"}
+        target = body.get("target_generation")
+        deadline = time.monotonic() + self.rollout_timeout_s
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(url + "/rollout",
+                                        timeout=10) as resp:
+                status = json.load(resp)
+            if not status.get("active"):
+                if status.get("generation") == target:
+                    return "ok", {"generation": target,
+                                  "verified_step": body.get("verified_step"),
+                                  "rollout": status}
+                return "rolled_back", {
+                    "reason": status.get("last_error")
+                    or "pool stayed on the incumbent generation",
+                    "rollout": status}
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"rollout to generation {target} still in flight after "
+            f"{self.rollout_timeout_s:.0f}s — poll {url}/rollout and "
+            "re-run to resume")
+
+    # ------------------------------------------------------------- run
+
+    def run(self) -> dict:
+        """Drive the stages, skipping completed ones (ledger resume),
+        and return the loop summary (one ``schema_version``-tagged
+        dict — the CLI prints it as the driver JSON line)."""
+        done = self.ledger.stages()
+        for stage in STAGES:
+            if stage in done:
+                logger.info("loopback: stage %s already recorded "
+                            "(%s) — skipping", stage,
+                            done[stage]["status"])
+                continue
+            logger.info("loopback: stage %s", stage)
+            if stage == "snapshot":
+                out = self._stage_snapshot()
+                status = "ok"
+            elif stage == "compile":
+                out = self._stage_compile(
+                    done["snapshot"]["out"]["snapshot"])
+                status = "ok"
+            elif stage == "retrain":
+                out = self._stage_retrain(
+                    done["compile"]["out"]["train_scenario"])
+                status = "ok"
+            elif stage == "evaluate":
+                out = self._stage_evaluate(
+                    done["retrain"]["out"]["candidate"],
+                    done["compile"]["out"]["scenario"])
+                status = "ok"
+            else:
+                status, out = self._stage_promote(
+                    done["retrain"]["out"]["candidate"],
+                    done["evaluate"]["out"])
+            self.ledger.append_stage(stage, status, out)
+            done = self.ledger.stages()
+        promote = done["promote"]
+        return {
+            "schema_version": LOOP_SCHEMA_VERSION,
+            "metric": "loopback_summary",
+            "spec_sha": self.spec.fingerprint(),
+            "loop_dir": str(self.loop_dir),
+            "trace_records": done["snapshot"]["out"]["records"],
+            "compile": done["compile"]["out"]["stats"],
+            "roundtrip": done["compile"]["out"]["roundtrip"],
+            "candidate": done["retrain"]["out"]["candidate"],
+            "verdict": done["evaluate"]["out"]["verdict"],
+            "matrix": done["evaluate"]["out"]["matrix"],
+            "promoted": promote["status"] == "ok",
+            "promote_status": promote["status"],
+            "promote": promote["out"],
+        }
+
+
+def fault_plan_from_env(value: str | None):
+    """Parse ``GRAFTLOOP_FAULTS`` into a deterministic FaultPlan
+    schedule: ``site:idx[,idx...]`` entries joined by ``;`` — e.g.
+    ``loopback.promote:1`` fires the first promote attempt,
+    ``loopback.compile:1,2;loopback.promote:1`` both seams. ``None``/
+    empty disarms (the production default — the plan is plumbed, never
+    ambient)."""
+    if not value:
+        return None
+    from rl_scheduler_tpu.utils.faults import FaultPlan
+
+    schedule: dict = {}
+    for entry in value.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        site, _, idxs = entry.partition(":")
+        if not idxs:
+            raise ValueError(
+                f"GRAFTLOOP_FAULTS entry {entry!r}: expected "
+                "site:call_index[,call_index...]")
+        try:
+            schedule[site.strip()] = tuple(
+                int(i) for i in idxs.split(","))
+        except ValueError:
+            raise ValueError(
+                f"GRAFTLOOP_FAULTS entry {entry!r}: call indices must "
+                "be integers")
+    return FaultPlan(schedule=schedule)

@@ -1,0 +1,375 @@
+"""graftloop part 2: fine-tune-from-trace jobs and the promotion verdict.
+
+A :class:`FinetuneSpec` names one retrain job end to end: warm-start
+from the incumbent generation's checkpoint (graftguard-verified restore,
+``train_ppo --warm-start``), train on the compiled trace scenario with a
+seeded share of the incumbent's original workload mixed back in
+(anti-forgetting — the ``mix`` parameter of the ``trace_replay`` family),
+keep the best in-training eval via the existing ``on_eval`` keeper
+(``--eval-every`` arms it), and score the candidate against the
+incumbent with a graftstudy-grade verdict.
+
+**The verdict is graded, not a point estimate.** ``score_candidate``
+runs PAIRED seeded greedy evaluations — candidate and incumbent on the
+IDENTICAL episode draws per verdict seed (the pairing removes the
+dominant seed-to-seed variance, exactly graftstudy's paired-delta
+discipline) — on the trace scenario, then grades the per-seed win/loss
+record with the shared statistics (``studies/analysis.py`` Wilson
+interval + two-sided sign test):
+
+- ``confirmed_above``: the Wilson LOWER bound of the candidate's
+  paired win rate clears 0.5 — the candidate beats the incumbent
+  robustly across seeds (the promotion bar; at 5 seeds only 5/5 makes
+  it, which is the honest arithmetic of a thin seed set).
+- ``point_above`` / ``point_below``: wins lead / trail but the interval
+  straddles 0.5.
+- ``confirmed_below``: the Wilson UPPER bound is under 0.5 — the
+  candidate measurably loses.
+
+An **anti-forgetting gate** rides along: the candidate is also paired
+against the incumbent on the incumbent's ORIGINAL workload (its
+checkpoint-meta scenario, or the CSV replay), and a mean regression
+beyond ``forgetting_tolerance_pct`` demotes any passing verdict to
+``point_above`` — a retrain that aces the trace by forgetting the base
+workload is not promotable (docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+# Verdict grades, worst to best — comparison by rank implements
+# "confirmed_above or better required to promote".
+VERDICTS = ("confirmed_below", "point_below", "point_above",
+            "confirmed_above")
+
+
+def verdict_rank(verdict: str) -> int:
+    if verdict not in VERDICTS:
+        raise ValueError(f"unknown verdict {verdict!r}; graded scale is "
+                         f"{list(VERDICTS)}")
+    return VERDICTS.index(verdict)
+
+
+@dataclasses.dataclass(frozen=True)
+class FinetuneSpec:
+    """One resumable fine-tune-from-trace job (module docstring)."""
+
+    incumbent: str                   # run dir of the serving checkpoint
+    scenario: str                    # trace_replay:<snapshot>[?steps&mix]
+    scenario_seed: int = 0
+    iterations: int = 8
+    seed: int = 0
+    eval_every: int = 2              # arms the best-eval keeper
+    eval_episodes: int = 32
+    verdict_seeds: tuple = (0, 1, 2, 3, 4)
+    verdict_episodes: int = 64
+    required_verdict: str = "confirmed_above"
+    forgetting_tolerance_pct: float = 10.0
+    num_nodes: int | None = None     # None = the incumbent's recorded N
+
+    def __post_init__(self):
+        if not self.scenario.startswith("trace_replay:"):
+            raise ValueError(
+                f"scenario={self.scenario!r}: a fine-tune-from-trace job "
+                "trains on a compiled trace (trace_replay:<snapshot_dir>)")
+        if self.iterations < 1:
+            raise ValueError(f"iterations={self.iterations}: >= 1")
+        if self.eval_every < 1:
+            raise ValueError(
+                f"eval_every={self.eval_every}: the job keeps best-eval "
+                "via the on_eval keeper, which needs the in-training "
+                "eval signal (>= 1)")
+        if not self.verdict_seeds:
+            raise ValueError("verdict_seeds: the paired sign test needs "
+                             "at least one seed")
+        if len(set(self.verdict_seeds)) != len(self.verdict_seeds):
+            raise ValueError(f"verdict_seeds {self.verdict_seeds}: "
+                             "duplicates would double-count pairs")
+        if self.verdict_episodes < 1:
+            raise ValueError(f"verdict_episodes={self.verdict_episodes}: "
+                             ">= 1")
+        verdict_rank(self.required_verdict)  # validates the name
+
+    def to_json(self) -> dict:
+        return json.loads(json.dumps(dataclasses.asdict(self)))
+
+    def fingerprint(self) -> str:
+        """Canonical-JSON sha — the loop ledger's resume-compatibility
+        key, the graftstudy discipline."""
+        blob = json.dumps(self.to_json(), sort_keys=True,
+                          separators=(",", ":"))
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def finetune_spec_from_json(d: dict) -> FinetuneSpec:
+    kw = dict(d)
+    kw["verdict_seeds"] = tuple(kw["verdict_seeds"])
+    return FinetuneSpec(**kw)
+
+
+# -------------------------------------------------------------- retrain
+
+
+def incumbent_meta(run_dir: str | Path) -> dict:
+    """The incumbent's newest verified checkpoint meta (graftguard
+    selection — corrupt steps fall back), without loading params."""
+    from rl_scheduler_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(run_dir)
+    try:
+        latest = mgr.latest_verified_step()
+        if latest is None:
+            raise ValueError(
+                f"incumbent {run_dir} has no verified checkpoint steps")
+        return mgr.restore_meta(latest)
+    finally:
+        mgr.close()
+
+
+def run_finetune(spec: FinetuneSpec, out_root: str | Path,
+                 run_name: str = "candidate",
+                 log_path: str | Path | None = None) -> Path:
+    """Execute the retrain as a fresh ``train_ppo`` subprocess (the
+    graftstudy worker discipline: a clean process per job, so the
+    orchestrator stays light and a crashed trainer cannot wedge the
+    loop) and return the candidate run dir.
+
+    The job is stage-idempotent, not step-resumable: a re-run WIPES any
+    partial candidate dir and retrains whole (the loop ledger only
+    records the stage once the subprocess exits 0, so a SIGKILL mid-train
+    re-enters here). The subprocess inherits the environment —
+    ``JAX_PLATFORMS=cpu`` flows through to container drills."""
+    meta = incumbent_meta(spec.incumbent)
+    if meta.get("env") != "cluster_set":
+        raise ValueError(
+            f"incumbent {spec.incumbent} trained env {meta.get('env')!r}; "
+            "fine-tune-from-trace retrains the set family (the trace "
+            "compiles cluster_set tables)")
+    out_root = Path(out_root)
+    run_dir = out_root / run_name
+    if run_dir.exists():
+        logger.warning("retrain: wiping partial candidate dir %s "
+                       "(stage re-run)", run_dir)
+        shutil.rmtree(run_dir)
+    out_root.mkdir(parents=True, exist_ok=True)
+    num_nodes = spec.num_nodes or meta.get("num_nodes") or 8
+    argv = [
+        sys.executable, "-m", "rl_scheduler_tpu.agent.train_ppo",
+        "--preset", meta.get("preset") or "quick",
+        "--env", "cluster_set",
+        "--scenario", spec.scenario,
+        "--scenario-seed", str(spec.scenario_seed),
+        "--warm-start", str(spec.incumbent),
+        "--iterations", str(spec.iterations),
+        "--seed", str(spec.seed),
+        "--eval-every", str(spec.eval_every),
+        "--eval-episodes", str(spec.eval_episodes),
+        "--num-nodes", str(num_nodes),
+        "--reseed-on-stall", "0",
+        "--run-name", run_name,
+        "--run-root", str(out_root),
+    ]
+    num_heads = meta.get("num_heads")
+    if num_heads is not None:
+        argv += ["--num-heads", str(num_heads)]
+    logger.info("retrain: %s", " ".join(argv))
+    # Source-tree resolution, the graftstudy worker discipline: the
+    # subprocess must import rl_scheduler_tpu the same way this process
+    # did, wherever the orchestrator was launched from.
+    env = dict(os.environ)
+    repo_root = str(Path(__file__).resolve().parents[2])
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    log_fh = open(log_path, "ab") if log_path is not None else None
+    try:
+        proc = subprocess.run(
+            argv, stdout=log_fh or None, stderr=subprocess.STDOUT
+            if log_fh else None, check=False, env=env)
+    finally:
+        if log_fh is not None:
+            log_fh.close()
+    if proc.returncode != 0:
+        tail = ""
+        if log_path is not None and Path(log_path).exists():
+            tail = Path(log_path).read_text()[-2000:]
+        raise RuntimeError(
+            f"retrain subprocess exited {proc.returncode}"
+            + (f"; log tail:\n{tail}" if tail else ""))
+    return run_dir
+
+
+# -------------------------------------------------------------- scoring
+
+
+def _load_set_policy(run_dir: str | Path, best: bool = False):
+    """``(net, params, meta)`` for a cluster_set checkpoint — the
+    evaluate-CLI rebuild, shared here so candidate and incumbent load
+    through one path. ``best`` reads the best-eval keeper when present
+    (falling back to latest — a short job may never have saved one)."""
+    from rl_scheduler_tpu.agent.loop import BEST_DIR
+    from rl_scheduler_tpu.models import SetTransformerPolicy
+    from rl_scheduler_tpu.utils.checkpoint import load_policy_params
+
+    run_dir = Path(run_dir)
+    source = run_dir
+    if best and (run_dir / BEST_DIR / "checkpoints").is_dir():
+        source = run_dir / BEST_DIR
+    params, meta = load_policy_params(source)
+    if meta.get("env") != "cluster_set":
+        raise ValueError(f"{run_dir} trained env {meta.get('env')!r}; "
+                         "the verdict evaluates the set family")
+    num_heads = meta.get("num_heads")
+    net = SetTransformerPolicy(dim=64, depth=2,
+                               num_heads=4 if num_heads is None
+                               else num_heads)
+    return net, params, meta
+
+
+def _paired_rewards(bundle, net_a, params_a, net_b, params_b,
+                    seeds: tuple, episodes: int) -> list:
+    """Per-seed ``(reward_a, reward_b)`` mean episode rewards, both
+    policies greedy on the IDENTICAL episode draws (same
+    ``PRNGKey(seed)`` through ``run_bundle_episodes`` — the paired
+    protocol that makes a sign test meaningful at few seeds)."""
+    from rl_scheduler_tpu.agent.evaluate import (
+        greedy_policy_fn,
+        run_bundle_episodes,
+    )
+
+    out = []
+    for seed in seeds:
+        r_a, _ = run_bundle_episodes(bundle, greedy_policy_fn(net_a, params_a),
+                                     episodes, seed=seed)
+        r_b, _ = run_bundle_episodes(bundle, greedy_policy_fn(net_b, params_b),
+                                     episodes, seed=seed)
+        out.append((float(r_a.mean()), float(r_b.mean())))
+    return out
+
+
+def grade_pairs(pairs: list) -> dict:
+    """Grade paired (candidate, incumbent) rewards into the module's
+    verdict scale: Wilson 95% on the win rate vs the 0.5 bar, plus the
+    two-sided sign test p-value on wins/losses (ties dropped)."""
+    from rl_scheduler_tpu.studies.analysis import (
+        sign_test_pvalue,
+        wilson_interval,
+    )
+
+    wins = sum(1 for c, i in pairs if c > i)
+    losses = sum(1 for c, i in pairs if c < i)
+    ties = len(pairs) - wins - losses
+    decided = wins + losses
+    lo, hi = wilson_interval(losses, decided) if decided else (0.0, 1.0)
+    # wilson_interval bounds the LOSS rate; win-rate bounds mirror it.
+    win_lo, win_hi = 1.0 - hi, 1.0 - lo
+    if decided == 0:
+        verdict = "point_below"    # all ties: nothing demonstrated
+    elif win_lo > 0.5:
+        verdict = "confirmed_above"
+    elif win_hi < 0.5:
+        verdict = "confirmed_below"
+    elif wins > losses:
+        verdict = "point_above"
+    else:
+        verdict = "point_below"
+    deltas = [c - i for c, i in pairs]
+    return {
+        "pairs": len(pairs),
+        "wins": wins,
+        "losses": losses,
+        "ties": ties,
+        "win_rate_wilson95": [round(win_lo, 3), round(win_hi, 3)],
+        "sign_test_p": round(sign_test_pvalue(wins, losses), 4),
+        "mean_delta": round(sum(deltas) / len(deltas), 3),
+        "per_seed_delta": [round(d, 3) for d in deltas],
+        "verdict": verdict,
+    }
+
+
+def score_candidate(candidate: str | Path, incumbent: str | Path,
+                    spec: FinetuneSpec) -> dict:
+    """The promotion verdict (module docstring): paired seeded greedy
+    evals of candidate-vs-incumbent on the trace scenario, graded; plus
+    the anti-forgetting pairing on the incumbent's original workload.
+    Returns the full eval matrix + the final ``verdict``/``promote``.
+
+    The trace pairing evaluates with a per-episode RANDOM table phase
+    (``random_phase``): a pure trace replay is otherwise fully
+    deterministic (fixed window, recorded pods, zero jitter), so every
+    verdict seed would replay the identical episode and the sign test
+    would grade one sample n times. A random phase makes each seed a
+    different window of the SAME logged traffic — honest seed-to-seed
+    variance over the workload the verdict is about — while candidate
+    and incumbent still see identical draws per seed (the pairing)."""
+    import dataclasses as _dc
+
+    from rl_scheduler_tpu.agent.ppo import PPOTrainConfig
+    from rl_scheduler_tpu.agent.train_ppo import make_bundle_and_net
+    from rl_scheduler_tpu.scenarios import get_scenario
+
+    net_c, params_c, meta_c = _load_set_policy(candidate, best=True)
+    net_i, params_i, meta_i = _load_set_policy(incumbent)
+    num_nodes = spec.num_nodes or meta_i.get("num_nodes") or 8
+    trace_scn = get_scenario(spec.scenario, seed=spec.scenario_seed)
+    eval_scn = _dc.replace(
+        trace_scn, knobs=trace_scn.knobs + (("random_phase", True),))
+    trace_bundle, _ = make_bundle_and_net(
+        "cluster_set", PPOTrainConfig(), scenario=eval_scn,
+        num_nodes=num_nodes)
+    trace_pairs = _paired_rewards(
+        trace_bundle, net_c, params_c, net_i, params_i,
+        spec.verdict_seeds, spec.verdict_episodes)
+    trace_grade = grade_pairs(trace_pairs)
+
+    # Anti-forgetting pairing: the incumbent's ORIGINAL workload — its
+    # recorded scenario, or the plain CSV replay.
+    orig_scn = None
+    if meta_i.get("scenario"):
+        orig_scn = get_scenario(meta_i["scenario"],
+                                seed=meta_i.get("scenario_seed", 0))
+    orig_bundle, _ = make_bundle_and_net(
+        "cluster_set", PPOTrainConfig(), scenario=orig_scn,
+        num_nodes=num_nodes)
+    orig_pairs = _paired_rewards(
+        orig_bundle, net_c, params_c, net_i, params_i,
+        spec.verdict_seeds, spec.verdict_episodes)
+    orig_grade = grade_pairs(orig_pairs)
+    incumbent_means = [i for _, i in orig_pairs]
+    mean_inc = sum(incumbent_means) / len(incumbent_means)
+    regression_pct = (-orig_grade["mean_delta"] / abs(mean_inc) * 100.0
+                      if mean_inc else 0.0)
+    forgot = regression_pct > spec.forgetting_tolerance_pct
+
+    verdict = trace_grade["verdict"]
+    if forgot and verdict_rank(verdict) > verdict_rank("point_above"):
+        verdict = "point_above"   # demoted: see module docstring
+    promote = (verdict_rank(verdict)
+               >= verdict_rank(spec.required_verdict))
+    return {
+        "matrix": {
+            "trace_scenario": {"scenario": trace_scn.name,
+                               **trace_grade},
+            "original_workload": {
+                "scenario": orig_scn.name if orig_scn else "csv",
+                **orig_grade,
+                "regression_pct": round(regression_pct, 2),
+                "forgot": forgot,
+            },
+        },
+        "candidate": str(candidate),
+        "candidate_best_eval": meta_c.get("best_eval"),
+        "incumbent": str(incumbent),
+        "verdict": verdict,
+        "required_verdict": spec.required_verdict,
+        "promote": promote,
+    }

@@ -169,6 +169,33 @@ def price_spike_tables(
     }
 
 
+def trace_replay_tables(
+    trace_dir: str,
+    steps: int = 256,
+    seed: int = 0,
+    mix_frac: float = 0.0,
+) -> dict:
+    """Family 6 — trace-driven replay of SERVED traffic (graftloop).
+
+    The only family whose tables come from measurement instead of a
+    generator: ``trace_dir`` is a graftloop trace snapshot
+    (``loopback.compile.snapshot_trace``) of the serving plane's durable
+    decision log, and the compiled ``costs``/``latencies``/``pod_scale``
+    rows replay the telemetry rows and pod sizes the pool actually
+    served, in served order (``loopback/compile.py`` owns the
+    reconstruction; this wrapper keeps the family dispatch in one
+    place). Same determinism contract as every generator here: bitwise-
+    identical tables per (trace snapshot, steps, seed, mix_frac) —
+    ``seed`` places the episode window inside a longer trace and draws
+    the mixture interleave; ``mix_frac`` blends that share of base-CSV
+    workload rows back in (the anti-forgetting mixture a
+    fine-tune-from-trace job trains on, docs/serving.md)."""
+    from rl_scheduler_tpu.loopback.compile import compiled_tables
+
+    return compiled_tables(trace_dir, steps=steps, seed=seed,
+                           mix_frac=mix_frac)
+
+
 def heterogeneous_capacities(
     num_nodes: int = 8,
     num_resources: int = 3,

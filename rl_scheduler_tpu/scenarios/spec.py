@@ -31,7 +31,15 @@ import dataclasses
 from typing import Any
 
 FAMILIES = ("bursty_diurnal", "heterogeneous", "churn", "price_spike",
-            "domain_random")
+            "domain_random", "trace_replay")
+
+# graftloop (rl_scheduler_tpu/loopback/): a trace_replay scenario is
+# named dynamically — ``trace_replay:<snapshot_dir>[?steps=N&mix=F]`` —
+# because its tables compile from a recorded trace snapshot on disk, not
+# from a registry preset. The NAME alone rebuilds the identical spec
+# (get_scenario parses it), so checkpoint-meta round-trips, resume
+# guards, and serving conformance all work unchanged.
+TRACE_SCENARIO_PREFIX = "trace_replay:"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,6 +64,18 @@ class Scenario:
         if self.steps < 2:
             raise ValueError(f"steps={self.steps}: a scenario table needs "
                              "at least 2 rows (episode length >= 1)")
+        if self.family == "trace_replay":
+            if not self.knob("trace_dir"):
+                raise ValueError(
+                    "trace_replay scenarios compile from a trace snapshot "
+                    "— name one via trace_replay:<dir> (get_scenario) or "
+                    "a trace_dir knob")
+            mix = float(self.knob("mix_frac", 0.0) or 0.0)
+            if not 0.0 <= mix < 1.0:
+                raise ValueError(
+                    f"mix_frac={mix}: the anti-forgetting mixture share "
+                    "of base-workload rows must be in [0, 1) — 1.0 would "
+                    "leave no trace rows to learn from")
 
     def knob(self, name: str, default: Any = None) -> Any:
         for k, v in self.knobs:
@@ -117,11 +137,55 @@ def list_scenarios() -> list:
     return sorted(SCENARIOS)
 
 
+def _parse_trace_name(name: str) -> Scenario:
+    """``trace_replay:<snapshot_dir>[?steps=N&mix=F]`` -> Scenario.
+
+    The whole spec lives in the name so it round-trips through checkpoint
+    meta, resume guards, and the extender's conformance demand exactly
+    like a registry preset's. ``steps`` caps the compiled episode length
+    (seeded window into a longer trace, loopback/compile.py); ``mix``
+    interleaves that share of base-CSV workload rows (the
+    anti-forgetting mixture graftloop retrains on)."""
+    spec_part = name[len(TRACE_SCENARIO_PREFIX):]
+    path, _, query = spec_part.partition("?")
+    if not path:
+        raise ValueError(
+            f"scenario {name!r}: trace_replay:<snapshot_dir> needs the "
+            "snapshot directory (loopback snapshot_trace writes one)")
+    steps, mix = 256, 0.0
+    if query:
+        for item in query.split("&"):
+            key, _, value = item.partition("=")
+            try:
+                if key == "steps":
+                    steps = int(value)
+                elif key == "mix":
+                    mix = float(value)
+                else:
+                    raise ValueError(
+                        f"scenario {name!r}: unknown trace_replay "
+                        f"parameter {key!r} (steps, mix)")
+            except ValueError as e:
+                if "unknown" in str(e):
+                    raise
+                raise ValueError(
+                    f"scenario {name!r}: bad value for {key!r}: {value!r}")
+    knobs = _knobs(trace_dir=path, mix_frac=mix)
+    return Scenario(name=name, family="trace_replay", steps=steps,
+                    knobs=knobs)
+
+
 def get_scenario(name: str, seed: int | None = None) -> Scenario:
-    """Registry lookup; ``seed`` re-seeds the preset's table generation."""
+    """Registry lookup; ``seed`` re-seeds the preset's table generation.
+    Names starting ``trace_replay:`` build graftloop's dynamic
+    trace-compiled scenario instead (:func:`_parse_trace_name`)."""
+    if name.startswith(TRACE_SCENARIO_PREFIX):
+        scn = _parse_trace_name(name)
+        return scn if seed is None else scn.with_seed(seed)
     if name not in SCENARIOS:
         raise ValueError(
-            f"unknown scenario {name!r}; registered: {list_scenarios()}")
+            f"unknown scenario {name!r}; registered: {list_scenarios()} "
+            f"(or trace_replay:<snapshot_dir> for a compiled trace)")
     scn = SCENARIOS[name]
     return scn if seed is None else scn.with_seed(seed)
 
@@ -143,6 +207,12 @@ def _compiled(scenario: Scenario) -> dict:
             spike_prob=scenario.knob("spike_prob", 0.04),
             spike_mult=scenario.knob("spike_mult", 4.0),
             decay=scenario.knob("decay", 0.7),
+        )
+    if scenario.family == "trace_replay":
+        return fam.trace_replay_tables(
+            trace_dir=scenario.knob("trace_dir"),
+            steps=scenario.steps, seed=scenario.seed,
+            mix_frac=float(scenario.knob("mix_frac", 0.0) or 0.0),
         )
     raise ValueError(
         f"family {scenario.family!r} compiles no tables (churn compiles a "
@@ -231,6 +301,22 @@ def cluster_set_params(scenario: Scenario, num_nodes: int = 8):
             num_nodes=num_nodes, table=table, avail_mask=mask,
             churn_penalty=scenario.knob("churn_penalty", 1.0),
             **randomization)
+    if scenario.family == "trace_replay":
+        # graftloop: replay the logged workload exactly — zero static
+        # node premium (a serving-side unknown; zero keeps the compiled
+        # cost/latency columns bit-exact through _observe, the
+        # round-trip pin in loopback/compile.py), and when the trace
+        # recorded pod sizes, a degenerate pod draw (low == high == 1.0)
+        # so pod_cpu at row t IS pod_scale[t] — the recorded request.
+        t = _compiled(scenario)
+        pod_kw = ({"pod_cpu_low": 1.0, "pod_cpu_high": 1.0}
+                  if t.get("pod_from_trace") else {})
+        return cs.make_params(
+            num_nodes=num_nodes,
+            table=_TableView(t["costs"], t["latencies"]),
+            pod_scale=t.get("pod_scale"),
+            node_jitter=0.0,
+            **pod_kw, **randomization)
     t = _compiled(scenario)
     return cs.make_params(
         num_nodes=num_nodes,

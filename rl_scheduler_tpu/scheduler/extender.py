@@ -687,14 +687,23 @@ class ExtenderPolicy:
 
     def _record_trace(self, endpoint: str, *, candidates: int,
                       chosen: str | None, score: float | None, obs,
-                      t0: float, fail_open: bool = False) -> None:
+                      t0: float, fail_open: bool = False,
+                      clouds: list | None = None) -> None:
         """Append one decision record to the durable trace (tracelog.py),
         count fail-opens, and close out the request's graftlens spans.
         Hot-path cost: one obs digest (computed at the source ON PURPOSE
         — it must fingerprint what was actually served, not a queue-held
         array a later request could alias) plus one bounded-queue put
         that never blocks; with no trace configured the fail-open/SLO
-        counters and the span close-out are the only work."""
+        counters and the span close-out are the only work.
+
+        ``clouds`` (the candidate cloud list, success paths only) and the
+        request's parsed pod_cpu (stashed thread-locally by
+        ``_structured_decide``) are graftloop's schema-2 replay fields —
+        what the trace→Scenario compiler and ``extender_bench
+        --replay-trace`` rebuild workloads from."""
+        pod_cpu = getattr(self._req_local, "pod_cpu", None)
+        self._req_local.pod_cpu = None
         if fail_open:
             with self._lock:
                 self._fail_open_total += 1
@@ -730,6 +739,7 @@ class ExtenderPolicy:
             worker_id=(self.pool_info or {}).get("worker_id"),
             generation=self.generation, fail_open=fail_open,
             breaker_state=self.backend_breaker.state, spans=spans_ms,
+            clouds=clouds, pod_cpu=pod_cpu,
         ))
 
     def decide(self) -> tuple[int, np.ndarray, np.ndarray]:
@@ -894,6 +904,10 @@ class ExtenderPolicy:
         t_parse = time.perf_counter()
         pod = args.get("pod")
         pod_cpu = pod_cpu_fraction(pod, self.node_capacity_cores)
+        # Stashed for the trace record (graftloop replay field): the
+        # record site closes out the request after marshal, where the
+        # parsed pod is long out of scope.
+        self._req_local.pod_cpu = pod_cpu
         cap = self.max_score_nodes
         idx = None
         if cap and len(clouds) > cap:
@@ -1003,7 +1017,8 @@ class ExtenderPolicy:
         # record describes the whole answered request.
         self._record_trace("filter", candidates=len(sources),
                            chosen=display[action],
-                           score=float(probs[action]), obs=obs, t0=t0)
+                           score=float(probs[action]), obs=obs, t0=t0,
+                           clouds=clouds)
         return result
 
     def _prioritize_structured(self, args: dict) -> list[dict]:
@@ -1045,7 +1060,8 @@ class ExtenderPolicy:
         # whole answered request.
         self._record_trace("prioritize", candidates=len(sources),
                            chosen=display[action],
-                           score=float(probs[action]), obs=obs, t0=t0)
+                           score=float(probs[action]), obs=obs, t0=t0,
+                           clouds=clouds)
         return result
 
     @staticmethod
@@ -1178,7 +1194,8 @@ class ExtenderPolicy:
                       "error": ""}
         self._span_add("marshal", time.perf_counter() - t_marshal)
         self._record_trace("filter", candidates=len(sources), chosen=chosen,
-                           score=float(probs[action]), obs=obs, t0=t0)
+                           score=float(probs[action]), obs=obs, t0=t0,
+                           clouds=clouds)
         return result
 
     def prioritize(self, args: dict) -> list[dict]:
@@ -1212,7 +1229,8 @@ class ExtenderPolicy:
             # Success record outside the try — see _prioritize_structured.
             self._record_trace("prioritize", candidates=len(display),
                                chosen=CLOUDS[action],
-                               score=float(probs[action]), obs=obs, t0=t0)
+                               score=float(probs[action]), obs=obs, t0=t0,
+                               clouds=clouds)
         else:
             self._record_trace("prioritize", candidates=len(display),
                                chosen=None, score=None, obs=None, t0=t0,
@@ -1463,6 +1481,12 @@ class ExtenderPolicy:
                 "(fsync + rename).",
                 f"# TYPE {p}_trace_segments_total counter",
                 f"{p}_trace_segments_total {trace['segments_total']}",
+                f"# HELP {p}_trace_segments_pruned_total Sealed segments "
+                "dropped by the --trace-max-segments retention cap "
+                "(oldest first).",
+                f"# TYPE {p}_trace_segments_pruned_total counter",
+                f"{p}_trace_segments_pruned_total "
+                f"{trace['segments_pruned_total']}",
             ]
         from rl_scheduler_tpu.utils.retry import CircuitBreaker
 
@@ -1615,6 +1639,7 @@ def build_policy(
     scenario: str | None = None,
     trace_dir: str | None = None,
     trace_prefix: str = "",
+    trace_max_segments: int = 0,
     spans: bool = True,
     slo_p99_ms: float | None = None,
     slo_avail: float | None = None,
@@ -1816,7 +1841,8 @@ def build_policy(
         # carries every worker's stream without write contention.
         from rl_scheduler_tpu.scheduler.tracelog import TraceLog
 
-        policy.trace = TraceLog(trace_dir, prefix=trace_prefix)
+        policy.trace = TraceLog(trace_dir, prefix=trace_prefix,
+                                max_segments=trace_max_segments)
     if max_score_nodes and policy.family not in ExtenderPolicy.STRUCTURED:
         # Same refuse-before-traffic rule as price_replay below: the flat
         # family scores per CLOUD (two logits however long the node list
@@ -1971,6 +1997,14 @@ def main(argv: list[str] | None = None) -> None:
                         "path never blocks). In pool mode each worker "
                         "writes its own w<id>- stream into the shared "
                         "directory. Omit to disable (docs/serving.md)")
+    p.add_argument("--trace-max-segments", type=int, default=0, metavar="N",
+                   help="trace retention: keep at most N sealed segments "
+                        "PER WORKER STREAM, pruning oldest-first (counted "
+                        "on *_trace_segments_pruned_total) so a long-"
+                        "serving pool's trace dir is bounded at roughly "
+                        "N x workers x 4096 records. graftloop snapshots "
+                        "the dir before compiling, so pruning never races "
+                        "a retrain (docs/serving.md). 0 keeps everything")
     p.add_argument("--no-spans", action="store_true",
                    help="graftlens: disable the per-phase decision-path "
                         "spans (parse/observe/forward/marshal/trace). "
@@ -2040,6 +2074,14 @@ def main(argv: list[str] | None = None) -> None:
             "(a 1-node sample is a coin flip, not a policy decision; "
             "0 disables the cap)"
         )
+    if args.trace_max_segments < 0:
+        raise SystemExit(
+            f"--trace-max-segments {args.trace_max_segments}: pass a "
+            "sealed-segment cap >= 1 (0 keeps everything)")
+    if args.trace_max_segments and args.trace_dir is None:
+        raise SystemExit(
+            "--trace-max-segments bounds the --trace-dir stream; pass "
+            "--trace-dir (or drop the retention cap)")
     if args.price_replay_period <= 0:
         # RawPriceReplay validates too (for programmatic entry points);
         # refusing here keeps the CLI's exit clean and pre-startup.
@@ -2109,6 +2151,7 @@ def main(argv: list[str] | None = None) -> None:
         max_score_nodes=args.max_score_nodes,
         scenario=args.scenario,
         trace_dir=args.trace_dir,
+        trace_max_segments=args.trace_max_segments,
         spans=not args.no_spans,
         slo_p99_ms=args.slo_p99_ms,
         slo_avail=args.slo_avail,

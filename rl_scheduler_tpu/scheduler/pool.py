@@ -451,7 +451,8 @@ def _summed_trace(snapshots: list) -> dict | None:
     if not traced:
         return None
     keys = ("records_total", "written_total", "dropped_total",
-            "write_errors_total", "segments_total")
+            "write_errors_total", "segments_total",
+            "segments_pruned_total")
     return {k: sum(t.get(k, 0) for t in traced) for k in keys}
 
 
@@ -536,6 +537,9 @@ def aggregate_metrics(snapshots: list, pool: dict) -> str:
                                    "(records dropped, serving unaffected)."),
             ("segments_total", "Trace segments sealed (fsync + rename), "
                                "pool total."),
+            ("segments_pruned_total", "Sealed segments dropped by the "
+                                      "--trace-max-segments retention "
+                                      "cap, pool total."),
         ):
             lines += [
                 f"# HELP {p}_trace_{key} {help_text}",

@@ -32,7 +32,18 @@ served) plus one bounded-queue ``put_nowait``:
   ``/stats/reset`` never clears them.
 
 ``iter_trace`` replays a trace directory in write order (sealed segments
-then active parts) — the seam the planned trace→Scenario compiler reads.
+then active parts) — per-writer stream order. ``iter_trace_merged``
+merges every writer's stream by timestamp (stable under ties) — the seam
+graftloop's trace→Scenario compiler and decisionview's per-generation
+report read, so a pool's interleaved traffic replays as one decision
+sequence.
+
+Retention (``max_segments``): a long-serving pool's trace dir is
+bounded — after each seal, sealed segments of THIS writer's stream
+beyond the cap are pruned oldest-first and counted
+(``segments_pruned_total``). graftloop snapshots the directory before
+compiling, so a prune can never yank rows out from under a compile
+(docs/serving.md).
 """
 
 from __future__ import annotations
@@ -49,7 +60,12 @@ from pathlib import Path
 
 logger = logging.getLogger(__name__)
 
-TRACE_SCHEMA = 1
+# Schema 2 added the OPTIONAL replay fields `clouds` (compact candidate
+# cloud string, see clouds_token) and `pod_cpu` — what the trace→Scenario
+# compiler and `extender_bench --replay-trace` reconstruct workloads
+# from. Readers tolerate their absence (schema-1 records replay fine,
+# minus pod-vector fidelity), per the additive-fields rule spans set.
+TRACE_SCHEMA = 2
 _SEG_RE = re.compile(r"^(?P<prefix>.*?)seg-(?P<seq>\d{6})\.jsonl(?P<part>\.part)?$")
 _SENTINEL = object()
 
@@ -66,6 +82,30 @@ def obs_digest(obs) -> str | None:
     return hashlib.sha256(arr.tobytes()).hexdigest()[:16]
 
 
+_CLOUD_CHARS = {"aws": "a", "azure": "z", None: "?"}
+
+
+def clouds_token(clouds) -> str | None:
+    """Compact per-candidate cloud string for the trace record: one char
+    per candidate (``a``=aws, ``z``=azure, ``?``=unknown). A 1024-node
+    request costs 1 KB as a list but ~1 KB of quotes/commas on top as
+    JSON — the token keeps fleet-N records loggable per decision while
+    still reconstructing the exact candidate-cloud layout a replayer
+    (``extender_bench --replay-trace``) needs."""
+    if clouds is None:
+        return None
+    return "".join(_CLOUD_CHARS.get(c, "?") for c in clouds)
+
+
+def clouds_from_token(token: str | None) -> list | None:
+    """Inverse of :func:`clouds_token` (``None`` stays ``None`` — a
+    schema-1 record without the field)."""
+    if token is None:
+        return None
+    rev = {"a": "aws", "z": "azure"}
+    return [rev.get(ch) for ch in token]
+
+
 def decision_record(*, endpoint: str, family: str, backend: str,
                     candidates: int, chosen: str | None,
                     score: float | None, latency_ms: float,
@@ -74,7 +114,9 @@ def decision_record(*, endpoint: str, family: str, backend: str,
                     worker_id: int | None = None, generation: int = 0,
                     fail_open: bool = False,
                     breaker_state: str | None = None,
-                    spans: dict | None = None) -> dict:
+                    spans: dict | None = None,
+                    clouds: list | None = None,
+                    pod_cpu: float | None = None) -> dict:
     """One schema-versioned trace record. Kept a plain dict (JSONL is the
     contract, not a class) — ``schema`` gates future field changes the
     way the bench's ``schema_version`` does. ``obs_sha`` short-circuits
@@ -83,7 +125,10 @@ def decision_record(*, endpoint: str, family: str, backend: str,
     graftlens' per-phase millisecond breakdown
     (parse/observe/forward/marshal/trace), so every logged decision is
     attributable after the fact — ``None`` on pre-graftlens records and
-    with spans disabled, which replayers must tolerate."""
+    with spans disabled, which replayers must tolerate. ``clouds`` (the
+    per-candidate cloud list, stored via :func:`clouds_token`) and
+    ``pod_cpu`` (the parsed pod request fraction) are graftloop's schema-2
+    replay fields — ``None`` on flat-family and legacy records."""
     return {
         "schema": TRACE_SCHEMA,
         "ts": round(time.time(), 6),
@@ -101,6 +146,8 @@ def decision_record(*, endpoint: str, family: str, backend: str,
         "fail_open": bool(fail_open),
         "breaker": breaker_state,
         "spans": spans,
+        "clouds": clouds_token(clouds),
+        "pod_cpu": None if pod_cpu is None else round(float(pod_cpu), 4),
     }
 
 
@@ -117,17 +164,21 @@ class TraceLog:
     def __init__(self, trace_dir: str | Path, prefix: str = "",
                  max_records_per_segment: int = 4096,
                  max_queue: int = 1024, fault_plan=None,
-                 autostart: bool = True):
+                 autostart: bool = True, max_segments: int = 0):
         if max_records_per_segment < 1:
             raise ValueError(
                 f"max_records_per_segment={max_records_per_segment}: "
                 "pass at least 1")
         if max_queue < 1:
             raise ValueError(f"max_queue={max_queue}: pass at least 1")
+        if max_segments < 0:
+            raise ValueError(f"max_segments={max_segments}: pass a sealed-"
+                             "segment cap >= 1 (0 keeps everything)")
         self.trace_dir = Path(trace_dir)
         self.trace_dir.mkdir(parents=True, exist_ok=True)
         self.prefix = prefix
         self.max_records_per_segment = max_records_per_segment
+        self.max_segments = max_segments
         self.fault_plan = fault_plan
         self._queue: queue.Queue = queue.Queue(maxsize=max_queue)
         self._lock = threading.Lock()
@@ -136,6 +187,7 @@ class TraceLog:
         self._dropped = 0
         self._write_errors = 0
         self._sealed = 0
+        self._pruned = 0
         self._active_records = 0
         self._closed = False
         self._fh = None
@@ -225,6 +277,31 @@ class TraceLog:
         self._part_path = None
         self._seq += 1
         self._active_records = 0
+        if self.max_segments:
+            self._prune()
+
+    def _prune(self) -> None:
+        """Retention (``max_segments``): drop the OLDEST sealed segments
+        of THIS writer's stream beyond the cap — the bounded-disk analogue
+        of the queue's counted drop-oldest. Only sealed ``*.jsonl`` files
+        of this prefix are candidates; the active part and other workers'
+        streams are never touched."""
+        sealed = sorted(
+            p for p in self.trace_dir.iterdir()
+            if (m := _SEG_RE.match(p.name)) is not None
+            and m.group("prefix") == self.prefix and not m.group("part"))
+        for path in sealed[:max(len(sealed) - self.max_segments, 0)]:
+            try:
+                path.unlink()
+                with self._lock:
+                    self._pruned += 1
+                logger.info("tracelog: pruned sealed segment %s "
+                            "(retention cap %d)", path.name,
+                            self.max_segments)
+            except OSError:
+                logger.exception("tracelog: pruning %s failed", path)
+                with self._lock:
+                    self._write_errors += 1
 
     def _drain(self) -> None:
         while True:
@@ -290,6 +367,7 @@ class TraceLog:
                 "dropped_total": self._dropped,
                 "write_errors_total": self._write_errors,
                 "segments_total": self._sealed,
+                "segments_pruned_total": self._pruned,
             }
 
 
@@ -324,3 +402,57 @@ def iter_trace(trace_dir: str | Path, prefix: str | None = None):
                                        path.name)
         except OSError:
             logger.exception("tracelog: unreadable segment %s", path)
+
+
+def trace_prefixes(trace_dir: str | Path) -> list:
+    """The distinct writer prefixes present under ``trace_dir`` (a pool's
+    ``w<id>-`` streams; ``""`` for the single-process writer), sorted."""
+    trace_dir = Path(trace_dir)
+    if not trace_dir.is_dir():
+        return []
+    found = {m.group("prefix") for p in trace_dir.iterdir()
+             if (m := _SEG_RE.match(p.name)) is not None}
+    return sorted(found)
+
+
+def iter_trace_merged(trace_dir: str | Path):
+    """Replay EVERY writer's stream under ``trace_dir`` as one
+    timestamp-ordered decision sequence.
+
+    Each per-prefix stream is time-ordered by construction (one writer
+    thread appends wallclock stamps monotonically — almost: an NTP step
+    can walk ``time.time`` backwards), so this is a k-way heap merge
+    keyed ``(ts, prefix, position-in-stream)`` — records with EQUAL
+    timestamps interleave deterministically by prefix then stream order
+    (pinned by test; the compiler and decisionview used to each ad-hoc
+    this). ``heapq.merge`` silently misorders UNsorted inputs, so each
+    stream's key is clamped to its running maximum (a clock step-back
+    keeps stream order and logs once per stream rather than corrupting
+    the merge); records without a ``ts`` field (hand-built test records,
+    foreign lines) inherit the stream's last timestamp — or sort first
+    when the stream starts without one — again keeping stream order.
+    Torn lines and unreadable segments degrade exactly as
+    :func:`iter_trace`."""
+    import heapq
+
+    def _keyed(prefix: str):
+        high = float("-inf")
+        warned = False
+        for n, record in enumerate(iter_trace(trace_dir, prefix=prefix)):
+            ts = record.get("ts")
+            if ts is None:
+                ts = high
+            elif ts < high:
+                if not warned:
+                    logger.warning(
+                        "tracelog: stream %r timestamps step backwards "
+                        "(%s < %s; clock adjustment?) — clamping to "
+                        "keep the merge stream-ordered", prefix, ts, high)
+                    warned = True
+                ts = high
+            high = ts
+            yield ((ts, prefix, n), record)
+
+    streams = [_keyed(prefix) for prefix in trace_prefixes(trace_dir)]
+    for _key, record in heapq.merge(*streams, key=lambda kr: kr[0]):
+        yield record

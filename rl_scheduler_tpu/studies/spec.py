@@ -180,7 +180,7 @@ class StudySpec:
                 allowed = {
                     "cluster_set": ("bursty_diurnal", "heterogeneous",
                                     "churn", "price_spike",
-                                    "domain_random"),
+                                    "domain_random", "trace_replay"),
                     "cluster_graph": ("price_spike",),
                 }[self.env]
                 if scn.family not in allowed:

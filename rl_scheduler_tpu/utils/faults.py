@@ -66,10 +66,18 @@ logger = logging.getLogger(__name__)
 #                      back rather than serve a badly-quantizing (or
 #                      unverifiable) candidate (scheduler/rollout.py,
 #                      scheduler/fastpath.check_int8_agreement)
+#   loopback.compile   graftloop's trace→Scenario compile raises mid-
+#                      stage — the loop ledger must record the failure
+#                      and a re-run must resume at the compile stage,
+#                      never promote (rl_scheduler_tpu/loopback/)
+#   loopback.promote   graftloop's promote stage fails before the POST —
+#                      the loop must surface the refusal with the pool
+#                      untouched on the incumbent generation
+#                      (rl_scheduler_tpu/loopback/orchestrator.py)
 SITES = ("checkpoint.save", "checkpoint.partial", "telemetry.scrape",
          "k8s.place", "backend.decide", "preempt", "scenario.churn",
          "tracelog.append", "rollout.spawn", "rollout.health",
-         "fastpath.agree")
+         "fastpath.agree", "loopback.compile", "loopback.promote")
 
 
 class FaultInjected(RuntimeError):

@@ -1045,7 +1045,10 @@ def test_rollout_drill(tmp_path):
     assert failed and all(r["generation"] == 2 for r in failed)
     # synthetic gate traffic is TAGGED: a trace consumer can exclude it
     assert sum(1 for r in records if r["endpoint"] == "probe") == probes
-    assert all(r["schema"] == 1 for r in records)
+    # schema 2 (graftloop): every record carries the replay fields era.
+    from rl_scheduler_tpu.scheduler.tracelog import TRACE_SCHEMA
+
+    assert all(r["schema"] == TRACE_SCHEMA for r in records)
 
 
 def test_healthz_rolling_and_sigkill_mid_rollout_rolls_back(tmp_path):

@@ -67,7 +67,10 @@ def test_scenarios_is_a_real_package():
     assert pkg.__file__ is not None and pkg.__file__.endswith("__init__.py")
     assert set(SCENARIOS) == {"bursty", "heterogeneous", "churn",
                               "price_spike", "randomized"}
-    assert len(FAMILIES) == 5
+    # trace_replay (graftloop) is name-built (trace_replay:<snapshot>),
+    # never a registry preset — FAMILIES grows, SCENARIOS does not.
+    assert len(FAMILIES) == 6
+    assert "trace_replay" in FAMILIES
 
 
 def test_stale_pycache_modules_do_not_import():

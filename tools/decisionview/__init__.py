@@ -93,14 +93,16 @@ def load_trace_records(trace_dir: str | Path,
                        include_probes: bool = False) -> list:
     """Replayed trace records, synthetic probe traffic EXCLUDED by
     default (``endpoint=probe`` — the client-facing numbers must match
-    what clients experienced). Reuses the trace log's own replayer
-    (``scheduler/tracelog.iter_trace`` — a stdlib-only module: sealed
-    segments then parts, torn trailing lines skipped), so the report
-    can never disagree with the writer about segment order."""
-    from rl_scheduler_tpu.scheduler.tracelog import iter_trace
+    what clients experienced). Reuses the trace log's own merged
+    replayer (``scheduler/tracelog.iter_trace_merged`` — a stdlib-only
+    module: a pool's per-worker streams heap-merged by timestamp, torn
+    trailing lines skipped), so the report can never disagree with the
+    writer about segment order and per-generation windows line up
+    chronologically across workers."""
+    from rl_scheduler_tpu.scheduler.tracelog import iter_trace_merged
 
     records = []
-    for record in iter_trace(trace_dir):
+    for record in iter_trace_merged(trace_dir):
         if not include_probes and record.get("endpoint") == "probe":
             continue
         records.append(record)

@@ -39,9 +39,12 @@ from tools.graftlint.rules import Rule, register
 # `scheduler` joined with graftroll: the serving plane's public surface
 # is now a zero-downtime contract (trace durability, rolling promotion,
 # rollback gates) — an untested public op there is an unverified claim
-# about what a live pool does under a promote.
+# about what a live pool does under a promote. `loopback` joined with
+# graftloop: its surface is the continual-learning contract (bitwise
+# trace compiles, graded promotion verdicts, SIGKILL-safe resume) — the
+# same class of claim.
 OP_DIRS = frozenset({"ops", "parallel", "scenarios", "studies",
-                     "scheduler"})
+                     "scheduler", "loopback"})
 
 
 @register
