@@ -11,7 +11,7 @@ TRACE ?= tests/fixtures/traceview/fixture.trace.json.gz
 .PHONY: lint lint-json test tier1 trace-summary obs chaos chaos-soak \
         serve-pool serve-soak rollout-drill eval-matrix scenario-bench \
         study study-list overlap-bench serve-report slo-check span-ab \
-        fastpath-ab loop-drill loop-soak
+        fastpath-ab loop-drill loop-soak transfer-grid mixture-smoke
 
 lint:
 	$(PY) -m tools.graftlint --check
@@ -151,6 +151,27 @@ study:
 
 study-list:
 	$(PY) -m rl_scheduler_tpu.studies --list
+
+# graftmix (docs/scenarios.md): the zero-shot transfer grid — the RUN
+# checkpoint (a mixture-trained generalist) vs each per-family
+# specialist (or the best paired baseline) across scenarios x node
+# counts, one graftstudy Wilson/sign-test verdict per cell. Point RUN
+# at the generalist; GRID_ARGS for specialists/seeds, e.g.
+#   make transfer-grid RUN=runs/GENERALIST \
+#     GRID_ARGS='--specialist churn=runs/CHURN --grid-nodes 8,16'
+GRID_NODES ?= 8,16
+transfer-grid:
+	JAX_PLATFORMS=cpu $(PY) -m rl_scheduler_tpu.agent.evaluate \
+		--transfer-grid $(if $(RUN),--run $(RUN)) \
+		--grid-nodes $(GRID_NODES) $(GRID_ARGS)
+
+# The graftmix drill (tier-1): a mixture smoke checkpoint trains through
+# the real CLI, the full transfer grid renders with verdicts engaged,
+# and provenance round-trips meta -> resume guards -> serving
+# conformance (tests/test_mixtures.py).
+mixture-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_mixtures.py -q \
+		-m 'not slow' -k mixture_smoke
 
 # Scenario throughput A/B vs the CSV replay (training path + env-step
 # microbench; BLAS pinned — the container's 2-thread default is measured

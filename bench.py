@@ -14,7 +14,7 @@ that depends on the computation is the only trustworthy sync).
 Baseline: the reference's Ray RLlib pipeline sustains ~60 env-steps/s on
 its documented hardware (SURVEY.md §6: 640k steps in ~3h).
 
-Prints FOUR JSON lines:
+Prints FIVE JSON lines:
 
 1. the config-3 headline {"metric", "value", "unit", "vs_baseline"} —
    unchanged schema, always first;
@@ -34,7 +34,13 @@ Prints FOUR JSON lines:
    {"overlap_collect": true, "fused_prologue": true} and the same
    "policy_path" key; each 20-update window is ONE lax.scan dispatch,
    which is exactly the program shape where rollout k+1 can overlap
-   SGD k.
+   SGD k;
+5. the set_fleet64_mixture line (graftmix, docs/scenarios.md): the SAME
+   fleet recipe on the mixture env — stacked per-family tables with a
+   per-episode family draw from the vmapped reset key — so
+   mixture-training steady state is driver-tracked beside the four
+   existing lines. Schema matches line 3 with {"mixture": "<preset>"}
+   instead of {"scenario": ...}.
 """
 
 from __future__ import annotations
@@ -101,7 +107,7 @@ def headline_metric() -> dict:
     }
 
 
-def _fleet_window(cfg, scenario=None) -> tuple[float, str]:
+def _fleet_window(cfg, scenario=None, mixture=None) -> tuple[float, str]:
     """Shared scaffold for every set_fleet64-family BENCH line:
     ``(steps_per_sec, policy_path)`` under the fetch-synced window
     methodology. Builds the exact policy the preset trains — the
@@ -117,7 +123,7 @@ def _fleet_window(cfg, scenario=None) -> tuple[float, str]:
     def build(fused: bool):
         bundle, net = make_bundle_and_net(
             "cluster_set", cfg, num_nodes=FLEET_NODES,
-            fused_set_block=fused, scenario=scenario)
+            fused_set_block=fused, scenario=scenario, mixture=mixture)
         return make_ppo_bundle(bundle, cfg, net=net)
 
     on_tpu = default_platform() == "tpu"
@@ -199,6 +205,30 @@ def fleet_scenario_metric(scenario_name: str = "bursty") -> dict:
     }
 
 
+def fleet_mixture_metric(mixture_name: str = "generalist") -> dict:
+    """set_fleet64 steady-state on the MIXTURE env (graftmix,
+    docs/scenarios.md) — the driver-tracked line for mixture-training
+    steady state, beside the per-family scenario line: identical recipe
+    and window/sync methodology, with the CSV replay swapped for the
+    stacked per-family tables + the per-episode family draw. The
+    classic-layout stack keeps the fleet policy path, fused kernel
+    included — the next chip session's generalist work shows up in the
+    driver's numbers."""
+    from rl_scheduler_tpu.agent.presets import PPO_PRESETS
+    from rl_scheduler_tpu.mixtures import get_mixture
+
+    steps_per_sec, policy_path = _fleet_window(
+        PPO_PRESETS["set_fleet64"], mixture=get_mixture(mixture_name))
+    return {
+        "metric": "set_fleet64_mixture env-steps/sec/chip "
+                  "(1024 envs x 64 nodes, fused PPO update, mixture env)",
+        "mixture": mixture_name,
+        "value": round(steps_per_sec, 1),
+        "unit": "env-steps/sec/chip",
+        "policy_path": policy_path,
+    }
+
+
 def scenario_train_bench(num_nodes: int = FLEET_NODES,
                          num_envs: int = 32, rollout_steps: int = 25,
                          iters: int = 3, repeats: int = 6) -> dict:
@@ -238,12 +268,23 @@ def scenario_train_bench(num_nodes: int = FLEET_NODES,
     # same code anywhere from 0.5x to 1.35x, while cache/frequency drift
     # hits interleaved variants equally (the repo's measurement
     # discipline, e.g. the preset-note A/Bs and the graftserve rounds).
+    from rl_scheduler_tpu.mixtures import get_mixture
+
     variants = {"csv": None}
     variants.update({name: get_scenario(name) for name in list_scenarios()})
+    # graftmix: the mixture row — same interleaved methodology, same
+    # 10% acceptance bar as the per-family rows (the per-episode family
+    # draw + stacked-table gathers must amortize to noise in the full
+    # update, like every other scenario's table work).
+    variants["mixture"] = get_mixture("generalist")
     runners, updates = {}, {}
     for name, scenario in variants.items():
-        bundle, net = make_bundle_and_net(
-            "cluster_set", cfg, num_nodes=num_nodes, scenario=scenario)
+        if name == "mixture":
+            bundle, net = make_bundle_and_net(
+                "cluster_set", cfg, num_nodes=num_nodes, mixture=scenario)
+        else:
+            bundle, net = make_bundle_and_net(
+                "cluster_set", cfg, num_nodes=num_nodes, scenario=scenario)
         init_fn, update_fn, _ = make_ppo_bundle(bundle, cfg, net=net)
         runner = jax.jit(init_fn)(jax.random.PRNGKey(0))
         update = jax.jit(
@@ -322,10 +363,18 @@ def scenario_env_step_bench(num_nodes: int = FLEET_NODES,
         fetch_sync(total)
         return [run, state, key]
 
+    from rl_scheduler_tpu.mixtures import (
+        get_mixture,
+        mixture_bundle,
+        mixture_set_params,
+    )
+
     variants = {
         "csv": cluster_set_bundle(cs.make_params(num_nodes=num_nodes))}
     variants.update({name: scenario_bundle(get_scenario(name), num_nodes)
                      for name in list_scenarios()})
+    variants["mixture"] = mixture_bundle(
+        mixture_set_params(get_mixture("generalist"), num_nodes))
     built = {name: build(b) for name, b in variants.items()}
     best = {name: float("inf") for name in variants}
     for _ in range(repeats):
@@ -529,6 +578,7 @@ def main(argv: list | None = None) -> None:
     print(json.dumps(fleet_metric()), flush=True)
     print(json.dumps(fleet_scenario_metric()), flush=True)
     print(json.dumps(fleet_overlap_metric()), flush=True)
+    print(json.dumps(fleet_mixture_metric()), flush=True)
 
 
 if __name__ == "__main__":

@@ -411,6 +411,50 @@ def structured_evaluate(env_name: str, bundle, net, params,
 MATRIX_SCHEMA_VERSION = 1
 
 
+def _load_set_checkpoint(run_dir: Path, best: bool = False) -> tuple:
+    """``((net, params, node_feat), meta)`` for a cluster_set checkpoint
+    run dir — the shared loader for the matrix's checkpoint column, the
+    transfer grid's generalist, and its per-family specialists."""
+    from rl_scheduler_tpu.utils.checkpoint import load_policy_params
+
+    if best:
+        from rl_scheduler_tpu.agent.loop import BEST_DIR
+
+        best_dir = run_dir / BEST_DIR
+        if not (best_dir / "checkpoints").is_dir():
+            # Same friendly refusal as the non-matrix --best path.
+            raise SystemExit(
+                f"--best: no best-eval checkpoint under {run_dir} "
+                "(the keeper runs whenever training has --eval-every "
+                "active)")
+        run_dir = best_dir
+    params, meta = load_policy_params(run_dir)
+    if meta.get("env") != "cluster_set":
+        raise SystemExit(
+            f"the scenario matrix/transfer grid sweeps the set family; "
+            f"checkpoint {run_dir} trained env {meta.get('env')!r}")
+    from rl_scheduler_tpu.models import SetTransformerPolicy
+
+    num_heads = meta.get("num_heads")
+    if num_heads is None:
+        # Checkpoints from before num_heads was recorded were always
+        # 4-head (the same mandatory fallback as the --run eval path).
+        num_heads = 4
+    net = SetTransformerPolicy(dim=64, depth=2, num_heads=num_heads)
+    return (net, params, meta.get("node_feat") or 6), meta
+
+
+def _trained_families(meta: dict) -> tuple:
+    """The families a checkpoint's training distribution covered — a
+    mixture's component families (graftmix meta), a single scenario's
+    family, or the bare CSV replay (domain_random-shaped)."""
+    if meta.get("mixture_families"):
+        return tuple(meta["mixture_families"])
+    if meta.get("scenario_family"):
+        return (meta["scenario_family"],)
+    return ()
+
+
 def _matrix_cell_policies(scenario_name: str, columns: dict,
                           node_feat: int, checkpoint: tuple | None) -> dict:
     """``{policy_name: policy_fn}`` for one matrix row: the hand-coded
@@ -437,6 +481,7 @@ def scenario_policy_matrix(
     episodes: int = 32,
     seed: int = 0,
     checkpoint: tuple | None = None,
+    trained_families: tuple = (),
     emit: Callable[[dict], None] | None = None,
 ) -> list[dict]:
     """The scenario × policy-family eval matrix (ROADMAP item 5).
@@ -450,8 +495,13 @@ def scenario_policy_matrix(
 
     ``checkpoint`` is ``(net, params, node_feat)`` from a trained run;
     cells whose scenario trains a different observation width record
-    ``"incompatible": true`` instead of a reward (the embed kernel bakes
-    the width — docs/scenarios.md).
+    ``"incompatible": true`` plus the structured ``reason`` field
+    (graftmix ``incompatible_reason`` — obs-width vs family vs
+    scenario-meta) instead of a reward (the embed kernel bakes the
+    width — docs/scenarios.md). ``trained_families`` (graftmix: the
+    checkpoint's training-distribution families, from meta) flags each
+    checkpoint cell ``held_out`` when its scenario's family was never
+    trained — the zero-shot columns.
 
     Emits one bench-style ``schema_version``-tagged dict per cell through
     ``emit`` (the CLI writes them as JSON lines) and returns them all.
@@ -460,6 +510,7 @@ def scenario_policy_matrix(
 
     from rl_scheduler_tpu.scenarios import (
         baseline_columns,
+        csv_reference_row,
         get_scenario,
         node_feat_for,
         scenario_bundle,
@@ -468,15 +519,13 @@ def scenario_policy_matrix(
     rows = []
     for sname in scenario_names:
         if sname == "csv":
-            from rl_scheduler_tpu.env import cluster_set as cs
-            from rl_scheduler_tpu.env.bundle import cluster_set_bundle
-
-            bundle = cluster_set_bundle(cs.make_params(num_nodes=num_nodes))
-            columns, feat = {"cost": 0, "cpu": 2}, cs.NODE_FEAT
+            bundle_fn, columns, feat, sfamily = csv_reference_row()
+            bundle = bundle_fn(num_nodes)
         else:
             scn = get_scenario(sname)
             bundle = scenario_bundle(scn, num_nodes)
             columns, feat = baseline_columns(scn), node_feat_for(scn)
+            sfamily = scn.family
         for pname, fn in _matrix_cell_policies(
                 sname, columns, feat, checkpoint).items():
             cell = {
@@ -489,10 +538,15 @@ def scenario_policy_matrix(
                 "node_feat": feat,
                 "seed": seed,
             }
+            if pname == "checkpoint" and trained_families:
+                cell["held_out"] = sfamily not in trained_families
             if fn is None:
+                from rl_scheduler_tpu.mixtures.grid import (
+                    incompatible_reason,
+                )
+
                 cell["incompatible"] = True
-                cell["note"] = (f"checkpoint trained at node_feat="
-                                f"{checkpoint[2]}, scenario observes {feat}")
+                cell.update(incompatible_reason(checkpoint[2], feat))
             else:
                 ep_rewards, _ = run_bundle_episodes(bundle, fn, episodes,
                                                     seed)
@@ -506,16 +560,21 @@ def scenario_policy_matrix(
 
 
 def matrix_summary(rows: list) -> str:
-    """Human-readable grid of the matrix cells (policies × scenarios)."""
+    """Human-readable grid of the matrix cells (policies × scenarios).
+    Scenarios whose family the checkpoint never trained on (graftmix
+    ``held_out`` cells) are starred — the zero-shot columns."""
     scenarios = list(dict.fromkeys(r["scenario"] for r in rows))
     policies = list(dict.fromkeys(r["policy"] for r in rows))
     cell = {(r["scenario"], r["policy"]): r for r in rows}
-    width = max(12, *(len(s) + 2 for s in scenarios))
+    held = {r["scenario"] for r in rows if r.get("held_out")}
+    labels = {s: s + ("*" if s in held else "") for s in scenarios}
+    width = max(12, *(len(labels[s]) + 2 for s in scenarios))
     lines = [
         "=" * (16 + width * len(scenarios)),
-        "SCENARIO x POLICY EVAL MATRIX (mean episode reward)",
+        "SCENARIO x POLICY EVAL MATRIX (mean episode reward)"
+        + ("   [* = held-out family]" if held else ""),
         "=" * (16 + width * len(scenarios)),
-        " " * 16 + "".join(f"{s:>{width}}" for s in scenarios),
+        " " * 16 + "".join(f"{labels[s]:>{width}}" for s in scenarios),
     ]
     for p in policies:
         vals = []
@@ -551,41 +610,17 @@ def _run_matrix(args) -> list:
 
     names = (["csv"] + list_scenarios() if args.scenarios == "all"
              else [s.strip() for s in args.scenarios.split(",") if s.strip()])
-    checkpoint = None
+    checkpoint, trained = None, ()
     if args.run is not None or args.best:
-        from rl_scheduler_tpu.utils.checkpoint import (
-            find_latest_run,
-            load_policy_params,
-        )
+        from rl_scheduler_tpu.utils.checkpoint import find_latest_run
 
         run_dir = Path(args.run) if args.run else find_latest_run(args.run_root)
-        if args.best:
-            from rl_scheduler_tpu.agent.loop import BEST_DIR
-
-            best_dir = run_dir / BEST_DIR
-            if not (best_dir / "checkpoints").is_dir():
-                # Same friendly refusal as the non-matrix --best path.
-                raise SystemExit(
-                    f"--best: no best-eval checkpoint under {run_dir} "
-                    "(the keeper runs whenever training has --eval-every "
-                    "active)")
-            run_dir = best_dir
-        params, meta = load_policy_params(run_dir)
-        if meta.get("env") != "cluster_set":
-            raise SystemExit(
-                f"--matrix with --run: the matrix sweeps the set family; "
-                f"checkpoint {run_dir} trained env {meta.get('env')!r}")
-        from rl_scheduler_tpu.models import SetTransformerPolicy
-
-        num_heads = meta.get("num_heads")
-        if num_heads is None:
-            # Checkpoints from before num_heads was recorded were always
-            # 4-head (the same mandatory fallback as the --run eval path).
-            num_heads = 4
-        net = SetTransformerPolicy(dim=64, depth=2, num_heads=num_heads)
-        checkpoint = (net, params, meta.get("node_feat") or 6)
+        checkpoint, meta = _load_set_checkpoint(run_dir, best=args.best)
+        trained = _trained_families(meta)
         print(f"Matrix checkpoint column: {run_dir} "
-              f"(node_feat={checkpoint[2]})")
+              f"(node_feat={checkpoint[2]}"
+              + (f", trained families: {', '.join(trained)}" if trained
+                 else "") + ")")
 
     results_dir = Path(args.results_dir)
     results_dir.mkdir(parents=True, exist_ok=True)
@@ -598,12 +633,87 @@ def _run_matrix(args) -> list:
 
         rows = scenario_policy_matrix(
             names, num_nodes=args.matrix_nodes, episodes=args.episodes,
-            seed=args.seed, checkpoint=checkpoint, emit=emit)
+            seed=args.seed, checkpoint=checkpoint, trained_families=trained,
+            emit=emit)
     summary = matrix_summary(rows)
     print(summary)
     (results_dir / "scenario_matrix.txt").write_text(summary + "\n")
     print(f"Matrix written to {out_path}")
     return rows
+
+
+def _run_transfer_grid(args) -> dict:
+    """``--transfer-grid`` mode (graftmix, docs/scenarios.md): the
+    zero-shot transfer grid — the generalist checkpoint vs each
+    per-family specialist (or the best paired baseline) across
+    scenarios × node counts, one graftstudy verdict per cell, one
+    ``transfer_grid`` JSON line + the human grid (``make
+    transfer-grid``)."""
+    from rl_scheduler_tpu.mixtures.grid import (
+        render_transfer_grid,
+        transfer_cells,
+        transfer_grid_summary,
+    )
+    from rl_scheduler_tpu.scenarios import list_scenarios
+    from rl_scheduler_tpu.utils.checkpoint import find_latest_run
+
+    run_dir = Path(args.run) if args.run else find_latest_run(args.run_root)
+    checkpoint, meta = _load_set_checkpoint(run_dir, best=args.best)
+    trained = _trained_families(meta)
+    specialists = {}
+    for item in args.specialist or ():
+        sname, sep, sdir = item.partition("=")
+        if not sep:
+            raise SystemExit(
+                f"--specialist {item!r}: pass <scenario>=<run_dir>")
+        spec_ckpt, spec_meta = _load_set_checkpoint(Path(sdir))
+        if spec_meta.get("mixture"):
+            raise SystemExit(
+                f"--specialist {sname}={sdir}: that run trained mixture "
+                f"{spec_meta['mixture']!r} — a generalist is not a "
+                "per-family specialist (the margin row would compare "
+                "the generalist against itself)")
+        if spec_meta.get("scenario") not in (None, sname):
+            raise SystemExit(
+                f"--specialist {sname}={sdir}: that run trained scenario "
+                f"{spec_meta.get('scenario')!r}, not {sname!r} — the "
+                "margin row must compare against the real specialist")
+        specialists[sname] = spec_ckpt
+    names = (["csv"] + list_scenarios() if args.scenarios == "all"
+             else [s.strip() for s in args.scenarios.split(",") if s.strip()])
+    node_counts = tuple(int(n) for n in args.grid_nodes.split(","))
+    seeds = tuple(range(args.seed, args.seed + args.grid_seeds))
+    print(f"Transfer grid: {run_dir} "
+          f"(mixture {meta.get('mixture')!r}, trained families "
+          f"{', '.join(trained) or '-'}; {len(names)} scenarios x "
+          f"{len(node_counts)} node counts, {len(seeds)} paired seeds x "
+          f"{args.grid_episodes} episodes"
+          + (f", specialists: {', '.join(sorted(specialists))}"
+             if specialists else "") + ")")
+
+    results_dir = Path(args.results_dir)
+    results_dir.mkdir(parents=True, exist_ok=True)
+    cells_path = results_dir / "transfer_grid.jsonl"
+    with cells_path.open("w") as fh:
+        def emit(cell: dict) -> None:
+            fh.write(json.dumps(cell) + "\n")
+
+        cells = transfer_cells(
+            checkpoint, names, node_counts=node_counts, seeds=seeds,
+            episodes=args.grid_episodes, specialists=specialists,
+            trained_families=trained,
+            scenario_seed=meta.get("scenario_seed", 0) or 0, emit=emit)
+    summary = transfer_grid_summary(cells, run=str(run_dir),
+                                    mixture=meta.get("mixture"),
+                                    trained_families=trained)
+    print(json.dumps(summary, sort_keys=True))
+    grid = render_transfer_grid(summary)
+    print(grid)
+    (results_dir / "transfer_grid.json").write_text(
+        json.dumps(summary, indent=2) + "\n")
+    (results_dir / "transfer_grid.txt").write_text(grid + "\n")
+    print(f"Transfer grid written to {cells_path}")
+    return summary
 
 
 def main(argv: list[str] | None = None):
@@ -635,9 +745,39 @@ def main(argv: list[str] | None = None):
                         "'all' (the registry + the csv baseline row)")
     p.add_argument("--matrix-nodes", type=int, default=8,
                    help="--matrix: node-set size each scenario builds")
+    p.add_argument("--transfer-grid", action="store_true",
+                   help="graftmix (docs/scenarios.md): the zero-shot "
+                        "transfer grid — the --run checkpoint (a "
+                        "mixture-trained generalist) vs each per-family "
+                        "specialist (--specialist) or the best paired "
+                        "baseline, across --scenarios x --grid-nodes, "
+                        "paired seeded episodes with a graftstudy "
+                        "Wilson/sign-test verdict per cell; one "
+                        "transfer_grid JSON line + the human grid "
+                        "(`make transfer-grid`)")
+    p.add_argument("--specialist", action="append", metavar="NAME=DIR",
+                   help="--transfer-grid: a per-family specialist run "
+                        "for the margin row, e.g. --specialist "
+                        "churn=runs/CHURN (repeatable; scenarios "
+                        "without one compare against the best "
+                        "hand-coded baseline on the same paired seeds)")
+    p.add_argument("--grid-nodes", default="8,16",
+                   help="--transfer-grid: comma-separated node counts "
+                        "(the grid's second axis; >= 2 for the "
+                        "acceptance protocol)")
+    p.add_argument("--grid-seeds", type=int, default=5,
+                   help="--transfer-grid: paired seeds per cell (the "
+                        "sign test's n; 5 means only 5/5 confirms)")
+    p.add_argument("--grid-episodes", type=int, default=8,
+                   help="--transfer-grid: episodes per (cell, seed)")
     p.add_argument("--results-dir", default="results")
     args = p.parse_args(argv)
 
+    if args.matrix and args.transfer_grid:
+        raise SystemExit("--matrix and --transfer-grid are different "
+                         "sweeps; pick one")
+    if args.transfer_grid:
+        return _run_transfer_grid(args)
     if args.matrix:
         return _run_matrix(args)
 
@@ -678,6 +818,7 @@ def main(argv: list[str] | None = None):
                 # train_ppo.py).
                 num_heads = 4
             scenario = None
+            mixture = None
             if meta.get("scenario"):
                 # Scenario-trained run: rebuild the SAME compiled
                 # workload (name + table seed from meta) so the policy is
@@ -690,9 +831,21 @@ def main(argv: list[str] | None = None):
                                         seed=meta.get("scenario_seed", 0))
                 print(f"Rebuilding scenario {scenario.name!r} "
                       f"(seed {scenario.seed}) from checkpoint meta")
+            elif meta.get("mixture"):
+                # graftmix generalist: rebuild the training MIXTURE so
+                # the report measures the distribution it trained for
+                # (the per-family columns live in the transfer grid,
+                # evaluate --transfer-grid).
+                from rl_scheduler_tpu.mixtures import get_mixture
+
+                mixture = get_mixture(meta["mixture"])
+                print(f"Rebuilding mixture {meta['mixture']!r} "
+                      f"(seed {meta.get('scenario_seed', 0)}) from "
+                      "checkpoint meta")
             bundle, net = make_bundle_and_net(
                 ckpt_env, PPOTrainConfig(), num_heads=num_heads,
-                scenario=scenario,
+                scenario=scenario, mixture=mixture,
+                mixture_seed=meta.get("scenario_seed", 0) or 0,
                 # Rebuild the env at the trained node count (fleet
                 # checkpoints; pre-fleet meta lacks the key -> default 8)
                 # and keep flash attention for flash-trained runs — at

@@ -109,7 +109,9 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
                         num_nodes: int | None = None,
                         flash_attn: bool = False,
                         fused_set_block: bool = False,
-                        scenario=None):
+                        scenario=None,
+                        mixture=None,
+                        mixture_seed: int = 0):
     """``(bundle, net)`` for each BASELINE env family.
 
     ``net=None`` means the default flat-obs ActorCritic; the set/graph envs
@@ -136,6 +138,15 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
     multi_cloud takes bursty_diurnal/price_spike cloud tables (plus
     random episode phases); cluster_graph takes the price_spike family's
     raw dollar regimes.
+
+    ``mixture`` (graftmix, a :class:`rl_scheduler_tpu.mixtures.
+    MixtureSpec`) swaps the cluster_set env for the stacked mixture
+    bundle: a per-episode family index drawn from the vmapped reset key
+    selects which component's tables the episode replays
+    (``mixtures/env.py``). The observation keeps the classic 6-feature
+    layout, so every cluster_set policy path — flax, ``fused_set``,
+    ``fused_set_block``, flash — composes unchanged; ``mixture_seed``
+    re-seeds every component's table compilation (``--scenario-seed``).
     """
     dtype = None
     if cfg.compute_dtype == "bfloat16":
@@ -180,28 +191,40 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
                 kwargs["attn_impl"] = "flash"
             return het, SetTransformerPolicy(dim=64, depth=2, dtype=dtype,
                                              **kwargs)
-        if scenario is not None:
+        if mixture is not None:
+            # graftmix: the stacked mixture bundle (classic obs layout —
+            # every policy path below composes unchanged).
+            from rl_scheduler_tpu.mixtures import (
+                mixture_bundle,
+                mixture_set_params,
+            )
+
+            set_bundle = mixture_bundle(mixture_set_params(
+                mixture, num_nodes if num_nodes is not None else 8,
+                seed=mixture_seed))
+        elif scenario is not None:
             from rl_scheduler_tpu.scenarios import cluster_set_params
 
-            set_params = cluster_set_params(
-                scenario, num_nodes if num_nodes is not None else 8)
+            set_bundle = cluster_set_bundle(cluster_set_params(
+                scenario, num_nodes if num_nodes is not None else 8))
         else:
-            set_params = cs.make_params(
+            set_bundle = cluster_set_bundle(cs.make_params(
                 **({} if num_nodes is None else {"num_nodes": num_nodes})
-            )
+            ))
         if fused_set_block:
             from rl_scheduler_tpu.models.set_fast import FusedBlockSetPolicy
 
             # Shape-specialized kernel: built at the env's actual node
             # count (constructor refuses non-fleet N with the pointer to
             # the dense path).
-            return cluster_set_bundle(set_params), FusedBlockSetPolicy(
-                num_nodes=set_params.num_nodes, dim=64, depth=2, dtype=dtype,
+            return set_bundle, FusedBlockSetPolicy(
+                num_nodes=set_bundle.num_actions, dim=64, depth=2,
+                dtype=dtype,
             )
         if fused_set:
             from rl_scheduler_tpu.models.set_fast import BatchMinorSetPolicy
 
-            return cluster_set_bundle(set_params), BatchMinorSetPolicy(
+            return set_bundle, BatchMinorSetPolicy(
                 dim=64, depth=2, dtype=dtype
             )
         from rl_scheduler_tpu.models import SetTransformerPolicy
@@ -209,7 +232,7 @@ def make_bundle_and_net(env_name: str, cfg, legacy_reward_sign: bool = False,
         kwargs = {} if num_heads is None else {"num_heads": num_heads}
         if flash_attn:
             kwargs["attn_impl"] = "flash"
-        return cluster_set_bundle(set_params), SetTransformerPolicy(
+        return set_bundle, SetTransformerPolicy(
             dim=64, depth=2, dtype=dtype, **kwargs
         )
     if env_name == "cluster_graph":
@@ -276,7 +299,26 @@ def main(argv: list[str] | None = None) -> Path:
     p.add_argument("--scenario-seed", type=int, default=0,
                    help="seed for the scenario's table compilation "
                         "(independent of --seed, so a reseeded training "
-                        "attempt keeps the SAME workload)")
+                        "attempt keeps the SAME workload); with "
+                        "--mixture it re-seeds every component's tables")
+    p.add_argument("--mixture", default=None,
+                   help="graftmix (docs/scenarios.md): train the "
+                        "GENERALIST on a seeded mixture curriculum over "
+                        "scenario families instead of one workload — a "
+                        "registered preset (generalist | "
+                        "generalist_anneal) or an inline "
+                        "mixture:<scenario>*<w>+...[@anneal=E&from=...] "
+                        "spec. Each episode draws its family from the "
+                        "env's own vmapped reset key; weight-zero "
+                        "components are refused as inert. cluster_set "
+                        "only (the default env when this flag is set); "
+                        "composable with --scenario-seed, "
+                        "--overlap-collect, and the fleet presets. "
+                        "Recorded in checkpoint meta — evaluation "
+                        "rebuilds the same mixture, the transfer grid "
+                        "reads the trained families, and serving "
+                        "conformance answers --scenario with the "
+                        "mixture name")
     p.add_argument("--sample-temp-anneal", type=float, default=None,
                    metavar="T_END",
                    help="anti-latch intervention (ROADMAP 3b, "
@@ -505,9 +547,12 @@ def main(argv: list[str] | None = None) -> Path:
             # an explicit --num-nodes overrides a preset's implied default.
             args.num_nodes = implied.get("num_nodes")
     if args.env is None:
-        # A scenario names a workload for the structured set family by
-        # default; the flat flagship stays the no-flag default.
-        args.env = "cluster_set" if args.scenario is not None else "multi_cloud"
+        # A scenario (or mixture) names a workload for the structured
+        # set family by default; the flat flagship stays the no-flag
+        # default.
+        args.env = ("cluster_set"
+                    if args.scenario is not None or args.mixture is not None
+                    else "multi_cloud")
 
     if args.resume and args.resume_best:
         # Validate before ANY side effect (run dir, managers): the two
@@ -525,6 +570,23 @@ def main(argv: list[str] | None = None) -> Path:
             "--warm-start is single-chip for now (the sharded init paths "
             "own their param layout); drop --dp/--sp/--tp")
 
+    mixture = None
+    if args.mixture is not None:
+        if args.scenario is not None:
+            raise SystemExit(
+                "--mixture IS a distribution over scenarios; --scenario "
+                "names a single one — pick one flag")
+        if args.env != "cluster_set":
+            raise SystemExit(
+                f"--mixture trains the set family's generalist; --env "
+                f"{args.env} has no mixture bundle (use cluster_set)")
+        from rl_scheduler_tpu.mixtures import get_mixture
+
+        try:
+            mixture = get_mixture(args.mixture)
+        except ValueError as e:
+            raise SystemExit(f"--mixture: {e}")
+
     scenario = None
     if args.scenario is not None:
         from rl_scheduler_tpu.scenarios import get_scenario, node_feat_for
@@ -537,7 +599,7 @@ def main(argv: list[str] | None = None) -> Path:
             "multi_cloud": ("bursty_diurnal", "price_spike"),
             "cluster_set": ("bursty_diurnal", "heterogeneous", "churn",
                             "price_spike", "domain_random",
-                            "trace_replay"),
+                            "trace_replay", "external_trace"),
             "cluster_graph": ("price_spike",),
         }
         allowed = env_families.get(args.env, ())
@@ -974,7 +1036,8 @@ def main(argv: list[str] | None = None) -> Path:
                                       num_nodes=args.num_nodes,
                                       flash_attn=args.flash_attn,
                                       fused_set_block=args.fused_set_block,
-                                      scenario=scenario)
+                                      scenario=scenario, mixture=mixture,
+                                      mixture_seed=args.scenario_seed)
     eval_net = None
     if args.sp > 1:
         # Training net: the bundle's own policy cloned with axis_name="sp"
@@ -1046,7 +1109,7 @@ def main(argv: list[str] | None = None) -> Path:
                 "would silently switch the training distribution mid-run "
                 + (f"(pass --scenario {ckpt_scn})" if ckpt_scn
                    else "(drop --scenario)"))
-        if (args.scenario is not None
+        if ((args.scenario is not None or args.mixture is not None)
                 and meta.get("scenario_seed") is not None
                 and meta.get("scenario_seed") != args.scenario_seed):
             raise SystemExit(
@@ -1055,6 +1118,21 @@ def main(argv: list[str] | None = None) -> Path:
                 f"{args.scenario_seed} would swap the compiled workload "
                 f"tables mid-run (pass --scenario-seed "
                 f"{meta['scenario_seed']})")
+        # graftmix: the mixture spec is the training DISTRIBUTION — a
+        # resumed run must keep it verbatim (canonical-name compare, so
+        # a preset name and its inline expansion match). Checkpoints
+        # from before the flag recorded nothing -> no mixture.
+        ckpt_mix = meta.get("mixture")
+        want_mix = mixture.canonical_name() if mixture is not None else None
+        if ckpt_mix != want_mix:
+            raise SystemExit(
+                f"{resume_flag}: run was trained on "
+                f"{'mixture ' + repr(ckpt_mix) if ckpt_mix else 'a single workload'}; "
+                f"resuming on "
+                f"{'mixture ' + repr(want_mix) if want_mix else 'a single workload'} "
+                "would silently switch the training distribution mid-run "
+                + (f"(pass --mixture {ckpt_mix!r})" if ckpt_mix
+                   else "(drop --mixture)"))
         # The seed that INITIALIZED the weights: carried forward into the
         # resumed run's checkpoint meta so attribution survives a resume
         # under a different --seed (which only changes the continuation's
@@ -1393,6 +1471,14 @@ def main(argv: list[str] | None = None) -> Path:
         from rl_scheduler_tpu.scenarios import scenario_meta
 
         checkpoint_extras.update(scenario_meta(scenario))
+    elif mixture is not None:
+        # graftmix provenance: the canonical mixture name rebuilds the
+        # training distribution at eval time, the resume guard pins it,
+        # the transfer grid reads the trained families from it, and the
+        # extender's conformance demand answers --scenario with it.
+        from rl_scheduler_tpu.mixtures import mixture_meta
+
+        checkpoint_extras.update(mixture_meta(mixture, args.scenario_seed))
     else:
         checkpoint_extras["scenario"] = None
 

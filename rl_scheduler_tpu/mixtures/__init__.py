@@ -1,0 +1,65 @@
+"""graftmix — external-trace import, mixture curricula, transfer grid.
+
+The generalist subsystem (ROADMAP item 4): one policy over the scenario
+universe. Three layers:
+
+- **importer** (``importer.py`` + ``fixtures.py``): public cluster
+  traces (Google ClusterData-style machine-event + task-usage CSVs,
+  Alibaba cluster-trace-v2018-style machine/container tables) compiled
+  through the shipped ``data/normalize`` pipeline into the
+  ``external_trace:<dir>?format=...`` scenario family —
+  schema-validated with counted row rejection, bitwise-deterministic
+  per (trace digest, seed), seeded synthetic fixtures so tier-1 stays
+  off-network.
+- **curricula** (``curriculum.py`` + ``env.py``): ``MixtureSpec`` —
+  named (family, weight) components, optional easy→adversarial anneal —
+  compiled to stacked per-family env tables with a per-episode family
+  index drawn from the vmapped reset key; ``train_ppo --mixture``.
+- **transfer grid** (``grid.py``): ``evaluate --transfer-grid`` /
+  ``make transfer-grid`` — the generalist vs each per-family specialist
+  (or the best hand-coded baseline) on paired seeded episodes, one
+  graftstudy Wilson/sign-test verdict per (scenario × node count) cell,
+  held-out families flagged.
+
+Design doc: ``docs/scenarios.md`` (graftmix sections).
+"""
+
+from rl_scheduler_tpu.mixtures.curriculum import (
+    MIXTURES,
+    MixtureSpec,
+    get_mixture,
+    list_mixtures,
+    mixture_meta,
+    parse_mixture,
+)
+from rl_scheduler_tpu.mixtures.env import (
+    MixtureSetParams,
+    MixtureState,
+    mixture_bundle,
+    mixture_set_params,
+)
+from rl_scheduler_tpu.mixtures.importer import (
+    ImportedTrace,
+    ImportReport,
+    TraceImportError,
+    import_external_trace,
+    trace_digest,
+)
+
+__all__ = [
+    "MIXTURES",
+    "MixtureSpec",
+    "get_mixture",
+    "list_mixtures",
+    "mixture_meta",
+    "parse_mixture",
+    "MixtureSetParams",
+    "MixtureState",
+    "mixture_bundle",
+    "mixture_set_params",
+    "ImportedTrace",
+    "ImportReport",
+    "TraceImportError",
+    "import_external_trace",
+    "trace_digest",
+]

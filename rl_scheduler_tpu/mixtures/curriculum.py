@@ -1,0 +1,249 @@
+"""graftmix part 2: seeded mixture curricula over the scenario universe.
+
+A :class:`MixtureSpec` names a training DISTRIBUTION over scenario
+families: weighted components (each a registered scenario preset or a
+name-built ``trace_replay:``/``external_trace:`` spec), optionally with
+an easy→adversarial anneal schedule. It compiles (``mixtures/env.py``)
+into stacked per-family env tables with a per-episode family index drawn
+from the vmapped reset key — the per-episode randomization substrate the
+scenario layer already rides — so one jitted fleet program trains the
+generalist across every component without a single host round-trip.
+
+**The name IS the spec** (the ``trace_replay:`` convention): the
+canonical form
+
+    ``mixture:<name>*<w>+<name>*<w>[@anneal=E&from=<name>*<w>+...]``
+
+round-trips through ``train_ppo --mixture``, checkpoint meta, the
+``--resume`` guards, and the extender's serving-conformance demand.
+Weights are relative (normalized at compile); ``anneal=E`` linearly
+interpolates from the ``from=`` weights to the final weights over each
+env lane's first ``E`` EPISODES (episodes, not iterations, because the
+family draw happens at the vmapped auto-reset inside the jitted update —
+``docs/scenarios.md`` gives the episodes↔iterations arithmetic:
+``episodes ≈ iterations * rollout_steps / episode_steps``).
+
+**Spec discipline** (graftstudy's): everything inert is refused at
+construction — a weight-zero component (it would never train at steady
+state), a single-component mixture (that is ``--scenario``), a
+duplicate component, an anneal whose start equals its end, ``from=``
+without ``anneal=``, and any component whose observation width differs
+from the classic 6-feature layout (the heterogeneous family — stacked
+tables need one obs shape; the transfer grid reports that cell
+``incompatible`` with the obs-width reason instead).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+MIXTURE_PREFIX = "mixture:"
+
+
+def _fmt_components(components: tuple) -> str:
+    return "+".join(f"{name}*{w:g}" for name, w in components)
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtureSpec:
+    """A frozen, validated mixture curriculum (module docstring).
+
+    ``components``/``start`` are ``((scenario_name, weight), ...)``
+    tuples; ``start`` is aligned to ``components`` by name and only
+    present with a nonzero ``anneal_episodes``.
+    """
+
+    components: tuple
+    anneal_episodes: int = 0
+    start: tuple = ()
+
+    def __post_init__(self):
+        if len(self.components) < 2:
+            raise ValueError(
+                "a mixture needs >= 2 components — a single-family "
+                "curriculum is --scenario, not --mixture")
+        names = [n for n, _ in self.components]
+        if len(set(names)) != len(names):
+            raise ValueError(
+                f"duplicate mixture components: {names} — merge the "
+                "weights instead")
+        for name, w in self.components:
+            if not w > 0:
+                raise ValueError(
+                    f"component {name!r} has weight {w}: weight-zero "
+                    "(or negative) components are inert — a family that "
+                    "never draws never trains; drop it from the spec")
+        if self.anneal_episodes < 0:
+            raise ValueError(
+                f"anneal={self.anneal_episodes}: the anneal horizon is "
+                "an episode count >= 0 (0 = static weights)")
+        if self.start and not self.anneal_episodes:
+            raise ValueError(
+                "from= start weights without anneal= are inert (the "
+                "schedule never runs); pass both or neither")
+        if self.anneal_episodes:
+            if not self.start:
+                raise ValueError(
+                    "anneal= needs from= start weights (which easy "
+                    "distribution the curriculum opens on)")
+            extra = {n for n, _ in self.start} - set(names)
+            if extra:
+                raise ValueError(
+                    f"from= names components not in the mixture: "
+                    f"{sorted(extra)}")
+            bad = [n for n, w in self.start if w < 0]
+            if bad:
+                raise ValueError(
+                    f"from= weights must be >= 0 (start-at-zero is how a "
+                    "family anneals IN): {bad}")
+            if not sum(w for _, w in self.start) > 0:
+                raise ValueError("from= weights must not all be zero")
+            if self._normalized(self.start_weights()) == \
+                    self._normalized([w for _, w in self.components]):
+                raise ValueError(
+                    "anneal from= equals the final weights — an inert "
+                    "schedule; drop anneal=/from= for a static mixture")
+        # Every component must parse/resolve NOW (the graftstudy
+        # at-construction discipline: a typo'd family name must fail
+        # before any training), and stacked tables need one obs width.
+        from rl_scheduler_tpu.scenarios import get_scenario, node_feat_for
+        from rl_scheduler_tpu.env.cluster_set import NODE_FEAT
+
+        for name, _ in self.components:
+            scn = get_scenario(name)
+            feat = node_feat_for(scn)
+            if feat != NODE_FEAT:
+                raise ValueError(
+                    f"component {name!r} (family {scn.family}) observes "
+                    f"{feat} features; mixture tables stack the classic "
+                    f"{NODE_FEAT}-feature layout — the heterogeneous "
+                    "family trains alone and joins the transfer grid as "
+                    "a held-out column")
+
+    @staticmethod
+    def _normalized(ws: list) -> tuple:
+        total = sum(ws)
+        return tuple(round(w / total, 9) for w in ws)
+
+    def names(self) -> tuple:
+        return tuple(n for n, _ in self.components)
+
+    def families(self) -> tuple:
+        """The component FAMILIES this mixture trains on — the transfer
+        grid's held-out test reads this from checkpoint meta."""
+        from rl_scheduler_tpu.scenarios import get_scenario
+
+        return tuple(sorted({get_scenario(n).family for n, _ in
+                             self.components}))
+
+    def weights(self) -> tuple:
+        """Final (steady-state) weights, normalized to sum 1."""
+        return self._normalized([w for _, w in self.components])
+
+    def start_weights(self) -> tuple:
+        """Anneal start weights aligned to ``components`` order (final
+        weights when no anneal), normalized to sum 1."""
+        if not self.anneal_episodes:
+            return self.weights()
+        by_name = dict(self.start)
+        raw = [by_name.get(n, 0.0) for n, _ in self.components]
+        return self._normalized(raw)
+
+    def canonical_name(self) -> str:
+        """The one round-tripping string (module docstring):
+        ``parse_mixture(spec.canonical_name()) == spec``."""
+        name = MIXTURE_PREFIX + _fmt_components(self.components)
+        if self.anneal_episodes:
+            name += (f"@anneal={self.anneal_episodes}"
+                     f"&from={_fmt_components(self.start)}")
+        return name
+
+
+def parse_mixture(name: str) -> MixtureSpec:
+    """Parse the canonical ``mixture:...`` string (module docstring).
+
+    Component weights split on the LAST ``*`` of each ``+``-separated
+    term, so name-built components (``external_trace:<dir>?format=...``)
+    carrying ``?``/``&`` in their own query parse unchanged; the
+    mixture-level suffix splits on the last ``@anneal=``."""
+    if not name.startswith(MIXTURE_PREFIX):
+        raise ValueError(
+            f"mixture spec {name!r} must start with {MIXTURE_PREFIX!r} "
+            "(or name a registered preset; list_mixtures())")
+    body = name[len(MIXTURE_PREFIX):]
+    anneal_episodes, start = 0, ()
+    if "@anneal=" in body:
+        body, _, suffix = body.rpartition("@anneal=")
+        anneal_part, _, from_part = suffix.partition("&from=")
+        try:
+            anneal_episodes = int(anneal_part)
+        except ValueError:
+            raise ValueError(
+                f"mixture spec {name!r}: bad anneal episode count "
+                f"{anneal_part!r}")
+        if from_part:
+            start = _parse_components(from_part, name)
+    components = _parse_components(body, name)
+    return MixtureSpec(components=components,
+                       anneal_episodes=anneal_episodes, start=start)
+
+
+def _parse_components(body: str, name: str) -> tuple:
+    out = []
+    for term in body.split("+"):
+        comp, sep, w = term.rpartition("*")
+        if not sep:
+            raise ValueError(
+                f"mixture spec {name!r}: component {term!r} needs "
+                "<scenario>*<weight>")
+        try:
+            out.append((comp, float(w)))
+        except ValueError:
+            raise ValueError(
+                f"mixture spec {name!r}: bad weight {w!r} for "
+                f"component {comp!r}")
+    return tuple(out)
+
+
+# Registry presets: the one-command curricula. `generalist` is THE
+# transfer-grid training distribution — every classic-width registry
+# family, equal weight. `generalist_anneal` opens easy (the CSV-shaped
+# domain_random workload) and anneals toward the adversarial families
+# (churn + price spikes) over the first 200 episodes per lane.
+MIXTURES = {
+    "generalist": "mixture:bursty*1+churn*1+price_spike*1+randomized*1",
+    "generalist_anneal": ("mixture:bursty*1+churn*1.5+price_spike*1.5"
+                          "+randomized*1@anneal=200"
+                          "&from=randomized*3+bursty*1"),
+}
+
+
+def list_mixtures() -> list:
+    return sorted(MIXTURES)
+
+
+def get_mixture(name: str) -> MixtureSpec:
+    """Preset lookup or inline ``mixture:...`` parse — the one entry
+    every CLI flag and meta rebuild goes through."""
+    if name in MIXTURES:
+        return parse_mixture(MIXTURES[name])
+    if name.startswith(MIXTURE_PREFIX):
+        return parse_mixture(name)
+    raise ValueError(
+        f"unknown mixture {name!r}; registered: {list_mixtures()} (or an "
+        f"inline {MIXTURE_PREFIX}<scenario>*<w>+... spec)")
+
+
+def mixture_meta(spec: MixtureSpec, scenario_seed: int = 0) -> dict:
+    """The checkpoint-meta record (the ``scenario_meta`` counterpart):
+    enough to rebuild the training distribution at eval time, pin the
+    resume guards, and answer the serving-conformance demand."""
+    from rl_scheduler_tpu.env.cluster_set import NODE_FEAT
+
+    return {
+        "scenario": None,
+        "mixture": spec.canonical_name(),
+        "mixture_families": list(spec.families()),
+        "scenario_seed": scenario_seed,
+        "node_feat": NODE_FEAT,
+    }

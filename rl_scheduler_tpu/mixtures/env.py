@@ -1,0 +1,292 @@
+"""graftmix env layer: stacked per-family tables, per-episode family draw.
+
+One :class:`MixtureSetParams` holds EVERY component's compiled
+``cluster_set`` tables stacked on a leading family axis ``[K, ...]``.
+At each episode boundary (reset and every vmapped auto-reset) the env
+draws a family index from its own ``jax.random`` key — the per-episode
+randomization substrate from the scenario layer — then steps the
+UNCHANGED ``env/cluster_set.py`` pure functions over that family's
+slice. Nothing here forks the env semantics: :func:`episode_params`
+materializes a per-episode :class:`~rl_scheduler_tpu.env.cluster_set.
+ClusterSetParams` by indexing the stacks, so every family's reward
+terms, churn masks, and randomization draws are exactly the single-
+family env's (the densification identities — all-ones avail mask,
+degenerate randomization ranges — are the bitwise no-ops the scenario
+suite already pins).
+
+Densification: the stacked layout needs structural uniformity, so
+components without a field get its identity value — ``pod_scale`` all
+ones, ``avail_mask`` all ones (churn penalty then contributes exactly
+0.0), missing randomization ranges become degenerate ``[x, x]`` ranges
+around the component's static value. ``random_phase`` is a Python bool
+on the single env (structural, untraceable per family), so the mixture
+always resets with it ON and value-gates the drawn phase by the
+component's flag — components without random phase land back on row 0
+with the pod re-drawn at that row from a dedicated key (one extra,
+unconditional draw per reset: the fixed-draw-order discipline).
+
+The anneal schedule lives in the STATE: each env lane counts its own
+episodes (``MixtureState.ep_count``, carried through the custom
+auto-reset), and the draw weights interpolate start→final over the
+first ``anneal_episodes`` episodes — resume-safe (the counter rides the
+full-state checkpoint tree) and fully vmappable.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rl_scheduler_tpu.env import cluster_set as cs
+from rl_scheduler_tpu.env.bundle import EnvBundle
+from rl_scheduler_tpu.mixtures.curriculum import MixtureSpec
+
+
+class MixtureSetParams(NamedTuple):
+    """Stacked per-family env tables (leading axis K = components) plus
+    the draw schedule. Scalar env knobs that cannot differ between
+    components (weights of the reward terms, the node→cloud map, episode
+    length) stay unstacked."""
+
+    # --- per-family stacks [K, ...] ---
+    costs: jnp.ndarray           # [K, T, 2]
+    latencies: jnp.ndarray       # [K, T, 2]
+    pod_scale: jnp.ndarray       # [K, T] (ones = identity)
+    avail_mask: jnp.ndarray      # [K, T, N] (ones = identity)
+    churn_penalty: jnp.ndarray   # [K]
+    node_jitter: jnp.ndarray     # [K]
+    pod_cpu_low: jnp.ndarray     # [K]
+    pod_cpu_high: jnp.ndarray    # [K]
+    drain_rate: jnp.ndarray      # [K]
+    overload_penalty: jnp.ndarray  # [K]
+    jitter_range: jnp.ndarray    # [K, 2]
+    drain_range: jnp.ndarray     # [K, 2]
+    overload_range: jnp.ndarray  # [K, 2]
+    random_phase_flag: jnp.ndarray  # [K] f32 0/1
+    # --- shared ---
+    cloud_of_node: jnp.ndarray   # [N]
+    cost_weight: jnp.ndarray
+    latency_weight: jnp.ndarray
+    reward_scale: jnp.ndarray
+    max_steps: jnp.ndarray
+    # --- draw schedule ---
+    weights: jnp.ndarray         # [K] final, sums to 1
+    start_weights: jnp.ndarray   # [K] anneal start (== weights if none)
+    anneal_episodes: jnp.ndarray  # scalar f32, 0 = static
+
+    @property
+    def num_components(self) -> int:
+        return self.costs.shape[0]
+
+    @property
+    def num_nodes(self) -> int:
+        return self.cloud_of_node.shape[0]
+
+
+class MixtureState(NamedTuple):
+    family: jnp.ndarray    # scalar int32: this episode's component
+    ep_count: jnp.ndarray  # scalar int32: episodes completed by this lane
+    inner: cs.ClusterSetState
+
+    # The generic auto-reset helpers key on `.key`; route to the inner
+    # env's carry so the mixture state satisfies the same contract.
+    @property
+    def key(self):
+        return self.inner.key
+
+
+def mixture_set_params(spec: MixtureSpec, num_nodes: int = 8,
+                       seed: int = 0) -> MixtureSetParams:
+    """Compile a :class:`MixtureSpec` into stacked env params.
+
+    ``seed`` re-seeds every component's table compilation (the
+    ``--scenario-seed`` composition: a reseeded training attempt keeps
+    the same workload stack). All components must compile tables of one
+    length — registry families share the 100-row convention; name-built
+    trace components pin ``steps=`` in their name to match.
+    """
+    from rl_scheduler_tpu.scenarios import get_scenario, cluster_set_params
+
+    per = [cluster_set_params(get_scenario(n, seed=seed), num_nodes)
+           for n in spec.names()]
+    rows = {p.costs.shape[0] for p in per}
+    if len(rows) > 1:
+        detail = ", ".join(f"{n}={p.costs.shape[0]}"
+                           for n, p in zip(spec.names(), per))
+        raise ValueError(
+            f"mixture components compile tables of different lengths "
+            f"({detail}); stacked replay needs one length — pin steps= "
+            "on the name-built components")
+    t = rows.pop()
+    for field in ("cost_weight", "latency_weight", "reward_scale",
+                  "max_steps"):
+        vals = {float(getattr(p, field)) for p in per}
+        if len(vals) > 1:
+            raise ValueError(
+                f"mixture components disagree on shared env knob "
+                f"{field}: {sorted(vals)}")
+    f32 = lambda x: jnp.asarray(x, jnp.float32)
+
+    def dense(p: cs.ClusterSetParams) -> dict:
+        ident_range = lambda rg, x: (np.asarray(rg, np.float32)
+                                     if rg is not None
+                                     else np.asarray([x, x], np.float32))
+        return dict(
+            costs=np.asarray(p.costs, np.float32),
+            latencies=np.asarray(p.latencies, np.float32),
+            pod_scale=(np.asarray(p.pod_scale, np.float32)
+                       if p.pod_scale is not None
+                       else np.ones(t, np.float32)),
+            avail_mask=(np.asarray(p.avail_mask, np.float32)
+                        if p.avail_mask is not None
+                        else np.ones((t, num_nodes), np.float32)),
+            churn_penalty=(float(p.churn_penalty)
+                           if p.churn_penalty is not None else 0.0),
+            node_jitter=float(p.node_jitter),
+            pod_cpu_low=float(p.pod_cpu_low),
+            pod_cpu_high=float(p.pod_cpu_high),
+            drain_rate=float(p.drain_rate),
+            overload_penalty=float(p.overload_penalty),
+            jitter_range=ident_range(p.jitter_range, float(p.node_jitter)),
+            drain_range=ident_range(p.drain_range, float(p.drain_rate)),
+            overload_range=ident_range(p.overload_range,
+                                       float(p.overload_penalty)),
+            random_phase_flag=1.0 if p.random_phase else 0.0,
+        )
+
+    stacks = [dense(p) for p in per]
+    stacked = {k: f32(np.stack([s[k] for s in stacks]))
+               for k in stacks[0]}
+    return MixtureSetParams(
+        **stacked,
+        cloud_of_node=per[0].cloud_of_node,
+        cost_weight=per[0].cost_weight,
+        latency_weight=per[0].latency_weight,
+        reward_scale=per[0].reward_scale,
+        max_steps=per[0].max_steps,
+        weights=f32(spec.weights()),
+        start_weights=f32(spec.start_weights()),
+        anneal_episodes=f32(spec.anneal_episodes),
+    )
+
+
+def episode_params(params: MixtureSetParams,
+                   family: jnp.ndarray) -> cs.ClusterSetParams:
+    """The per-episode single-family view: every stacked leaf indexed at
+    ``family`` (traced-safe), identity leaves included — the unchanged
+    ``cluster_set`` reset/step consume it as-is. ``random_phase`` stays
+    structurally True; :func:`reset` value-gates the drawn phase."""
+    return cs.ClusterSetParams(
+        costs=params.costs[family],
+        latencies=params.latencies[family],
+        cloud_of_node=params.cloud_of_node,
+        cost_weight=params.cost_weight,
+        latency_weight=params.latency_weight,
+        reward_scale=params.reward_scale,
+        overload_penalty=params.overload_penalty[family],
+        node_jitter=params.node_jitter[family],
+        pod_cpu_low=params.pod_cpu_low[family],
+        pod_cpu_high=params.pod_cpu_high[family],
+        drain_rate=params.drain_rate[family],
+        max_steps=params.max_steps,
+        pod_scale=params.pod_scale[family],
+        avail_mask=params.avail_mask[family],
+        churn_penalty=params.churn_penalty[family],
+        jitter_range=params.jitter_range[family],
+        drain_range=params.drain_range[family],
+        overload_range=params.overload_range[family],
+        random_phase=True,
+    )
+
+
+def weights_at(params: MixtureSetParams,
+               ep_count: jnp.ndarray) -> jnp.ndarray:
+    """Draw weights for a lane's ``ep_count``-th episode: linear
+    start→final over ``anneal_episodes`` (already final when static —
+    the compile sets start == final then, so the formula degenerates)."""
+    frac = jnp.where(
+        params.anneal_episodes > 0,
+        jnp.clip(ep_count.astype(jnp.float32)
+                 / jnp.maximum(params.anneal_episodes, 1.0), 0.0, 1.0),
+        1.0)
+    w = params.start_weights + frac * (params.weights
+                                       - params.start_weights)
+    return w / w.sum()
+
+
+def draw_family(params: MixtureSetParams, key: jnp.ndarray,
+                ep_count: jnp.ndarray) -> jnp.ndarray:
+    """One seeded family index ~ Categorical(:func:`weights_at`)."""
+    cum = jnp.cumsum(weights_at(params, ep_count))
+    u = jax.random.uniform(key, (), jnp.float32)
+    idx = jnp.searchsorted(cum, u, side="right")
+    return jnp.clip(idx, 0, params.num_components - 1).astype(jnp.int32)
+
+
+def reset(params: MixtureSetParams, key: jnp.ndarray,
+          ep_count: jnp.ndarray | int = 0
+          ) -> tuple[MixtureState, jnp.ndarray]:
+    """Draw this episode's family, then the single-family reset.
+
+    The inner reset runs with ``random_phase`` structurally on (the
+    stacked params' one static shape); the drawn phase is then
+    value-gated by the family's flag and the pending pod re-drawn at the
+    gated row from a dedicated key — unconditional, so the split count
+    and draw order are identical for every family (vmap-uniform)."""
+    ep_count = jnp.asarray(ep_count, jnp.int32)
+    fam_key, env_key, pod_key = jax.random.split(key, 3)
+    family = draw_family(params, fam_key, ep_count)
+    ep = episode_params(params, family)
+    inner, _ = cs.reset(ep, env_key)
+    flag = (params.random_phase_flag[family] > 0).astype(jnp.int32)
+    inner = inner._replace(phase=inner.phase * flag)
+    inner = inner._replace(
+        pod_cpu=cs._draw_pod(ep, pod_key, cs._table_row(ep, inner)))
+    state = MixtureState(family=family, ep_count=ep_count, inner=inner)
+    return state, cs._observe(ep, inner)
+
+
+def step(params: MixtureSetParams, state: MixtureState,
+         action: jnp.ndarray) -> tuple[MixtureState, cs.TimeStep]:
+    """Single step inside the episode's family (pure, jit/vmap-safe)."""
+    ep = episode_params(params, state.family)
+    inner, ts = cs.step(ep, state.inner, action)
+    return state._replace(inner=inner), ts
+
+
+def mixture_bundle(params: MixtureSetParams) -> EnvBundle:
+    """The mixture env as an :class:`EnvBundle` — the same vmapped
+    auto-reset fleet path every family trains through, with ONE
+    difference from ``bundle_from_single``: the auto-reset threads the
+    lane's episode counter into the replacement episode's draw (the
+    anneal schedule's clock), incrementing exactly on ``done``."""
+
+    def step_autoreset(state: MixtureState, action):
+        new_state, ts = step(params, state, action)
+        reset_key, carry_key = jax.random.split(new_state.inner.key)
+        next_count = state.ep_count + 1
+        r_state, r_obs = reset(params, reset_key, ep_count=next_count)
+        r_state = r_state._replace(
+            inner=r_state.inner._replace(key=carry_key))
+        out_state = jax.tree.map(
+            lambda r, n: jnp.where(ts.done, r, n), r_state, new_state)
+        out_obs = jnp.where(ts.done, r_obs, ts.obs)
+        return out_state, ts._replace(obs=out_obs)
+
+    step_batch = jax.vmap(step_autoreset, in_axes=(0, 0))
+
+    def reset_batch(key, num_envs):
+        keys = jax.random.split(key, num_envs)
+        return jax.vmap(lambda k: reset(params, k))(keys)
+
+    return EnvBundle(
+        reset_batch=reset_batch,
+        step_batch=step_batch,
+        obs_shape=(params.num_nodes, cs.NODE_FEAT),
+        num_actions=params.num_nodes,
+        name="cluster_set_mixture",
+        episode_steps=int(params.max_steps),
+    )

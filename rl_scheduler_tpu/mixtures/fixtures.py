@@ -1,0 +1,158 @@
+"""Seeded synthetic fixtures for both external-trace formats (graftmix).
+
+The importer (``mixtures/importer.py``) parses Google ClusterData-style
+and Alibaba cluster-trace-v2018-style CSVs; the real traces are
+multi-GB downloads, so tier-1 must never touch the network. These
+generators synthesize structurally-faithful miniature traces — the same
+column orders, the same event/usage semantics, machine lifecycles and a
+diurnal-ish load wave so the compiled tables have real structure — from
+one ``np.random.RandomState(seed)`` with a fixed draw order (the
+``data/generate.py`` determinism discipline: same seed ⇒ byte-identical
+CSV files, which is what makes the importer's bitwise-determinism pin
+testable end to end).
+
+Both fixtures are deliberately imperfect in the ways real traces are:
+events are written in slightly shuffled order (the importer must sort),
+a machine mid-trace REMOVE/re-ADD cycle exercises the availability
+reconstruction, and a duplicate ADD exercises the counted-rejection
+path. Tests that need *broken* rows (truncated mid-row, junk fields)
+corrupt these files themselves — the generators write valid traces.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+# File names the importer looks for per format (headerless CSVs, like
+# the real releases; column orders in mixtures/importer.py).
+GOOGLE_MACHINE_EVENTS = "machine_events.csv"
+GOOGLE_TASK_USAGE = "task_usage.csv"
+ALIBABA_MACHINE_USAGE = "machine_usage.csv"
+ALIBABA_CONTAINER_META = "container_meta.csv"
+
+
+def _write_rows(path: Path, rows: list) -> Path:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with path.open("w") as fh:
+        for row in rows:
+            fh.write(",".join(str(x) for x in row) + "\n")
+    return path
+
+
+def generate_google_fixture(
+    out_dir: str | Path,
+    machines: int = 8,
+    tasks: int = 200,
+    span: int = 10_000,
+    seed: int = 0,
+) -> dict:
+    """Write a miniature Google ClusterData-style trace directory.
+
+    ``machine_events.csv``: (timestamp, machine_id, event_type,
+    platform_id, cpus, memory) — every machine ADDs near t=0, one seeded
+    machine runs a REMOVE/re-ADD cycle mid-trace, and one duplicate ADD
+    is planted (the importer counts it, idempotently). ``task_usage.csv``:
+    (start_time, end_time, job_id, task_index, machine_id, cpu_rate,
+    memory_usage) — task arrivals follow a sinusoidal day with seeded
+    noise, cpu_rate follows the wave (peak-hours pods are bigger).
+    Deterministic per seed; returns ``{"dir", "files", "machines",
+    "tasks"}``.
+    """
+    rng = np.random.RandomState(seed)
+    out_dir = Path(out_dir)
+    machine_ids = [1000 + 7 * m for m in range(machines)]
+    events = []
+    for i, mid in enumerate(machine_ids):
+        # Staggered ADDs near the trace start (event_type 0 = ADD).
+        events.append((int(rng.randint(0, span // 50)), mid, 0,
+                       f"plat{i % 2}", 1.0, 1.0))
+    # One machine churns: REMOVE (1) mid-trace, re-ADD later.
+    churner = machine_ids[int(rng.randint(0, machines))]
+    down_at = int(span * 0.4 + rng.randint(0, span // 10))
+    up_at = down_at + int(span * 0.2)
+    events.append((down_at, churner, 1, "plat0", 1.0, 1.0))
+    events.append((up_at, churner, 0, "plat0", 1.0, 1.0))
+    # A duplicate ADD for an already-up machine (counted, idempotent).
+    dup = machine_ids[0]
+    events.append((int(span * 0.1), dup, 0, "plat0", 1.0, 1.0))
+
+    usage = []
+    for t in range(tasks):
+        start = int(rng.uniform(0, span * 0.95))
+        end = start + int(rng.uniform(span * 0.01, span * 0.1))
+        mid = machine_ids[int(rng.randint(0, machines))]
+        day = 0.5 + 0.5 * np.sin(2 * np.pi * start / span * 3.0)
+        cpu = float(np.clip(0.05 + 0.4 * day + rng.uniform(-0.05, 0.05),
+                            0.01, 1.0))
+        mem = float(np.clip(rng.uniform(0.02, 0.3), 0.0, 1.0))
+        usage.append((start, end, 5000 + t // 4, t % 4, mid,
+                      round(cpu, 4), round(mem, 4)))
+    # Realistic imperfection: rows land near-sorted but not sorted (the
+    # importer must order by timestamp itself).
+    rng.shuffle(events)
+    rng.shuffle(usage)
+    files = [
+        _write_rows(out_dir / GOOGLE_MACHINE_EVENTS, events),
+        _write_rows(out_dir / GOOGLE_TASK_USAGE, usage),
+    ]
+    return {"dir": str(out_dir), "files": [str(f) for f in files],
+            "machines": machines, "tasks": tasks}
+
+
+def generate_alibaba_fixture(
+    out_dir: str | Path,
+    machines: int = 8,
+    containers: int = 150,
+    span: int = 10_000,
+    ticks: int = 40,
+    seed: int = 0,
+) -> dict:
+    """Write a miniature Alibaba cluster-trace-v2018-style directory.
+
+    ``machine_usage.csv``: (machine_id, time_stamp, cpu_util_percent,
+    mem_util_percent) — one row per machine per tick over each machine's
+    observed lifespan (one seeded machine joins late, one leaves early:
+    the lifespan-availability reconstruction has something to find),
+    cpu_util following a per-machine-offset diurnal wave.
+    ``container_meta.csv``: (container_id, machine_id, time_stamp,
+    app_du, status, cpu_request, cpu_limit, mem_size) with
+    ``cpu_request`` in the v2018 convention of 1/100 cores (100 = 1
+    core). Deterministic per seed.
+    """
+    rng = np.random.RandomState(seed)
+    out_dir = Path(out_dir)
+    machine_ids = [f"m_{m + 1}" for m in range(machines)]
+    late = machine_ids[int(rng.randint(0, machines))]
+    remaining = [m for m in machine_ids if m != late]
+    early = remaining[int(rng.randint(0, len(remaining)))]
+    usage = []
+    tick_times = np.linspace(0, span, ticks, dtype=np.int64)
+    for i, mid in enumerate(machine_ids):
+        phase = rng.uniform(0, 2 * np.pi)
+        for t in tick_times:
+            if mid == late and t < span * 0.3:
+                continue           # joins late
+            if mid == early and t > span * 0.7:
+                continue           # decommissioned early
+            day = 0.5 + 0.5 * np.sin(2 * np.pi * t / span * 2.0 + phase)
+            cpu = float(np.clip(10 + 60 * day + rng.uniform(-5, 5), 1, 100))
+            mem = float(np.clip(rng.uniform(20, 70), 1, 100))
+            usage.append((mid, int(t), round(cpu, 2), round(mem, 2)))
+    meta = []
+    for c in range(containers):
+        t = int(rng.uniform(0, span))
+        mid = machine_ids[int(rng.randint(0, machines))]
+        day = 0.5 + 0.5 * np.sin(2 * np.pi * t / span * 2.0)
+        req = int(np.clip(rng.uniform(20, 60) + 40 * day, 10, 400))
+        meta.append((f"c_{c}", mid, t, f"app_{c % 5}", "started",
+                     req, req * 2, round(rng.uniform(0.5, 8.0), 2)))
+    rng.shuffle(usage)
+    rng.shuffle(meta)
+    files = [
+        _write_rows(out_dir / ALIBABA_MACHINE_USAGE, usage),
+        _write_rows(out_dir / ALIBABA_CONTAINER_META, meta),
+    ]
+    return {"dir": str(out_dir), "files": [str(f) for f in files],
+            "machines": machines, "containers": containers}

@@ -1,0 +1,327 @@
+"""graftmix part 3: the zero-shot transfer grid.
+
+One policy over the scenario universe is a CLAIM; this module is its
+measurement. For every (scenario × node count) cell the GENERALIST (a
+mixture-trained checkpoint) plays paired seeded episodes against an
+OPPONENT — the per-family specialist checkpoint when one is named, else
+the best hand-coded node baseline on the same paired seeds — and the
+cell gets a graftstudy verdict: a Wilson 95% interval over the per-seed
+win rate plus a two-sided sign test (``studies/analysis.py``, the same
+arithmetic the anti-latch studies grade with), on the graded scale
+
+- ``confirmed_above``  — Wilson LOWER bound > 0.5: the generalist is
+  measurably better across seeds,
+- ``point_above`` / ``point_below`` — the point estimate is on that
+  side but the interval straddles 0.5 (the honest small-n answer),
+- ``tied`` — every paired seed tied: zero evidence either way,
+- ``confirmed_below`` — Wilson UPPER bound < 0.5.
+
+Families the mixture never trained on are flagged ``held_out`` — those
+columns ARE the zero-shot transfer claim. A cell whose scenario
+observes a different width than the checkpoint trained (the
+heterogeneous family vs a classic 6-feature generalist) reports
+``incompatible`` with the structured ``reason`` the eval matrix also
+carries, never a garbage score.
+
+Pairing discipline: within a cell, every policy — generalist,
+specialist, every candidate baseline — evaluates on the SAME seeded
+episode batch per seed (one ``PRNGKey(seed)`` through
+``run_bundle_episodes``), so the comparison removes the dominant
+episode-draw variance exactly like ``structured_evaluate``'s baseline
+convention and graftstudy's paired-seed deltas.
+
+Entry points: ``evaluate --transfer-grid`` / ``make transfer-grid``
+(docs/scenarios.md has the one-command chip protocol).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+TRANSFER_GRID_SCHEMA_VERSION = 1
+
+
+def incompatible_reason(ckpt_feat: int, scenario_feat: int,
+                        ckpt_env: str = "cluster_set") -> dict:
+    """The structured ``reason`` an incompatible cell carries — shared
+    with the eval matrix (``evaluate --matrix``): ``obs_width`` (the
+    embed kernel bakes the trained width), ``env_family`` (a non-set
+    checkpoint has no per-node pointer logits to score nodes with), or
+    ``scenario_meta`` (widths agree but the recorded provenance cannot
+    — reserved for future families)."""
+    if ckpt_env != "cluster_set":
+        return {"reason": "env_family",
+                "note": f"checkpoint trained env {ckpt_env!r}; the grid "
+                        "scores per-node set policies"}
+    if ckpt_feat != scenario_feat:
+        return {"reason": "obs_width",
+                "note": f"checkpoint trained at node_feat={ckpt_feat}, "
+                        f"scenario observes {scenario_feat}"}
+    return {"reason": "scenario_meta",
+            "note": "widths agree but the scenario meta does not"}
+
+
+def cell_verdict(wins: int, losses: int, ties: int) -> dict:
+    """Grade one cell's paired-seed record (module docstring)."""
+    from rl_scheduler_tpu.studies.analysis import (
+        sign_test_pvalue,
+        wilson_interval,
+    )
+
+    n = wins + losses
+    if n == 0:
+        # All ties (or no seeds): ZERO evidence either way — say so
+        # instead of claiming a side (the summary/render treat `tied`
+        # as the neutral middle of the graded scale).
+        return {"wins": wins, "losses": losses, "ties": ties,
+                "win_rate": None, "wilson95": None, "sign_test_p": 1.0,
+                "verdict": "tied"}
+    # wilson_interval counts "failures"; feed it the WINS so the
+    # interval reads as the win-rate interval directly.
+    lo, hi = wilson_interval(wins, n)
+    rate = wins / n
+    if lo > 0.5:
+        verdict = "confirmed_above"
+    elif hi < 0.5:
+        verdict = "confirmed_below"
+    elif rate >= 0.5:
+        verdict = "point_above"
+    else:
+        verdict = "point_below"
+    return {"wins": wins, "losses": losses, "ties": ties,
+            "win_rate": round(rate, 3),
+            "wilson95": [round(lo, 3), round(hi, 3)],
+            "sign_test_p": round(sign_test_pvalue(wins, losses), 4),
+            "verdict": verdict}
+
+
+def _paired_means(bundle, policy_fn, episodes: int, seeds: tuple) -> list:
+    """Per-seed mean episode rewards, ONE compiled program for all seeds
+    (vmapped over the seed axis). Key-split order matches
+    ``run_bundle_episodes(seed=s)`` exactly, so the pairing contract is
+    the same — but a grid run touches dozens of (bundle, policy) pairs,
+    and per-seed recompiles would dominate its wall clock."""
+    import jax
+    import jax.numpy as jnp
+
+    steps = bundle.episode_steps
+
+    def one(key):
+        reset_key, policy_key = jax.random.split(key)
+        state, obs = bundle.reset_batch(reset_key, episodes)
+
+        def step_fn(carry, k):
+            state, obs = carry
+            action = policy_fn(obs, k)
+            state, ts = bundle.step_batch(state, action)
+            return (state, ts.obs), ts.reward
+
+        keys = jax.random.split(policy_key, steps)
+        _, rewards = jax.lax.scan(step_fn, (state, obs), keys)
+        return rewards.sum(axis=0).mean()
+
+    means = jax.jit(jax.vmap(one))(
+        jnp.stack([jax.random.PRNGKey(s) for s in seeds]))
+    return [float(m) for m in means]
+
+
+def transfer_cells(
+    checkpoint: tuple,
+    scenario_names: list,
+    node_counts: tuple = (8, 16),
+    seeds: tuple = (0, 1, 2, 3, 4),
+    episodes: int = 8,
+    specialists: dict | None = None,
+    trained_families: tuple = (),
+    scenario_seed: int = 0,
+    emit: Callable[[dict], None] | None = None,
+) -> list[dict]:
+    """One verdict-graded cell per (scenario × node count).
+
+    ``checkpoint`` is ``(net, params, node_feat)`` — the generalist;
+    ``specialists`` maps scenario name → the same tuple for a per-family
+    specialist run; scenarios without one fall back to the strongest
+    hand-coded baseline ON THE SAME PAIRED SEEDS. ``"csv"`` names the
+    un-scenarioed replay row. Emits each cell through ``emit`` as it
+    completes (the matrix CLI convention) and returns them all.
+    """
+    import logging
+
+    from rl_scheduler_tpu.agent.evaluate import greedy_policy_fn
+    from rl_scheduler_tpu.env.baselines import structured_baselines
+    from rl_scheduler_tpu.scenarios import (
+        baseline_columns,
+        csv_reference_row,
+        get_scenario,
+        node_feat_for,
+        scenario_bundle,
+    )
+
+    specialists = specialists or {}
+    net, params, ckpt_feat = checkpoint
+    gen_policy = greedy_policy_fn(net, params)
+    cells = []
+    for sname in scenario_names:
+        if sname == "csv":
+            # The shared csv-row definition (scenarios/spec.py): same
+            # columns/width AND the same domain_random family mapping
+            # the eval matrix keys its held-out flags on.
+            csv_bundle_fn, columns, feat, csv_family = csv_reference_row()
+            held_out = bool(trained_families) and \
+                csv_family not in trained_families
+            scn = None
+        else:
+            scn = get_scenario(sname, seed=scenario_seed)
+            feat = node_feat_for(scn)
+            columns = baseline_columns(scn)
+            held_out = bool(trained_families) and \
+                scn.family not in trained_families
+        for nodes in node_counts:
+            cell = {
+                "schema_version": TRANSFER_GRID_SCHEMA_VERSION,
+                "metric": "transfer_grid_cell",
+                "scenario": sname,
+                "num_nodes": nodes,
+                "node_feat": feat,
+                "held_out": held_out,
+                "episodes": episodes,
+                "seeds": len(seeds),
+            }
+            if feat != ckpt_feat:
+                cell["incompatible"] = True
+                cell.update(incompatible_reason(ckpt_feat, feat))
+            else:
+                if sname == "csv":
+                    bundle = csv_bundle_fn(nodes)
+                else:
+                    bundle = scenario_bundle(scn, nodes)
+                gen = _paired_means(bundle, gen_policy, episodes, seeds)
+                spec = specialists.get(sname)
+                if spec is not None and spec[2] != feat:
+                    # An EXPLICITLY named specialist that cannot score
+                    # this scenario must not silently become a baseline
+                    # row — say so in the cell and in the log.
+                    logging.getLogger(__name__).warning(
+                        "transfer grid: --specialist %s trained "
+                        "node_feat=%d but the scenario observes %d — "
+                        "falling back to the baseline opponent",
+                        sname, spec[2], feat)
+                    cell["specialist_ignored"] = "obs_width"
+                    spec = None
+                if spec is not None:
+                    opp_name = "specialist"
+                    opp = _paired_means(
+                        bundle, greedy_policy_fn(spec[0], spec[1]),
+                        episodes, seeds)
+                else:
+                    # Strongest hand-coded opponent on the SAME paired
+                    # seeds — picked by its mean over them, so the
+                    # comparison is against the best honest alternative.
+                    candidates = {
+                        bname: _paired_means(bundle, fn, episodes, seeds)
+                        for bname, fn in structured_baselines(
+                            "cluster_set", columns=columns).items()
+                    }
+                    best = max(candidates,
+                               key=lambda b: float(np.mean(candidates[b])))
+                    opp_name = f"baseline:{best}"
+                    opp = candidates[best]
+                wins = sum(1 for g, o in zip(gen, opp) if g > o)
+                losses = sum(1 for g, o in zip(gen, opp) if g < o)
+                ties = len(seeds) - wins - losses
+                opp_mean = float(np.mean(opp))
+                margin = ((float(np.mean(gen)) - opp_mean)
+                          / abs(opp_mean) * 100.0 if opp_mean else 0.0)
+                cell.update({
+                    "opponent": opp_name,
+                    "generalist_reward_mean": round(float(np.mean(gen)), 3),
+                    "opponent_reward_mean": round(opp_mean, 3),
+                    "margin_pct": round(margin, 2),
+                })
+                cell.update(cell_verdict(wins, losses, ties))
+            cells.append(cell)
+            if emit is not None:
+                emit(cell)
+    return cells
+
+
+def transfer_grid_summary(cells: list, run: str = "",
+                          mixture: str | None = None,
+                          trained_families: tuple = ()) -> dict:
+    """The ONE ``schema_version``-tagged driver line for a grid run
+    (bench.py convention): the cells plus the aggregate the acceptance
+    bar reads — how many held-out cells the generalist wins or holds
+    within the margin, and the worst held-out verdict."""
+    order = ("confirmed_below", "point_below", "tied", "point_above",
+             "confirmed_above")
+    held = [c for c in cells if c.get("held_out")
+            and not c.get("incompatible")]
+    worst = min((order.index(c["verdict"]) for c in held), default=None)
+    return {
+        "schema_version": TRANSFER_GRID_SCHEMA_VERSION,
+        "metric": "transfer_grid",
+        "run": run,
+        "mixture": mixture,
+        "trained_families": list(trained_families),
+        "scenarios": list(dict.fromkeys(c["scenario"] for c in cells)),
+        "node_counts": sorted({c["num_nodes"] for c in cells}),
+        "cells": cells,
+        "held_out_cells": len(held),
+        "held_out_not_below": sum(
+            1 for c in held if c["verdict"] != "confirmed_below"),
+        "worst_held_out_verdict": order[worst] if worst is not None
+        else None,
+        "incompatible_cells": sum(1 for c in cells
+                                  if c.get("incompatible")),
+    }
+
+
+def render_transfer_grid(summary: dict) -> str:
+    """The human grid: one row per scenario (held-out rows starred), one
+    column per node count, each cell ``margin% verdict-glyph`` —
+    ``++/+/=/-/--`` for confirmed/point above, tied, point/confirmed
+    below — with the generalist-vs-opponent margin the acceptance
+    criterion reads."""
+    glyph = {"confirmed_above": "++", "point_above": "+ ", "tied": "= ",
+             "point_below": "- ", "confirmed_below": "--"}
+    nodes = summary["node_counts"]
+    by = {(c["scenario"], c["num_nodes"]): c for c in summary["cells"]}
+    width = 21
+    lines = [
+        "=" * (22 + width * len(nodes)),
+        "ZERO-SHOT TRANSFER GRID (generalist margin vs opponent, "
+        "paired seeds)",
+        f"mixture: {summary.get('mixture')}   trained families: "
+        f"{', '.join(summary.get('trained_families') or ()) or '-'}",
+        "=" * (22 + width * len(nodes)),
+        " " * 22 + "".join(f"{'N=' + str(n):>{width}}" for n in nodes),
+    ]
+    for s in summary["scenarios"]:
+        cols = []
+        for n in nodes:
+            c = by.get((s, n))
+            if c is None:
+                cols.append(f"{'-':>{width}}")
+            elif c.get("incompatible"):
+                cols.append(f"{'incompat(' + c['reason'] + ')':>{width}}")
+            else:
+                cols.append(
+                    f"{c['margin_pct']:+9.1f}% {glyph[c['verdict']]}"
+                    f"{' vs spec' if c['opponent'] == 'specialist' else '':<6}"
+                    .rjust(width))
+        held = next((c.get("held_out") for c in summary["cells"]
+                     if c["scenario"] == s), False)
+        lines.append(f"{s + (' *' if held else ''):<22}" + "".join(cols))
+    lines += [
+        "-" * (22 + width * len(nodes)),
+        "* = held-out family (zero-shot)   ++/+/=/-/-- = "
+        "confirmed/point above, tied, point/confirmed below "
+        "(Wilson95 + sign test vs 0.5)",
+        f"held-out cells not confirmed_below: "
+        f"{summary['held_out_not_below']}/{summary['held_out_cells']}"
+        f"   worst held-out verdict: {summary['worst_held_out_verdict']}",
+        "=" * (22 + width * len(nodes)),
+    ]
+    return "\n".join(lines)

@@ -1,0 +1,519 @@
+"""graftmix part 1: external cluster-trace importer.
+
+Turns public cluster traces — Google ClusterData-style machine-event +
+task-usage CSVs, Alibaba cluster-trace-v2018-style machine/container
+tables — into the table space the envs already replay, **through the
+existing data pipeline**: the per-cloud load series derived from the
+trace drives a raw price/latency frame built on ``data/generate.py``'s
+public on-demand anchors, and ``data/normalize.normalize`` MinMax-scales
+it into the same ``[0, 1]`` columns the shipped CSV takes. The result is
+a drop-in scenario family (``external_trace:<dir>?format=...``,
+``scenarios/spec.py``), not a parallel format.
+
+**What is reconstructed, and how.**
+
+- *Load → cost/latency* ``[T, 2]``: machines are split into two "cloud"
+  halves by sorted machine id (the ``cluster_set`` first-half-aws
+  convention); per time bucket, each half's mean CPU utilization is the
+  demand signal — cost follows it weakly (demand pricing), latency
+  follows it hard, both through the normalize pipeline. Buckets a half
+  never reports in carry the last observed level forward.
+- *Pod sizes →* ``pod_scale [T]``: the mean requested CPU of
+  tasks/containers arriving in each bucket, normalized to mean 1.0 — the
+  arrival-intensity multiplier ``ClusterSetParams.pod_scale`` applies to
+  the env's pod draw. An EMPTY usage table is a recorded outcome, not a
+  crash: the import degrades to the env's default draw
+  (``pod_scale=None``) and the report says so.
+- *Machine lifecycle →* ``avail_mask [T, N]``: Google's ADD/REMOVE
+  events (and Alibaba machines' observed usage lifespans) give each
+  machine an up/down series; machines map onto the requested node count
+  by a seeded assignment inside each cloud half, and a node is up when
+  at least half its machines are (at least one node is kept up per row —
+  the ``churn_mask`` discipline).
+
+**Schema validation, counted.** Rows are validated positionally against
+the format's column order; malformed rows (short, non-numeric where a
+number is required, inverted time ranges) are COUNTED per reason in the
+:class:`ImportReport` and skipped — a truncated download or a torn final
+line must never kill a campaign. Only a trace with too few usable rows
+to bucket refuses (:class:`TraceImportError`).
+
+**Determinism.** Bitwise-identical tables per ``(trace digest, seed)``
+(pinned by test): rows are sorted with stable tie-breaks after parse
+(real traces arrive shard-ordered, not time-ordered — counted when
+observed), and every random draw (latency jitter, machine→node
+assignment) comes from one ``np.random.RandomState(seed)`` with a fixed
+draw order. :func:`trace_digest` fingerprints the source bytes so "same
+trace" is checkable, not assumed (the ``loopback/compile.py``
+convention).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from pathlib import Path
+
+import numpy as np
+
+GOOGLE_FORMAT = "google"
+ALIBABA_FORMAT = "alibaba"
+FORMATS = (GOOGLE_FORMAT, ALIBABA_FORMAT)
+
+# Positional column orders (headerless CSVs, matching the public
+# releases' layouts; extra trailing columns are ignored so fuller
+# real-trace exports parse unchanged).
+GOOGLE_MACHINE_EVENT_COLUMNS = (
+    "timestamp", "machine_id", "event_type", "platform_id", "cpus",
+    "memory")
+GOOGLE_TASK_USAGE_COLUMNS = (
+    "start_time", "end_time", "job_id", "task_index", "machine_id",
+    "cpu_rate", "memory_usage")
+ALIBABA_MACHINE_USAGE_COLUMNS = (
+    "machine_id", "time_stamp", "cpu_util_percent", "mem_util_percent")
+ALIBABA_CONTAINER_META_COLUMNS = (
+    "container_id", "machine_id", "time_stamp", "app_du", "status",
+    "cpu_request", "cpu_limit", "mem_size")
+
+# Google machine_events event_type values.
+MACHINE_ADD, MACHINE_REMOVE, MACHINE_UPDATE = 0, 1, 2
+
+_FORMAT_FILES = {
+    GOOGLE_FORMAT: ("machine_events.csv", "task_usage.csv"),
+    ALIBABA_FORMAT: ("machine_usage.csv", "container_meta.csv"),
+}
+
+# Pod-scale clipping: the compiled multiplier stays within the range the
+# bursty family uses, so an outlier task cannot turn every pod draw into
+# a guaranteed overload.
+POD_SCALE_LOW, POD_SCALE_HIGH = 0.25, 4.0
+
+
+class TraceImportError(ValueError):
+    """The trace directory cannot compile — missing files or too few
+    usable rows after counted rejection."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ImportedTrace:
+    """One import: env-ready tables plus the full accounting report."""
+
+    costs: np.ndarray          # [T, 2] f32, normalized [0, 1]
+    latencies: np.ndarray      # [T, 2] f32
+    pod_scale: np.ndarray | None  # [T] f32 (None: empty usage table)
+    machine_avail: np.ndarray  # [T, M] f32, 1 = up, machine-major
+    machine_clouds: np.ndarray  # [M] int32, 0 = aws half, 1 = azure half
+    report: "ImportReport"
+
+    @property
+    def steps(self) -> int:
+        return int(self.costs.shape[0])
+
+
+@dataclasses.dataclass
+class ImportReport:
+    """Counted-outcome accounting for one import (module docstring).
+
+    Row invariant (pinned by test): ``rows_total == rows_used +
+    rows_ignored + sum(rejected.values())`` — ``rejected`` counts
+    malformed/invalid data (short rows, non-numeric fields, inverted
+    intervals), ``rows_ignored`` counts well-formed rows the
+    reconstruction deliberately skips (UPDATE events, duplicate
+    add/remove transitions), and ``rows_used`` is what actually fed the
+    compile. Non-row outcomes (an empty usage table) live in their own
+    fields (``pod_from_trace``), not the row counters."""
+
+    format: str
+    digest: str
+    seed: int
+    steps: int
+    files: dict = dataclasses.field(default_factory=dict)
+    rows_total: int = 0
+    rows_used: int = 0
+    rows_ignored: int = 0
+    rejected: dict = dataclasses.field(default_factory=dict)
+    machines: int = 0
+    usage_rows: int = 0
+    pod_from_trace: bool = False
+    out_of_order_rows: int = 0
+    duplicate_machine_adds: int = 0
+
+    def reject(self, reason: str, n: int = 1, parsed: bool = False) -> None:
+        """Count a discarded row; ``parsed=True`` moves an
+        already-parsed row out of ``rows_used`` (post-parse semantic
+        rejection keeps the row invariant exact)."""
+        self.rejected[reason] = self.rejected.get(reason, 0) + n
+        if parsed:
+            self.rows_used -= n
+
+    def ignore(self, n: int = 1) -> None:
+        """A well-formed row the reconstruction deliberately skips."""
+        self.rows_ignored += n
+        self.rows_used -= n
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def trace_digest(trace_dir: str | Path, fmt: str) -> str:
+    """Content digest over the format's source files (sorted, name +
+    bytes) — the determinism key: same digest + same seed ⇒ bitwise the
+    same compiled tables."""
+    trace_dir = Path(trace_dir)
+    h = hashlib.sha256()
+    for name in sorted(_format_files(fmt)):
+        path = trace_dir / name
+        if path.is_file():
+            h.update(name.encode())
+            h.update(path.read_bytes())
+    return h.hexdigest()[:16]
+
+
+def _format_files(fmt: str) -> tuple:
+    if fmt not in _FORMAT_FILES:
+        raise TraceImportError(
+            f"unknown external-trace format {fmt!r}; choose from "
+            f"{list(FORMATS)}")
+    return _FORMAT_FILES[fmt]
+
+
+def _parse_rows(path: Path, schema: tuple, numeric: tuple,
+                report: ImportReport, kind: str) -> list:
+    """Positional CSV parse with counted rejection: one dict per valid
+    row; short rows and non-numeric required fields are counted under
+    ``<kind>_short_row`` / ``<kind>_bad_number`` and skipped. A torn
+    final line (truncated download, mid-row writer crash) is just a
+    short/bad row — counted like any other."""
+    rows = []
+    with path.open() as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            report.rows_total += 1
+            fields = line.split(",")
+            if len(fields) < len(schema):
+                report.reject(f"{kind}_short_row")
+                continue
+            row = dict(zip(schema, fields))
+            ok = True
+            for col in numeric:
+                try:
+                    row[col] = float(row[col])
+                except ValueError:
+                    report.reject(f"{kind}_bad_number")
+                    ok = False
+                    break
+            if not ok:
+                continue
+            rows.append(row)
+            report.rows_used += 1
+    return rows
+
+
+def _sorted_counted(rows: list, key, report: ImportReport) -> list:
+    """Stable sort by ``key``, counting how many rows arrived out of
+    order (real traces are shard-ordered; the importer must not trust
+    file order)."""
+    keys = [key(r) for r in rows]
+    report.out_of_order_rows += sum(
+        1 for a, b in zip(keys, keys[1:]) if b < a)
+    return [r for _, r in sorted(enumerate(rows),
+                                 key=lambda ir: (key(rows[ir[0]]), ir[0]))]
+
+
+def _load_google(trace_dir: Path, report: ImportReport):
+    """``(machine_series, usage_points)`` from a Google-style dir:
+    machine_series maps machine_id -> sorted [(time, up_bool)] from
+    ADD/REMOVE events (duplicates counted, idempotent); usage_points is
+    [(start_time, cpu_request)] per task."""
+    events = _parse_rows(
+        trace_dir / "machine_events.csv", GOOGLE_MACHINE_EVENT_COLUMNS,
+        ("timestamp", "event_type"), report, "machine_events")
+    usage = _parse_rows(
+        trace_dir / "task_usage.csv", GOOGLE_TASK_USAGE_COLUMNS,
+        ("start_time", "end_time", "cpu_rate"), report, "task_usage")
+    events = _sorted_counted(events, lambda r: r["timestamp"], report)
+    series: dict = {}
+    up: dict = {}
+    for ev in events:
+        mid = ev["machine_id"]
+        etype = int(ev["event_type"])
+        if etype == MACHINE_UPDATE:
+            report.ignore()      # valid, deliberately unused
+            continue
+        want_up = etype == MACHINE_ADD
+        if up.get(mid) == want_up:
+            # Redundant transition: idempotent, counted (report
+            # invariant: ignored, not rejected — the row is well-formed).
+            if want_up:
+                report.duplicate_machine_adds += 1
+            report.ignore()
+            continue
+        up[mid] = want_up
+        series.setdefault(mid, []).append((ev["timestamp"], want_up))
+    points = []
+    for row in usage:
+        if row["end_time"] < row["start_time"]:
+            report.reject("task_usage_inverted_interval", parsed=True)
+            continue
+        points.append((row["start_time"], row["cpu_rate"],
+                       row["machine_id"]))
+    return series, points
+
+
+def _load_alibaba(trace_dir: Path, report: ImportReport):
+    """Same ``(machine_series, usage_points)`` shape from an
+    Alibaba-v2018-style dir: a machine's lifespan is its first..last
+    observed ``machine_usage`` timestamp (the table has no explicit
+    add/remove events); per-machine utilization samples double as the
+    load signal; container ``cpu_request`` arrives in 1/100 cores."""
+    usage = _parse_rows(
+        trace_dir / "machine_usage.csv", ALIBABA_MACHINE_USAGE_COLUMNS,
+        ("time_stamp", "cpu_util_percent"), report, "machine_usage")
+    meta = _parse_rows(
+        trace_dir / "container_meta.csv", ALIBABA_CONTAINER_META_COLUMNS,
+        ("time_stamp", "cpu_request"), report, "container_meta")
+    usage = _sorted_counted(usage, lambda r: r["time_stamp"], report)
+    spans: dict = {}
+    samples: dict = {}
+    for row in usage:
+        mid = row["machine_id"]
+        t = row["time_stamp"]
+        lo, hi = spans.get(mid, (t, t))
+        spans[mid] = (min(lo, t), max(hi, t))
+        samples.setdefault(mid, []).append((t, row["cpu_util_percent"]
+                                            / 100.0))
+    series = {mid: [(lo, True), (hi, False)]
+              for mid, (lo, hi) in spans.items()}
+    points = [(row["time_stamp"], row["cpu_request"] / 100.0,
+               row["machine_id"]) for row in meta]
+    return series, points, samples
+
+
+def _machine_clouds(machine_ids: list) -> np.ndarray:
+    """First half of the SORTED machine ids is cloud 0 (aws), second
+    half cloud 1 — the ``cluster_set`` node convention lifted to
+    machines, so the mapping is a pure function of the trace."""
+    n = len(machine_ids)
+    return (np.arange(n) >= n // 2).astype(np.int32)
+
+
+def _avail_matrix(series: dict, machine_ids: list,
+                  edges: np.ndarray) -> np.ndarray:
+    """``[T, M]`` machine availability: up at bucket b iff up at the
+    bucket's left edge per the transition series."""
+    t = len(edges) - 1
+    out = np.zeros((t, len(machine_ids)), np.float32)
+    for m, mid in enumerate(machine_ids):
+        transitions = series.get(mid, ())
+        state = False
+        ti = 0
+        for b in range(t):
+            while ti < len(transitions) and transitions[ti][0] <= edges[b]:
+                state = transitions[ti][1]
+                ti += 1
+            out[b, m] = 1.0 if state else 0.0
+    return out
+
+
+def _bucket_mean(times: np.ndarray, values: np.ndarray,
+                 edges: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """``(mean_per_bucket [T], has_data [T])`` of ``values`` grouped by
+    the bucket each time lands in."""
+    t = len(edges) - 1
+    idx = np.clip(np.searchsorted(edges, times, side="right") - 1, 0, t - 1)
+    sums = np.bincount(idx, weights=values, minlength=t)
+    counts = np.bincount(idx, minlength=t)
+    has = counts > 0
+    means = np.divide(sums, np.maximum(counts, 1))
+    return means, has
+
+
+def _forward_fill(values: np.ndarray, has: np.ndarray,
+                  fallback: float) -> np.ndarray:
+    """Carry the last observed level into empty buckets; buckets before
+    the first observation take ``fallback``."""
+    out = np.empty_like(values)
+    last = fallback
+    for i in range(len(values)):
+        if has[i]:
+            last = values[i]
+        out[i] = last
+    return out
+
+
+def import_external_trace(
+    trace_dir: str | Path,
+    fmt: str,
+    steps: int = 100,
+    seed: int = 0,
+) -> ImportedTrace:
+    """Import one external trace directory (module docstring).
+
+    Deterministic per (:func:`trace_digest`, ``seed``); raises
+    :class:`TraceImportError` on missing files or too few usable rows.
+    """
+    from rl_scheduler_tpu.data.generate import (
+        AWS_COST_BASE,
+        AWS_LATENCY_BASE,
+        AZURE_COST_BASE,
+        AZURE_LATENCY_BASE,
+    )
+    from rl_scheduler_tpu.data.normalize import normalize
+
+    trace_dir = Path(trace_dir)
+    if steps < 2:
+        raise TraceImportError(f"steps={steps}: a compiled table needs at "
+                               "least 2 rows")
+    for name in _format_files(fmt):
+        if not (trace_dir / name).is_file():
+            raise TraceImportError(
+                f"{fmt} trace under {trace_dir} is missing {name} "
+                f"(expected files: {', '.join(_format_files(fmt))}; "
+                "mixtures/fixtures.py generates synthetic ones)")
+    report = ImportReport(format=fmt, digest=trace_digest(trace_dir, fmt),
+                          seed=seed, steps=steps)
+    for name in _format_files(fmt):
+        report.files[name] = (trace_dir / name).stat().st_size
+
+    samples: dict = {}
+    if fmt == GOOGLE_FORMAT:
+        series, points = _load_google(trace_dir, report)
+        # Google: the load signal is the tasks' cpu_rate at their start
+        # times, attributed to the machine that ran them.
+        for t, cpu, mid in points:
+            samples.setdefault(mid, []).append((t, cpu))
+    else:
+        series, points, samples = _load_alibaba(trace_dir, report)
+
+    machine_ids = sorted(series)
+    report.machines = len(machine_ids)
+    report.usage_rows = len(points)
+    if len(machine_ids) < 2:
+        raise TraceImportError(
+            f"{fmt} trace under {trace_dir} describes "
+            f"{len(machine_ids)} machines after counted rejection "
+            f"({report.rejected or 'no rejects'}) — the two-cloud split "
+            "needs at least 2")
+    clouds = _machine_clouds(machine_ids)
+
+    # Time base: the union span of machine transitions and usage points,
+    # divided into `steps` equal buckets.
+    all_times = [t for tr in series.values() for t, _ in tr]
+    all_times += [t for t, _, _ in points]
+    t_lo, t_hi = min(all_times), max(all_times)
+    if t_hi <= t_lo:
+        raise TraceImportError(
+            f"trace under {trace_dir} spans zero time ({t_lo}..{t_hi}) — "
+            "nothing to bucket")
+    edges = np.linspace(t_lo, t_hi, steps + 1)
+
+    # Per-cloud utilization series (the demand signal).
+    rng = np.random.RandomState(seed)
+    util = np.zeros((steps, 2), np.float64)
+    for c in range(2):
+        cloud_machines = {machine_ids[m] for m in range(len(machine_ids))
+                          if clouds[m] == c}
+        times, vals = [], []
+        for mid in cloud_machines:
+            for t, v in samples.get(mid, ()):
+                times.append(t)
+                vals.append(v)
+        if times:
+            means, has = _bucket_mean(np.asarray(times, np.float64),
+                                      np.asarray(vals, np.float64), edges)
+            fallback = float(np.asarray(vals).mean())
+            util[:, c] = _forward_fill(means, has, fallback)
+        # else: a cloud half with zero usage keeps util 0 (flat anchors).
+    util = np.clip(util, 0.0, 1.5)
+
+    # Raw $/ms frame on the shipped anchors, normalized through the
+    # SHIPPED pipeline — demand pricing couples cost weakly and latency
+    # hard to the trace's load, jitter drawn from this import's stream.
+    import pandas as pd
+
+    jitter = rng.uniform(-0.02, 0.02, (steps, 2))
+    raw = pd.DataFrame({
+        "step": range(steps),
+        "cost_aws": AWS_COST_BASE * (1.0 + 0.5 * util[:, 0]
+                                     + jitter[:, 0]),
+        "cost_azure": AZURE_COST_BASE * (1.0 + 0.5 * util[:, 1]
+                                         + jitter[:, 1]),
+        "latency_aws": AWS_LATENCY_BASE * (1.0 + 1.5 * util[:, 0]),
+        "latency_azure": AZURE_LATENCY_BASE * (1.0 + 1.5 * util[:, 1]),
+    })
+    table = normalize(raw)
+    costs = table[["cost_aws", "cost_azure"]].to_numpy(np.float32)
+    lats = table[["latency_aws", "latency_azure"]].to_numpy(np.float32)
+
+    # Pod sizes: mean requested CPU per arrival bucket, normalized to
+    # mean 1.0. An empty usage table degrades to the env's default draw.
+    pod_scale = None
+    if points:
+        times = np.asarray([t for t, _, _ in points], np.float64)
+        reqs = np.asarray([v for _, v, _ in points], np.float64)
+        means, has = _bucket_mean(times, reqs, edges)
+        filled = _forward_fill(means, has, float(reqs.mean()))
+        overall = filled.mean()
+        if overall > 0:
+            pod_scale = np.clip(filled / overall, POD_SCALE_LOW,
+                                POD_SCALE_HIGH).astype(np.float32)
+    # An empty usage table is a non-ROW outcome: recorded on its own
+    # field (the compile degrades to the env's default pod draw), kept
+    # out of the per-row rejected counters so the row invariant holds.
+    report.pod_from_trace = pod_scale is not None
+
+    avail = _avail_matrix(series, machine_ids, edges)
+    return ImportedTrace(costs=costs, latencies=lats, pod_scale=pod_scale,
+                         machine_avail=avail, machine_clouds=clouds,
+                         report=report)
+
+
+def node_avail_mask(imported: ImportedTrace, num_nodes: int,
+                    seed: int = 0) -> np.ndarray:
+    """Map the trace's per-machine availability onto ``num_nodes`` env
+    node slots: machines are dealt round-robin (in a seeded shuffle)
+    onto the slots of their cloud half, a node is up when >= half of its
+    machines are, and at least one node stays up per row (the
+    ``churn_mask`` discipline — an all-dark cluster teaches nothing).
+    Seeded independently of the table compile so the same draw order
+    holds whatever ``num_nodes`` is."""
+    t, m = imported.machine_avail.shape
+    rng = np.random.RandomState(seed)
+    order = rng.permutation(m)
+    half = num_nodes // 2
+    slots: list = [[] for _ in range(num_nodes)]
+    next_slot = {0: 0, 1: 0}
+    for mi in order:
+        cloud = int(imported.machine_clouds[mi])
+        base, width = (0, half) if cloud == 0 else (half, num_nodes - half)
+        if width <= 0:           # degenerate tiny node counts
+            base, width = 0, num_nodes
+        slots[base + next_slot[cloud] % width].append(mi)
+        next_slot[cloud] += 1
+    mask = np.ones((t, num_nodes), np.float32)
+    for n, members in enumerate(slots):
+        if not members:
+            continue             # an unbacked slot stays up (neutral)
+        up_frac = imported.machine_avail[:, members].mean(axis=1)
+        mask[:, n] = (up_frac >= 0.5).astype(np.float32)
+    dark = mask.sum(axis=1) == 0
+    mask[dark, 0] = 1.0
+    return mask
+
+
+def external_tables(trace_dir: str | Path, fmt: str, steps: int = 100,
+                    seed: int = 0) -> dict:
+    """The family-dispatch entry (``scenarios/families.
+    external_trace_tables``): one import as the plain table dict every
+    scenario family compiles into."""
+    imported = import_external_trace(trace_dir, fmt, steps=steps, seed=seed)
+    return {
+        "costs": imported.costs,
+        "latencies": imported.latencies,
+        "pod_scale": imported.pod_scale,
+        "report": imported.report.to_json(),
+    }
+
+

@@ -15,10 +15,18 @@ over. Four production-shaped families ship (``spec.SCENARIOS``):
 - ``price_spike``   — spot-market price-spike regimes generated through
                       ``data/generate.py``
 
-Entry points: ``train_ppo --scenario NAME`` / ``train_dqn --scenario``,
+Two further families are name-built, never registry presets:
+``trace_replay:<snapshot>`` (graftloop — served traffic, replayed) and
+``external_trace:<dir>?format=google|alibaba`` (graftmix — public
+cluster traces imported through ``rl_scheduler_tpu/mixtures/``).
+
+Entry points: ``train_ppo --scenario NAME`` / ``train_dqn --scenario``
+(``--mixture`` for graftmix curricula over several families),
 ``python -m rl_scheduler_tpu.agent.evaluate --matrix`` (the scenario ×
-policy-family eval matrix), ``make eval-matrix``, and the extender's
-scenario-conformance check. Design doc: ``docs/scenarios.md``.
+policy-family eval matrix), ``--transfer-grid`` (the zero-shot
+generalist grid), ``make eval-matrix`` / ``make transfer-grid``, and
+the extender's scenario-conformance check. Design doc:
+``docs/scenarios.md``.
 """
 
 from rl_scheduler_tpu.scenarios.spec import (
@@ -28,6 +36,7 @@ from rl_scheduler_tpu.scenarios.spec import (
     baseline_columns,
     cloud_table,
     cluster_set_params,
+    csv_reference_row,
     get_scenario,
     list_scenarios,
     node_feat_for,
@@ -43,6 +52,7 @@ __all__ = [
     "baseline_columns",
     "cloud_table",
     "cluster_set_params",
+    "csv_reference_row",
     "get_scenario",
     "list_scenarios",
     "node_feat_for",

@@ -196,6 +196,27 @@ def trace_replay_tables(
                            mix_frac=mix_frac)
 
 
+def external_trace_tables(
+    trace_dir: str,
+    fmt: str,
+    steps: int = 100,
+    seed: int = 0,
+) -> dict:
+    """Family 7 — imported PUBLIC cluster traces (graftmix).
+
+    ``trace_dir`` holds a Google ClusterData-style (machine_events +
+    task_usage) or Alibaba cluster-trace-v2018-style (machine_usage +
+    container_meta) CSV set; ``mixtures/importer.py`` owns the parse
+    (schema-validated with counted row rejection) and the compile
+    through the shipped ``data/normalize`` pipeline. Same determinism
+    contract as every generator here: bitwise-identical tables per
+    (trace digest, seed) — this wrapper keeps the family dispatch in
+    one place, like :func:`trace_replay_tables` does for graftloop."""
+    from rl_scheduler_tpu.mixtures.importer import external_tables
+
+    return external_tables(trace_dir, fmt, steps=steps, seed=seed)
+
+
 def heterogeneous_capacities(
     num_nodes: int = 8,
     num_resources: int = 3,

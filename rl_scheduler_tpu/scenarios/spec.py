@@ -31,7 +31,7 @@ import dataclasses
 from typing import Any
 
 FAMILIES = ("bursty_diurnal", "heterogeneous", "churn", "price_spike",
-            "domain_random", "trace_replay")
+            "domain_random", "trace_replay", "external_trace")
 
 # graftloop (rl_scheduler_tpu/loopback/): a trace_replay scenario is
 # named dynamically — ``trace_replay:<snapshot_dir>[?steps=N&mix=F]`` —
@@ -40,6 +40,14 @@ FAMILIES = ("bursty_diurnal", "heterogeneous", "churn", "price_spike",
 # (get_scenario parses it), so checkpoint-meta round-trips, resume
 # guards, and serving conformance all work unchanged.
 TRACE_SCENARIO_PREFIX = "trace_replay:"
+
+# graftmix (rl_scheduler_tpu/mixtures/): an external_trace scenario —
+# ``external_trace:<dir>?format=google|alibaba[&steps=N]`` — compiles a
+# PUBLIC cluster trace (Google ClusterData-style machine-event +
+# task-usage CSVs, Alibaba v2018-style machine/container tables) through
+# the importer + data/normalize pipeline. Same name-built convention as
+# trace_replay: the whole spec lives in the name.
+EXTERNAL_SCENARIO_PREFIX = "external_trace:"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,6 +84,19 @@ class Scenario:
                     f"mix_frac={mix}: the anti-forgetting mixture share "
                     "of base-workload rows must be in [0, 1) — 1.0 would "
                     "leave no trace rows to learn from")
+        if self.family == "external_trace":
+            if not self.knob("trace_dir"):
+                raise ValueError(
+                    "external_trace scenarios compile from a public "
+                    "cluster-trace directory — name one via "
+                    "external_trace:<dir>?format=... (get_scenario) or a "
+                    "trace_dir knob")
+            from rl_scheduler_tpu.mixtures.importer import FORMATS
+
+            if self.knob("format") not in FORMATS:
+                raise ValueError(
+                    f"external_trace scenarios need format= one of "
+                    f"{list(FORMATS)}; got {self.knob('format')!r}")
 
     def knob(self, name: str, default: Any = None) -> Any:
         for k, v in self.knobs:
@@ -175,17 +196,62 @@ def _parse_trace_name(name: str) -> Scenario:
                     knobs=knobs)
 
 
+def _parse_external_name(name: str) -> Scenario:
+    """``external_trace:<dir>?format=google|alibaba[&steps=N]`` ->
+    Scenario (graftmix importer, ``mixtures/importer.py``). The same
+    name-round-trip contract as :func:`_parse_trace_name`: checkpoint
+    meta, resume guards, and the extender's conformance demand carry the
+    one string."""
+    spec_part = name[len(EXTERNAL_SCENARIO_PREFIX):]
+    path, _, query = spec_part.partition("?")
+    if not path:
+        raise ValueError(
+            f"scenario {name!r}: external_trace:<dir>?format=... needs "
+            "the trace directory (mixtures/fixtures.py generates "
+            "synthetic ones)")
+    steps, fmt = 100, None
+    if query:
+        for item in query.split("&"):
+            key, _, value = item.partition("=")
+            if key == "steps":
+                try:
+                    steps = int(value)
+                except ValueError:
+                    raise ValueError(
+                        f"scenario {name!r}: bad value for {key!r}: "
+                        f"{value!r}")
+            elif key == "format":
+                fmt = value
+            else:
+                raise ValueError(
+                    f"scenario {name!r}: unknown external_trace "
+                    f"parameter {key!r} (format, steps)")
+    if fmt is None:
+        raise ValueError(
+            f"scenario {name!r}: external_trace needs ?format=google or "
+            "?format=alibaba (which parser reads the directory)")
+    knobs = _knobs(trace_dir=path, format=fmt)
+    return Scenario(name=name, family="external_trace", steps=steps,
+                    knobs=knobs)
+
+
 def get_scenario(name: str, seed: int | None = None) -> Scenario:
     """Registry lookup; ``seed`` re-seeds the preset's table generation.
     Names starting ``trace_replay:`` build graftloop's dynamic
-    trace-compiled scenario instead (:func:`_parse_trace_name`)."""
+    trace-compiled scenario instead (:func:`_parse_trace_name`); names
+    starting ``external_trace:`` build graftmix's imported public-trace
+    scenario (:func:`_parse_external_name`)."""
     if name.startswith(TRACE_SCENARIO_PREFIX):
         scn = _parse_trace_name(name)
+        return scn if seed is None else scn.with_seed(seed)
+    if name.startswith(EXTERNAL_SCENARIO_PREFIX):
+        scn = _parse_external_name(name)
         return scn if seed is None else scn.with_seed(seed)
     if name not in SCENARIOS:
         raise ValueError(
             f"unknown scenario {name!r}; registered: {list_scenarios()} "
-            f"(or trace_replay:<snapshot_dir> for a compiled trace)")
+            f"(or trace_replay:<snapshot_dir> / "
+            f"external_trace:<dir>?format=... for a compiled trace)")
     scn = SCENARIOS[name]
     return scn if seed is None else scn.with_seed(seed)
 
@@ -213,6 +279,12 @@ def _compiled(scenario: Scenario) -> dict:
             trace_dir=scenario.knob("trace_dir"),
             steps=scenario.steps, seed=scenario.seed,
             mix_frac=float(scenario.knob("mix_frac", 0.0) or 0.0),
+        )
+    if scenario.family == "external_trace":
+        return fam.external_trace_tables(
+            trace_dir=scenario.knob("trace_dir"),
+            fmt=scenario.knob("format"),
+            steps=scenario.steps, seed=scenario.seed,
         )
     raise ValueError(
         f"family {scenario.family!r} compiles no tables (churn compiles a "
@@ -301,6 +373,29 @@ def cluster_set_params(scenario: Scenario, num_nodes: int = 8):
             num_nodes=num_nodes, table=table, avail_mask=mask,
             churn_penalty=scenario.knob("churn_penalty", 1.0),
             **randomization)
+    if scenario.family == "external_trace":
+        # graftmix: an imported public trace carries THREE table kinds at
+        # once — demand-priced cost/latency rows, the arrival-size
+        # multiplier, and the machine-lifecycle availability mask (the
+        # node-count-late compile, like the churn family's mask). ONE
+        # import feeds all three: real public traces are multi-GB, and
+        # the transfer grid rebuilds params per (scenario, node count).
+        from rl_scheduler_tpu.mixtures.importer import (
+            import_external_trace,
+            node_avail_mask,
+        )
+
+        imported = import_external_trace(
+            scenario.knob("trace_dir"), scenario.knob("format"),
+            steps=scenario.steps, seed=scenario.seed)
+        mask = node_avail_mask(imported, num_nodes, seed=scenario.seed)
+        return cs.make_params(
+            num_nodes=num_nodes,
+            table=_TableView(imported.costs, imported.latencies),
+            pod_scale=imported.pod_scale,
+            avail_mask=mask,
+            churn_penalty=scenario.knob("churn_penalty", 1.0),
+            **randomization)
     if scenario.family == "trace_replay":
         # graftloop: replay the logged workload exactly — zero static
         # node premium (a serving-side unknown; zero keeps the compiled
@@ -329,6 +424,23 @@ def _default_table():
     from rl_scheduler_tpu.data.loader import load_table
 
     return load_table()
+
+
+def csv_reference_row() -> tuple:
+    """The un-scenarioed CSV-replay row every scenario sweep reads its
+    scenarios against — ``(bundle_fn, columns, node_feat, family)`` with
+    ``bundle_fn(num_nodes)`` building the plain cluster_set bundle. ONE
+    definition shared by the eval matrix and the transfer grid
+    (``agent/evaluate.py``, ``mixtures/grid.py``) so the two tools'
+    ``csv`` rows — including the domain_random family mapping the
+    held-out flags key on — can never drift."""
+    from rl_scheduler_tpu.env import cluster_set as cs
+    from rl_scheduler_tpu.env.bundle import cluster_set_bundle
+
+    def bundle_fn(num_nodes: int):
+        return cluster_set_bundle(cs.make_params(num_nodes=num_nodes))
+
+    return bundle_fn, {"cost": 0, "cpu": 2}, cs.NODE_FEAT, "domain_random"
 
 
 def scenario_bundle(scenario: Scenario, num_nodes: int = 8):

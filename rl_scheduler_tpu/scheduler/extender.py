@@ -1698,7 +1698,12 @@ def build_policy(
             logger.exception("checkpoint load failed; serving cost-greedy fallback")
         if meta is not None:
             ckpt_env = meta.get("env", "multi_cloud")
-            ckpt_scenario = meta.get("scenario")
+            # graftmix: a mixture-trained generalist answers the
+            # conformance demand with its canonical mixture name (the
+            # same one-string round-trip as trace_replay scenarios) —
+            # the obs layout is the classic set layout, so serving is
+            # otherwise identical.
+            ckpt_scenario = meta.get("scenario") or meta.get("mixture")
             node_feat = meta.get("node_feat")
             if (ckpt_env == "cluster_set" and node_feat
                     and node_feat != 6):

@@ -411,7 +411,9 @@ class TestCompile:
         from rl_scheduler_tpu.scenarios.spec import FAMILIES
 
         assert "trace_replay" in FAMILIES
-        assert len(FAMILIES) == 6
+        # 7 since graftmix added external_trace (tests/test_mixtures.py
+        # owns that family's registry pin).
+        assert len(FAMILIES) == 7
         assert callable(trace_replay_tables)
 
     def test_roundtrip_pin_through_real_env(self, trace_dir, tmp_path):

@@ -67,10 +67,13 @@ def test_scenarios_is_a_real_package():
     assert pkg.__file__ is not None and pkg.__file__.endswith("__init__.py")
     assert set(SCENARIOS) == {"bursty", "heterogeneous", "churn",
                               "price_spike", "randomized"}
-    # trace_replay (graftloop) is name-built (trace_replay:<snapshot>),
-    # never a registry preset — FAMILIES grows, SCENARIOS does not.
-    assert len(FAMILIES) == 6
+    # trace_replay (graftloop) and external_trace (graftmix) are
+    # name-built (trace_replay:<snapshot> /
+    # external_trace:<dir>?format=...), never registry presets —
+    # FAMILIES grows, SCENARIOS does not.
+    assert len(FAMILIES) == 7
     assert "trace_replay" in FAMILIES
+    assert "external_trace" in FAMILIES
 
 
 def test_stale_pycache_modules_do_not_import():
@@ -528,6 +531,8 @@ def test_scenario_bench_functions_exist_and_run_tiny():
     out = bench.scenario_env_step_bench(num_nodes=4, num_envs=4, steps=5,
                                         repeats=1)
     assert out["schema_version"] == 1
-    assert set(out["scenarios"]) == set(SCENARIOS)
+    # graftmix: the mixture variant rides every scenario bench beside
+    # the per-family rows (same interleaved methodology, same bar).
+    assert set(out["scenarios"]) == set(SCENARIOS) | {"mixture"}
     for cell in out["scenarios"].values():
         assert cell["steps_per_sec"] > 0

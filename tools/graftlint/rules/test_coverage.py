@@ -42,9 +42,12 @@ from tools.graftlint.rules import Rule, register
 # about what a live pool does under a promote. `loopback` joined with
 # graftloop: its surface is the continual-learning contract (bitwise
 # trace compiles, graded promotion verdicts, SIGKILL-safe resume) — the
-# same class of claim.
+# same class of claim. `mixtures` joined with graftmix: bitwise trace
+# imports, seeded family draws inside vmap, and statistical transfer
+# verdicts are exactly the cross-environment determinism contracts this
+# rule exists to keep referenced.
 OP_DIRS = frozenset({"ops", "parallel", "scenarios", "studies",
-                     "scheduler", "loopback"})
+                     "scheduler", "loopback", "mixtures"})
 
 
 @register
