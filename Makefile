@@ -11,7 +11,8 @@ TRACE ?= tests/fixtures/traceview/fixture.trace.json.gz
 .PHONY: lint lint-json test tier1 trace-summary obs chaos chaos-soak \
         serve-pool serve-soak rollout-drill eval-matrix scenario-bench \
         study study-list overlap-bench serve-report slo-check span-ab \
-        fastpath-ab loop-drill loop-soak transfer-grid mixture-smoke
+        fastpath-ab front-ab loop-drill loop-soak transfer-grid \
+        mixture-smoke
 
 lint:
 	$(PY) -m tools.graftlint --check
@@ -126,6 +127,25 @@ fastpath-ab:
 		--levers $(FP_LEVERS) --nodes $(FP_NODES) --threads 8 \
 		--workers 2 --rounds $(FP_ROUNDS) --duration $(FP_DURATION) \
 		--history BENCH_serving.jsonl
+
+# graftfront A/B (docs/serving.md): threading vs asyncio data-plane
+# fronts, interleaved pools on the cache lever, keep-alive compact-wire
+# traffic at each FRONT_THREADS concurrency; one ledger line per
+# (front x concurrency), then the history gate judges the new rows
+# against their own (front, keepalive) shapes.
+FRONT_NODES ?= 1024
+FRONT_ROUNDS ?= 2
+FRONT_DURATION ?= 10
+FRONT_THREADS ?= 8,64
+FRONTS ?= threading,asyncio
+front-ab:
+	JAX_PLATFORMS=cpu $(PY) loadgen/extender_bench.py \
+		--fronts $(FRONTS) --front-threads $(FRONT_THREADS) \
+		--nodes $(FRONT_NODES) --workers 2 \
+		--rounds $(FRONT_ROUNDS) --duration $(FRONT_DURATION) \
+		--history BENCH_serving.jsonl
+	$(PY) -m tools.decisionview --bench BENCH_serving.jsonl \
+		--check-history
 
 # graftscenario (docs/scenarios.md): the scenario x policy-family eval
 # matrix — one schema_version-tagged JSON line per cell to

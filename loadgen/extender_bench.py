@@ -40,6 +40,13 @@ requests rebuilt from the trace's schema-2 fields, probes excluded — so
 a serving A/B measures the traffic the pool actually served. The result
 line carries a ``replay`` tag.
 
+``--keepalive`` (graftfront, soak mode) reuses each bench thread's
+connection across requests; connection setup is timed apart from
+request latency either way (``connect_p50_ms``/``connections``).
+``--fronts threading,asyncio`` self-hosts an interleaved front A/B at
+each ``--front-threads`` concurrency, keep-alive compact-wire traffic
+on the cache lever — ``make front-ab`` is the one-command recipe.
+
 Stdlib-only for the synthetic modes (no locust dependency) so it runs
 anywhere the extender does; ``--replay-trace`` imports the repo's
 trace-log reader.
@@ -134,6 +141,19 @@ def load_replay_payloads(trace_dir: str, node_capacity_cores: float = 4.0,
     return payloads, report
 
 
+def make_wire_payload(i: int, num_nodes: int = 2) -> bytes:
+    """The compact-wire twin of :func:`make_payload` (graftfront,
+    ``scheduler/wire.py``): same first-half-aws/second-half-azure
+    candidate layout, ~num_nodes bytes instead of ~100 bytes per node of
+    JSON. The fronts A/B sends these so the transport comparison runs on
+    the codec the sub-millisecond target is specified against."""
+    from rl_scheduler_tpu.scheduler.wire import encode_request
+
+    clouds = ["aws" if j < num_nodes // 2 else "azure"
+              for j in range(num_nodes)]
+    return encode_request(clouds, 500)
+
+
 def one_request(base: str, i: int, num_nodes: int = 2,
                 payload: bytes | None = None) -> float:
     path = "/filter" if i % 2 == 0 else "/prioritize"
@@ -145,6 +165,73 @@ def one_request(base: str, i: int, num_nodes: int = 2,
     with urllib.request.urlopen(req, timeout=10) as resp:
         resp.read()
     return (time.perf_counter() - t0) * 1000.0
+
+
+class BenchClient:
+    """One bench thread's HTTP client, with connection-setup and request
+    latency measured SEPARATELY (satellite of graftfront: the old
+    connection-per-request urllib path folded TCP setup into every
+    latency sample, which confounds any transport A/B).
+
+    ``keepalive=True`` reuses one ``http.client.HTTPConnection`` across
+    requests (reconnecting — and counting the reconnect — whenever the
+    server closes or errors); ``keepalive=False`` reproduces the classic
+    connection-per-request behaviour, still timing the setup apart.
+    ``connects_ms`` accumulates one sample per TCP connect; request
+    latencies EXCLUDE it either way."""
+
+    def __init__(self, host: str, port: int, keepalive: bool = False,
+                 content_type: str = "application/json",
+                 timeout: float = 10.0):
+        self.host, self.port = host, port
+        self.keepalive = keepalive
+        self.content_type = content_type
+        self.timeout = timeout
+        self.conn = None
+        self.connects_ms: list = []
+
+    def _connect(self) -> None:
+        import http.client
+
+        t0 = time.perf_counter()
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+        conn.connect()
+        self.connects_ms.append((time.perf_counter() - t0) * 1000.0)
+        self.conn = conn
+
+    def request(self, i: int, num_nodes: int = 2,
+                payload: bytes | None = None) -> float:
+        path = "/filter" if i % 2 == 0 else "/prioritize"
+        body = payload if payload is not None \
+            else make_payload(i, num_nodes)
+        if self.conn is None:
+            self._connect()
+        t0 = time.perf_counter()
+        try:
+            self.conn.request("POST", path, body,
+                              {"Content-Type": self.content_type})
+            resp = self.conn.getresponse()
+            data = resp.read()
+            will_close = resp.will_close
+        except Exception:
+            # Whatever broke, the connection state is unknown: drop it so
+            # a retry (or the next request) reconnects cleanly.
+            self.close()
+            raise
+        ms = (time.perf_counter() - t0) * 1000.0
+        if resp.status >= 400:
+            self.close()
+            raise RuntimeError(
+                f"HTTP {resp.status} on {path}: {data[:200]!r}")
+        if not self.keepalive or will_close:
+            self.close()
+        return ms
+
+    def close(self) -> None:
+        if self.conn is not None:
+            self.conn.close()
+            self.conn = None
 
 
 def _is_connection_error(exc: Exception) -> bool:
@@ -162,15 +249,16 @@ def _is_connection_error(exc: Exception) -> bool:
     return isinstance(exc, (ConnectionError, http.client.RemoteDisconnected))
 
 
-def _request_with_retry(base: str, i: int, num_nodes: int, payload: bytes,
+def _request_with_retry(client: BenchClient, i: int, num_nodes: int,
+                        payload: bytes,
                         connect_retries: int) -> tuple[float, int]:
     """``(latency_ms, retries_used)``; only connection-level errors
     retry (against a fresh connection the kernel re-hashes to a live
-    worker). Anything else — and a retry budget exhausted — propagates
-    as a soak failure."""
+    worker — the client dropped the broken one). Anything else — and a
+    retry budget exhausted — propagates as a soak failure."""
     for attempt in range(connect_retries + 1):
         try:
-            return one_request(base, i, num_nodes, payload), attempt
+            return client.request(i, num_nodes, payload), attempt
         except Exception as exc:  # noqa: BLE001 - classified below
             if attempt >= connect_retries or not _is_connection_error(exc):
                 raise
@@ -179,7 +267,9 @@ def _request_with_retry(base: str, i: int, num_nodes: int, payload: bytes,
 
 
 def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
-          promote_at: float | None = None, payloads: list | None = None):
+          promote_at: float | None = None, payloads: list | None = None,
+          keepalive: bool = False,
+          content_type: str = "application/json"):
     """Duration-based load: each thread loops until the deadline.
 
     Payloads are prebuilt once (at N=1024 a node list is ~100 KB of
@@ -192,18 +282,27 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
     a dying worker's accept queue RSTs on close; the retry's fresh
     connection re-hashes to a live worker; retries are reported, HTTP
     errors never retry).
-    Returns ``(sorted_latencies_ms, wall_s, failures, phases, retries)``
-    — ``retries`` is counted (and reported) UNCONDITIONALLY, so lever
-    A/B lines stay field-comparable with rollout-drill lines; ``phases``
-    is ``None`` without a promote.
+    Returns ``(sorted_latencies_ms, wall_s, failures, phases, retries,
+    sorted_connects_ms)`` — ``retries`` is counted (and reported)
+    UNCONDITIONALLY, so lever A/B lines stay field-comparable with
+    rollout-drill lines; ``phases`` is ``None`` without a promote.
+
+    graftfront: every soak thread now runs a :class:`BenchClient`, so
+    connection setup is timed apart from request latency in BOTH
+    connection modes; ``keepalive=True`` reuses each thread's connection
+    across requests (``--keepalive``), which is what makes a transport
+    A/B measure the transport rather than the TCP handshake rate.
     """
     if payloads is None:
         payloads = [make_payload(i, num_nodes) for i in range(16)]
+    host, _, port_s = base.rpartition("//")[2].partition(":")
+    port = int(port_s)
     connect_retries = 3 if promote_at is not None else 0
     t_start = time.perf_counter()
     deadline = t_start + duration_s
     t_promote = None if promote_at is None else t_start + promote_at
     latencies: list = []
+    connects: list = []
     failures = [0]
     retries_total = [0]
     phases = {"pre_promote": {"requests": 0, "failures": 0, "retries": 0},
@@ -211,6 +310,8 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
     lock = threading.Lock()
 
     def run(thread_id: int) -> None:
+        client = BenchClient(host, port, keepalive=keepalive,
+                             content_type=content_type)
         local: list = []
         failed = 0
         counts = {"pre_promote": [0, 0, 0], "post_promote": [0, 0, 0]}
@@ -224,7 +325,7 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
                      else "pre_promote")
             try:
                 ms, retried = _request_with_retry(
-                    base, i, num_nodes, payloads[i % len(payloads)],
+                    client, i, num_nodes, payloads[i % len(payloads)],
                     connect_retries)
                 local.append(ms)
                 counts[phase][0] += 1
@@ -234,8 +335,10 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
                 counts[phase][0] += 1
                 counts[phase][1] += 1
             i += threads
+        client.close()
         with lock:
             latencies.extend(local)
+            connects.extend(client.connects_ms)
             failures[0] += failed
             for phase, (reqs, fails, retries) in counts.items():
                 phases[phase]["requests"] += reqs
@@ -249,7 +352,8 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
     for w in workers:
         w.join()
     return (sorted(latencies), time.perf_counter() - t_start, failures[0],
-            phases if t_promote is not None else None, retries_total[0])
+            phases if t_promote is not None else None, retries_total[0],
+            sorted(connects))
 
 
 def _fire_promote(control: str, checkpoint: str, delay_s: float,
@@ -369,7 +473,7 @@ def _run_lever_round(np_tree: dict, lever: str, args) -> dict:
             headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(reset_req, timeout=10) as resp:
             resp.read()
-        latencies, wall, failures, _, retries = _soak(
+        latencies, wall, failures, _, retries, _ = _soak(
             base, args.duration, args.threads, args.nodes)
         server_stats = _get_json(control + "/stats")
     finally:
@@ -453,6 +557,11 @@ def run_levers_matrix(args) -> list:
             "bench": "extender_serving",
             "mode": "levers",
             "lever": lever,
+            # Constant on the levers matrix: lever pools serve the
+            # incumbent threading front over per-request connections, so
+            # these rows stay shape-comparable with `mode: fronts` rows.
+            "front": "threading",
+            "keepalive": False,
             "workers": args.workers,
             "nodes": args.nodes,
             "concurrency": args.threads,
@@ -473,6 +582,161 @@ def run_levers_matrix(args) -> list:
         if off_rps and line["lever"] != "off":
             print(f"{line['lever']}: {line['req_per_sec'] / off_rps:.2f}x "
                   "off-lever req/s", file=sys.stderr)
+    return lines
+
+
+def _run_front_round(np_tree: dict, front: str, threads_n: int,
+                     args) -> dict:
+    """One front x one concurrency x one round: fresh pool serving the
+    cache lever (the sub-millisecond target is specified against cache
+    hits), keep-alive wire-codec soak, pool-wide stats. The SAME payload
+    set, lever and client drive both fronts, so the row isolates the
+    transport."""
+    from rl_scheduler_tpu.scheduler.pool import ServingPool
+    from rl_scheduler_tpu.scheduler.wire import WIRE_CONTENT_TYPE
+
+    pool = ServingPool(
+        _lever_factory(np_tree, "cache", args.batch_window_ms,
+                       args.cache_epoch_s, nodes=args.nodes),
+        workers=args.workers, host="127.0.0.1", port=0, control_port=0,
+        front=front)
+    pool.start(ready_timeout_s=120.0)
+    try:
+        base = f"http://127.0.0.1:{pool.port}"
+        control = "http://127.0.0.1:%d" % pool.control_address[1]
+        payloads = [make_wire_payload(i, args.nodes) for i in range(16)]
+        warm = BenchClient("127.0.0.1", pool.port, keepalive=True,
+                           content_type=WIRE_CONTENT_TYPE)
+        try:
+            for i in range(2 * args.workers + 4):
+                warm.request(i, args.nodes, payloads[i % len(payloads)])
+        finally:
+            warm.close()
+        _get_json(control + "/healthz")
+        reset_req = urllib.request.Request(
+            control + "/stats/reset", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(reset_req, timeout=10) as resp:
+            resp.read()
+        latencies, wall, failures, _, retries, connects = _soak(
+            base, args.duration, threads_n, args.nodes,
+            payloads=payloads, keepalive=True,
+            content_type=WIRE_CONTENT_TYPE)
+        server_stats = _get_json(control + "/stats")
+    finally:
+        pool.shutdown()
+    if not latencies:
+        raise RuntimeError(
+            f"front {front!r} x{threads_n}: soak completed zero requests")
+    p50 = latencies[len(latencies) // 2]
+    out = {
+        "req_per_sec": round(len(latencies) / wall, 1),
+        "client_p50_ms": round(p50, 3),
+        "client_p99_ms": round(
+            latencies[min(len(latencies) - 1,
+                          int(0.99 * len(latencies)))], 3),
+        "requests": len(latencies),
+        "failures": failures,
+        "retries": retries,
+        "connections": len(connects),
+        "connect_p50_ms": round(connects[len(connects) // 2], 3)
+        if connects else None,
+        "connect_p99_ms": round(
+            connects[min(len(connects) - 1, int(0.99 * len(connects)))], 3)
+        if connects else None,
+        "server_p50_ms": (server_stats.get("latency") or {}).get("p50_ms"),
+        "backend": server_stats.get("backend"),
+        "fastpath": server_stats.get("fastpath"),
+    }
+    return out
+
+
+def run_fronts_matrix(args) -> list:
+    """The ``--fronts`` A/B (graftfront): one pool per front per
+    concurrency per round, fronts INTERLEAVED inside every round (the
+    levers-matrix discipline — sequential per-variant runs drift with
+    the host), keep-alive compact-wire traffic on the cache lever for
+    EVERY cell, best-of-rounds per (front, concurrency), ONE
+    ``schema_version`` JSON line per cell carrying ``front`` +
+    ``keepalive`` + ``codec`` fields. `make front-ab` is the
+    one-command recipe; with ``--history`` the lines append to the
+    serving ledger and `tools/decisionview --check-history` gates each
+    (front x concurrency) shape separately."""
+    import pathlib
+    import sys as _sys
+
+    _sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rl_scheduler_tpu.models.transformer import SetTransformerPolicy
+    from rl_scheduler_tpu.scheduler.extender import FRONTS
+
+    fronts = [f.strip() for f in args.fronts.split(",") if f.strip()]
+    unknown = [f for f in fronts if f not in FRONTS]
+    if unknown:
+        raise SystemExit(f"--fronts: unknown front(s) {unknown}; "
+                         f"choose from {list(FRONTS)}")
+    try:
+        thread_grid = [int(t) for t in args.front_threads.split(",") if t]
+    except ValueError:
+        raise SystemExit(f"--front-threads {args.front_threads!r}: "
+                         "expected a csv of ints (e.g. 8,64)")
+    net = SetTransformerPolicy(dim=64, depth=2)
+    tree = net.init(jax.random.PRNGKey(0), jnp.zeros((8, 6), jnp.float32))
+    np_tree = jax.tree_util.tree_map(np.asarray, tree)
+
+    cells = [(front, tn) for tn in thread_grid for front in fronts]
+    rows: dict = {cell: [] for cell in cells}
+    for r in range(args.rounds):
+        order = cells if r % 2 == 0 else list(reversed(cells))
+        for front, tn in order:
+            row = _run_front_round(np_tree, front, tn, args)
+            rows[(front, tn)].append(row)
+            print(f"round {r} front={front} x{tn}: "
+                  f"{row['req_per_sec']} req/s "
+                  f"p50 {row['client_p50_ms']} ms "
+                  f"({row['requests']} reqs, {row['failures']} failures, "
+                  f"{row['connections']} conns)", file=sys.stderr)
+
+    lines = []
+    for front, tn in cells:
+        if not rows[(front, tn)]:
+            continue
+        best = max(rows[(front, tn)], key=lambda row: row["req_per_sec"])
+        line = {
+            "schema_version": SCHEMA_VERSION,
+            "bench": "extender_serving",
+            "mode": "fronts",
+            "front": front,
+            "keepalive": True,
+            "codec": "wire",
+            "workers": args.workers,
+            "nodes": args.nodes,
+            "concurrency": tn,
+            "threads": tn,
+            "rounds": len(rows[(front, tn)]),
+            "duration_s": args.duration,
+            "rounds_rps": [row["req_per_sec"]
+                           for row in rows[(front, tn)]],
+            **best,
+        }
+        lines.append(line)
+        print(json.dumps(line))
+        if args.history is not None:
+            with open(args.history, "a", encoding="utf-8") as fh:
+                fh.write(json.dumps(line) + "\n")
+    for tn in thread_grid:
+        base_rps = next((ln["req_per_sec"] for ln in lines
+                         if ln["front"] == "threading"
+                         and ln["concurrency"] == tn), None)
+        for line in lines:
+            if base_rps and line["concurrency"] == tn \
+                    and line["front"] != "threading":
+                print(f"x{tn} {line['front']}: "
+                      f"{line['req_per_sec'] / base_rps:.2f}x threading "
+                      "req/s", file=sys.stderr)
     return lines
 
 
@@ -556,7 +820,50 @@ def main(argv: list[str] | None = None) -> dict:
                         "levers (default 3600 — the bench's request "
                         "stream repeats node sets, so one epoch shows "
                         "the hit path; live serving uses ~15)")
+    p.add_argument("--keepalive", action="store_true",
+                   help="soak mode (graftfront): reuse each bench "
+                        "thread's HTTP connection across requests "
+                        "instead of reconnecting per request. "
+                        "Connection setup is timed SEPARATELY either "
+                        "way (connect_p50_ms/connect_p99_ms/"
+                        "connections in the result line); against the "
+                        "threading front (HTTP/1.0 — the server closes "
+                        "after every response) this degrades to "
+                        "reconnect-per-request and the connect counts "
+                        "show it")
+    p.add_argument("--front", default="threading",
+                   help="label for the result line: which --front the "
+                        "TARGET server was started with (the bench "
+                        "cannot detect it; default threading). History "
+                        "gating treats front as part of the row shape")
+    p.add_argument("--fronts", default=None, metavar="F1,F2",
+                   help="graftfront A/B mode: self-host one pool per "
+                        "front per concurrency per round (threading/"
+                        "asyncio, interleaved — the levers-matrix "
+                        "discipline), soak each with keep-alive "
+                        "compact-wire traffic on the cache lever, and "
+                        "print/append ONE JSON line per (front x "
+                        "concurrency) cell. Ignores --host/--port; "
+                        "`make front-ab` is the one-command recipe")
+    p.add_argument("--front-threads", default="8,64", metavar="T1,T2",
+                   help="fronts mode: csv concurrency grid (default "
+                        "8,64 — the serving contract's low-load latency "
+                        "point and the saturation point)")
     args = p.parse_args(argv)
+    if args.fronts is not None:
+        if args.duration is None:
+            args.duration = 10.0
+        if args.levers is not None:
+            p.error("--fronts and --levers are separate matrices; run "
+                    "them as separate invocations")
+        if args.promote_at is not None:
+            p.error("--fronts and --promote-at are separate drills")
+        if args.replay_trace is not None:
+            p.error("--fronts self-hosts synthetic pools; --replay-trace "
+                    "drives an existing server — separate modes")
+        return run_fronts_matrix(args)
+    if args.keepalive and args.duration is None:
+        p.error("--keepalive applies to soak mode; add --duration")
     if args.levers is not None:
         if args.duration is None:
             args.duration = 10.0
@@ -631,6 +938,7 @@ def main(argv: list[str] | None = None) -> dict:
               "percentiles may include pre-run traffic", file=sys.stderr)
 
     failures = retries = 0
+    connects: list = []
     phases = promote = None
     if args.duration is not None:
         promote_thread = result_box = None
@@ -646,9 +954,10 @@ def main(argv: list[str] | None = None) -> dict:
             promote_thread = threading.Thread(target=_promote_then_record,
                                               daemon=True)
             promote_thread.start()
-        latencies, wall, failures, phases, retries = _soak(
+        latencies, wall, failures, phases, retries, connects = _soak(
             base, args.duration, args.threads, args.nodes,
-            promote_at=args.promote_at, payloads=replay_payloads)
+            promote_at=args.promote_at, payloads=replay_payloads,
+            keepalive=args.keepalive)
         if promote_thread is not None:
             promote_thread.join(timeout=60.0)
             promote = result_box
@@ -687,6 +996,11 @@ def main(argv: list[str] | None = None) -> dict:
         "schema_version": SCHEMA_VERSION,
         "bench": "extender_serving",
         "mode": "soak" if args.duration is not None else "count",
+        # graftfront: the target's front is a bench LABEL (--front); the
+        # connection mode is the bench's own. Both join the history
+        # shape so fronts never gate against each other's priors.
+        "front": args.front,
+        "keepalive": bool(args.keepalive),
         "workers": workers,
         "nodes": args.nodes,
         "concurrency": args.threads,
@@ -706,6 +1020,14 @@ def main(argv: list[str] | None = None) -> dict:
         "server_p99_ms": server_latency.get("p99_ms"),
         "backend": server_stats.get("backend"),
     }
+    if connects:
+        # Connection setup, reported apart from request latency: under
+        # --keepalive this approaches one sample per thread; without it
+        # (or against an HTTP/1.0 server) one per request.
+        out["connections"] = len(connects)
+        out["connect_p50_ms"] = round(connects[len(connects) // 2], 3)
+        out["connect_p99_ms"] = round(
+            connects[min(len(connects) - 1, int(0.99 * len(connects)))], 3)
     if replay_report is not None:
         # The `replay` tag: this round's traffic was recorded, not
         # synthetic — history gating treats it as its own shape via the

@@ -62,6 +62,11 @@ import numpy as np
 
 from rl_scheduler_tpu.scheduler.policy_backend import make_backend
 from rl_scheduler_tpu.scheduler.tracelog import decision_record, obs_digest
+from rl_scheduler_tpu.scheduler.wire import (
+    WIRE_CONTENT_TYPE,
+    WireError,
+    serve_wire,
+)
 from rl_scheduler_tpu.utils.retry import CircuitOpenError
 from rl_scheduler_tpu.scheduler.telemetry import (
     PrometheusCpu,
@@ -881,7 +886,7 @@ class ExtenderPolicy:
         annotations = (((pod or {}).get("metadata") or {})
                        .get("annotations") or {})
         aff_name = annotations.get(AFFINITY_ANNOTATION)
-        if aff_name in display:
+        if aff_name is not None and aff_name in display:
             affinity = display.index(aff_name)
         obs = build_graph_obs(clouds, price_row, cpus, hops, adj,
                               affinity, pod_cpu, step_frac)
@@ -904,6 +909,22 @@ class ExtenderPolicy:
         t_parse = time.perf_counter()
         pod = args.get("pod")
         pod_cpu = pod_cpu_fraction(pod, self.node_capacity_cores)
+        pod_reqs = (pod_resource_fractions(pod, self.node_capacity_cores)
+                    if self.family == "set" and self.num_resources else None)
+        self._span_add("parse", time.perf_counter() - t_parse)
+        return self._decide_candidates(display, clouds, pod, pod_cpu,
+                                       pod_reqs)
+
+    def _decide_candidates(self, display, clouds: list, pod: dict | None,
+                           pod_cpu: float, pod_reqs: list | None
+                           ) -> tuple[int, np.ndarray, np.ndarray]:
+        """The family dispatch both request encodings share: cap-sample,
+        decide, re-expand. ``display`` may be any sequence (the wire
+        path's lazy name view — only indexed names materialize). The
+        JSON path arrives via :meth:`_structured_decide`; graftfront's
+        compact wire path calls this directly with its pre-parsed
+        fields."""
+        t_parse = time.perf_counter()
         # Stashed for the trace record (graftloop replay field): the
         # record site closes out the request after marshal, where the
         # parsed pod is long out of scope.
@@ -923,13 +944,10 @@ class ExtenderPolicy:
             sub_display = [display[i] for i in idx]
         else:
             sub_clouds, sub_display = clouds, display
+        self._span_add("parse", time.perf_counter() - t_parse)
         if self.family == "set":
-            pod_reqs = (pod_resource_fractions(pod, self.node_capacity_cores)
-                        if self.num_resources else None)
-            self._span_add("parse", time.perf_counter() - t_parse)
             action, probs, obs = self.decide_set(sub_clouds, pod_cpu, pod_reqs)
         else:
-            self._span_add("parse", time.perf_counter() - t_parse)
             action, probs, obs = self.decide_graph(sub_clouds, sub_display,
                                                    pod, pod_cpu)
         if idx is not None:
@@ -1235,6 +1253,123 @@ class ExtenderPolicy:
             self._record_trace("prioritize", candidates=len(display),
                                chosen=None, score=None, obs=None, t0=t0,
                                fail_open=True)
+        return out
+
+    # --------------------------------------------------- graftfront wire
+
+    def filter_wire(self, req, parse_s: float = 0.0) -> list | None:
+        """Compact-wire ExtenderFilterResult: answer with kept candidate
+        INDICES — ``None`` means keep all (the fail-open/passthrough
+        answer). ``req`` is a decoded ``wire.WireRequest``; ``parse_s``
+        is the codec's decode time, charged to the request's ``parse``
+        span so the phase decomposition covers the wire path end to end.
+        Span/trace/SLO semantics mirror :meth:`filter` exactly — the
+        graftlens agreement suites run against both entry points."""
+        self._span_begin()
+        self._span_add("parse", parse_s)
+        clouds = req.clouds
+        n = len(clouds)
+        if not n:
+            return None
+        t0 = time.perf_counter()
+        try:
+            if self.family in self.STRUCTURED:
+                action, probs, obs = self._decide_candidates(
+                    req.names, clouds, None,
+                    req.pod_cpu_fraction(self.node_capacity_cores), None)
+            else:
+                action, probs, obs = self.decide()
+        except CircuitOpenError:
+            logger.debug("backend breaker open; passing all nodes")
+            self._record_trace("filter", candidates=n, chosen=None,
+                               score=None, obs=None, t0=t0, fail_open=True)
+            return None
+        except Exception:  # never wedge scheduling: keep every candidate.
+            logger.exception("%s policy decision failed; passing all nodes",
+                             self.family)
+            self._record_trace("filter", candidates=n, chosen=None,
+                               score=None, obs=None, t0=t0, fail_open=True)
+            return None
+        t_marshal = time.perf_counter()
+        if self.family in self.STRUCTURED:
+            kept = [action]
+            chosen = req.names[action]
+            if self.placer is not None and clouds[action] is not None:
+                self.placer.submit(clouds[action])
+        else:
+            chosen = CLOUDS[action]
+            if self.placer is not None:
+                self.placer.submit(chosen)
+            kept = [i for i, c in enumerate(clouds)
+                    if c is None or c == chosen]
+        self._span_add("marshal", time.perf_counter() - t_marshal)
+        self._record_trace("filter", candidates=n, chosen=chosen,
+                           score=float(probs[action]), obs=obs, t0=t0,
+                           clouds=clouds)
+        return kept
+
+    def prioritize_wire(self, req, parse_s: float = 0.0) -> list:
+        """Compact-wire HostPriorityList: one 0-100 score per candidate
+        (positional — the wire response carries no names). Fail-open
+        answers uniform midpoint scores, mirroring the JSON paths."""
+        self._span_begin()
+        self._span_add("parse", parse_s)
+        clouds = req.clouds
+        n = len(clouds)
+        if not n:
+            return []
+        t0 = time.perf_counter()
+        if self.family in self.STRUCTURED:
+            try:
+                action, probs, obs = self._decide_candidates(
+                    req.names, clouds, None,
+                    req.pod_cpu_fraction(self.node_capacity_cores), None)
+            except CircuitOpenError:
+                logger.debug("backend breaker open; uniform priorities")
+                self._record_trace("prioritize", candidates=n, chosen=None,
+                                   score=None, obs=None, t0=t0,
+                                   fail_open=True)
+                return [MAX_EXTENDER_SCORE // 2] * n
+            except Exception:
+                logger.exception("%s policy decision failed; uniform "
+                                 "priorities", self.family)
+                self._record_trace("prioritize", candidates=n, chosen=None,
+                                   score=None, obs=None, t0=t0,
+                                   fail_open=True)
+                return [MAX_EXTENDER_SCORE // 2] * n
+            t_marshal = time.perf_counter()
+            scores = np.round(probs / probs.max() * MAX_EXTENDER_SCORE)
+            out = [int(s) for s in scores]
+            self._span_add("marshal", time.perf_counter() - t_marshal)
+            # Success record outside the try — see _prioritize_structured.
+            self._record_trace("prioritize", candidates=n,
+                               chosen=req.names[action],
+                               score=float(probs[action]), obs=obs, t0=t0,
+                               clouds=clouds)
+            return out
+        action = obs = None
+        try:
+            action, probs, obs = self.decide()
+        except CircuitOpenError:
+            logger.debug("backend breaker open; uniform priorities")
+            probs = np.full(len(CLOUDS), 1.0 / len(CLOUDS))
+        except Exception:
+            logger.exception("policy decision failed; uniform priorities")
+            probs = np.full(len(CLOUDS), 1.0 / len(CLOUDS))
+        t_marshal = time.perf_counter()
+        out = [MAX_EXTENDER_SCORE // 2 if c is None
+               else int(round(float(probs[CLOUDS.index(c)])
+                              * MAX_EXTENDER_SCORE))
+               for c in clouds]
+        self._span_add("marshal", time.perf_counter() - t_marshal)
+        if action is not None:
+            self._record_trace("prioritize", candidates=n,
+                               chosen=CLOUDS[action],
+                               score=float(probs[action]), obs=obs, t0=t0,
+                               clouds=clouds)
+        else:
+            self._record_trace("prioritize", candidates=n, chosen=None,
+                               score=None, obs=None, t0=t0, fail_open=True)
         return out
 
     @staticmethod
@@ -1546,6 +1681,26 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802
         length = int(self.headers.get("Content-Length", 0))
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype == WIRE_CONTENT_TYPE:
+            # graftfront compact wire (wire.py): both fronts serve both
+            # encodings on one port, so the A/B isolates the transport.
+            body = self.rfile.read(length)
+            try:
+                answer = serve_wire(self.policy, self.path, body)
+            except WireError as exc:
+                # A refusal, never a dropped connection (codec contract).
+                self._send(400, {"error": f"bad wire: {exc}"})
+                return
+            except ValueError:
+                self._send(404, {"error": f"unknown path {self.path}"})
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", WIRE_CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(answer)))
+            self.end_headers()
+            self.wfile.write(answer)
+            return
         try:
             args = json.loads(self.rfile.read(length) or b"{}")
         except json.JSONDecodeError as exc:
@@ -1584,8 +1739,12 @@ class _Handler(BaseHTTPRequestHandler):
         logger.debug("%s " + fmt, self.address_string(), *log_args)
 
 
+FRONTS = ("threading", "asyncio")
+
+
 def make_server(policy: ExtenderPolicy, host: str = "0.0.0.0", port: int = 8787,
-                reuse_port: bool = False, inherited_socket=None):
+                reuse_port: bool = False, inherited_socket=None,
+                front: str = "threading"):
     """The extender's HTTP server. Two pool-worker variants (graftserve,
     ``scheduler/pool.py``) share the handler stack unchanged:
 
@@ -1595,7 +1754,22 @@ def make_server(policy: ExtenderPolicy, host: str = "0.0.0.0", port: int = 8787,
     - ``inherited_socket``: skip bind/listen entirely and ``accept()`` on
       a listener the supervisor bound before forking — the fallback where
       ``SO_REUSEPORT`` is unavailable (pre-fork accept sharing).
+
+    ``front`` picks the transport (graftfront): ``"threading"`` is the
+    classic ``ThreadingHTTPServer`` (default; one thread per
+    connection), ``"asyncio"`` the event-loop data plane in ``front.py``
+    (keep-alive, 10k+ concurrent connections, same facade: construction
+    binds, ``serve_forever()`` blocks, ``shutdown()`` drains,
+    ``server_close()`` releases). Both serve identical routes and
+    semantics — the graftlens agreement suites run against each.
     """
+    if front not in FRONTS:
+        raise ValueError(f"unknown front {front!r} (choose from {FRONTS})")
+    if front == "asyncio":
+        from rl_scheduler_tpu.scheduler.front import AsyncFrontServer
+
+        return AsyncFrontServer(policy, host, port, reuse_port=reuse_port,
+                                inherited_socket=inherited_socket)
     handler = type("BoundHandler", (_Handler,), {"policy": policy})
     if inherited_socket is not None:
         server = ThreadingHTTPServer((host, port), handler,
@@ -1929,6 +2103,15 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--run-root", default=None)
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8787)
+    p.add_argument("--front", default="threading", choices=FRONTS,
+                   help="graftfront: data-plane transport. 'threading' "
+                        "(default) is the classic ThreadingHTTPServer — "
+                        "one thread per connection; 'asyncio' is the "
+                        "event-loop front (scheduler/front.py): keep-"
+                        "alive, 10k+ concurrent connections, policy "
+                        "decisions in a bounded executor, identical "
+                        "/stats//metrics/trace/SLO semantics. Applies "
+                        "per worker in pool mode (docs/serving.md)")
     p.add_argument("--workers", type=int, default=None, metavar="N",
                    help="graftserve pool mode: fork N worker processes "
                         "sharing --port via SO_REUSEPORT (fork-after-bind "
@@ -2176,7 +2359,7 @@ def main(argv: list[str] | None = None) -> None:
         run_pool(build_kwargs, workers=args.workers, host=args.host,
                  port=args.port, control_port=args.control_port,
                  control_host=args.control_host,
-                 blas_threads=args.blas_threads)
+                 blas_threads=args.blas_threads, front=args.front)
         return
     try:
         policy = build_policy(**build_kwargs)
@@ -2186,9 +2369,9 @@ def main(argv: list[str] | None = None) -> None:
         # with actionable messages — exit cleanly, not with a traceback.
         raise SystemExit(str(e))
     check_warm_nodes_served(policy, warm_nodes)
-    server = make_server(policy, args.host, args.port)
+    server = make_server(policy, args.host, args.port, front=args.front)
     print(f"Scheduler extender serving on {args.host}:{args.port} "
-          f"(backend={policy.backend.name})", flush=True)
+          f"(backend={policy.backend.name}, front={args.front})", flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

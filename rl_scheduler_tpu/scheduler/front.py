@@ -1,0 +1,388 @@
+"""graftfront: the asyncio data-plane front for the scheduler extender.
+
+graftfwd left the serving plane transport-bound: with the score cache
+armed the POLICY answers a cache hit in ~0.055 ms, yet clients measured
+p50 ~26 ms at 8-way concurrency (BENCH_serving.jsonl) — the residual is
+``ThreadingHTTPServer``'s one-GIL-bound-thread-per-connection accept
+path plus a fresh TCP connection per request. This module replaces the
+transport and ONLY the transport:
+
+- :class:`AsyncFrontServer` is facade-compatible with the
+  ``ThreadingHTTPServer`` the pool workers drive (``server_address``
+  readable after construction, blocking ``serve_forever()``,
+  thread-safe ``shutdown()`` that drains in-flight requests, idempotent
+  ``server_close()``, a writable ``daemon_threads`` attribute) — so
+  ``pool.py``'s supervisor, SIGTERM drain, and rolling promote/canary
+  gates run unchanged on asyncio workers.
+- One event loop accepts 10k+ concurrent keep-alive connections
+  (``loops=N`` runs N accept loops over ``SO_REUSEPORT`` sockets — the
+  same port-sharing the pool's listener machinery uses across worker
+  PROCESSES, here across loops of one worker).
+- Every policy call — JSON decode included — runs in a bounded
+  ``ThreadPoolExecutor`` via ``run_in_executor``: the loop never blocks
+  on numpy/backend work, and each request occupies exactly one executor
+  thread for its whole policy call, which is what keeps the policy's
+  ``threading.local`` span/synthetic machinery (graftlens) working
+  bit-for-bit: phase counts stay uniform, fail-open drops partial
+  spans, probes stay excluded, ``/stats/reset`` never rewinds
+  lifetimes. The agreement suites run identically against both fronts.
+- ``/filter``/``/prioritize`` bodies with the compact wire content type
+  (``wire.py``) skip JSON entirely; a malformed wire token answers 400
+  and KEEPS the connection — a refusal is not a reset.
+
+What this front does NOT change: routes, payloads, status codes, the
+fail-open backstops, trace records, SLO accounting. ``--front asyncio``
+selects it; threading stays the default (docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import socket
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+from rl_scheduler_tpu.scheduler.wire import (
+    WIRE_CONTENT_TYPE,
+    WireError,
+    serve_wire,
+)
+
+logger = logging.getLogger(__name__)
+
+# Header-section cap (stdlib http.server reads 64 KiB lines; same bar).
+_MAX_HEADER_BYTES = 65536
+# Listen backlog: sized for connection storms, clamped by somaxconn.
+_BACKLOG = 1024
+# How long shutdown waits for in-flight requests before cancelling the
+# stragglers (the pool supervisor's terminate->join(10 s)->kill
+# escalation is the outer bound).
+_DRAIN_TIMEOUT_S = 10.0
+
+_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+            431: "Request Header Fields Too Large",
+            500: "Internal Server Error"}
+
+
+class AsyncFrontServer:
+    """The event-loop HTTP front (module doc). Dispatch semantics are
+    defined by ``extender._Handler`` — this class reimplements the
+    transport beneath them, not the routes."""
+
+    def __init__(self, policy, host: str = "0.0.0.0", port: int = 8787,
+                 reuse_port: bool = False, inherited_socket=None,
+                 loops: int = 1, executor_workers: int | None = None):
+        if loops < 1:
+            raise ValueError(f"loops={loops}: pass at least 1")
+        if loops > 1 and inherited_socket is not None:
+            raise ValueError("loops>1 needs per-loop SO_REUSEPORT "
+                             "listeners; an inherited socket is one "
+                             "shared listener (use loops=1)")
+        self.policy = policy
+        # Binding happens AT CONSTRUCTION, exactly like HTTPServer's
+        # __init__: the pool worker sends its hello (with
+        # server_address[1]) before serve_forever starts.
+        if inherited_socket is not None:
+            self._socks = [inherited_socket]
+            self._owns_socks = False
+        else:
+            want_reuseport = reuse_port or loops > 1
+            if want_reuseport and not hasattr(socket, "SO_REUSEPORT"):
+                raise ValueError(
+                    "SO_REUSEPORT unavailable on this platform (the "
+                    "pool's inherit mode is the fallback)")
+            self._socks = []
+            try:
+                for _ in range(loops):
+                    self._socks.append(
+                        self._bind(host, port, want_reuseport))
+                    # Subsequent loops join the first socket's port.
+                    port = self._socks[0].getsockname()[1]
+            except OSError:
+                for s in self._socks:
+                    s.close()
+                raise
+            self._owns_socks = True
+        self.server_address = self._socks[0].getsockname()
+        # Facade compatibility: pool.py sets this on both fronts. The
+        # drain behaviour it selects on ThreadingHTTPServer (join
+        # handlers on close) is this front's only behaviour.
+        self.daemon_threads = False
+        self._loops_n = loops
+        self._executor = ThreadPoolExecutor(
+            max_workers=executor_workers or 32,
+            thread_name_prefix="graftfront")
+        self._loop_ctx: list = [None] * loops  # (loop, stop_event) pairs
+        self._serving = threading.Event()
+        self._is_shut_down = threading.Event()
+        self._is_shut_down.set()  # matches socketserver: set while idle
+        self._shutdown_requested = False
+        self._closed = False
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _bind(host: str, port: int, reuseport: bool) -> socket.socket:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            if reuseport:
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            sock.bind((host, port))
+            sock.listen(_BACKLOG)
+            sock.setblocking(False)
+        except OSError:
+            sock.close()
+            raise
+        return sock
+
+    # ------------------------------------------------------------ facade
+
+    def serve_forever(self) -> None:
+        """Run the accept loop(s) until :meth:`shutdown`. Loop 0 runs in
+        the calling thread (the worker's main thread, where the SIGTERM
+        drain handler lives); extra loops run in daemon threads."""
+        with self._lock:
+            if self._shutdown_requested or self._closed:
+                return  # shutdown() won the race before serving started
+            self._is_shut_down.clear()
+            self._serving.set()
+        threads = [
+            threading.Thread(target=self._run_loop, args=(i,),
+                             name=f"graftfront-loop-{i}", daemon=True)
+            for i in range(1, self._loops_n)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            self._run_loop(0)
+        finally:
+            for t in threads:
+                t.join()
+            self._serving.clear()
+            self._is_shut_down.set()
+
+    def shutdown(self) -> None:
+        """Thread-safe stop: close the listeners, finish in-flight
+        requests, close idle keep-alive connections, then return once
+        serve_forever has unwound (ThreadingHTTPServer.shutdown's
+        blocking contract — the pool's SIGTERM drain depends on it)."""
+        with self._lock:
+            self._shutdown_requested = True
+            if not self._serving.is_set():
+                return
+            for ctx in self._loop_ctx:
+                if ctx is None:
+                    continue
+                loop, stop = ctx
+                try:
+                    loop.call_soon_threadsafe(stop.set)
+                except RuntimeError:
+                    pass  # loop already closed: nothing left to stop
+        self._is_shut_down.wait()
+
+    def server_close(self) -> None:
+        """Release the sockets and join the executor (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._owns_socks:
+            for sock in self._socks:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        self._executor.shutdown(wait=True)
+
+    # ------------------------------------------------------- event loops
+
+    def _run_loop(self, idx: int) -> None:
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(self._serve(loop, idx))
+        finally:
+            loop.close()
+
+    async def _serve(self, loop, idx: int) -> None:
+        stop = asyncio.Event()
+        conns: dict = {}  # task -> mutable {"inflight": bool}
+        with self._lock:
+            if self._shutdown_requested:
+                return
+            self._loop_ctx[idx] = (loop, stop)
+        stopping = {"flag": False}
+
+        async def handle(reader, writer):
+            task = asyncio.current_task()
+            state = {"inflight": False}
+            conns[task] = state
+            try:
+                await self._handle_conn(reader, writer, state, stopping)
+            except asyncio.CancelledError:
+                pass  # idle keep-alive connection closed by the drain
+            except (ConnectionResetError, BrokenPipeError, EOFError,
+                    TimeoutError, OSError):
+                pass  # client went away mid-request: nothing to answer
+            finally:
+                conns.pop(task, None)
+                writer.close()
+
+        server = await asyncio.start_server(
+            handle, sock=self._socks[idx], limit=_MAX_HEADER_BYTES,
+            backlog=_BACKLOG)
+        await stop.wait()
+        # Drain: stop accepting, let in-flight requests answer, close
+        # idle connections — a request an exiting worker already read
+        # is answered, not reset (the rolling-restart zero-failures bar,
+        # same contract as the threading front's server_close join).
+        server.close()
+        # close() closed our listening socket too; mark it released so
+        # server_close does not double-close an fd someone else may own.
+        await server.wait_closed()
+        stopping["flag"] = True
+        for task, state in list(conns.items()):
+            if not state["inflight"]:
+                task.cancel()
+        if conns:
+            await asyncio.wait(list(conns), timeout=_DRAIN_TIMEOUT_S)
+        for task in list(conns):
+            task.cancel()
+        if conns:
+            await asyncio.gather(*list(conns), return_exceptions=True)
+
+    async def _handle_conn(self, reader, writer, state: dict,
+                           stopping: dict) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            try:
+                head = await reader.readuntil(b"\r\n\r\n")
+            except (asyncio.IncompleteReadError, ConnectionResetError):
+                return  # clean EOF between requests (or torn request)
+            except asyncio.LimitOverrunError:
+                await self._respond(writer, 431,
+                                    b'{"error": "headers too large"}',
+                                    "application/json", close=True)
+                return
+            parsed = self._parse_head(head)
+            if parsed is None:
+                await self._respond(writer, 400,
+                                    b'{"error": "malformed request"}',
+                                    "application/json", close=True)
+                return
+            method, path, version, headers = parsed
+            try:
+                length = int(headers.get("content-length", 0))
+            except ValueError:
+                length = -1
+            if length < 0 or length > 64 * 1024 * 1024:
+                await self._respond(writer, 400,
+                                    b'{"error": "bad content-length"}',
+                                    "application/json", close=True)
+                return
+            body = await reader.readexactly(length) if length else b""
+            conn_hdr = headers.get("connection", "").lower()
+            keep = (version == "HTTP/1.1" and conn_hdr != "close") \
+                or conn_hdr == "keep-alive"
+            state["inflight"] = True
+            try:
+                # The whole request — JSON/wire decode AND the policy
+                # call — on ONE executor thread: the policy's
+                # threading.local request state needs exactly that.
+                status, ctype, payload = await loop.run_in_executor(
+                    self._executor, _dispatch, self.policy, method, path,
+                    headers, body)
+            finally:
+                state["inflight"] = False
+            close = not keep or stopping["flag"]
+            await self._respond(writer, status, payload, ctype,
+                                close=close)
+            if close:
+                return
+
+    @staticmethod
+    def _parse_head(head: bytes):
+        """Request line + headers; None on malformation (a 400, never a
+        reset)."""
+        try:
+            lines = head[:-4].decode("latin-1").split("\r\n")
+            method, path, version = lines[0].split(" ")
+        except ValueError:
+            return None
+        headers = {}
+        for line in lines[1:]:
+            name, sep, value = line.partition(":")
+            if not sep:
+                return None
+            headers[name.strip().lower()] = value.strip()
+        return method, path, version, headers
+
+    @staticmethod
+    async def _respond(writer, status: int, payload: bytes, ctype: str,
+                       close: bool = False) -> None:
+        reason = _REASONS.get(status, "Unknown")
+        conn = "close" if close else "keep-alive"
+        writer.write(
+            (f"HTTP/1.1 {status} {reason}\r\n"
+             f"Content-Type: {ctype}\r\n"
+             f"Content-Length: {len(payload)}\r\n"
+             f"Connection: {conn}\r\n\r\n").encode("latin-1") + payload)
+        await writer.drain()
+
+
+def _dispatch(policy, method: str, path: str, headers: dict,
+              body: bytes) -> tuple:
+    """One request against the policy: ``(status, content_type, bytes)``.
+    Runs on an executor thread. Routes, payloads, and every fail-open
+    backstop mirror ``extender._Handler`` line for line — that handler
+    is the semantics spec; this function is its transport-free twin."""
+    from rl_scheduler_tpu.scheduler.extender import ExtenderPolicy
+
+    def js(code, obj):
+        return code, "application/json", json.dumps(obj).encode()
+
+    if method == "GET":
+        if path == "/healthz":
+            return js(200, policy.health())
+        if path == "/stats":
+            return js(200, policy.statistics())
+        if path == "/metrics":
+            return (200, "text/plain; version=0.0.4; charset=utf-8",
+                    policy.metrics_text().encode())
+        return js(404, {"error": f"unknown path {path}"})
+    if method != "POST":
+        return js(404, {"error": f"unknown path {path}"})
+    ctype = (headers.get("content-type") or "").split(";")[0].strip()
+    if ctype == WIRE_CONTENT_TYPE:
+        try:
+            answer = serve_wire(policy, path, body)
+        except WireError as exc:
+            # A refusal, never a dropped connection (codec contract).
+            return js(400, {"error": f"bad wire: {exc}"})
+        except ValueError:
+            return js(404, {"error": f"unknown path {path}"})
+        return 200, WIRE_CONTENT_TYPE, answer
+    try:
+        args = json.loads(body or b"{}")
+    except json.JSONDecodeError as exc:
+        return js(400, {"error": f"bad json: {exc}"})
+    args = {k.lower(): v for k, v in args.items()}
+    if path == "/filter":
+        try:
+            result = policy.filter(args)
+        except Exception:  # noqa: BLE001 — last-line fail-open backstop
+            logger.exception("filter failed on malformed request; "
+                             "passing nodes through")
+            result = ExtenderPolicy._passthrough(args)
+        return js(200, result)
+    if path == "/prioritize":
+        try:
+            result = policy.prioritize(args)
+        except Exception:  # noqa: BLE001 — last-line fail-open backstop
+            logger.exception("prioritize failed on malformed request; "
+                             "empty priority list")
+            result = []
+        return js(200, result)
+    if path == "/stats/reset":
+        return js(200, policy.reset_stats())
+    return js(404, {"error": f"unknown path {path}"})

@@ -768,7 +768,7 @@ def _worker_main(worker_id: int, n_workers: int, policy_factory, shared,
                  host: str, port: int, listener, reuse_port: bool,
                  control_spec: str, blas_threads: int = 0,
                  spec: WorkerSpec | None = None,
-                 takes_spec: bool = False) -> None:
+                 takes_spec: bool = False, front: str = "threading") -> None:
     """The forked worker body: build the policy, serve the data port
     (own SO_REUSEPORT listener, or the inherited pre-fork socket), and
     answer the supervisor's control commands. Any startup failure exits
@@ -794,12 +794,13 @@ def _worker_main(worker_id: int, n_workers: int, policy_factory, shared,
                             "generation": spec.generation}
         policy.generation = spec.generation
         if reuse_port:
-            server = make_server(policy, host, port, reuse_port=True)
+            server = make_server(policy, host, port, reuse_port=True,
+                                 front=front)
             if listener is not None:
                 listener.close()  # the supervisor's startup placeholder
         else:
             server = make_server(policy, host, port,
-                                 inherited_socket=listener)
+                                 inherited_socket=listener, front=front)
         # Drainable handlers: ThreadingHTTPServer's daemon handler
         # threads are NOT tracked by socketserver's _Threads, so
         # server_close() would join nothing and an in-flight request
@@ -916,9 +917,12 @@ class ServingPool:
                  blas_threads: int | None = None,
                  initial_checkpoint: str | None = None,
                  fault_plan=None, rollout_opts: dict | None = None,
-                 slo_enabled: bool = False):
+                 slo_enabled: bool = False, front: str = "threading"):
         if workers < 1:
             raise ValueError(f"workers={workers}: pass at least 1")
+        if front not in ("threading", "asyncio"):
+            raise ValueError(f"unknown front {front!r} (choose "
+                             "'threading' or 'asyncio')")
         if blas_threads is not None and blas_threads < 0:
             raise ValueError(f"blas_threads={blas_threads}: pass a positive "
                              "count, 0 to leave library defaults, or None "
@@ -937,6 +941,10 @@ class ServingPool:
                              "(mode='auto' falls back to socket inheritance)")
         self.reuse_port = (mode == "reuseport"
                           or (mode == "auto" and have_reuseport))
+        # graftfront: per-worker data-plane transport. The supervisor's
+        # control plane stays ThreadingHTTPServer either way — it is a
+        # scrape/promote plane, not the 10k-connection path.
+        self.front = front
         self._factory = policy_factory
         # graftroll: spec-aware factories take (worker_id, shared, spec)
         # and can build a policy for ANY checkpoint generation; legacy
@@ -1111,7 +1119,7 @@ class ServingPool:
             args=(slot.worker_id, self.workers, self._factory, self.shared,
                   self.host, self.port, self._listener, self.reuse_port,
                   self._control_spec, self.blas_threads, slot.spec,
-                  self._factory_takes_spec),
+                  self._factory_takes_spec, self.front),
             daemon=False,
             name=f"graftserve-worker-{slot.worker_id}",
         )
@@ -1396,7 +1404,8 @@ def _make_control_server(pool: ServingPool, host: str,
 
 def run_pool(build_kwargs: dict, workers: int, host: str, port: int,
              control_port: int | None, control_host: str | None = None,
-             blas_threads: int | None = None) -> None:
+             blas_threads: int | None = None,
+             front: str = "threading") -> None:
     """The ``--workers N`` entry point behind the extender CLI: wrap
     ``build_policy`` into a per-worker factory (each worker restores the
     checkpoint and compiles its own backend AFTER the fork — the
@@ -1445,7 +1454,8 @@ def run_pool(build_kwargs: dict, workers: int, host: str, port: int,
                        control_port=control_port, blas_threads=blas_threads,
                        initial_checkpoint=build_kwargs.get("run"),
                        slo_enabled=slo_cfg is not None,
-                       rollout_opts={"slo": slo_cfg} if slo_cfg else None)
+                       rollout_opts={"slo": slo_cfg} if slo_cfg else None,
+                       front=front)
     pool.start()
 
     def _stop(signum, frame):  # noqa: ARG001 (signal API)
@@ -1456,7 +1466,7 @@ def run_pool(build_kwargs: dict, workers: int, host: str, port: int,
     status = pool.status()
     print(
         f"graftserve pool: {workers} worker(s) on {host}:{pool.port} "
-        f"({status['mode']}), control plane on "
+        f"({status['mode']}, front={front}), control plane on "
         f"{pool.control_address[0]}:{pool.control_address[1]}",
         flush=True,
     )

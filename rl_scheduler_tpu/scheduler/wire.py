@@ -1,0 +1,237 @@
+"""graftfront wire: the compact candidate-list codec for the data plane.
+
+The extender protocol's JSON bodies are the serving plane's residual
+parse cost once graftfwd cached/quantized the forward: a 1024-node
+/filter request is ~40 KB of JSON that ``json.loads`` re-materializes
+into a Python list of node strings (or worse, node OBJECTS) on every
+request, only for the policy to immediately reduce it to a per-candidate
+cloud list. This module extends the trace log's ``clouds_token``
+one-char-per-candidate encoding (``tracelog.py``) into a full
+request/response codec so a front can hand a request to the policy
+without ever building that list:
+
+request body (``Content-Type: application/x-graft-wire``)::
+
+    1;<pod_millicores>;<clouds_token>[;<name,name,...>]
+
+    1;500;azaz?          # 5 candidates, pod requests 0.5 cores
+    1;250;az;web-0,web-1 # explicit display names (optional)
+
+- one char per candidate: ``a``=aws, ``z``=azure, ``?``=unknown — the
+  EXACT alphabet ``tracelog.clouds_token`` writes, so a trace replayer
+  can turn records back into wire bodies with zero translation;
+- display names are optional: when absent they synthesize lazily
+  (``aws-0``, ``azure-3``, ``node-7`` — the same names ``extender_bench
+  --replay-trace`` fabricates) and only the chosen one is ever built;
+- the decoder is STRICT where the trace reader is lenient: an unknown
+  cloud char, a malformed millicore field or a name-count mismatch
+  raises :class:`WireError`, which both fronts answer with HTTP 400 —
+  a refusal, never a dropped connection.
+
+responses::
+
+    /filter      1;0,3,7     kept candidate indices (csv)
+                 1;*         keep ALL (fail-open / empty request)
+    /prioritize  1;100,42,7  one 0-100 score per candidate (csv)
+
+Wire v1 carries the pod's cpu request only (millicores); deployments
+whose checkpoints consume full heterogeneous resource vectors keep the
+JSON path — the two content types share one port and one policy.
+"""
+
+from __future__ import annotations
+
+import time
+from collections.abc import Sequence
+
+from rl_scheduler_tpu.scheduler.tracelog import _CLOUD_CHARS
+
+WIRE_CONTENT_TYPE = "application/x-graft-wire"
+WIRE_VERSION = 1
+
+# Strict inverses of the trace alphabet: the trace READER tolerates junk
+# chars (an old record must replay), the wire DECODER refuses them (a
+# malformed request must 400, not silently score "unknown cloud").
+_CHAR_TO_CLOUD = {ch: cloud for cloud, ch in _CLOUD_CHARS.items()}
+_CLOUD_TO_CHAR = dict(_CLOUD_CHARS)
+# Delimiters the name field cannot carry (no escaping in v1 — k8s node
+# names are DNS-1123 labels, which exclude all three anyway).
+_NAME_FORBIDDEN = (";", ",", "\n", "\r")
+_SENTINEL = object()
+
+
+class WireError(ValueError):
+    """Malformed wire body — the fronts answer 400 with this message."""
+
+
+class SynthNames(Sequence):
+    """Lazy display names for a names-less wire request: ``{cloud}-{i}``
+    (``node-{i}`` for unknown clouds), matching what ``extender_bench
+    --replay-trace`` synthesizes from trace records. Indexing builds ONE
+    string; the policy only ever needs the chosen candidate's name."""
+
+    __slots__ = ("_clouds",)
+
+    def __init__(self, clouds: Sequence) -> None:
+        self._clouds = clouds
+
+    def __len__(self) -> int:
+        return len(self._clouds)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        cloud = self._clouds[i]
+        return f"{cloud or 'node'}-{i if i >= 0 else i % len(self._clouds)}"
+
+
+class WireRequest:
+    """One decoded wire request: the candidate cloud list (the only
+    per-candidate structure the decide path consumes), the pod's cpu
+    request in millicores, and a display-name sequence that is lazy
+    unless the client sent explicit names."""
+
+    __slots__ = ("clouds", "pod_millicores", "names")
+
+    def __init__(self, clouds: list, pod_millicores: int,
+                 names: Sequence | None = None) -> None:
+        self.clouds = clouds
+        self.pod_millicores = pod_millicores
+        self.names = names if names is not None else SynthNames(clouds)
+
+    def __len__(self) -> int:
+        return len(self.clouds)
+
+    def pod_cpu_fraction(self, node_capacity_cores: float) -> float:
+        """The set policy's [0,1] pod_cpu feature, same normalization as
+        ``pod_cpu_fraction`` on the JSON path."""
+        return self.pod_millicores / 1e3 / node_capacity_cores
+
+
+def encode_request(clouds: Sequence, pod_millicores: int,
+                   names: Sequence | None = None) -> bytes:
+    """Candidate list -> wire body (the client/bench side)."""
+    if pod_millicores < 0 or int(pod_millicores) != pod_millicores:
+        raise WireError(f"pod_millicores {pod_millicores!r}: pass a "
+                        "non-negative integer")
+    try:
+        token = "".join(_CLOUD_TO_CHAR[c] for c in clouds)
+    except KeyError as exc:
+        raise WireError(f"unknown cloud {exc.args[0]!r} (wire v1 encodes "
+                        f"{sorted(c for c in _CLOUD_TO_CHAR if c)})")
+    parts = [str(WIRE_VERSION), str(int(pod_millicores)), token]
+    if names is not None:
+        names = list(names)
+        if len(names) != len(clouds):
+            raise WireError(f"{len(names)} names for {len(clouds)} "
+                            "candidates")
+        for name in names:
+            if not isinstance(name, str) or not name:
+                raise WireError(f"bad candidate name {name!r}")
+            if any(ch in name for ch in _NAME_FORBIDDEN):
+                raise WireError(f"name {name!r} contains a wire delimiter "
+                                "(;,\\n\\r) — send JSON for such names")
+        parts.append(",".join(names))
+    return ";".join(parts).encode("utf-8")
+
+
+def decode_request(body: bytes) -> WireRequest:
+    """Wire body -> :class:`WireRequest`; :class:`WireError` on any
+    malformation (the fronts' 400 path)."""
+    try:
+        text = body.decode("utf-8")
+    except UnicodeDecodeError as exc:
+        raise WireError(f"body is not utf-8: {exc}")
+    parts = text.split(";")
+    if len(parts) not in (3, 4):
+        raise WireError(f"expected 3 or 4 ';'-fields, got {len(parts)}")
+    if parts[0] != str(WIRE_VERSION):
+        raise WireError(f"unsupported wire version {parts[0]!r} "
+                        f"(this server speaks {WIRE_VERSION})")
+    try:
+        millis = int(parts[1])
+    except ValueError:
+        raise WireError(f"bad pod_millicores field {parts[1]!r}")
+    if millis < 0:
+        raise WireError(f"negative pod_millicores {millis}")
+    clouds = []
+    for ch in parts[2]:
+        cloud = _CHAR_TO_CLOUD.get(ch, _SENTINEL)
+        if cloud is _SENTINEL:
+            raise WireError(f"unknown cloud char {ch!r} in token "
+                            f"(alphabet: {sorted(_CHAR_TO_CLOUD)})")
+        clouds.append(cloud)
+    names = None
+    if len(parts) == 4:
+        names = parts[3].split(",") if parts[3] else []
+        if len(names) != len(clouds):
+            raise WireError(f"{len(names)} names for {len(clouds)} "
+                            "candidates")
+        if any(not n for n in names):
+            raise WireError("empty candidate name")
+    return WireRequest(clouds, millis, names)
+
+
+def encode_filter_response(kept: Sequence | None) -> bytes:
+    """Kept-indices -> wire body; ``None`` is the fail-open/passthrough
+    answer (``1;*`` — keep every candidate)."""
+    if kept is None:
+        return f"{WIRE_VERSION};*".encode()
+    return (f"{WIRE_VERSION};"
+            + ",".join(str(int(i)) for i in kept)).encode()
+
+
+def decode_filter_response(body: bytes, n: int) -> list | None:
+    """Wire filter body -> kept indices (``None`` = keep all); strict —
+    an out-of-range index is a server bug the client must see."""
+    text = body.decode("utf-8")
+    parts = text.split(";")
+    if len(parts) != 2 or parts[0] != str(WIRE_VERSION):
+        raise WireError(f"bad filter response {text!r}")
+    if parts[1] == "*":
+        return None
+    if not parts[1]:
+        return []
+    try:
+        kept = [int(f) for f in parts[1].split(",")]
+    except ValueError:
+        raise WireError(f"bad filter response {text!r}")
+    if any(i < 0 or i >= n for i in kept):
+        raise WireError(f"filter response index out of range 0..{n - 1}")
+    return kept
+
+
+def encode_prioritize_response(scores: Sequence) -> bytes:
+    """Per-candidate 0-100 scores -> wire body."""
+    return (f"{WIRE_VERSION};"
+            + ",".join(str(int(s)) for s in scores)).encode()
+
+
+def decode_prioritize_response(body: bytes) -> list:
+    text = body.decode("utf-8")
+    parts = text.split(";")
+    if len(parts) != 2 or parts[0] != str(WIRE_VERSION):
+        raise WireError(f"bad prioritize response {text!r}")
+    if not parts[1]:
+        return []
+    try:
+        return [int(f) for f in parts[1].split(",")]
+    except ValueError:
+        raise WireError(f"bad prioritize response {text!r}")
+
+
+def serve_wire(policy, path: str, body: bytes) -> bytes:
+    """One wire request against the policy: decode (the request's
+    ``parse`` phase — charged to the span exactly like the JSON path's
+    node extraction), dispatch to the policy's wire entry points, encode
+    the answer. Raises :class:`WireError` on a malformed body (callers
+    answer 400) and ``ValueError`` on an unknown path (404)."""
+    t_parse = time.perf_counter()
+    req = decode_request(body)
+    parse_s = time.perf_counter() - t_parse
+    if path == "/filter":
+        return encode_filter_response(policy.filter_wire(req, parse_s))
+    if path == "/prioritize":
+        return encode_prioritize_response(
+            policy.prioritize_wire(req, parse_s))
+    raise ValueError(f"unknown wire path {path}")

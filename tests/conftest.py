@@ -13,6 +13,13 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 # Keep compilation deterministic and quiet in CI.
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Persistent-cache env vars, not just the in-process config below: tests
+# spawn real CLIs as subprocesses (train_ppo retrains, pool workers,
+# study workers) which inherit os.environ — without these each
+# subprocess pays every compile cold (the loop drill alone re-compiles
+# ~35s of programs the suite already built).
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -27,9 +34,14 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: compiles dominate suite runtime on CPU
-# (~1.2s per jit on this box vs ~0.1ms per dispatched step).
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+# (~1.2s per jit on this box vs ~0.1ms per dispatched step). Config
+# mirrors the env vars exported above (a site hook may have imported
+# jax before the env was set, so update the config explicitly too).
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update(
+    "jax_persistent_cache_min_compile_time_secs",
+    float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
 
 
 @pytest.fixture(scope="session")

@@ -27,3 +27,10 @@ def check_gl011_fixture_names_are_covered():
     # measure_decide_monotonic, cache_age_seconds, stamp_record,
     # one_hour_ago — referenced here so only GL011 fires there.
     pass
+
+
+def check_gl012_fixture_names_are_covered():
+    # scheduler/gl012_bad.py + gl012_good.py public surface: handle,
+    # fetch, probe, dispatch, with_helper, sync_path — referenced here
+    # so only GL012 fires there.
+    pass

@@ -1,0 +1,21 @@
+"""GL012 positive fixture: synchronous blocking calls inside async
+defs on the serving data plane (each one parks the whole event loop)."""
+
+import time
+import urllib.request
+
+
+async def handle(reader, writer):
+    time.sleep(0.1)
+    data = open("/tmp/fixture").read()
+    writer.write(data.encode())
+    await writer.drain()
+
+
+async def fetch(url):
+    return urllib.request.urlopen(url).read()
+
+
+async def probe(sock):
+    conn, _ = sock.accept()
+    return conn.recv(4096)

@@ -1,0 +1,31 @@
+"""GL012 negative fixture: coroutines that await, delegate blocking
+work to the executor, or keep sync calls inside nested sync defs."""
+
+import asyncio
+import time
+
+
+async def handle(reader, writer):
+    await asyncio.sleep(0.01)
+    body = await reader.readexactly(4)
+    writer.write(body)
+    await writer.drain()
+
+
+async def dispatch(loop, executor, policy, body):
+    return await loop.run_in_executor(executor, policy.decide, body)
+
+
+async def with_helper():
+    def helper():
+        # A nested sync def only defines; it runs on an executor
+        # thread, not on the loop.
+        time.sleep(0.0)
+        return 0
+
+    return await asyncio.get_running_loop().run_in_executor(None, helper)
+
+
+def sync_path():
+    # Not a coroutine: blocking here never touches an event loop.
+    time.sleep(0.0)

@@ -1,0 +1,329 @@
+"""graftfront: the asyncio data-plane front behind ``--front``.
+
+``AsyncFrontServer`` replaces ``ThreadingHTTPServer`` behind
+``make_server(..., front="asyncio")`` and must be a drop-in under the
+pool supervisor's facade contract — ``server_address`` readable after
+construction, blocking ``serve_forever``, thread-safe ``shutdown``,
+idempotent ``server_close`` — while serving the EXACT decision/stats/
+metrics semantics of the threading front (the graftlens suites are the
+spec; ``test_graftlens``/``test_pool`` run parameterized over both
+fronts). Here: the facade contract, front parity on the observable
+stats surface, keep-alive connection reuse, and loop health under
+concurrent load."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from rl_scheduler_tpu.scheduler.extender import (
+    PHASES,
+    ExtenderPolicy,
+    make_server,
+)
+from rl_scheduler_tpu.scheduler.front import AsyncFrontServer
+from rl_scheduler_tpu.scheduler.policy_backend import GreedyBackend
+from rl_scheduler_tpu.scheduler.telemetry import RandomCpu, TableTelemetry
+
+FRONT_PARAMS = ["threading", "asyncio"]
+
+
+def _policy(seed=0):
+    telemetry = TableTelemetry.from_table(cpu_source=RandomCpu(seed=seed))
+    return ExtenderPolicy(GreedyBackend(), telemetry)
+
+
+def _args(i=0, n=4):
+    return {"nodenames": [f"{'aws' if j % 2 else 'azure'}-n{i}-{j}"
+                          for j in range(n)], "pod": {}}
+
+
+class _Server:
+    """Start/serve/stop helper for one front."""
+
+    def __init__(self, front, policy=None):
+        self.policy = policy or _policy()
+        self.srv = make_server(self.policy, host="127.0.0.1", port=0,
+                               front=front)
+        self.port = self.srv.server_address[1]
+        self.thread = threading.Thread(target=self.srv.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    def post(self, path, body, ctype="application/json"):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.port}{path}", data=body,
+            headers={"Content-Type": ctype})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, resp.read()
+
+    def get_json(self, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{self.port}{path}", timeout=5) as resp:
+            return json.loads(resp.read())
+
+    def stop(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+        self.thread.join(timeout=10)
+
+
+# ------------------------------------------------------- facade contract
+
+
+def test_make_server_refuses_unknown_front():
+    with pytest.raises(ValueError):
+        make_server(_policy(), host="127.0.0.1", port=0, front="gevent")
+
+
+def test_async_front_satisfies_the_pool_facade():
+    """The supervisor's contract: address before serve_forever, blocking
+    serve loop, thread-safe shutdown, idempotent close."""
+    srv = AsyncFrontServer(_policy(), "127.0.0.1", 0)
+    host, port = srv.server_address[:2]
+    assert host == "127.0.0.1" and port > 0
+    srv.daemon_threads = True  # writable, like ThreadingHTTPServer's
+
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz",
+                                timeout=5) as resp:
+        assert json.loads(resp.read())["status"] == "ok"
+    srv.shutdown()           # from another thread, like the control loop
+    thread.join(timeout=10)
+    assert not thread.is_alive()
+    srv.server_close()
+    srv.server_close()       # idempotent
+    with pytest.raises(OSError):
+        http.client.HTTPConnection("127.0.0.1", port, timeout=1).connect()
+
+
+def test_shutdown_before_serve_forever_is_clean():
+    """The SIGTERM race: a drain signal can land between construction
+    and serve_forever; the serve loop must exit immediately."""
+    srv = AsyncFrontServer(_policy(), "127.0.0.1", 0)
+    srv.shutdown()
+    srv.serve_forever()      # returns at once instead of serving
+    srv.server_close()
+
+
+# ----------------------------------------------------------- front parity
+
+
+def test_stats_surface_identical_across_fronts():
+    """The agreement suite's core claim: the same request stream
+    produces the SAME decision counters, per-phase sample counts and
+    fail-open counts on both fronts (latencies differ; counts and
+    structure may not)."""
+    snaps = {}
+    for front in FRONT_PARAMS:
+        server = _Server(front)
+        try:
+            for i in range(6):
+                path = "/filter" if i % 2 == 0 else "/prioritize"
+                status, _ = server.post(path, json.dumps(_args(i)).encode())
+                assert status == 200
+            snaps[front] = server.get_json("/stats")
+        finally:
+            server.stop()
+    a, b = snaps["threading"], snaps["asyncio"]
+    assert a["decisions"] == b["decisions"]  # per-cloud choice counts
+    assert a["fail_open_total"] == b["fail_open_total"] == 0
+    assert a["choice_fractions"] == b["choice_fractions"]
+    assert set(a["phases"]) == set(b["phases"]) == set(PHASES)
+    for phase in PHASES:
+        assert a["phases"][phase]["lifetime_count"] \
+            == b["phases"][phase]["lifetime_count"], phase
+
+
+def test_phase_count_uniformity_on_asyncio():
+    """graftlens count-uniformity: one sample per phase per served
+    decision on the asyncio front — probes and /stats traffic add
+    nothing."""
+    server = _Server("asyncio")
+    try:
+        for i in range(5):
+            server.post("/filter", json.dumps(_args(i)).encode())
+        server.get_json("/healthz")
+        server.get_json("/stats")
+        stats = server.get_json("/stats")
+        counts = {phase: stats["phases"][phase]["lifetime_count"]
+                  for phase in PHASES}
+        assert set(counts.values()) == {5}, counts
+    finally:
+        server.stop()
+
+
+def test_reset_never_rewinds_lifetime_counters_on_asyncio():
+    server = _Server("asyncio")
+    try:
+        for i in range(4):
+            server.post("/filter", json.dumps(_args(i)).encode())
+        before = server.get_json("/stats")
+        status, _ = server.post("/stats/reset", b"{}")
+        assert status == 200
+        after = server.get_json("/stats")
+        assert after["decisions"] == before["decisions"]
+        for phase in PHASES:
+            assert after["phases"][phase]["lifetime_count"] \
+                == before["phases"][phase]["lifetime_count"]
+        assert after["latency"]["count"] == 0  # the ring DID clear
+    finally:
+        server.stop()
+
+
+@pytest.mark.parametrize("front", FRONT_PARAMS)
+def test_error_semantics_match(front):
+    """404 on unknown paths, 400 on undecodable JSON — identical status
+    codes and JSON error bodies on both fronts."""
+    server = _Server(front)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=5)
+        conn.request("GET", "/nope")
+        resp = conn.getresponse()
+        assert resp.status == 404 and b"error" in resp.read()
+        conn.close()
+
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=5)
+        conn.request("POST", "/filter", b"{not json",
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 400 and b"error" in resp.read()
+        conn.close()
+
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=5)
+        conn.request("POST", "/nope", b"{}",
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 404
+        resp.read()
+        conn.close()
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------------- keep-alive
+
+
+def test_asyncio_front_keeps_connections_alive():
+    """HTTP/1.1 keep-alive end to end: many requests ride ONE
+    connection (the threading front is HTTP/1.0 and closes per
+    request — exactly the setup cost the asyncio front removes)."""
+    server = _Server("asyncio")
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=5)
+        for i in range(10):
+            conn.request("POST", "/filter",
+                         json.dumps(_args(i)).encode(),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = resp.read()
+            assert resp.status == 200
+            assert json.loads(body)["nodenames"]
+            assert not resp.will_close, "server dropped keep-alive"
+        stats = server.get_json("/stats")
+        assert sum(stats["decisions"].values()) == 10
+        conn.close()
+    finally:
+        server.stop()
+
+
+def test_connection_close_header_is_honored():
+    server = _Server("asyncio")
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=5)
+        conn.request("POST", "/filter", json.dumps(_args()).encode(),
+                     {"Content-Type": "application/json",
+                      "Connection": "close"})
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 200 and resp.will_close
+        conn.close()
+    finally:
+        server.stop()
+
+
+# -------------------------------------------------------- load / drain
+
+
+def test_concurrent_keepalive_load_zero_failures():
+    """8 keep-alive clients x 25 requests on one event loop: every
+    request answers 200 and the stats account for all of them."""
+    server = _Server("asyncio")
+    errors = []
+
+    def client(tid):
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=10)
+            for i in range(25):
+                path = "/filter" if i % 2 == 0 else "/prioritize"
+                conn.request("POST", path,
+                             json.dumps(_args(tid * 100 + i)).encode(),
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status != 200:
+                    errors.append((tid, i, resp.status))
+            conn.close()
+        except Exception as exc:  # noqa: BLE001 - collected for assert
+            errors.append((tid, repr(exc)))
+
+    try:
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors[:5]
+        stats = server.get_json("/stats")
+        assert sum(stats["decisions"].values()) == 8 * 25
+    finally:
+        server.stop()
+
+
+def test_shutdown_drains_inflight_requests():
+    """A shutdown issued mid-request lets the in-flight decision finish
+    (the SIGTERM drain contract) instead of resetting the client."""
+    import time as _time
+
+    class _SlowBackend:
+        name = "slow"
+
+        def decide(self, obs):
+            _time.sleep(0.3)
+            import numpy as np
+
+            return 0, np.zeros(2, "float32")
+
+    telemetry = TableTelemetry.from_table(cpu_source=RandomCpu(seed=0))
+    server = _Server("asyncio",
+                     policy=ExtenderPolicy(_SlowBackend(), telemetry))
+    result = {}
+
+    def slow_request():
+        try:
+            result["status"], result["body"] = server.post(
+                "/filter", json.dumps(_args()).encode())
+        except Exception as exc:  # noqa: BLE001 - asserted below
+            result["error"] = repr(exc)
+
+    t = threading.Thread(target=slow_request)
+    t.start()
+    _time.sleep(0.1)           # let the request reach the executor
+    server.srv.shutdown()      # drain: must NOT cut the in-flight reply
+    t.join(timeout=15)
+    server.srv.server_close()
+    server.thread.join(timeout=10)
+    assert result.get("status") == 200, result
+    assert json.loads(result["body"])["nodenames"]

@@ -241,10 +241,14 @@ def test_build_policy_arms_slo_and_no_spans(tmp_path):
 # ------------------------------------------------------------------ HTTP
 
 
-def test_http_stats_and_metrics_carry_phases_and_slo():
+@pytest.mark.parametrize("front", ["threading", "asyncio"])
+def test_http_stats_and_metrics_carry_phases_and_slo(front):
+    """Parameterized over BOTH data-plane fronts (graftfront): the
+    phase/SLO surface is the agreement spec the asyncio front must
+    serve bit-for-bit."""
     slo = SloTracker(SloConfig(p99_ms=1000.0, availability=0.999))
     policy = _policy(slo=slo)
-    srv = make_server(policy, host="127.0.0.1", port=0)
+    srv = make_server(policy, host="127.0.0.1", port=0, front=front)
     thread = threading.Thread(target=srv.serve_forever, daemon=True)
     thread.start()
     try:
@@ -273,3 +277,4 @@ def test_http_stats_and_metrics_carry_phases_and_slo():
                 "degraded": False, "burning": []}
     finally:
         srv.shutdown()
+        srv.server_close()

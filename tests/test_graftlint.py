@@ -99,6 +99,8 @@ CASES = [
     ("scheduler/gl010_good.py", "GL010", 0),
     ("scheduler/gl011_bad.py", "GL011", 3),
     ("scheduler/gl011_good.py", "GL011", 0),
+    ("scheduler/gl012_bad.py", "GL012", 5),
+    ("scheduler/gl012_good.py", "GL012", 0),
 ]
 
 
@@ -211,6 +213,6 @@ def test_cli_json_and_exit_code_on_bad_fixture():
 def test_cli_list_rules_covers_registry():
     proc = _run_cli("--list-rules")
     assert proc.returncode == 0
-    for rid in ["GL000"] + [f"GL{i:03d}" for i in range(1, 12)]:
+    for rid in ["GL000"] + [f"GL{i:03d}" for i in range(1, 13)]:
         assert rid in proc.stdout
-    assert len(load_rules()) == 11
+    assert len(load_rules()) == 12

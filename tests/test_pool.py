@@ -865,7 +865,8 @@ def _rollout_factory(trace_dir=None):
 
 
 def _make_rollout_pool(workers=2, trace_dir=None, fault_plan=None,
-                       restart_policy=None, **rollout_opts):
+                       restart_policy=None, front="threading",
+                       **rollout_opts):
     opts = {"canary_hold_s": 0.2, "probe_count": 2, "ready_timeout_s": 60.0}
     opts.update(rollout_opts)
     pool = ServingPool(
@@ -873,7 +874,7 @@ def _make_rollout_pool(workers=2, trace_dir=None, fault_plan=None,
         port=0, control_port=0,
         restart_policy=restart_policy or FAST_RESTARTS,
         stable_after_s=60.0, poll_interval_s=0.05,
-        fault_plan=fault_plan, rollout_opts=opts,
+        fault_plan=fault_plan, rollout_opts=opts, front=front,
     )
     pool.start(ready_timeout_s=60.0)
     return pool
@@ -942,20 +943,23 @@ def test_verify_candidate_manifest_semantics(tmp_path):
     assert verify_candidate(tmp_path / "nope")[0] is None
 
 
-def test_rollout_drill(tmp_path):
+@pytest.mark.parametrize("front", ["threading", "asyncio"])
+def test_rollout_drill(tmp_path, front):
     """`make rollout-drill`: (a) a good promote lands generation 1 on
     every worker with serving uninterrupted; (b) a corrupted copy is
     refused before any worker is touched; (c) a verifies-clean-but-
     regressing promote fails the canary's warm-up probes and rolls the
     pool back to the incumbent generation; the trace log replays every
-    decision and /stats/reset never rewinds the lifetime counters."""
+    decision and /stats/reset never rewinds the lifetime counters.
+    Parameterized over BOTH data-plane fronts (graftfront): promote,
+    canary and rollback must behave identically on asyncio workers."""
     good = _make_verified_checkpoint(tmp_path, "ckpt-good")
     corrupt = Path(shutil.copytree(good, tmp_path / "ckpt-corrupt"))
     state = corrupt / "checkpoints" / "1" / "state.bin"
     state.write_bytes(state.read_bytes() + b"JUNK")
     regress = _make_verified_checkpoint(tmp_path, "ckpt-regress")
     trace_dir = tmp_path / "trace"
-    pool = _make_rollout_pool(trace_dir=str(trace_dir))
+    pool = _make_rollout_pool(trace_dir=str(trace_dir), front=front)
     requests = 0
     try:
         cport = pool.control_address[1]

@@ -1,0 +1,233 @@
+"""graftfront wire codec: round-trip properties and the strictness
+contract.
+
+The compact wire format (``scheduler/wire.py``) is the data-plane
+front's parse budget: one char per candidate, lazy display names, and a
+decoder that is STRICT where the trace reader is lenient. These tests
+pin (a) bitwise encode/decode round-trips across the edge cases —
+unicode names, empty candidate lists, maximal-N tokens, unknown-cloud
+candidates — (b) every malformation class raising :class:`WireError`,
+and (c) the HTTP contract that a malformed body answers 400 WITHOUT
+dropping the connection (a kube-scheduler keeps its keep-alive pool)."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+
+import pytest
+
+from rl_scheduler_tpu.scheduler.extender import ExtenderPolicy, make_server
+from rl_scheduler_tpu.scheduler.policy_backend import GreedyBackend
+from rl_scheduler_tpu.scheduler.telemetry import RandomCpu, TableTelemetry
+from rl_scheduler_tpu.scheduler.wire import (
+    WIRE_CONTENT_TYPE,
+    SynthNames,
+    WireError,
+    WireRequest,
+    decode_filter_response,
+    decode_prioritize_response,
+    decode_request,
+    encode_filter_response,
+    encode_prioritize_response,
+    encode_request,
+    serve_wire,
+)
+
+
+def _policy():
+    telemetry = TableTelemetry.from_table(cpu_source=RandomCpu(seed=0))
+    return ExtenderPolicy(GreedyBackend(), telemetry)
+
+
+def _clouds(n):
+    return ["aws" if i % 2 == 0 else "azure" for i in range(n)]
+
+
+# ------------------------------------------------------------ round trips
+
+
+@pytest.mark.parametrize("clouds", [
+    ["aws"],
+    ["aws", "azure", None],
+    _clouds(7),
+    [None] * 3,
+    _clouds(4096),                      # maximal-N token
+], ids=["one", "mixed", "seven", "all-unknown", "maximal"])
+def test_request_roundtrip_without_names(clouds):
+    body = encode_request(clouds, 500)
+    req = decode_request(body)
+    assert req.clouds == list(clouds)
+    assert req.pod_millicores == 500
+    assert len(req) == len(clouds)
+    # Bitwise: re-encoding the decoded request reproduces the body.
+    assert encode_request(req.clouds, req.pod_millicores) == body
+
+
+def test_request_roundtrip_with_names_is_bitwise():
+    clouds = ["aws", "azure", None]
+    names = ["wéb-0", "ノード-1", "node.x"]  # unicode survives utf-8
+    body = encode_request(clouds, 250, names=names)
+    req = decode_request(body)
+    assert list(req.names) == names
+    assert encode_request(req.clouds, req.pod_millicores,
+                          names=list(req.names)) == body
+
+
+def test_request_roundtrip_empty():
+    req = decode_request(encode_request([], 0))
+    assert len(req) == 0 and req.clouds == []
+
+
+def test_pod_cpu_fraction_matches_json_normalization():
+    req = WireRequest(["aws"], 500)
+    assert req.pod_cpu_fraction(4.0) == pytest.approx(0.125)
+
+
+def test_synth_names_are_lazy_and_sliceable():
+    names = SynthNames(["aws", "azure", None])
+    assert names[0] == "aws-0"
+    assert names[1] == "azure-1"
+    assert names[2] == "node-2"
+    assert list(names[1:]) == ["azure-1", "node-2"]
+    assert len(names) == 3
+
+
+# ------------------------------------------------------------- strictness
+
+
+def test_encode_refuses_delimiter_names_and_bad_inputs():
+    for bad in ("a;b", "a,b", "a\nb", "a\rb"):
+        with pytest.raises(WireError):
+            encode_request(["aws"], 100, names=[bad])
+    with pytest.raises(WireError):
+        encode_request(["aws"], 100, names=["x", "y"])  # count mismatch
+    with pytest.raises(WireError):
+        encode_request(["aws"], -1)
+    with pytest.raises(WireError):
+        encode_request(["gcp"], 100)  # cloud outside the v1 alphabet
+
+
+@pytest.mark.parametrize("body", [
+    b"\xff\xfe",            # not utf-8
+    b"1;100",               # too few fields
+    b"1;100;aa;x;y",        # too many fields
+    b"2;100;aa",            # unsupported version
+    b"1;abc;aa",            # malformed millicores
+    b"1;-5;aa",             # negative millicores
+    b"1;100;ab",            # unknown cloud char
+    b"1;100;aa;only-one",   # name count mismatch
+    b"1;100;aa;x,",         # empty name
+], ids=["utf8", "short", "long", "version", "millis", "negative",
+        "cloudchar", "namecount", "emptyname"])
+def test_decode_refuses_malformed_bodies(body):
+    with pytest.raises(WireError):
+        decode_request(body)
+
+
+def test_filter_response_roundtrip():
+    assert encode_filter_response(None) == b"1;*"
+    assert decode_filter_response(b"1;*", 5) is None
+    assert decode_filter_response(encode_filter_response([0, 3, 4]),
+                                  5) == [0, 3, 4]
+    assert decode_filter_response(encode_filter_response([]), 5) == []
+    with pytest.raises(WireError):
+        decode_filter_response(b"1;9", 5)  # index out of range
+    with pytest.raises(WireError):
+        decode_filter_response(b"1;x", 5)
+    with pytest.raises(WireError):
+        decode_filter_response(b"0;1", 5)
+
+
+def test_prioritize_response_roundtrip():
+    assert decode_prioritize_response(
+        encode_prioritize_response([0, 100, 42])) == [0, 100, 42]
+    assert decode_prioritize_response(
+        encode_prioritize_response([])) == []
+    with pytest.raises(WireError):
+        decode_prioritize_response(b"1;a,b")
+
+
+# ------------------------------------------------- policy-level agreement
+
+
+def test_serve_wire_agrees_with_json_filter_and_prioritize():
+    """The wire path must reproduce the JSON path's decisions: two
+    fresh policies (identical seeded telemetry) serve the SAME candidate
+    set, one per encoding; kept names and scores must match."""
+    n = 6
+    clouds = _clouds(n)
+    names = [f"{c}-n{i}" for i, c in enumerate(clouds)]
+
+    wire_policy, json_policy = _policy(), _policy()
+    kept = decode_filter_response(
+        serve_wire(wire_policy, "/filter",
+                   encode_request(clouds, 0, names=names)), n)
+    json_out = json_policy.filter({"nodenames": list(names), "pod": {}})
+    assert [names[i] for i in (kept if kept is not None else range(n))] \
+        == json_out["nodenames"]
+
+    scores = decode_prioritize_response(
+        serve_wire(wire_policy, "/prioritize",
+                   encode_request(clouds, 0, names=names)))
+    json_scores = json_policy.prioritize({"nodenames": list(names),
+                                          "pod": {}})
+    assert scores == [entry["score"] for entry in json_scores]
+
+
+def test_serve_wire_unknown_path_is_value_error():
+    with pytest.raises(ValueError):
+        serve_wire(_policy(), "/stats", encode_request(["aws"], 0))
+
+
+# ----------------------------------------------------------- HTTP contract
+
+
+@pytest.mark.parametrize("front", ["threading", "asyncio"])
+def test_bad_wire_answers_400(front):
+    """Both fronts refuse a malformed wire body with HTTP 400 and a
+    JSON error body — never a dropped connection or a 500."""
+    srv = make_server(_policy(), host="127.0.0.1", port=0, front=front)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1",
+                                          srv.server_address[1], timeout=5)
+        conn.request("POST", "/filter", b"1;100;ab",
+                     {"Content-Type": WIRE_CONTENT_TYPE})
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 400
+        assert "bad wire" in json.loads(body)["error"]
+        conn.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_bad_wire_keeps_the_asyncio_connection_alive():
+    """The strictness contract end to end: on the keep-alive front a
+    malformed body 400s and the SAME connection then serves a good
+    request — a client's connection pool survives its own bad input."""
+    srv = make_server(_policy(), host="127.0.0.1", port=0, front="asyncio")
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1",
+                                          srv.server_address[1], timeout=5)
+        conn.request("POST", "/prioritize", b"1;100;!!",
+                     {"Content-Type": WIRE_CONTENT_TYPE})
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 400 and not resp.will_close
+
+        conn.request("POST", "/prioritize", encode_request(_clouds(4), 250),
+                     {"Content-Type": WIRE_CONTENT_TYPE})
+        resp = conn.getresponse()
+        scores = decode_prioritize_response(resp.read())
+        assert resp.status == 200 and len(scores) == 4
+        conn.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()

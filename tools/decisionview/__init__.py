@@ -359,15 +359,18 @@ def check_history(history: list, tolerance_pct: float = 25.0) -> list:
     """Violation strings for ``--check-history``: the newest bench round
     must keep ``req_per_sec`` within ``tolerance_pct`` below — and
     ``client_p50_ms`` within ``tolerance_pct`` above — the BEST prior
-    round at the same (workers, nodes, concurrency, lever) shape (the
-    ``lever`` key is graftfwd's matrix dimension; rows without it gate
-    against each other as before — an off-lever row must not be judged
-    against a cache-hit row). Fewer than two comparable rounds passes
-    vacuously (the ledger is just starting)."""
+    round at the same (workers, nodes, concurrency, lever, front,
+    keepalive) shape (``lever`` is graftfwd's matrix dimension;
+    ``front``/``keepalive`` are graftfront's — a keep-alive asyncio row
+    must not be judged against a reconnect-per-request threading row,
+    and vice versa; rows without a key gate against each other as
+    before). Fewer than two comparable rounds passes vacuously (the
+    ledger is just starting)."""
     if len(history) < 2:
         return []
     newest = history[-1]
-    shape_keys = ("workers", "nodes", "concurrency", "lever")
+    shape_keys = ("workers", "nodes", "concurrency", "lever",
+                  "front", "keepalive")
     shape = tuple(newest.get(k) for k in shape_keys)
     priors = [r for r in history[:-1]
               if tuple(r.get(k) for k in shape_keys) == shape]

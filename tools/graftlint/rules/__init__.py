@@ -50,6 +50,7 @@ def load_rules() -> dict:
     global _LOADED
     if not _LOADED:
         from tools.graftlint.rules import (  # noqa: F401
+            async_blocking,
             clocks,
             control_flow,
             donate,

@@ -1,0 +1,118 @@
+"""GL012 blocking call inside an async def on the serving data plane.
+
+graftfront's asyncio front runs EVERY connection on one event loop:
+a single synchronous call inside a coroutine — ``time.sleep``, a bare
+``open()``, a ``requests``/``urlopen`` HTTP round-trip, a blocking
+socket ``accept``/``recv`` — stalls all 10k connections for its
+duration, not just its own. That failure is silent in tests (one
+connection never notices the loop pausing for itself) and catastrophic
+under fan-in, which is exactly the regime the front exists for. The
+repo's convention: coroutines in ``scheduler/`` either await, or hand
+blocking work to the bounded executor (``loop.run_in_executor`` — how
+``front.py`` runs the policy itself).
+
+The rule flags synchronous calls in ``async def`` bodies under
+``scheduler/``: ``time.sleep`` (and a bare ``sleep`` imported from
+``time``), the ``open()`` builtin, ``requests.*``, ``urlopen``,
+``socket.create_connection``, and blocking socket method calls
+(``.accept()``/``.recv()``/``.recvfrom()``). Nested sync defs inside a
+coroutine stay unflagged — defining a helper is free; only the
+coroutine's own statements run on the loop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.engine import Module, walk_own
+from tools.graftlint.rules import Rule, register
+
+# Blocking attribute calls by full dotted prefix (module-level APIs).
+_BLOCKING_ATTRS = {
+    ("time", "sleep"): "time.sleep() parks the whole event loop — "
+                       "await asyncio.sleep() instead",
+    ("socket", "create_connection"): "socket.create_connection() blocks "
+                                     "the loop on the TCP handshake — "
+                                     "use asyncio.open_connection()",
+}
+# Method names that are blocking on any socket-like receiver.
+_BLOCKING_METHODS = {
+    "accept": ".accept() blocks the loop until a peer connects — "
+              "asyncio.start_server() owns the accept loop",
+    "recv": ".recv() blocks the loop until bytes arrive — use a "
+            "StreamReader (await reader.read/readexactly)",
+    "recvfrom": ".recvfrom() blocks the loop until a datagram arrives "
+                "— use a DatagramProtocol",
+}
+
+
+def _bare_sleep_names(tree: ast.AST) -> set:
+    """Local names meaning ``time.sleep``: ``from time import sleep``."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "sleep":
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+def _blocking_message(func: ast.AST, sleep_names: set) -> str | None:
+    """Why this callee blocks the loop, or None if it does not."""
+    if isinstance(func, ast.Name):
+        if func.id == "open":
+            return ("open() is synchronous disk I/O on the event loop "
+                    "— run it in the executor (loop.run_in_executor)")
+        if func.id in sleep_names:
+            return _BLOCKING_ATTRS[("time", "sleep")]
+        if func.id == "urlopen":
+            return ("urlopen() holds the loop for a full HTTP "
+                    "round-trip — run it in the executor")
+        return None
+    if not isinstance(func, ast.Attribute):
+        return None
+    if func.attr == "urlopen":
+        return ("urlopen() holds the loop for a full HTTP round-trip "
+                "— run it in the executor")
+    if isinstance(func.value, ast.Name):
+        root = func.value.id
+        msg = _BLOCKING_ATTRS.get((root, func.attr))
+        if msg is not None:
+            return msg
+        if root == "requests":
+            return (f"requests.{func.attr}() is a synchronous HTTP "
+                    "client — run it in the executor")
+    if func.attr in _BLOCKING_METHODS:
+        return _BLOCKING_METHODS[func.attr]
+    return None
+
+
+@register
+class BlockingCallInAsync(Rule):
+    id = "GL012"
+    name = "blocking-call-in-async"
+    summary = ("synchronous blocking call inside an async def under "
+               "scheduler/ — await, or hand it to the executor")
+
+    # The asyncio front lives on the serving data plane; coroutines
+    # elsewhere (tests, tools) are not one-loop-per-10k-connections.
+    DIRS = frozenset({"scheduler"})
+
+    def check(self, module: Module, ctx) -> Iterator:
+        if not (self.DIRS & set(module.rel.split("/")[:-1])):
+            return
+        sleep_names = _bare_sleep_names(module.tree)
+        for rec in module.functions:
+            if not isinstance(rec.node, ast.AsyncFunctionDef):
+                continue
+            for node in walk_own(rec.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = _blocking_message(node.func, sleep_names)
+                if msg is not None:
+                    yield self.finding(
+                        module, node.lineno,
+                        f"async def {rec.qualname} blocks the event "
+                        f"loop: {msg}",
+                    )
