@@ -12,7 +12,7 @@ TRACE ?= tests/fixtures/traceview/fixture.trace.json.gz
         serve-pool serve-soak rollout-drill eval-matrix scenario-bench \
         study study-list overlap-bench serve-report slo-check span-ab \
         fastpath-ab front-ab loop-drill loop-soak transfer-grid \
-        mixture-smoke
+        mixture-smoke fleet-drill fleet-soak
 
 lint:
 	$(PY) -m tools.graftlint --check
@@ -84,6 +84,21 @@ loop-drill:
 
 loop-soak:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_loopback.py -q
+
+# graftfleet (docs/serving.md#graftfleet): the ROADMAP item-1 drill —
+# a 3-pool fleet under continuous multi-target bench traffic where a
+# fleet promote canaries, rolls pool by pool, and (with an injected
+# regression) aborts and reverts every rolled pool, with zero failed
+# requests in every phase, fleet-merged gauges pinned == the union of
+# the pool scrapes, and a SIGKILLed fleet promote resuming its ledger
+# byte-prefix-exact. `fleet-soak` adds the slow pass that retrains one
+# graftloop iteration from the fleet-wide trace union.
+fleet-drill:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_graftfleet.py -q \
+		-m 'not slow' -k fleet_drill
+
+fleet-soak:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_graftfleet.py -q
 
 # graftlens (docs/observability.md): the serving perf report with
 # regression gating — phase decomposition, per-generation latency, SLO

@@ -269,7 +269,9 @@ def _request_with_retry(client: BenchClient, i: int, num_nodes: int,
 def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
           promote_at: float | None = None, payloads: list | None = None,
           keepalive: bool = False,
-          content_type: str = "application/json"):
+          content_type: str = "application/json",
+          targets: list | None = None,
+          connect_retries: int | None = None):
     """Duration-based load: each thread loops until the deadline.
 
     Payloads are prebuilt once (at N=1024 a node list is ~100 KB of
@@ -283,21 +285,40 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
     connection re-hashes to a live worker; retries are reported, HTTP
     errors never retry).
     Returns ``(sorted_latencies_ms, wall_s, failures, phases, retries,
-    sorted_connects_ms)`` — ``retries`` is counted (and reported)
-    UNCONDITIONALLY, so lever A/B lines stay field-comparable with
-    rollout-drill lines; ``phases`` is ``None`` without a promote.
+    sorted_connects_ms, per_pool)`` — ``retries`` is counted (and
+    reported) UNCONDITIONALLY, so lever A/B lines stay field-comparable
+    with rollout-drill lines; ``phases`` is ``None`` without a promote,
+    ``per_pool`` is ``None`` without ``targets``.
 
     graftfront: every soak thread now runs a :class:`BenchClient`, so
     connection setup is timed apart from request latency in BOTH
     connection modes; ``keepalive=True`` reuses each thread's connection
     across requests (``--keepalive``), which is what makes a transport
     A/B measure the transport rather than the TCP handshake rate.
+
+    graftfleet: ``targets`` (a ``host:port`` list) switches the soak to
+    multi-pool mode — each thread holds one :class:`BenchClient` per
+    target and round-robins its OWN requests across them (so every
+    thread exercises every pool, not a per-thread pinning), and the
+    return gains a ``per_pool`` ``{target: {"requests", "failures"}}``
+    map so the fleet drill judges zero-failures per pool from one
+    invocation. ``connect_retries`` overrides the promote-derived
+    default (fleet drills retry connections in every phase: a pool
+    replacing a worker mid-roll RSTs exactly like the single-pool
+    promote drill).
     """
     if payloads is None:
         payloads = [make_payload(i, num_nodes) for i in range(16)]
-    host, _, port_s = base.rpartition("//")[2].partition(":")
-    port = int(port_s)
-    connect_retries = 3 if promote_at is not None else 0
+    if targets:
+        endpoints = []
+        for target in targets:
+            t_host, _, t_port = target.rpartition(":")
+            endpoints.append((target, t_host, int(t_port)))
+    else:
+        host, _, port_s = base.rpartition("//")[2].partition(":")
+        endpoints = [(None, host, int(port_s))]
+    if connect_retries is None:
+        connect_retries = 3 if promote_at is not None else 0
     t_start = time.perf_counter()
     deadline = t_start + duration_s
     t_promote = None if promote_at is None else t_start + promote_at
@@ -307,15 +328,21 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
     retries_total = [0]
     phases = {"pre_promote": {"requests": 0, "failures": 0, "retries": 0},
               "post_promote": {"requests": 0, "failures": 0, "retries": 0}}
+    per_pool = {name: {"requests": 0, "failures": 0}
+                for name, _, _ in endpoints if name is not None}
     lock = threading.Lock()
 
     def run(thread_id: int) -> None:
-        client = BenchClient(host, port, keepalive=keepalive,
-                             content_type=content_type)
+        clients = [BenchClient(c_host, c_port, keepalive=keepalive,
+                               content_type=content_type)
+                   for _, c_host, c_port in endpoints]
         local: list = []
         failed = 0
         counts = {"pre_promote": [0, 0, 0], "post_promote": [0, 0, 0]}
+        pool_counts = {name: [0, 0] for name, _, _ in endpoints
+                       if name is not None}
         i = thread_id
+        k = thread_id  # stagger the starting pool across threads
         while True:
             now = time.perf_counter()
             if now >= deadline:
@@ -323,28 +350,41 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
             phase = ("post_promote"
                      if t_promote is not None and now >= t_promote
                      else "pre_promote")
+            idx = k % len(clients)
+            k += 1
+            name = endpoints[idx][0]
             try:
                 ms, retried = _request_with_retry(
-                    client, i, num_nodes, payloads[i % len(payloads)],
-                    connect_retries)
+                    clients[idx], i, num_nodes,
+                    payloads[i % len(payloads)], connect_retries)
                 local.append(ms)
                 counts[phase][0] += 1
                 counts[phase][2] += retried
+                if name is not None:
+                    pool_counts[name][0] += 1
             except Exception:  # noqa: BLE001 - soak counts, never aborts
                 failed += 1
                 counts[phase][0] += 1
                 counts[phase][1] += 1
+                if name is not None:
+                    pool_counts[name][0] += 1
+                    pool_counts[name][1] += 1
             i += threads
-        client.close()
+        for client in clients:
+            client.close()
         with lock:
             latencies.extend(local)
-            connects.extend(client.connects_ms)
+            for client in clients:
+                connects.extend(client.connects_ms)
             failures[0] += failed
             for phase, (reqs, fails, retries) in counts.items():
                 phases[phase]["requests"] += reqs
                 phases[phase]["failures"] += fails
                 phases[phase]["retries"] += retries
                 retries_total[0] += retries
+            for name, (reqs, fails) in pool_counts.items():
+                per_pool[name]["requests"] += reqs
+                per_pool[name]["failures"] += fails
 
     workers = [threading.Thread(target=run, args=(t,)) for t in range(threads)]
     for w in workers:
@@ -353,7 +393,7 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
         w.join()
     return (sorted(latencies), time.perf_counter() - t_start, failures[0],
             phases if t_promote is not None else None, retries_total[0],
-            sorted(connects))
+            sorted(connects), per_pool if targets else None)
 
 
 def _fire_promote(control: str, checkpoint: str, delay_s: float,
@@ -473,7 +513,7 @@ def _run_lever_round(np_tree: dict, lever: str, args) -> dict:
             headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(reset_req, timeout=10) as resp:
             resp.read()
-        latencies, wall, failures, _, retries, _ = _soak(
+        latencies, wall, failures, _, retries, _, _ = _soak(
             base, args.duration, args.threads, args.nodes)
         server_stats = _get_json(control + "/stats")
     finally:
@@ -618,7 +658,7 @@ def _run_front_round(np_tree: dict, front: str, threads_n: int,
             headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(reset_req, timeout=10) as resp:
             resp.read()
-        latencies, wall, failures, _, retries, connects = _soak(
+        latencies, wall, failures, _, retries, connects, _ = _soak(
             base, args.duration, threads_n, args.nodes,
             payloads=payloads, keepalive=True,
             content_type=WIRE_CONTENT_TYPE)
@@ -849,6 +889,13 @@ def main(argv: list[str] | None = None) -> dict:
                    help="fronts mode: csv concurrency grid (default "
                         "8,64 — the serving contract's low-load latency "
                         "point and the saturation point)")
+    p.add_argument("--targets", default=None, metavar="H:P,H:P,...",
+                   help="graftfleet multi-pool soak: round-robin each "
+                        "thread's requests across these data planes and "
+                        "report per-pool request/failure counts; point "
+                        "--host/--control-port at the FLEET control "
+                        "plane so the server-side stats on the line are "
+                        "fleet-merged (needs --duration)")
     args = p.parse_args(argv)
     if args.fronts is not None:
         if args.duration is None:
@@ -914,12 +961,26 @@ def main(argv: list[str] | None = None) -> dict:
                     f"[0, {args.duration})")
     elif args.promote_checkpoint is not None:
         p.error("--promote-checkpoint only applies with --promote-at")
+    targets = None
+    if args.targets is not None:
+        targets = [t.strip() for t in args.targets.split(",") if t.strip()]
+        if not targets:
+            p.error("--targets: at least one host:port entry")
+        if args.duration is None:
+            p.error("--targets is a soak mode; add --duration")
+        if args.promote_at is not None:
+            p.error("--targets and --promote-at are separate drills: "
+                    "fleet promotes run through the fleet CLI "
+                    "(python -m rl_scheduler_tpu.scheduler.fleet)")
+        if args.replay_trace is not None:
+            p.error("--targets and --replay-trace are separate modes")
     base = f"http://{args.host}:{args.port}"
     control = (f"http://{args.host}:{args.control_port}"
                if args.control_port is not None else base)
 
+    warm_bases = ([f"http://{t}" for t in targets] if targets else [base])
     for i in range(args.warmup):
-        one_request(base, i, args.nodes,
+        one_request(warm_bases[i % len(warm_bases)], i, args.nodes,
                     payload=replay_payloads[i % len(replay_payloads)]
                     if replay_payloads else None)
     # Scope the server-side percentiles to THIS run: the latency ring
@@ -939,7 +1000,7 @@ def main(argv: list[str] | None = None) -> dict:
 
     failures = retries = 0
     connects: list = []
-    phases = promote = None
+    phases = promote = per_pool = None
     if args.duration is not None:
         promote_thread = result_box = None
         if args.promote_at is not None:
@@ -954,10 +1015,11 @@ def main(argv: list[str] | None = None) -> dict:
             promote_thread = threading.Thread(target=_promote_then_record,
                                               daemon=True)
             promote_thread.start()
-        latencies, wall, failures, phases, retries, connects = _soak(
-            base, args.duration, args.threads, args.nodes,
-            promote_at=args.promote_at, payloads=replay_payloads,
-            keepalive=args.keepalive)
+        latencies, wall, failures, phases, retries, connects, per_pool = \
+            _soak(base, args.duration, args.threads, args.nodes,
+                  promote_at=args.promote_at, payloads=replay_payloads,
+                  keepalive=args.keepalive, targets=targets,
+                  connect_retries=3 if targets else None)
         if promote_thread is not None:
             promote_thread.join(timeout=60.0)
             promote = result_box
@@ -1038,6 +1100,11 @@ def main(argv: list[str] | None = None) -> dict:
         out["phases"] = phases
     if promote is not None:
         out["promote"] = promote
+    if per_pool is not None:
+        # graftfleet: the drill's zero-failures bar is judged per pool
+        # from this one line.
+        out["targets"] = targets
+        out["per_pool"] = per_pool
     print(json.dumps(out))
     if args.history is not None:
         # Durable append-only ledger (one JSON line per round). Plain

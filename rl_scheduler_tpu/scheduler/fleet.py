@@ -1,0 +1,1110 @@
+"""graftfleet: the multi-host fleet control plane.
+
+Every production primitive below this module is pool-local: ``POST
+/promote`` lands on ONE supervisor, ``/stats``/``/metrics`` merge one
+pool's workers, trace dirs live on one host. This module generalizes
+each of those exactly one level up — pools-in-a-fleet reuse the same
+machinery as workers-in-a-pool:
+
+* **Discovery** — a resolver seam turns a topology source into
+  ``PoolRef``s: ``StaticResolver`` for a ``--pools host:port,...``
+  list, ``EndpointsResolver`` for a kubernetes Endpoints document
+  (the Service in ``k8s_manifests/extender-deployment.yaml``), read
+  from a file so it is fixture-testable off-network.
+
+* **Fleet promote** — one designated canary POOL promotes through its
+  own ``/promote`` + ``/rollout`` gates (which already canary one
+  WORKER internally) and holds; the remaining pools roll one at a
+  time only after the canary pool lands. Any pool-level rollback or a
+  pool dying mid-roll aborts the fleet promote and reverts every
+  already-rolled pool to its incumbent checkpoint. The fleet
+  generation advances only after the last pool. All of it is recorded
+  in a graftstudy-discipline ``fleet_ledger.jsonl`` (atomic whole-file
+  rewrites, spec-fingerprint header, SIGKILL-anywhere resumable) with
+  graftloop's promote-stage semantics: a pool 422 is a *refusal*
+  outcome, a 5xx/timeout is transient (nothing recorded — a re-run
+  resumes and retries), a connection-level failure mid-roll is an
+  *abort*.
+
+* **Fleet observability** — ``GET /stats`` and ``/metrics`` merge pool
+  scrapes with the SAME pure functions the pool applies to worker
+  snapshots (``aggregate_stats`` over pseudo-snapshots built from each
+  pool's additive ``raw`` histogram section): bucket sums for
+  latency/phases, ``slo.merge_snapshots``, breaker max-by-severity,
+  fastpath counter sums / agreement min. Merged == union of per-pool
+  scrapes, pinned by test. Fleet-only series (``_fleet_generation``,
+  ``_fleet_pool_up{pool=}``, promote/rollback/abort totals) ride on
+  top; ``/healthz`` separates *degraded* pools (scrape answered,
+  below strength or burning SLO) from *down* pools (scrape failed).
+  Scrape EITHER the pools OR the fleet — scraping both double-counts.
+
+* **Trace harvest** — ``fleet_snapshot`` fans graftloop's
+  ``snapshot_trace`` out across every pool's trace dir into ONE
+  snapshot root with per-pool file prefixes and a union manifest, so a
+  single graftloop iteration retrains on fleet-wide traffic.
+
+Stdlib-only: the controller never imports jax (or the loopback retrain
+stack — snapshot helpers import lazily), so it runs on any box that
+can reach the pools' control planes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import shutil
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+from rl_scheduler_tpu.scheduler.extender import (
+    LatencyStats,
+    fastpath_metric_lines,
+    phase_metric_lines,
+    slo_metric_lines,
+)
+from rl_scheduler_tpu.scheduler.pool import (
+    METRIC_PREFIX,
+    aggregate_stats,
+    merge_phase_histograms,
+    merge_worker_histograms,
+)
+from rl_scheduler_tpu.utils.pidlock import acquire_pidfile_lock
+from rl_scheduler_tpu.utils.retry import CircuitBreaker
+
+logger = logging.getLogger(__name__)
+
+FLEET_SCHEMA_VERSION = 1
+FLEET_LEDGER_NAME = "fleet_ledger.jsonl"
+FLEET_LOCK_NAME = "fleet_promote.lock"
+
+
+# ------------------------------------------------------------ discovery
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolRef:
+    """One pool's control plane. ``name`` is the stable identity the
+    ledger and the ``pool=`` metric label use; ``host:port`` is where
+    the scrapes and promotes go."""
+
+    name: str
+    host: str
+    port: int
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+
+def parse_pools(spec: str) -> list:
+    """``host:port,host:port,...`` -> ``[PoolRef]`` (names are the
+    ``host:port`` strings — unambiguous and stable across restarts)."""
+    refs = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        host, sep, port = entry.rpartition(":")
+        if not sep or not host:
+            raise ValueError(
+                f"--pools entry {entry!r}: expected host:port")
+        try:
+            refs.append(PoolRef(name=entry, host=host, port=int(port)))
+        except ValueError:
+            raise ValueError(f"--pools entry {entry!r}: port must be an "
+                             "integer")
+    if not refs:
+        raise ValueError("--pools: at least one host:port entry")
+    return refs
+
+
+class StaticResolver:
+    """The ``--pools`` list, resolved once at construction. The seam
+    every other topology source implements: ``resolve() -> [PoolRef]``,
+    called per refresh so dynamic sources can churn."""
+
+    def __init__(self, pools):
+        self._pools = (parse_pools(pools) if isinstance(pools, str)
+                       else list(pools))
+
+    def resolve(self) -> list:
+        return list(self._pools)
+
+
+class EndpointsResolver:
+    """Pool discovery from a kubernetes Endpoints document (JSON), read
+    from a file on every ``resolve()`` so endpoint churn is picked up.
+    Off-network by design: point it at ``kubectl get endpoints
+    rl-scheduler-extender -o json`` output, a downward-API mount, or a
+    test fixture. Addresses come from every subset; the port is the
+    subset port named ``port_name`` (the control port in
+    ``k8s_manifests/extender-deployment.yaml``), falling back to the
+    first listed port when nothing matches by name."""
+
+    def __init__(self, source: str | Path, port_name: str = "control"):
+        self.source = Path(source)
+        self.port_name = port_name
+
+    def resolve(self) -> list:
+        doc = json.loads(self.source.read_text())
+        refs = []
+        for subset in doc.get("subsets") or []:
+            ports = subset.get("ports") or []
+            port = next((p["port"] for p in ports
+                         if p.get("name") == self.port_name),
+                        ports[0]["port"] if ports else None)
+            if port is None:
+                continue
+            for addr in subset.get("addresses") or []:
+                ip = addr.get("ip")
+                if ip:
+                    refs.append(PoolRef(name=f"{ip}:{port}",
+                                        host=ip, port=int(port)))
+        if not refs:
+            raise ValueError(
+                f"{self.source}: no ready addresses in the Endpoints "
+                "document (is the Deployment ready?)")
+        return refs
+
+
+# ----------------------------------------------------------- the merge
+
+
+_EMPTY_HIST = {"cumulative": [], "sum": 0.0, "count": 0}
+
+
+def pool_stats_snapshot(name: str, body: dict) -> dict:
+    """Adapt one pool's ``/stats`` body into the pseudo-worker-snapshot
+    shape ``pool.aggregate_stats`` consumes, so the fleet merge is
+    LITERALLY the pool merge one level up. Raw bucket counts come from
+    the body's additive ``raw`` section; a version-skewed pool without
+    it contributes an empty histogram (the optional-phase rule), so its
+    counters still sum while its latency simply adds no buckets."""
+    raw = body.get("raw") or {}
+    stats = {
+        "backend": body.get("backend"),
+        "family": body.get("family"),
+        "decisions": body.get("decisions") or {},
+        "breakers": body.get("breakers") or {},
+        "latency": body.get("latency") or {},
+    }
+    for key in ("shed_fraction", "reroute_fraction", "placements_dropped",
+                "fail_open_total", "fastpath"):
+        if key in body:
+            stats[key] = body[key]
+    snap = {
+        "worker_id": name,
+        "pid": None,
+        "generation": (body.get("pool") or {}).get("generation", 0),
+        "stats": stats,
+        "histogram": raw.get("histogram") or dict(_EMPTY_HIST),
+        "phases": raw.get("phases") or {},
+    }
+    if body.get("slo"):
+        snap["slo"] = body["slo"]
+    if body.get("trace"):
+        snap["trace"] = body["trace"]
+    return snap
+
+
+def aggregate_fleet_stats(scrapes: dict, fleet: dict) -> dict:
+    """The fleet ``GET /stats`` body: ``pool.aggregate_stats`` over the
+    pool pseudo-snapshots (down pools — ``None`` bodies — contribute
+    nothing; they are visible in ``fleet.down``, never silently
+    averaged in). The body keeps the pool-body keys decisionview reads
+    (``latency``/``phases``/``slo``/``fastpath``) and its own additive
+    ``raw`` section, so a fleet-of-fleets merges the same way."""
+    snaps = [pool_stats_snapshot(name, body)
+             for name, body in sorted(scrapes.items()) if body]
+    out = aggregate_stats(snaps, pool={})
+    del out["pool"]
+    rows = out.pop("workers")
+    for row in rows:
+        row["pool"] = row.pop("worker_id")
+        row.pop("pid", None)
+    out["pools"] = rows
+    out["fleet"] = dict(fleet)
+    return out
+
+
+def aggregate_fleet_metrics(scrapes: dict, fleet: dict) -> str:
+    """The fleet Prometheus exposition: the SAME metric names and the
+    same shared exposition helpers as the pool plane (one scrape config
+    serves worker, pool, and fleet), counters summed across pools, ONE
+    merged histogram, plus the ``_fleet_*`` series. Point Prometheus at
+    EITHER the pools or the fleet — both double-counts."""
+    p = METRIC_PREFIX
+    snaps = [pool_stats_snapshot(name, body)
+             for name, body in sorted(scrapes.items()) if body]
+    merged_cum, merged_sum, merged_count = merge_worker_histograms(snaps)
+    phase_hists = merge_phase_histograms(snaps)
+    stats = aggregate_fleet_stats(scrapes, fleet)
+    lines = [
+        f"# HELP {p}_decisions_total Placement decisions by cloud "
+        "(summed across fleet pools).",
+        f"# TYPE {p}_decisions_total counter",
+    ]
+    for cloud, n in sorted(stats["decisions"].items()):
+        lines.append(f'{p}_decisions_total{{cloud="{cloud}"}} {n}')
+    lines += [
+        f"# HELP {p}_decision_latency_seconds Server-side decision "
+        "latency (merged across fleet pools; lifetime histogram).",
+        f"# TYPE {p}_decision_latency_seconds histogram",
+    ]
+    bounds = [f"{b:g}" for b in LatencyStats.BUCKETS] + ["+Inf"]
+    for bound, c in zip(bounds, merged_cum or [0] * len(bounds)):
+        lines.append(
+            f'{p}_decision_latency_seconds_bucket{{le="{bound}"}} {c}')
+    lines.append(f"{p}_decision_latency_seconds_sum {merged_sum:.9g}")
+    lines.append(f"{p}_decision_latency_seconds_count {merged_count}")
+    if phase_hists:
+        lines += phase_metric_lines(p, phase_hists)
+    if "slo" in stats:
+        lines += slo_metric_lines(p, stats["slo"])
+    if "fastpath" in stats:
+        lines += fastpath_metric_lines(p, stats["fastpath"])
+    for key, help_text in (
+        ("fail_open_total", "Requests answered by a fail-open path, "
+                            "summed across fleet pools."),
+        ("placements_dropped", "Dry-run placements dropped by the "
+                               "bounded async queues, fleet total."),
+    ):
+        if key in stats:
+            suffix = "_total" if not key.endswith("_total") else ""
+            lines += [
+                f"# HELP {p}_{key}{suffix} {help_text}",
+                f"# TYPE {p}_{key}{suffix} counter",
+                f"{p}_{key}{suffix} {stats[key]}",
+            ]
+    breakers = stats["breakers"]
+    if breakers:
+        lines += [
+            f"# HELP {p}_circuit_state Circuit breaker state per "
+            "host-I/O boundary, MAX across fleet pools (0=closed, "
+            "1=half_open, 2=open).",
+            f"# TYPE {p}_circuit_state gauge",
+        ]
+        for name, snap in breakers.items():
+            code = CircuitBreaker.STATE_CODES[snap["state"]]
+            lines.append(f'{p}_circuit_state{{breaker="{name}"}} {code}')
+    # The fleet-only series: topology liveness and the ledger-derived
+    # promote lifecycle (monotonic — /stats/reset fan-out never touches
+    # the ledger, pinned by test).
+    up = [name for name, body in sorted(scrapes.items()) if body]
+    lines += [
+        f"# HELP {p}_fleet_pools Pools in the fleet topology.",
+        f"# TYPE {p}_fleet_pools gauge",
+        f"{p}_fleet_pools {len(scrapes)}",
+        f"# HELP {p}_fleet_pools_up Pools that answered this scrape.",
+        f"# TYPE {p}_fleet_pools_up gauge",
+        f"{p}_fleet_pools_up {len(up)}",
+        f"# HELP {p}_fleet_pool_up Per-pool scrape liveness "
+        "(1=answered, 0=down).",
+        f"# TYPE {p}_fleet_pool_up gauge",
+    ]
+    for name in sorted(scrapes):
+        lines.append(
+            f'{p}_fleet_pool_up{{pool="{name}"}} '
+            f'{1 if scrapes[name] else 0}')
+    lines += [
+        f"# HELP {p}_fleet_pool_generation Policy generation each pool "
+        "serves (divergence mid-roll is visible, never averaged).",
+        f"# TYPE {p}_fleet_pool_generation gauge",
+    ]
+    for name in sorted(scrapes):
+        body = scrapes[name]
+        if body:
+            gen = (body.get("pool") or {}).get("generation", 0)
+            lines.append(
+                f'{p}_fleet_pool_generation{{pool="{name}"}} {gen}')
+    lines += [
+        f"# HELP {p}_fleet_generation Fleet policy generation (advances "
+        "only after the LAST pool of a fleet promote lands).",
+        f"# TYPE {p}_fleet_generation gauge",
+        f"{p}_fleet_generation {fleet.get('generation', 0)}",
+    ]
+    for key, help_text in (
+        ("promotions_total", "Fleet promotes that landed on every pool "
+                             "(lifetime)."),
+        ("rollbacks_total", "Pool-level rollbacks observed during fleet "
+                            "promotes (lifetime)."),
+        ("aborts_total", "Fleet promotes aborted and reverted "
+                         "(lifetime)."),
+        ("refusals_total", "Fleet promotes refused by the canary pool "
+                           "with nothing rolled (lifetime)."),
+    ):
+        lines += [
+            f"# HELP {p}_fleet_{key} {help_text}",
+            f"# TYPE {p}_fleet_{key} counter",
+            f"{p}_fleet_{key} {fleet.get(key, 0)}",
+        ]
+    return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------------ the ledger
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSpec:
+    """The fleet promote topology, frozen: which pools, which one
+    canaries. The fingerprint binds the ledger — a changed topology
+    refuses to resume into the same fleet dir (the graftstudy rule:
+    two protocols must not interleave records)."""
+
+    pools: tuple
+    canary: str
+
+    def __post_init__(self):
+        if not self.pools:
+            raise ValueError("pools: a fleet has at least one pool")
+        if self.canary not in self.pools:
+            raise ValueError(
+                f"canary {self.canary!r} is not one of the fleet's pools "
+                f"{list(self.pools)}")
+
+    def to_json(self) -> dict:
+        return {"pools": list(self.pools), "canary": self.canary}
+
+    def fingerprint(self) -> str:
+        blob = json.dumps(self.to_json(), sort_keys=True,
+                          separators=(",", ":"))
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+class FleetLedgerMismatch(RuntimeError):
+    """The fleet dir's ledger was written under a different topology."""
+
+
+class FleetLedger:
+    """The fleet's promote journal: the graftstudy ledger discipline
+    (whole-file tmp-then-rename appends, sorted-key records, header
+    bound to the spec fingerprint) applied to fleet promotes. A SIGKILL
+    leaves a complete ledger — prior bytes survive verbatim, so a
+    resumed run's ledger is a byte-prefix extension of the killed one.
+
+    Record kinds after the header: ``begin`` (promote id, candidate
+    checkpoint, per-pool incumbents), ``stage`` (one pool × role —
+    canary/roll/revert — with graftloop's outcome vocabulary:
+    ok/refused/rolled_back/aborted), ``end`` (ok/refused/aborted). The
+    fleet lifecycle counters DERIVE from the ledger, which is why
+    ``/stats/reset`` can never rewind them."""
+
+    def __init__(self, fleet_dir: str | Path, spec: FleetSpec):
+        self.path = Path(fleet_dir) / FLEET_LEDGER_NAME
+        self.spec = spec
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        if self.path.exists() and self.path.stat().st_size:
+            header = json.loads(self.path.read_text().splitlines()[0])
+            if header.get("spec_sha") != spec.fingerprint():
+                raise FleetLedgerMismatch(
+                    f"{self.path} was written for topology "
+                    f"{header.get('spec_sha')}; this run's topology is "
+                    f"{spec.fingerprint()} — a changed fleet cannot "
+                    "resume into the same ledger (use a new fleet dir)")
+        else:
+            self._rewrite([self._dumps({
+                "kind": "header",
+                "schema_version": FLEET_SCHEMA_VERSION,
+                "spec_sha": spec.fingerprint(),
+                "spec": spec.to_json(),
+            })])
+
+    @staticmethod
+    def _dumps(record: dict) -> str:
+        return json.dumps(record, sort_keys=True, separators=(", ", ": "))
+
+    def _rewrite(self, lines: list) -> None:
+        tmp = self.path.with_suffix(f".tmp.{os.getpid()}")
+        data = "".join(line + "\n" for line in lines)
+        with open(tmp, "w") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def append(self, record: dict) -> None:
+        record = dict(record)
+        record.setdefault("ts", round(time.time(), 3))
+        lines = (self.path.read_text().splitlines()
+                 if self.path.exists() else [])
+        self._rewrite(lines + [self._dumps(record)])
+
+    def records(self) -> list:
+        if not self.path.exists():
+            return []
+        return [json.loads(line)
+                for line in self.path.read_text().splitlines()[1:]]
+
+    def begun_total(self) -> int:
+        return sum(1 for r in self.records() if r.get("kind") == "begin")
+
+    def open_promote(self) -> dict | None:
+        """The latest ``begin`` record with no matching ``end`` —
+        the promote a resumed run must finish before anything else."""
+        open_by_id: dict = {}
+        for record in self.records():
+            if record.get("kind") == "begin":
+                open_by_id[record["promote"]] = record
+            elif record.get("kind") == "end":
+                open_by_id.pop(record["promote"], None)
+        if not open_by_id:
+            return None
+        return list(open_by_id.values())[-1]
+
+    def promote_stages(self, promote_id: str) -> dict:
+        """``{(pool, role): record}`` for one promote's recorded
+        stages (newest wins)."""
+        out = {}
+        for record in self.records():
+            if (record.get("kind") == "stage"
+                    and record.get("promote") == promote_id):
+                out[(record["pool"], record["role"])] = record
+        return out
+
+    def counters(self) -> dict:
+        """The fleet lifecycle counters, derived by scanning the ledger
+        — durable across controller restarts and immune to
+        ``/stats/reset`` by construction."""
+        out = {"generation": 0, "promotions_total": 0,
+               "rollbacks_total": 0, "aborts_total": 0,
+               "refusals_total": 0}
+        for record in self.records():
+            kind = record.get("kind")
+            if kind == "end":
+                status = record.get("status")
+                if status == "ok":
+                    out["promotions_total"] += 1
+                elif status == "aborted":
+                    out["aborts_total"] += 1
+                elif status == "refused":
+                    out["refusals_total"] += 1
+            elif (kind == "stage"
+                    and record.get("status") == "rolled_back"):
+                out["rollbacks_total"] += 1
+        out["generation"] = out["promotions_total"]
+        return out
+
+
+# -------------------------------------------------------- the controller
+
+
+class FleetController:
+    """Scrape, merge, health-classify, and promote across a fleet of
+    pool control planes. Stdlib HTTP only; every network failure is
+    classified, never swallowed silently."""
+
+    def __init__(self, resolver, fleet_dir: str | Path,
+                 canary: str | None = None, scrape_timeout_s: float = 2.0,
+                 rollout_timeout_s: float = 120.0,
+                 canary_hold_s: float = 0.0, fault_plan=None):
+        self.resolver = resolver
+        self.fleet_dir = Path(fleet_dir)
+        self.scrape_timeout_s = scrape_timeout_s
+        self.rollout_timeout_s = rollout_timeout_s
+        self.canary_hold_s = canary_hold_s
+        self.fault_plan = fault_plan
+        self.pools = list(resolver.resolve())
+        if not self.pools:
+            raise ValueError("resolver returned no pools")
+        names = [ref.name for ref in self.pools]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate pool names in topology: {names}")
+        self.canary = canary if canary is not None else names[0]
+        self.spec = FleetSpec(pools=tuple(names), canary=self.canary)
+        self.fleet_dir.mkdir(parents=True, exist_ok=True)
+        self.ledger = FleetLedger(self.fleet_dir, self.spec)
+        self._by_name = {ref.name: ref for ref in self.pools}
+
+    def refresh(self) -> list:
+        """Re-resolve the topology (Endpoints churn). Scrapes follow the
+        new pool set immediately; the promote topology stays bound to
+        the ledger spec — a changed pool SET needs a new fleet dir."""
+        self.pools = list(self.resolver.resolve())
+        self._by_name = {ref.name: ref for ref in self.pools}
+        return self.pools
+
+    # ------------------------------------------------------- scraping
+
+    def scrape_pool(self, ref: PoolRef) -> dict | None:
+        """One pool's ``/stats`` body, ``None`` when the pool is down
+        or times out — the merge proceeds over the pools that answered
+        (the fault site ``fleet.scrape`` fires here)."""
+        try:
+            if self.fault_plan is not None:
+                self.fault_plan.check("fleet.scrape", TimeoutError)
+            with urllib.request.urlopen(
+                    ref.url + "/stats",
+                    timeout=self.scrape_timeout_s) as resp:
+                body = json.load(resp)
+            return body if isinstance(body, dict) else None
+        except Exception as exc:  # noqa: BLE001 — classified as down
+            logger.warning("fleet: scrape of %s failed: %s", ref.name, exc)
+            return None
+
+    def scrape(self) -> dict:
+        # Serial, in topology order: deterministic fault-plan indices
+        # matter more than shaving a timeout off a 3-pool scrape.
+        return {ref.name: self.scrape_pool(ref) for ref in self.pools}
+
+    def fleet_info(self, scrapes: dict) -> dict:
+        counters = self.ledger.counters()
+        down = sorted(n for n, body in scrapes.items() if body is None)
+        return {
+            "pools": [ref.name for ref in self.pools],
+            "canary": self.canary,
+            "up": len(scrapes) - len(down),
+            "down": down,
+            **counters,
+        }
+
+    def stats(self) -> dict:
+        scrapes = self.scrape()
+        return aggregate_fleet_stats(scrapes, self.fleet_info(scrapes))
+
+    def metrics(self) -> str:
+        scrapes = self.scrape()
+        return aggregate_fleet_metrics(scrapes, self.fleet_info(scrapes))
+
+    def health(self) -> dict:
+        """Degraded-vs-down classification from ONE scrape pass: a pool
+        whose scrape failed is *down*; a pool that answered but is below
+        worker strength (outside a rollout) or burning its SLO budget is
+        *degraded*. The fleet is ``down`` only when every pool is."""
+        scrapes = self.scrape()
+        pools: dict = {}
+        for ref in self.pools:
+            body = scrapes.get(ref.name)
+            if body is None:
+                pools[ref.name] = {"status": "down"}
+                continue
+            status = body.get("pool") or {}
+            rolling = bool((status.get("rollout") or {}).get("active"))
+            workers = status.get("workers", 0)
+            alive = status.get("alive", status.get("responding", 0))
+            state = "ok"
+            if alive < workers:
+                state = "rolling" if rolling else "degraded"
+            if (body.get("slo") or {}).get("degraded") and state == "ok":
+                state = "degraded"
+            pools[ref.name] = {
+                "status": state,
+                "workers": workers,
+                "alive": alive,
+                "generation": status.get("generation", 0),
+            }
+        down = sorted(n for n, p in pools.items() if p["status"] == "down")
+        degraded = sorted(n for n, p in pools.items()
+                          if p["status"] == "degraded")
+        if len(down) == len(pools):
+            fleet_state = "down"
+        elif down or degraded:
+            fleet_state = "degraded"
+        else:
+            fleet_state = "ok"
+        counters = self.ledger.counters()
+        return {
+            "status": fleet_state,
+            "pools": pools,
+            "up": len(pools) - len(down),
+            "down": down,
+            "degraded": degraded,
+            "workers": sum(p.get("alive", 0) for p in pools.values()),
+            "generation": counters["generation"],
+        }
+
+    def reset_stats(self) -> dict:
+        """Fan ``/stats/reset`` out to every pool. The fleet lifecycle
+        counters derive from the ledger and every pool-side lifetime
+        counter is reset-proof already, so nothing monotonic rewinds."""
+        acked = {}
+        for ref in self.pools:
+            try:
+                req = urllib.request.Request(ref.url + "/stats/reset",
+                                             data=b"", method="POST")
+                with urllib.request.urlopen(
+                        req, timeout=self.scrape_timeout_s) as resp:
+                    acked[ref.name] = resp.status == 200
+            except Exception as exc:  # noqa: BLE001 — down pool: not acked
+                logger.warning("fleet: /stats/reset to %s failed: %s",
+                               ref.name, exc)
+                acked[ref.name] = False
+        return {"status": "reset", "pools": acked}
+
+    # ------------------------------------------------------- promoting
+
+    def promote(self, checkpoint: str) -> dict:
+        """Run (or resume) one fleet promote of ``checkpoint``. Single
+        writer per fleet dir (pidfile lock); every outcome lands in the
+        ledger before this returns."""
+        checkpoint = str(checkpoint)
+        lock = acquire_pidfile_lock(
+            self.fleet_dir / FLEET_LOCK_NAME,
+            "fleet promote already running as pid {pid} (lock {lock})")
+        try:
+            return self._promote_locked(checkpoint)
+        finally:
+            lock.unlink(missing_ok=True)
+
+    def _promote_locked(self, checkpoint: str) -> dict:
+        order = [self.canary] + [n for n in self.spec.pools
+                                 if n != self.canary]
+        begin = self.ledger.open_promote()
+        if begin is not None and begin.get("checkpoint") != checkpoint:
+            raise RuntimeError(
+                f"fleet promote of {begin.get('checkpoint')!r} is "
+                f"mid-flight in {self.ledger.path}; resume that "
+                "checkpoint first (re-run with it) — two promotes must "
+                "not interleave")
+        if begin is None:
+            # Gather incumbents BEFORE anything rolls: this is the
+            # revert target set. A pool unreachable here is transient
+            # (nothing recorded) — fix the pool and re-run.
+            incumbents = {}
+            for name in order:
+                status = self._rollout_status(self._by_name[name])
+                if status.get("active"):
+                    raise RuntimeError(
+                        f"pool {name} has a rollout in flight — wait "
+                        "for it before a fleet promote")
+                incumbents[name] = {
+                    "generation": status.get("generation", 0),
+                    "checkpoint": status.get("checkpoint"),
+                }
+            promote_id = f"fp{self.ledger.begun_total() + 1:04d}"
+            self.ledger.append({"kind": "begin", "promote": promote_id,
+                                "checkpoint": checkpoint,
+                                "incumbents": incumbents})
+        else:
+            promote_id = begin["promote"]
+            incumbents = begin["incumbents"]
+        stages = self.ledger.promote_stages(promote_id)
+        rolled = []
+        failure = None
+        for name in order:
+            role = "canary" if name == self.canary else "roll"
+            if (name, role) in stages:
+                record = stages[(name, role)]
+                if record["status"] == "ok":
+                    rolled.append(name)
+                    continue
+                failure = {"pool": name, "role": role,
+                           "status": record["status"],
+                           "out": record.get("out", {})}
+                break
+            if failure is None:
+                status, out = self._promote_pool(
+                    self._by_name[name], checkpoint, role)
+                self.ledger.append({"kind": "stage", "promote": promote_id,
+                                    "pool": name, "role": role,
+                                    "status": status, "out": out})
+                if status != "ok":
+                    failure = {"pool": name, "role": role,
+                               "status": status, "out": out}
+                    break
+                rolled.append(name)
+                if role == "canary" and self.canary_hold_s > 0:
+                    # The fleet-level canary HOLD: the canary pool bakes
+                    # on live traffic before the rest of the fleet rolls.
+                    time.sleep(self.canary_hold_s)
+        if failure is None:
+            counters = self.ledger.counters()
+            generation = counters["generation"] + 1
+            self.ledger.append({"kind": "end", "promote": promote_id,
+                                "status": "ok", "checkpoint": checkpoint,
+                                "generation": generation})
+            return {"promote": promote_id, "status": "ok",
+                    "generation": generation, "pools": order,
+                    "checkpoint": checkpoint}
+        if failure["status"] == "refused" and not rolled:
+            # graftloop's rule, one level up: a refusal with NOTHING
+            # rolled is an outcome, not an abort — the fleet never left
+            # the incumbent generation.
+            self.ledger.append({"kind": "end", "promote": promote_id,
+                                "status": "refused",
+                                "reason": failure["out"].get("reason"),
+                                "pool": failure["pool"]})
+            return {"promote": promote_id, "status": "refused",
+                    "pool": failure["pool"],
+                    "reason": failure["out"].get("reason")}
+        reverted = {}
+        for name in reversed(rolled):
+            if (name, "revert") in stages:
+                reverted[name] = stages[(name, "revert")]["status"]
+                continue
+            status, out = self._promote_pool(
+                self._by_name[name], incumbents[name].get("checkpoint"),
+                "revert")
+            self.ledger.append({"kind": "stage", "promote": promote_id,
+                                "pool": name, "role": "revert",
+                                "status": status, "out": out})
+            reverted[name] = status
+        self.ledger.append({"kind": "end", "promote": promote_id,
+                            "status": "aborted", "pool": failure["pool"],
+                            "reason": failure["out"].get("reason"),
+                            "reverted": reverted})
+        return {"promote": promote_id, "status": "aborted",
+                "pool": failure["pool"],
+                "reason": failure["out"].get("reason"),
+                "reverted": reverted}
+
+    def _promote_pool(self, ref: PoolRef, checkpoint, role: str):
+        """One pool × role step: ``(status, out)`` with graftloop's
+        promote-stage vocabulary. ``ok`` — the pool serves the
+        checkpoint; ``refused`` — the pool said no (4xx) and stayed on
+        its incumbent; ``rolled_back`` — the pool's own canary/health
+        gate rolled it back; ``aborted`` — the pool became unreachable
+        mid-roll. Transient conditions (5xx, poll deadline) RAISE with
+        nothing recorded, so a re-run resumes and retries the step."""
+        try:
+            if checkpoint is None:
+                return "refused", {"reason": f"pool {ref.name} has no "
+                                   "incumbent checkpoint to revert to"}
+            # Idempotent resume: a killed run's POST may have landed.
+            status = self._rollout_status(ref)
+            if status.get("active"):
+                status = self._poll_rollout(ref)
+            if status.get("checkpoint") == checkpoint:
+                return "ok", {"generation": status.get("generation", 0),
+                              "already_serving": True}
+            if self.fault_plan is not None:
+                self.fault_plan.check("fleet.promote", ConnectionError)
+            req = urllib.request.Request(
+                ref.url + "/promote",
+                data=json.dumps({"checkpoint": checkpoint}).encode(),
+                headers={"Content-Type": "application/json"})
+            target = None
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    body = json.load(resp)
+                target = body.get("target_generation")
+            except urllib.error.HTTPError as exc:
+                detail = exc.read().decode(errors="replace")[:200]
+                if exc.code == 409:
+                    # A rollout raced in (our own killed POST, or an
+                    # operator's) — judge by where the pool lands.
+                    pass
+                elif exc.code >= 500:
+                    raise RuntimeError(
+                        f"pool {ref.name} answered {exc.code} on "
+                        f"/promote ({detail}) — transient, re-run to "
+                        "resume this step")
+                else:
+                    return "refused", {
+                        "code": exc.code,
+                        "reason": f"pool {ref.name} refused the promote "
+                                  f"({exc.code}): {detail}"}
+            status = self._poll_rollout(ref)
+            if status.get("checkpoint") == checkpoint and (
+                    target is None
+                    or status.get("generation") == target):
+                return "ok", {"generation": status.get("generation", 0)}
+            return "rolled_back", {
+                "generation": status.get("generation", 0),
+                "reason": status.get("last_error")
+                or f"pool {ref.name} stayed on its incumbent"}
+        except (TimeoutError, RuntimeError):
+            raise
+        except (urllib.error.URLError, OSError) as exc:
+            return "aborted", {
+                "reason": f"pool {ref.name} unreachable mid-{role}: "
+                          f"{exc}"}
+
+    def _rollout_status(self, ref: PoolRef, attempts: int = 3) -> dict:
+        """``GET /rollout`` with a couple of quick retries so one
+        dropped packet does not read as a dead pool."""
+        for attempt in range(attempts):
+            try:
+                with urllib.request.urlopen(ref.url + "/rollout",
+                                            timeout=10) as resp:
+                    return json.load(resp)
+            except (urllib.error.URLError, OSError):
+                if attempt == attempts - 1:
+                    raise
+                time.sleep(0.2)
+        raise AssertionError("unreachable")
+
+    def _poll_rollout(self, ref: PoolRef) -> dict:
+        deadline = time.monotonic() + self.rollout_timeout_s
+        while time.monotonic() < deadline:
+            status = self._rollout_status(ref)
+            if not status.get("active"):
+                return status
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"pool {ref.name} rollout still in flight after "
+            f"{self.rollout_timeout_s:.0f}s — transient, re-run to "
+            "resume")
+
+
+# -------------------------------------------------------- trace harvest
+
+
+def fleet_snapshot(trace_dirs, dest: str | Path, fault_plan=None) -> dict:
+    """Fan graftloop's ``snapshot_trace`` across every pool's trace dir
+    into ONE snapshot root. Each pool's segments land under a ``p<i>-``
+    prefix (still ``_SEG_RE``-parseable, so the union root IS a valid
+    trace dir for ``iter_trace`` — and therefore for a graftloop
+    iteration's own snapshot stage), with a union manifest recording
+    per-pool provenance, the merged record count, and the content
+    digest. ``trace_dirs`` is ``{pool_name: dir}`` (sorted for a
+    deterministic prefix assignment) or an ordered ``[(name, dir)]``."""
+    from rl_scheduler_tpu.loopback.compile import (
+        SNAPSHOT_META,
+        snapshot_digest,
+        snapshot_trace,
+    )
+    from rl_scheduler_tpu.scheduler.tracelog import iter_trace
+    from rl_scheduler_tpu.studies.runner import atomic_write_json
+
+    items = (sorted(trace_dirs.items()) if isinstance(trace_dirs, dict)
+             else list(trace_dirs))
+    if not items:
+        raise ValueError("fleet_snapshot: at least one (name, trace_dir)")
+    dest = Path(dest)
+    if dest.exists():
+        shutil.rmtree(dest)
+    dest.mkdir(parents=True)
+    pools_meta = {}
+    files = {}
+    for i, (name, trace_dir) in enumerate(items):
+        staging = dest / f".pool-{i}.tmp"
+        meta = snapshot_trace(trace_dir, staging, fault_plan=fault_plan)
+        prefix = f"p{i}-"
+        for fname in sorted(meta["files"]):
+            os.replace(staging / fname, dest / (prefix + fname))
+            files[prefix + fname] = meta["files"][fname]
+        shutil.rmtree(staging)
+        pools_meta[name] = {"source": meta["source"],
+                            "records": meta["records"],
+                            "segments": len(meta["files"]),
+                            "prefix": prefix}
+    records = sum(1 for _ in iter_trace(dest))
+    union = {
+        "source": "fleet",
+        "pools": pools_meta,
+        "files": files,
+        "records": records,
+        "digest": snapshot_digest(dest),
+    }
+    atomic_write_json(dest / SNAPSHOT_META, union, indent=2)
+    return union
+
+
+# ------------------------------------------------------------ HTTP plane
+
+
+class _FleetHandler(BaseHTTPRequestHandler):
+    controller: FleetController  # bound by _make_fleet_server
+
+    def _send(self, code: int, payload, content_type="application/json"):
+        body = (payload if isinstance(payload, bytes)
+                else json.dumps(payload).encode())
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        if self.path == "/healthz":
+            health = self.controller.health()
+            self._send(200 if health["status"] != "down" else 503, health)
+        elif self.path == "/stats":
+            self._send(200, self.controller.stats())
+        elif self.path == "/metrics":
+            self._send(200, self.controller.metrics().encode(),
+                       content_type="text/plain; version=0.0.4; "
+                                    "charset=utf-8")
+        else:
+            self._send(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):  # noqa: N802
+        length = int(self.headers.get("Content-Length", 0))
+        self.rfile.read(length)
+        if self.path == "/stats/reset":
+            self._send(200, self.controller.reset_stats())
+        else:
+            # Fleet promotes run through the CLI (single writer, ledger
+            # lock) — the HTTP plane stays read-mostly by design.
+            self._send(404, {"error": f"unknown path {self.path}"})
+
+    def log_message(self, fmt, *log_args):  # quiet, like the pool plane
+        logger.debug("%s " + fmt, self.address_string(), *log_args)
+
+
+def _make_fleet_server(controller: FleetController, host: str,
+                       port: int) -> ThreadingHTTPServer:
+    handler = type("BoundFleetHandler", (_FleetHandler,),
+                   {"controller": controller})
+    server = ThreadingHTTPServer((host, port), handler)
+    server.daemon_threads = True
+    return server
+
+
+def run_fleet(controller: FleetController, host: str, port: int) -> None:
+    """Serve the fleet control plane until SIGTERM/SIGINT."""
+    server = _make_fleet_server(controller, host, port)
+
+    def _stop(signum, frame):  # noqa: ARG001 (signal API)
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    bound = server.socket.getsockname()
+    print(
+        f"graftfleet: {len(controller.pools)} pool(s) "
+        f"({', '.join(r.name for r in controller.pools)}), canary "
+        f"{controller.canary}, control plane on {bound[0]}:{bound[1]}",
+        flush=True,
+    )
+    try:
+        server.serve_forever()
+    finally:
+        server.server_close()
+
+
+# --------------------------------------------------------------- CLI glue
+
+
+def fault_plan_from_env(value: str | None):
+    """Parse ``GRAFTFLEET_FAULTS`` into a deterministic FaultPlan
+    schedule: ``site:idx[,idx...]`` entries joined by ``;`` — e.g.
+    ``fleet.promote:3`` fires the third pool-promote attempt,
+    ``fleet.scrape:1`` the first pool scrape. ``None``/empty disarms
+    (the production default — the plan is plumbed, never ambient)."""
+    if not value:
+        return None
+    from rl_scheduler_tpu.utils.faults import FaultPlan
+
+    schedule: dict = {}
+    for entry in value.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        site, _, idxs = entry.partition(":")
+        if not idxs:
+            raise ValueError(
+                f"GRAFTFLEET_FAULTS entry {entry!r}: expected "
+                "site:call_index[,call_index...]")
+        try:
+            schedule[site.strip()] = tuple(
+                int(i) for i in idxs.split(","))
+        except ValueError:
+            raise ValueError(
+                f"GRAFTFLEET_FAULTS entry {entry!r}: call indices must "
+                "be integers")
+    return FaultPlan(schedule=schedule)
+
+
+def _build_resolver(args):
+    if args.endpoints:
+        return EndpointsResolver(args.endpoints,
+                                 port_name=args.endpoints_port)
+    if args.pools:
+        return StaticResolver(args.pools)
+    raise SystemExit("pass --pools host:port,... or --endpoints FILE")
+
+
+def _build_controller(args, fault_plan=None) -> FleetController:
+    return FleetController(
+        _build_resolver(args), fleet_dir=args.fleet_dir,
+        canary=args.canary, scrape_timeout_s=args.scrape_timeout,
+        rollout_timeout_s=getattr(args, "rollout_timeout", 120.0),
+        canary_hold_s=getattr(args, "canary_hold", 0.0),
+        fault_plan=fault_plan)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m rl_scheduler_tpu.scheduler.fleet",
+        description="graftfleet: discovery, cross-pool canary promote, "
+                    "fleet-merged observability, fleet-wide trace "
+                    "harvest (docs/serving.md#graftfleet).")
+    topo = argparse.ArgumentParser(add_help=False)
+    topo.add_argument("--pools", default=None,
+                      help="static topology: host:port,host:port,...")
+    topo.add_argument("--endpoints", default=None,
+                      help="k8s Endpoints JSON file (kubectl get "
+                           "endpoints ... -o json); re-read per refresh")
+    topo.add_argument("--endpoints-port", default="control",
+                      help="named port to pick from the Endpoints "
+                           "document (default: control)")
+    topo.add_argument("--canary", default=None,
+                      help="pool name that canaries a fleet promote "
+                           "(default: first pool)")
+    topo.add_argument("--fleet-dir", default="fleet",
+                      help="ledger + lock directory (default: ./fleet)")
+    topo.add_argument("--scrape-timeout", type=float, default=2.0)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    serve = sub.add_parser("serve", parents=[topo],
+                           help="serve the fleet control plane")
+    serve.add_argument("--host", default="0.0.0.0")
+    serve.add_argument("--port", type=int, default=8790)
+    promote = sub.add_parser("promote", parents=[topo],
+                             help="run (or resume) one fleet promote")
+    promote.add_argument("--checkpoint", required=True,
+                         help="candidate run dir (every pool must see "
+                              "this path)")
+    promote.add_argument("--rollout-timeout", type=float, default=120.0)
+    promote.add_argument("--canary-hold", type=float, default=0.0,
+                         help="seconds the canary pool bakes before the "
+                              "rest of the fleet rolls")
+    status = sub.add_parser("status", parents=[topo],
+                            help="print the fleet health body")
+    del status  # parsed via args.cmd
+    snap = sub.add_parser("snapshot",
+                          help="union-snapshot every pool's trace dir")
+    snap.add_argument("--trace-dirs", required=True,
+                      help="comma-separated pool trace directories")
+    snap.add_argument("--names", default=None,
+                      help="comma-separated pool names (default: "
+                           "pool0,pool1,...)")
+    snap.add_argument("--out", required=True,
+                      help="union snapshot destination directory")
+    args = p.parse_args(argv)
+
+    fault_plan = fault_plan_from_env(os.environ.get("GRAFTFLEET_FAULTS"))
+    if args.cmd == "snapshot":
+        dirs = [d.strip() for d in args.trace_dirs.split(",") if d.strip()]
+        names = ([n.strip() for n in args.names.split(",")]
+                 if args.names else [f"pool{i}" for i in range(len(dirs))])
+        if len(names) != len(dirs):
+            p.error("--names must match --trace-dirs one to one")
+        union = fleet_snapshot(list(zip(names, dirs)), args.out,
+                               fault_plan=fault_plan)
+        print(json.dumps({"metric": "fleet_snapshot",
+                          "schema_version": FLEET_SCHEMA_VERSION,
+                          "out": str(args.out),
+                          "records": union["records"],
+                          "segments": len(union["files"]),
+                          "pools": {n: m["records"]
+                                    for n, m in union["pools"].items()},
+                          "digest": union["digest"]}))
+        return 0
+    controller = _build_controller(args, fault_plan=fault_plan)
+    if args.cmd == "serve":
+        run_fleet(controller, args.host, args.port)
+        return 0
+    if args.cmd == "status":
+        health = controller.health()
+        print(json.dumps(health, indent=2, sort_keys=True))
+        return 0 if health["status"] != "down" else 1
+    # promote
+    summary = controller.promote(args.checkpoint)
+    summary = {"metric": "fleet_promote",
+               "schema_version": FLEET_SCHEMA_VERSION, **summary}
+    print(json.dumps(summary, sort_keys=True))
+    return {"ok": 0, "refused": 2}.get(summary["status"], 3)
+
+
+if __name__ == "__main__":  # pragma: no cover - CLI entry
+    raise SystemExit(main())

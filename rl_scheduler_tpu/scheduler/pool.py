@@ -385,6 +385,27 @@ def aggregate_stats(snapshots: list, pool: dict, merged=None,
             entry["lifetime_count"] = p_count
             phases[phase] = entry
         out["phases"] = phases
+    # graftfleet: the raw merged buckets ride on the body so a fleet
+    # controller can re-merge pool scrapes with the SAME machinery the
+    # pool applies to workers — quantiles do not merge, bucket counts
+    # do. Additive; version-skewed scrapers simply ignore the key, and
+    # a version-skewed pool missing it contributes an empty histogram
+    # (the optional-phase rule, one level up).
+    out["raw"] = {
+        "histogram": {
+            "cumulative": [int(c) for c in merged_cum],
+            "sum": merged_sum,
+            "count": int(merged_count),
+        },
+        "phases": {
+            phase: {
+                "cumulative": [int(c) for c in cum],
+                "sum": p_sum,
+                "count": int(p_count),
+            }
+            for phase, (cum, p_sum, p_count) in (phase_hists or {}).items()
+        },
+    }
     merged_slo = merge_worker_slo(snapshots)
     if merged_slo is not None:
         out["slo"] = merged_slo

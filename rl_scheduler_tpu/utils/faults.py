@@ -74,10 +74,19 @@ logger = logging.getLogger(__name__)
 #                      the loop must surface the refusal with the pool
 #                      untouched on the incumbent generation
 #                      (rl_scheduler_tpu/loopback/orchestrator.py)
+#   fleet.scrape       a fleet controller's pool /stats scrape raises
+#                      TimeoutError — the pool must show as down/degraded
+#                      on fleet /healthz while the merge proceeds over
+#                      the pools that answered (scheduler/fleet.py)
+#   fleet.promote      a pool becomes unreachable mid fleet-roll (OSError
+#                      before the POST dispatches) — the fleet promote
+#                      must record `aborted` and revert every already-
+#                      rolled pool to its incumbent (scheduler/fleet.py)
 SITES = ("checkpoint.save", "checkpoint.partial", "telemetry.scrape",
          "k8s.place", "backend.decide", "preempt", "scenario.churn",
          "tracelog.append", "rollout.spawn", "rollout.health",
-         "fastpath.agree", "loopback.compile", "loopback.promote")
+         "fastpath.agree", "loopback.compile", "loopback.promote",
+         "fleet.scrape", "fleet.promote")
 
 
 class FaultInjected(RuntimeError):

@@ -95,6 +95,45 @@ def test_format_report_renders_every_section(report):
         assert needle in text
 
 
+def test_fleet_merged_stats_body_reports_like_a_pool_body():
+    """graftfleet satellite: the fleet controller's merged /stats body
+    (aggregate_fleet_stats over pool bodies) reads like any pool body —
+    `decisionview --stats http://fleet:8790/stats` renders e2e latency,
+    phases, and the SLO section from it without special-casing."""
+    from rl_scheduler_tpu.scheduler.extender import ExtenderPolicy
+    from rl_scheduler_tpu.scheduler.fleet import aggregate_fleet_stats
+    from rl_scheduler_tpu.scheduler.policy_backend import GreedyBackend
+    from rl_scheduler_tpu.scheduler.pool import (
+        PoolShared,
+        aggregate_stats,
+        worker_snapshot,
+    )
+    from rl_scheduler_tpu.scheduler.slo import SloConfig, SloTracker
+    from rl_scheduler_tpu.scheduler.telemetry import RandomCpu, TableTelemetry
+
+    bodies = {}
+    for p, n in enumerate((3, 5)):
+        shared = PoolShared()
+        telemetry = TableTelemetry.from_table(
+            cpu_source=RandomCpu(seed=0), counter=shared.table_counter)
+        policy = ExtenderPolicy(GreedyBackend(), telemetry)
+        policy.slo = SloTracker(SloConfig(p99_ms=1000.0))
+        for i in range(n):
+            policy.filter({"nodenames": [f"aws-w{i}", f"azure-w{i}"],
+                           "pod": {}})
+        bodies[f"pool{p}"] = aggregate_stats(
+            [worker_snapshot(policy, 0)], {"workers": 1, "alive": 1})
+    fleet_body = aggregate_fleet_stats(bodies, fleet={"generation": 2})
+    fleet_report = build_report(stats=fleet_body)
+    assert fleet_report["e2e"]["count"] == 8
+    assert fleet_report["e2e"]["mean_ms"] > 0
+    assert set(fleet_report["phases"]) == {"parse", "observe", "batch_wait",
+                                           "forward", "marshal", "trace"}
+    assert fleet_report["slo"]["latency"]["attainment"] == 1.0
+    text = format_report(fleet_report)
+    assert "Phase decomposition" in text and "SLO attainment" in text
+
+
 # ------------------------------------------------------------------- gates
 
 

@@ -269,6 +269,37 @@ def test_merge_worker_histograms_is_the_pinned_method():
         LatencyStats.merged_histogram([stats_a, stats_b])
 
 
+def test_aggregate_stats_raw_section_carries_the_merged_buckets():
+    """The ``raw`` section on the /stats body IS the merged bucket
+    state — ``merge_worker_histograms`` and ``merge_phase_histograms``
+    verbatim, ints throughout — so a fleet controller can re-merge
+    pool scrapes with the same machinery the pool applies to workers
+    (graftfleet's pool_stats_snapshot reads exactly these keys)."""
+    from rl_scheduler_tpu.scheduler.extender import PHASES
+    from rl_scheduler_tpu.scheduler.pool import merge_phase_histograms
+
+    shared = PoolShared()
+    snapshots = []
+    for worker_id, n in enumerate((3, 5)):
+        policy = _greedy_factory(worker_id, shared)
+        for i in range(n):
+            policy.filter(_filter_args(i))
+        snapshots.append(worker_snapshot(policy, worker_id))
+    out = aggregate_stats(snapshots, {"workers": 2, "alive": 2})
+    ref_cum, ref_sum, ref_count = merge_worker_histograms(snapshots)
+    raw = out["raw"]
+    assert raw["histogram"]["cumulative"] == [int(c) for c in ref_cum]
+    assert raw["histogram"]["sum"] == ref_sum
+    assert raw["histogram"]["count"] == ref_count == 8
+    assert all(isinstance(c, int) for c in raw["histogram"]["cumulative"])
+    ref_phases = merge_phase_histograms(snapshots)
+    assert set(raw["phases"]) == set(ref_phases) == set(PHASES)
+    for phase, (cum, p_sum, p_count) in ref_phases.items():
+        assert raw["phases"][phase]["cumulative"] == [int(c) for c in cum]
+        assert raw["phases"][phase]["sum"] == p_sum
+        assert raw["phases"][phase]["count"] == int(p_count)
+
+
 def test_worker_snapshot_round_trips_histogram():
     """The control-plane snapshot carries exactly the worker's lifetime
     histogram, and _HistogramView feeds it back to merged_histogram

@@ -60,7 +60,9 @@ PHASE_ORDER = ("parse", "observe", "batch_wait", "forward", "marshal",
 
 
 def load_stats(source: str) -> dict:
-    """A ``/stats`` body from a JSON file or a live ``http://`` URL."""
+    """A ``/stats`` body from a JSON file or a live ``http://`` URL —
+    a pool control plane or a graftfleet controller's merged body (the
+    fleet merge reuses the pool's sections, so both render alike)."""
     if source.startswith(("http://", "https://")):
         import urllib.request
 

@@ -50,7 +50,8 @@ def main(argv: list | None = None) -> int:
                     "gates.")
     p.add_argument("--stats", default=None, metavar="FILE|URL",
                    help="/stats body: a JSON file or a live http:// URL "
-                        "(pool control plane or single-process server)")
+                        "(pool control plane, single-process server, or "
+                        "a graftfleet controller's merged /stats)")
     p.add_argument("--trace", default=None, metavar="DIR",
                    help="decision trace-log directory (--trace-dir); "
                         "probe records are excluded")

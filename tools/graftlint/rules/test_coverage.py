@@ -45,7 +45,10 @@ from tools.graftlint.rules import Rule, register
 # same class of claim. `mixtures` joined with graftmix: bitwise trace
 # imports, seeded family draws inside vmap, and statistical transfer
 # verdicts are exactly the cross-environment determinism contracts this
-# rule exists to keep referenced.
+# rule exists to keep referenced. graftfleet rides the existing
+# `scheduler` entry: scheduler/fleet.py's publics (cross-pool promote,
+# ledger resume, fleet merges) are the fleet-level zero-downtime
+# contract and must stay referenced the same way.
 OP_DIRS = frozenset({"ops", "parallel", "scenarios", "studies",
                      "scheduler", "loopback", "mixtures"})
 
