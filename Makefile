@@ -8,17 +8,25 @@ PY ?= python
 # `train_ppo --profile-dir`) to summarize/check a real run.
 TRACE ?= tests/fixtures/traceview/fixture.trace.json.gz
 
-.PHONY: lint lint-json test tier1 trace-summary obs chaos chaos-soak \
+.PHONY: lint lint-json lint-sarif test tier1 trace-summary obs chaos chaos-soak \
         serve-pool serve-soak rollout-drill eval-matrix scenario-bench \
         study study-list overlap-bench serve-report slo-check span-ab \
         fastpath-ab front-ab loop-drill loop-soak transfer-grid \
         mixture-smoke fleet-drill fleet-soak
 
+# Exit codes (all lint targets): 0 clean, 1 findings (or stale
+# suppressions under --audit-suppressions), 2 usage/config error.
+# `lint` runs the suppression audit too — a disable comment whose rule
+# no longer fires is a gate failure, same as a finding.
 lint:
-	$(PY) -m tools.graftlint --check
+	$(PY) -m tools.graftlint --check --audit-suppressions
 
 lint-json:
 	$(PY) -m tools.graftlint --check --json
+
+# SARIF 2.1.0 artifact for CI annotators (GitHub code scanning et al).
+lint-sarif:
+	$(PY) -m tools.graftlint --check --audit-suppressions --sarif graftlint.sarif
 
 trace-summary:
 	$(PY) -m tools.traceview $(TRACE)

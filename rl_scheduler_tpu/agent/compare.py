@@ -26,6 +26,7 @@ from rl_scheduler_tpu.agent.presets import PPO_PRESETS
 from rl_scheduler_tpu.config import EnvConfig
 from rl_scheduler_tpu.env import core as env_core
 from rl_scheduler_tpu.models import ActorCritic
+from rl_scheduler_tpu.utils.fsio import atomic_write_json
 
 
 def compare(
@@ -137,7 +138,8 @@ def main(argv: list[str] | None = None) -> dict:
 
     out = Path(args.results_dir)
     out.mkdir(parents=True, exist_ok=True)
-    (out / "comparison.json").write_text(json.dumps(results, indent=2) + "\n")
+    # Atomic: eval tooling tails comparison.json while a rerun overwrites.
+    atomic_write_json(out / "comparison.json", results, indent=2)
     if save_plot(results, out / "reward_comparison.png"):
         print(f"\nPlot saved to {out}/reward_comparison.png")
     print(f"Results saved to {out}/comparison.json")

@@ -36,6 +36,7 @@ from rl_scheduler_tpu.env.baselines import (
     round_robin_policy,
 )
 from rl_scheduler_tpu.env.vector import reset_batch, rollout_from
+from rl_scheduler_tpu.utils.fsio import atomic_write_json
 
 # The reference's hardcoded eval anchor (final_evaluation.py:73), kept only
 # to report alongside the computed baseline.
@@ -596,9 +597,9 @@ def _write_report(results_dir: Path, stem: str, report) -> None:
     the flat and structured evaluation families)."""
     results_dir.mkdir(parents=True, exist_ok=True)
     (results_dir / f"{stem}.txt").write_text(report.summary() + "\n")
-    (results_dir / f"{stem}.json").write_text(
-        json.dumps(report.to_json(), indent=2) + "\n"
-    )
+    # Atomic: the report is re-read by studies/loop tooling mid-run.
+    atomic_write_json(results_dir / f"{stem}.json", report.to_json(),
+                      indent=2)
     print(f"Report written to {results_dir}/{stem}.txt")
 
 
@@ -709,8 +710,8 @@ def _run_transfer_grid(args) -> dict:
     print(json.dumps(summary, sort_keys=True))
     grid = render_transfer_grid(summary)
     print(grid)
-    (results_dir / "transfer_grid.json").write_text(
-        json.dumps(summary, indent=2) + "\n")
+    # Atomic: graftmix's grid consumers poll this file between cells.
+    atomic_write_json(results_dir / "transfer_grid.json", summary, indent=2)
     (results_dir / "transfer_grid.txt").write_text(grid + "\n")
     print(f"Transfer grid written to {cells_path}")
     return summary

@@ -54,6 +54,8 @@ from pathlib import Path
 
 import numpy as np
 
+from rl_scheduler_tpu.utils.fsio import atomic_write_json, fresh_dir
+
 logger = logging.getLogger(__name__)
 
 SNAPSHOT_META = "snapshot.json"
@@ -92,10 +94,7 @@ def snapshot_trace(trace_dir: str | Path, dest: str | Path,
         raise TraceCompileError(
             f"trace dir {trace_dir} does not exist — point --trace-dir at "
             "the pool's trace directory")
-    dest = Path(dest)
-    if dest.exists():
-        shutil.rmtree(dest)
-    dest.mkdir(parents=True)
+    dest = fresh_dir(dest)
     files = {}
     for path in sorted(trace_dir.iterdir()):
         m = _SEG_RE.match(path.name)
@@ -122,8 +121,6 @@ def snapshot_trace(trace_dir: str | Path, dest: str | Path,
         "records": records,
         "digest": snapshot_digest(dest),
     }
-    from rl_scheduler_tpu.studies.runner import atomic_write_json
-
     atomic_write_json(dest / SNAPSHOT_META, meta, indent=2)
     return meta
 

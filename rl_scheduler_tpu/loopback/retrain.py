@@ -158,10 +158,13 @@ def run_finetune(spec: FinetuneSpec, out_root: str | Path,
             "compiles cluster_set tables)")
     out_root = Path(out_root)
     run_dir = out_root / run_name
-    if run_dir.exists():
-        logger.warning("retrain: wiping partial candidate dir %s "
-                       "(stage re-run)", run_dir)
+    try:  # EAFP: no exists()/rmtree window for a concurrent stage re-run
         shutil.rmtree(run_dir)
+    except FileNotFoundError:
+        pass
+    else:
+        logger.warning("retrain: wiped partial candidate dir %s "
+                       "(stage re-run)", run_dir)
     out_root.mkdir(parents=True, exist_ok=True)
     num_nodes = spec.num_nodes or meta.get("num_nodes") or 8
     argv = [

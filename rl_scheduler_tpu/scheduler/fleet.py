@@ -863,16 +863,13 @@ def fleet_snapshot(trace_dirs, dest: str | Path, fault_plan=None) -> dict:
         snapshot_trace,
     )
     from rl_scheduler_tpu.scheduler.tracelog import iter_trace
-    from rl_scheduler_tpu.studies.runner import atomic_write_json
+    from rl_scheduler_tpu.utils.fsio import atomic_write_json, fresh_dir
 
     items = (sorted(trace_dirs.items()) if isinstance(trace_dirs, dict)
              else list(trace_dirs))
     if not items:
         raise ValueError("fleet_snapshot: at least one (name, trace_dir)")
-    dest = Path(dest)
-    if dest.exists():
-        shutil.rmtree(dest)
-    dest.mkdir(parents=True)
+    dest = fresh_dir(dest)
     pools_meta = {}
     files = {}
     for i, (name, trace_dir) in enumerate(items):
@@ -946,7 +943,11 @@ def _make_fleet_server(controller: FleetController, host: str,
     handler = type("BoundFleetHandler", (_FleetHandler,),
                    {"controller": controller})
     server = ThreadingHTTPServer((host, port), handler)
-    server.daemon_threads = True
+    # Non-daemon handler threads: server_close() joins them, so the
+    # finally-block drain in run_fleet actually waits for in-flight
+    # requests instead of letting interpreter exit kill them mid-reply
+    # (same contract as the pool's serving plane, scheduler/pool.py).
+    server.daemon_threads = False
     return server
 
 

@@ -351,6 +351,18 @@ class TraceLog:
                     except queue.Empty:
                         pass
             self._thread.join(timeout=30.0)
+            if self._thread.is_alive():
+                # Wedged writer (blocked write(2) on a dying mount): it
+                # still owns self._fh, so sealing here would race its
+                # next write. Leave the .part for the next startup's
+                # _recover() to seal — losing the seal is recoverable,
+                # a torn concurrent write is not.
+                logger.error(
+                    "tracelog: writer thread still alive after 30s drain "
+                    "timeout; leaving active segment unsealed for "
+                    "startup recovery")
+                self._thread = None
+                return
             self._thread = None
         self._seal()
 

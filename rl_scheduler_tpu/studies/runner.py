@@ -33,6 +33,9 @@ from pathlib import Path
 
 from rl_scheduler_tpu.studies.ledger import StudyLedger
 from rl_scheduler_tpu.studies.spec import StudySpec, TrialSpec
+# atomic_write_json moved to utils/fsio.py when the discipline went
+# repo-wide (graftlint GL013); re-exported here for existing importers.
+from rl_scheduler_tpu.utils.fsio import atomic_write_json  # noqa: F401
 from rl_scheduler_tpu.utils.pidlock import acquire_pidfile_lock, read_live_pid
 
 logger = logging.getLogger(__name__)
@@ -310,20 +313,6 @@ def run_trial(spec: StudySpec, trial: TrialSpec, trial_dir: str | Path,
     }
     write_result(trial_dir, record)
     return record
-
-
-def atomic_write_json(path: str | Path, obj, indent: int | None = None) -> None:
-    """tmp-then-rename JSON write — the one implementation of the
-    graftguard atomicity discipline for study artifacts (results,
-    summaries, threshold caches); a kill leaves either nothing or a
-    complete file. The tmp name is per-writer-unique (pid): concurrent
-    writers of the same target (e.g. same-variant workers racing on the
-    threshold cache) each rename their OWN complete file, last one
-    wins — never a shared tmp renamed out from under a mid-write peer."""
-    path = Path(path)
-    tmp = path.parent / f".{path.name}.{os.getpid()}.tmp"
-    tmp.write_text(json.dumps(obj, sort_keys=True, indent=indent))
-    os.replace(tmp, path)
 
 
 def write_result(trial_dir: str | Path, record: dict) -> None:

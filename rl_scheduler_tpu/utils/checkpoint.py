@@ -278,12 +278,19 @@ class CheckpointManager:
         while dest.exists():
             n += 1
             dest = qdir / f"{step}.{n}"
+        # EAFP moves: a concurrent quarantine (two restore paths hitting
+        # the same corrupt step) may have taken the evidence first —
+        # "already moved" is success, not an error.
         step_dir = self._step_dir(step)
-        if step_dir.exists():
+        try:
             shutil.move(str(step_dir), str(dest))
+        except FileNotFoundError:
+            pass
         mpath = self._manifest_path(step)
-        if mpath.exists():
+        try:
             shutil.move(str(mpath), str(dest) + ".manifest.json")
+        except FileNotFoundError:
+            pass
         logger.warning(
             "checkpoint step %d failed verification (%s); quarantined to %s",
             step, reason, dest)

@@ -1,0 +1,57 @@
+"""Atomic filesystem idioms: the one place the write/replace discipline
+lives.
+
+Two primitives, each the canonical fix for a graftlint rule's defect
+class (docs/static_analysis.md):
+
+- :func:`atomic_write_json` (GL013): durable ``.json`` artifacts —
+  ledgers, manifests, verdicts, caches — must never be observable
+  half-written. Write a per-writer-unique ``.{name}.{pid}.tmp`` sibling
+  and ``os.replace`` it in: a kill leaves either nothing or a complete
+  file, and concurrent writers each rename their OWN complete file
+  (last one wins) instead of racing on a shared tmp name.
+- :func:`fresh_dir` (GL014): the ``if dest.exists(): rmtree(dest)``
+  check-then-act pair loses to any process that creates or deletes
+  ``dest`` inside the window. EAFP: delete unconditionally, swallow
+  only "already gone", recreate.
+
+Grew out of ``studies/runner.py`` (which re-exports
+``atomic_write_json`` for its existing importers) when the discipline
+went repo-wide with the GL013/GL014 rules.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from pathlib import Path
+
+
+def atomic_write_json(path: str | Path, obj, indent: int | None = None) -> None:
+    """tmp-then-rename JSON write — the one implementation of the
+    graftguard atomicity discipline for durable artifacts (results,
+    summaries, threshold caches, snapshot manifests); a kill leaves
+    either nothing or a complete file. The tmp name is per-writer-unique
+    (pid): concurrent writers of the same target (e.g. same-variant
+    workers racing on the threshold cache) each rename their OWN
+    complete file, last one wins — never a shared tmp renamed out from
+    under a mid-write peer."""
+    path = Path(path)
+    tmp = path.parent / f".{path.name}.{os.getpid()}.tmp"
+    tmp.write_text(json.dumps(obj, sort_keys=True, indent=indent))
+    os.replace(tmp, path)
+
+
+def fresh_dir(dest: str | Path) -> Path:
+    """Recreate ``dest`` empty, without the exists()/rmtree TOCTOU pair:
+    remove whatever is there (tolerating a concurrent delete), then
+    mkdir. A concurrent CREATOR still surfaces as ``FileExistsError``
+    from the mkdir — that conflict is real and must not be silenced."""
+    dest = Path(dest)
+    try:
+        shutil.rmtree(dest)
+    except FileNotFoundError:
+        pass
+    dest.mkdir(parents=True)
+    return dest

@@ -1,0 +1,22 @@
+"""Deliberately-bad fixture: Python-scalar leaves on traced pytrees.
+
+``EnvP`` crosses the trace boundary as an argument of a jitted
+function; its ``bool``/``int`` defaults are pytree leaves that become
+tracers under the transform — ``if params.random_start:`` then raises
+TracerBoolConversionError (the PR-7 ``random_start`` near-miss).
+"""
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class EnvP(NamedTuple):
+    rates: jnp.ndarray
+    random_start: bool = False  # GL016: bool leaf on a traced argument
+    horizon: int = 128          # GL016: int leaf on a traced argument
+
+
+@jax.jit
+def apply_prices(params: EnvP, load):
+    return load * params.rates

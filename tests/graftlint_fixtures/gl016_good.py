@@ -1,0 +1,42 @@
+"""Good fixture: the three safe shapes around scalar pytree leaves.
+
+Arrays-only fields on the traced-argument type; Python scalars on a
+container that stays CLOSED OVER (never a traced argument — the
+``ClusterSetParams.random_phase`` pattern); and a plain dataclass,
+which is not a pytree at all (jit rejects it loudly, not late).
+"""
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class EnvP(NamedTuple):
+    rates: jnp.ndarray  # arrays only on the traced-argument type
+    horizon: jnp.ndarray = jnp.ones(())
+
+
+class PhaseCfg(NamedTuple):
+    random_phase: bool = False  # never a traced argument: closed over
+
+
+@dataclasses.dataclass
+class TrainCfg:
+    lr: float = 3e-4  # plain dataclass: not a pytree, out of scope
+
+
+@jax.jit
+def apply_prices(params: EnvP, load):
+    return load * params.rates
+
+
+def make_step(cfg: PhaseCfg):
+    # The scalar rides the CLOSURE, not the trace boundary.
+    shift = 1.0 if cfg.random_phase else 0.0
+
+    @jax.jit
+    def step(load):
+        return load + shift
+
+    return step

@@ -1,0 +1,30 @@
+"""Deliberately-bad fixture: non-atomic durable JSON artifact writes.
+
+Each write lands a ``*.json`` artifact through a plain write — a reader
+overlapping the write observes a torn file (the threshold-cache race).
+"""
+import json
+import threading
+
+
+def write_manifest(dest, payload):
+    (dest / "manifest.json").write_text(json.dumps(payload))  # GL013
+
+
+def write_cache(path, obj):
+    name = f"{path.stem}.json"
+    out = path.parent / name
+    with out.open("w") as fh:
+        json.dump(obj, fh)  # GL013: 'w' handle resolved through def-use
+
+
+def _writer(path, obj):
+    # GL013, and the context model tags this as a thread target: the
+    # torn window is concurrent by construction.
+    path.with_suffix(".json").write_text(json.dumps(obj))
+
+
+def start(path, obj):
+    worker = threading.Thread(target=_writer, args=(path, obj))
+    worker.start()
+    return worker

@@ -1,0 +1,31 @@
+"""Good fixture: every durable JSON write rides an atomic idiom.
+
+Covers the three accepted shapes — the pid-unique tmp sibling
+(``atomic_write_json``), the unnamed-tmp write-then-rename, and the
+``.jsonl`` line-stream exemption (torn tails are the recovery layer's
+job, not tmp-then-rename's).
+"""
+import json
+import os
+
+
+def atomic_write_json(path, obj):
+    tmp = path.parent / f".{path.name}.{os.getpid()}.tmp"
+    tmp.write_text(json.dumps(obj))
+    os.replace(tmp, path)
+
+
+def write_manifest(dest, payload):
+    atomic_write_json(dest / "manifest.json", payload)
+
+
+def staged_write(path, obj):
+    staging = path.parent / "staging.json"
+    staging.write_text(json.dumps(obj))  # renamed below: the tmp half
+    os.replace(staging, path)
+
+
+def emit_stream(dest, rows):
+    with (dest / "events.jsonl").open("w") as fh:  # line stream: exempt
+        for row in rows:
+            fh.write(json.dumps(row) + "\n")

@@ -1,0 +1,24 @@
+"""Deliberately-bad fixture: check-then-act TOCTOU windows.
+
+Every pair checks existence of a path expression and then acts on the
+SAME expression with nothing closing the window — another process wins
+the race between the two lines.
+"""
+import os
+import shutil
+
+
+def refresh(dest):
+    if dest.exists():
+        shutil.rmtree(dest)  # GL014: dest can vanish/appear in between
+    dest.mkdir(parents=True)
+
+
+def clear_lock(lock_path):
+    if lock_path.is_file():
+        os.remove(lock_path)  # GL014: a new holder can recreate it first
+
+
+def seed_default(path, payload):
+    if not path.exists():
+        path.write_text(payload)  # GL014: two seeders both pass the check

@@ -1,0 +1,37 @@
+"""Good fixture: the two accepted closures of the check-act window.
+
+EAFP (act, tolerate "already gone") and check-under-lock (the pidlock
+seam makes check-then-act the LOCK's semantics, not a race).
+"""
+import os
+import shutil
+
+from rl_scheduler_tpu.utils.pidlock import acquire_pidfile_lock
+
+
+def refresh(dest):
+    try:
+        shutil.rmtree(dest)
+    except FileNotFoundError:
+        pass  # concurrent delete won: nothing left to remove
+    dest.mkdir(parents=True)
+
+
+def clear_lock(lock_path):
+    lock_path.unlink(missing_ok=True)
+
+
+def fresh_under_lock(study_dir):
+    fd = acquire_pidfile_lock(study_dir / "runner.pid")
+    trials = study_dir / "trials"
+    if trials.exists():  # held lock: the window is closed by design
+        shutil.rmtree(trials)
+    os.close(fd)
+
+
+def read_if_present(path):
+    # Check then READ is outside the rule: the racing acts are the
+    # destructive/creating ones.
+    if path.exists():
+        return path.read_text()
+    return None

@@ -1,0 +1,23 @@
+"""Deliberately-bad fixture: one breaker shared across endpoint keys.
+
+Two endpoints funnel failures into a single CircuitBreaker — a flapping
+``aws`` dilutes (or poisons) the ``azure`` signal and the breaker never
+opens cleanly under mixed traffic (the telemetry/k8s defect, twice).
+"""
+from rl_scheduler_tpu.scheduler.telemetry import CircuitBreaker
+
+
+class TelemetryPush:
+    def __init__(self):
+        self.breaker = CircuitBreaker(threshold=5)  # GL015: one for all keys
+
+    def push_aws(self, payload):
+        if self.breaker.allow("aws"):
+            self._post("aws", payload)
+
+    def push_azure(self, payload):
+        if self.breaker.allow("azure"):
+            self._post("azure", payload)
+
+    def _post(self, cloud, payload):
+        del cloud, payload

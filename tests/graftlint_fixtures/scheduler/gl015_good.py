@@ -1,0 +1,33 @@
+"""Good fixture: per-key breaker instances, and the one-endpoint case.
+
+The dict-comprehension construction is per-key discipline (telemetry/
+k8s_client); a single breaker guarding a single dependency takes no key
+at all.
+"""
+from rl_scheduler_tpu.scheduler.telemetry import CircuitBreaker
+
+
+class TelemetryPush:
+    def __init__(self, clouds):
+        # Per-key construction: each endpoint owns its failure domain.
+        self.breakers = {c: CircuitBreaker(threshold=5) for c in clouds}
+
+    def push(self, cloud, payload):
+        if self.breakers[cloud].allow():
+            self._post(cloud, payload)
+
+    def _post(self, cloud, payload):
+        del cloud, payload
+
+
+class Backend:
+    def __init__(self):
+        self.breaker = CircuitBreaker(threshold=3)  # one dependency: fine
+
+    def call(self, request):
+        if self.breaker.allow():
+            return self._send(request)
+        return None
+
+    def _send(self, request):
+        del request

@@ -1,0 +1,30 @@
+"""Deliberately-bad fixture: drain contracts that don't drain.
+
+A timed join on a daemon thread with no ``is_alive()`` verdict, and a
+socketserver whose ``daemon_threads = True`` voids ``server_close()``'s
+handler join (the graftroll record-loss race).
+"""
+import threading
+from http.server import ThreadingHTTPServer
+
+
+class Recorder:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        self._thread.join(timeout=5.0)  # GL017: wedged writer unnoticed
+        self._seal()
+
+    def _seal(self):
+        pass
+
+
+def make_server(handler_cls):
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    server.daemon_threads = True  # GL017: server_close() skips the join
+    return server

@@ -1,0 +1,56 @@
+"""Good fixture: honored drain contracts.
+
+The timed join takes the is_alive() verdict (wedged branch leaves
+sealing to recovery), the bare join is a guaranteed drain, fan-out
+polling with join(timeout) outside a drain path is by-design, and the
+server keeps non-daemon handler threads so server_close() drains.
+"""
+import threading
+from http.server import ThreadingHTTPServer
+
+
+class Recorder:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        self._thread.join(timeout=5.0)
+        if self._thread.is_alive():  # verdict taken: wedged branch
+            return
+        self._seal()
+
+    def _seal(self):
+        pass
+
+
+class Courier:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        self._thread.join()  # bare join: guaranteed drain, never flagged
+
+
+def poll_workers(jobs):
+    threads = []
+    for job in jobs:
+        t = threading.Thread(target=job, daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=0.5)  # fan-out poll, not a drain path
+    return threads
+
+
+def make_server(handler_cls):
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    server.daemon_threads = False  # server_close() joins handlers
+    return server

@@ -1,0 +1,16 @@
+"""Deliberately-stale suppression: the audit must flag this file.
+
+The disable comment below is justified and parses cleanly — but the
+write it once excused has since been made atomic, so GL013 no longer
+fires on the covered lines. A justification that outlived its code is a
+silenced alarm: the suppression audit turns it into a gate failure.
+"""
+import json
+import os
+
+
+def write_manifest(dest, payload):
+    # graftlint: disable=GL013 -- manifest write predates the atomic idiom
+    tmp = dest / ".manifest.json.tmp"
+    tmp.write_text(json.dumps(payload))
+    os.replace(tmp, dest / "manifest.json")

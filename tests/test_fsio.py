@@ -1,0 +1,96 @@
+"""Regression tests for utils/fsio — the canonical GL013/GL014 fixes.
+
+``atomic_write_json`` (grown out of studies/runner.py) is now the one
+write path behind comparison.json, eval reports, the transfer grid, and
+every studies ledger; ``fresh_dir`` is the EAFP recreate behind
+loopback's trace snapshots and fleet_snapshot. These tests pin the
+crash/race semantics the GL013/GL014 lint rules exist to protect.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import pytest
+
+from rl_scheduler_tpu.utils.fsio import atomic_write_json, fresh_dir
+
+
+def test_atomic_write_json_crash_before_replace_keeps_old_file(
+        tmp_path, monkeypatch):
+    """The GL013 contract: a writer killed mid-write leaves either the
+    OLD complete file or the NEW complete file — never a torn one."""
+    path = tmp_path / "comparison.json"
+    atomic_write_json(path, {"verdict": "old"})
+
+    real_replace = os.replace
+
+    def crash(src, dst):
+        raise OSError("simulated SIGKILL before rename")
+
+    monkeypatch.setattr(os, "replace", crash)
+    with pytest.raises(OSError, match="simulated"):
+        atomic_write_json(path, {"verdict": "new"})
+    monkeypatch.setattr(os, "replace", real_replace)
+
+    # The reader still sees the old COMPLETE artifact.
+    assert json.loads(path.read_text()) == {"verdict": "old"}
+    # The half-written attempt is a .tmp sibling, never the target.
+    leftovers = list(tmp_path.glob(".comparison.json.*.tmp"))
+    assert len(leftovers) == 1
+
+
+def test_atomic_write_json_tmp_name_is_per_writer_unique(tmp_path,
+                                                         monkeypatch):
+    """Concurrent writers of the same target must each rename their OWN
+    complete file — the tmp name carries the pid, so two workers racing
+    on a shared threshold cache never truncate each other's tmp."""
+    seen = []
+    real_replace = os.replace
+
+    def record(src, dst):
+        seen.append(os.path.basename(str(src)))
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", record)
+    atomic_write_json(tmp_path / "cache.json", {"t": 1})
+    assert seen == [f".cache.json.{os.getpid()}.tmp"]
+
+
+def test_fresh_dir_creates_wipes_and_tolerates_concurrent_delete(
+        tmp_path, monkeypatch):
+    dest = tmp_path / "snap"
+    # Absent: created.
+    assert fresh_dir(dest) == dest and dest.is_dir()
+    # Present with content: wiped and recreated empty.
+    (dest / "stale.json").write_text("{}")
+    fresh_dir(dest)
+    assert list(dest.iterdir()) == []
+
+    # The GL014 race this replaced `if exists(): rmtree()` to survive:
+    # a concurrent deleter wins the rmtree — "already gone" is fine.
+    def racing_rmtree(p, **kw):
+        raise FileNotFoundError(p)
+
+    monkeypatch.setattr(shutil, "rmtree", racing_rmtree)
+    fresh_dir(tmp_path / "snap2")
+    assert (tmp_path / "snap2").is_dir()
+
+
+def test_fresh_dir_surfaces_concurrent_creator(tmp_path, monkeypatch):
+    """A concurrent CREATOR is a real conflict (two snapshotters told to
+    own the same dest) and must not be silenced by the EAFP rewrite."""
+    dest = tmp_path / "snap"
+    dest.mkdir()
+    monkeypatch.setattr(shutil, "rmtree", lambda p, **kw: None)  # racer
+    with pytest.raises(FileExistsError):
+        fresh_dir(dest)
+
+
+def test_studies_runner_still_reexports_atomic_write_json():
+    """The implementation moved to utils/fsio when the discipline went
+    repo-wide; studies/runner.py re-exports it for existing importers."""
+    from rl_scheduler_tpu.studies import runner
+
+    assert runner.atomic_write_json is atomic_write_json

@@ -1090,3 +1090,19 @@ def test_fleet_soak_union_feeds_one_loop_iteration(tmp_path):
     assert "dry-run" in reason or "verdict" in reason
     if "dry-run" in reason:
         assert summary["promote"]["would_promote"] == summary["candidate"]
+
+
+def test_fleet_server_handler_threads_are_joinable(tmp_path, fakes):
+    """The GL017 fix pinned: ThreadingHTTPServer defaults
+    ``daemon_threads = True``, which lets server_close() abandon
+    in-flight scrapes mid-write on shutdown. The fleet server must keep
+    handler threads non-daemon so shutdown() + server_close() DRAINS
+    them (the same drain contract the pool server documents)."""
+    from rl_scheduler_tpu.scheduler.fleet import _make_fleet_server
+
+    controller, _ = _controller(tmp_path, fakes(1))
+    server = _make_fleet_server(controller, "127.0.0.1", 0)
+    try:
+        assert server.daemon_threads is False
+    finally:
+        server.server_close()

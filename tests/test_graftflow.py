@@ -1,0 +1,317 @@
+"""Units for the graftflow dataflow tier (tools/graftlint/flow).
+
+The GL013–GL017 rule pack rides on three small analyses: intra-scope
+def-use chains with a string lattice (``defuse.py``), canonical path
+expressions, and execution-context tagging (``context.py``). These
+tests pin each analysis in isolation — the fixture-driven tests in
+test_graftlint.py only prove the composed rules, so a regression here
+would otherwise surface as an opaque fixture-count mismatch.
+
+Everything is pure-AST (ast.parse on inline sources); no JAX import,
+so the suite costs milliseconds and is identical on both JAX versions.
+"""
+from __future__ import annotations
+
+import ast
+import textwrap
+from pathlib import Path
+
+from tools.graftlint.engine import Module
+from tools.graftlint.rules import load_rules
+from tools.graftlint.flow import (
+    DefUse,
+    flows_through,
+    literal_strings,
+    module_contexts,
+    path_expr,
+    scope_statements,
+)
+
+
+def _module(source: str, rel: str = "scheduler/mod.py") -> Module:
+    src = textwrap.dedent(source)
+    return Module(Path(rel), rel, src, known_rules=set(load_rules()))
+
+
+def _fn(source: str) -> ast.FunctionDef:
+    tree = ast.parse(textwrap.dedent(source))
+    node = tree.body[0]
+    assert isinstance(node, ast.FunctionDef)
+    return node
+
+
+def _expr(source: str) -> ast.AST:
+    return ast.parse(source, mode="eval").body
+
+
+# ---------------------------------------------------------------- DefUse
+
+
+def test_defuse_reassignment_picks_newest_binding():
+    fn = _fn(
+        """
+        def f():
+            p = a
+            p = b
+            use(p)
+        """
+    )
+    du = DefUse(fn)
+    # Two bindings recorded in line order (the dedented source has a
+    # leading blank line: def on 2, bindings on 3 and 4, use on 5);
+    # value_at resolves the reaching definition for any later use line.
+    assert [v.id for v in du.values("p")] == ["a", "b"]
+    assert du.value_at("p", 5).id == "b"
+    # A use between the bindings sees only the first one.
+    assert du.value_at("p", 3).id == "a"
+    # Before any binding: no reaching definition.
+    assert du.value_at("p", 2) is None
+
+
+def test_defuse_loop_carried_binding_resolves_to_iterable():
+    fn = _fn(
+        """
+        def f(paths):
+            for p in paths:
+                touch(p)
+        """
+    )
+    du = DefUse(fn)
+    (value,) = du.values("p")
+    assert isinstance(value, ast.Name) and value.id == "paths"
+
+
+def test_defuse_with_tuple_and_walrus_bindings():
+    fn = _fn(
+        """
+        def f():
+            with open(src) as fh:
+                a, b = pair()
+        """
+    )
+    du = DefUse(fn)
+    (with_value,) = du.values("fh")
+    assert isinstance(with_value, ast.Call)  # the context expression
+    # Tuple targets: each element bound to the whole right-hand side.
+    assert isinstance(du.values("a")[0], ast.Call)
+    assert isinstance(du.values("b")[0], ast.Call)
+
+
+def test_defuse_module_scope_and_scope_statements():
+    tree = ast.parse("x = 1\ny = x\n")
+    du = DefUse(tree)
+    assert du.value_at("y", 2).id == "x"
+    assert [s.lineno for s in scope_statements(tree)] == [1, 2]
+
+
+def test_defuse_skips_nested_function_bodies():
+    fn = _fn(
+        """
+        def f():
+            p = outer
+            def g():
+                p = inner
+            return p
+        """
+    )
+    du = DefUse(fn)
+    # g's rebinding is a different scope; it must not shadow f's chain.
+    assert [v.id for v in du.values("p")] == ["outer"]
+
+
+# ------------------------------------------------------------- path_expr
+
+
+def test_path_expr_canonical_forms():
+    assert path_expr(_expr("dest")) == "dest"
+    assert path_expr(_expr("self._queue")) == "self._queue"
+    assert path_expr(_expr("qdir / name")) == "(qdir/name)"
+    assert path_expr(_expr("cache['run']")) == "cache['run']"
+
+
+def test_path_expr_unwraps_path_transparent_calls():
+    # A check on `p` must match an act on `str(p)` / `Path(p)` /
+    # `p.resolve()` — wrappers canonicalize to their operand.
+    assert path_expr(_expr("str(p)")) == "p"
+    assert path_expr(_expr("Path(p)")) == "p"
+    assert path_expr(_expr("p.resolve()")) == "p"
+    assert path_expr(_expr("os.fspath(p)")) == "p"
+
+
+def test_path_expr_parent_is_a_different_path():
+    assert path_expr(_expr("p.parent")) == "p.parent"
+    assert path_expr(_expr("p.parent")) != path_expr(_expr("p"))
+
+
+def test_path_expr_unstable_identity_is_none():
+    # Call results have no stable identity: never-matching, not a guess.
+    assert path_expr(_expr("make_path()")) is None
+    assert path_expr(_expr("a @ b")) is None
+
+
+# ------------------------------------------------------- literal_strings
+
+
+def test_literal_strings_fstring_and_concat():
+    assert literal_strings(_expr("f'{stem}.json'")) == {".json"}
+    assert literal_strings(_expr("base + '.tmp'")) == {".tmp"}
+    assert literal_strings(_expr("Path('out') / name")) == {"out"}
+
+
+def test_literal_strings_follows_defuse_hops():
+    fn = _fn(
+        """
+        def f(dest):
+            name = f"{dest.stem}.json"
+            target = dest / name
+            write(target)
+        """
+    )
+    du = DefUse(fn)
+    target = du.value_at("target", 4)
+    assert ".json" in literal_strings(target, du)
+
+
+def test_literal_strings_lineno_resolves_reaching_definition():
+    fn = _fn(
+        """
+        def f():
+            suffix = ".tmp"
+            suffix = ".json"
+            use(suffix)
+        """
+    )
+    du = DefUse(fn)
+    probe = _expr("suffix")
+    # At the use line only the newest binding reaches...
+    assert literal_strings(probe, du, lineno=4) == {".json"}
+    # ...while the un-pinned query is a may-analysis over all bindings.
+    assert literal_strings(probe, du) == {".tmp", ".json"}
+
+
+def test_literal_strings_hop_bound_terminates():
+    fn = _fn(
+        """
+        def f():
+            a = ".json"
+            b = a
+            c = b
+            d = c
+            use(d)
+        """
+    )
+    du = DefUse(fn)
+    # d -> c -> b -> a is 4 hops; the 3-hop bound stops at `a`'s Name.
+    assert literal_strings(_expr("d"), du) == set()
+    assert literal_strings(_expr("c"), du) == {".json"}
+
+
+# --------------------------------------------------------- flows_through
+
+
+def test_flows_through_direct_and_via_defuse():
+    fn = _fn(
+        """
+        def f():
+            fd = os.open(path, os.O_WRONLY | os.O_EXCL)
+            handle = fd
+            write(handle)
+        """
+    )
+    du = DefUse(fn)
+    assert flows_through(du.value_at("fd", 3), {"O_EXCL"})
+    # Transitively through the def-use hop handle -> fd.
+    assert flows_through(_expr("handle"), {"O_EXCL"}, du)
+    assert not flows_through(_expr("handle"), {"mkstemp"}, du)
+
+
+# ------------------------------------------------------- module_contexts
+
+
+def test_context_handler_tags_transitive_subclasses():
+    module = _module(
+        """
+        from http.server import BaseHTTPRequestHandler
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                pass
+
+        class MetricsHandler(Handler):
+            def do_POST(self):
+                pass
+
+        def helper():
+            pass
+        """
+    )
+    tags = module_contexts(module)
+    assert "handler" in tags["Handler.do_GET"]
+    # Transitive: a subclass of a local handler subclass is one too.
+    assert "handler" in tags["MetricsHandler.do_POST"]
+    assert tags["helper"] == frozenset({"main"})
+
+
+def test_context_thread_process_and_executor_seams():
+    module = _module(
+        """
+        import threading
+        import multiprocessing
+
+        def writer():
+            pass
+
+        def worker():
+            pass
+
+        def hop():
+            pass
+
+        def later():
+            pass
+
+        def start(pool, loop):
+            t = threading.Thread(target=writer, daemon=True)
+            p = multiprocessing.Process(target=worker)
+            pool.submit(hop, 1)
+            loop.run_in_executor(None, later)
+            t.start()
+        """
+    )
+    tags = module_contexts(module)
+    assert "thread" in tags["writer"]
+    assert "forked-worker" in tags["worker"]
+    assert "executor" in tags["hop"]
+    assert "executor" in tags["later"]
+    # The constructing function owns the lifecycle: supervisor.
+    assert "supervisor" in tags["start"]
+    # Seam tags do not leak onto the supervisor itself.
+    assert "thread" not in tags["start"]
+
+
+def test_context_async_and_nested_inheritance():
+    module = _module(
+        """
+        import threading
+
+        async def serve():
+            pass
+
+        def run():
+            t = threading.Thread(target=drain)
+            t.start()
+
+        def drain():
+            def flush():
+                pass
+            flush()
+        """
+    )
+    tags = module_contexts(module)
+    assert "async" in tags["serve"]
+    # A closure defined in a thread-target executes on that thread...
+    assert "thread" in tags["drain"]
+    assert "thread" in tags["drain.flush"]
+    # ...but "supervisor" describes the parent's OWN body only.
+    assert "supervisor" in tags["run"]
+    assert "supervisor" not in tags["drain.flush"]

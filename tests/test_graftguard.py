@@ -1182,3 +1182,19 @@ class TestGraftrollChaos:
                                 tp._filter_args(0))["nodenames"]) == 1
         finally:
             pool.shutdown()
+
+
+def test_quarantine_tolerates_concurrent_move(tmp_path):
+    """The GL014 fix pinned: two restore paths can race to quarantine
+    the same corrupt step. The loser's moves find the evidence already
+    gone — that is success (the evidence IS preserved, by the winner),
+    not a crash in the restore path."""
+    mgr = CheckpointManager(tmp_path)
+    mgr.save(1, SMALL_TREE, wait=True)
+    dest = mgr.quarantine(1, "race-winner")
+    assert dest.exists()
+    # The racing loser: step dir and manifest were already moved.
+    dest2 = mgr.quarantine(1, "race-loser")
+    assert not dest2.exists()  # nothing left to move — and no raise
+    assert dest.exists()
+    mgr.close()

@@ -240,3 +240,49 @@ def test_reset_stats_never_clears_trace_counters(tmp_path):
     assert "rl_scheduler_extender_trace_dropped_total 0" in metrics
     assert "rl_scheduler_extender_fail_open_total 0" in metrics
     log.close()
+
+
+def test_close_with_wedged_writer_leaves_part_for_recovery(
+        tmp_path, caplog):
+    """The GL017 drain contract: close() joins the writer with a
+    timeout, and when the join VERDICT says the thread is still alive
+    (write(2) wedged on a dying mount), it must NOT seal the active
+    segment — the writer still owns the file handle, and sealing under
+    it would race its next write. The .part is left for the next
+    startup's recovery, which is the crash path that already works."""
+    import logging
+    import time
+
+    log = TraceLog(tmp_path, max_records_per_segment=100)
+    log.append({"i": 1})
+    deadline = time.monotonic() + 5.0
+    while log.snapshot()["written_total"] < 1:
+        assert time.monotonic() < deadline, "writer never drained"
+        time.sleep(0.01)
+    assert list(tmp_path.glob("*.jsonl.part"))
+
+    class _Wedged:
+        """Stands in for a writer blocked in write(2): join times out,
+        is_alive stays True."""
+
+        def join(self, timeout=None):
+            pass
+
+        def is_alive(self):
+            return True
+
+    real_thread = log._thread
+    log._thread = _Wedged()
+    with caplog.at_level(logging.ERROR,
+                         logger="rl_scheduler_tpu.scheduler.tracelog"):
+        log.close()
+    assert any("still alive" in r.message for r in caplog.records)
+    # Not sealed: the segment is still a .part, owned by the writer.
+    assert list(tmp_path.glob("*.jsonl.part"))
+    assert not list(tmp_path.glob("*.jsonl"))
+    real_thread.join(timeout=5.0)  # the real writer drained the sentinel
+
+    # Startup recovery seals it — the record is never lost.
+    log2 = TraceLog(tmp_path)
+    log2.close()
+    assert [r["i"] for r in iter_trace(tmp_path)] == [1]

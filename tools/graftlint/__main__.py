@@ -5,13 +5,28 @@
     python -m tools.graftlint [paths ...] [--json] [--list-rules]
                               [--select GL001,GL002] [--disable GL007]
                               [--show-suppressed] [--check]
+                              [--sarif out.sarif] [--audit-suppressions]
 
 With no paths, lints the ``[tool.graftlint]`` paths from pyproject.toml
-(falling back to the repo defaults). Exit status is 0 when no unsuppressed
-finding remains, 1 otherwise — ``--check`` is an explicit alias for that
-default so ``make lint`` reads honestly. Suppressed findings are counted
+(falling back to the repo defaults). Suppressed findings are counted
 in the summary (and listed with ``--show-suppressed``) so deliberate
 boundary cases stay visible without failing the gate.
+
+Exit codes (``make lint`` relies on these):
+
+- **0** — no unsuppressed error-severity finding (warn-severity findings
+  are printed but do not gate: the ``[tool.graftlint.severity]``
+  warn-first landing lane), and no stale suppression when
+  ``--audit-suppressions`` is on.
+- **1** — at least one unsuppressed error-severity finding, or (under
+  ``--audit-suppressions``) a justified suppression whose rule no longer
+  fires on its line.
+- **2** — usage error (argparse).
+
+``--check`` is an explicit alias for the default gate behavior so
+``make lint`` reads honestly. ``--sarif PATH`` additionally writes the
+findings as a SARIF 2.1.0 document for CI annotation (suppressed
+findings carry ``suppressions: [{kind: "inSource"}]``).
 """
 
 from __future__ import annotations
@@ -48,7 +63,13 @@ def main(argv: list | None = None) -> int:
                         help="also print suppressed findings")
     parser.add_argument("--check", action="store_true",
                         help="explicit gate mode (the default behavior): "
-                             "exit 1 on any unsuppressed finding")
+                             "exit 1 on any unsuppressed error finding")
+    parser.add_argument("--sarif", default=None, metavar="PATH",
+                        help="also write findings as a SARIF 2.1.0 "
+                             "document to PATH")
+    parser.add_argument("--audit-suppressions", action="store_true",
+                        help="fail (exit 1) on justified suppressions "
+                             "whose rule no longer fires on their line")
     parser.add_argument("--config", default=None,
                         help="path to a pyproject.toml (default: ./pyproject.toml)")
     args = parser.parse_args(argv)
@@ -82,23 +103,35 @@ def main(argv: list | None = None) -> int:
     paths = args.paths or list(config.paths)
     result = lint_paths(paths, config)
 
+    if args.sarif:
+        from tools.graftlint.sarif import write_sarif
+
+        write_sarif(result, args.sarif)
+
+    stale = result.stale_suppressions if args.audit_suppressions else []
     if args.as_json:
         print(json.dumps({
             "files_checked": result.files_checked,
             "unsuppressed": [f.to_dict() for f in result.unsuppressed],
             "suppressed": [f.to_dict() for f in result.suppressed],
+            "stale_suppressions": [
+                f.to_dict() for f in result.stale_suppressions],
         }, indent=2))
     else:
         shown = result.findings if args.show_suppressed else result.unsuppressed
         for f in shown:
             print(f.format())
+        for f in stale:
+            print(f.format())
         print(
-            f"graftlint: {len(result.unsuppressed)} finding(s), "
-            f"{len(result.suppressed)} suppressed, "
+            f"graftlint: {len(result.errors)} error(s), "
+            f"{len(result.warnings)} warning(s), "
+            f"{len(result.suppressed)} suppressed "
+            f"({len(result.stale_suppressions)} stale), "
             f"{result.files_checked} file(s) checked",
             file=sys.stderr,
         )
-    return 1 if result.unsuppressed else 0
+    return 1 if (result.errors or stale) else 0
 
 
 if __name__ == "__main__":

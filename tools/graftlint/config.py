@@ -12,6 +12,9 @@ Keys (all optional — defaults are this repo's layout)::
     [tool.graftlint.per-path-ignore]            # glob -> rule ids
     "loadgen/*" = ["GL007"]
 
+    [tool.graftlint.severity]       # rule id -> "error" (default) | "warn"
+    GL018 = "warn"                  # warn-first landing lane for new rules
+
 TOML parsing uses stdlib ``tomllib`` when available (3.11+) and falls back
 to ``tomli`` (the container's 3.10); with neither present the defaults
 apply and a note goes to stderr — the analyzer itself never needs more
@@ -37,6 +40,15 @@ class LintConfig:
     test_paths: tuple = DEFAULT_TEST_PATHS
     disable: tuple = ()
     per_path_ignore: dict = dataclasses.field(default_factory=dict)
+    severity: dict = dataclasses.field(default_factory=dict)
+
+    def severity_for(self, rule_id: str) -> str:
+        """Per-rule severity: "error" unless the config demotes to "warn".
+
+        Warn findings are printed but never fail the gate — the lane a
+        new rule lands in while its false-positive rate is unproven."""
+        level = self.severity.get(rule_id, "error")
+        return "warn" if level == "warn" else "error"
 
     def rules_ignored_for(self, rel: str) -> set:
         ignored: set = set()
@@ -82,4 +94,5 @@ def load_config(pyproject: Path | str | None = None) -> LintConfig:
             k: tuple(v)
             for k, v in section.get("per-path-ignore", {}).items()
         },
+        severity=dict(section.get("severity", {})),
     )

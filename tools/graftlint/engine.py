@@ -66,10 +66,12 @@ class Finding:
     line: int
     message: str
     suppressed: bool = False
+    severity: str = "error"  # "error" | "warn", from [tool.graftlint.severity]
 
     def format(self) -> str:
         tag = " (suppressed)" if self.suppressed else ""
-        return f"{self.path}:{self.line}: {self.rule} {self.message}{tag}"
+        sev = " [warn]" if self.severity == "warn" else ""
+        return f"{self.path}:{self.line}: {self.rule}{sev} {self.message}{tag}"
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -79,6 +81,11 @@ class Finding:
 class LintResult:
     findings: list  # list[Finding], sorted by (path, line, rule)
     files_checked: int
+    # Justified suppressions whose rule no longer fires on the covered
+    # line(s): the justification outlived the code it excused. Reported
+    # as Findings (rule GL000) but kept OUT of ``findings`` — they are
+    # the audit's verdict, never themselves suppressible.
+    stale_suppressions: list = dataclasses.field(default_factory=list)
 
     @property
     def unsuppressed(self) -> list:
@@ -87,6 +94,16 @@ class LintResult:
     @property
     def suppressed(self) -> list:
         return [f for f in self.findings if f.suppressed]
+
+    @property
+    def errors(self) -> list:
+        """Unsuppressed findings at error severity — what gates exit 1."""
+        return [f for f in self.unsuppressed if f.severity != "warn"]
+
+    @property
+    def warnings(self) -> list:
+        """Unsuppressed findings at warn severity — printed, never gate."""
+        return [f for f in self.unsuppressed if f.severity == "warn"]
 
 
 def _comment_lines(source: str, lines: list) -> Iterator:
@@ -648,5 +665,52 @@ def lint_paths(paths: Iterable, config: "LintConfig | None" = None,
                 )
                 findings.append(finding)
 
+    for finding in findings:
+        finding.severity = config.severity_for(finding.rule)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    return LintResult(findings=findings, files_checked=len(files))
+    stale = _audit_suppressions(modules, findings, enabled, config)
+    return LintResult(findings=findings, files_checked=len(files),
+                      stale_suppressions=stale)
+
+
+def _audit_suppressions(modules: list, findings: list, enabled: list,
+                        config) -> list:
+    """Justified suppressions whose rule no longer fires where they point.
+
+    A line suppression for rule R at line S is stale when R actually RAN
+    for that module (enabled, not per-path-ignored — a suppression for a
+    rule the config skipped is unverifiable, not stale) and no R finding
+    landed at S or S+1 (the two lines ``covers`` serves). A disable-file
+    suppression is stale when R fires nowhere in the module. Stale
+    entries are deliberate gate-failures: a justification whose target
+    healed is a silenced alarm nobody will re-arm.
+    """
+    fired: dict = {}  # (rel, rule) -> set of lines
+    for f in findings:
+        fired.setdefault((f.path, f.rule), set()).add(f.line)
+    stale: list = []
+    for module in modules:
+        ignored_here = config.rules_ignored_for(module.rel)
+        ran = {r.id for r in enabled if r.id not in ignored_here} | {"GL000"}
+        for lineno, rules in sorted(module.suppressions.line_rules.items()):
+            for rule in sorted(rules):
+                if rule not in ran:
+                    continue
+                lines = fired.get((module.rel, rule), set())
+                if lineno not in lines and lineno + 1 not in lines:
+                    stale.append(Finding(
+                        "GL000", module.rel, lineno,
+                        f"stale suppression: {rule} no longer fires on "
+                        f"this line — the code it excused is gone; delete "
+                        f"the disable comment (audit)"))
+        for rule in sorted(module.suppressions.file_rules):
+            if rule not in ran:
+                continue
+            if not fired.get((module.rel, rule)):
+                stale.append(Finding(
+                    "GL000", module.rel, 1,
+                    f"stale suppression: disable-file={rule} but {rule} "
+                    f"fires nowhere in this file; delete the disable "
+                    f"comment (audit)"))
+    stale.sort(key=lambda f: (f.path, f.line, f.rule))
+    return stale

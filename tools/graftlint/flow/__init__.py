@@ -1,0 +1,40 @@
+"""graftflow: the intra-function dataflow tier under graftlint.
+
+The GL001–GL012 rules are per-statement matchers; the concurrency/
+atomicity defect classes the review rounds kept re-catching (CHANGES.md
+PRs 4–17) all require tracking a VALUE across statements: the path
+expression that was ``exists()``-checked and then ``rmtree``'d, the file
+handle that was opened ``"w"`` and then ``json.dump``'ed into, the
+daemon thread handle that a ``close()`` joins, the one shared breaker
+instance that two endpoint keys reach. graftflow provides exactly that
+much dataflow — no more:
+
+- :mod:`tools.graftlint.flow.defuse` — def-use chains over simple
+  names within one scope, canonical path expressions for
+  attribute/subscript roots, and a small string-constant lattice good
+  enough to answer "does this path expression name a ``.json``
+  artifact?" / "does this value flow from ``tempfile``/``O_EXCL``?".
+- :mod:`tools.graftlint.flow.context` — a class-level execution-context
+  model tagging each function with the thread/process/event-loop it
+  runs on, derived from the known entry seams (HTTP handler classes,
+  ``threading.Thread(target=...)``, fork supervisor vs forked child,
+  ``async def`` on the front's loop vs nested sync helpers on the
+  executor).
+
+Everything stays pure-AST (``ast`` only), same as the engine: the
+analyzer behaves identically on the container's CPU JAX and the
+driver's TPU JAX, because it never imports either.
+"""
+
+from tools.graftlint.flow.context import (  # noqa: F401
+    CONTEXTS,
+    module_contexts,
+)
+from tools.graftlint.flow.defuse import (  # noqa: F401
+    DefUse,
+    flows_through,
+    literal_strings,
+    path_expr,
+    scope_statements,
+    scope_walk,
+)

@@ -1,0 +1,147 @@
+"""Execution-context tagging: which thread/process/loop runs a function.
+
+The concurrency rule pack needs to know WHERE code runs before it can
+say what discipline applies: a write inside an HTTP handler races with
+its siblings, a ``close()`` on the supervisor thread owns the drain
+contract, an ``async def`` body must not block the front's event loop.
+None of that is spelled in the function — it is spelled at the *entry
+seams*, and this repo has a small closed set of them:
+
+- HTTP/socketserver handler classes (``BaseHTTPRequestHandler``
+  subclasses — graftserve/graftfleet's request paths), where every
+  ``do_*``/``handle*`` method runs on a per-connection daemon thread;
+- ``threading.Thread(target=...)`` construction sites (tracelog's
+  writer, the async placer, fleet scrape fan-out);
+- ``multiprocessing``/fork worker targets (the pool's forked workers);
+- ``async def`` (graftfront's event loop) and
+  ``run_in_executor``/``Executor.submit`` seams (sync helpers hopped
+  onto executor threads);
+- everything else: the supervisor/main context that constructs and
+  joins the above.
+
+:func:`module_contexts` derives a per-function tag set from those seams
+in one module pass. Tags are a may-analysis — a function referenced by
+two seams carries both tags — and lexical nesting inherits the parent's
+context (a closure defined on the writer thread runs on the writer
+thread).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import dotted_last
+
+# The closed tag vocabulary. "main" is the default (module import /
+# supervisor call chain); "supervisor" additionally marks functions that
+# CONSTRUCT threads/processes/servers and therefore own drain contracts.
+CONTEXTS = frozenset({
+    "main", "handler", "async", "thread", "forked-worker",
+    "executor", "supervisor",
+})
+
+# Base classes whose subclasses' methods run per-connection, usually on
+# daemon threads owned by a ThreadingMixIn server.
+_HANDLER_BASES = frozenset({
+    "BaseHTTPRequestHandler", "SimpleHTTPRequestHandler",
+    "BaseRequestHandler", "StreamRequestHandler", "DatagramRequestHandler",
+})
+
+# Server/executor types whose construction marks the enclosing function
+# as a supervisor (it owns lifecycle for some other context).
+_SUPERVISED_TYPES = frozenset({
+    "Thread", "Process", "ThreadPoolExecutor", "ProcessPoolExecutor",
+    "ThreadingHTTPServer", "HTTPServer", "TCPServer", "UDPServer",
+})
+
+
+def _target_names(call: ast.Call) -> list:
+    """Bare names a Thread/Process ``target=``/``submit`` seam invokes."""
+    out = []
+    for kw in call.keywords:
+        if kw.arg == "target":
+            name = dotted_last(kw.value)
+            if name:
+                out.append(name)
+    return out
+
+
+def module_contexts(module) -> dict:
+    """``qualname -> frozenset(tags)`` for every function in ``module``.
+
+    ``module`` is an engine :class:`~tools.graftlint.engine.Module`.
+    Every function gets at least ``{"main"}``; seam-derived tags are
+    added on top, then lexical nesting inherits the parent's tags.
+    """
+    tags: dict = {rec.qualname: {"main"} for rec in module.functions}
+
+    def add(name: str, tag: str) -> None:
+        for rec in module.records_named(name):
+            tags[rec.qualname].add(tag)
+
+    # Seam 1: handler classes. Transitive within the module: a subclass
+    # of a local handler subclass is a handler class too.
+    handler_classes: set = set()
+    class_bases: dict = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ClassDef):
+            bases = {dotted_last(b) for b in node.bases} - {None}
+            class_bases[node.name] = bases
+            if bases & _HANDLER_BASES:
+                handler_classes.add(node.name)
+    changed = True
+    while changed:
+        changed = False
+        for cls, bases in class_bases.items():
+            if cls not in handler_classes and bases & handler_classes:
+                handler_classes.add(cls)
+                changed = True
+    for rec in module.functions:
+        cls = rec.qualname.rsplit(".", 1)[0] if "." in rec.qualname else None
+        if cls in handler_classes:
+            tags[rec.qualname].add("handler")
+
+    # Seam 2: async defs run on the event loop.
+    for rec in module.functions:
+        if isinstance(rec.node, ast.AsyncFunctionDef):
+            tags[rec.qualname].add("async")
+
+    # Seams 3–5: construction/submission sites, one walk.
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_last(node.func)
+        if callee == "Thread":
+            for name in _target_names(node):
+                add(name, "thread")
+        elif callee == "Process":
+            for name in _target_names(node):
+                add(name, "forked-worker")
+        elif callee == "submit" and node.args:
+            name = dotted_last(node.args[0])
+            if name:
+                add(name, "executor")
+        elif callee == "run_in_executor" and len(node.args) >= 2:
+            name = dotted_last(node.args[1])
+            if name:
+                add(name, "executor")
+
+    # Supervisor: a function whose own body constructs a supervised type
+    # owns lifecycle for another context.
+    from tools.graftlint.engine import walk_own
+
+    for rec in module.functions:
+        for node in walk_own(rec.node):
+            if isinstance(node, ast.Call) and \
+                    dotted_last(node.func) in _SUPERVISED_TYPES:
+                tags[rec.qualname].add("supervisor")
+                break
+
+    # Lexical nesting inherits: a closure defined in a thread-target
+    # executes on that thread (minus "supervisor", which is about the
+    # parent's own body).
+    for rec in module.functions:  # outer-to-inner indexing order
+        if rec.parent is not None:
+            tags[rec.qualname] |= tags[rec.parent.qualname] - {"supervisor"}
+
+    return {q: frozenset(t) for q, t in tags.items()}

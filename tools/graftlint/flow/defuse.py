@@ -1,0 +1,265 @@
+"""Def-use chains, canonical path expressions, and the string lattice.
+
+The unit of analysis is one *scope* — a function body (via the engine's
+``iter_own_statements``, which recurses into compound statements but not
+nested defs) or a whole module's top-level statements. Within a scope,
+:class:`DefUse` records every binding of every simple name in line
+order, so a rule can ask "what value reached ``dest`` by line 96?" and
+follow it backwards a bounded number of hops.
+
+Three deliberately-small abstractions ride on top:
+
+- :func:`path_expr` — a canonical string for a path-like expression
+  (``self._queue``, ``dest``, ``qdir / str(step)``), used to decide
+  "is this the same path expression that was checked?" Textual identity
+  over one scope is the right granularity for the TOCTOU class: the
+  review-round defects were literally check-then-act on the same
+  spelled expression.
+- :func:`literal_strings` — the value lattice's string facet: every
+  string constant reachable in an expression (through f-strings,
+  ``+``/``/`` concatenation, ``Path(...)``/``str(...)`` wrappers, and
+  def-use hops), so a rule can ask "does this path name a ``.json``
+  artifact?" or "is there a ``.tmp`` marker in this name?".
+- :func:`flows_through` — "does this value's construction involve a
+  call to one of these names?" (``tempfile``/``mkstemp``/``O_EXCL``
+  handling, ``Thread(daemon=True)`` construction).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from tools.graftlint.engine import dotted_last, iter_own_statements, walk_own
+
+# Call wrappers that are path-transparent: the path identity of
+# ``str(p)`` / ``Path(p)`` is the identity of ``p``.
+_PATH_WRAPPERS = frozenset({"str", "Path", "PurePath", "PosixPath",
+                            "fspath", "abspath", "resolve", "absolute"})
+
+_MAX_HOPS = 3  # def-use resolution depth bound (keeps the lattice O(1))
+
+
+def _shell(node: ast.AST) -> ast.AST:
+    """A function-shaped wrapper so engine scope walks accept Modules."""
+    if not isinstance(node, ast.Module):
+        return node
+    return ast.FunctionDef(
+        name="<module>", args=ast.arguments(
+            posonlyargs=[], args=[], kwonlyargs=[], kw_defaults=[],
+            defaults=[]),
+        body=node.body, decorator_list=[], returns=None,
+    )
+
+
+def scope_statements(node: ast.AST) -> Iterator[ast.stmt]:
+    """Line-ordered own statements of a function OR module scope."""
+    yield from iter_own_statements(_shell(node))
+
+
+def scope_walk(node: ast.AST) -> Iterator[ast.AST]:
+    """All AST nodes of a scope's own statements (no nested defs) — the
+    module-capable sibling of the engine's ``walk_own``."""
+    yield from walk_own(_shell(node))
+
+
+class DefUse:
+    """Intra-scope def-use chains over simple names.
+
+    Bindings are recorded in line order for ``Assign``/``AnnAssign``/
+    ``AugAssign``, ``for`` targets (the loop-carried case: the binding's
+    value is the iterable), and ``with ... as`` targets (value = the
+    context expression). Tuple targets record each element against the
+    whole right-hand side — coarse, but sound for the string/flow
+    queries rules make.
+    """
+
+    def __init__(self, scope: ast.AST):
+        self.bindings: dict = {}  # name -> [(lineno, value_node)]
+        for stmt in scope_statements(scope):
+            for name, value in _stmt_bindings(stmt):
+                if value is not None:
+                    self.bindings.setdefault(name, []).append(
+                        (stmt.lineno, value))
+
+    def values(self, name: str) -> list:
+        """Every value node ever bound to ``name`` in this scope."""
+        return [v for _, v in self.bindings.get(name, [])]
+
+    def value_at(self, name: str, lineno: int) -> ast.AST | None:
+        """The value of the LAST binding of ``name`` at or before
+        ``lineno`` (the reaching definition, straight-line approximation
+        — reassignment picks the newest, loop-carried bindings resolve
+        to the iterable)."""
+        best = None
+        for bound_line, value in self.bindings.get(name, []):
+            if bound_line <= lineno:
+                best = value
+        return best
+
+
+def _stmt_bindings(stmt: ast.stmt) -> Iterator:
+    """(name, value_node) pairs bound by one statement."""
+
+    def targets_of(t) -> Iterator[str]:
+        if isinstance(t, ast.Name):
+            yield t.id
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                yield from targets_of(e)
+        elif isinstance(t, ast.Starred):
+            yield from targets_of(t.value)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            for name in targets_of(t):
+                yield name, stmt.value
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        for name in targets_of(stmt.target):
+            yield name, stmt.value
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        for name in targets_of(stmt.target):
+            yield name, stmt.iter
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                for name in targets_of(item.optional_vars):
+                    yield name, item.context_expr
+    elif isinstance(stmt, ast.NamedExpr):  # walrus at statement level
+        for name in targets_of(stmt.target):
+            yield name, stmt.value
+
+
+def path_expr(node: ast.AST) -> str | None:
+    """Canonical textual identity of a path-like expression.
+
+    ``None`` means "no stable identity" (a call result, a literal-free
+    computation) — rules treat that as never-matching rather than
+    guessing. Path-transparent wrappers (``str(p)``, ``Path(p)``,
+    ``p.resolve()``) canonicalize to their operand so a check on ``p``
+    matches an act on ``str(p)``.
+    """
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        if node.attr in ("parent",):  # p.parent is a DIFFERENT path
+            base = path_expr(node.value)
+            return f"{base}.parent" if base else None
+        base = path_expr(node.value)
+        return f"{base}.{node.attr}" if base else None
+    if isinstance(node, ast.Subscript):
+        base = path_expr(node.value)
+        if base is None:
+            return None
+        if isinstance(node.slice, ast.Constant):
+            return f"{base}[{node.slice.value!r}]"
+        inner = path_expr(node.slice)
+        return f"{base}[{inner}]" if inner else None
+    if isinstance(node, ast.Call):
+        callee = dotted_last(node.func)
+        if callee in _PATH_WRAPPERS:
+            if node.args:
+                return path_expr(node.args[0])
+            # p.resolve() / p.absolute(): identity of the receiver
+            if isinstance(node.func, ast.Attribute):
+                return path_expr(node.func.value)
+        return None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Div, ast.Add)):
+        left, right = path_expr(node.left), path_expr(node.right)
+        if left and right:
+            return f"({left}/{right})"
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return repr(node.value)
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(repr(v.value))
+            else:
+                inner = path_expr(
+                    v.value if isinstance(v, ast.FormattedValue) else v)
+                if inner is None:
+                    return None
+                parts.append(inner)
+        return "+".join(parts)
+    return None
+
+
+def literal_strings(node: ast.AST, defuse: DefUse | None = None,
+                    lineno: int | None = None,
+                    _hops: int = _MAX_HOPS) -> set:
+    """Every string constant reachable in ``node``'s construction.
+
+    Follows f-string parts, ``+``/``/`` concatenation, call arguments
+    (``Path("x") / name`` and formatting helpers alike), and — when a
+    :class:`DefUse` is given — up to ``_MAX_HOPS`` def-use hops through
+    simple names (resolved at ``lineno`` when given, else every binding
+    contributes: the lattice is a may-analysis).
+    """
+    out: set = set()
+
+    def visit(n: ast.AST, hops: int) -> None:
+        if isinstance(n, ast.Constant):
+            if isinstance(n.value, str):
+                out.add(n.value)
+            return
+        if isinstance(n, ast.Name):
+            if defuse is not None and hops > 0:
+                if lineno is not None:
+                    value = defuse.value_at(n.id, lineno)
+                    values = [value] if value is not None else []
+                else:
+                    values = defuse.values(n.id)
+                for v in values:
+                    visit(v, hops - 1)
+            return
+        if isinstance(n, ast.JoinedStr):
+            for v in n.values:
+                visit(v, hops)
+            return
+        if isinstance(n, ast.FormattedValue):
+            visit(n.value, hops)
+            return
+        if isinstance(n, (ast.BinOp, ast.Call, ast.Attribute, ast.Subscript,
+                          ast.Tuple, ast.List, ast.IfExp, ast.NamedExpr)):
+            for child in ast.iter_child_nodes(n):
+                visit(child, hops)
+            return
+
+    visit(node, _hops)
+    return out
+
+
+def flows_through(node: ast.AST, call_names: Iterable[str],
+                  defuse: DefUse | None = None,
+                  _hops: int = _MAX_HOPS) -> bool:
+    """Whether ``node``'s construction involves a call to (or attribute
+    read of) one of ``call_names`` — transitively through def-use hops.
+
+    Answers the lattice's provenance questions: "does this handle flow
+    from ``tempfile``?", "is ``O_EXCL`` in this open's flag
+    expression?", "was this thread constructed ``daemon=True``?".
+    """
+    names = set(call_names)
+
+    def visit(n: ast.AST, hops: int) -> bool:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Call) and dotted_last(sub.func) in names:
+                return True
+            if isinstance(sub, ast.Attribute) and sub.attr in names:
+                return True
+            if isinstance(sub, ast.Name):
+                if sub.id in names:
+                    return True
+                if defuse is not None and hops > 0 and sub is not n:
+                    for v in defuse.values(sub.id):
+                        if visit(v, hops - 1):
+                            return True
+        if isinstance(n, ast.Name) and defuse is not None and hops > 0:
+            for v in defuse.values(n.id):
+                if visit(v, hops - 1):
+                    return True
+        return False
+
+    return visit(node, _hops)

@@ -51,6 +51,7 @@ def load_rules() -> dict:
     if not _LOADED:
         from tools.graftlint.rules import (  # noqa: F401
             async_blocking,
+            atomic_write,
             clocks,
             control_flow,
             donate,
@@ -58,8 +59,12 @@ def load_rules() -> dict:
             metrics_loop,
             pallas_tiles,
             prng,
+            shared_key,
             swallow,
             test_coverage,
+            thread_drain,
+            toctou,
+            pytree_leaf,
             weak_types,
         )
         _LOADED = True

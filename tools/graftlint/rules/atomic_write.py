@@ -1,0 +1,144 @@
+"""GL013 atomic-write discipline for durable JSON artifacts.
+
+A ``.json`` artifact another process reads (ledgers, manifests,
+verdicts, caches) must never be observable half-written: the
+threshold-cache race (CHANGES.md PR 9) persisted torn JSON exactly
+because a reader overlapped a plain ``open(...,'w')`` + dump, and the
+fix — ``atomic_write_json`` (now ``utils/fsio.py``): write a
+per-writer-unique ``.{name}.{pid}.tmp`` sibling, then ``os.replace`` —
+has been the repo-wide discipline since. This rule makes the discipline
+checkable: in the production dirs, a ``.write_text(...)`` or
+``json.dump`` landing on a path whose name lattice says ``*.json`` is
+flagged UNLESS the flow shows the idiom (a ``tmp`` marker in the name,
+or the written path feeding a later ``os.replace``/``os.rename`` in the
+same scope).
+
+``.jsonl`` append streams are exempt by construction (their names do
+not END in ``.json``): line-framed logs have their own torn-tail
+recovery discipline (graftroll's ``_recover``), not tmp-then-rename.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.engine import (LintContext, Module, dotted_last,
+                                    dotted_name)
+from tools.graftlint.flow import (DefUse, literal_strings, module_contexts,
+                                  path_expr, scope_walk)
+from tools.graftlint.rules import Rule, register
+
+_RENAMES = frozenset({"replace", "rename", "renames", "move", "link"})
+
+
+def _scopes(module: Module):
+    """(display-name, scope-node, context-tags) for module + functions."""
+    contexts = module_contexts(module)
+    yield "<module>", module.tree, frozenset({"main"})
+    for rec in module.functions:
+        yield rec.qualname, rec.node, contexts[rec.qualname]
+
+
+def _renamed_exprs(scope) -> set:
+    """Path expressions fed as the SOURCE of a rename/replace/move in
+    this scope — the tmp half of a write-then-rename, even unnamed."""
+    out = set()
+    for node in scope_walk(scope):
+        if isinstance(node, ast.Call) and node.args and \
+                dotted_last(node.func) in _RENAMES:
+            expr = path_expr(node.args[0])
+            if expr:
+                out.add(expr)
+            # tmp.rename(dst) / tmp.replace(dst): receiver is the source
+            if isinstance(node.func, ast.Attribute):
+                recv = path_expr(node.func.value)
+                if recv:
+                    out.add(recv)
+    return out
+
+
+def _opened_path(handle_value: ast.AST) -> tuple:
+    """(path-node, mode) for an ``open(p, m)`` / ``p.open(m)`` value."""
+    if not isinstance(handle_value, ast.Call):
+        return None, ""
+    call = handle_value
+    mode = "r"
+    for i, arg in enumerate(call.args):
+        if i == 1 and isinstance(arg, ast.Constant) and \
+                isinstance(arg.value, str):
+            mode = arg.value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = str(kw.value.value)
+    name = dotted_last(call.func)
+    if name == "open":
+        if isinstance(call.func, ast.Attribute):  # p.open(mode)
+            if call.args and isinstance(call.args[0], ast.Constant) and \
+                    isinstance(call.args[0].value, str):
+                mode = call.args[0].value
+            return call.func.value, mode
+        if call.args:  # open(p, mode)
+            return call.args[0], mode
+    return None, ""
+
+
+@register
+class AtomicWriteDiscipline(Rule):
+    id = "GL013"
+    name = "non-atomic-json-artifact-write"
+    summary = ("durable .json artifact written with open('w')/write_text "
+               "instead of atomic_write_json / tmp-then-rename")
+
+    # Every production dir that persists JSON artifacts other code reads.
+    DIRS = frozenset({"scheduler", "utils", "studies", "loopback", "agent",
+                      "mixtures", "scenarios", "data"})
+
+    def check(self, module: Module, ctx: LintContext) -> Iterator:
+        if not (self.DIRS & set(module.rel.split("/")[:-1])):
+            return
+        for qualname, scope, tags in _scopes(module):
+            defuse = DefUse(scope)
+            renamed = _renamed_exprs(scope)
+            for node in scope_walk(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                path_node = verb = None
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "write_text":
+                    path_node, verb = node.func.value, "write_text"
+                elif dotted_name(node.func) == "dump" or \
+                        dotted_name(node.func) == "json.dump":
+                    if len(node.args) >= 2:
+                        handle = node.args[1]
+                        if isinstance(handle, ast.Name):
+                            handle = defuse.value_at(
+                                handle.id, node.lineno) or handle
+                        path_node, mode = _opened_path(handle)
+                        verb = "json.dump"
+                        if path_node is None or not any(
+                                c in mode for c in "wx"):
+                            continue
+                if path_node is None:
+                    continue
+                names = literal_strings(path_node, defuse, node.lineno)
+                if not any(s.endswith(".json") for s in names):
+                    continue
+                if any("tmp" in s for s in names):
+                    continue  # the tmp half of the write-then-rename idiom
+                expr = path_expr(path_node)
+                if expr is not None and expr in renamed:
+                    continue  # unnamed tmp: written then renamed in-scope
+                where = ""
+                racy = tags & {"handler", "thread", "forked-worker"}
+                if racy:
+                    where = (f" (and {qualname} runs in a "
+                             f"{sorted(racy)[0]} context — concurrent "
+                             f"writers make the torn window real)")
+                yield self.finding(
+                    module, node.lineno,
+                    f"{verb} lands a .json artifact non-atomically — a "
+                    f"reader can observe the torn file; route it through "
+                    f"utils.fsio.atomic_write_json (per-writer .pid.tmp "
+                    f"sibling + os.replace){where}",
+                )

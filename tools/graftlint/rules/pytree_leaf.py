@@ -1,0 +1,132 @@
+"""GL016 Python-scalar pytree leaf on a traced argument type.
+
+A ``NamedTuple``/registered-pytree field holding a Python ``bool``/
+``int``/``float`` is a pytree LEAF: pass the container as a traced
+argument and that leaf becomes a tracer, so the first ``if
+params.random_start:`` throws ``TracerBoolConversionError`` — the PR-7
+near-miss that would have broken 14 tests had the field ridden
+``vmap``. The discipline is: fields of containers that cross the trace
+boundary as ARGUMENTS are arrays (``jnp.ndarray`` annotations, array
+defaults); Python scalars belong on config objects that stay closed
+over (``ClusterSetParams.random_phase`` is safe exactly because
+``bundle.py`` closes over it).
+
+Detection needs both halves, possibly in different modules: (a) the
+container — a ``NamedTuple`` subclass or a registered pytree class
+(``@struct.dataclass``, ``@register_pytree_node_class``,
+``register_pytree_node(Cls, ...)``) with a scalar-annotated,
+scalar-defaulted field; (b) the flow — some TRACED function (engine
+traced-scope verdict) annotating a non-static parameter with that type.
+Plain ``@dataclasses.dataclass`` types are deliberately out of scope:
+they are not pytrees, and jit fails loudly (not silently late) when
+handed one.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.engine import (LintContext, Module, dotted_last,
+                                    dotted_name)
+from tools.graftlint.rules import Rule, register
+
+_SCALARS = frozenset({"bool", "int", "float"})
+
+
+def _pytree_decorator(dec: ast.AST) -> bool:
+    """``@struct.dataclass`` / ``@register_pytree_node_class`` — NOT the
+    stdlib ``@dataclass``/``@dataclasses.dataclass`` (not a pytree)."""
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    if dotted_last(dec) == "register_pytree_node_class":
+        return True
+    full = dotted_name(dec) or ""
+    return full.endswith("struct.dataclass")
+
+
+def _pytree_classes(module: Module) -> list:
+    """(ClassDef, reason) for pytree-registered classes in the module."""
+    registered: set = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) and \
+                dotted_last(node.func) == "register_pytree_node" and \
+                node.args and isinstance(node.args[0], ast.Name):
+            registered.add(node.args[0].id)
+    out = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        if any(dotted_last(b) == "NamedTuple" for b in node.bases):
+            out.append((node, "NamedTuple"))
+        elif any(_pytree_decorator(d) for d in node.decorator_list):
+            out.append((node, "registered pytree"))
+        elif node.name in registered:
+            out.append((node, "register_pytree_node"))
+    return out
+
+
+def _scalar_fields(cls: ast.ClassDef) -> Iterator:
+    """(field name, annotation, line) for Python-scalar-defaulted fields."""
+    for stmt in cls.body:
+        if not isinstance(stmt, ast.AnnAssign) or stmt.value is None:
+            continue
+        ann = dotted_last(stmt.annotation)
+        if ann not in _SCALARS:
+            continue
+        if isinstance(stmt.value, ast.Constant) and \
+                isinstance(stmt.value.value, (bool, int, float)) and \
+                isinstance(stmt.target, ast.Name):
+            yield stmt.target.id, ann, stmt.lineno
+
+
+def _traced_consumers(ctx: LintContext) -> dict:
+    """type name -> [(module rel, function qualname, param)] for traced,
+    non-static parameters annotated with that type, across the lint set."""
+    cached = getattr(ctx, "_gl016_consumers", None)
+    if cached is not None:
+        return cached
+    index: dict = {}
+    for module in ctx.modules:
+        for rec in module.functions:
+            if not rec.traced:
+                continue
+            args = rec.node.args
+            for arg in args.posonlyargs + args.args + args.kwonlyargs:
+                if arg.annotation is None or arg.arg in rec.static_params:
+                    continue
+                ann = dotted_last(arg.annotation)
+                if ann:
+                    index.setdefault(ann, []).append(
+                        (module.rel, rec.qualname, arg.arg))
+    ctx._gl016_consumers = index
+    return index
+
+
+@register
+class PythonScalarPytreeLeaf(Rule):
+    id = "GL016"
+    name = "python-scalar-pytree-leaf"
+    summary = ("bool/int/float-defaulted field on a NamedTuple/registered "
+               "pytree that flows into a traced argument position")
+
+    def check(self, module: Module, ctx: LintContext) -> Iterator:
+        classes = _pytree_classes(module)
+        if not classes:
+            return
+        consumers = _traced_consumers(ctx)
+        for cls, kind in classes:
+            used = consumers.get(cls.name)
+            if not used:
+                continue
+            rel, qual, param = used[0]
+            for field, ann, line in _scalar_fields(cls):
+                yield self.finding(
+                    module, line,
+                    f"{cls.name}.{field} is a Python {ann} leaf on a "
+                    f"{kind}, and {cls.name} is a traced argument "
+                    f"({rel}:{qual}({param})) — under vmap/jit this leaf "
+                    f"becomes a tracer and `if .{field}:` raises "
+                    f"TracerBoolConversionError; make it a jnp array, or "
+                    f"keep the container closed over instead of passed",
+                )

@@ -1,0 +1,91 @@
+"""GL015 shared guard instance reached by multiple endpoint keys.
+
+A ``CircuitBreaker`` aggregates failures for ONE dependency; share a
+single instance across several endpoints and a flapping backend poisons
+(or dilutes below threshold) every other backend's signal — the breaker
+never opens under mixed traffic. This repo shipped that defect twice
+(telemetry's per-cloud HTTP pushes, then the k8s client — CHANGES.md
+PRs 8/10) and both fixes landed the same discipline: a dict of per-key
+instances (``{cloud: CircuitBreaker(...) for cloud in clouds}``,
+``scheduler/telemetry.py`` / ``scheduler/k8s_client.py``).
+
+Detection is flow-shaped: a SINGLE construction of a guard type bound
+to a plain name/attribute (dict-comprehension and per-key-subscript
+constructions never register), whose methods are then invoked with ≥2
+DISTINCT string key literals across the module — two different keys
+funneled into one failure domain.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.engine import LintContext, Module, dotted_last
+from tools.graftlint.flow import literal_strings, path_expr
+from tools.graftlint.rules import Rule, register
+
+# Guard types whose instances aggregate per-dependency state.
+GUARD_TYPES = frozenset({"CircuitBreaker", "RetryPolicy", "RateLimiter",
+                         "TokenBucket"})
+
+
+def _constructions(module: Module) -> dict:
+    """target path expression -> (guard type, line) for single-instance
+    guard constructions (value is DIRECTLY the constructor call)."""
+    out: dict = {}
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Call) and
+                dotted_last(node.value.func) in GUARD_TYPES):
+            continue
+        for target in node.targets:
+            if isinstance(target, ast.Subscript):
+                continue  # per-key: breakers[cloud] = CircuitBreaker()
+            expr = path_expr(target)
+            if expr is not None:
+                out[expr] = (dotted_last(node.value.func), node.lineno)
+    return out
+
+
+@register
+class SharedInstancePerKey(Rule):
+    id = "GL015"
+    name = "shared-guard-instance-per-key"
+    summary = ("one CircuitBreaker/RetryPolicy instance invoked with >=2 "
+               "distinct endpoint key literals — per-key instances "
+               "required")
+
+    DIRS = frozenset({"scheduler", "utils"})
+
+    def check(self, module: Module, ctx: LintContext) -> Iterator:
+        if not (self.DIRS & set(module.rel.split("/")[:-1])):
+            return
+        owners = _constructions(module)
+        if not owners:
+            return
+        keys_seen: dict = {expr: set() for expr in owners}
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute)):
+                continue
+            recv = path_expr(node.func.value)
+            if recv not in keys_seen:
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                keys_seen[recv] |= literal_strings(arg)
+        for expr, (guard, line) in sorted(owners.items(),
+                                          key=lambda kv: kv[1][1]):
+            keys = sorted(keys_seen[expr])
+            if len(keys) < 2:
+                continue
+            shown = ", ".join(repr(k) for k in keys[:4])
+            yield self.finding(
+                module, line,
+                f"one {guard} instance `{expr}` receives {len(keys)} "
+                f"distinct key literals ({shown}) — its failure counts "
+                f"mix endpoints and it will never open cleanly under "
+                f"mixed traffic; construct per-key instances (dict keyed "
+                f"by endpoint, as telemetry/k8s_client do)",
+            )

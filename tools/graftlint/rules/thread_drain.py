@@ -1,0 +1,148 @@
+"""GL017 drain contracts over daemon threads.
+
+Two shapes of the same broken promise — "close() means the data is on
+disk" — both shipped and both bitten (CHANGES.md PR 11, the graftroll
+record-loss race):
+
+- **timed join without a verdict**: a drain path (``close``/
+  ``shutdown``/``stop``/``flush``/...) calls ``handle.join(timeout=..)``
+  on a ``daemon=True`` thread and then proceeds as if the thread exited.
+  A daemon thread survives the timeout silently — the interpreter will
+  kill it mid-write at exit. After a timed join the drain MUST consult
+  ``is_alive()`` and take the wedged branch (log, skip the seal, leave
+  recovery to the next startup). A bare ``join()`` is a guaranteed
+  drain and is never flagged.
+- **socketserver daemon handlers**: ``server.daemon_threads = True``
+  makes ``server_close()`` skip joining per-connection handler threads
+  (stdlib semantics: only non-daemon handler threads are joined), so
+  in-flight records die with the process. The pool sets ``False``
+  (``scheduler/pool.py``) for exactly this reason.
+
+The first shape uses graftflow end-to-end: daemon construction is found
+by value flow (``Thread(..., daemon=True)`` assignments and
+``handle.daemon = True`` writes), joins are matched to handles by
+canonical path expression, and only supervisor-side drain-named
+functions are in scope — worker fan-out helpers that poll with
+``join(timeout)`` by design stay unflagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.engine import LintContext, Module, dotted_last, walk_own
+from tools.graftlint.flow import path_expr
+from tools.graftlint.rules import Rule, register
+
+# Function names that promise a drain: after they return, the caller
+# may assume buffered work is durable and the worker is gone.
+_DRAIN_WORDS = ("close", "shutdown", "stop", "drain", "flush", "terminate")
+_DRAIN_EXACT = frozenset({"__exit__", "__del__", "join", "join_all"})
+
+
+def _is_drain_name(name: str) -> bool:
+    low = name.lower()
+    return name in _DRAIN_EXACT or any(w in low for w in _DRAIN_WORDS)
+
+
+def _daemon_handles(module: Module) -> set:
+    """Canonical path expressions of thread handles constructed (or
+    later marked) ``daemon=True`` anywhere in the module."""
+    handles: set = set()
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        value, targets = node.value, node.targets
+        if isinstance(value, ast.Call) and \
+                dotted_last(value.func) == "Thread" and any(
+                    kw.arg == "daemon" and
+                    isinstance(kw.value, ast.Constant) and
+                    kw.value.value is True
+                    for kw in value.keywords):
+            for t in targets:
+                expr = path_expr(t)
+                if expr is not None:
+                    handles.add(expr)
+        for t in targets:  # handle.daemon = True after construction
+            if isinstance(t, ast.Attribute) and t.attr == "daemon" and \
+                    isinstance(value, ast.Constant) and value.value is True:
+                expr = path_expr(t.value)
+                if expr is not None:
+                    handles.add(expr)
+    return handles
+
+
+def _join_timeout(call: ast.Call) -> bool:
+    if call.args:
+        return not (isinstance(call.args[0], ast.Constant) and
+                    call.args[0].value is None)
+    return any(kw.arg == "timeout" and
+               not (isinstance(kw.value, ast.Constant) and
+                    kw.value.value is None)
+               for kw in call.keywords)
+
+
+@register
+class DaemonDrainContract(Rule):
+    id = "GL017"
+    name = "daemon-drain-contract"
+    summary = ("drain path joins a daemon thread with a timeout but never "
+               "checks is_alive(); or socketserver daemon_threads=True "
+               "voids server_close()'s join")
+
+    DIRS = frozenset({"scheduler", "utils"})
+
+    def check(self, module: Module, ctx: LintContext) -> Iterator:
+        if not (self.DIRS & set(module.rel.split("/")[:-1])):
+            return
+        handles = _daemon_handles(module)
+        for rec in module.functions:
+            if not _is_drain_name(rec.name) or not handles:
+                continue
+            joined: list = []      # (expr, call) timed joins on daemons
+            verdicts: set = set()  # exprs consulted via is_alive()
+            for node in walk_own(rec.node):
+                if not (isinstance(node, ast.Call) and
+                        isinstance(node.func, ast.Attribute)):
+                    continue
+                recv = path_expr(node.func.value)
+                if recv not in handles:
+                    continue
+                if node.func.attr == "join" and _join_timeout(node):
+                    joined.append((recv, node))
+                elif node.func.attr == "is_alive":
+                    verdicts.add(recv)
+            for expr, call in joined:
+                if expr in verdicts:
+                    continue
+                yield self.finding(
+                    module, call.lineno,
+                    f"{rec.qualname} joins daemon thread `{expr}` with a "
+                    f"timeout and never checks is_alive() — a wedged "
+                    f"writer survives the join silently and dies "
+                    f"mid-record at interpreter exit; branch on "
+                    f"is_alive() and leave sealing to startup recovery",
+                )
+        # Shape (b): daemon_threads = True on a socketserver.
+        for node in ast.walk(module.tree):
+            flagged = None
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Constant) and \
+                    node.value.value is True:
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            t.attr == "daemon_threads":
+                        flagged = node
+                    if isinstance(t, ast.Name) and \
+                            t.id == "daemon_threads":
+                        flagged = node  # class-body attribute
+            if flagged is not None:
+                yield self.finding(
+                    module, flagged.lineno,
+                    "daemon_threads = True makes server_close() skip "
+                    "joining per-connection handler threads — in-flight "
+                    "records are lost at shutdown (the graftroll race); "
+                    "set False and let server_close() drain, as "
+                    "scheduler/pool.py does",
+                )

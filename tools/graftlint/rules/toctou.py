@@ -1,0 +1,154 @@
+"""GL014 check-then-act TOCTOU on filesystem paths.
+
+The runner-lock and ``--fresh`` races (CHANGES.md PRs 9/13) were all the
+same shape: ``exists()``/``is_file()`` on a path, then a destructive or
+creating act on the SAME path expression later in the scope — and
+between the two, another process (a resumed study runner, a second
+fleet controller, a promote racing a snapshot) changes the world. The
+fixes were always one of two idioms, and this rule accepts exactly
+those:
+
+- **EAFP**: drop the check, act, and catch ``FileNotFoundError`` /
+  pass ``missing_ok=True`` / ``ignore_errors=True`` / ``exist_ok=True``
+  (``utils.fsio.fresh_dir`` packages the rmtree+mkdir case);
+- **a real lock**: scopes whose flow touches ``O_EXCL`` or the
+  ``utils/pidlock`` seam (``acquire_pidfile_lock`` /
+  ``acquire_runner_lock`` / ``read_live_pid`` / ``pid_alive``) are
+  exempt wholesale — check-then-act UNDER the lock is the lock's whole
+  point.
+
+Matching is by canonical path expression (:func:`path_expr`) within one
+scope: a check on ``dest`` pairs with ``shutil.rmtree(dest)`` and with
+``shutil.rmtree(str(dest))``, not with acts on other paths.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.engine import LintContext, Module, dotted_last
+from tools.graftlint.flow import path_expr, scope_walk
+from tools.graftlint.rules import Rule, register
+
+# Existence checks: method form (p.exists()) and os.path form.
+_CHECK_METHODS = frozenset({"exists", "is_file", "is_dir"})
+_CHECK_FUNCS = frozenset({"exists", "isfile", "isdir", "lexists"})
+
+# Acts racing the check: destructive ops and creating writes. The
+# atomic renames (os.rename/os.replace) are deliberately absent — they
+# overwrite atomically, which is the FIX for this class, not the bug.
+_ACT_FUNCS = frozenset({"rmtree", "remove", "unlink", "move"})
+_ACT_METHODS = frozenset({"unlink", "rename", "rmdir", "write_text",
+                          "touch"})
+
+# Keyword escapes that make the act EAFP on their own.
+_EAFP_KWARGS = frozenset({"missing_ok", "ignore_errors", "exist_ok"})
+
+# Names whose presence in a scope means the check-act runs under a real
+# inter-process lock (utils/pidlock) or creates with O_EXCL itself.
+_LOCK_NAMES = frozenset({
+    "O_EXCL", "acquire_pidfile_lock", "acquire_runner_lock",
+    "read_live_pid", "_read_live_pid", "pid_alive",
+})
+
+
+def _scope_has_lock(scope) -> bool:
+    for node in scope_walk(scope):
+        if isinstance(node, ast.Name) and node.id in _LOCK_NAMES:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _LOCK_NAMES:
+            return True
+    return False
+
+
+def _eafp_kwargs(call: ast.Call) -> bool:
+    return any(kw.arg in _EAFP_KWARGS and
+               not (isinstance(kw.value, ast.Constant) and
+                    kw.value.value is False)
+               for kw in call.keywords)
+
+
+def _checks(scope) -> dict:
+    """path expression -> earliest check line in this scope."""
+    out: dict = {}
+    for node in scope_walk(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        expr = None
+        name = dotted_last(node.func)
+        if isinstance(node.func, ast.Attribute) and \
+                name in _CHECK_METHODS and not node.args:
+            expr = path_expr(node.func.value)
+        elif name in _CHECK_FUNCS and node.args and \
+                isinstance(node.func, ast.Attribute):  # os.path.exists(p)
+            expr = path_expr(node.args[0])
+        if expr is not None:
+            out.setdefault(expr, node.lineno)
+            if node.lineno < out[expr]:
+                out[expr] = node.lineno
+    return out
+
+
+def _acts(scope) -> Iterator:
+    """(path-expression, call, verb) for racing acts in this scope."""
+    for node in scope_walk(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_last(node.func)
+        if name in _ACT_FUNCS and node.args and not (
+                isinstance(node.func, ast.Attribute) and
+                not isinstance(node.func.value, ast.Name)):
+            # shutil.rmtree(p) / os.remove(p) / bare rmtree(p) /
+            # shutil.move(src, dst): the racing operand is the source.
+            expr = path_expr(node.args[0])
+            if expr is not None:
+                yield expr, node, name
+        elif isinstance(node.func, ast.Attribute) and name in _ACT_METHODS:
+            expr = path_expr(node.func.value)
+            if expr is not None:
+                yield expr, node, f".{name}()"
+        elif name == "open" and isinstance(node.func, ast.Name) and \
+                len(node.args) >= 2 and \
+                isinstance(node.args[1], ast.Constant) and \
+                any(c in str(node.args[1].value) for c in "wx"):
+            expr = path_expr(node.args[0])
+            if expr is not None:
+                yield expr, node, "open(.., 'w')"
+
+
+@register
+class CheckThenActToctou(Rule):
+    id = "GL014"
+    name = "check-then-act-toctou"
+    summary = ("exists()/is_file() then remove/rmtree/rename/creating "
+               "write on the same path expression, without O_EXCL or the "
+               "pidlock seam in the flow")
+
+    DIRS = frozenset({"scheduler", "utils", "studies", "loopback", "agent",
+                      "mixtures", "scenarios", "data"})
+
+    def check(self, module: Module, ctx: LintContext) -> Iterator:
+        if not (self.DIRS & set(module.rel.split("/")[:-1])):
+            return
+        scopes = [module.tree] + [rec.node for rec in module.functions]
+        for scope in scopes:
+            checks = _checks(scope)
+            if not checks:
+                continue
+            if _scope_has_lock(scope):
+                continue
+            for expr, call, verb in _acts(scope):
+                check_line = checks.get(expr)
+                if check_line is None or call.lineno <= check_line:
+                    continue
+                if _eafp_kwargs(call):
+                    continue
+                yield self.finding(
+                    module, call.lineno,
+                    f"{verb} on `{expr}` races the existence check at "
+                    f"line {check_line} — another process can win the "
+                    f"window; go EAFP (catch FileNotFoundError / "
+                    f"missing_ok / utils.fsio.fresh_dir) or take the "
+                    f"pidlock seam first",
+                )

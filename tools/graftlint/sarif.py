@@ -1,0 +1,82 @@
+"""SARIF 2.1.0 serialization of a :class:`~tools.graftlint.engine.LintResult`.
+
+SARIF is the interchange format CI annotators (GitHub code scanning,
+Gitea, reviewdog) consume natively, so ``python -m tools.graftlint
+--sarif out.sarif`` turns the gate's findings into inline PR annotations
+with zero bespoke glue. The mapping is deliberately minimal and pinned
+by ``tests/test_graftlint.py``:
+
+- one ``run``, driver ``graftlint``, with the full rule registry (plus
+  the synthetic GL000) in ``tool.driver.rules`` so viewers can resolve
+  ``ruleId`` -> description without the repo checked out;
+- one ``result`` per finding: ``level`` is ``error``/``warning`` from
+  the per-rule severity, ``suppressions: [{kind: "inSource"}]`` marks
+  in-source-suppressed findings (SARIF's own vocabulary for exactly our
+  ``# graftlint: disable`` mechanism — consumers hide but retain them);
+- stale-suppression audit findings ride along as ordinary ``error``
+  results so a stale justification is visible in the same annotation
+  stream that the suppression once silenced.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+
+
+def _rule_descriptor(rule_id: str, name: str, summary: str) -> dict:
+    return {
+        "id": rule_id,
+        "name": name,
+        "shortDescription": {"text": summary},
+    }
+
+
+def _result(finding) -> dict:
+    out = {
+        "ruleId": finding.rule,
+        "level": "warning" if finding.severity == "warn" else "error",
+        "message": {"text": finding.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": finding.path},
+                "region": {"startLine": max(1, finding.line)},
+            },
+        }],
+    }
+    if finding.suppressed:
+        out["suppressions"] = [{"kind": "inSource"}]
+    return out
+
+
+def to_sarif(result) -> dict:
+    """Build the SARIF document for a LintResult (rules registry included)."""
+    from tools.graftlint.rules import RULES, load_rules
+
+    load_rules()
+    rules = [_rule_descriptor(
+        "GL000", "bad-suppression",
+        "suppression without justification / unknown rule / unparsable "
+        "file / stale audit target")]
+    rules += [_rule_descriptor(r.id, r.name, r.summary)
+              for _, r in sorted(RULES.items())]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri": "docs/static_analysis.md",
+                "rules": rules,
+            }},
+            "results": [_result(f) for f in result.findings]
+                       + [_result(f) for f in result.stale_suppressions],
+        }],
+    }
+
+
+def write_sarif(result, path) -> None:
+    Path(path).write_text(json.dumps(to_sarif(result), indent=2) + "\n")
