@@ -12,7 +12,8 @@ TRACE ?= tests/fixtures/traceview/fixture.trace.json.gz
         serve-pool serve-soak rollout-drill eval-matrix scenario-bench \
         study study-list overlap-bench serve-report slo-check span-ab \
         fastpath-ab front-ab loop-drill loop-soak transfer-grid \
-        mixture-smoke fleet-drill fleet-soak
+        mixture-smoke fleet-drill fleet-soak drift-report drift-drill \
+        drift-soak
 
 # Exit codes (all lint targets): 0 clean, 1 findings (or stale
 # suppressions under --audit-suppressions), 2 usage/config error.
@@ -127,6 +128,33 @@ serve-report:
 # end of a soak/drill; serves the fixture off-network by default).
 slo-check:
 	$(PY) -m tools.decisionview --stats $(SERVE_STATS) --slo-check
+
+# graftdrift (docs/observability.md §5): the distribution-shift report
+# with retrain-trigger gating — per-stream PSI/KS vs the frozen
+# reference, drifting verdicts (burn semantics), reference lineage,
+# shadow agreement. Defaults to the checked-in fixture so the gate is
+# self-contained off-network; point DRIFT_STATS at a live pool
+# (`make drift-report DRIFT_STATS=http://127.0.0.1:8788/stats
+# DRIFT_REF=/var/drift/reference.json`).
+DRIFT_STATS ?= tests/fixtures/driftview/stats.json
+DRIFT_REF ?= tests/fixtures/driftview/reference.json
+drift-report:
+	$(PY) -m tools.driftview --stats $(DRIFT_STATS) \
+		--reference $(DRIFT_REF) \
+		--check --budgets tools/driftview/budgets.json
+
+# The graftdrift drill (tier-1, docs/serving.md): a drift-armed pool
+# soaked by the bench, mid-soak regime flip (--flip-at swaps the
+# price-replay tables) flips *_drifting within the short window and
+# `driftview --check` exits 2, while the stationary control soak never
+# flips it — with shadow scoring running concurrently at bitwise-zero
+# effect on served decisions. `drift-soak` adds the slow passes.
+drift-drill:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_graftdrift.py -q \
+		-m 'not slow' -k drift_drill
+
+drift-soak:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_graftdrift.py -q
 
 # graftlens span-overhead A/B (docs/serving.md acceptance: spans-on
 # within 2% of spans-off req/s and p50 at 8-way N=1024, interleaved).

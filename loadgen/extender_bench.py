@@ -94,6 +94,7 @@ def load_replay_payloads(trace_dir: str, node_capacity_cores: float = 4.0,
     counted — a replay must tolerate a mixed-era trace dir."""
     from rl_scheduler_tpu.scheduler.tracelog import (
         clouds_from_token,
+        is_synthetic_endpoint,
         iter_trace_merged,
     )
 
@@ -101,7 +102,9 @@ def load_replay_payloads(trace_dir: str, node_capacity_cores: float = 4.0,
     skipped = probes = 0
     counts: dict = {}
     for record in iter_trace_merged(trace_dir):
-        if record.get("endpoint") == "probe":
+        if is_synthetic_endpoint(record.get("endpoint")):
+            # Probes AND shadow scores: synthetic records never answered
+            # a real request, so a replay must not re-issue them.
             probes += 1
             continue
         clouds = clouds_from_token(record.get("clouds"))
@@ -271,7 +274,8 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
           keepalive: bool = False,
           content_type: str = "application/json",
           targets: list | None = None,
-          connect_retries: int | None = None):
+          connect_retries: int | None = None,
+          flip_at: float | None = None):
     """Duration-based load: each thread loops until the deadline.
 
     Payloads are prebuilt once (at N=1024 a node list is ~100 KB of
@@ -283,12 +287,16 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
     connection-level errors retry up to 3 times (``_request_with_retry``:
     a dying worker's accept queue RSTs on close; the retry's fresh
     connection re-hashes to a live worker; retries are reported, HTTP
-    errors never retry).
+    errors never retry). ``flip_at`` (graftdrift) adds a second mark of
+    the SAME mechanism: every request is phased independently against
+    every mark, so a promote + flip soak reports all four phase counts
+    (``pre_promote``/``post_promote``/``pre_flip``/``post_flip``).
     Returns ``(sorted_latencies_ms, wall_s, failures, phases, retries,
     sorted_connects_ms, per_pool)`` — ``retries`` is counted (and
-    reported) UNCONDITIONALLY, so lever A/B lines stay field-comparable
-    with rollout-drill lines; ``phases`` is ``None`` without a promote,
-    ``per_pool`` is ``None`` without ``targets``.
+    reported) UNCONDITIONALLY and ONCE per request (never once per
+    mark), so lever A/B lines stay field-comparable with rollout-drill
+    lines; ``phases`` is ``None`` without any mark, ``per_pool`` is
+    ``None`` without ``targets``.
 
     graftfront: every soak thread now runs a :class:`BenchClient`, so
     connection setup is timed apart from request latency in BOTH
@@ -321,13 +329,19 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
         connect_retries = 3 if promote_at is not None else 0
     t_start = time.perf_counter()
     deadline = t_start + duration_s
-    t_promote = None if promote_at is None else t_start + promote_at
+    # Phase marks: each named instant splits every request (by START
+    # time) into its own pre/post pair, independently of other marks.
+    marks = {}
+    if promote_at is not None:
+        marks["promote"] = t_start + promote_at
+    if flip_at is not None:
+        marks["flip"] = t_start + flip_at
     latencies: list = []
     connects: list = []
     failures = [0]
     retries_total = [0]
-    phases = {"pre_promote": {"requests": 0, "failures": 0, "retries": 0},
-              "post_promote": {"requests": 0, "failures": 0, "retries": 0}}
+    phases = {f"{side}_{name}": {"requests": 0, "failures": 0, "retries": 0}
+              for name in marks for side in ("pre", "post")}
     per_pool = {name: {"requests": 0, "failures": 0}
                 for name, _, _ in endpoints if name is not None}
     lock = threading.Lock()
@@ -338,7 +352,8 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
                    for _, c_host, c_port in endpoints]
         local: list = []
         failed = 0
-        counts = {"pre_promote": [0, 0, 0], "post_promote": [0, 0, 0]}
+        local_retries = 0
+        counts = {key: [0, 0, 0] for key in phases}
         pool_counts = {name: [0, 0] for name, _, _ in endpoints
                        if name is not None}
         i = thread_id
@@ -347,9 +362,8 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
             now = time.perf_counter()
             if now >= deadline:
                 break
-            phase = ("post_promote"
-                     if t_promote is not None and now >= t_promote
-                     else "pre_promote")
+            keys = [("post_" if now >= t_mark else "pre_") + mark
+                    for mark, t_mark in marks.items()]
             idx = k % len(clients)
             k += 1
             name = endpoints[idx][0]
@@ -358,14 +372,17 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
                     clients[idx], i, num_nodes,
                     payloads[i % len(payloads)], connect_retries)
                 local.append(ms)
-                counts[phase][0] += 1
-                counts[phase][2] += retried
+                local_retries += retried
+                for key in keys:
+                    counts[key][0] += 1
+                    counts[key][2] += retried
                 if name is not None:
                     pool_counts[name][0] += 1
             except Exception:  # noqa: BLE001 - soak counts, never aborts
                 failed += 1
-                counts[phase][0] += 1
-                counts[phase][1] += 1
+                for key in keys:
+                    counts[key][0] += 1
+                    counts[key][1] += 1
                 if name is not None:
                     pool_counts[name][0] += 1
                     pool_counts[name][1] += 1
@@ -377,11 +394,13 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
             for client in clients:
                 connects.extend(client.connects_ms)
             failures[0] += failed
-            for phase, (reqs, fails, retries) in counts.items():
-                phases[phase]["requests"] += reqs
-                phases[phase]["failures"] += fails
-                phases[phase]["retries"] += retries
-                retries_total[0] += retries
+            # Retries merge ONCE per thread — merging them per phase row
+            # double-counted the total whenever two marks were active.
+            retries_total[0] += local_retries
+            for key, (reqs, fails, retries) in counts.items():
+                phases[key]["requests"] += reqs
+                phases[key]["failures"] += fails
+                phases[key]["retries"] += retries
             for name, (reqs, fails) in pool_counts.items():
                 per_pool[name]["requests"] += reqs
                 per_pool[name]["failures"] += fails
@@ -392,7 +411,7 @@ def _soak(base: str, duration_s: float, threads: int, num_nodes: int,
     for w in workers:
         w.join()
     return (sorted(latencies), time.perf_counter() - t_start, failures[0],
-            phases if t_promote is not None else None, retries_total[0],
+            phases if marks else None, retries_total[0],
             sorted(connects), per_pool if targets else None)
 
 
@@ -435,6 +454,34 @@ def _fire_promote(control: str, checkpoint: str, delay_s: float,
             return out
         time.sleep(0.2)
     out["error"] = "rollout still in flight at the soak deadline"
+    return out
+
+
+def _fire_flip(control: str, tables: str, delay_s: float) -> dict:
+    """graftdrift regime flip: sleep ``delay_s``, then POST
+    ``/telemetry/flip`` so every pool worker swaps its price-replay
+    table mid-soak. Returns what happened for the result line — the
+    drift drill asserts the ``*_drifting`` transition downstream, this
+    only reports whether the flip was accepted."""
+    time.sleep(delay_s)
+    out: dict = {"requested": True, "tables": tables}
+    req = urllib.request.Request(
+        control + "/telemetry/flip",
+        data=json.dumps({"path": tables}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            out["response_code"] = resp.status
+            out["response"] = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        out["response_code"] = e.code
+        try:
+            out["response"] = json.loads(e.read())
+        except Exception:  # noqa: BLE001 - body is advisory
+            out["response"] = None
+    except Exception as e:  # noqa: BLE001 - soak reports, never aborts
+        out["error"] = str(e)
     return out
 
 
@@ -808,6 +855,19 @@ def main(argv: list[str] | None = None) -> dict:
                         "bar (docs/serving.md)")
     p.add_argument("--promote-checkpoint", default=None, metavar="DIR",
                    help="checkpoint run dir to promote at --promote-at")
+    p.add_argument("--flip-at", type=float, default=None, metavar="T",
+                   help="graftdrift drill hook (soak mode): POST "
+                        "/telemetry/flip to the control plane T seconds "
+                        "into the soak, swapping every worker's price-"
+                        "replay table to --flip-tables (a real mid-soak "
+                        "regime change, off-network), and report per-"
+                        "phase (pre/post-flip) request counts — the "
+                        "drift drill then asserts *_drifting flips "
+                        "within the short window (docs/serving.md)")
+    p.add_argument("--flip-tables", default=None, metavar="PATH",
+                   help="normalized telemetry table CSV to swap in at "
+                        "--flip-at (same loader + validation as the "
+                        "server's --telemetry table)")
     p.add_argument("--history", default=None, metavar="FILE",
                    help="graftlens serving bench ledger: append this "
                         "run's schema_version:1 JSON line to FILE "
@@ -905,6 +965,8 @@ def main(argv: list[str] | None = None) -> dict:
                     "them as separate invocations")
         if args.promote_at is not None:
             p.error("--fronts and --promote-at are separate drills")
+        if args.flip_at is not None:
+            p.error("--fronts and --flip-at are separate drills")
         if args.replay_trace is not None:
             p.error("--fronts self-hosts synthetic pools; --replay-trace "
                     "drives an existing server — separate modes")
@@ -916,6 +978,8 @@ def main(argv: list[str] | None = None) -> dict:
             args.duration = 10.0
         if args.promote_at is not None:
             p.error("--levers and --promote-at are separate drills")
+        if args.flip_at is not None:
+            p.error("--levers and --flip-at are separate drills")
         if args.replay_trace is not None:
             p.error("--levers self-hosts synthetic pools; --replay-trace "
                     "drives an existing server from a recorded trace — "
@@ -961,6 +1025,16 @@ def main(argv: list[str] | None = None) -> dict:
                     f"[0, {args.duration})")
     elif args.promote_checkpoint is not None:
         p.error("--promote-checkpoint only applies with --promote-at")
+    if args.flip_at is not None:
+        if args.duration is None:
+            p.error("--flip-at needs --duration (the soak is the drill)")
+        if args.flip_tables is None:
+            p.error("--flip-at needs --flip-tables")
+        if not 0 <= args.flip_at < args.duration:
+            p.error("--flip-at must land inside the soak window "
+                    f"[0, {args.duration})")
+    elif args.flip_tables is not None:
+        p.error("--flip-tables only applies with --flip-at")
     targets = None
     if args.targets is not None:
         targets = [t.strip() for t in args.targets.split(",") if t.strip()]
@@ -1000,9 +1074,10 @@ def main(argv: list[str] | None = None) -> dict:
 
     failures = retries = 0
     connects: list = []
-    phases = promote = per_pool = None
+    phases = promote = per_pool = flip = None
     if args.duration is not None:
         promote_thread = result_box = None
+        flip_thread = flip_box = None
         if args.promote_at is not None:
             result_box = {}
             remaining = args.duration - args.promote_at
@@ -1015,14 +1090,28 @@ def main(argv: list[str] | None = None) -> dict:
             promote_thread = threading.Thread(target=_promote_then_record,
                                               daemon=True)
             promote_thread.start()
+        if args.flip_at is not None:
+            flip_box = {}
+
+            def _flip_then_record():
+                flip_box.update(_fire_flip(
+                    control, args.flip_tables, args.flip_at))
+
+            flip_thread = threading.Thread(target=_flip_then_record,
+                                           daemon=True)
+            flip_thread.start()
         latencies, wall, failures, phases, retries, connects, per_pool = \
             _soak(base, args.duration, args.threads, args.nodes,
                   promote_at=args.promote_at, payloads=replay_payloads,
                   keepalive=args.keepalive, targets=targets,
-                  connect_retries=3 if targets else None)
+                  connect_retries=3 if targets else None,
+                  flip_at=args.flip_at)
         if promote_thread is not None:
             promote_thread.join(timeout=60.0)
             promote = result_box
+        if flip_thread is not None:
+            flip_thread.join(timeout=30.0)
+            flip = flip_box
         if not latencies:
             raise SystemExit(
                 f"soak completed zero requests in {args.duration}s "
@@ -1096,10 +1185,15 @@ def main(argv: list[str] | None = None) -> dict:
         # modal `nodes` it already carries.
         out["replay"] = replay_report
     if phases is not None:
-        out["promote_at_s"] = args.promote_at
+        if args.promote_at is not None:
+            out["promote_at_s"] = args.promote_at
+        if args.flip_at is not None:
+            out["flip_at_s"] = args.flip_at
         out["phases"] = phases
     if promote is not None:
         out["promote"] = promote
+    if flip is not None:
+        out["flip"] = flip
     if per_pool is not None:
         # graftfleet: the drill's zero-failures bar is judged per pool
         # from this one line.

@@ -161,7 +161,10 @@ def usable_records(trace_dir: str | Path) -> tuple[list, dict]:
     """``(records, exclusion_counts)``: the merged decision sequence a
     compile consumes — probes and fail-opens out, a telemetry position
     required (the one field the reconstruction is a function of)."""
-    from rl_scheduler_tpu.scheduler.tracelog import iter_trace_merged
+    from rl_scheduler_tpu.scheduler.tracelog import (
+        is_synthetic_endpoint,
+        iter_trace_merged,
+    )
 
     used: list = []
     stats = {"records_total": 0, "probes_excluded": 0,
@@ -169,7 +172,10 @@ def usable_records(trace_dir: str | Path) -> tuple[list, dict]:
              "generations": set()}
     for record in iter_trace_merged(trace_dir):
         stats["records_total"] += 1
-        if record.get("endpoint") == "probe":
+        if is_synthetic_endpoint(record.get("endpoint")):
+            # Probes AND shadow scores: neither consumed a telemetry
+            # position on the serving path, so neither can anchor a
+            # reconstruction step.
             stats["probes_excluded"] += 1
             continue
         if record.get("fail_open"):

@@ -1,0 +1,966 @@
+"""graftdrift: online distribution-shift observability for the serving plane.
+
+graftlens (slo.py) watches *how fast* the extender answers; nothing
+watches *what it is answering* — the policy could latch onto one cloud,
+the telemetry table could enter a price-spike regime, and every latency
+gauge would stay green. This module is the drift layer, the instrument
+ROADMAP item 3's loop daemon triggers on:
+
+- :class:`DriftTracker` keeps **online sketches** of four per-decision
+  streams on the decide hot path — the chosen decision's ``score``
+  (softmax probability), the chosen-cloud ``action`` categorical, and
+  the input telemetry's ``cost``/``latency`` feature columns — each
+  accumulated into fixed-bucket histograms (the ``LatencyStats``
+  discipline: bucket counts are the ONE shape that merges exactly
+  across workers) twice over: a time-bucketed ring for trailing
+  fast/slow windows (the ``SloTracker`` ring construction) and
+  lifetime-monotonic counts with a host-side Welford accumulator (the
+  flight-recorder pattern). One observation per stream per served
+  decision; probes, shadow scores and fail-opens are excluded at record
+  time (``tracelog.is_synthetic_endpoint``), so drift can never be
+  tripped by the gates that watch it.
+- **Frozen references**: :func:`build_reference` freezes a fingerprinted
+  per-(generation, stream) distribution from a live ``/stats`` drift
+  section or a recorded trace dir (``python -m
+  rl_scheduler_tpu.scheduler.drift snapshot``). The server grades live
+  windows against the loaded reference with bucket-wise **PSI** (with
+  epsilon-floored probabilities) and **KS** distance. A reference is
+  generation-keyed: after a promote the scores report
+  ``generation_mismatch`` — never a false drift alarm — until the
+  operator re-snapshots (docs/observability.md §5).
+- **Multi-window verdicts reuse ``slo.compute_burn``**: each stream's
+  PSI, normalized by the configured threshold, is fed through the SLO
+  burn machinery as a pseudo-availability objective (budget = 0.5,
+  both burn thresholds = 1.0, window counts at ``_SCALE`` resolution),
+  so ``drifting`` is true exactly when the normalized score is over
+  threshold in BOTH the fast and the slow window — a transient spike
+  never trips it, the same contract that keeps a 2-second latency blip
+  from paging.
+- :func:`merge_snapshots` is the pool/fleet merge: window and lifetime
+  counts sum, distances and verdicts recompute from the sums (the
+  ``merged_histogram`` discipline — rates and distances are not
+  linear). Its output is shaped exactly like a tracker snapshot, so a
+  fleet-of-pools re-merges pool sections the same way a pool merges
+  workers.
+- :class:`ShadowScorer` is the item-3c substrate: an optional candidate
+  checkpoint scores live requests in shadow off the serving thread
+  (bounded queue, drop-oldest — the AsyncPlacer discipline), recording
+  incumbent-vs-shadow top-1 agreement and a score-delta histogram.
+  Shadow decisions are tagged ``endpoint=shadow`` and excluded from
+  SLO/latency/phase/drift recording exactly like probes.
+
+Surfaced on ``/stats`` (``drift``/``shadow`` sections), ``/metrics``
+(``*_drift_score{stream=,window=,kind=}``, ``*_drifting{stream=}``,
+``*_shadow_agreement``) and the ``/healthz`` body. ``tools/driftview``
+joins the sections into the gated drift report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import bisect
+import dataclasses
+import hashlib
+import json
+import logging
+import math
+import queue
+import sys
+import threading
+import time
+
+from rl_scheduler_tpu.scheduler import slo as slo_mod
+
+logger = logging.getLogger(__name__)
+
+DRIFT_SCHEMA = 1
+REFERENCE_SCHEMA = 1
+
+# One observation per stream per served decision (the count-uniformity
+# discipline, applied to sketches): score = the chosen decision's
+# probability, action = the chosen cloud, cost/latency = the mean of the
+# observation's cost/latency feature columns. All four live on [0, 1]
+# by construction (softmax / normalized table), so one uniform bucket
+# grid serves every numeric stream.
+STREAMS = ("score", "action", "cost", "latency")
+ACTION_CATEGORIES = ("aws", "azure", "unknown")
+NUM_BINS = 16
+UNIT_EDGES = tuple(round((i + 1) / NUM_BINS, 6) for i in range(NUM_BINS - 1))
+# Shadow score deltas live on [-1, 1] (difference of two probabilities).
+DELTA_EDGES = tuple(round(-1.0 + 2.0 * (i + 1) / NUM_BINS, 6)
+                    for i in range(NUM_BINS - 1))
+
+_STREAM_SPECS: dict = {
+    "score": {"edges": UNIT_EDGES},
+    "action": {"categories": ACTION_CATEGORIES},
+    "cost": {"edges": UNIT_EDGES},
+    "latency": {"edges": UNIT_EDGES},
+}
+
+# compute_burn is reused verbatim for the drifting verdict: the
+# threshold-normalized PSI becomes a pseudo-availability bad-fraction at
+# _SCALE resolution against a 0.5 error budget with both burn thresholds
+# at 1.0, so burn_rate == min(psi/threshold, _BURN_CAP) and burning ==
+# over threshold in BOTH windows. Pinned by test against compute_burn.
+_SCALE = 1_000_000
+_BURN_BUDGET = 0.5
+_BURN_CAP = 8.0
+
+
+@dataclasses.dataclass(frozen=True)
+class DriftConfig:
+    """Drift scoring knobs. ``threshold`` is the PSI alarm bar (0.2 is
+    the classic "significant shift" bound); the window pair is the
+    multi-window burn construction; ``min_window_count`` keeps a
+    near-empty window from alarming on sampling noise; ``bucket_s`` is
+    the ring granularity (defaults to fast_window_s/8, clamped to
+    [0.05, 1] — sub-second buckets are what let a drill run fast
+    windows of a couple of seconds)."""
+
+    threshold: float = 0.2
+    fast_window_s: float = 60.0
+    slow_window_s: float = 600.0
+    min_window_count: int = 20
+    bucket_s: float | None = None
+
+    def __post_init__(self):
+        if self.threshold <= 0:
+            raise ValueError(f"drift threshold={self.threshold}: pass a "
+                             "positive PSI bound (e.g. 0.2)")
+        if not 0 < self.fast_window_s < self.slow_window_s:
+            raise ValueError(
+                f"drift windows fast={self.fast_window_s}s slow="
+                f"{self.slow_window_s}s: fast must be positive and "
+                "shorter than slow")
+        if self.min_window_count < 1:
+            raise ValueError("drift min_window_count must be >= 1")
+        if self.bucket_s is not None and not (
+                0 < self.bucket_s <= self.fast_window_s):
+            raise ValueError(
+                f"drift bucket_s={self.bucket_s}: must be positive and "
+                "no longer than the fast window")
+
+    @property
+    def ring_bucket_s(self) -> float:
+        if self.bucket_s is not None:
+            return self.bucket_s
+        return max(0.05, min(1.0, self.fast_window_s / 8.0))
+
+    def to_dict(self) -> dict:
+        return {
+            "threshold": self.threshold,
+            "fast_window_s": self.fast_window_s,
+            "slow_window_s": self.slow_window_s,
+            "min_window_count": self.min_window_count,
+            "bucket_s": self.ring_bucket_s,
+        }
+
+
+def config_from_snapshot(snapshot: dict) -> DriftConfig:
+    cfg = dict(snapshot["config"])
+    return DriftConfig(**cfg)
+
+
+def stream_size(name: str) -> int:
+    spec = _STREAM_SPECS[name]
+    if "categories" in spec:
+        return len(spec["categories"])
+    return len(spec["edges"]) + 1
+
+
+def bucket_index(name: str, value) -> int | None:
+    """Bucket index for one observation, or None when the value cannot
+    land (non-finite numeric, unknown stream)."""
+    spec = _STREAM_SPECS[name]
+    if "categories" in spec:
+        cats = spec["categories"]
+        label = value if value in cats else cats[-1]
+        return cats.index(label)
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return None
+    if math.isnan(v) or math.isinf(v):
+        return None
+    return min(bisect.bisect_right(spec["edges"], v), len(spec["edges"]))
+
+
+# --------------------------------------------------------------- distances
+
+
+def psi(live_counts, ref_counts, eps: float = 1e-4) -> float | None:
+    """Population Stability Index between two bucket-count vectors:
+    ``sum((p - q) * ln(p / q))`` over epsilon-floored probabilities.
+    ``None`` when the reference is empty (no basis to grade against);
+    0.0 when the live side is empty (no evidence of movement)."""
+    ref_total = sum(ref_counts)
+    if ref_total <= 0:
+        return None
+    live_total = sum(live_counts)
+    if live_total <= 0:
+        return 0.0
+    out = 0.0
+    for c, r in zip(live_counts, ref_counts):
+        p = max(c / live_total, eps)
+        q = max(r / ref_total, eps)
+        out += (p - q) * math.log(p / q)
+    return out
+
+
+def ks(live_counts, ref_counts) -> float | None:
+    """Kolmogorov-Smirnov distance (max CDF gap) between two bucket-count
+    vectors over the same fixed bucket order. On the categorical stream
+    the bucket order is the fixed ACTION_CATEGORIES order — stable, if
+    arbitrary, which is all KS needs to be comparable over time."""
+    ref_total = sum(ref_counts)
+    if ref_total <= 0:
+        return None
+    live_total = sum(live_counts)
+    if live_total <= 0:
+        return 0.0
+    worst = cdf_live = cdf_ref = 0.0
+    for c, r in zip(live_counts, ref_counts):
+        cdf_live += c / live_total
+        cdf_ref += r / ref_total
+        worst = max(worst, abs(cdf_live - cdf_ref))
+    return worst
+
+
+# ----------------------------------------------------------------- scoring
+
+
+def compute_scores(config: DriftConfig, streams: dict,
+                   reference: dict | None, generation: int) -> dict:
+    """Per-stream drift scores from raw window counts — shared by the
+    tracker snapshot and the pool/fleet merge (the ``compute_burn``
+    sharing discipline: per-worker and merged sections can never
+    disagree on the math). The drifting verdict itself is delegated to
+    ``slo.compute_burn`` (module doc)."""
+    ref_streams = (reference or {}).get("streams") or {}
+    ref_generation = (reference or {}).get("generation")
+    scores: dict = {}
+    for name, entry in streams.items():
+        if reference is None or name not in ref_streams:
+            status = "no_reference"
+        elif ref_generation is not None and ref_generation != generation:
+            status = "generation_mismatch"
+        else:
+            status = "ok"
+        ref_counts = (ref_streams.get(name) or {}).get("counts")
+        windows: dict = {}
+        psi_by_window: dict = {}
+        ks_by_window: dict = {}
+        burn_windows: dict = {}
+        for wname in slo_mod.WINDOWS:
+            raw = entry["windows_raw"][wname]
+            counts = raw["counts"]
+            n = sum(counts)
+            psi_v = ks_v = None
+            if status == "ok" and ref_counts:
+                psi_v = psi(counts, ref_counts)
+                ks_v = ks(counts, ref_counts)
+            psi_by_window[wname] = (None if psi_v is None
+                                    else round(psi_v, 6))
+            ks_by_window[wname] = None if ks_v is None else round(ks_v, 6)
+            windows[wname] = {"count": n,
+                              "sufficient": n >= config.min_window_count}
+            normalized = 0.0
+            if psi_v is not None and n >= config.min_window_count:
+                normalized = min(psi_v / config.threshold, _BURN_CAP)
+            burn_windows[wname] = (
+                raw["seconds"], _SCALE, 0,
+                int(round(normalized * _BURN_BUDGET * _SCALE)))
+        verdict = slo_mod.compute_burn(
+            slo_mod.SloConfig(availability=1.0 - _BURN_BUDGET,
+                              fast_window_s=config.fast_window_s,
+                              slow_window_s=config.slow_window_s,
+                              fast_burn=1.0, slow_burn=1.0),
+            burn_windows, lifetime={})
+    # burn_rate per window == min(psi/threshold, cap); burning ==
+    # over threshold in BOTH windows (compute_burn's AND).
+        availability = verdict["objectives"][slo_mod.AVAILABILITY]
+        scores[name] = {
+            "status": status,
+            "psi": psi_by_window,
+            "ks": ks_by_window,
+            "windows": windows,
+            "burn": {w: availability["windows"][w]["burn_rate"]
+                     for w in slo_mod.WINDOWS},
+            "drifting": bool(availability["burning"]),
+        }
+    return scores
+
+
+# --------------------------------------------------------------- the tracker
+
+
+class DriftTracker:
+    """Online per-stream sketches + drift scoring (module doc).
+
+    Thread-safe: serving threads record, the control-plane thread
+    snapshots. ``clock`` is injectable for tests (monotonic seconds).
+    Lifetime counts are monotonic — ``/stats/reset`` never rewinds them,
+    the same contract as the latency histograms (pinned by test)."""
+
+    def __init__(self, config: DriftConfig | None = None,
+                 clock=time.monotonic):
+        self.config = config or DriftConfig()
+        self._clock = clock
+        self._lock = threading.Lock()
+        bucket_s = self.config.ring_bucket_s
+        self._bucket_s = bucket_s
+        n = int(self.config.slow_window_s / bucket_s) + 2
+        self._n = n
+        self._ids = [-1] * n
+        self._ring = {name: [[0] * stream_size(name) for _ in range(n)]
+                      for name in STREAMS}
+        self._life_counts = {name: [0] * stream_size(name)
+                             for name in STREAMS}
+        self._life_n = {name: 0 for name in STREAMS}
+        # Host-side Welford per numeric stream (count, mean, m2, min, max)
+        # — the flight-recorder accumulator, merged with Chan's formula.
+        self._welford = {name: [0, 0.0, 0.0, math.inf, -math.inf]
+                         for name in STREAMS
+                         if "edges" in _STREAM_SPECS[name]}
+        self._reference: dict | None = None
+
+    # ------------------------------------------------------------ recording
+
+    def set_reference(self, reference: dict | None) -> None:
+        with self._lock:
+            self._reference = reference
+
+    @property
+    def reference(self) -> dict | None:
+        with self._lock:
+            return self._reference
+
+    def _slot(self, now: float) -> int:
+        bucket_id = int(now / self._bucket_s)
+        slot = bucket_id % self._n
+        if self._ids[slot] != bucket_id:
+            self._ids[slot] = bucket_id
+            for rows in self._ring.values():
+                row = rows[slot]
+                for i in range(len(row)):
+                    row[i] = 0
+        return slot
+
+    def observe_decision(self, cloud, score, cost=None,
+                         latency=None) -> None:
+        """One served decision: at most one observation per stream.
+        ``None`` feature values (a family whose observation carries no
+        cost/latency columns) skip that stream — never a zero-fill."""
+        samples = {"score": score, "action": cloud,
+                   "cost": cost, "latency": latency}
+        with self._lock:
+            slot = self._slot(self._clock())
+            for name, value in samples.items():
+                if value is None:
+                    continue
+                idx = bucket_index(name, value)
+                if idx is None:
+                    continue
+                self._ring[name][slot][idx] += 1
+                self._life_counts[name][idx] += 1
+                self._life_n[name] += 1
+                acc = self._welford.get(name)
+                if acc is not None:
+                    v = float(value)
+                    acc[0] += 1
+                    delta = v - acc[1]
+                    acc[1] += delta / acc[0]
+                    acc[2] += delta * (v - acc[1])
+                    acc[3] = min(acc[3], v)
+                    acc[4] = max(acc[4], v)
+
+    # ------------------------------------------------------------ snapshots
+
+    def _window_counts(self, name: str, now: float,
+                       window_s: float) -> list:
+        """Bucket counts over the trailing window. Caller holds lock."""
+        now_id = int(now / self._bucket_s)
+        first = now_id - int(window_s / self._bucket_s) + 1
+        counts = [0] * stream_size(name)
+        rows = self._ring[name]
+        for bucket_id in range(first, now_id + 1):
+            slot = bucket_id % self._n
+            if self._ids[slot] != bucket_id:
+                continue
+            row = rows[slot]
+            for i, c in enumerate(row):
+                counts[i] += c
+        return counts
+
+    def snapshot(self, generation: int = 0) -> dict:
+        cfg = self.config
+        with self._lock:
+            now = self._clock()
+            streams: dict = {}
+            for name in STREAMS:
+                spec = _STREAM_SPECS[name]
+                entry: dict = {
+                    "windows_raw": {
+                        "fast": {"seconds": cfg.fast_window_s,
+                                 "counts": self._window_counts(
+                                     name, now, cfg.fast_window_s)},
+                        "slow": {"seconds": cfg.slow_window_s,
+                                 "counts": self._window_counts(
+                                     name, now, cfg.slow_window_s)},
+                    },
+                    "lifetime": {
+                        "count": self._life_n[name],
+                        "counts": list(self._life_counts[name]),
+                    },
+                }
+                if "edges" in spec:
+                    entry["edges"] = list(spec["edges"])
+                    acc = self._welford[name]
+                    life = entry["lifetime"]
+                    life["mean"] = round(acc[1], 6) if acc[0] else None
+                    life["m2"] = round(acc[2], 6)
+                    life["std"] = (round(math.sqrt(acc[2] / acc[0]), 6)
+                                   if acc[0] else None)
+                    life["min"] = acc[3] if acc[0] else None
+                    life["max"] = acc[4] if acc[0] else None
+                else:
+                    entry["categories"] = list(spec["categories"])
+                streams[name] = entry
+            reference = self._reference
+        scores = compute_scores(cfg, streams, reference, generation)
+        return {
+            "schema": DRIFT_SCHEMA,
+            "generation": generation,
+            "config": cfg.to_dict(),
+            "streams": streams,
+            "reference": reference,
+            "scores": scores,
+            "drifting": sorted(name for name, s in scores.items()
+                               if s["drifting"]),
+        }
+
+
+def merge_snapshots(snapshots: list) -> dict | None:
+    """Pool/fleet-wide drift section: window and lifetime bucket counts
+    sum across workers, Welford moments merge with Chan's formula, and
+    distances + verdicts recompute from the sums via
+    :func:`compute_scores`. ``None`` when no worker tracks drift — a
+    version-skewed worker or pool without a drift section contributes
+    NOTHING, it is never zero-filled into a distance. The output is
+    shaped like a tracker snapshot, so the fleet re-merges pool
+    sections with this same function (closed under merge)."""
+    snapshots = [s for s in snapshots if s]
+    if not snapshots:
+        return None
+    config = config_from_snapshot(snapshots[0])
+    generation = max(s.get("generation", 0) for s in snapshots)
+    streams: dict = {}
+    for name in STREAMS:
+        entries = [s["streams"][name] for s in snapshots
+                   if name in s.get("streams", {})]
+        if not entries:
+            continue
+        size = stream_size(name)
+        merged: dict = {"windows_raw": {}}
+        for wname in slo_mod.WINDOWS:
+            counts = [0] * size
+            seconds = 0.0
+            for entry in entries:
+                raw = entry["windows_raw"][wname]
+                seconds = max(seconds, raw["seconds"])
+                for i, c in enumerate(raw["counts"][:size]):
+                    counts[i] += c
+            merged["windows_raw"][wname] = {"seconds": seconds,
+                                            "counts": counts}
+        life_counts = [0] * size
+        life_n = 0
+        for entry in entries:
+            life = entry["lifetime"]
+            life_n += life["count"]
+            for i, c in enumerate(life["counts"][:size]):
+                life_counts[i] += c
+        merged["lifetime"] = {"count": life_n, "counts": life_counts}
+        spec = _STREAM_SPECS[name]
+        if "edges" in spec:
+            merged["edges"] = list(spec["edges"])
+            n_acc, mean, m2 = 0, 0.0, 0.0
+            lo, hi = math.inf, -math.inf
+            for entry in entries:
+                life = entry["lifetime"]
+                nb = life["count"]
+                if not nb:
+                    continue
+                mb = life.get("mean") or 0.0
+                m2b = life.get("m2") or 0.0
+                delta = mb - mean
+                total = n_acc + nb
+                mean += delta * nb / total
+                m2 += m2b + delta * delta * n_acc * nb / total
+                n_acc = total
+                if life.get("min") is not None:
+                    lo = min(lo, life["min"])
+                if life.get("max") is not None:
+                    hi = max(hi, life["max"])
+            life = merged["lifetime"]
+            life["mean"] = round(mean, 6) if n_acc else None
+            life["m2"] = round(m2, 6)
+            life["std"] = (round(math.sqrt(m2 / n_acc), 6)
+                           if n_acc else None)
+            life["min"] = lo if n_acc else None
+            life["max"] = hi if n_acc else None
+        else:
+            merged["categories"] = list(spec["categories"])
+        streams[name] = merged
+    references = [s.get("reference") for s in snapshots
+                  if s.get("reference")]
+    reference = references[0] if references else None
+    fingerprints = {r.get("fingerprint") for r in references}
+    scores = compute_scores(config, streams, reference, generation)
+    out = {
+        "schema": DRIFT_SCHEMA,
+        "generation": generation,
+        "config": config.to_dict(),
+        "streams": streams,
+        "reference": reference,
+        "scores": scores,
+        "drifting": sorted(name for name, s in scores.items()
+                           if s["drifting"]),
+    }
+    if len(fingerprints) > 1:
+        # Workers of one pool share one serve config; divergence (a
+        # mid-roll reference swap) must be VISIBLE, never averaged away.
+        out["reference_mixed"] = True
+    return out
+
+
+# -------------------------------------------------------------- references
+
+
+def reference_fingerprint(reference: dict) -> str:
+    """Content fingerprint over the distribution itself (schema +
+    generation + stream counts/edges) — NOT over provenance fields, so
+    re-capturing identical counts yields an identical fingerprint."""
+    body = {
+        "schema": reference.get("schema", REFERENCE_SCHEMA),
+        "generation": reference.get("generation", 0),
+        "streams": reference.get("streams") or {},
+    }
+    canonical = json.dumps(body, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(canonical.encode()).hexdigest()
+
+
+def build_reference(drift_snapshot: dict, source: str = "") -> dict:
+    """Freeze a reference from a drift section's LIFETIME counts (the
+    full distribution the plane has served under this generation)."""
+    streams: dict = {}
+    for name, entry in (drift_snapshot.get("streams") or {}).items():
+        life = entry.get("lifetime") or {}
+        stream = {
+            "counts": [int(c) for c in life.get("counts") or []],
+            "count": int(life.get("count") or 0),
+        }
+        if entry.get("edges") is not None:
+            stream["edges"] = list(entry["edges"])
+        if entry.get("categories") is not None:
+            stream["categories"] = list(entry["categories"])
+        streams[name] = stream
+    ref = {
+        "schema": REFERENCE_SCHEMA,
+        "generation": int(drift_snapshot.get("generation", 0)),
+        "source": source,
+        "streams": streams,
+    }
+    ref["fingerprint"] = reference_fingerprint(ref)
+    return ref
+
+
+def reference_from_trace(trace_dir: str) -> dict:
+    """Freeze a reference from a recorded trace dir (the eval-corpus
+    path). Trace records carry the chosen score and — for the flat
+    family — the chosen cloud, but only an observation DIGEST, so a
+    trace-built reference covers the ``score`` (and, flat-family,
+    ``action``) streams; the feature streams stay ungraded
+    (``no_reference``) until a live snapshot replaces it. Synthetic
+    records (probe/shadow) and fail-opens are excluded, and only the
+    NEWEST generation present is counted — references are
+    per-generation."""
+    from rl_scheduler_tpu.scheduler.tracelog import (
+        is_synthetic_endpoint,
+        iter_trace_merged,
+    )
+
+    generations: dict = {}
+    for record in iter_trace_merged(trace_dir):
+        if is_synthetic_endpoint(record.get("endpoint")):
+            continue
+        if record.get("fail_open"):
+            continue
+        gen = int(record.get("generation", 0))
+        bucket = generations.setdefault(gen, {
+            "score": [0] * stream_size("score"),
+            "action": [0] * stream_size("action"),
+            "records": 0, "actions": 0,
+        })
+        score = record.get("score")
+        idx = bucket_index("score", score) if score is not None else None
+        if idx is None:
+            continue
+        bucket["score"][idx] += 1
+        bucket["records"] += 1
+        chosen = record.get("chosen")
+        if chosen in ACTION_CATEGORIES:
+            bucket["action"][bucket_index("action", chosen)] += 1
+            bucket["actions"] += 1
+    if not generations or not any(b["records"]
+                                  for b in generations.values()):
+        raise ValueError(
+            f"{trace_dir}: no scorable decision records (synthetic "
+            "records and fail-opens are excluded) — serve real traffic "
+            "before freezing a reference")
+    gen = max(g for g, b in generations.items() if b["records"])
+    bucket = generations[gen]
+    streams = {"score": {"counts": bucket["score"],
+                         "count": bucket["records"],
+                         "edges": list(UNIT_EDGES)}}
+    if bucket["actions"]:
+        streams["action"] = {"counts": bucket["action"],
+                             "count": bucket["actions"],
+                             "categories": list(ACTION_CATEGORIES)}
+    ref = {
+        "schema": REFERENCE_SCHEMA,
+        "generation": gen,
+        "source": f"trace:{trace_dir}",
+        "streams": streams,
+    }
+    ref["fingerprint"] = reference_fingerprint(ref)
+    return ref
+
+
+def save_reference(path: str, reference: dict) -> None:
+    from rl_scheduler_tpu.utils.fsio import atomic_write_json
+
+    atomic_write_json(path, reference)
+
+
+def load_reference(path: str) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        ref = json.load(fh)
+    if not isinstance(ref, dict) or ref.get("schema") != REFERENCE_SCHEMA:
+        raise ValueError(f"{path}: not a drift reference "
+                         f"(schema {REFERENCE_SCHEMA} expected)")
+    expected = reference_fingerprint(ref)
+    if ref.get("fingerprint") != expected:
+        raise ValueError(
+            f"{path}: reference fingerprint mismatch (stored "
+            f"{str(ref.get('fingerprint'))[:12]}…, distribution hashes "
+            f"to {expected[:12]}…) — the file was edited or truncated; "
+            "re-snapshot instead of repairing by hand")
+    return ref
+
+
+# ----------------------------------------------------------- shadow scoring
+
+
+class ShadowScorer:
+    """Candidate-checkpoint shadow scoring off the serving thread
+    (module doc). ``score_fn(obs) -> (action, score)`` runs the
+    candidate backend; serving threads call :meth:`submit` (bounded
+    queue, drop-newest-on-full — the serving thread NEVER blocks), a
+    single daemon worker drains it. ``record_fn(action, score,
+    latency_ms, obs)``, when given, appends the ``endpoint=shadow``
+    trace record. Errors count and never propagate: a broken shadow
+    cannot touch serving."""
+
+    def __init__(self, score_fn, record_fn=None, queue_size: int = 512):
+        self._score_fn = score_fn
+        self._record_fn = record_fn
+        self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._lock = threading.Lock()
+        self.submitted_total = 0
+        self.scored_total = 0
+        self.dropped_total = 0
+        self.errors_total = 0
+        self.agreements_total = 0
+        self._delta_counts = [0] * (len(DELTA_EDGES) + 1)
+        self._delta_sum = 0.0
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="shadow-scorer")
+        self._thread.start()
+
+    def submit(self, obs, action: int, score: float) -> None:
+        with self._lock:
+            self.submitted_total += 1
+        try:
+            self._queue.put_nowait((obs, int(action), float(score)))
+        except queue.Full:
+            with self._lock:
+                self.dropped_total += 1
+
+    def _loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            obs, action, score = item
+            t0 = time.perf_counter()
+            try:
+                shadow_action, shadow_score = self._score_fn(obs)
+            except Exception:  # noqa: BLE001 - shadow never hurts serving
+                with self._lock:
+                    self.errors_total += 1
+                logger.warning("shadow score_fn failed", exc_info=True)
+                continue
+            latency_ms = (time.perf_counter() - t0) * 1e3
+            delta = float(shadow_score) - score
+            idx = min(bisect.bisect_right(DELTA_EDGES, delta),
+                      len(DELTA_EDGES))
+            with self._lock:
+                self.scored_total += 1
+                if int(shadow_action) == action:
+                    self.agreements_total += 1
+                self._delta_counts[idx] += 1
+                self._delta_sum += delta
+            if self._record_fn is not None:
+                try:
+                    self._record_fn(int(shadow_action),
+                                    float(shadow_score), latency_ms, obs)
+                except Exception:  # noqa: BLE001 - trace is best-effort
+                    with self._lock:
+                        self.errors_total += 1
+                    logger.warning("shadow record_fn failed", exc_info=True)
+
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """Wait for the queue to empty (tests and drills; serving never
+        calls this)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._queue.empty():
+                return True
+            time.sleep(0.01)
+        return self._queue.empty()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            scored = self.scored_total
+            return {
+                "submitted_total": self.submitted_total,
+                "scored_total": scored,
+                "dropped_total": self.dropped_total,
+                "errors_total": self.errors_total,
+                "agreements_total": self.agreements_total,
+                "agreement_rate": (round(self.agreements_total / scored, 4)
+                                   if scored else None),
+                "score_delta": {
+                    "edges": list(DELTA_EDGES),
+                    "counts": list(self._delta_counts),
+                    "count": scored,
+                    "sum": round(self._delta_sum, 6),
+                    "mean": (round(self._delta_sum / scored, 6)
+                             if scored else None),
+                },
+            }
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._queue.put_nowait(None)
+        except queue.Full:
+            pass
+        self._thread.join(timeout=2.0)
+        if self._thread.is_alive():
+            # A wedged score_fn survives the timed join; the daemon
+            # thread dies with the interpreter and its in-flight shadow
+            # record is lost — counters already on /stats stay valid.
+            logger.error("shadow scorer failed to drain within 2s; "
+                         "abandoning the worker thread")
+
+
+def sum_shadow(sections: list) -> dict | None:
+    """Pool/fleet-wide shadow section: counters and delta-histogram
+    counts sum exactly across workers; the agreement rate and delta mean
+    recompute from the sums. ``None`` when no worker shadows."""
+    sections = [s for s in sections if s]
+    if not sections:
+        return None
+    keys = ("submitted_total", "scored_total", "dropped_total",
+            "errors_total", "agreements_total")
+    out = {k: sum(int(s.get(k, 0)) for s in sections) for k in keys}
+    scored = out["scored_total"]
+    out["agreement_rate"] = (round(out["agreements_total"] / scored, 4)
+                             if scored else None)
+    counts = [0] * (len(DELTA_EDGES) + 1)
+    delta_sum = 0.0
+    for s in sections:
+        delta = s.get("score_delta") or {}
+        for i, c in enumerate((delta.get("counts") or [])[:len(counts)]):
+            counts[i] += c
+        delta_sum += delta.get("sum") or 0.0
+    out["score_delta"] = {
+        "edges": list(DELTA_EDGES),
+        "counts": counts,
+        "count": scored,
+        "sum": round(delta_sum, 6),
+        "mean": round(delta_sum / scored, 6) if scored else None,
+    }
+    return out
+
+
+# -------------------------------------------------------------- exposition
+
+
+def drift_metric_lines(prefix: str, snapshot: dict) -> list:
+    """Prometheus exposition for a drift section — shared by the single
+    plane, the pool supervisor and the fleet controller (the
+    ``slo_metric_lines`` sharing discipline)."""
+    p = prefix
+    scores = snapshot.get("scores") or {}
+    lines = [
+        f"# HELP {p}_drift_score Distribution distance vs the frozen "
+        "reference, per stream and trailing window.",
+        f"# TYPE {p}_drift_score gauge",
+    ]
+    for name in sorted(scores):
+        for kind in ("psi", "ks"):
+            for wname in slo_mod.WINDOWS:
+                value = scores[name][kind][wname]
+                if value is None:
+                    continue
+                lines.append(
+                    f'{p}_drift_score{{stream="{name}",window="{wname}",'
+                    f'kind="{kind}"}} {value:.6g}')
+    lines += [
+        f"# HELP {p}_drifting Stream over the PSI threshold in BOTH "
+        "burn windows (slo.compute_burn semantics).",
+        f"# TYPE {p}_drifting gauge",
+    ]
+    for name in sorted(scores):
+        lines.append(f'{p}_drifting{{stream="{name}"}} '
+                     f'{1 if scores[name]["drifting"] else 0}')
+    lines += [
+        f"# HELP {p}_drift_observations_total Lifetime sketch "
+        "observations per stream (monotonic; reset never rewinds).",
+        f"# TYPE {p}_drift_observations_total counter",
+    ]
+    for name in sorted(snapshot.get("streams") or {}):
+        count = snapshot["streams"][name]["lifetime"]["count"]
+        lines.append(
+            f'{p}_drift_observations_total{{stream="{name}"}} {count}')
+    reference = snapshot.get("reference")
+    lines += [
+        f"# HELP {p}_drift_reference Loaded reference distribution "
+        "(1 = loaded; fingerprint/generation as labels).",
+        f"# TYPE {p}_drift_reference gauge",
+    ]
+    if reference:
+        fp = str(reference.get("fingerprint", ""))[:12]
+        lines.append(
+            f'{p}_drift_reference{{fingerprint="{fp}",'
+            f'generation="{reference.get("generation", 0)}"}} 1')
+    else:
+        lines.append(f'{p}_drift_reference 0')
+    return lines
+
+
+def shadow_metric_lines(prefix: str, section: dict) -> list:
+    p = prefix
+    lines = []
+    for key, help_text in (
+        ("scored_total", "Live requests re-scored by the shadow "
+                         "candidate (lifetime)."),
+        ("dropped_total", "Shadow submissions dropped by the bounded "
+                          "queue (lifetime)."),
+        ("errors_total", "Shadow scoring errors (lifetime; serving is "
+                         "never affected)."),
+        ("agreements_total", "Shadow top-1 choices agreeing with the "
+                             "incumbent (lifetime)."),
+    ):
+        lines += [
+            f"# HELP {p}_shadow_{key} {help_text}",
+            f"# TYPE {p}_shadow_{key} counter",
+            f"{p}_shadow_{key} {section.get(key, 0)}",
+        ]
+    rate = section.get("agreement_rate")
+    lines += [
+        f"# HELP {p}_shadow_agreement Incumbent-vs-shadow top-1 "
+        "agreement rate (lifetime).",
+        f"# TYPE {p}_shadow_agreement gauge",
+        f"{p}_shadow_agreement {-1 if rate is None else rate}",
+    ]
+    mean = (section.get("score_delta") or {}).get("mean")
+    lines += [
+        f"# HELP {p}_shadow_score_delta_mean Mean (shadow top-1 score - "
+        "incumbent score), lifetime.",
+        f"# TYPE {p}_shadow_score_delta_mean gauge",
+        f"{p}_shadow_score_delta_mean {0 if mean is None else mean:.6g}",
+    ]
+    return lines
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def _load_stats(source: str) -> dict:
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+
+        with urllib.request.urlopen(source, timeout=10) as resp:
+            return json.loads(resp.read())
+    with open(source, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m rl_scheduler_tpu.scheduler.drift",
+        description="graftdrift reference tooling (module doc)")
+    sub = parser.add_subparsers(dest="command", required=True)
+    snap = sub.add_parser(
+        "snapshot",
+        help="freeze a fingerprinted reference distribution from a live "
+             "pool's /stats (lifetime counts) or a recorded trace dir")
+    snap.add_argument("--stats", default=None, metavar="URL|FILE",
+                      help="a /stats body (live URL or saved JSON) whose "
+                           "drift section's lifetime counts become the "
+                           "reference")
+    snap.add_argument("--trace", default=None, metavar="DIR",
+                      help="a recorded trace dir (eval corpus): score/"
+                           "action streams only — trace records carry "
+                           "no feature columns")
+    snap.add_argument("--out", required=True, metavar="FILE",
+                      help="reference JSON path (written atomically)")
+    args = parser.parse_args(argv)
+    if (args.stats is None) == (args.trace is None):
+        parser.error("snapshot: pass exactly one of --stats / --trace")
+    if args.stats is not None:
+        stats = _load_stats(args.stats)
+        section = stats.get("drift")
+        if not section:
+            print(f"error: {args.stats} has no drift section — start "
+                  "the server with --drift", file=sys.stderr)
+            return 2
+        if not any((e.get("lifetime") or {}).get("count")
+                   for e in (section.get("streams") or {}).values()):
+            print(f"error: {args.stats}: drift sketches are empty — "
+                  "serve traffic before freezing a reference",
+                  file=sys.stderr)
+            return 2
+        ref = build_reference(section, source=f"stats:{args.stats}")
+    else:
+        try:
+            ref = reference_from_trace(args.trace)
+        except ValueError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+    save_reference(args.out, ref)
+    counts = {name: s["count"] for name, s in ref["streams"].items()}
+    print(json.dumps({"out": args.out, "generation": ref["generation"],
+                      "fingerprint": ref["fingerprint"],
+                      "stream_counts": counts}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

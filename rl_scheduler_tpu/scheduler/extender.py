@@ -30,9 +30,13 @@ telemetry-observe, backend-forward, priority-marshal, trace-append —
 feeding one :class:`LatencyStats` per phase (``/stats`` percentiles,
 ``/metrics`` lifetime histograms, span breakdown on every trace record),
 plus an optional SLO engine (``scheduler/slo.py``: ``--slo-p99-ms`` /
-``--slo-avail`` burn-rate gauges, ``/healthz`` degradation). Synthetic
-``warmup_probe`` traffic is excluded from every client-facing histogram
-and SLO counter at record time.
+``--slo-avail`` burn-rate gauges, ``/healthz`` degradation). graftdrift
+(``scheduler/drift.py``, ``--drift``/``--shadow-run``) adds
+distribution-shift sketches on the same hot path and an optional
+candidate checkpoint scoring live requests in shadow. Synthetic traffic
+(``endpoint in tracelog.SYNTHETIC_ENDPOINTS``: warmup probes, shadow
+scores) is excluded from every client-facing histogram, SLO counter and
+drift sketch at record time.
 
 Node -> cloud mapping uses the ``cloud: aws|azure`` node labels that the
 kind cluster configs apply (reference ``aws-cluster-config.yaml:12-14``),
@@ -60,6 +64,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from rl_scheduler_tpu.scheduler.drift import (
+    drift_metric_lines,
+    shadow_metric_lines,
+)
 from rl_scheduler_tpu.scheduler.policy_backend import make_backend
 from rl_scheduler_tpu.scheduler.tracelog import decision_record, obs_digest
 from rl_scheduler_tpu.scheduler.wire import (
@@ -554,6 +562,15 @@ class ExtenderPolicy:
         # lives in the backend (--backend native-int8).
         self.score_cache = None
         self.batcher = None
+        # graftdrift (scheduler/drift.py): the distribution-shift sketches
+        # and the optional shadow scorer, both None by default (hot path
+        # untouched); build_policy attaches them from --drift /
+        # --shadow-run. The drift tracker records in _record_trace (so
+        # probes/shadow/fail-opens are excluded in ONE place); the shadow
+        # scorer is fed at the decide sites where (obs, action, score)
+        # are all in scope.
+        self.drift = None
+        self.shadow = None
         # Candidate-list cap for the structured families — the same idea
         # as kube-scheduler's percentageOfNodesToScore: scoring cost per
         # request is O(cap) no matter how large the fleet's node list
@@ -690,6 +707,27 @@ class ExtenderPolicy:
         if self.slo is not None:
             self.slo.observe(seconds)
 
+    def _drift_features(self, obs) -> tuple:
+        """The drift tracker's input-telemetry features for one served
+        observation: the mean of its cost and latency columns. The flat
+        layout is ``[cost_aws, cost_azure, lat_aws, lat_azure, ...]``;
+        both structured table layouts put cost/latency in columns 0/1
+        (``observe_nodes`` / ``observe_nodes_het``). The graph family's
+        raw-dollar prices are not on the normalized [0, 1] scale, so its
+        feature streams record nothing (never garbage buckets) — its
+        score/action streams still track."""
+        if obs is None or self.family == "graph":
+            return None, None
+        try:
+            arr = np.asarray(obs)
+            if arr.ndim == 1 and arr.size >= 4:
+                return float(arr[0:2].mean()), float(arr[2:4].mean())
+            if arr.ndim == 2 and arr.shape[1] >= 2:
+                return float(arr[:, 0].mean()), float(arr[:, 1].mean())
+        except Exception:  # noqa: BLE001 — sketches must never hurt serving
+            logger.debug("drift feature extraction failed", exc_info=True)
+        return None, None
+
     def _record_trace(self, endpoint: str, *, candidates: int,
                       chosen: str | None, score: float | None, obs,
                       t0: float, fail_open: bool = False,
@@ -709,6 +747,16 @@ class ExtenderPolicy:
         --replay-trace`` rebuild workloads from."""
         pod_cpu = getattr(self._req_local, "pod_cpu", None)
         self._req_local.pod_cpu = None
+        if self.drift is not None and not fail_open \
+                and not self._synthetic and score is not None:
+            # graftdrift sketches, exactly one observation per stream per
+            # SERVED decision — recorded here so the exclusions (probes,
+            # shadow, fail-opens) mirror the histograms' in one place.
+            cloud = (chosen if chosen in CLOUDS
+                     else node_cloud(chosen) if chosen else None)
+            cost, lat = self._drift_features(obs)
+            self.drift.observe_decision(cloud or "unknown", score,
+                                        cost, lat)
         if fail_open:
             with self._lock:
                 self._fail_open_total += 1
@@ -763,6 +811,12 @@ class ExtenderPolicy:
         with self._lock:
             self._decisions[CLOUDS[action]] += 1
         self._span_add("marshal", time.perf_counter() - t_fwd)
+        if self.shadow is not None and not self._synthetic:
+            # graftdrift shadow scoring: one non-blocking enqueue AFTER
+            # the marshal span closed — the served answer, its latency
+            # samples and its phase counts are bitwise those of a
+            # shadow-off run (pinned by test).
+            self.shadow.submit(obs, action, float(probs[action]))
         return action, probs, obs
 
     def _fastpath_forward(self, obs):
@@ -805,6 +859,10 @@ class ExtenderPolicy:
         with self._lock:
             self._decisions[clouds[action] or "unknown"] += 1
         self._span_add("marshal", time.perf_counter() - t_hit)
+        if self.shadow is not None and not self._synthetic:
+            # Cache hits shadow-score too: the candidate grades against
+            # the live request mix, not just the cache-miss slice.
+            self.shadow.submit(obs, action, float(probs[action]))
         return action, probs, obs
 
     def decide_set(self, clouds: list, pod_cpu: float,
@@ -864,6 +922,8 @@ class ExtenderPolicy:
         with self._lock:
             self._decisions[clouds[action] or "unknown"] += 1
         self._span_add("marshal", time.perf_counter() - t_fwd)
+        if self.shadow is not None and not self._synthetic:
+            self.shadow.submit(obs, action, float(probs[action]))
         return action, probs, obs
 
     def decide_graph(self, clouds: list, display: list,
@@ -1164,6 +1224,43 @@ class ExtenderPolicy:
             out["ok"] = bool(ok)
         return out
 
+    def flip_tables(self, data_path: str) -> dict:
+        """graftdrift regime flip: swap the replayed price table in
+        place (``POST /telemetry/flip`` on the pool control plane;
+        ``extender_bench --flip-tables`` drives it mid-soak). The new
+        table goes through the same ``load_table`` validation the
+        startup path uses — a bad flip refuses, it never serves
+        half-validated prices."""
+        from rl_scheduler_tpu.data.loader import load_table
+
+        table = load_table(data_path)
+        self.telemetry.swap_table(np.asarray(table.costs),
+                                  np.asarray(table.latencies))
+        logger.info("telemetry table flipped to %s (%d rows, swap #%d)",
+                    data_path, len(np.asarray(table.costs)),
+                    self.telemetry.swaps_total)
+        return {"swapped": True, "rows": int(len(np.asarray(table.costs))),
+                "swaps_total": self.telemetry.swaps_total}
+
+    def set_drift_reference(self, path: str) -> dict:
+        """Load a frozen reference (``drift snapshot`` output) into the
+        drift tracker — fingerprint-verified by ``load_reference``, so a
+        hand-edited file refuses here instead of silently grading
+        against a tampered distribution."""
+        if self.drift is None:
+            raise ValueError(
+                "drift tracking is not armed on this policy (start the "
+                "server with --drift)")
+        from rl_scheduler_tpu.scheduler.drift import load_reference
+
+        ref = load_reference(path)
+        self.drift.set_reference(ref)
+        logger.info("drift reference loaded from %s (generation %d, "
+                    "fingerprint %s)", path, ref["generation"],
+                    ref["fingerprint"][:12])
+        return {"loaded": True, "generation": ref["generation"],
+                "fingerprint": ref["fingerprint"]}
+
     def filter(self, args: dict) -> dict:
         """ExtenderFilterResult: keep nodes on the chosen cloud; fail open."""
         if self.family in self.STRUCTURED:
@@ -1429,6 +1526,18 @@ class ExtenderPolicy:
             }
             if snap["degraded"]:
                 out["status"] = "degraded"
+        if self.drift is not None:
+            # Body-only (status untouched): a drifting stream is a
+            # RETRAIN trigger for the loop daemon, not a liveness or
+            # readiness failure — the plane still answers correctly,
+            # just under a moved distribution.
+            snap = self.drift.snapshot(generation=self.generation)
+            out["drift"] = {
+                "drifting": snap["drifting"],
+                "reference": bool(snap["reference"]),
+                "statuses": {name: s["status"]
+                             for name, s in snap["scores"].items()},
+            }
         if self.scenario is not None:
             out["scenario"] = self.scenario
         if self.pool_info is not None:
@@ -1470,6 +1579,14 @@ class ExtenderPolicy:
             out["fastpath"] = fastpath
         if self.slo is not None:
             out["slo"] = self.slo.snapshot()
+        if self.drift is not None:
+            # graftdrift section: sketches + scores vs the loaded
+            # reference (scheduler/drift.py). Lifetime counts are
+            # monotonic like the histograms — /stats/reset never rewinds
+            # them (pinned by test).
+            out["drift"] = self.drift.snapshot(generation=self.generation)
+        if self.shadow is not None:
+            out["shadow"] = self.shadow.snapshot()
         if self.trace is not None:
             # Trace-writer counters (records/dropped/write_errors/
             # segments). Lifetime-monotonic like the histogram —
@@ -1563,6 +1680,11 @@ class ExtenderPolicy:
                     for phase, stats in self.phase_stats.items()})
         if self.slo is not None:
             lines += slo_metric_lines(p, self.slo.snapshot())
+        if self.drift is not None:
+            lines += drift_metric_lines(
+                p, self.drift.snapshot(generation=self.generation))
+        if self.shadow is not None:
+            lines += shadow_metric_lines(p, self.shadow.snapshot())
         lines += fastpath_metric_lines(p, self.fastpath_snapshot())
         shed = getattr(self.backend, "shed_fraction", None)
         if shed is not None:
@@ -1821,6 +1943,14 @@ def build_policy(
     batch_max: int = 8,
     score_cache_epoch_s: float = 0.0,
     score_cache_entries: int = 256,
+    drift: bool = False,
+    drift_ref: str | None = None,
+    drift_threshold: float | None = None,
+    drift_fast_window_s: float | None = None,
+    drift_slow_window_s: float | None = None,
+    drift_min_count: int | None = None,
+    drift_bucket_s: float | None = None,
+    shadow_run: str | None = None,
 ) -> ExtenderPolicy:
     """Assemble the serving stack: checkpoint -> backend -> telemetry.
 
@@ -2071,6 +2201,106 @@ def build_policy(
 
         policy.score_cache = ScoreCache(epoch_s=score_cache_epoch_s,
                                         max_entries=score_cache_entries)
+    # graftdrift (scheduler/drift.py) — refuse-before-traffic like every
+    # serve-config knob above: a drift sub-flag without --drift would
+    # silently track nothing.
+    drift_sub = {"drift_ref": drift_ref, "drift_threshold": drift_threshold,
+                 "drift_fast_window_s": drift_fast_window_s,
+                 "drift_slow_window_s": drift_slow_window_s,
+                 "drift_min_count": drift_min_count,
+                 "drift_bucket_s": drift_bucket_s}
+    if not drift and any(v is not None for v in drift_sub.values()):
+        named = sorted(k for k, v in drift_sub.items() if v is not None)
+        raise ValueError(
+            f"{', '.join(named)}: drift knobs configure the --drift "
+            "tracker; pass drift=True (--drift) or drop them")
+    if drift:
+        from rl_scheduler_tpu.scheduler.drift import (
+            DriftConfig,
+            DriftTracker,
+            load_reference,
+        )
+
+        cfg_kwargs: dict = {}
+        if drift_threshold is not None:
+            cfg_kwargs["threshold"] = drift_threshold
+        if drift_fast_window_s is not None:
+            cfg_kwargs["fast_window_s"] = drift_fast_window_s
+        if drift_slow_window_s is not None:
+            cfg_kwargs["slow_window_s"] = drift_slow_window_s
+        if drift_min_count is not None:
+            cfg_kwargs["min_window_count"] = drift_min_count
+        if drift_bucket_s is not None:
+            cfg_kwargs["bucket_s"] = drift_bucket_s
+        # DriftConfig validates up front (bad windows/threshold refuse
+        # before traffic, like SloConfig).
+        policy.drift = DriftTracker(DriftConfig(**cfg_kwargs))
+        if drift_ref is not None:
+            policy.drift.set_reference(load_reference(drift_ref))
+    if shadow_run is not None:
+        # graftdrift shadow scoring: a SECOND policy build supplies the
+        # candidate backend (same checkpoint restore + warm path as the
+        # incumbent); only its backend is kept. The family must match —
+        # comparing a per-node pointer to a cloud argmax is not an
+        # agreement signal — and a shadow that fell back to greedy
+        # (corrupt/missing checkpoint) is refused outright: silently
+        # grading the incumbent against the fallback would report
+        # meaningless agreement.
+        if policy.family == "graph":
+            raise ValueError(
+                "shadow_run: shadow scoring covers the cloud and set "
+                "families; the graph family's per-request topology is "
+                "not reproducible from the queued observation alone")
+        shadow_policy = build_policy(
+            backend=backend, run=shadow_run, serve_device=serve_device,
+            spans=False)
+        shadow_backend = shadow_policy.backend
+        shadow_name = getattr(shadow_backend, "name",
+                              shadow_backend.__class__.__name__)
+        if backend != "greedy" and shadow_name == "greedy":
+            raise ValueError(
+                f"shadow_run={shadow_run}: the shadow checkpoint failed "
+                "to load (greedy fallback) — fix the run dir; a greedy "
+                "shadow grades nothing")
+        if shadow_policy.family != policy.family:
+            raise ValueError(
+                f"shadow_run={shadow_run}: shadow family "
+                f"{shadow_policy.family!r} != incumbent family "
+                f"{policy.family!r}; shadow a matching checkpoint")
+        from rl_scheduler_tpu.scheduler.drift import ShadowScorer
+
+        def _softmax_top1(action, logits):
+            z = logits - logits.max()
+            probs = np.exp(z) / np.exp(z).sum()
+            return int(action), float(probs[int(action)])
+
+        if policy.family == "set":
+            def _shadow_score(obs):
+                action, logits = shadow_backend.decide_nodes(obs)
+                return _softmax_top1(action, np.asarray(logits))
+        else:
+            def _shadow_score(obs):
+                action, logits = shadow_backend.decide(obs)
+                return _softmax_top1(action, np.asarray(logits))
+
+        def _shadow_record(action, score, latency_ms, obs):
+            if policy.trace is None:
+                return
+            arr = np.asarray(obs) if obs is not None else None
+            candidates = (len(arr) if arr is not None and arr.ndim == 2
+                          else len(CLOUDS))
+            chosen = (CLOUDS[action]
+                      if policy.family == "cloud" and action < len(CLOUDS)
+                      else f"candidate-{action}")
+            policy.trace.append(decision_record(
+                endpoint="shadow", family=policy.family,
+                backend=shadow_name, candidates=candidates, chosen=chosen,
+                score=score, latency_ms=latency_ms,
+                worker_id=(policy.pool_info or {}).get("worker_id"),
+                generation=policy.generation))
+
+        policy.shadow = ShadowScorer(_shadow_score,
+                                     record_fn=_shadow_record)
     return policy
 
 
@@ -2239,6 +2469,46 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--score-cache-entries", type=int, default=256,
                    metavar="N",
                    help="score cache LRU bound (default 256)")
+    p.add_argument("--drift", action="store_true",
+                   help="graftdrift: track per-decision distribution "
+                        "sketches (score/action/cost/latency streams) "
+                        "and grade them against a frozen reference — "
+                        "drift section on /stats, *_drift_score/"
+                        "*_drifting on /metrics, drift body on /healthz "
+                        "(docs/observability.md#graftdrift)")
+    p.add_argument("--drift-ref", default=None, metavar="FILE",
+                   help="load a frozen reference distribution at startup "
+                        "(the `drift snapshot` CLI's fingerprinted "
+                        "output); also loadable live via the pool's "
+                        "POST /drift/reference")
+    p.add_argument("--drift-threshold", type=float, default=None,
+                   metavar="F",
+                   help="PSI alarm bar per stream (default 0.2, the "
+                        "classic significant-shift bound)")
+    p.add_argument("--drift-fast-window", type=float, default=None,
+                   metavar="S",
+                   help="short drift window seconds (default 60); "
+                        "drifting requires BOTH windows over threshold")
+    p.add_argument("--drift-slow-window", type=float, default=None,
+                   metavar="S",
+                   help="long drift window seconds (default 600)")
+    p.add_argument("--drift-min-count", type=int, default=None,
+                   metavar="N",
+                   help="observations a window needs before it can "
+                        "alarm (default 20 — sampling noise is not "
+                        "drift)")
+    p.add_argument("--drift-bucket-s", type=float, default=None,
+                   metavar="S",
+                   help="drift ring bucket seconds (default: fast "
+                        "window / 8, clamped to [0.05, 1])")
+    p.add_argument("--shadow-run", default=None, metavar="DIR",
+                   help="graftdrift shadow scoring: a candidate "
+                        "checkpoint that re-scores live requests off the "
+                        "serving thread, never answering — incumbent-vs-"
+                        "shadow agreement + score-delta histogram on "
+                        "/stats (endpoint=shadow in the trace; excluded "
+                        "from every served-traffic histogram like "
+                        "probes)")
     args = p.parse_args(argv)
     if args.batch_window_ms < 0:
         raise SystemExit(
@@ -2270,6 +2540,19 @@ def main(argv: list[str] | None = None) -> None:
         raise SystemExit(
             "--trace-max-segments bounds the --trace-dir stream; pass "
             "--trace-dir (or drop the retention cap)")
+    drift_sub_flags = {"--drift-ref": args.drift_ref,
+                       "--drift-threshold": args.drift_threshold,
+                       "--drift-fast-window": args.drift_fast_window,
+                       "--drift-slow-window": args.drift_slow_window,
+                       "--drift-min-count": args.drift_min_count,
+                       "--drift-bucket-s": args.drift_bucket_s}
+    if not args.drift and any(v is not None
+                              for v in drift_sub_flags.values()):
+        named = sorted(k for k, v in drift_sub_flags.items()
+                       if v is not None)
+        raise SystemExit(
+            f"{', '.join(named)}: drift knobs configure the --drift "
+            "tracker; pass --drift (or drop them)")
     if args.price_replay_period <= 0:
         # RawPriceReplay validates too (for programmatic entry points);
         # refusing here keeps the CLI's exit clean and pre-startup.
@@ -2347,6 +2630,14 @@ def main(argv: list[str] | None = None) -> None:
         batch_max=args.batch_max,
         score_cache_epoch_s=args.score_cache_epoch_s,
         score_cache_entries=args.score_cache_entries,
+        drift=args.drift,
+        drift_ref=args.drift_ref,
+        drift_threshold=args.drift_threshold,
+        drift_fast_window_s=args.drift_fast_window,
+        drift_slow_window_s=args.drift_slow_window,
+        drift_min_count=args.drift_min_count,
+        drift_bucket_s=args.drift_bucket_s,
+        shadow_run=args.shadow_run,
     )
     if args.workers is not None:
         # graftserve: the supervisor never builds a policy (workers each

@@ -65,6 +65,10 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
+from rl_scheduler_tpu.scheduler.drift import (
+    drift_metric_lines,
+    shadow_metric_lines,
+)
 from rl_scheduler_tpu.scheduler.extender import (
     LatencyStats,
     fastpath_metric_lines,
@@ -198,7 +202,12 @@ def pool_stats_snapshot(name: str, body: dict) -> dict:
         "latency": body.get("latency") or {},
     }
     for key in ("shed_fraction", "reroute_fraction", "placements_dropped",
-                "fail_open_total", "fastpath"):
+                "fail_open_total", "fastpath", "drift", "shadow"):
+        # graftdrift: the drift section is closed under merge (bucket
+        # counts sum, distances recompute), so the pool-merged section
+        # re-merges at fleet level with the SAME drift.merge_snapshots
+        # the pool used — a pool without it contributes nothing, never
+        # a zero-filled distance.
         if key in body:
             stats[key] = body[key]
     snap = {
@@ -270,6 +279,10 @@ def aggregate_fleet_metrics(scrapes: dict, fleet: dict) -> str:
         lines += phase_metric_lines(p, phase_hists)
     if "slo" in stats:
         lines += slo_metric_lines(p, stats["slo"])
+    if "drift" in stats:
+        lines += drift_metric_lines(p, stats["drift"])
+    if "shadow" in stats:
+        lines += shadow_metric_lines(p, stats["shadow"])
     if "fastpath" in stats:
         lines += fastpath_metric_lines(p, stats["fastpath"])
     for key, help_text in (

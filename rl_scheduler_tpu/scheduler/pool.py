@@ -91,6 +91,7 @@ from rl_scheduler_tpu.scheduler.rollout import (
     RolloutController,
     WorkerSpec,
 )
+from rl_scheduler_tpu.scheduler import drift as drift_mod
 from rl_scheduler_tpu.scheduler import slo as slo_mod
 from rl_scheduler_tpu.utils.retry import CircuitBreaker, RetryPolicy
 
@@ -306,6 +307,29 @@ def merge_worker_slo(snapshots: list) -> dict | None:
     )
 
 
+def merge_worker_drift(snapshots: list) -> dict | None:
+    """Pool-wide drift snapshot (``drift.merge_snapshots``): bucket
+    counts sum, Welford moments merge, PSI/KS distances RECOMPUTE from
+    the merged counts — the ``merged_histogram`` discipline, never an
+    average of per-worker distances. Workers without a ``drift``
+    section (version skew, ``--drift`` off) contribute nothing;
+    ``None`` when no worker tracks drift."""
+    return drift_mod.merge_snapshots(
+        [s.get("stats", {}).get("drift") for s in snapshots]
+    )
+
+
+def sum_worker_shadow(snapshots: list) -> dict | None:
+    """Pool-wide shadow-scoring section (``drift.sum_shadow``):
+    lifetime counters and delta-histogram buckets sum; agreement rate
+    recomputes from the sums. ``None`` when no worker runs a shadow
+    checkpoint."""
+    return drift_mod.sum_shadow(
+        [s.get("stats", {}).get("shadow") for s in snapshots
+         if s.get("stats", {}).get("shadow")]
+    )
+
+
 def aggregate_stats(snapshots: list, pool: dict, merged=None,
                     phase_hists=None) -> dict:
     """The pool-wide ``GET /stats`` body from per-worker snapshots.
@@ -409,6 +433,16 @@ def aggregate_stats(snapshots: list, pool: dict, merged=None,
     merged_slo = merge_worker_slo(snapshots)
     if merged_slo is not None:
         out["slo"] = merged_slo
+    # graftdrift: merged drift sketches (counts sum, distances
+    # recompute) and summed shadow-scoring counters ride the pool body
+    # under the same keys as the single-process /stats, so driftview
+    # reads one shape from either plane.
+    merged_drift = merge_worker_drift(snapshots)
+    if merged_drift is not None:
+        out["drift"] = merged_drift
+    shadow = sum_worker_shadow(snapshots)
+    if shadow is not None:
+        out["shadow"] = shadow
     fastpath = sum_fastpath(snapshots)
     if fastpath is not None:
         out["fastpath"] = fastpath
@@ -516,6 +550,13 @@ def aggregate_metrics(snapshots: list, pool: dict) -> str:
         lines += phase_metric_lines(p, phase_hists)
     if "slo" in stats:
         lines += slo_metric_lines(p, stats["slo"])
+    if "drift" in stats:
+        # graftdrift: the SAME exposition helpers as the single-process
+        # plane, fed the merged drift section — distances were already
+        # recomputed from the summed buckets in aggregate_stats.
+        lines += drift_mod.drift_metric_lines(p, stats["drift"])
+    if "shadow" in stats:
+        lines += drift_mod.shadow_metric_lines(p, stats["shadow"])
     if "fastpath" in stats:
         # graftfwd: the SAME exposition helper as the single-process
         # plane, fed the pool-summed section (one scrape config).
@@ -717,7 +758,8 @@ def _worker_control_loop(policy, server, sock, worker_id: int) -> None:
         reader = sock.makefile("rb")
         for line in reader:
             try:
-                cmd = json.loads(line).get("cmd")
+                msg = json.loads(line)
+                cmd = msg.get("cmd")
             except (json.JSONDecodeError, AttributeError):
                 _send_line(sock, {"error": "bad command"})
                 continue
@@ -746,6 +788,31 @@ def _worker_control_loop(policy, server, sock, worker_id: int) -> None:
                 ack = verify() if verify is not None else {"ok": True}
                 ack.setdefault("ok", False)
                 _send_line(sock, ack)
+            elif cmd == "flip_tables":
+                # graftdrift regime flip: swap this worker's price-replay
+                # table in place (same loader contract as --telemetry-data;
+                # the shared replay counter keeps walking, so all workers
+                # of one pool flip onto the same trajectory).
+                try:
+                    _send_line(sock, {"ok": True,
+                                      **policy.flip_tables(msg.get("path"))})
+                except Exception as exc:  # noqa: BLE001 - report, don't die
+                    logger.warning("worker %d refused flip_tables: %s",
+                                   worker_id, exc)
+                    _send_line(sock, {"ok": False, "error": str(exc)})
+            elif cmd == "drift_ref":
+                # Load a frozen drift reference (drift.save_reference
+                # output) into this worker's tracker; fingerprint-verified
+                # by load_reference, so a truncated file is refused.
+                try:
+                    _send_line(sock, {
+                        "ok": True,
+                        **policy.set_drift_reference(msg.get("path")),
+                    })
+                except Exception as exc:  # noqa: BLE001 - report, don't die
+                    logger.warning("worker %d refused drift_ref: %s",
+                                   worker_id, exc)
+                    _send_line(sock, {"ok": False, "error": str(exc)})
             else:
                 _send_line(sock, {"error": f"unknown cmd {cmd!r}"})
     except OSError:
@@ -1238,14 +1305,14 @@ class ServingPool:
     # -------------------------------------------------------- control plane
 
     def _command(self, slot: _WorkerSlot, cmd: str,
-                 timeout_s: float) -> dict | None:
+                 timeout_s: float, args: dict | None = None) -> dict | None:
         with slot.conn_lock:
             conn = slot.conn
             if conn is None:
                 return None
             try:
                 conn.settimeout(timeout_s)
-                _send_line(conn, {"cmd": cmd})
+                _send_line(conn, {"cmd": cmd, **(args or {})})
                 reader = conn.makefile("rb")
                 line = reader.readline()
                 conn.settimeout(None)
@@ -1259,7 +1326,8 @@ class ServingPool:
                 slot.conn = None
                 return None
 
-    def _fanout(self, cmd: str, timeout_s: float) -> list:
+    def _fanout(self, cmd: str, timeout_s: float,
+                args: dict | None = None) -> list:
         """Issue ``cmd`` to every worker CONCURRENTLY (one thread per
         slot): a wedged worker costs max one timeout, not one timeout
         per wedged worker serially — a degraded pool is exactly when the
@@ -1267,7 +1335,7 @@ class ServingPool:
         results: list = [None] * len(self._slots)
 
         def ask(i: int, slot: _WorkerSlot) -> None:
-            results[i] = self._command(slot, cmd, timeout_s)
+            results[i] = self._command(slot, cmd, timeout_s, args)
 
         threads = [threading.Thread(target=ask, args=(i, slot), daemon=True)
                    for i, slot in enumerate(self._slots)]
@@ -1291,6 +1359,35 @@ class ServingPool:
         acked = sum(1 for ack in self._fanout("reset", timeout_s)
                     if (ack or {}).get("ok"))
         return {"status": "reset", "workers": acked}
+
+    def flip_tables(self, path: str, timeout_s: float = 5.0) -> dict:
+        """graftdrift: fan a price-replay table swap out to every worker
+        (the drift drill's mid-soak regime flip). Per-worker acks ride
+        back so a worker that refused the table (shape mismatch, missing
+        file) is visible, not averaged away."""
+        acks = self._fanout("flip_tables", timeout_s, {"path": path})
+        flipped = sum(1 for ack in acks if (ack or {}).get("ok"))
+        out = {"status": "flipped" if flipped == len(self._slots)
+               else "partial", "workers": flipped, "path": path}
+        errors = sorted({ack["error"] for ack in acks
+                         if ack and not ack.get("ok") and "error" in ack})
+        if errors:
+            out["errors"] = errors
+        return out
+
+    def set_drift_reference(self, path: str,
+                            timeout_s: float = 5.0) -> dict:
+        """Load a frozen drift reference into every worker's tracker.
+        Same fan-out/ack contract as :meth:`flip_tables`."""
+        acks = self._fanout("drift_ref", timeout_s, {"path": path})
+        loaded = sum(1 for ack in acks if (ack or {}).get("ok"))
+        out = {"status": "loaded" if loaded == len(self._slots)
+               else "partial", "workers": loaded, "path": path}
+        errors = sorted({ack["error"] for ack in acks
+                         if ack and not ack.get("ok") and "error" in ack})
+        if errors:
+            out["errors"] = errors
+        return out
 
     def status(self) -> dict:
         alive = sum(1 for s in self._slots if s.alive)
@@ -1407,6 +1504,25 @@ class _PoolHandler(BaseHTTPRequestHandler):
             code, out = self.pool.rollout.request_promote(
                 payload.get("checkpoint"))
             self._send(code, out)
+        elif self.path in ("/telemetry/flip", "/drift/reference"):
+            # graftdrift control plane: both take {"path": "<file>"} and
+            # fan out to every worker (table swap / reference load). The
+            # bench's --flip-tables drives the first; `drift snapshot` +
+            # this route close the reference lifecycle for the second.
+            try:
+                payload = json.loads(body or b"{}")
+            except json.JSONDecodeError as exc:
+                self._send(400, {"error": f"bad json: {exc}"})
+                return
+            if not isinstance(payload, dict) or not payload.get("path"):
+                self._send(400, {"error": "pass a JSON object: "
+                                          '{"path": "<file>"}'})
+                return
+            if self.path == "/telemetry/flip":
+                out = self.pool.flip_tables(payload["path"])
+            else:
+                out = self.pool.set_drift_reference(payload["path"])
+            self._send(200 if not out.get("errors") else 409, out)
         else:
             self._send(404, {"error": f"unknown path {self.path}"})
 

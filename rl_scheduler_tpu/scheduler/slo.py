@@ -20,9 +20,11 @@ canary gate should hold against. This module is the objective layer:
   600 s @ 6x) are the classic page-worthy burn pair scaled to a serving
   process you watch live; every knob is a flag.
 - Synthetic traffic never lands here: the extender's ``warmup_probe``
-  decisions (tagged ``endpoint=probe`` in the trace) are excluded at
-  record time, so a rollout's own gate probes cannot burn the budget
-  they gate on.
+  decisions and graftdrift's shadow scores (``endpoint`` in
+  ``tracelog.SYNTHETIC_ENDPOINTS``, one shared predicate —
+  ``is_synthetic_endpoint``) are excluded at record time, so neither a
+  rollout's own gate probes nor a shadow checkpoint can burn the budget
+  they are judged against.
 - :func:`merge_snapshots` sums per-worker window counts and recomputes
   burn rates pool-wide (counts are linear, rates are not), the same
   discipline as ``LatencyStats.merged_histogram``.

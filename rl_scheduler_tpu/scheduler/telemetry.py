@@ -177,6 +177,7 @@ class TableTelemetry:
         self.costs = np.asarray(costs, np.float32)
         self.latencies = np.asarray(latencies, np.float32)
         self.cpu = cpu_source or RandomCpu()
+        self.swaps_total = 0
         self._counter = counter
         self._step = 0
         self._lock = threading.Lock()
@@ -196,7 +197,37 @@ class TableTelemetry:
         return cls(np.asarray(table.costs), np.asarray(table.latencies),
                    cpu_source, counter=counter)
 
-    def _next_idx(self) -> int:
+    def swap_table(self, costs: np.ndarray, latencies: np.ndarray) -> None:
+        """Replace the replayed table in place — the regime-flip seam
+        (graftdrift's drill and ``extender_bench --flip-tables`` drive it
+        through the pool's ``/telemetry/flip``). Validates the same
+        contract ``data/loader.load_table`` enforces, then swaps both
+        arrays under the lock so a concurrent observation reads a
+        coherent pair (``_table``). The replay counter keeps running —
+        a flip is a regime change, not a rewind."""
+        costs = np.asarray(costs, np.float32)
+        latencies = np.asarray(latencies, np.float32)
+        if costs.shape != latencies.shape or costs.ndim != 2 \
+                or costs.shape[1] != len(self.costs[0]) or len(costs) < 2:
+            raise ValueError(
+                f"swap_table: costs {costs.shape} / latencies "
+                f"{latencies.shape}: need matching [T>=2, "
+                f"{len(self.costs[0])}] arrays (loader.load_table shape)")
+        for name, arr in (("costs", costs), ("latencies", latencies)):
+            if not np.isfinite(arr).all() or arr.min() < 0 or arr.max() > 1:
+                raise ValueError(f"swap_table: {name} must be normalized "
+                                 "to [0, 1] and finite (loader contract)")
+        with self._lock:
+            self.costs = costs
+            self.latencies = latencies
+            self.swaps_total += 1
+
+    def _table(self) -> tuple:
+        """Coherent (costs, latencies) pair — never half of two tables."""
+        with self._lock:
+            return self.costs, self.latencies
+
+    def _next_idx(self, length: int) -> int:
         if self._counter is not None:
             raw = self._counter.next_index()
         else:
@@ -204,7 +235,7 @@ class TableTelemetry:
                 raw = self._step
                 self._step += 1
         self._local.raw = raw
-        return raw % len(self.costs)
+        return raw % length
 
     def note_replay_position(self, raw: int) -> None:
         """Overwrite THIS thread's last-observed replay position
@@ -226,10 +257,11 @@ class TableTelemetry:
         return getattr(self._local, "raw", None)
 
     def observe(self) -> np.ndarray:
-        idx = self._next_idx()
+        costs, lats = self._table()
+        idx = self._next_idx(len(costs))
         cpu_aws, cpu_azure = self.cpu.sample()
         return np.concatenate(
-            [self.costs[idx], self.latencies[idx], [cpu_aws, cpu_azure]]
+            [costs[idx], lats[idx], [cpu_aws, cpu_azure]]
         ).astype(np.float32)
 
     def observe_nodes(self, clouds: list, pod_cpu: float) -> np.ndarray:
@@ -244,10 +276,11 @@ class TableTelemetry:
         cross-cloud mean and ``cloud_id = 0.5``, so they score from neutral
         features instead of being special-cased out of the decision.
         """
-        idx = self._next_idx()
-        costs, lats = self.costs[idx], self.latencies[idx]
+        table_costs, table_lats = self._table()
+        idx = self._next_idx(len(table_costs))
+        costs, lats = table_costs[idx], table_lats[idx]
         cpus = np.asarray(self.cpu.sample(), np.float32)
-        step_frac = idx / max(len(self.costs) - 1, 1)
+        step_frac = idx / max(len(table_costs) - 1, 1)
         cloud_idx = np.fromiter(
             ({"aws": 0, "azure": 1}.get(c, -1) for c in clouds),
             np.int64, count=len(clouds),

@@ -106,6 +106,22 @@ def clouds_from_token(token: str | None) -> list | None:
     return [rev.get(ch) for ch in token]
 
 
+# Endpoints whose records are synthetic traffic — warmup probes and
+# graftdrift shadow scores — never a served decision. Every histogram
+# family (e2e latency, phases, SLO, drift sketches) and every trace
+# consumer (bench replay, loopback compile, decisionview, drift
+# references) excludes them through THIS predicate; a new synthetic
+# endpoint joins the frozenset once and every surface agrees (pinned by
+# tests/test_graftdrift.py's exclusion audit).
+SYNTHETIC_ENDPOINTS = frozenset({"probe", "shadow"})
+
+
+def is_synthetic_endpoint(endpoint) -> bool:
+    """True for trace/serving endpoints that must stay out of every
+    served-traffic statistic (module comment on SYNTHETIC_ENDPOINTS)."""
+    return endpoint in SYNTHETIC_ENDPOINTS
+
+
 def decision_record(*, endpoint: str, family: str, backend: str,
                     candidates: int, chosen: str | None,
                     score: float | None, latency_ms: float,

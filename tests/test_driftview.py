@@ -1,0 +1,266 @@
+"""driftview (tools/driftview): the drift report + retrain-trigger gate.
+
+The package duplicates ``scheduler/drift.reference_fingerprint`` to
+stay stdlib-only; the cross-check test here pins the two
+implementations byte-equal (and the two REFERENCE_SCHEMA constants
+equal) so they can never drift apart silently. The ``--check`` gates
+are pinned one by one — a missing drift section fails loudly, a
+drifting stream exits 2, a zero-data stream is exempt from
+``require_reference``, a stale ``--reference`` file is visible as a
+fingerprint mismatch — and the checked-in fixture under
+``tests/fixtures/driftview/`` keeps ``make drift-report`` green and
+off-network in tier-1.
+"""
+
+import copy
+import json
+from pathlib import Path
+
+import pytest
+
+import tools.driftview as driftview
+from tools.driftview import (
+    REFERENCE_SCHEMA,
+    build_report,
+    check_drift,
+    format_report,
+    load_budgets,
+    load_reference,
+    load_stats,
+    reference_fingerprint,
+    summarize_trace,
+)
+from tools.driftview.__main__ import main as driftview_main
+from rl_scheduler_tpu.scheduler import drift as drift_mod
+from rl_scheduler_tpu.scheduler.tracelog import TraceLog, decision_record
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "driftview"
+BUDGETS = Path(__file__).resolve().parents[1] / "tools" / "driftview" \
+    / "budgets.json"
+
+
+def _reference(observations=6):
+    tracker = drift_mod.DriftTracker(drift_mod.DriftConfig(),
+                                     clock=lambda: 1000.0)
+    for i in range(observations):
+        tracker.observe_decision("aws" if i % 2 else "azure",
+                                 0.1 * (i % 5), cost=0.3, latency=0.4)
+    return tracker, drift_mod.build_reference(tracker.snapshot(),
+                                              source="test")
+
+
+def test_fingerprint_cross_check_pins_both_implementations():
+    """driftview.reference_fingerprint must equal
+    scheduler/drift.reference_fingerprint on the same reference — the
+    stdlib duplicate and the scheduler original share one
+    canonicalization, and the schema constants agree."""
+    assert REFERENCE_SCHEMA == drift_mod.REFERENCE_SCHEMA
+    _, ref = _reference()
+    assert reference_fingerprint(ref) == ref["fingerprint"]
+    assert reference_fingerprint(ref) \
+        == drift_mod.reference_fingerprint(ref)
+    # provenance fields stay outside the hash in BOTH implementations
+    relabeled = dict(ref, source="elsewhere")
+    assert reference_fingerprint(relabeled) \
+        == drift_mod.reference_fingerprint(relabeled) \
+        == ref["fingerprint"]
+
+
+def test_load_reference_refuses_tamper(tmp_path):
+    _, ref = _reference()
+    path = tmp_path / "ref.json"
+    drift_mod.save_reference(str(path), ref)
+    assert load_reference(path) == ref
+
+    tampered = copy.deepcopy(ref)
+    tampered["streams"]["score"]["counts"][0] += 1
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(tampered))
+    with pytest.raises(ValueError, match="fingerprint"):
+        load_reference(bad)
+    notref = tmp_path / "notref.json"
+    notref.write_text(json.dumps({"hello": 1}))
+    with pytest.raises(ValueError, match="schema"):
+        load_reference(notref)
+
+
+def test_load_stats_file_and_checked_in_budgets(tmp_path):
+    body = {"backend": "greedy", "drift": {"drifting": []}}
+    path = tmp_path / "stats.json"
+    path.write_text(json.dumps(body))
+    assert load_stats(str(path)) == body
+    budgets = load_budgets(BUDGETS)
+    assert budgets["schema_version"] == 1
+    assert budgets["require_reference"] is True
+    assert budgets["allow_drifting"] is False
+    assert 0.0 < budgets["shadow_agreement_floor"] <= 1.0
+    assert budgets["shadow_floor_min_scored"] >= 1
+
+
+def test_build_and_format_report_sections():
+    tracker, ref = _reference(observations=10)
+    tracker.set_reference(ref)
+    stats = {
+        "drift": tracker.snapshot(),
+        "shadow": {"submitted_total": 5, "scored_total": 4,
+                   "dropped_total": 1, "errors_total": 0,
+                   "agreements_total": 4, "agreement_rate": 1.0,
+                   "score_delta": {"mean": -0.002}},
+    }
+    report = build_report(
+        stats=stats, reference=ref,
+        trace_summary={"generations": {"0": 7}, "served_records": 7,
+                       "synthetic_excluded": 2, "fail_opens_excluded": 1})
+    drift = report["drift"]
+    assert drift["reference_loaded"] is True
+    assert drift["reference_fingerprint"] == ref["fingerprint"]
+    assert drift["streams"]["score"]["status"] == "ok"
+    assert drift["streams"]["score"]["lifetime_count"] == 10
+    assert report["shadow"]["agreement_rate"] == 1.0
+    assert report["reference_file"]["streams"] \
+        == sorted(ref["streams"])
+
+    text = format_report(report)
+    assert "== drift (generation 0) ==" in text
+    assert "== shadow ==" in text
+    assert "== reference file ==" in text
+    assert "== trace ==" in text
+    assert ref["fingerprint"][:12] in text
+    assert "DRIFTING" not in text
+    assert check_drift(report, load_budgets(BUDGETS)) == []
+
+    bare = build_report(stats=None, reference=None, trace_summary=None)
+    assert format_report(bare) == ""
+
+
+def _report(drifting=(), statuses=None, lifetime=50, ref_fp="f" * 64,
+            file_fp=None, mixed=False, shadow=None):
+    statuses = statuses or {}
+    streams = {}
+    for name in ("score", "action", "cost", "latency"):
+        streams[name] = {
+            "status": statuses.get(name, "ok"),
+            "psi": {"fast": 0.01, "slow": 0.01},
+            "ks": {"fast": 0.01, "slow": 0.01},
+            "windows": {"fast": {"count": lifetime, "sufficient": True},
+                        "slow": {"count": lifetime, "sufficient": True}},
+            "drifting": name in drifting,
+            "lifetime_count": lifetime,
+        }
+    report = {"schema_version": 1, "drift": {
+        "generation": 0, "streams": streams,
+        "drifting": sorted(drifting), "reference_loaded": bool(ref_fp),
+        "reference_fingerprint": ref_fp, "reference_generation": 0,
+        "reference_mixed": mixed,
+    }}
+    if file_fp is not None:
+        report["reference_file"] = {"fingerprint": file_fp,
+                                    "generation": 0, "streams": []}
+    if shadow is not None:
+        report["shadow"] = shadow
+    return report
+
+
+def test_check_drift_gates_one_by_one():
+    budgets = load_budgets(BUDGETS)
+
+    missing = check_drift({"schema_version": 1}, budgets)
+    assert len(missing) == 1 and "no drift section" in missing[0]
+
+    assert check_drift(_report(), budgets) == []
+
+    drifting = check_drift(_report(drifting=("cost",)), budgets)
+    assert len(drifting) == 1 and "`cost` is DRIFTING" in drifting[0]
+    assert check_drift(_report(drifting=("cost",)),
+                       dict(budgets, allow_drifting=True)) == []
+
+    ungraded = check_drift(
+        _report(statuses={"cost": "no_reference"}), budgets)
+    assert len(ungraded) == 1 and "`cost`" in ungraded[0]
+    skewed = check_drift(
+        _report(statuses={"cost": "generation_mismatch"}), budgets)
+    assert "generation_mismatch" in skewed[0]
+    # a stream the deployment never feeds is NOT gradable: exempt
+    assert check_drift(
+        _report(statuses={"cost": "no_reference"}, lifetime=0),
+        budgets) == []
+    assert check_drift(
+        _report(statuses={"cost": "no_reference"}),
+        dict(budgets, require_reference=False)) == []
+
+    stale = check_drift(_report(file_fp="a" * 64), budgets)
+    assert len(stale) == 1 and "reference mismatch" in stale[0]
+    assert check_drift(_report(file_fp="f" * 64), budgets) == []
+
+    torn = check_drift(_report(mixed=True), budgets)
+    assert len(torn) == 1 and "disagree" in torn[0]
+
+    low = {"scored_total": 30, "agreement_rate": 0.5}
+    floored = check_drift(_report(shadow=low), budgets)
+    assert len(floored) == 1 and "agreement" in floored[0]
+    # the floor binds only once enough was scored
+    assert check_drift(
+        _report(shadow={"scored_total": 3, "agreement_rate": 0.0}),
+        budgets) == []
+    # per-run override beats the budgets file
+    assert check_drift(_report(shadow=low), budgets,
+                       shadow_floor=0.25) == []
+
+
+def test_summarize_trace_counts_synthetic_apart(tmp_path):
+    log = TraceLog(tmp_path / "trace", prefix="w0-")
+
+    def _rec(**kw):
+        base = dict(endpoint="extender", family="cloud", backend="greedy",
+                    candidates=2, chosen="aws", score=0.4, latency_ms=1.0)
+        base.update(kw)
+        assert log.append(decision_record(**base))
+
+    _rec(generation=0)
+    _rec(generation=0)
+    _rec(generation=1)
+    _rec(endpoint="probe")
+    _rec(endpoint="shadow")
+    _rec(fail_open=True, score=None, chosen=None)
+    log.close()
+    summary = summarize_trace(tmp_path / "trace")
+    assert summary["generations"] == {"0": 2, "1": 1}
+    assert summary["served_records"] == 3
+    assert summary["synthetic_excluded"] == 2
+    assert summary["fail_opens_excluded"] == 1
+
+
+def test_fixture_gate_green_and_drifting_red(tmp_path, capsys):
+    """``make drift-report``'s exact invocation against the checked-in
+    fixture exits 0 (off-network tier-1 proof the gate plumbing works
+    end to end); flipping one stream's verdict in the same body exits 2
+    with the violation on stderr and in the JSON line."""
+    assert driftview_main(["--stats", str(FIXTURES / "stats.json"),
+                           "--reference",
+                           str(FIXTURES / "reference.json"),
+                           "--check", "--budgets", str(BUDGETS)]) == 0
+    out, err = capsys.readouterr()
+    assert "== drift" in out
+    line = json.loads(out.strip().splitlines()[-1])
+    assert line["report"] == "driftview"
+    assert line["violations"] == []
+    assert err == ""
+
+    stats = json.loads((FIXTURES / "stats.json").read_text())
+    stats["drift"]["scores"]["cost"]["drifting"] = True
+    stats["drift"]["drifting"] = ["cost"]
+    red = tmp_path / "drifting.json"
+    red.write_text(json.dumps(stats))
+    assert driftview_main(["--stats", str(red), "--reference",
+                           str(FIXTURES / "reference.json"), "--check",
+                           "--budgets", str(BUDGETS), "--json"]) == 2
+    out, err = capsys.readouterr()
+    assert "DRIFTING" in err
+    line = json.loads(out.strip().splitlines()[-1])
+    assert any("cost" in v for v in line["violations"])
+    assert "== drift" not in out  # --json suppresses the tables
+
+    with pytest.raises(SystemExit):
+        driftview_main([])  # at least one input is required
+    capsys.readouterr()
+    assert driftview.SCHEMA_VERSION == 1

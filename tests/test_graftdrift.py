@@ -1,0 +1,962 @@
+"""graftdrift (scheduler/drift.py): online distribution-shift
+observability.
+
+Pinned at three levels: pure-function tests for the sketch/score math
+(bucket edges, PSI/KS semantics, the ``compute_burn`` delegation that
+makes ``drifting`` a two-window verdict), in-process policy tests for
+the serving-path wiring (one observation per served decision recorded
+in ``_record_trace``, synthetic traffic excluded everywhere, shadow
+scoring with bitwise-zero effect on served decisions), and a forked
+2-worker pool drill (``make drift-drill``): a price-replay regime flip
+mid-soak flips ``*_drifting`` within the short window while the
+stationary control soak never alarms. Merge discipline follows the
+repo rule — counts sum, distances recompute — and is pinned
+fleet-merged == union-of-workers through PR 17's pseudo-worker
+machinery.
+"""
+
+import json
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import os
+import pytest
+
+from rl_scheduler_tpu.scheduler import drift as drift_mod
+from rl_scheduler_tpu.scheduler.drift import (
+    ACTION_CATEGORIES,
+    STREAMS,
+    UNIT_EDGES,
+    DriftConfig,
+    DriftTracker,
+    ShadowScorer,
+    bucket_index,
+    build_reference,
+    compute_scores,
+    config_from_snapshot,
+    drift_metric_lines,
+    ks,
+    load_reference,
+    merge_snapshots,
+    psi,
+    reference_fingerprint,
+    reference_from_trace,
+    save_reference,
+    shadow_metric_lines,
+    stream_size,
+    sum_shadow,
+)
+from rl_scheduler_tpu.scheduler.extender import ExtenderPolicy
+from rl_scheduler_tpu.scheduler.fleet import (
+    aggregate_fleet_metrics,
+    aggregate_fleet_stats,
+)
+from rl_scheduler_tpu.scheduler.policy_backend import GreedyBackend
+from rl_scheduler_tpu.scheduler.pool import (
+    PoolShared,
+    ServingPool,
+    aggregate_metrics,
+    aggregate_stats,
+    merge_worker_drift,
+    sum_worker_shadow,
+    worker_snapshot,
+)
+from rl_scheduler_tpu.scheduler.slo import SloConfig, SloTracker
+from rl_scheduler_tpu.scheduler.telemetry import RandomCpu, TableTelemetry
+from rl_scheduler_tpu.scheduler.tracelog import (
+    SYNTHETIC_ENDPOINTS,
+    TraceLog,
+    decision_record,
+    is_synthetic_endpoint,
+)
+from rl_scheduler_tpu.utils.retry import RetryPolicy
+
+needs_fork = pytest.mark.skipif(
+    not hasattr(os, "fork"), reason="graftserve pools require fork"
+)
+
+FAST_RESTARTS = RetryPolicy(max_attempts=5, base_delay_s=0.05,
+                            max_delay_s=0.2, jitter=0.0)
+
+
+class _Clock:
+    """Injectable monotonic clock for the ring-window tests."""
+
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _tracker(clock=None, **overrides):
+    cfg = dict(threshold=0.2, fast_window_s=1.0, slow_window_s=4.0,
+               min_window_count=1, bucket_s=0.5)
+    cfg.update(overrides)
+    return DriftTracker(DriftConfig(**cfg), clock=clock or _Clock())
+
+
+def _filter_args(i=0):
+    return {"nodenames": [f"aws-w{i}", f"azure-w{i}"], "pod": {}}
+
+
+def _policy(drift=True, shadow_fn=None):
+    telemetry = TableTelemetry.from_table(cpu_source=RandomCpu(seed=0))
+    policy = ExtenderPolicy(GreedyBackend(), telemetry)
+    if drift:
+        policy.drift = DriftTracker(DriftConfig())
+    if shadow_fn is not None:
+        policy.shadow = ShadowScorer(shadow_fn)
+    return policy
+
+
+# ---------------------------------------------------------- sketch math
+
+
+def test_bucket_index_edges_and_stream_size():
+    """Numeric streams clamp into [0, NUM_BINS-1] over the unit edges;
+    non-finite values land nowhere (None, never a silent zero bucket);
+    the categorical stream maps unknown clouds to its 'unknown' tail."""
+    assert stream_size("score") == len(UNIT_EDGES) + 1
+    assert stream_size("action") == len(ACTION_CATEGORIES)
+    assert bucket_index("score", -5.0) == 0
+    assert bucket_index("score", 0.0) == 0
+    assert bucket_index("score", 5.0) == len(UNIT_EDGES)
+    # interior edges are half-open on the left (bisect_right)
+    assert bucket_index("cost", UNIT_EDGES[0]) == 1
+    assert bucket_index("latency", float("nan")) is None
+    assert bucket_index("score", float("inf")) is None
+    assert bucket_index("score", "not-a-number") is None
+    assert bucket_index("action", "aws") == 0
+    assert bucket_index("action", "azure") == 1
+    assert bucket_index("action", "gcp") == ACTION_CATEGORIES.index(
+        "unknown")
+
+
+def test_psi_ks_distance_semantics():
+    """PSI/KS contract: None with an empty reference (no basis to
+    grade), 0.0 with an empty live side (no evidence of movement), ~0
+    for identical distributions, large for disjoint ones."""
+    same = [10, 20, 30, 40]
+    assert psi(same, same) == pytest.approx(0.0, abs=1e-9)
+    assert ks(same, same) == pytest.approx(0.0, abs=1e-9)
+    assert psi(same, [0, 0, 0, 0]) is None
+    assert ks(same, [0, 0, 0, 0]) is None
+    assert psi([0, 0, 0, 0], same) == 0.0
+    assert ks([0, 0, 0, 0], same) == 0.0
+    disjoint = psi([100, 0, 0, 0], [0, 0, 0, 100])
+    assert disjoint > 10.0
+    assert ks([100, 0, 0, 0], [0, 0, 0, 100]) == pytest.approx(1.0)
+    # scale-invariant: x10 the counts on either side, same distances
+    assert psi([1, 3], [3, 1]) == pytest.approx(psi([10, 30], [30, 10]))
+    assert ks([1, 3], [3, 1]) == pytest.approx(ks([10, 30], [30, 10]))
+
+
+def test_drift_config_validation_and_bucket_default():
+    with pytest.raises(ValueError):
+        DriftConfig(threshold=0.0)
+    with pytest.raises(ValueError):
+        DriftConfig(fast_window_s=600.0, slow_window_s=60.0)
+    with pytest.raises(ValueError):
+        DriftConfig(min_window_count=0)
+    with pytest.raises(ValueError):
+        DriftConfig(bucket_s=120.0)  # longer than the fast window
+    cfg = DriftConfig(fast_window_s=60.0, slow_window_s=600.0)
+    assert cfg.ring_bucket_s == pytest.approx(1.0)  # clamped to 1 s
+    assert DriftConfig(fast_window_s=1.0, slow_window_s=3.0) \
+        .ring_bucket_s == pytest.approx(0.125)
+    rt = config_from_snapshot({"config": cfg.to_dict()})
+    assert rt.threshold == cfg.threshold
+    assert rt.bucket_s == cfg.ring_bucket_s
+
+
+def _stream_entry(fast_counts, slow_counts, fast_s=60.0, slow_s=600.0):
+    return {
+        "windows_raw": {
+            "fast": {"seconds": fast_s, "counts": list(fast_counts)},
+            "slow": {"seconds": slow_s, "counts": list(slow_counts)},
+        },
+        "lifetime": {"count": sum(slow_counts),
+                     "counts": list(slow_counts)},
+        "edges": list(UNIT_EDGES),
+    }
+
+
+def test_compute_scores_two_window_burn_delegation():
+    """The drifting verdict IS slo.compute_burn's: burn_rate per window
+    equals min(psi/threshold, 8.0) and ``drifting`` requires BOTH
+    windows over the threshold — a fast-window blip with a clean slow
+    window never alarms, and a near-empty window (< min_window_count)
+    contributes zero burn regardless of its PSI."""
+    size = stream_size("cost")
+    ref_counts = [0] * size
+    ref_counts[2] = 400
+    reference = {"schema": 1, "generation": 0,
+                 "streams": {"cost": {"counts": ref_counts,
+                                      "count": 400}}}
+    cfg = DriftConfig(threshold=0.2, min_window_count=20)
+    shifted = [0] * size
+    shifted[10] = 100
+    matching = [0] * size
+    matching[2] = 100
+
+    both = compute_scores(cfg, {"cost": _stream_entry(shifted, shifted)},
+                          reference, generation=0)["cost"]
+    assert both["status"] == "ok"
+    assert both["drifting"] is True
+    for w in ("fast", "slow"):
+        assert both["psi"][w] > cfg.threshold
+        assert both["burn"][w] == pytest.approx(
+            min(both["psi"][w] / cfg.threshold, 8.0), rel=1e-3)
+        assert both["windows"][w]["sufficient"]
+
+    blip = compute_scores(cfg, {"cost": _stream_entry(shifted, matching)},
+                          reference, generation=0)["cost"]
+    assert blip["psi"]["fast"] > cfg.threshold
+    assert blip["psi"]["slow"] == pytest.approx(0.0, abs=1e-6)
+    assert blip["drifting"] is False
+
+    thin = [0] * size
+    thin[10] = 5  # fully shifted but under min_window_count
+    starved = compute_scores(cfg, {"cost": _stream_entry(thin, thin)},
+                             reference, generation=0)["cost"]
+    assert starved["windows"]["fast"]["sufficient"] is False
+    assert starved["burn"]["fast"] == 0.0
+    assert starved["drifting"] is False
+
+    no_ref = compute_scores(cfg, {"cost": _stream_entry(shifted, shifted)},
+                            None, generation=0)["cost"]
+    assert no_ref["status"] == "no_reference"
+    assert no_ref["psi"]["fast"] is None
+    assert no_ref["drifting"] is False
+
+    skew = compute_scores(cfg, {"cost": _stream_entry(shifted, shifted)},
+                          reference, generation=3)["cost"]
+    assert skew["status"] == "generation_mismatch"
+    assert skew["psi"]["fast"] is None
+
+
+# ------------------------------------------------------------ the tracker
+
+
+def test_tracker_ring_windows_expire_lifetime_monotonic():
+    clock = _Clock()
+    tracker = _tracker(clock)
+    for _ in range(5):
+        tracker.observe_decision("aws", 0.5, cost=0.2, latency=0.3)
+    snap = tracker.snapshot()
+    for name in STREAMS:
+        raw = snap["streams"][name]["windows_raw"]
+        assert sum(raw["fast"]["counts"]) == 5
+        assert sum(raw["slow"]["counts"]) == 5
+        assert snap["streams"][name]["lifetime"]["count"] == 5
+
+    clock.advance(2.0)  # past the 1 s fast window, inside the slow
+    snap = tracker.snapshot()
+    raw = snap["streams"]["score"]["windows_raw"]
+    assert sum(raw["fast"]["counts"]) == 0
+    assert sum(raw["slow"]["counts"]) == 5
+
+    clock.advance(10.0)  # past the slow window: ring empty, lifetime not
+    snap = tracker.snapshot()
+    raw = snap["streams"]["score"]["windows_raw"]
+    assert sum(raw["slow"]["counts"]) == 0
+    assert snap["streams"]["score"]["lifetime"]["count"] == 5
+
+
+def test_tracker_welford_moments_and_optional_features():
+    """Numeric lifetime carries Welford mean/std/min/max; a None
+    feature (a family whose observation has no such column) skips that
+    stream entirely — never a zero-fill; an unknown cloud lands in the
+    categorical 'unknown' tail."""
+    tracker = _tracker()
+    for v in (0.2, 0.4, 0.6):
+        tracker.observe_decision("gcp-onprem-3", v, cost=None, latency=v)
+    snap = tracker.snapshot()
+    life = snap["streams"]["score"]["lifetime"]
+    assert life["mean"] == pytest.approx(0.4)
+    assert life["min"] == 0.2 and life["max"] == 0.6
+    assert life["std"] == pytest.approx(math.sqrt(0.08 / 3), rel=1e-4)
+    assert snap["streams"]["cost"]["lifetime"]["count"] == 0
+    assert snap["streams"]["latency"]["lifetime"]["count"] == 3
+    action = snap["streams"]["action"]
+    unknown = ACTION_CATEGORIES.index("unknown")
+    assert action["lifetime"]["counts"][unknown] == 3
+
+
+def test_merge_snapshots_counts_sum_closed_under_merge():
+    """The repo's merge discipline: bucket counts and lifetime counters
+    sum, Welford moments merge with Chan's formula, distances recompute
+    from the sums. The output is snapshot-shaped, so the fleet re-merge
+    of pool sections equals one flat merge over every worker (closed
+    under merge); absent sections contribute nothing."""
+    clock = _Clock()
+    a, b, c = (_tracker(clock) for _ in range(3))
+    for _ in range(3):
+        a.observe_decision("aws", 0.1, cost=0.2, latency=0.2)
+    for _ in range(5):
+        b.observe_decision("azure", 0.9, cost=0.8, latency=0.8)
+    c.observe_decision("aws", 0.5, cost=0.5, latency=0.5)
+
+    merged = merge_snapshots([a.snapshot(), b.snapshot()])
+    act = merged["streams"]["action"]["lifetime"]
+    assert act["counts"][:2] == [3, 5]
+    cost = merged["streams"]["cost"]["lifetime"]
+    assert cost["count"] == 8
+    assert cost["mean"] == pytest.approx((3 * 0.2 + 5 * 0.8) / 8)
+    assert cost["min"] == 0.2 and cost["max"] == 0.8
+
+    flat = merge_snapshots([a.snapshot(), b.snapshot(), c.snapshot()])
+    nested = merge_snapshots([merged, c.snapshot()])
+    for name in STREAMS:
+        assert nested["streams"][name]["lifetime"]["counts"] \
+            == flat["streams"][name]["lifetime"]["counts"]
+        assert nested["streams"][name]["windows_raw"]["fast"]["counts"] \
+            == flat["streams"][name]["windows_raw"]["fast"]["counts"]
+    assert nested["streams"]["cost"]["lifetime"]["mean"] \
+        == pytest.approx(flat["streams"]["cost"]["lifetime"]["mean"])
+
+    assert merge_snapshots([None, {}, None]) is None
+    # a worker without a drift section contributes NOTHING
+    solo = merge_snapshots([a.snapshot(), None])
+    assert solo["streams"]["cost"]["lifetime"]["count"] == 3
+
+
+def test_merge_snapshots_mixed_references_visible():
+    clock = _Clock()
+    a, b = _tracker(clock), _tracker(clock)
+    a.observe_decision("aws", 0.5, cost=0.5, latency=0.5)
+    b.observe_decision("aws", 0.5, cost=0.5, latency=0.5)
+    ref_a = build_reference(a.snapshot(), source="a")
+    a.set_reference(ref_a)
+    b.observe_decision("azure", 0.9, cost=0.9, latency=0.9)
+    b.set_reference(build_reference(b.snapshot(), source="b"))
+    merged = merge_snapshots([a.snapshot(), b.snapshot()])
+    # a mid-roll reference swap must be VISIBLE, never averaged away
+    assert merged["reference_mixed"] is True
+    same = merge_snapshots([a.snapshot(), a.snapshot()])
+    assert "reference_mixed" not in same
+    assert same["reference"]["fingerprint"] == ref_a["fingerprint"]
+
+
+# ------------------------------------------------------------- references
+
+
+def test_reference_roundtrip_fingerprint_and_tamper(tmp_path):
+    tracker = _tracker()
+    for _ in range(10):
+        tracker.observe_decision("aws", 0.3, cost=0.3, latency=0.3)
+    ref = build_reference(tracker.snapshot(), source="test")
+    assert ref["fingerprint"] == reference_fingerprint(ref)
+    # content-addressed: re-capturing identical counts => identical
+    # fingerprint, provenance fields don't participate
+    again = build_reference(tracker.snapshot(), source="elsewhere")
+    assert again["fingerprint"] == ref["fingerprint"]
+
+    path = tmp_path / "reference.json"
+    save_reference(str(path), ref)
+    loaded = load_reference(str(path))
+    assert loaded == ref
+
+    tampered = dict(ref)
+    tampered["streams"] = dict(ref["streams"])
+    score = dict(ref["streams"]["score"])
+    score["counts"] = [c + 1 for c in score["counts"]]
+    tampered["streams"]["score"] = score
+    bad = tmp_path / "tampered.json"
+    bad.write_text(json.dumps(tampered))
+    with pytest.raises(ValueError, match="fingerprint"):
+        load_reference(str(bad))
+    notref = tmp_path / "notref.json"
+    notref.write_text(json.dumps({"schema": 99}))
+    with pytest.raises(ValueError, match="schema"):
+        load_reference(str(notref))
+
+
+def _write_trace(trace_dir, records):
+    log = TraceLog(trace_dir, prefix="w0-")
+    for record in records:
+        assert log.append(record)
+    log.close()
+
+
+def _trace_record(endpoint="extender", score=0.4, chosen="aws",
+                  generation=0, fail_open=False):
+    return decision_record(
+        endpoint=endpoint, family="cloud", backend="greedy", candidates=2,
+        chosen=chosen, score=score, latency_ms=1.0,
+        generation=generation, fail_open=fail_open)
+
+
+def test_reference_from_trace_newest_generation_excludes_synthetic(
+        tmp_path):
+    """The eval-corpus path: only the NEWEST generation with scorable
+    records is frozen, probe/shadow records and fail-opens are excluded,
+    and a trace with nothing scorable refuses loudly."""
+    trace = tmp_path / "trace"
+    _write_trace(trace, [
+        _trace_record(generation=0, score=0.2),
+        _trace_record(generation=1, score=0.4),
+        _trace_record(generation=1, score=0.4, chosen="azure"),
+        _trace_record(generation=1, endpoint="probe", score=0.9),
+        _trace_record(generation=1, endpoint="shadow", score=0.9),
+        _trace_record(generation=1, fail_open=True, score=None,
+                      chosen=None),
+    ])
+    ref = reference_from_trace(str(trace))
+    assert ref["generation"] == 1
+    assert ref["streams"]["score"]["count"] == 2  # synthetic excluded
+    assert ref["streams"]["action"]["counts"][:2] == [1, 1]
+    assert ref["fingerprint"] == reference_fingerprint(ref)
+
+    empty = tmp_path / "empty"
+    _write_trace(empty, [_trace_record(endpoint="probe"),
+                         _trace_record(fail_open=True, score=None,
+                                       chosen=None)])
+    with pytest.raises(ValueError, match="no scorable"):
+        reference_from_trace(str(empty))
+
+
+def test_drift_snapshot_cli(tmp_path, capsys):
+    """``python -m rl_scheduler_tpu.scheduler.drift snapshot``: freezes
+    a fingerprint-verified reference from a /stats body (file or URL)
+    or a trace dir; refuses a statsless/empty server with exit 2."""
+    tracker = _tracker()
+    for _ in range(5):
+        tracker.observe_decision("aws", 0.3, cost=0.3, latency=0.3)
+    stats = tmp_path / "stats.json"
+    stats.write_text(json.dumps({"backend": "greedy",
+                                 "drift": tracker.snapshot()}))
+    out = tmp_path / "ref.json"
+    assert drift_mod.main(["snapshot", "--stats", str(stats),
+                           "--out", str(out)]) == 0
+    ref = load_reference(str(out))
+    assert ref["streams"]["score"]["count"] == 5
+    assert ref["source"] == f"stats:{stats}"
+
+    nodrift = tmp_path / "nodrift.json"
+    nodrift.write_text(json.dumps({"backend": "greedy"}))
+    assert drift_mod.main(["snapshot", "--stats", str(nodrift),
+                           "--out", str(out)]) == 2
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"drift": _tracker().snapshot()}))
+    assert drift_mod.main(["snapshot", "--stats", str(empty),
+                           "--out", str(out)]) == 2
+
+    trace = tmp_path / "trace"
+    _write_trace(trace, [_trace_record()])
+    out2 = tmp_path / "ref2.json"
+    assert drift_mod.main(["snapshot", "--trace", str(trace),
+                           "--out", str(out2)]) == 0
+    assert load_reference(str(out2))["source"] == f"trace:{trace}"
+    capsys.readouterr()
+
+
+# --------------------------------------------------------- shadow scoring
+
+
+def test_shadow_scorer_agreement_errors_and_drops():
+    seen = []
+    scorer = ShadowScorer(lambda obs: (1, 0.9),
+                          record_fn=lambda a, s, lat, obs:
+                          seen.append((a, s, obs)))
+    scorer.submit([0.0], 1, 0.5)   # agrees, delta +0.4
+    scorer.submit([0.0], 0, 0.7)   # disagrees, delta +0.2
+    assert scorer.drain()
+    time.sleep(0.05)
+    snap = scorer.snapshot()
+    assert snap["submitted_total"] == 2
+    assert snap["scored_total"] == 2
+    assert snap["agreements_total"] == 1
+    assert snap["agreement_rate"] == pytest.approx(0.5)
+    assert snap["score_delta"]["mean"] == pytest.approx(0.3)
+    assert len(seen) == 2 and seen[0][0] == 1
+    scorer.close()
+
+    broken = ShadowScorer(lambda obs: 1 / 0)
+    broken.submit([0.0], 0, 0.5)
+    broken.drain()
+    time.sleep(0.05)
+    snap = broken.snapshot()
+    assert snap["errors_total"] == 1 and snap["scored_total"] == 0
+    assert snap["agreement_rate"] is None
+    broken.close()
+
+    gate = threading.Event()
+
+    def _blocked(obs):
+        gate.wait(5.0)
+        return 0, 0.5
+
+    slow = ShadowScorer(_blocked, queue_size=1)
+    for _ in range(4):  # worker holds one, queue holds one, rest drop
+        slow.submit([0.0], 0, 0.5)
+    dropped = slow.snapshot()["dropped_total"]
+    assert dropped >= 2  # the serving side NEVER blocked
+    gate.set()
+    slow.drain()
+    slow.close()
+    assert slow.snapshot()["submitted_total"] == 4
+
+
+def test_sum_shadow_counters_sum_rate_recomputes():
+    a = {"submitted_total": 10, "scored_total": 8, "dropped_total": 2,
+         "errors_total": 0, "agreements_total": 8,
+         "score_delta": {"counts": [8, 0, 0], "sum": 0.8}}
+    b = {"submitted_total": 4, "scored_total": 2, "dropped_total": 0,
+         "errors_total": 1, "agreements_total": 0,
+         "score_delta": {"counts": [0, 2, 0], "sum": -0.2}}
+    merged = sum_shadow([a, b, None])
+    assert merged["scored_total"] == 10
+    assert merged["agreements_total"] == 8
+    assert merged["agreement_rate"] == pytest.approx(0.8)
+    assert merged["score_delta"]["counts"][:3] == [8, 2, 0]
+    assert merged["score_delta"]["mean"] == pytest.approx(0.06)
+    assert sum_shadow([None, {}]) is None
+
+
+# ----------------------------------------------- serving-path wiring
+
+
+def test_policy_records_drift_in_record_trace_reset_never_rewinds():
+    """One drift observation per served decision — recorded in
+    ``_record_trace`` so every exclusion (probes, shadow, fail-opens)
+    happens in the ONE place the histograms already use — and
+    ``/stats/reset`` never rewinds the lifetime sketches (the same
+    monotonicity contract as the latency histograms)."""
+    policy = _policy()
+    n = 12
+    for i in range(n):
+        policy.filter(_filter_args(i))
+    policy.warmup_probe()  # synthetic: must not land in any sketch
+    stats = policy.statistics()
+    snap = stats["drift"]
+    for name in STREAMS:
+        assert snap["streams"][name]["lifetime"]["count"] == n
+    # flat-family features: cost/latency column means land in [0, 1]
+    assert 0.0 <= snap["streams"]["cost"]["lifetime"]["mean"] <= 1.0
+    aws, azure = (snap["streams"]["action"]["lifetime"]["counts"][i]
+                  for i in range(2))
+    assert aws + azure == n
+
+    policy.reset_stats()
+    after = policy.statistics()["drift"]
+    for name in STREAMS:
+        assert after["streams"][name]["lifetime"]["count"] == n
+
+    health = policy.health()
+    assert health["status"] == "ok"  # drift is body-only, never liveness
+    assert health["drift"]["reference"] is False
+    assert set(health["drift"]["statuses"]) == set(STREAMS)
+    text = policy.metrics_text()
+    assert ('rl_scheduler_extender_drift_observations_total'
+            '{stream="score"}') in text
+    assert "rl_scheduler_extender_drift_reference 0" in text
+
+
+def test_synthetic_exclusion_audited_in_one_place(tmp_path):
+    """The pinned invariant: every histogram family — e2e latency,
+    per-phase spans, SLO counters, drift sketches — excludes
+    ``endpoint in SYNTHETIC_ENDPOINTS`` ({probe, shadow}) at record
+    time via the shared ``is_synthetic_endpoint`` predicate, so
+    count-uniformity closes at exactly the served-request count."""
+    assert SYNTHETIC_ENDPOINTS == frozenset({"probe", "shadow"})
+    assert is_synthetic_endpoint("probe")
+    assert is_synthetic_endpoint("shadow")
+    assert not is_synthetic_endpoint("extender")
+    assert not is_synthetic_endpoint(None)
+
+    policy = _policy()
+    policy.slo = SloTracker(SloConfig(p99_ms=1000.0, availability=0.999))
+    policy.trace = TraceLog(tmp_path / "trace", prefix="w0-")
+    n = 10
+    for i in range(n):
+        policy.filter(_filter_args(i))
+    for _ in range(3):
+        policy.warmup_probe()
+    policy.trace.close()
+    stats = policy.statistics()
+    assert stats["latency"]["lifetime_count"] == n
+    for phase, entry in stats["phases"].items():
+        assert entry["lifetime_count"] == n, phase
+    assert stats["slo"]["lifetime"]["requests_total"] == n
+    for name in STREAMS:
+        assert stats["drift"]["streams"][name]["lifetime"]["count"] == n
+
+    # trace consumers route through the same predicate: the probes are
+    # on disk (tagged) but never replayed/compiled/frozen
+    from rl_scheduler_tpu.loopback.compile import usable_records
+    from tools.decisionview import load_trace_records
+    records, cstats = usable_records(str(tmp_path / "trace"))
+    assert cstats["probes_excluded"] == 3
+    assert all(not is_synthetic_endpoint(r.get("endpoint"))
+               for r in records)
+    served = load_trace_records(str(tmp_path / "trace"))
+    assert len(served) == n
+    both = load_trace_records(str(tmp_path / "trace"), include_probes=True)
+    assert len(both) == n + 3
+
+
+def _shadow_greedy(obs):
+    import numpy as np
+
+    action, logits = GreedyBackend().decide(obs)
+    z = logits - logits.max()
+    probs = np.exp(z) / np.exp(z).sum()
+    return int(action), float(probs[action])
+
+
+def test_shadow_scoring_zero_effect_on_serving():
+    """The acceptance pin: shadow scoring has ZERO effect on served
+    decisions, SLO counters, and phase count-uniformity — a shadowed
+    policy and a shadow-off twin fed the identical request sequence
+    produce bitwise-identical decisions and counters, while the shadow
+    side actually scored (agreement 1.0: greedy judging greedy)."""
+    shadowed = _policy(drift=False, shadow_fn=_shadow_greedy)
+    plain = _policy(drift=False)
+    shadowed.slo = SloTracker(SloConfig(p99_ms=1000.0))
+    plain.slo = SloTracker(SloConfig(p99_ms=1000.0))
+    n = 16
+    results = [(shadowed.filter(_filter_args(i)),
+                plain.filter(_filter_args(i))) for i in range(n)]
+    for with_shadow, without in results:
+        assert with_shadow == without
+    s_stats, p_stats = shadowed.statistics(), plain.statistics()
+    assert s_stats["decisions"] == p_stats["decisions"]
+    assert s_stats["choice_fractions"] == p_stats["choice_fractions"]
+    assert s_stats["fail_open_total"] == p_stats["fail_open_total"]
+    assert s_stats["latency"]["lifetime_count"] \
+        == p_stats["latency"]["lifetime_count"] == n
+    for phase in s_stats["phases"]:
+        assert s_stats["phases"][phase]["lifetime_count"] \
+            == p_stats["phases"][phase]["lifetime_count"] == n
+    assert s_stats["slo"]["lifetime"] == p_stats["slo"]["lifetime"]
+    assert "shadow" not in p_stats
+
+    assert shadowed.shadow.drain()
+    time.sleep(0.05)
+    shadow = shadowed.statistics()["shadow"]
+    assert shadow["submitted_total"] == n
+    assert shadow["scored_total"] == n
+    assert shadow["agreement_rate"] == pytest.approx(1.0)
+    assert shadow["score_delta"]["mean"] == pytest.approx(0.0, abs=1e-9)
+    text = shadowed.metrics_text()
+    assert f"rl_scheduler_extender_shadow_scored_total {n}" in text
+    assert "rl_scheduler_extender_shadow_agreement 1.0" in text
+    shadowed.shadow.close()
+
+
+# ----------------------------------------------------------- expositions
+
+
+def test_metric_lines_exposition():
+    tracker = _tracker()
+    tracker.observe_decision("aws", 0.5, cost=0.5, latency=0.5)
+    no_ref = "\n".join(drift_metric_lines("rl", tracker.snapshot()))
+    assert "rl_drift_reference 0" in no_ref
+    assert 'rl_drifting{stream="score"} 0' in no_ref
+    assert 'rl_drift_observations_total{stream="cost"} 1' in no_ref
+
+    ref = build_reference(tracker.snapshot())
+    tracker.set_reference(ref)
+    text = "\n".join(drift_metric_lines("rl", tracker.snapshot()))
+    fp = ref["fingerprint"][:12]
+    assert f'rl_drift_reference{{fingerprint="{fp}",generation="0"}} 1' \
+        in text
+    assert 'stream="score",window="fast",kind="psi"' in text
+
+    shadow = "\n".join(shadow_metric_lines(
+        "rl", {"scored_total": 4, "agreements_total": 3,
+               "agreement_rate": 0.75, "score_delta": {"mean": -0.01}}))
+    assert "rl_shadow_scored_total 4" in shadow
+    assert "rl_shadow_agreement 0.75" in shadow
+    assert "rl_shadow_score_delta_mean -0.01" in shadow
+    idle = "\n".join(shadow_metric_lines("rl", {}))
+    assert "rl_shadow_agreement -1" in idle
+
+
+# -------------------------------------------------- pool + fleet merges
+
+
+def _drift_worker(worker_id, clouds_costs, reference=None, shadow=None):
+    """A real policy snapshot with a drift section fed a known mix."""
+    shared = PoolShared()
+    telemetry = TableTelemetry.from_table(
+        cpu_source=RandomCpu(seed=0), counter=shared.table_counter)
+    policy = ExtenderPolicy(GreedyBackend(), telemetry)
+    policy.drift = DriftTracker(DriftConfig())
+    for cloud, cost in clouds_costs:
+        policy.drift.observe_decision(cloud, 0.5, cost=cost, latency=cost)
+    if reference is not None:
+        policy.drift.set_reference(reference)
+    if shadow is not None:
+        policy.shadow = shadow
+    return worker_snapshot(policy, worker_id)
+
+
+def test_pool_merge_worker_drift_and_shadow_sections():
+    """merge_worker_drift/sum_worker_shadow are drift's merges lifted
+    over worker snapshots; aggregate_stats carries the sections and
+    aggregate_metrics exports them; workers (or whole pools) without
+    the sections contribute nothing — never a zero-fill."""
+    snap_a = _drift_worker(0, [("aws", 0.2)] * 3)
+    snap_b = _drift_worker(1, [("azure", 0.8)] * 5)
+    merged = merge_worker_drift([snap_a, snap_b])
+    assert merged["streams"]["cost"]["lifetime"]["count"] == 8
+    assert merged["streams"]["action"]["lifetime"]["counts"][:2] == [3, 5]
+
+    plain = {"schema": 1, "worker_id": 2, "pid": 3,
+             "stats": {"decisions": {}},
+             "histogram": {"cumulative": [], "sum": 0.0, "count": 0}}
+    assert merge_worker_drift([plain]) is None
+    degraded = merge_worker_drift([snap_a, plain])
+    assert degraded["streams"]["cost"]["lifetime"]["count"] == 3
+
+    body = aggregate_stats([snap_a, snap_b], pool={"workers": 2})
+    assert body["drift"]["streams"]["cost"]["lifetime"]["count"] == 8
+    assert "shadow" not in body
+    text = aggregate_metrics([snap_a, snap_b], pool={"workers": 2,
+                                                     "alive": 2})
+    assert 'drift_observations_total{stream="cost"} 8' in text
+
+    shadow = {"submitted_total": 6, "scored_total": 6, "dropped_total": 0,
+              "errors_total": 0, "agreements_total": 6,
+              "score_delta": {"counts": [6], "sum": 0.0}}
+    snap_c = dict(snap_a)
+    snap_c["stats"] = dict(snap_a["stats"])
+    snap_c["stats"]["shadow"] = shadow
+    assert sum_worker_shadow([snap_a, snap_b]) is None
+    pooled = sum_worker_shadow([snap_c, snap_b])
+    assert pooled["scored_total"] == 6
+    assert pooled["agreement_rate"] == pytest.approx(1.0)
+
+
+def test_fleet_drift_merge_equals_union_of_workers():
+    """Satellite (c): fleet-merged drift over 3 pools x 2 workers ==
+    one flat merge over all six worker sections (counts exactly,
+    moments to rounding), via PR 17's pseudo-worker machinery; a
+    version-skewed pool without a drift section degrades the merge to
+    the pools that have one — never zero-fills; the fleet exposition
+    carries the drifting gauge."""
+    mixes = [[("aws", 0.1)] * 2, [("azure", 0.9)] * 3,
+             [("aws", 0.3)] * 4, [("azure", 0.7)] * 1,
+             [("aws", 0.5)] * 5, [("azure", 0.5)] * 2]
+    all_snaps = [_drift_worker(i % 2, mix) for i, mix in enumerate(mixes)]
+    bodies = {
+        f"pool{p}": aggregate_stats(all_snaps[2 * p:2 * p + 2],
+                                    pool={"workers": 2, "alive": 2})
+        for p in range(3)
+    }
+    fleet_body = aggregate_fleet_stats(bodies, fleet={"generation": 0})
+    union = merge_snapshots(
+        [s["stats"]["drift"] for s in all_snaps])
+    for name in STREAMS:
+        assert fleet_body["drift"]["streams"][name]["lifetime"] \
+            == union["streams"][name]["lifetime"]
+        assert fleet_body["drift"]["streams"][name]["windows_raw"] \
+            == union["streams"][name]["windows_raw"]
+    assert fleet_body["drift"]["drifting"] == union["drifting"]
+
+    skewed = {k: v for k, v in bodies["pool0"].items() if k != "drift"}
+    partial = aggregate_fleet_stats(
+        {"old": skewed, "pool1": bodies["pool1"],
+         "pool2": bodies["pool2"]}, fleet={})
+    expect = merge_snapshots([s["stats"]["drift"] for s in all_snaps[2:]])
+    assert partial["drift"]["streams"]["cost"]["lifetime"]["count"] \
+        == expect["streams"]["cost"]["lifetime"]["count"]
+
+    text = aggregate_fleet_metrics(bodies, fleet={"pools": 3})
+    assert 'drifting{stream="cost"} 0' in text
+    assert 'drift_observations_total{stream="action"} 17' in text
+
+
+# ------------------------------------------------------ the drill (E2E)
+
+
+_DRILL_TABLES: dict = {}  # set before pool start; forked workers inherit
+
+_DRILL_CONFIG = DriftConfig(threshold=0.2, fast_window_s=1.0,
+                            slow_window_s=3.0, min_window_count=10,
+                            bucket_s=0.25)
+
+
+def _drill_factory(worker_id, shared):
+    telemetry = TableTelemetry.from_table(
+        data_path=_DRILL_TABLES["base"],
+        cpu_source=RandomCpu(seed=0), counter=shared.table_counter)
+    policy = ExtenderPolicy(GreedyBackend(), telemetry)
+    policy.drift = DriftTracker(_DRILL_CONFIG)
+    policy.shadow = ShadowScorer(_shadow_greedy)
+    return policy
+
+
+def _write_table(path, cost_aws, cost_azure, lat_aws, lat_azure,
+                 rows=32):
+    """A normalized replay table with jitter small enough to stay
+    inside one drift bucket (width 1/16), so the stationary soak is
+    genuinely stationary."""
+    lines = ["cost_aws,cost_azure,latency_aws,latency_azure"]
+    for i in range(rows):
+        j = (i % 8) * 0.001
+        lines.append(f"{cost_aws + j:.4f},{cost_azure + j:.4f},"
+                     f"{lat_aws + j:.4f},{lat_azure + j:.4f}")
+    path.write_text("\n".join(lines) + "\n")
+
+
+def _load_bench():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "extender_bench",
+        Path(__file__).resolve().parents[1] / "loadgen" /
+        "extender_bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    return bench
+
+
+def _get(port, path, timeout=10):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=timeout) as resp:
+        body = resp.read()
+    if resp.headers.get("Content-Type", "").startswith("application/json"):
+        return json.loads(body)
+    return body.decode()
+
+
+def _post(port, path, payload, timeout=10):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.load(resp)
+
+
+@needs_fork
+def test_drift_drill(tmp_path):
+    """``make drift-drill``: a 2-worker pool soaks under price replay;
+    a stationary control soak against a frozen reference never alarms
+    (and ``driftview --check`` exits 0); a mid-soak regime flip
+    (``extender_bench --flip-at/--flip-tables`` swapping the replay
+    table through ``POST /telemetry/flip``) flips ``*_drifting`` in
+    BOTH burn windows on the feature and action streams (and
+    ``driftview --check`` exits 2). Lifetime sketches survive
+    ``/stats/reset``; shadow scoring rode along the whole soak with
+    perfect agreement and zero serving failures."""
+    from tools.driftview.__main__ import main as driftview_main
+
+    base_csv = tmp_path / "base.csv"
+    spike_csv = tmp_path / "spike.csv"
+    # base: aws clearly cheapest (greedy serves aws); spike: azure
+    # cheapest and every cost/latency column shifted ~10 buckets up
+    _write_table(base_csv, 0.10, 0.30, 0.20, 0.24)
+    _write_table(spike_csv, 0.95, 0.60, 0.90, 0.85)
+    _DRILL_TABLES["base"] = str(base_csv)
+    budgets = str(Path(__file__).resolve().parents[1] / "tools" /
+                  "driftview" / "budgets.json")
+
+    bench = _load_bench()
+    pool = ServingPool(_drill_factory, workers=2, host="127.0.0.1",
+                       port=0, control_port=0,
+                       restart_policy=FAST_RESTARTS,
+                       stable_after_s=60.0, poll_interval_s=0.05)
+    pool.start(ready_timeout_s=60.0)
+    try:
+        cport = pool.control_address[1]
+        stats_url = f"http://127.0.0.1:{cport}/stats"
+        common = ["--port", str(pool.port), "--threads", "4",
+                  "--warmup", "5", "--control-port", str(cport)]
+
+        # phase 1: soak the base regime, then freeze the reference
+        out1 = bench.main(common + ["--duration", "1.5"])
+        assert out1["failures"] == 0
+        ref_path = tmp_path / "reference.json"
+        assert drift_mod.main(["snapshot", "--stats", stats_url,
+                               "--out", str(ref_path)]) == 0
+        ref = load_reference(str(ref_path))
+        assert set(ref["streams"]) == set(STREAMS)
+
+        # control-plane refusals: a bad reference path / bad table
+        # refuses with 409 + errors, a missing body key with 400
+        for path, payload, code in (
+                ("/drift/reference", {"path": str(tmp_path / "nope")},
+                 409),
+                ("/telemetry/flip", {"path": str(tmp_path / "nope")},
+                 409),
+                ("/drift/reference", {}, 400)):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(cport, path, payload)
+            assert err.value.code == code
+
+        resp = _post(cport, "/drift/reference", {"path": str(ref_path)})
+        assert resp["status"] == "loaded" and resp["workers"] == 2
+
+        # phase 2: the stationary control — zero drifting transitions
+        out2 = bench.main(common + ["--duration", "1.2"])
+        assert out2["failures"] == 0
+        stats = _get(cport, "/stats")
+        drift = stats["drift"]
+        assert drift["drifting"] == []
+        assert all(s["status"] == "ok" for s in drift["scores"].values())
+        assert drift["reference"]["fingerprint"] == ref["fingerprint"]
+        assert driftview_main(["--stats", stats_url, "--reference",
+                               str(ref_path), "--check", "--budgets",
+                               budgets, "--json"]) == 0
+
+        # phase 3: the regime flip mid-soak — post-flip traffic fills
+        # both burn windows (slow = 3 s < the 3.5 s post-flip tail)
+        out3 = bench.main(common + ["--duration", "4.0",
+                                    "--flip-at", "0.5",
+                                    "--flip-tables", str(spike_csv)])
+        assert out3["failures"] == 0
+        assert out3["flip"]["response_code"] == 200
+        assert out3["flip"]["response"]["status"] == "flipped"
+        assert out3["flip"]["response"]["workers"] == 2
+        assert out3["phases"]["pre_flip"]["requests"] > 0
+        assert out3["phases"]["post_flip"]["requests"] > 0
+        assert out3["flip_at_s"] == pytest.approx(0.5)
+
+        stats = _get(cport, "/stats")
+        drift = stats["drift"]
+        # every stream moved: the chosen cloud flipped to azure, the
+        # feature means jumped ~10 buckets, and the greedy softmax
+        # score crossed a bucket edge with the new cost gap
+        assert drift["drifting"] == sorted(STREAMS)
+        for name in STREAMS:
+            score = drift["scores"][name]
+            assert score["drifting"] is True, (name, score)
+            for w in ("fast", "slow"):
+                assert score["burn"][w] >= 1.0
+                assert score["windows"][w]["sufficient"]
+        metrics = _get(cport, "/metrics")
+        assert 'drifting{stream="cost"} 1' in metrics
+        assert 'drifting{stream="action"} 1' in metrics
+        health = _get(pool.port, "/healthz")
+        assert health["drift"]["drifting"] == drift["drifting"]
+
+        # shadow rode the whole soak: scored plenty, agreed perfectly,
+        # and the serving side never failed a request (above)
+        shadow = stats["shadow"]
+        assert shadow["scored_total"] > 0
+        assert shadow["agreement_rate"] == pytest.approx(1.0)
+
+        assert driftview_main(["--stats", stats_url, "--reference",
+                               str(ref_path), "--check", "--budgets",
+                               budgets, "--json"]) == 2
+
+        # /stats/reset fans out but never rewinds the lifetime sketches
+        before = drift["streams"]["score"]["lifetime"]["count"]
+        _post(cport, "/stats/reset", {})
+        after = _get(cport, "/stats")["drift"]
+        assert after["streams"]["score"]["lifetime"]["count"] >= before
+    finally:
+        pool.shutdown()
+        _DRILL_TABLES.clear()

@@ -101,11 +101,15 @@ def load_trace_records(trace_dir: str | Path,
     trailing lines skipped), so the report can never disagree with the
     writer about segment order and per-generation windows line up
     chronologically across workers."""
-    from rl_scheduler_tpu.scheduler.tracelog import iter_trace_merged
+    from rl_scheduler_tpu.scheduler.tracelog import (
+        is_synthetic_endpoint,
+        iter_trace_merged,
+    )
 
     records = []
     for record in iter_trace_merged(trace_dir):
-        if not include_probes and record.get("endpoint") == "probe":
+        if not include_probes and \
+                is_synthetic_endpoint(record.get("endpoint")):
             continue
         records.append(record)
     return records

@@ -1,0 +1,328 @@
+"""graftdrift part 2: the drift report with retrain-trigger gating.
+
+``tools/decisionview`` joined the serving plane's latency artifacts into
+a budget-gated perf report; nothing did the same for the DISTRIBUTION
+artifacts graftdrift produces. driftview is the drift sibling: a
+pure-stdlib joiner over three inputs —
+
+- a ``/stats`` **snapshot** (single-process, pool, or fleet body; JSON
+  file or live ``http://`` URL) carrying the ``drift`` section
+  (per-stream PSI/KS vs the loaded reference, window counts, the
+  burn-style drifting verdicts) and the optional ``shadow`` section
+  (incumbent-vs-candidate top-1 agreement, score-delta histogram),
+- a frozen **reference** file (``drift snapshot`` CLI output,
+  fingerprint-verified) to cross-check what the server actually loaded,
+- a **trace-log** directory, summarized per generation with synthetic
+  (probe/shadow) records counted apart — the corpus a reference would
+  be re-frozen from after a promote,
+
+— into one report:
+
+- **Per-stream drift table**: status (``ok`` / ``no_reference`` /
+  ``generation_mismatch``), fast/slow PSI and KS, window sample counts
+  with sufficiency, and the drifting verdict (burn semantics: BOTH
+  windows over threshold — a transient spike never trips it).
+- **Reference lineage**: the fingerprint/generation the server loaded
+  vs the ``--reference`` file on disk — a stale file is visible before
+  anyone trusts a green gate.
+- **Shadow verdict**: candidate agreement rate and score-delta mean
+  next to the drop/error counters that bound how much was graded.
+- **Gating** (``--check``, exit 2 — the decisionview/graftlint
+  fail-the-build contract): any drifting stream (unless the budgets
+  allow it), a gradable stream with no/mismatched reference when the
+  budgets require one, a server/file fingerprint mismatch, and a shadow
+  agreement rate under the floor. ``make drift-report`` runs it against
+  the checked-in fixture (off-network tier-1) or a live pool.
+
+Every input is optional — pass what you have. The module stays
+stdlib-only (no numpy, no scheduler imports at module scope) so the
+report runs anywhere the JSON artifacts land; the fingerprint recompute
+below mirrors ``scheduler/drift.reference_fingerprint`` and is pinned
+equal by test. docs/observability.md §5.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+
+SCHEMA_VERSION = 1
+REFERENCE_SCHEMA = 1  # scheduler/drift.REFERENCE_SCHEMA (pinned by test)
+
+
+# ------------------------------------------------------------------ inputs
+
+
+def load_stats(source: str) -> dict:
+    """A ``/stats`` body from a JSON file or a live ``http://`` URL —
+    single-process server, pool control plane, or a graftfleet
+    controller's merged body (all carry the same ``drift`` shape)."""
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+
+        with urllib.request.urlopen(source, timeout=10) as resp:
+            return json.load(resp)
+    return json.loads(Path(source).read_text())
+
+
+def reference_fingerprint(reference: dict) -> str:
+    """Recompute the reference's content fingerprint — the SAME
+    canonicalization as ``scheduler/drift.reference_fingerprint``
+    (schema + generation + streams, sorted keys, compact separators),
+    duplicated here so the report stays stdlib-only; a cross-check test
+    pins the two implementations equal."""
+    body = {
+        "schema": reference.get("schema", REFERENCE_SCHEMA),
+        "generation": reference.get("generation", 0),
+        "streams": reference.get("streams") or {},
+    }
+    canonical = json.dumps(body, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(canonical.encode()).hexdigest()
+
+
+def load_reference(path: str | Path) -> dict:
+    """A frozen reference file, fingerprint-verified on load (an edited
+    or truncated file is refused, same contract as the server's
+    ``--drift-ref``)."""
+    ref = json.loads(Path(path).read_text())
+    if not isinstance(ref, dict) or ref.get("schema") != REFERENCE_SCHEMA:
+        raise ValueError(f"{path}: not a drift reference "
+                         f"(schema {REFERENCE_SCHEMA} expected)")
+    expected = reference_fingerprint(ref)
+    if ref.get("fingerprint") != expected:
+        raise ValueError(
+            f"{path}: reference fingerprint mismatch (stored "
+            f"{str(ref.get('fingerprint'))[:12]}…, distribution hashes "
+            f"to {expected[:12]}…) — re-snapshot instead of repairing "
+            "by hand")
+    return ref
+
+
+def load_budgets(path: str | Path) -> dict:
+    return json.loads(Path(path).read_text())
+
+
+def summarize_trace(trace_dir: str | Path) -> dict:
+    """Per-generation record counts from a trace dir, synthetic
+    (probe/shadow) traffic counted APART — the served corpus a
+    post-promote reference re-freeze would draw from."""
+    from rl_scheduler_tpu.scheduler.tracelog import (
+        is_synthetic_endpoint,
+        iter_trace_merged,
+    )
+
+    generations: dict = {}
+    synthetic = fail_opens = 0
+    for record in iter_trace_merged(trace_dir):
+        if is_synthetic_endpoint(record.get("endpoint")):
+            synthetic += 1
+            continue
+        if record.get("fail_open"):
+            fail_opens += 1
+            continue
+        gen = int(record.get("generation", 0))
+        generations[gen] = generations.get(gen, 0) + 1
+    return {
+        "generations": {str(g): n for g, n in sorted(generations.items())},
+        "served_records": sum(generations.values()),
+        "synthetic_excluded": synthetic,
+        "fail_opens_excluded": fail_opens,
+    }
+
+
+# ------------------------------------------------------------------ report
+
+
+def build_report(stats: dict | None = None,
+                 reference: dict | None = None,
+                 trace_summary: dict | None = None) -> dict:
+    """Join the inputs into the report dict the formatter and the gates
+    consume. Sections are present only when their input was."""
+    report: dict = {"schema_version": SCHEMA_VERSION}
+    drift = (stats or {}).get("drift")
+    if drift is not None:
+        streams = {}
+        for name, score in (drift.get("scores") or {}).items():
+            entry = dict(score)
+            lifetime = ((drift.get("streams") or {}).get(name) or {}) \
+                .get("lifetime") or {}
+            entry["lifetime_count"] = lifetime.get("count", 0)
+            streams[name] = entry
+        loaded_ref = drift.get("reference") or None
+        report["drift"] = {
+            "generation": drift.get("generation", 0),
+            "streams": streams,
+            "drifting": list(drift.get("drifting") or []),
+            "reference_loaded": bool(loaded_ref),
+            "reference_fingerprint": (loaded_ref or {}).get("fingerprint"),
+            "reference_generation": (loaded_ref or {}).get("generation"),
+            "reference_mixed": bool(drift.get("reference_mixed")),
+        }
+    shadow = (stats or {}).get("shadow")
+    if shadow is not None:
+        delta = shadow.get("score_delta") or {}
+        report["shadow"] = {
+            "scored_total": shadow.get("scored_total", 0),
+            "submitted_total": shadow.get("submitted_total", 0),
+            "dropped_total": shadow.get("dropped_total", 0),
+            "errors_total": shadow.get("errors_total", 0),
+            "agreement_rate": shadow.get("agreement_rate"),
+            "score_delta_mean": delta.get("mean"),
+        }
+    if reference is not None:
+        report["reference_file"] = {
+            "fingerprint": reference.get("fingerprint"),
+            "generation": reference.get("generation"),
+            "source": reference.get("source", ""),
+            "streams": sorted((reference.get("streams") or {}).keys()),
+        }
+    if trace_summary is not None:
+        report["trace"] = dict(trace_summary)
+    return report
+
+
+def format_report(report: dict) -> str:
+    """Human tables (stdout). The JSON line is the machine surface;
+    this is the operator's."""
+    lines = []
+    drift = report.get("drift")
+    if drift is not None:
+        lines.append("== drift (generation "
+                     f"{drift['generation']}) ==")
+        header = (f"{'stream':<10} {'status':<20} {'fast_psi':>9} "
+                  f"{'slow_psi':>9} {'fast_ks':>8} {'slow_ks':>8} "
+                  f"{'n_fast':>7} {'n_slow':>7}  drifting")
+        lines.append(header)
+        for name, s in sorted(drift["streams"].items()):
+            psi = s.get("psi") or {}
+            ks = s.get("ks") or {}
+            windows = s.get("windows") or {}
+
+            def _f(v):
+                return "-" if v is None else f"{v:.4f}"
+
+            lines.append(
+                f"{name:<10} {s.get('status', '?'):<20} "
+                f"{_f(psi.get('fast')):>9} {_f(psi.get('slow')):>9} "
+                f"{_f(ks.get('fast')):>8} {_f(ks.get('slow')):>8} "
+                f"{(windows.get('fast') or {}).get('count', 0):>7} "
+                f"{(windows.get('slow') or {}).get('count', 0):>7}  "
+                f"{'DRIFTING' if s.get('drifting') else 'ok'}")
+        ref_fp = drift.get("reference_fingerprint")
+        lines.append(
+            "reference: "
+            + (f"{ref_fp[:12]}… (generation "
+               f"{drift.get('reference_generation')})"
+               if ref_fp else "NONE LOADED")
+            + ("  [MIXED across workers]" if drift.get("reference_mixed")
+               else ""))
+    shadow = report.get("shadow")
+    if shadow is not None:
+        lines.append("== shadow ==")
+        rate = shadow.get("agreement_rate")
+        lines.append(
+            f"scored {shadow['scored_total']}/"
+            f"{shadow['submitted_total']} submitted "
+            f"(dropped {shadow['dropped_total']}, "
+            f"errors {shadow['errors_total']}); "
+            "agreement "
+            + ("-" if rate is None else f"{rate:.4f}")
+            + ", score-delta mean "
+            + ("-" if shadow.get("score_delta_mean") is None
+               else f"{shadow['score_delta_mean']:+.4f}"))
+    ref_file = report.get("reference_file")
+    if ref_file is not None:
+        lines.append("== reference file ==")
+        lines.append(
+            f"{str(ref_file.get('fingerprint'))[:12]}… generation "
+            f"{ref_file.get('generation')} "
+            f"streams={','.join(ref_file.get('streams') or [])} "
+            f"source={ref_file.get('source') or '-'}")
+    trace = report.get("trace")
+    if trace is not None:
+        lines.append("== trace ==")
+        gens = ", ".join(f"gen {g}: {n}"
+                         for g, n in trace["generations"].items()) or "-"
+        lines.append(
+            f"served {trace['served_records']} ({gens}); "
+            f"{trace['synthetic_excluded']} synthetic + "
+            f"{trace['fail_opens_excluded']} fail-open excluded")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------- gates
+
+
+def check_drift(report: dict, budgets: dict,
+                shadow_floor: float | None = None) -> list:
+    """The ``--check`` violations (each a string; non-empty = exit 2).
+
+    Gates, in severity order: a missing ``drift`` section (a gate that
+    cannot see drift must fail loudly, not pass vacuously); any
+    DRIFTING stream unless ``allow_drifting``; a stream without a
+    usable reference (``no_reference`` / ``generation_mismatch``) when
+    ``require_reference``; the server's loaded fingerprint disagreeing
+    with the ``--reference`` file; a mixed reference across workers;
+    and a shadow agreement rate under the floor once enough requests
+    were scored (``shadow_floor_min_scored`` — an idle shadow must not
+    fail on one early disagreement)."""
+    violations = []
+    drift = report.get("drift")
+    if drift is None:
+        violations.append(
+            "no drift section in the stats body — serve with --drift "
+            "(or scrape a pool whose workers do)")
+        return violations
+    if not budgets.get("allow_drifting", False):
+        for name in drift.get("drifting") or []:
+            s = (drift["streams"].get(name) or {})
+            psi = s.get("psi") or {}
+            violations.append(
+                f"stream `{name}` is DRIFTING (fast PSI "
+                f"{psi.get('fast')}, slow PSI {psi.get('slow')}) — "
+                "re-snapshot the reference if this regime change is "
+                "intended, retrain if not")
+    if budgets.get("require_reference", True):
+        for name, s in sorted(drift["streams"].items()):
+            if s.get("status") == "ok":
+                continue
+            if s.get("status") == "no_reference" \
+                    and not s.get("lifetime_count"):
+                # A stream the deployment never feeds (e.g. the graph
+                # family's feature columns) is not gradable — absence
+                # of data is not absence of a reference.
+                continue
+            violations.append(
+                f"stream `{name}` has status `{s.get('status')}` — "
+                "freeze a reference for the serving generation "
+                "(`drift snapshot`; mandatory re-snapshot after every "
+                "promote)")
+    ref_file = report.get("reference_file")
+    if ref_file is not None and drift.get("reference_fingerprint") \
+            and ref_file.get("fingerprint") \
+            != drift.get("reference_fingerprint"):
+        violations.append(
+            "reference mismatch: server loaded "
+            f"{str(drift['reference_fingerprint'])[:12]}… but the "
+            f"--reference file is {str(ref_file['fingerprint'])[:12]}… "
+            "— load the file (POST /drift/reference) or re-snapshot")
+    if drift.get("reference_mixed"):
+        violations.append(
+            "workers disagree on the loaded reference (mixed "
+            "fingerprints in the merged section) — re-fan the load "
+            "(POST /drift/reference reaches every worker)")
+    shadow = report.get("shadow")
+    floor = (shadow_floor if shadow_floor is not None
+             else budgets.get("shadow_agreement_floor"))
+    if shadow is not None and floor is not None:
+        min_scored = int(budgets.get("shadow_floor_min_scored", 20))
+        rate = shadow.get("agreement_rate")
+        if shadow.get("scored_total", 0) >= min_scored \
+                and rate is not None and rate < floor:
+            violations.append(
+                f"shadow agreement {rate:.4f} under the floor "
+                f"{floor:.4f} over {shadow['scored_total']} scored "
+                "requests — the candidate disagrees with the incumbent "
+                "too often to promote blind")
+    return violations

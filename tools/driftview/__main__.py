@@ -1,0 +1,102 @@
+"""driftview CLI (graftdrift part 2 — see the package docstring).
+
+Usage::
+
+    # full report against a live pool's control plane + its artifacts
+    python -m tools.driftview --stats http://127.0.0.1:8788/stats \
+        --reference /var/drift/reference.json --trace /var/trace
+
+    # the regression gate (tier-1 runs this against the checked-in
+    # fixture; exit 2 on a drifting stream / missing reference /
+    # shadow-agreement floor)
+    python -m tools.driftview --stats tests/fixtures/driftview/stats.json \
+        --check --budgets tools/driftview/budgets.json
+
+Prints the human tables to stdout plus ONE bench.py-style JSON line
+(the documented schema); all violations go to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.driftview import (
+    build_report,
+    check_drift,
+    format_report,
+    load_budgets,
+    load_reference,
+    load_stats,
+    summarize_trace,
+)
+
+
+def main(argv: list | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.driftview",
+        description="Join a /stats drift section, a frozen reference "
+                    "file and a decision-trace directory into one "
+                    "distribution-shift report, with retrain-trigger "
+                    "gates.")
+    p.add_argument("--stats", default=None, metavar="FILE|URL",
+                   help="/stats body: a JSON file or a live http:// URL "
+                        "(single-process server, pool control plane, or "
+                        "a graftfleet controller's merged /stats)")
+    p.add_argument("--reference", default=None, metavar="FILE",
+                   help="frozen reference (drift snapshot output); "
+                        "fingerprint-verified on load and cross-checked "
+                        "against what the server loaded")
+    p.add_argument("--trace", default=None, metavar="DIR",
+                   help="decision trace-log directory; summarized per "
+                        "generation with synthetic (probe/shadow) "
+                        "records counted apart")
+    p.add_argument("--budgets", default="tools/driftview/budgets.json",
+                   metavar="FILE",
+                   help="gate config for --check (default "
+                        "tools/driftview/budgets.json)")
+    p.add_argument("--check", action="store_true",
+                   help="gate mode: exit 2 on a drifting stream, a "
+                        "gradable stream without a reference, a "
+                        "server/file reference mismatch, or a shadow "
+                        "agreement rate under the floor")
+    p.add_argument("--shadow-floor", type=float, default=None,
+                   metavar="RATE",
+                   help="override the budgets' shadow_agreement_floor "
+                        "for this run")
+    p.add_argument("--json", action="store_true",
+                   help="suppress the human tables; print only the "
+                        "JSON line")
+    args = p.parse_args(argv)
+    if args.stats is None and args.reference is None \
+            and args.trace is None:
+        p.error("pass at least one of --stats / --reference / --trace")
+
+    stats = load_stats(args.stats) if args.stats else None
+    reference = load_reference(args.reference) if args.reference else None
+    trace_summary = summarize_trace(args.trace) if args.trace else None
+    report = build_report(stats=stats, reference=reference,
+                          trace_summary=trace_summary)
+
+    if not args.json:
+        formatted = format_report(report)
+        if formatted:
+            print(formatted)
+    line = {"schema_version": report["schema_version"],
+            "report": "driftview", **{k: v for k, v in report.items()
+                                      if k != "schema_version"}}
+    violations: list = []
+    if args.check:
+        budgets = load_budgets(args.budgets)
+        violations = check_drift(report, budgets,
+                                 shadow_floor=args.shadow_floor)
+        line["violations"] = violations
+    print(json.dumps(line))
+    for violation in violations:
+        print(f"driftview: {violation}", file=sys.stderr)
+    return 2 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

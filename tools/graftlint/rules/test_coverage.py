@@ -48,9 +48,12 @@ from tools.graftlint.rules import Rule, register
 # rule exists to keep referenced. graftfleet rides the existing
 # `scheduler` entry: scheduler/fleet.py's publics (cross-pool promote,
 # ledger resume, fleet merges) are the fleet-level zero-downtime
-# contract and must stay referenced the same way.
+# contract and must stay referenced the same way. `driftview` joined
+# with graftdrift: its publics are the retrain-trigger gate (drifting
+# verdicts, reference-fingerprint cross-checks, the shadow floor) — an
+# untested gate is an unverified claim about when the loop retrains.
 OP_DIRS = frozenset({"ops", "parallel", "scenarios", "studies",
-                     "scheduler", "loopback", "mixtures"})
+                     "scheduler", "loopback", "mixtures", "driftview"})
 
 
 @register
