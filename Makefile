@@ -13,7 +13,7 @@ TRACE ?= tests/fixtures/traceview/fixture.trace.json.gz
         study study-list overlap-bench serve-report slo-check span-ab \
         fastpath-ab front-ab loop-drill loop-soak transfer-grid \
         mixture-smoke fleet-drill fleet-soak drift-report drift-drill \
-        drift-soak
+        drift-soak daemon-drill daemon-soak
 
 # Exit codes (all lint targets): 0 clean, 1 findings (or stale
 # suppressions under --audit-suppressions), 2 usage/config error.
@@ -93,6 +93,23 @@ loop-drill:
 
 loop-soak:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_loopback.py -q
+
+# graftpilot (docs/serving.md#graftpilot): the unattended drift-
+# triggered retrain daemon drill, container-safe and in tier-1 — a
+# 2-worker drift-armed pool serves bench traffic while the price regime
+# flips mid-soak; the daemon detects the drift off /stats (driftview's
+# own grading), confirms it across consecutive polls, retrains through
+# graftloop, passes the LIVE shadow sign-test gate, and hot-promotes
+# generation 0→1 with zero failed requests — SIGKILLed once
+# mid-iteration and resuming its ledger byte-prefix-exact, while the
+# stationary control records only no_drift decisions and provably never
+# retrains. `daemon-soak` adds the slow kill-matrix/hysteresis passes.
+daemon-drill:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_graftpilot.py -q \
+		-m 'not slow' -k daemon_drill
+
+daemon-soak:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_graftpilot.py -q
 
 # graftfleet (docs/serving.md#graftfleet): the ROADMAP item-1 drill —
 # a 3-pool fleet under continuous multi-target bench traffic where a

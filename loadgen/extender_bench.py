@@ -1170,6 +1170,13 @@ def main(argv: list[str] | None = None) -> dict:
         "server_p50_ms": server_latency.get("p50_ms"),
         "server_p99_ms": server_latency.get("p99_ms"),
         "backend": server_stats.get("backend"),
+        # graftpilot: the policy generation the target served at line-
+        # emit time (pool body nests it under "pool"; the single-process
+        # server carries it at top level). A multi-hour soak under the
+        # retrain daemon joins its latency history against generation
+        # flips through this one field.
+        "daemon_generation": (server_stats.get("pool") or {}).get(
+            "generation", server_stats.get("generation", 0)),
     }
     if connects:
         # Connection setup, reported apart from request latency: under

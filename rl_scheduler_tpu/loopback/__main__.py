@@ -95,6 +95,13 @@ def main(argv: list | None = None) -> int:
                         "(recorded as a refusal; the candidate and "
                         "verdict stay in the loop dir)")
     p.add_argument("--rollout-timeout", type=float, default=120.0)
+    p.add_argument("--max-stage-retries", type=int, default=2,
+                   help="bounded in-process retries of a TRANSIENTLY "
+                        "failing stage (transport error, subprocess "
+                        "crash, pool 5xx) with RetryPolicy backoff; "
+                        "failed attempts land kind=attempt ledger "
+                        "records. Refusals never retry (default 2; 0 "
+                        "restores single-shot)")
     p.add_argument("--fresh", action="store_true",
                    help="discard an existing loop dir's ledger/artifacts "
                         "and start over (refused while another loop "
@@ -147,7 +154,8 @@ def main(argv: list | None = None) -> int:
                 shutil.rmtree(entry) if entry.is_dir() else entry.unlink()
         fault_plan = fault_plan_from_env(os.environ.get("GRAFTLOOP_FAULTS"))
         runner = LoopRunner(spec, loop_dir, fault_plan=fault_plan,
-                            rollout_timeout_s=args.rollout_timeout)
+                            rollout_timeout_s=args.rollout_timeout,
+                            max_stage_retries=args.max_stage_retries)
         summary = runner.run()
     finally:
         lock.unlink(missing_ok=True)

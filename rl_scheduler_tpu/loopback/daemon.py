@@ -1,0 +1,1143 @@
+"""graftpilot: the unattended drift-triggered retrain daemon.
+
+graftloop closed the decision loop as ONE command a human runs after
+deciding "the traffic has shifted, retrain now". graftpilot removes the
+human: a long-running controller that watches the serving pool's own
+observability plane and runs the loop only when the evidence says to —
+then holds the result to a HIGHER bar than a hand-run loop, because
+nobody is watching.
+
+**Trigger on evidence, not a timer.** Every ``poll_interval_s`` the
+daemon GETs the pool's ``/stats`` and grades the drift section with the
+SAME logic as ``driftview --check`` (``tools.driftview.grade_report`` —
+one grading implementation, three surfaces), plus the graftlens SLO
+burn verdict: the trigger is "any drifting stream OR any burning
+objective". A trigger only ARMS an iteration after it persists across
+``confirm_checks`` consecutive polls (a transient spike never
+retrains), after the trace corpus clears ``min_trace_records`` (a
+retrain from thin evidence is worse than none), and outside the
+anti-churn windows below. Every poll lands a ``decision`` record —
+``no_drift`` / ``confirming`` / ``armed`` / ``suppressed_cooldown`` /
+``suppressed_spacing`` / ``insufficient_trace`` / ``breaker_open`` /
+``poll_error`` — so a stationary soak can PROVE the daemon never
+retrained (the drill asserts only ``no_drift`` decisions).
+
+**The live shadow promote gate.** An armed iteration drives graftloop's
+orchestrator as a child stage (``LoopRunner.run_stages(until=
+"evaluate")``), then inserts a promotion gate the offline verdict
+cannot provide: the candidate is deployed via the pool's runtime
+``/shadow`` surface, every worker scores IDENTICAL live traffic with
+both checkpoints, and the summed win/loss counters feed graftstudy's
+two-sided sign test. Only ``wins > losses`` at ``shadow_alpha``
+proceeds to ``run_stages(until="promote")`` — the offline verdict says
+"better on the replayed past", the shadow gate says "better on the
+traffic of the last N seconds", and an unattended promote needs both.
+The gate disarms the shadow in a ``finally`` (the pool never keeps
+paying double-inference for a dead gate) and a rejection is a RECORDED
+outcome (``shadow_rejected``) that never retries.
+
+**Survive everything.** ``daemon_ledger.jsonl`` carries the graftstudy
+ledger discipline (fingerprint-bound header, whole-file atomic
+rewrites: a SIGKILL at any instant leaves a byte-prefix-exact ledger).
+A restart reconstructs the confirm streak, hysteresis windows, breaker
+seed, and the in-flight iteration from the ledger alone, then resumes
+the iteration's OWN loop ledger mid-stage. Transient stage failures
+retry in-process (``utils/retry.RetryPolicy`` backoff); consecutive
+failed iterations trip a ``CircuitBreaker`` into observe-only mode
+(polls continue, decisions record ``breaker_open``, nothing retrains
+until the reset timeout). Post-promote ``cooldown_s`` plus
+``min_spacing_s`` between iterations is the anti-churn hysteresis — a
+noisy boundary regime cannot flap generations. Chaos seams
+``daemon.poll`` / ``daemon.trigger`` / ``daemon.shadow_gate``
+(``utils/faults``, armed via ``GRAFTPILOT_FAULTS``) make each failure
+window drillable on purpose.
+
+Surfaces: ``python -m rl_scheduler_tpu.loopback.daemon run|status|stop``
+and a tiny status plane (``GET /status`` / ``/metrics`` / ``/healthz``)
+with the breaker state, decision/iteration outcome counters, streak and
+hysteresis gauges. docs/serving.md §graftpilot; drill:
+``make daemon-drill``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+from rl_scheduler_tpu.loopback.orchestrator import (
+    TRANSIENT_STAGE_ERRORS,
+    LoopSpec,
+    fault_plan_from_env,
+)
+from rl_scheduler_tpu.utils.retry import CircuitBreaker, RetryPolicy
+
+logger = logging.getLogger(__name__)
+
+DAEMON_SCHEMA_VERSION = 1
+DAEMON_LEDGER_NAME = "daemon_ledger.jsonl"
+DAEMON_LOCK_NAME = "daemon.lock"
+DAEMON_STATE_NAME = "daemon_state.json"
+ITER_DIR_FMT = "iter-{:04d}"
+
+# Every poll records exactly one decision (the stationary-control proof
+# depends on the exhaustiveness of this set).
+DECISION_OUTCOMES = ("no_drift", "confirming", "armed",
+                     "suppressed_cooldown", "suppressed_spacing",
+                     "insufficient_trace", "breaker_open", "poll_error")
+# Daemon-ledger iteration stages (the loop's five stages live in the
+# iteration's OWN loop_ledger.jsonl; these are the daemon's coarser
+# checkpoints around them). `cooldown` is ALWAYS the terminal record and
+# carries the iteration outcome + the hysteresis window timestamps.
+ITERATION_STAGES = ("armed", "retrain", "shadow_gate", "promote",
+                    "cooldown")
+ITERATION_OUTCOMES = ("promoted", "refused", "shadow_rejected",
+                      "rolled_back")
+
+
+class DaemonDrained(Exception):
+    """Raised internally when SIGTERM lands mid-iteration: unwind to the
+    main loop without recording a stage (the ledger stays resumable),
+    releasing the shadow gate on the way out."""
+
+
+@dataclasses.dataclass(frozen=True)
+class DaemonSpec:
+    """The daemon's frozen protocol. Its fingerprint binds the daemon
+    ledger exactly as ``LoopSpec`` binds a loop ledger: changed trigger
+    thresholds or loop knobs refuse to resume into the same history
+    (``--fresh`` or a new out dir)."""
+
+    trace_dir: str                    # the pool's trace directory
+    incumbent: str                    # run dir serving at daemon start
+    pool_url: str                     # pool control plane base URL
+    # ------------------------------------------------------- trigger
+    poll_interval_s: float = 30.0
+    poll_retries: int = 2             # transient /stats retries per poll
+    confirm_checks: int = 2           # consecutive drifting polls to arm
+    min_trace_records: int = 50       # trace-volume floor before arming
+    # ---------------------------------------------------- hysteresis
+    cooldown_s: float = 300.0         # post-PROMOTE quiet period
+    min_spacing_s: float = 60.0       # min gap between ANY iterations
+    # -------------------------------------------------- shadow gate
+    shadow_min_scored: int = 50       # paired verdicts before grading
+    shadow_alpha: float = 0.05        # two-sided sign-test bar
+    shadow_timeout_s: float = 120.0   # collection deadline (transient)
+    # ------------------------------------------------------ breaker
+    breaker_threshold: int = 3        # consecutive failures to open
+    breaker_reset_s: float = 600.0
+    # ------------------------------------------------------- bounds
+    max_iterations: int = 0           # 0 = unbounded
+    max_polls: int = 0                # 0 = unbounded (soak/test bound)
+    # ---------------------------------------------- loop iteration
+    steps: int = 256
+    mix_frac: float = 0.25
+    compile_seed: int = 0
+    iterations: int = 8
+    seed: int = 0
+    eval_every: int = 2
+    eval_episodes: int = 32
+    verdict_seeds: tuple = (0, 1, 2, 3, 4)
+    verdict_episodes: int = 64
+    required_verdict: str = "confirmed_above"
+    forgetting_tolerance_pct: float = 10.0
+    num_nodes: int | None = None
+    max_stage_retries: int = 2
+    rollout_timeout_s: float = 120.0
+
+    def __post_init__(self):
+        if not self.pool_url:
+            raise ValueError("pool_url: the daemon watches (and promotes "
+                             "through) a pool control plane")
+        if self.poll_interval_s <= 0:
+            raise ValueError(f"poll_interval_s={self.poll_interval_s}: > 0")
+        if self.confirm_checks < 1:
+            raise ValueError(f"confirm_checks={self.confirm_checks}: >= 1")
+        if self.shadow_min_scored < 1:
+            raise ValueError(
+                f"shadow_min_scored={self.shadow_min_scored}: >= 1")
+        if not 0.0 < self.shadow_alpha <= 1.0:
+            raise ValueError(f"shadow_alpha={self.shadow_alpha}: (0, 1]")
+        if self.breaker_threshold < 1:
+            raise ValueError(
+                f"breaker_threshold={self.breaker_threshold}: >= 1")
+        if self.cooldown_s < 0 or self.min_spacing_s < 0:
+            raise ValueError("cooldown_s/min_spacing_s: >= 0")
+        self.loop_spec(self.incumbent)  # validates the loop knobs
+
+    def loop_spec(self, incumbent: str) -> LoopSpec:
+        """The loop iteration this daemon arms. ``incumbent`` moves as
+        promotes land (the ledger's last promoted candidate), so each
+        iteration warm-starts from — and verdicts against — what the
+        pool actually serves."""
+        return LoopSpec(
+            trace_dir=self.trace_dir,
+            incumbent=incumbent,
+            pool_url=self.pool_url,
+            steps=self.steps,
+            mix_frac=self.mix_frac,
+            compile_seed=self.compile_seed,
+            iterations=self.iterations,
+            seed=self.seed,
+            eval_every=self.eval_every,
+            eval_episodes=self.eval_episodes,
+            verdict_seeds=tuple(self.verdict_seeds),
+            verdict_episodes=self.verdict_episodes,
+            required_verdict=self.required_verdict,
+            forgetting_tolerance_pct=self.forgetting_tolerance_pct,
+            num_nodes=self.num_nodes,
+            dry_run=False,
+        )
+
+    def to_json(self) -> dict:
+        return json.loads(json.dumps(dataclasses.asdict(self)))
+
+    def fingerprint(self) -> str:
+        blob = json.dumps(self.to_json(), sort_keys=True,
+                          separators=(",", ":"))
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def daemon_spec_from_json(d: dict) -> DaemonSpec:
+    kw = dict(d)
+    kw["verdict_seeds"] = tuple(kw["verdict_seeds"])
+    return DaemonSpec(**kw)
+
+
+class DaemonLedgerMismatch(RuntimeError):
+    """The daemon dir's ledger was written under a different spec."""
+
+
+class DaemonLedger:
+    """The daemon's cross-iteration journal — the graftstudy/graftloop
+    ledger discipline (whole-file tmp-then-rename appends, sorted-key
+    records, header bound to the spec fingerprint) over two record
+    kinds: per-poll ``decision`` records and per-iteration ``iteration``
+    stage records. A SIGKILL at any instant leaves either the old or the
+    new complete ledger — prior lines survive bitwise, which the kill
+    matrix asserts with byte-prefix checks."""
+
+    def __init__(self, daemon_dir: str | Path, spec: DaemonSpec):
+        self.path = Path(daemon_dir) / DAEMON_LEDGER_NAME
+        self.spec = spec
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        if self.path.exists() and self.path.stat().st_size:
+            header = json.loads(self.path.read_text().splitlines()[0])
+            if header.get("spec_sha") != spec.fingerprint():
+                raise DaemonLedgerMismatch(
+                    f"{self.path} was written for spec "
+                    f"{header.get('spec_sha')}; this run's spec is "
+                    f"{spec.fingerprint()} — a changed daemon protocol "
+                    "cannot resume into the same ledger (new out dir, "
+                    "or --fresh to discard)")
+        else:
+            self._rewrite([self._dumps({
+                "kind": "header",
+                "schema_version": DAEMON_SCHEMA_VERSION,
+                "spec_sha": spec.fingerprint(),
+                "spec": spec.to_json(),
+            })])
+
+    @staticmethod
+    def _dumps(record: dict) -> str:
+        return json.dumps(record, sort_keys=True, separators=(", ", ": "))
+
+    def _rewrite(self, lines: list) -> None:
+        tmp = self.path.with_suffix(f".tmp.{os.getpid()}")
+        data = "".join(line + "\n" for line in lines)
+        with open(tmp, "w") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def _append(self, record: dict) -> None:
+        lines = self.path.read_text().splitlines() if self.path.exists() \
+            else []
+        self._rewrite(lines + [self._dumps(record)])
+
+    def append_decision(self, outcome: str, detail: dict) -> None:
+        if outcome not in DECISION_OUTCOMES:
+            raise ValueError(f"outcome={outcome!r}: one of "
+                             f"{DECISION_OUTCOMES}")
+        self._append({"kind": "decision", "seq": self.next_seq(),
+                      "ts": round(time.time(), 3), "outcome": outcome,
+                      "detail": detail})
+
+    def append_iteration(self, iteration: int, stage: str, status: str,
+                         out: dict) -> None:
+        if stage not in ITERATION_STAGES:
+            raise ValueError(f"stage={stage!r}: one of "
+                             f"{ITERATION_STAGES}")
+        self._append({"kind": "iteration", "iter": iteration,
+                      "stage": stage, "status": status,
+                      "ts": round(time.time(), 3), "out": out})
+
+    def records(self) -> list:
+        return [json.loads(line)
+                for line in self.path.read_text().splitlines()[1:]]
+
+    def decisions(self) -> list:
+        return [r for r in self.records() if r["kind"] == "decision"]
+
+    def next_seq(self) -> int:
+        return len(self.decisions()) + 1
+
+    def iterations(self) -> dict:
+        """``{iter: {stage: record}}`` (newest wins — at most one per
+        stage per iteration in a healthy ledger)."""
+        out: dict = {}
+        for r in self.records():
+            if r["kind"] == "iteration":
+                out.setdefault(r["iter"], {})[r["stage"]] = r
+        return out
+
+    def confirm_streak(self) -> int:
+        """Trailing consecutive ``confirming`` decisions — the streak a
+        restart resumes instead of re-counting from zero (the trigger's
+        persistence requirement survives the process)."""
+        streak = 0
+        for r in reversed(self.decisions()):
+            if r["outcome"] != "confirming":
+                break
+            streak += 1
+        return streak
+
+    def inflight_iteration(self) -> int | None:
+        """The armed iteration missing its terminal ``cooldown`` record,
+        if any — what a restart must resume before polling again."""
+        iters = self.iterations()
+        open_ = [i for i, stages in iters.items()
+                 if "cooldown" not in stages]
+        return max(open_) if open_ else None
+
+    def current_incumbent(self) -> str:
+        """The run dir the pool serves NOW: the last promoted
+        candidate, else the spec's initial incumbent."""
+        incumbent = self.spec.incumbent
+        for i in sorted(self.iterations()):
+            stages = self.iterations()[i]
+            cool = stages.get("cooldown")
+            if cool and cool["out"].get("outcome") == "promoted":
+                incumbent = stages["retrain"]["out"]["candidate"]
+        return incumbent
+
+    def hysteresis(self) -> tuple:
+        """``(cooldown_until, next_allowed_at)`` from the newest
+        terminal record (absolute epoch seconds; ``(0, 0)`` before the
+        first iteration completes)."""
+        newest = (0.0, 0.0)
+        for stages in self.iterations().values():
+            cool = stages.get("cooldown")
+            if cool:
+                pair = (float(cool["out"].get("cooldown_until", 0.0)),
+                        float(cool["out"].get("next_allowed_at", 0.0)))
+                newest = max(newest, pair)
+        return newest
+
+    def trailing_failures(self) -> int:
+        """Consecutive ``rolled_back`` outcomes ending the iteration
+        history — the breaker's resume seed (a restart must not reset an
+        almost-open breaker to closed)."""
+        streak = 0
+        for i in sorted(self.iterations(), reverse=True):
+            cool = self.iterations()[i].get("cooldown")
+            if cool is None:
+                continue  # the in-flight iteration has no outcome yet
+            if cool["out"].get("outcome") != "rolled_back":
+                break
+            streak += 1
+        return streak
+
+
+class Daemon:
+    """The graftpilot controller: poll → confirm → iterate → gate →
+    promote → cool down, forever, resumable from the ledger alone."""
+
+    def __init__(self, spec: DaemonSpec, daemon_dir: str | Path,
+                 fault_plan=None):
+        self.spec = spec
+        self.daemon_dir = Path(daemon_dir)
+        self.fault_plan = fault_plan
+        self.daemon_dir.mkdir(parents=True, exist_ok=True)
+        self.ledger = DaemonLedger(self.daemon_dir, spec)
+        self.breaker = CircuitBreaker(
+            "graftpilot.iteration",
+            failure_threshold=spec.breaker_threshold,
+            reset_timeout_s=spec.breaker_reset_s)
+        for _ in range(self.ledger.trailing_failures()):
+            self.breaker.record_failure()
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._state = "starting"
+        self.polls_total = 0
+        # Outcome counters seed from the ledger so /status and /metrics
+        # survive restarts exactly like the ledger does.
+        self.decision_counts = {o: 0 for o in DECISION_OUTCOMES}
+        self.iteration_counts = {o: 0 for o in ITERATION_OUTCOMES}
+        for r in self.ledger.decisions():
+            self.decision_counts[r["outcome"]] += 1
+        for stages in self.ledger.iterations().values():
+            cool = stages.get("cooldown")
+            if cool:
+                self.iteration_counts[cool["out"]["outcome"]] += 1
+
+    # ------------------------------------------------------- plumbing
+
+    def request_stop(self) -> None:
+        """Graceful drain: finish nothing new, unwind the in-flight
+        stage at the next boundary (the SIGTERM handler and ``stop``
+        subcommand land here)."""
+        self._stop.set()
+
+    def _set_state(self, state: str) -> None:
+        with self._lock:
+            self._state = state
+
+    def _record_decision(self, outcome: str, detail: dict) -> None:
+        self.ledger.append_decision(outcome, detail)
+        with self._lock:
+            self.decision_counts[outcome] += 1
+        logger.info("graftpilot: decision %s %s", outcome, detail)
+
+    def _http(self, path: str, payload: dict | None = None,
+              timeout_s: float = 10.0) -> dict:
+        url = self.spec.pool_url.rstrip("/") + path
+        if payload is None:
+            req = urllib.request.Request(url)
+        else:
+            req = urllib.request.Request(
+                url, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                return json.load(resp)
+        except urllib.error.HTTPError as e:
+            # Control-plane refusals (partial fan-out 409, 5xx) are
+            # transient to the daemon: RuntimeError rides the
+            # TRANSIENT_STAGE_ERRORS family, the iteration resumes.
+            raise RuntimeError(
+                f"pool answered {e.code} on {path}") from e
+
+    # ----------------------------------------------------------- poll
+
+    def _get_stats(self) -> dict:
+        if self.fault_plan is not None:
+            self.fault_plan.check("daemon.poll", OSError)
+        return self._http("/stats")
+
+    def _poll_stats(self) -> dict:
+        """One poll under the transient retry budget (the orchestrator's
+        manual-loop-over-``RetryPolicy.delays()`` idiom, so exhaustion
+        re-raises the original error type for the ``poll_error``
+        record)."""
+        if self.spec.poll_retries == 0:
+            return self._get_stats()
+        delays = RetryPolicy(max_attempts=self.spec.poll_retries + 1,
+                             base_delay_s=0.05, max_delay_s=1.0,
+                             seed=self.spec.seed).delays()
+        for attempt in range(1, self.spec.poll_retries + 2):
+            try:
+                return self._get_stats()
+            except TRANSIENT_STAGE_ERRORS:
+                if attempt > self.spec.poll_retries:
+                    raise
+                self._stop.wait(delays[attempt - 1])
+        raise AssertionError("unreachable: the final attempt re-raises")
+
+    def evaluate_trigger(self, stats: dict) -> dict:
+        """The evidence one poll produces: driftview-graded streams
+        (``grade_report`` — the SAME grading as ``driftview --check``),
+        burning SLO objectives, and the trace-volume floor input."""
+        from tools.driftview import build_report, grade_report
+
+        grade = grade_report(build_report(stats=stats), budgets={})
+        drifting = sorted(s for s, g in grade["streams"].items()
+                          if g == "drifting")
+        slo = stats.get("slo") or {}
+        burning = sorted(
+            name for name, obj in (slo.get("objectives") or {}).items()
+            if obj.get("burning"))
+        pool = stats.get("pool") or {}
+        return {
+            "drifting": drifting,
+            "burning": burning,
+            "trace_records": (stats.get("trace") or {})
+            .get("records_total", 0),
+            "generation": pool.get("generation",
+                                   stats.get("generation", 0)),
+        }
+
+    def _tick_poll(self) -> bool:
+        """One poll → exactly one decision record. Returns True when an
+        iteration was armed (the caller runs it without waiting)."""
+        self.polls_total += 1
+        try:
+            stats = self._poll_stats()
+        except TRANSIENT_STAGE_ERRORS as exc:
+            self._record_decision("poll_error", {"error": repr(exc)})
+            return False
+        evidence = self.evaluate_trigger(stats)
+        now = time.time()
+        cooldown_until, next_allowed = self.ledger.hysteresis()
+        if not (evidence["drifting"] or evidence["burning"]):
+            self._record_decision("no_drift", evidence)
+            return False
+        if evidence["trace_records"] < self.spec.min_trace_records:
+            self._record_decision("insufficient_trace", {
+                **evidence, "floor": self.spec.min_trace_records})
+            return False
+        if now < cooldown_until:
+            self._record_decision("suppressed_cooldown", {
+                **evidence, "cooldown_until": cooldown_until})
+            return False
+        if now < next_allowed:
+            self._record_decision("suppressed_spacing", {
+                **evidence, "next_allowed_at": next_allowed})
+            return False
+        if not self.breaker.allow():
+            # Observe-only mode: the trigger is real, the daemon refuses
+            # to act on it until the breaker's reset timeout.
+            self._record_decision("breaker_open", {
+                **evidence, "breaker": self.breaker.snapshot()})
+            return False
+        streak = self.ledger.confirm_streak()
+        if streak + 1 < self.spec.confirm_checks:
+            self._record_decision("confirming", {
+                **evidence, "streak": streak + 1,
+                "needed": self.spec.confirm_checks})
+            return False
+        if self.fault_plan is not None:
+            # The crash window between the trigger verdict and arming:
+            # nothing recorded yet, so a resume re-polls live evidence
+            # and can never double-arm a phantom iteration.
+            self.fault_plan.check("daemon.trigger", OSError)
+        iteration = max(self.ledger.iterations(), default=-1) + 1
+        loop_dir = self.daemon_dir / ITER_DIR_FMT.format(iteration)
+        incumbent = self.ledger.current_incumbent()
+        # Iteration record FIRST, then the decision: a kill between the
+        # two leaves an in-flight iteration a resume finds (the reverse
+        # order would leave an `armed` decision pointing at nothing).
+        self.ledger.append_iteration(iteration, "armed", "ok", {
+            "loop_dir": str(loop_dir), "incumbent": incumbent,
+            "evidence": evidence})
+        self._record_decision("armed", {"iter": iteration, **evidence})
+        return True
+
+    # ------------------------------------------------------ iteration
+
+    def _shadow_gate(self, candidate: str) -> dict:
+        """Deploy the candidate on the pool's runtime ``/shadow``
+        surface, collect paired live verdicts on identical traffic, and
+        grade incumbent-vs-candidate with the two-sided sign test (ties
+        dropped). ALWAYS disarms on the way out — timeout, drain, and
+        chaos paths included."""
+        from rl_scheduler_tpu.studies.analysis import sign_test_pvalue
+
+        if self.fault_plan is not None:
+            self.fault_plan.check("daemon.shadow_gate", OSError)
+        armed = self._http("/shadow", {"path": candidate}, timeout_s=60.0)
+        if armed.get("errors"):
+            raise RuntimeError(
+                f"shadow arm was partial: {armed['errors']}")
+        shadow: dict = {}
+        try:
+            deadline = time.monotonic() + self.spec.shadow_timeout_s
+            while True:
+                if self._stop.is_set():
+                    raise DaemonDrained("SIGTERM mid shadow gate")
+                stats = self._http("/stats")
+                shadow = stats.get("shadow") or {}
+                if shadow.get("scored_total", 0) \
+                        >= self.spec.shadow_min_scored:
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"shadow gate scored "
+                        f"{shadow.get('scored_total', 0)} < "
+                        f"{self.spec.shadow_min_scored} paired verdicts "
+                        f"in {self.spec.shadow_timeout_s:.0f}s — is the "
+                        "pool receiving traffic?")
+                self._stop.wait(0.25)
+        finally:
+            try:
+                self._http("/shadow", {"path": None}, timeout_s=30.0)
+            except Exception as exc:  # noqa: BLE001 — disarm is
+                # best-effort on the unwind path; the next arm swaps in
+                # fresh scorers anyway, and the original error matters
+                # more than a failed cleanup.
+                logger.warning("graftpilot: shadow disarm failed: %s",
+                               exc)
+        wins = int(shadow.get("wins_total", 0))
+        losses = int(shadow.get("losses_total", 0))
+        pvalue = sign_test_pvalue(wins, losses)
+        confirmed = wins > losses and pvalue <= self.spec.shadow_alpha
+        return {
+            "confirmed": confirmed,
+            "wins": wins,
+            "losses": losses,
+            "ties": int(shadow.get("ties_total", 0)),
+            "scored": int(shadow.get("scored_total", 0)),
+            "pvalue": round(pvalue, 6),
+            "alpha": self.spec.shadow_alpha,
+            "verdict": "confirmed_above" if confirmed
+            else "not_confirmed",
+        }
+
+    def _adopt_landed_promote(self, armed_generation: int) -> dict | None:
+        """Recover from the promote crash window: a kill can land AFTER
+        graftloop's ``POST /promote`` dispatched but BEFORE its ledger
+        record — the loop's at-least-once resume would re-roll the same
+        candidate and bump the generation twice. The daemon is the
+        pool's single promoting writer, so a pool already past the
+        generation this iteration armed against IS our promote landing:
+        adopt it (waiting out an in-flight rollout first) instead of
+        re-posting. Returns the promote `out` to record, or ``None``
+        when the pool still serves the armed generation (promote never
+        dispatched — run the stage normally)."""
+        deadline = time.monotonic() + self.spec.rollout_timeout_s
+        while True:
+            rollout = self._http("/rollout")
+            if not rollout.get("active"):
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    "a rollout was already in flight on resume and "
+                    "stayed active past "
+                    f"{self.spec.rollout_timeout_s:.0f}s")
+            self._stop.wait(0.2)
+        generation = int(rollout.get("generation", 0))
+        if generation <= armed_generation:
+            return None
+        logger.info("graftpilot: pool already serves generation %d "
+                    "(armed against %d) — adopting the landed promote "
+                    "instead of re-rolling", generation,
+                    armed_generation)
+        return {"generation": generation, "adopted": True,
+                "rollout": rollout}
+
+    def _finish_iteration(self, iteration: int, outcome: str) -> None:
+        now = time.time()
+        cooldown_until = now + self.spec.cooldown_s \
+            if outcome == "promoted" else now
+        self.ledger.append_iteration(iteration, "cooldown", "ok", {
+            "outcome": outcome,
+            "cooldown_until": round(cooldown_until, 3),
+            "next_allowed_at": round(now + self.spec.min_spacing_s, 3),
+        })
+        with self._lock:
+            self.iteration_counts[outcome] += 1
+        if outcome == "promoted":
+            self.breaker.record_success()
+        elif outcome == "rolled_back":
+            # The pool's own gates refused a candidate BOTH offline and
+            # live evidence endorsed: that is the daemon malfunction the
+            # breaker counts. Refusals and shadow rejections are the
+            # gates WORKING — breaker-neutral.
+            self.breaker.record_failure()
+        logger.info("graftpilot: iteration %d finished: %s",
+                    iteration, outcome)
+
+    def _run_iteration(self, iteration: int) -> None:
+        """Drive (or resume) one armed iteration through retrain →
+        shadow_gate → promote → cooldown. Each daemon stage is recorded
+        after it completes; the loop stages inside `retrain`/`promote`
+        resume from the iteration's own loop ledger, so a SIGKILL
+        anywhere re-enters exactly the interrupted work."""
+        from rl_scheduler_tpu.loopback.orchestrator import LoopRunner
+
+        stages = self.ledger.iterations()[iteration]
+        armed = stages["armed"]["out"]
+        runner = LoopRunner(
+            self.spec.loop_spec(armed["incumbent"]),
+            armed["loop_dir"], fault_plan=self.fault_plan,
+            rollout_timeout_s=self.spec.rollout_timeout_s,
+            max_stage_retries=self.spec.max_stage_retries)
+        if "retrain" not in stages:
+            self._set_state("retraining")
+            done = runner.run_stages(until="evaluate")
+            verdict = done["evaluate"]["out"]
+            status = "ok" if verdict.get("promote") else "refused"
+            self.ledger.append_iteration(iteration, "retrain", status, {
+                "candidate": done["retrain"]["out"]["candidate"],
+                "verdict": verdict.get("verdict"),
+            })
+            stages = self.ledger.iterations()[iteration]
+        if self._stop.is_set():
+            raise DaemonDrained("SIGTERM between stages")
+        retrain = stages["retrain"]
+        if retrain["status"] != "ok":
+            # The offline verdict refused the candidate: a recorded
+            # outcome, never retried (a fresh trigger arms a fresh
+            # iteration over fresh traffic).
+            self._finish_iteration(iteration, "refused")
+            return
+        candidate = retrain["out"]["candidate"]
+        if "shadow_gate" not in stages:
+            self._set_state("shadow_gating")
+            gate = self._shadow_gate(candidate)
+            self.ledger.append_iteration(
+                iteration, "shadow_gate",
+                "ok" if gate["confirmed"] else "shadow_rejected", gate)
+            stages = self.ledger.iterations()[iteration]
+        if stages["shadow_gate"]["status"] != "ok":
+            self._finish_iteration(iteration, "shadow_rejected")
+            return
+        if self._stop.is_set():
+            raise DaemonDrained("SIGTERM between stages")
+        if "promote" not in stages:
+            self._set_state("promoting")
+            adopted = self._adopt_landed_promote(
+                int(armed["evidence"].get("generation", 0)))
+            if adopted is not None:
+                self.ledger.append_iteration(
+                    iteration, "promote", "ok",
+                    {**adopted, "candidate": candidate})
+            else:
+                done = runner.run_stages(until="promote")
+                promote = done["promote"]
+                self.ledger.append_iteration(
+                    iteration, "promote", promote["status"],
+                    {**promote["out"], "candidate": candidate})
+            stages = self.ledger.iterations()[iteration]
+        outcome = {"ok": "promoted", "refused": "refused",
+                   "rolled_back": "rolled_back"}[
+                       stages["promote"]["status"]]
+        self._finish_iteration(iteration, outcome)
+
+    # ------------------------------------------------------ main loop
+
+    def completed_iterations(self) -> int:
+        return sum(1 for s in self.ledger.iterations().values()
+                   if "cooldown" in s)
+
+    def run_forever(self) -> dict:
+        """The daemon main loop, until drained or a ``max_*`` bound.
+        Returns the final status body (the CLI's summary line)."""
+        logger.info("graftpilot: watching %s (spec %s)",
+                    self.spec.pool_url, self.spec.fingerprint())
+        while not self._stop.is_set():
+            if self.spec.max_iterations and self.completed_iterations() \
+                    >= self.spec.max_iterations:
+                break
+            inflight = self.ledger.inflight_iteration()
+            if inflight is not None:
+                if not self.breaker.allow():
+                    # Observe-only with work parked in flight: each
+                    # refused resume counts as (and is bounded like) a
+                    # poll, so a soak bound still terminates the loop.
+                    if self.spec.max_polls and self.polls_total \
+                            >= self.spec.max_polls:
+                        break
+                    self.polls_total += 1
+                    self._set_state("observe_only")
+                    self._record_decision("breaker_open", {
+                        "iter": inflight,
+                        "breaker": self.breaker.snapshot()})
+                    self._stop.wait(self.spec.poll_interval_s)
+                    continue
+                try:
+                    self._run_iteration(inflight)
+                except DaemonDrained:
+                    break
+                except TRANSIENT_STAGE_ERRORS as exc:
+                    # In-process retries exhausted: the iteration stays
+                    # in-flight (its ledgers resume), the breaker counts
+                    # the failure, the loop backs off one poll interval.
+                    self.breaker.record_failure()
+                    logger.warning(
+                        "graftpilot: iteration %d failed transiently "
+                        "(%s); will resume", inflight, exc)
+                    self._stop.wait(self.spec.poll_interval_s)
+                continue
+            if self.spec.max_polls and self.polls_total \
+                    >= self.spec.max_polls:
+                break
+            self._set_state("polling")
+            armed = False
+            try:
+                armed = self._tick_poll()
+            except TRANSIENT_STAGE_ERRORS as exc:
+                # daemon.trigger's crash window: seen but unrecorded —
+                # the next poll re-derives the verdict from live
+                # evidence.
+                logger.warning("graftpilot: poll tick failed (%s); "
+                               "re-polling", exc)
+            if not armed:
+                self._stop.wait(self.spec.poll_interval_s)
+        self._set_state("stopped")
+        logger.info("graftpilot: drained")
+        return self.status_body()
+
+    # ------------------------------------------------------- surfaces
+
+    def status_body(self) -> dict:
+        with self._lock:
+            state = self._state
+            decisions = dict(self.decision_counts)
+            iterations = dict(self.iteration_counts)
+        cooldown_until, next_allowed = self.ledger.hysteresis()
+        return {
+            "schema_version": DAEMON_SCHEMA_VERSION,
+            "daemon": "graftpilot",
+            "state": state,
+            "spec_sha": self.spec.fingerprint(),
+            "pool_url": self.spec.pool_url,
+            "incumbent": self.ledger.current_incumbent(),
+            "polls_total": self.polls_total,
+            "decisions": decisions,
+            "iterations": iterations,
+            "iterations_completed": self.completed_iterations(),
+            "inflight_iteration": self.ledger.inflight_iteration(),
+            "confirm_streak": self.ledger.confirm_streak(),
+            "cooldown_until": cooldown_until,
+            "next_allowed_at": next_allowed,
+            "breaker": self.breaker.snapshot(),
+        }
+
+    def metrics_body(self) -> str:
+        """Prometheus exposition for the daemon's own plane (the pool
+        keeps its own ``/metrics``; one scrape config reads both)."""
+        body = self.status_body()
+        breaker = body["breaker"]
+        now = time.time()
+        lines = [
+            "# HELP graftpilot_breaker_state Iteration breaker state "
+            "(0=closed, 1=half_open, 2=open; open = observe-only).",
+            "# TYPE graftpilot_breaker_state gauge",
+            f"graftpilot_breaker_state "
+            f"{CircuitBreaker.STATE_CODES[breaker['state']]}",
+            "# HELP graftpilot_breaker_consecutive_failures Consecutive "
+            "failed iterations counted toward the open threshold.",
+            "# TYPE graftpilot_breaker_consecutive_failures gauge",
+            f"graftpilot_breaker_consecutive_failures "
+            f"{breaker['consecutive_failures']}",
+            "# HELP graftpilot_breaker_opens_total Times the iteration "
+            "breaker opened (daemon lifetime).",
+            "# TYPE graftpilot_breaker_opens_total counter",
+            f"graftpilot_breaker_opens_total {breaker['opens_total']}",
+            "# HELP graftpilot_decisions_total Poll decisions by "
+            "outcome (one per poll; ledger-backed, survives restarts).",
+            "# TYPE graftpilot_decisions_total counter",
+        ]
+        lines += [
+            f'graftpilot_decisions_total{{outcome="{o}"}} {n}'
+            for o, n in sorted(body["decisions"].items())
+        ]
+        lines += [
+            "# HELP graftpilot_iterations_total Finished retrain "
+            "iterations by outcome.",
+            "# TYPE graftpilot_iterations_total counter",
+        ]
+        lines += [
+            f'graftpilot_iterations_total{{outcome="{o}"}} {n}'
+            for o, n in sorted(body["iterations"].items())
+        ]
+        lines += [
+            "# HELP graftpilot_confirm_streak Consecutive drifting "
+            "polls toward the confirm_checks arming bar.",
+            "# TYPE graftpilot_confirm_streak gauge",
+            f"graftpilot_confirm_streak {body['confirm_streak']}",
+            "# HELP graftpilot_cooldown_active Whether the post-promote "
+            "cool-down window is suppressing triggers.",
+            "# TYPE graftpilot_cooldown_active gauge",
+            f"graftpilot_cooldown_active "
+            f"{1 if now < body['cooldown_until'] else 0}",
+            "# HELP graftpilot_polls_total /stats polls this process "
+            "has issued.",
+            "# TYPE graftpilot_polls_total counter",
+            f"graftpilot_polls_total {body['polls_total']}",
+        ]
+        return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------------ status plane
+
+
+class _DaemonHandler(BaseHTTPRequestHandler):
+    daemon: Daemon = None  # set by serve_status
+
+    def log_message(self, *args):  # noqa: A002 — silence stdlib logging
+        pass
+
+    def _send(self, code: int, body, content_type="application/json"):
+        data = body.encode() if isinstance(body, str) \
+            else json.dumps(body, sort_keys=True).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        if self.path == "/status":
+            self._send(200, self.daemon.status_body())
+        elif self.path == "/metrics":
+            self._send(200, self.daemon.metrics_body(),
+                       content_type="text/plain; version=0.0.4")
+        elif self.path == "/healthz":
+            self._send(200, {"status": "ok", "pid": os.getpid()})
+        else:
+            self._send(404, {"error": f"unknown path {self.path}"})
+
+
+def serve_status(daemon: Daemon, host: str = "127.0.0.1",
+                 port: int = 0) -> ThreadingHTTPServer:
+    """Start the daemon's status plane on a background thread; returns
+    the bound server (``server_address[1]`` is the ephemeral port)."""
+    handler = type("_BoundHandler", (_DaemonHandler,),
+                   {"daemon": daemon})
+    server = ThreadingHTTPServer((host, port), handler)
+    thread = threading.Thread(target=server.serve_forever,
+                              name="graftpilot-status", daemon=True)
+    thread.start()
+    return server
+
+
+# -------------------------------------------------------------------- CLI
+
+
+def _read_state(daemon_dir: Path) -> dict:
+    state_path = daemon_dir / DAEMON_STATE_NAME
+    if not state_path.exists():
+        raise SystemExit(
+            f"no {DAEMON_STATE_NAME} under {daemon_dir} — is a daemon "
+            "running over this dir?")
+    return json.loads(state_path.read_text())
+
+
+def _cmd_run(args) -> int:
+    from rl_scheduler_tpu.studies.spec import parse_seeds
+    from rl_scheduler_tpu.utils.fsio import atomic_write_json
+    from rl_scheduler_tpu.utils.pidlock import acquire_pidfile_lock
+
+    try:
+        spec = DaemonSpec(
+            trace_dir=args.trace_dir,
+            incumbent=args.incumbent,
+            pool_url=args.pool,
+            poll_interval_s=args.poll_interval,
+            poll_retries=args.poll_retries,
+            confirm_checks=args.confirm_checks,
+            min_trace_records=args.min_trace_records,
+            cooldown_s=args.cooldown,
+            min_spacing_s=args.min_spacing,
+            shadow_min_scored=args.shadow_min_scored,
+            shadow_alpha=args.shadow_alpha,
+            shadow_timeout_s=args.shadow_timeout,
+            breaker_threshold=args.breaker_threshold,
+            breaker_reset_s=args.breaker_reset,
+            max_iterations=args.max_iterations,
+            max_polls=args.max_polls,
+            steps=args.steps,
+            mix_frac=args.mix,
+            compile_seed=args.compile_seed,
+            iterations=args.iterations,
+            seed=args.seed,
+            eval_every=args.eval_every,
+            eval_episodes=args.eval_episodes,
+            verdict_seeds=tuple(parse_seeds(args.verdict_seeds)),
+            verdict_episodes=args.verdict_episodes,
+            required_verdict=args.required_verdict,
+            forgetting_tolerance_pct=args.forgetting_tolerance,
+            num_nodes=args.num_nodes,
+            max_stage_retries=args.max_stage_retries,
+            rollout_timeout_s=args.rollout_timeout,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e))
+
+    daemon_dir = Path(args.out)
+    daemon_dir.mkdir(parents=True, exist_ok=True)
+    try:
+        lock = acquire_pidfile_lock(
+            daemon_dir / DAEMON_LOCK_NAME,
+            "a graftpilot daemon is already running over this dir (pid "
+            "{pid} holds {lock}); two controllers would interleave "
+            "iterations")
+    except RuntimeError as e:
+        raise SystemExit(str(e))
+    server = None
+    try:
+        if args.fresh:
+            import shutil
+
+            for entry in list(daemon_dir.iterdir()):
+                if entry.name == DAEMON_LOCK_NAME:
+                    continue
+                shutil.rmtree(entry) if entry.is_dir() \
+                    else entry.unlink()
+        fault_plan = fault_plan_from_env(
+            os.environ.get("GRAFTPILOT_FAULTS"))
+        try:
+            daemon = Daemon(spec, daemon_dir, fault_plan=fault_plan)
+        except DaemonLedgerMismatch as e:
+            raise SystemExit(str(e))
+        server = serve_status(daemon, port=args.status_port)
+        atomic_write_json(daemon_dir / DAEMON_STATE_NAME, {
+            "pid": os.getpid(),
+            "status_port": server.server_address[1],
+            "started_at": round(time.time(), 3),
+            "spec_sha": spec.fingerprint(),
+        })
+        signal.signal(signal.SIGTERM,
+                      lambda *_: daemon.request_stop())
+        summary = daemon.run_forever()
+    finally:
+        if server is not None:
+            server.shutdown()
+        lock.unlink(missing_ok=True)
+    print(json.dumps({"metric": "graftpilot_summary", **summary},
+                     sort_keys=True))
+    return 0
+
+
+def _cmd_status(args) -> int:
+    state = _read_state(Path(args.out))
+    url = f"http://127.0.0.1:{state['status_port']}/status"
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            body = json.load(resp)
+    except OSError as e:
+        raise SystemExit(
+            f"daemon status plane unreachable at {url} ({e}) — the "
+            f"recorded pid is {state['pid']}; stale state file?")
+    print(json.dumps(body, sort_keys=True))
+    return 0
+
+
+def _cmd_stop(args) -> int:
+    state = _read_state(Path(args.out))
+    pid = state["pid"]
+    try:
+        os.kill(pid, signal.SIGTERM)
+    except ProcessLookupError:
+        print(json.dumps({"stopped": False, "pid": pid,
+                          "reason": "not running"}))
+        return 0
+    deadline = time.monotonic() + args.timeout
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            print(json.dumps({"stopped": True, "pid": pid}))
+            return 0
+        time.sleep(0.2)
+    print(json.dumps({"stopped": False, "pid": pid,
+                      "reason": f"still running after "
+                                f"{args.timeout:.0f}s"}))
+    return 1
+
+
+def main(argv: list | None = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m rl_scheduler_tpu.loopback.daemon",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser(
+        "run", help="start the controller (foreground; SIGTERM drains)")
+    run.add_argument("--trace-dir", required=True,
+                     help="the pool's trace directory (extender "
+                          "--trace-dir)")
+    run.add_argument("--incumbent", required=True,
+                     help="run dir the pool serves at daemon start; "
+                          "moves automatically as promotes land")
+    run.add_argument("--pool", required=True, metavar="URL",
+                     help="pool control-plane base URL (polled for "
+                          "/stats, armed via /shadow, promoted via "
+                          "/promote)")
+    run.add_argument("--out", required=True,
+                     help="daemon working dir: ledger, state file, "
+                          "per-iteration loop dirs. Re-running resumes")
+    run.add_argument("--status-port", type=int, default=0,
+                     help="status-plane port (default 0 = ephemeral; "
+                          "recorded in daemon_state.json)")
+    run.add_argument("--poll-interval", type=float, default=30.0,
+                     help="seconds between /stats polls (default 30)")
+    run.add_argument("--poll-retries", type=int, default=2,
+                     help="transient /stats retries per poll before a "
+                          "poll_error decision (default 2)")
+    run.add_argument("--confirm-checks", type=int, default=2,
+                     help="consecutive drifting polls required to arm "
+                          "(default 2 — one spike never retrains)")
+    run.add_argument("--min-trace-records", type=int, default=50,
+                     help="trace-volume floor before arming (default 50)")
+    run.add_argument("--cooldown", type=float, default=300.0,
+                     help="post-PROMOTE quiet seconds (default 300)")
+    run.add_argument("--min-spacing", type=float, default=60.0,
+                     help="minimum seconds between iterations of any "
+                          "outcome (default 60)")
+    run.add_argument("--shadow-min-scored", type=int, default=50,
+                     help="paired live verdicts the shadow gate "
+                          "collects before grading (default 50)")
+    run.add_argument("--shadow-alpha", type=float, default=0.05,
+                     help="two-sided sign-test significance bar "
+                          "(default 0.05)")
+    run.add_argument("--shadow-timeout", type=float, default=120.0,
+                     help="shadow collection deadline, transient on "
+                          "expiry (default 120)")
+    run.add_argument("--breaker-threshold", type=int, default=3,
+                     help="consecutive failed iterations before "
+                          "observe-only mode (default 3)")
+    run.add_argument("--breaker-reset", type=float, default=600.0,
+                     help="observe-only cool-down seconds (default 600)")
+    run.add_argument("--max-iterations", type=int, default=0,
+                     help="stop after N completed iterations "
+                          "(default 0 = unbounded)")
+    run.add_argument("--max-polls", type=int, default=0,
+                     help="stop after N polls with no iteration "
+                          "in flight (default 0 = unbounded)")
+    run.add_argument("--steps", type=int, default=256)
+    run.add_argument("--mix", type=float, default=0.25)
+    run.add_argument("--compile-seed", type=int, default=0)
+    run.add_argument("--iterations", type=int, default=8,
+                     help="fine-tune iterations per retrain (default 8)")
+    run.add_argument("--seed", type=int, default=0)
+    run.add_argument("--eval-every", type=int, default=2)
+    run.add_argument("--eval-episodes", type=int, default=32)
+    run.add_argument("--verdict-seeds", default="0-4", metavar="SPEC")
+    run.add_argument("--verdict-episodes", type=int, default=64)
+    run.add_argument("--required-verdict", default="confirmed_above",
+                     choices=("point_above", "confirmed_above"))
+    run.add_argument("--forgetting-tolerance", type=float, default=10.0,
+                     metavar="PCT")
+    run.add_argument("--num-nodes", type=int, default=None)
+    run.add_argument("--max-stage-retries", type=int, default=2)
+    run.add_argument("--rollout-timeout", type=float, default=120.0)
+    run.add_argument("--fresh", action="store_true",
+                     help="discard the daemon dir's ledger/iterations "
+                          "and start over (refused while another "
+                          "daemon holds the lock)")
+    run.set_defaults(fn=_cmd_run)
+
+    status = sub.add_parser(
+        "status", help="print a running daemon's /status body")
+    status.add_argument("--out", required=True)
+    status.set_defaults(fn=_cmd_status)
+
+    stop = sub.add_parser(
+        "stop", help="SIGTERM the recorded pid and wait for the drain")
+    stop.add_argument("--out", required=True)
+    stop.add_argument("--timeout", type=float, default=30.0)
+    stop.set_defaults(fn=_cmd_stop)
+
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -191,6 +191,18 @@ class LoopLedger:
             else []
         self._rewrite(lines + [self._dumps(record)])
 
+    def append_attempt(self, stage: str, attempt: int,
+                       error: str) -> None:
+        """Record one FAILED-but-retried transient attempt (the in-loop
+        RetryPolicy). Attempt records never mark a stage done —
+        :meth:`stages` skips them — they make the retry budget auditable
+        after the fact."""
+        record = {"kind": "attempt", "stage": stage, "attempt": attempt,
+                  "ts": round(time.time(), 3), "error": error}
+        lines = self.path.read_text().splitlines() if self.path.exists() \
+            else []
+        self._rewrite(lines + [self._dumps(record)])
+
     def stages(self) -> dict:
         """``{stage: record}`` for every recorded stage (newest wins —
         there is at most one per stage in a healthy ledger)."""
@@ -202,15 +214,38 @@ class LoopLedger:
         return out
 
 
+# The exception families a stage may raise TRANSIENTLY (transport
+# errors, subprocess crashes, pool 5xx/409 re-raised as RuntimeError,
+# rollout TimeoutError). Anything else — spec validation ValueErrors,
+# ledger mismatches — propagates immediately: retrying a deterministic
+# error burns the budget to reach the identical failure.
+TRANSIENT_STAGE_ERRORS = (OSError, TimeoutError, RuntimeError)
+
+
 class LoopRunner:
-    """Execute (or resume) one loop iteration over ``loop_dir``."""
+    """Execute (or resume) one loop iteration over ``loop_dir``.
+
+    ``max_stage_retries`` bounds IN-PROCESS retries of a transiently
+    failing stage (``utils/retry.RetryPolicy`` backoff; each failed
+    attempt lands a ``kind=attempt`` ledger record). The default is 0 —
+    identical single-shot semantics to the pre-retry orchestrator; the
+    CLI passes ``--max-stage-retries`` (default 2). On exhaustion the
+    LAST underlying exception re-raises unchanged, so callers (and the
+    chaos suite) see the same error types with retries on or off.
+    Refusals are recorded outcomes, not errors — they stay single-shot
+    regardless of the budget."""
 
     def __init__(self, spec: LoopSpec, loop_dir: str | Path,
-                 fault_plan=None, rollout_timeout_s: float = 120.0):
+                 fault_plan=None, rollout_timeout_s: float = 120.0,
+                 max_stage_retries: int = 0):
+        if max_stage_retries < 0:
+            raise ValueError(
+                f"max_stage_retries={max_stage_retries}: >= 0")
         self.spec = spec
         self.loop_dir = Path(loop_dir)
         self.fault_plan = fault_plan
         self.rollout_timeout_s = rollout_timeout_s
+        self.max_stage_retries = max_stage_retries
         self.loop_dir.mkdir(parents=True, exist_ok=True)
         self.ledger = LoopLedger(self.loop_dir, spec)
 
@@ -345,12 +380,45 @@ class LoopRunner:
 
     # ------------------------------------------------------------- run
 
-    def run(self) -> dict:
-        """Drive the stages, skipping completed ones (ledger resume),
-        and return the loop summary (one ``schema_version``-tagged
-        dict — the CLI prints it as the driver JSON line)."""
+    def _attempt_stage(self, stage: str, fn):
+        """Run one stage body under the bounded transient-retry budget.
+        RetryPolicy supplies the (seeded, jittered) backoff schedule;
+        the loop re-raises the LAST exception itself so exhaustion
+        surfaces the original error type, not a wrapper."""
+        if self.max_stage_retries == 0:
+            return fn()
+        from rl_scheduler_tpu.utils.retry import RetryPolicy
+
+        delays = RetryPolicy(max_attempts=self.max_stage_retries + 1,
+                             base_delay_s=0.05, max_delay_s=2.0,
+                             seed=self.spec.seed).delays()
+        for attempt in range(1, self.max_stage_retries + 2):
+            try:
+                return fn()
+            except TRANSIENT_STAGE_ERRORS as exc:
+                if attempt > self.max_stage_retries:
+                    raise
+                logger.warning(
+                    "loopback: stage %s attempt %d/%d failed "
+                    "transiently (%s); retrying in %.2fs", stage,
+                    attempt, self.max_stage_retries + 1, exc,
+                    delays[attempt - 1])
+                self.ledger.append_attempt(stage, attempt, repr(exc))
+                time.sleep(delays[attempt - 1])
+        raise AssertionError("unreachable: the final attempt re-raises")
+
+    def run_stages(self, until: str | None = None) -> dict:
+        """Drive the stages in order up to and including ``until``
+        (default: all five), skipping completed ones (ledger resume);
+        returns :meth:`LoopLedger.stages`. graftpilot's daemon runs
+        ``until="evaluate"``, holds its live shadow gate, then calls
+        back with ``until="promote"`` — both halves resume from the
+        same ledger."""
+        if until is not None and until not in STAGES:
+            raise ValueError(f"until={until!r}: one of {STAGES}")
+        last = STAGES.index(until) if until is not None else len(STAGES) - 1
         done = self.ledger.stages()
-        for stage in STAGES:
+        for stage in STAGES[:last + 1]:
             if stage in done:
                 logger.info("loopback: stage %s already recorded "
                             "(%s) — skipping", stage,
@@ -358,27 +426,38 @@ class LoopRunner:
                 continue
             logger.info("loopback: stage %s", stage)
             if stage == "snapshot":
-                out = self._stage_snapshot()
+                out = self._attempt_stage(stage, self._stage_snapshot)
                 status = "ok"
             elif stage == "compile":
-                out = self._stage_compile(
-                    done["snapshot"]["out"]["snapshot"])
+                out = self._attempt_stage(
+                    stage, lambda: self._stage_compile(
+                        done["snapshot"]["out"]["snapshot"]))
                 status = "ok"
             elif stage == "retrain":
-                out = self._stage_retrain(
-                    done["compile"]["out"]["train_scenario"])
+                out = self._attempt_stage(
+                    stage, lambda: self._stage_retrain(
+                        done["compile"]["out"]["train_scenario"]))
                 status = "ok"
             elif stage == "evaluate":
-                out = self._stage_evaluate(
-                    done["retrain"]["out"]["candidate"],
-                    done["compile"]["out"]["scenario"])
+                out = self._attempt_stage(
+                    stage, lambda: self._stage_evaluate(
+                        done["retrain"]["out"]["candidate"],
+                        done["compile"]["out"]["scenario"]))
                 status = "ok"
             else:
-                status, out = self._stage_promote(
-                    done["retrain"]["out"]["candidate"],
-                    done["evaluate"]["out"])
+                status, out = self._attempt_stage(
+                    stage, lambda: self._stage_promote(
+                        done["retrain"]["out"]["candidate"],
+                        done["evaluate"]["out"]))
             self.ledger.append_stage(stage, status, out)
             done = self.ledger.stages()
+        return done
+
+    def run(self) -> dict:
+        """Drive the stages, skipping completed ones (ledger resume),
+        and return the loop summary (one ``schema_version``-tagged
+        dict — the CLI prints it as the driver JSON line)."""
+        done = self.run_stages()
         promote = done["promote"]
         return {
             "schema_version": LOOP_SCHEMA_VERSION,

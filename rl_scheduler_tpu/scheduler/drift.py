@@ -681,6 +681,14 @@ class ShadowScorer:
         self.dropped_total = 0
         self.errors_total = 0
         self.agreements_total = 0
+        # Paired win/loss/tie counts for graftpilot's live promote gate:
+        # one pair per scored request, win = shadow top-1 confidence
+        # strictly above the incumbent's score on the SAME observation.
+        # These feed graftstudy's two-sided sign test, which only needs
+        # the signs — so the counters sum exactly across workers.
+        self.wins_total = 0
+        self.losses_total = 0
+        self.ties_total = 0
         self._delta_counts = [0] * (len(DELTA_EDGES) + 1)
         self._delta_sum = 0.0
         self._closed = False
@@ -719,6 +727,12 @@ class ShadowScorer:
                 self.scored_total += 1
                 if int(shadow_action) == action:
                     self.agreements_total += 1
+                if delta > 0.0:
+                    self.wins_total += 1
+                elif delta < 0.0:
+                    self.losses_total += 1
+                else:
+                    self.ties_total += 1
                 self._delta_counts[idx] += 1
                 self._delta_sum += delta
             if self._record_fn is not None:
@@ -749,6 +763,9 @@ class ShadowScorer:
                 "dropped_total": self.dropped_total,
                 "errors_total": self.errors_total,
                 "agreements_total": self.agreements_total,
+                "wins_total": self.wins_total,
+                "losses_total": self.losses_total,
+                "ties_total": self.ties_total,
                 "agreement_rate": (round(self.agreements_total / scored, 4)
                                    if scored else None),
                 "score_delta": {
@@ -786,7 +803,8 @@ def sum_shadow(sections: list) -> dict | None:
     if not sections:
         return None
     keys = ("submitted_total", "scored_total", "dropped_total",
-            "errors_total", "agreements_total")
+            "errors_total", "agreements_total", "wins_total",
+            "losses_total", "ties_total")
     out = {k: sum(int(s.get(k, 0)) for s in sections) for k in keys}
     scored = out["scored_total"]
     out["agreement_rate"] = (round(out["agreements_total"] / scored, 4)
@@ -876,6 +894,12 @@ def shadow_metric_lines(prefix: str, section: dict) -> list:
                          "never affected)."),
         ("agreements_total", "Shadow top-1 choices agreeing with the "
                              "incumbent (lifetime)."),
+        ("wins_total", "Paired requests where the shadow's top-1 "
+                       "confidence beat the incumbent score (lifetime; "
+                       "graftpilot's sign-test gate input)."),
+        ("losses_total", "Paired requests the incumbent won (lifetime)."),
+        ("ties_total", "Paired requests with an exactly equal score "
+                       "(lifetime; excluded from the sign test)."),
     ):
         lines += [
             f"# HELP {p}_shadow_{key} {help_text}",

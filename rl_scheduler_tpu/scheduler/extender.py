@@ -571,6 +571,12 @@ class ExtenderPolicy:
         # are all in scope.
         self.drift = None
         self.shadow = None
+        # graftpilot (loopback/daemon.py): the backend request this
+        # policy was assembled under, stashed by build_policy so
+        # set_shadow can rebuild a candidate backend at RUNTIME with the
+        # same restore path the incumbent used. None on hand-constructed
+        # policies — runtime shadow arming refuses there.
+        self._shadow_build: dict | None = None
         # Candidate-list cap for the structured families — the same idea
         # as kube-scheduler's percentageOfNodesToScore: scoring cost per
         # request is O(cap) no matter how large the fleet's node list
@@ -1260,6 +1266,36 @@ class ExtenderPolicy:
                     ref["fingerprint"][:12])
         return {"loaded": True, "generation": ref["generation"],
                 "fingerprint": ref["fingerprint"]}
+
+    def set_shadow(self, shadow_run: str | None) -> dict:
+        """graftpilot (loopback/daemon.py): arm or disarm shadow scoring
+        at RUNTIME (``POST /shadow`` on the pool control plane). Arming
+        rebuilds the candidate backend through the same
+        refuse-before-grading checks as ``--shadow-run`` at startup and
+        swaps in a FRESH :class:`~..drift.ShadowScorer` — zeroed
+        counters, so the paired promote gate grades exactly the window
+        it armed, never stale startup-shadow traffic. ``None`` disarms.
+        The previous scorer (startup or runtime) is closed either way;
+        a failed arm leaves it serving untouched."""
+        if shadow_run is not None and self._shadow_build is None:
+            raise ValueError(
+                "set_shadow: this policy was not assembled by "
+                "build_policy (no recorded backend request), so the "
+                "candidate backend cannot be rebuilt — arm shadow at "
+                "startup via shadow_run instead")
+        scorer = None
+        if shadow_run is not None:
+            scorer = build_shadow_scorer(self, str(shadow_run),
+                                         **self._shadow_build)
+        old, self.shadow = self.shadow, scorer
+        if old is not None:
+            old.close()
+        if scorer is None:
+            logger.info("shadow scoring disarmed")
+            return {"shadow": "disarmed"}
+        logger.info("shadow scoring armed on %s (fresh counters)",
+                    shadow_run)
+        return {"shadow": "armed", "run": str(shadow_run)}
 
     def filter(self, args: dict) -> dict:
         """ExtenderFilterResult: keep nodes on the chosen cloud; fail open."""
@@ -2237,71 +2273,83 @@ def build_policy(
         policy.drift = DriftTracker(DriftConfig(**cfg_kwargs))
         if drift_ref is not None:
             policy.drift.set_reference(load_reference(drift_ref))
+    # graftpilot: record the backend request so set_shadow can rebuild a
+    # candidate at runtime under the same restore path.
+    policy._shadow_build = {"backend": backend,
+                            "serve_device": serve_device}
     if shadow_run is not None:
-        # graftdrift shadow scoring: a SECOND policy build supplies the
-        # candidate backend (same checkpoint restore + warm path as the
-        # incumbent); only its backend is kept. The family must match —
-        # comparing a per-node pointer to a cloud argmax is not an
-        # agreement signal — and a shadow that fell back to greedy
-        # (corrupt/missing checkpoint) is refused outright: silently
-        # grading the incumbent against the fallback would report
-        # meaningless agreement.
-        if policy.family == "graph":
-            raise ValueError(
-                "shadow_run: shadow scoring covers the cloud and set "
-                "families; the graph family's per-request topology is "
-                "not reproducible from the queued observation alone")
-        shadow_policy = build_policy(
-            backend=backend, run=shadow_run, serve_device=serve_device,
-            spans=False)
-        shadow_backend = shadow_policy.backend
-        shadow_name = getattr(shadow_backend, "name",
-                              shadow_backend.__class__.__name__)
-        if backend != "greedy" and shadow_name == "greedy":
-            raise ValueError(
-                f"shadow_run={shadow_run}: the shadow checkpoint failed "
-                "to load (greedy fallback) — fix the run dir; a greedy "
-                "shadow grades nothing")
-        if shadow_policy.family != policy.family:
-            raise ValueError(
-                f"shadow_run={shadow_run}: shadow family "
-                f"{shadow_policy.family!r} != incumbent family "
-                f"{policy.family!r}; shadow a matching checkpoint")
-        from rl_scheduler_tpu.scheduler.drift import ShadowScorer
-
-        def _softmax_top1(action, logits):
-            z = logits - logits.max()
-            probs = np.exp(z) / np.exp(z).sum()
-            return int(action), float(probs[int(action)])
-
-        if policy.family == "set":
-            def _shadow_score(obs):
-                action, logits = shadow_backend.decide_nodes(obs)
-                return _softmax_top1(action, np.asarray(logits))
-        else:
-            def _shadow_score(obs):
-                action, logits = shadow_backend.decide(obs)
-                return _softmax_top1(action, np.asarray(logits))
-
-        def _shadow_record(action, score, latency_ms, obs):
-            if policy.trace is None:
-                return
-            arr = np.asarray(obs) if obs is not None else None
-            candidates = (len(arr) if arr is not None and arr.ndim == 2
-                          else len(CLOUDS))
-            chosen = (CLOUDS[action]
-                      if policy.family == "cloud" and action < len(CLOUDS)
-                      else f"candidate-{action}")
-            policy.trace.append(decision_record(
-                endpoint="shadow", family=policy.family,
-                backend=shadow_name, candidates=candidates, chosen=chosen,
-                score=score, latency_ms=latency_ms,
-                worker_id=(policy.pool_info or {}).get("worker_id"),
-                generation=policy.generation))
-
-        policy.shadow = ShadowScorer(_shadow_score,
-                                     record_fn=_shadow_record)
+        policy.shadow = build_shadow_scorer(policy, shadow_run,
+                                            backend=backend,
+                                            serve_device=serve_device)
     return policy
+
+
+def build_shadow_scorer(policy: ExtenderPolicy, shadow_run: str,
+                        backend: str = "jax",
+                        serve_device: str = "cpu"):
+    """graftdrift shadow scoring: a SECOND policy build supplies the
+    candidate backend (same checkpoint restore + warm path as the
+    incumbent); only its backend is kept. The family must match —
+    comparing a per-node pointer to a cloud argmax is not an agreement
+    signal — and a shadow that fell back to greedy (corrupt/missing
+    checkpoint) is refused outright: silently grading the incumbent
+    against the fallback would report meaningless agreement. Shared by
+    the startup path (``--shadow-run``) and graftpilot's runtime
+    :meth:`ExtenderPolicy.set_shadow`."""
+    if policy.family == "graph":
+        raise ValueError(
+            "shadow_run: shadow scoring covers the cloud and set "
+            "families; the graph family's per-request topology is "
+            "not reproducible from the queued observation alone")
+    shadow_policy = build_policy(
+        backend=backend, run=shadow_run, serve_device=serve_device,
+        spans=False)
+    shadow_backend = shadow_policy.backend
+    shadow_name = getattr(shadow_backend, "name",
+                          shadow_backend.__class__.__name__)
+    if backend != "greedy" and shadow_name == "greedy":
+        raise ValueError(
+            f"shadow_run={shadow_run}: the shadow checkpoint failed "
+            "to load (greedy fallback) — fix the run dir; a greedy "
+            "shadow grades nothing")
+    if shadow_policy.family != policy.family:
+        raise ValueError(
+            f"shadow_run={shadow_run}: shadow family "
+            f"{shadow_policy.family!r} != incumbent family "
+            f"{policy.family!r}; shadow a matching checkpoint")
+    from rl_scheduler_tpu.scheduler.drift import ShadowScorer
+
+    def _softmax_top1(action, logits):
+        z = logits - logits.max()
+        probs = np.exp(z) / np.exp(z).sum()
+        return int(action), float(probs[int(action)])
+
+    if policy.family == "set":
+        def _shadow_score(obs):
+            action, logits = shadow_backend.decide_nodes(obs)
+            return _softmax_top1(action, np.asarray(logits))
+    else:
+        def _shadow_score(obs):
+            action, logits = shadow_backend.decide(obs)
+            return _softmax_top1(action, np.asarray(logits))
+
+    def _shadow_record(action, score, latency_ms, obs):
+        if policy.trace is None:
+            return
+        arr = np.asarray(obs) if obs is not None else None
+        candidates = (len(arr) if arr is not None and arr.ndim == 2
+                      else len(CLOUDS))
+        chosen = (CLOUDS[action]
+                  if policy.family == "cloud" and action < len(CLOUDS)
+                  else f"candidate-{action}")
+        policy.trace.append(decision_record(
+            endpoint="shadow", family=policy.family,
+            backend=shadow_name, candidates=candidates, chosen=chosen,
+            score=score, latency_ms=latency_ms,
+            worker_id=(policy.pool_info or {}).get("worker_id"),
+            generation=policy.generation))
+
+    return ShadowScorer(_shadow_score, record_fn=_shadow_record)
 
 
 def check_warm_nodes_served(policy: ExtenderPolicy,
@@ -2331,6 +2379,12 @@ def main(argv: list[str] | None = None) -> None:
                             "greedy"))
     p.add_argument("--run", default=None, help="checkpoint run dir")
     p.add_argument("--run-root", default=None)
+    p.add_argument("--data", default=None, metavar="CSV",
+                   help="telemetry replay table (cluster trace CSV) the "
+                        "serving-path TableTelemetry walks; defaults to "
+                        "the bundled table. Pin this when a drill or "
+                        "soak needs a known regime before a "
+                        "/telemetry/flip")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8787)
     p.add_argument("--front", default="threading", choices=FRONTS,
@@ -2613,6 +2667,7 @@ def main(argv: list[str] | None = None) -> None:
     logging.basicConfig(level=logging.INFO)
     build_kwargs = dict(
         backend=args.backend, run=args.run, run_root=args.run_root,
+        data_path=args.data,
         prometheus=args.prometheus, dry_run_place=args.dry_run_place,
         serve_device=args.serve_device,
         node_capacity_cores=args.node_capacity_cores,

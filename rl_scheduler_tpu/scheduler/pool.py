@@ -813,6 +813,21 @@ def _worker_control_loop(policy, server, sock, worker_id: int) -> None:
                     logger.warning("worker %d refused drift_ref: %s",
                                    worker_id, exc)
                     _send_line(sock, {"ok": False, "error": str(exc)})
+            elif cmd == "shadow":
+                # graftpilot promote gate: arm (path = candidate run dir)
+                # or disarm (path = null) runtime shadow scoring on this
+                # worker. Arming swaps in a FRESH scorer — zeroed
+                # counters, so the pool-summed paired verdict covers
+                # exactly the gated window.
+                try:
+                    _send_line(sock, {
+                        "ok": True,
+                        **policy.set_shadow(msg.get("path")),
+                    })
+                except Exception as exc:  # noqa: BLE001 - report, don't die
+                    logger.warning("worker %d refused shadow: %s",
+                                   worker_id, exc)
+                    _send_line(sock, {"ok": False, "error": str(exc)})
             else:
                 _send_line(sock, {"error": f"unknown cmd {cmd!r}"})
     except OSError:
@@ -1389,6 +1404,29 @@ class ServingPool:
             out["errors"] = errors
         return out
 
+    def set_shadow(self, path: str | None,
+                   timeout_s: float = 30.0) -> dict:
+        """graftpilot promote gate: arm (``path`` = candidate run dir)
+        or disarm (``path`` = None) runtime shadow scoring on every
+        worker. Same fan-out/ack contract as :meth:`flip_tables`; the
+        longer timeout covers each worker's candidate checkpoint restore
+        + compile. Arming swaps in FRESH per-worker scorers, so the
+        summed ``/stats`` shadow section counts exactly the traffic
+        paired while the gate is up."""
+        acks = self._fanout("shadow", timeout_s, {"path": path})
+        acked = sum(1 for ack in acks if (ack or {}).get("ok"))
+        full = acked == len(self._slots)
+        if path is None:
+            status = "disarmed" if full else "partial"
+        else:
+            status = "armed" if full else "partial"
+        out = {"status": status, "workers": acked, "path": path}
+        errors = sorted({ack["error"] for ack in acks
+                         if ack and not ack.get("ok") and "error" in ack})
+        if errors:
+            out["errors"] = errors
+        return out
+
     def status(self) -> dict:
         alive = sum(1 for s in self._slots if s.alive)
         with self._lock:
@@ -1522,6 +1560,22 @@ class _PoolHandler(BaseHTTPRequestHandler):
                 out = self.pool.flip_tables(payload["path"])
             else:
                 out = self.pool.set_drift_reference(payload["path"])
+            self._send(200 if not out.get("errors") else 409, out)
+        elif self.path == "/shadow":
+            # graftpilot promote gate: {"path": "<run_dir>"} arms
+            # runtime shadow scoring pool-wide, {"path": null} disarms.
+            # Unlike the graftdrift routes above, a null path is a valid
+            # request here — so the route validates separately.
+            try:
+                payload = json.loads(body or b"{}")
+            except json.JSONDecodeError as exc:
+                self._send(400, {"error": f"bad json: {exc}"})
+                return
+            if not isinstance(payload, dict) or "path" not in payload:
+                self._send(400, {"error": "pass a JSON object: "
+                                          '{"path": "<run_dir>"|null}'})
+                return
+            out = self.pool.set_shadow(payload["path"])
             self._send(200 if not out.get("errors") else 409, out)
         else:
             self._send(404, {"error": f"unknown path {self.path}"})

@@ -82,11 +82,26 @@ logger = logging.getLogger(__name__)
 #                      before the POST dispatches) — the fleet promote
 #                      must record `aborted` and revert every already-
 #                      rolled pool to its incumbent (scheduler/fleet.py)
+#   daemon.poll        graftpilot's /stats poll raises OSError — the
+#                      daemon must record a `poll_error` decision (after
+#                      its RetryPolicy budget) and keep polling; a flaky
+#                      control plane never kills the controller
+#                      (rl_scheduler_tpu/loopback/daemon.py)
+#   daemon.trigger     raised between the trigger verdict and arming the
+#                      iteration — the crash window where drift was seen
+#                      but nothing is recorded yet; a resume must re-poll
+#                      and re-arm from live evidence, never double-arm
+#   daemon.shadow_gate raised inside the live shadow gate (arm/collect/
+#                      grade) — the gate must leave the pool disarmed on
+#                      the incumbent generation and the iteration must
+#                      resume at the shadow_gate stage, never promote on
+#                      a half-collected verdict
 SITES = ("checkpoint.save", "checkpoint.partial", "telemetry.scrape",
          "k8s.place", "backend.decide", "preempt", "scenario.churn",
          "tracelog.append", "rollout.spawn", "rollout.health",
          "fastpath.agree", "loopback.compile", "loopback.promote",
-         "fleet.scrape", "fleet.promote")
+         "fleet.scrape", "fleet.promote", "daemon.poll",
+         "daemon.trigger", "daemon.shadow_gate")
 
 
 class FaultInjected(RuntimeError):

@@ -67,3 +67,20 @@ def cloud_table():
 @pytest.fixture(scope="session")
 def rng():
     return np.random.RandomState(0)
+
+
+@pytest.fixture(scope="session")
+def incumbent_run(tmp_path_factory):
+    """A deliberately thin incumbent (1 iteration): the serving
+    checkpoint today's pool carries, weak enough that a fine-tune on
+    the served trace reliably beats it 5/5 paired seeds. Session-scoped
+    so the graftloop and graftpilot drills share ONE training run."""
+    from rl_scheduler_tpu.agent import train_ppo
+
+    root = tmp_path_factory.mktemp("loopback_cli")
+    return train_ppo.main([
+        "--env", "cluster_set", "--preset", "quick", "--num-envs", "4",
+        "--rollout-steps", "8", "--minibatch-size", "32",
+        "--iterations", "1", "--eval-every", "1", "--eval-episodes", "2",
+        "--run-name", "INCUMBENT", "--run-root", str(root),
+    ])

@@ -1,0 +1,1275 @@
+"""graftpilot: the unattended drift-triggered retrain daemon (PR 20).
+
+Covers the tentpole and its satellites:
+
+- ``DaemonSpec`` / ``DaemonLedger``: fingerprint binding, byte-prefix
+  atomic appends, streak/hysteresis/inflight/incumbent reconstruction.
+- The trigger: one decision per poll (``no_drift`` / ``confirming`` /
+  ``armed`` / ``suppressed_*`` / ``insufficient_trace`` /
+  ``breaker_open`` / ``poll_error``), graded with driftview's own
+  ``grade_report`` plus SLO burn, against a stub control plane.
+- The live shadow promote gate: arm → collect paired verdicts →
+  two-sided sign test → ALWAYS disarm (timeout, drain and chaos paths).
+- The breaker's observe-only mode, resumable from the ledger alone.
+- driftview ``--json``'s machine verdict line pinned equal to
+  ``--check``'s grading (one ``grade_report`` derivation).
+- The orchestrator's bounded per-stage transient retries
+  (``kind=attempt`` records; exhaustion re-raises the original type).
+- Runtime shadow plumbing: ``ShadowScorer`` win/loss/tie pairs,
+  ``sum_shadow``, ``ExtenderPolicy.set_shadow`` fresh-scorer swaps.
+- ``make daemon-drill`` (``test_daemon_drill_kill_matrix``): the E2E
+  acceptance — a 2-worker drift-armed pool under continuous traffic, a
+  mid-soak regime flip, a daemon that detects → confirms → retrains →
+  shadow-confirms → hot-promotes generation 0→1 with zero failed
+  requests, SIGKILLed once in EVERY daemon ledger stage and resuming
+  byte-prefix-exact, while the stationary control provably never
+  retrains.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import pytest
+
+from rl_scheduler_tpu.loopback.daemon import (
+    DAEMON_LEDGER_NAME,
+    DAEMON_STATE_NAME,
+    DECISION_OUTCOMES,
+    ITERATION_STAGES,
+    Daemon,
+    DaemonDrained,
+    DaemonLedger,
+    DaemonLedgerMismatch,
+    DaemonSpec,
+    daemon_spec_from_json,
+    serve_status,
+)
+from rl_scheduler_tpu.loopback.daemon import main as daemon_main
+from rl_scheduler_tpu.loopback.orchestrator import (
+    TRANSIENT_STAGE_ERRORS,
+    LoopLedger,
+    LoopRunner,
+    LoopSpec,
+    fault_plan_from_env,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+FIXTURE_STATS = REPO_ROOT / "tests" / "fixtures" / "driftview" / "stats.json"
+BUDGETS = REPO_ROOT / "tools" / "driftview" / "budgets.json"
+
+
+def _dspec(tmp_path, **kw):
+    kw.setdefault("trace_dir", str(tmp_path / "trace"))
+    kw.setdefault("incumbent", str(tmp_path / "incumbent"))
+    kw.setdefault("pool_url", "http://127.0.0.1:1")
+    kw.setdefault("poll_interval_s", 0.05)
+    kw.setdefault("poll_retries", 0)
+    kw.setdefault("confirm_checks", 1)
+    kw.setdefault("min_trace_records", 5)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("min_spacing_s", 0.0)
+    return DaemonSpec(**kw)
+
+
+# ------------------------------------------------------------ spec
+
+
+class TestDaemonSpec:
+    def test_fingerprint_roundtrips_through_json(self, tmp_path):
+        spec = _dspec(tmp_path, confirm_checks=3,
+                      verdict_seeds=(1, 2, 3))
+        again = daemon_spec_from_json(spec.to_json())
+        assert again == spec
+        assert again.fingerprint() == spec.fingerprint()
+        assert isinstance(again.verdict_seeds, tuple)
+        # any protocol knob moves the fingerprint
+        other = _dspec(tmp_path, confirm_checks=4,
+                       verdict_seeds=(1, 2, 3))
+        assert other.fingerprint() != spec.fingerprint()
+
+    def test_validation_refusals(self, tmp_path):
+        with pytest.raises(ValueError, match="pool_url"):
+            _dspec(tmp_path, pool_url="")
+        with pytest.raises(ValueError, match="confirm_checks"):
+            _dspec(tmp_path, confirm_checks=0)
+        with pytest.raises(ValueError, match="shadow_alpha"):
+            _dspec(tmp_path, shadow_alpha=0.0)
+        with pytest.raises(ValueError, match="breaker_threshold"):
+            _dspec(tmp_path, breaker_threshold=0)
+        with pytest.raises(ValueError, match="cooldown_s"):
+            _dspec(tmp_path, cooldown_s=-1.0)
+        with pytest.raises(ValueError, match="poll_interval_s"):
+            _dspec(tmp_path, poll_interval_s=0.0)
+
+    def test_loop_spec_tracks_moving_incumbent(self, tmp_path):
+        spec = _dspec(tmp_path, steps=32, mix_frac=0.5)
+        loop = spec.loop_spec("promoted-gen-3")
+        assert isinstance(loop, LoopSpec)
+        assert loop.incumbent == "promoted-gen-3"
+        assert loop.trace_dir == spec.trace_dir
+        assert loop.steps == 32 and loop.mix_frac == 0.5
+        assert loop.dry_run is False
+
+
+# ---------------------------------------------------------- ledger
+
+
+class TestDaemonLedger:
+    def test_appends_preserve_prior_bytes(self, tmp_path):
+        spec = _dspec(tmp_path)
+        led = DaemonLedger(tmp_path / "d", spec)
+        header = led.path.read_bytes()
+        led.append_decision("no_drift", {"drifting": []})
+        first = led.path.read_bytes()
+        assert first.startswith(header)
+        led.append_iteration(0, "armed", "ok", {"loop_dir": "x"})
+        second = led.path.read_bytes()
+        assert second.startswith(first)
+        led.append_decision("armed", {"iter": 0})
+        assert led.path.read_bytes().startswith(second)
+        assert [r["seq"] for r in led.decisions()] == [1, 2]
+        assert led.next_seq() == 3
+        assert list(led.iterations()) == [0]
+        assert set(led.records()[0]) >= {"kind", "seq", "ts", "outcome"}
+
+    def test_invalid_outcome_and_stage_refused(self, tmp_path):
+        led = DaemonLedger(tmp_path / "d", _dspec(tmp_path))
+        with pytest.raises(ValueError, match="outcome"):
+            led.append_decision("maybe", {})
+        with pytest.raises(ValueError, match="stage"):
+            led.append_iteration(0, "warmup", "ok", {})
+        assert "maybe" not in DECISION_OUTCOMES
+        assert "warmup" not in ITERATION_STAGES
+
+    def test_changed_spec_refuses_resume(self, tmp_path):
+        DaemonLedger(tmp_path / "d", _dspec(tmp_path))
+        with pytest.raises(DaemonLedgerMismatch, match="cannot resume"):
+            DaemonLedger(tmp_path / "d",
+                         _dspec(tmp_path, confirm_checks=5))
+
+    def test_confirm_streak_counts_trailing_only(self, tmp_path):
+        led = DaemonLedger(tmp_path / "d", _dspec(tmp_path))
+        assert led.confirm_streak() == 0
+        led.append_decision("confirming", {})
+        led.append_decision("no_drift", {})
+        led.append_decision("confirming", {})
+        led.append_decision("confirming", {})
+        assert led.confirm_streak() == 2
+        led.append_decision("armed", {})
+        assert led.confirm_streak() == 0
+
+    def test_inflight_incumbent_hysteresis_failures(self, tmp_path):
+        spec = _dspec(tmp_path)
+        led = DaemonLedger(tmp_path / "d", spec)
+        assert led.inflight_iteration() is None
+        assert led.current_incumbent() == spec.incumbent
+        assert led.hysteresis() == (0.0, 0.0)
+        assert led.trailing_failures() == 0
+
+        led.append_iteration(0, "armed", "ok", {})
+        led.append_iteration(0, "retrain", "ok", {"candidate": "cand0"})
+        assert led.inflight_iteration() == 0
+        led.append_iteration(0, "cooldown", "ok", {
+            "outcome": "promoted", "cooldown_until": 100.0,
+            "next_allowed_at": 50.0})
+        assert led.inflight_iteration() is None
+        assert led.current_incumbent() == "cand0"
+        assert led.hysteresis() == (100.0, 50.0)
+
+        for i in (1, 2):
+            led.append_iteration(i, "armed", "ok", {})
+            led.append_iteration(i, "cooldown", "ok", {
+                "outcome": "rolled_back", "cooldown_until": 100.0 + i,
+                "next_allowed_at": 50.0 + i})
+        assert led.trailing_failures() == 2
+        # a rolled_back iteration never moves the incumbent
+        assert led.current_incumbent() == "cand0"
+        # the in-flight iteration has no outcome yet: skipped, not a
+        # streak breaker
+        led.append_iteration(3, "armed", "ok", {})
+        assert led.inflight_iteration() == 3
+        assert led.trailing_failures() == 2
+
+
+# ---------------------------------------------- driftview verdict pin
+
+
+class TestDriftviewVerdict:
+    def test_grade_report_pins_check_drift(self):
+        from tools.driftview import (
+            build_report,
+            check_drift,
+            grade_report,
+            load_budgets,
+            load_stats,
+        )
+
+        budgets = load_budgets(str(BUDGETS))
+        report = build_report(stats=load_stats(str(FIXTURE_STATS)))
+        grade = grade_report(report, budgets)
+        # one derivation: --check's violations ARE the grade's
+        assert check_drift(report, budgets) == grade["violations"]
+        assert grade["ok"] == (not grade["violations"])
+        assert grade["exit_code"] == (2 if grade["violations"] else 0)
+        assert set(grade["streams"]) == set(report["drift"]["streams"])
+        assert [g["gate"] for g in grade["gates"]] == [
+            "drift_section", "drifting_streams", "reference_coverage",
+            "reference_match", "reference_uniform", "shadow_floor"]
+        # a gate that cannot see drift fails loudly, never vacuously
+        blind = grade_report({}, budgets)
+        assert not blind["ok"]
+        assert blind["exit_reason"] == "drift_section"
+        assert blind["exit_code"] == 2
+
+    def test_json_verdict_line_equals_check_grading(self, capsys):
+        from tools.driftview import (
+            build_report,
+            grade_report,
+            load_budgets,
+            load_stats,
+        )
+        from tools.driftview.__main__ import main as driftview_main
+
+        rc = driftview_main(["--stats", str(FIXTURE_STATS), "--check",
+                             "--json", "--budgets", str(BUDGETS)])
+        line = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        grade = grade_report(
+            build_report(stats=load_stats(str(FIXTURE_STATS))),
+            load_budgets(str(BUDGETS)))
+        verdict = line["verdict"]
+        assert verdict["would_exit"] == rc == grade["exit_code"]
+        assert verdict["ok"] == grade["ok"]
+        assert verdict["exit_reason"] == grade["exit_reason"]
+        assert verdict["streams"] == grade["streams"]
+        assert verdict["gates"] == grade["gates"]
+        assert line["violations"] == grade["violations"]
+        # --json without --check: same verdict, exit stays 0 (the line
+        # reports what --check WOULD do; only --check acts on it)
+        rc2 = driftview_main(["--stats", str(FIXTURE_STATS), "--json",
+                              "--budgets", str(BUDGETS)])
+        line2 = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc2 == 0
+        assert line2["verdict"] == verdict
+
+
+# ------------------------------------------- orchestrator retries
+
+
+class TestOrchestratorRetries:
+    def _runner(self, tmp_path, name, retries):
+        spec = LoopSpec(trace_dir=str(tmp_path / "trace"),
+                        incumbent="run", dry_run=True)
+        return LoopRunner(spec, tmp_path / name,
+                          max_stage_retries=retries)
+
+    def _attempts(self, runner):
+        return [json.loads(line)
+                for line in runner.ledger.path.read_text().splitlines()[1:]
+                if json.loads(line).get("kind") == "attempt"]
+
+    def test_transient_retries_land_attempt_records(self, tmp_path):
+        runner = self._runner(tmp_path, "a", retries=2)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError(f"transient {len(calls)}")
+            return {"records": 7}
+
+        runner._stage_snapshot = flaky
+        done = runner.run_stages(until="snapshot")
+        assert len(calls) == 3
+        assert done["snapshot"]["status"] == "ok"
+        assert done["snapshot"]["out"] == {"records": 7}
+        attempts = self._attempts(runner)
+        assert [a["attempt"] for a in attempts] == [1, 2]
+        assert all(a["stage"] == "snapshot" for a in attempts)
+        assert all("transient" in a["error"] for a in attempts)
+        # attempt records never mark a stage done
+        assert set(runner.ledger.stages()) == {"snapshot"}
+
+    def test_exhaustion_reraises_original_type(self, tmp_path):
+        runner = self._runner(tmp_path, "b", retries=1)
+
+        def always():
+            raise TimeoutError("still down")
+
+        runner._stage_snapshot = always
+        assert isinstance(TimeoutError("x"), TRANSIENT_STAGE_ERRORS)
+        with pytest.raises(TimeoutError, match="still down"):
+            runner.run_stages(until="snapshot")
+        assert len(self._attempts(runner)) == 1
+        assert runner.ledger.stages() == {}
+
+    def test_deterministic_errors_never_retry(self, tmp_path):
+        runner = self._runner(tmp_path, "c", retries=2)
+        calls = []
+
+        def misconfigured():
+            calls.append(1)
+            raise ValueError("bad spec")
+
+        runner._stage_snapshot = misconfigured
+        with pytest.raises(ValueError, match="bad spec"):
+            runner.run_stages(until="snapshot")
+        assert len(calls) == 1
+        assert self._attempts(runner) == []
+
+    def test_zero_budget_is_single_shot(self, tmp_path):
+        runner = self._runner(tmp_path, "d", retries=0)
+        calls = []
+
+        def failing():
+            calls.append(1)
+            raise OSError("down")
+
+        runner._stage_snapshot = failing
+        with pytest.raises(OSError, match="down"):
+            runner.run_stages(until="snapshot")
+        assert len(calls) == 1
+        assert self._attempts(runner) == []
+
+    def test_bad_until_and_negative_budget_refused(self, tmp_path):
+        runner = self._runner(tmp_path, "e", retries=0)
+        with pytest.raises(ValueError, match="until"):
+            runner.run_stages(until="deploy")
+        with pytest.raises(ValueError, match="max_stage_retries"):
+            self._runner(tmp_path, "f", retries=-1)
+
+    def test_append_attempt_preserves_prior_bytes(self, tmp_path):
+        spec = LoopSpec(trace_dir="/t", incumbent="run", dry_run=True)
+        ledger = LoopLedger(tmp_path / "led", spec)
+        ledger.append_stage("snapshot", "ok", {"records": 1})
+        before = ledger.path.read_bytes()
+        ledger.append_attempt("compile", 1, "OSError('x')")
+        assert ledger.path.read_bytes().startswith(before)
+        assert set(ledger.stages()) == {"snapshot"}
+
+
+# ------------------------------------------------ shadow plumbing
+
+
+class TestShadowPlumbing:
+    def test_shadow_scorer_win_loss_tie_pairs(self):
+        from rl_scheduler_tpu.scheduler.drift import (
+            ShadowScorer,
+            sum_shadow,
+        )
+
+        scorer = ShadowScorer(lambda obs: (0, float(obs)))
+        try:
+            scorer.submit(0.9, 0, 0.5)  # shadow above → win
+            scorer.submit(0.1, 0, 0.5)  # shadow below → loss
+            scorer.submit(0.5, 1, 0.5)  # equal → tie (and disagreement)
+            deadline = time.monotonic() + 5.0
+            while scorer.scored_total < 3 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            snap = scorer.snapshot()
+        finally:
+            scorer.close()
+        assert snap["scored_total"] == 3
+        assert (snap["wins_total"], snap["losses_total"],
+                snap["ties_total"]) == (1, 1, 1)
+        assert snap["agreements_total"] == 2
+        pooled = sum_shadow([snap, snap])
+        assert (pooled["wins_total"], pooled["losses_total"],
+                pooled["ties_total"]) == (2, 2, 2)
+        assert pooled["scored_total"] == 6
+
+    def test_set_shadow_swaps_fresh_scorers(self, tmp_path):
+        from rl_scheduler_tpu.scheduler.extender import (
+            ExtenderPolicy,
+            build_policy,
+            build_shadow_scorer,
+        )
+        from rl_scheduler_tpu.scheduler.policy_backend import (
+            GreedyBackend,
+        )
+
+        policy = build_policy(backend="greedy")
+        try:
+            assert policy.shadow is None
+            assert policy.set_shadow(None)["shadow"] == "disarmed"
+            out = policy.set_shadow(str(tmp_path / "cand"))
+            assert out["shadow"] == "armed"
+            first = policy.shadow
+            assert first is not None and first.scored_total == 0
+            first.submit(0.5, 0, 0.5)
+            # re-arming swaps a FRESH scorer: the promote gate grades
+            # exactly the window it armed, never stale counters
+            policy.set_shadow(str(tmp_path / "cand2"))
+            assert policy.shadow is not first
+            assert policy.shadow.scored_total == 0
+            policy.set_shadow(None)
+            assert policy.shadow is None
+            # the module seam set_shadow rides on
+            scorer = build_shadow_scorer(policy, str(tmp_path / "c3"),
+                                         backend="greedy")
+            scorer.close()
+            bare = ExtenderPolicy(GreedyBackend(), policy.telemetry)
+            with pytest.raises(ValueError, match="not assembled"):
+                bare.set_shadow(str(tmp_path / "cand"))
+        finally:
+            if policy.shadow is not None:
+                policy.shadow.close()
+
+
+# --------------------------------------------- daemon vs stub pool
+
+
+def _stub_stats(drifting=False, records=500, generation=0, shadow=None,
+                burning=()):
+    names = ("cost", "action")
+    body = {
+        "pool": {"generation": generation, "workers": 2, "alive": 2},
+        "drift": {
+            "generation": generation,
+            "scores": {n: {"status": "ok", "drifting": bool(drifting)}
+                       for n in names},
+            "streams": {n: {"lifetime": {"count": records}}
+                        for n in names},
+            "drifting": sorted(names) if drifting else [],
+            "reference": {"fingerprint": "f" * 16,
+                          "generation": generation},
+        },
+        "trace": {"records_total": records},
+    }
+    if shadow is not None:
+        body["shadow"] = shadow
+    if burning:
+        body["slo"] = {"objectives": {n: {"burning": True}
+                                      for n in burning}}
+    return body
+
+
+class _StubPool:
+    """A /stats + /rollout + /shadow control-plane stand-in whose
+    responses come from a mutable ``box`` — the daemon under test sees
+    exactly the drift/shadow evidence each case scripts."""
+
+    def __init__(self):
+        box = {
+            "stats": _stub_stats(),
+            "stats_code": 200,
+            "rollout": {"generation": 0, "active": False,
+                        "promotions_total": 0, "last_error": None},
+            "shadow_ack": {"status": "armed", "workers": 2},
+            "shadow_posts": [],
+        }
+        self.box = box
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _send(self, code, body):
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/stats":
+                    if box["stats_code"] != 200:
+                        self._send(box["stats_code"], {"error": "down"})
+                    else:
+                        self._send(200, box["stats"])
+                elif self.path == "/rollout":
+                    self._send(200, box["rollout"])
+                else:
+                    self._send(404, {"error": self.path})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/shadow":
+                    box["shadow_posts"].append(payload)
+                    if payload.get("path") is None:
+                        self._send(200, {"status": "disarmed",
+                                         "workers": 2})
+                    else:
+                        self._send(200, box["shadow_ack"])
+                else:
+                    self._send(404, {"error": self.path})
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.server.server_address[1]}"
+
+    def close(self):
+        self.server.shutdown()
+
+
+@pytest.fixture()
+def stub_pool():
+    pool = _StubPool()
+    yield pool
+    pool.close()
+
+
+class TestDaemonTrigger:
+    def _daemon(self, tmp_path, stub_pool, name="d", faults=None, **kw):
+        spec = _dspec(tmp_path, pool_url=stub_pool.url, **kw)
+        plan = fault_plan_from_env(faults) if faults else None
+        return Daemon(spec, tmp_path / name, fault_plan=plan)
+
+    def test_one_decision_per_poll_in_priority_order(self, tmp_path,
+                                                     stub_pool):
+        daemon = self._daemon(tmp_path, stub_pool, confirm_checks=2,
+                              min_trace_records=50)
+        box = stub_pool.box
+
+        box["stats"] = _stub_stats(drifting=False)
+        assert daemon._tick_poll() is False
+        box["stats"] = _stub_stats(drifting=True, records=10)
+        assert daemon._tick_poll() is False
+        box["stats"] = _stub_stats(drifting=True, records=500)
+        assert daemon._tick_poll() is False  # confirming 1/2
+        assert daemon._tick_poll() is True   # armed
+        outcomes = [r["outcome"] for r in daemon.ledger.decisions()]
+        assert outcomes == ["no_drift", "insufficient_trace",
+                            "confirming", "armed"]
+        iters = daemon.ledger.iterations()
+        assert list(iters) == [0]
+        armed = iters[0]["armed"]["out"]
+        assert armed["incumbent"] == daemon.spec.incumbent
+        assert armed["evidence"]["drifting"] == ["action", "cost"]
+        assert Path(armed["loop_dir"]).name == "iter-0000"
+        assert daemon.polls_total == 4
+
+    def test_evaluate_trigger_slo_burn_arms_without_drift(
+            self, tmp_path, stub_pool):
+        daemon = self._daemon(tmp_path, stub_pool)
+        stats = _stub_stats(drifting=False, burning=("p99_ms",))
+        evidence = daemon.evaluate_trigger(stats)
+        assert evidence["drifting"] == []
+        assert evidence["burning"] == ["p99_ms"]
+        stub_pool.box["stats"] = stats
+        assert daemon._tick_poll() is True  # burn alone arms
+        assert daemon.ledger.decisions()[-1]["outcome"] == "armed"
+
+    def test_hysteresis_suppresses_cooldown_then_spacing(
+            self, tmp_path, stub_pool):
+        spec = _dspec(tmp_path, pool_url=stub_pool.url)
+        now = time.time()
+        led = DaemonLedger(tmp_path / "cool", spec)
+        led.append_iteration(0, "armed", "ok", {})
+        led.append_iteration(0, "retrain", "ok", {"candidate": "c0"})
+        led.append_iteration(0, "cooldown", "ok", {
+            "outcome": "promoted", "cooldown_until": now + 60.0,
+            "next_allowed_at": now + 60.0})
+        daemon = Daemon(spec, tmp_path / "cool")
+        stub_pool.box["stats"] = _stub_stats(drifting=True)
+        assert daemon._tick_poll() is False
+        assert daemon.ledger.decisions()[-1]["outcome"] \
+            == "suppressed_cooldown"
+
+        led2 = DaemonLedger(tmp_path / "space", spec)
+        led2.append_iteration(0, "armed", "ok", {})
+        led2.append_iteration(0, "cooldown", "ok", {
+            "outcome": "refused", "cooldown_until": now - 1.0,
+            "next_allowed_at": now + 60.0})
+        daemon2 = Daemon(spec, tmp_path / "space")
+        assert daemon2._tick_poll() is False
+        assert daemon2.ledger.decisions()[-1]["outcome"] \
+            == "suppressed_spacing"
+        # stationary evidence short-circuits before any suppression
+        stub_pool.box["stats"] = _stub_stats(drifting=False)
+        daemon2._tick_poll()
+        assert daemon2.ledger.decisions()[-1]["outcome"] == "no_drift"
+
+    def test_poll_error_after_retry_budget(self, tmp_path, stub_pool):
+        daemon = self._daemon(tmp_path, stub_pool,
+                              faults="daemon.poll:1,2,3",
+                              poll_retries=2)
+        stub_pool.box["stats"] = _stub_stats(drifting=True)
+        assert daemon._tick_poll() is False
+        assert daemon.ledger.decisions()[-1]["outcome"] == "poll_error"
+        # the fault budget is spent: the next poll grades normally
+        assert daemon._tick_poll() is True
+        # HTTP 5xx rides the same transient family
+        stub_pool.box["stats_code"] = 500
+        daemon2 = self._daemon(tmp_path, stub_pool, name="d2",
+                               poll_retries=0)
+        daemon2._tick_poll()
+        assert daemon2.ledger.decisions()[-1]["outcome"] == "poll_error"
+
+    def test_trigger_fault_is_seen_but_unrecorded(self, tmp_path,
+                                                  stub_pool):
+        daemon = self._daemon(tmp_path, stub_pool,
+                              faults="daemon.trigger:1")
+        stub_pool.box["stats"] = _stub_stats(drifting=True)
+        with pytest.raises(OSError):
+            daemon._tick_poll()
+        # nothing recorded in the crash window: no armed decision, no
+        # phantom iteration
+        assert all(r["outcome"] != "armed"
+                   for r in daemon.ledger.decisions())
+        assert daemon.ledger.iterations() == {}
+        # the resume re-derives the verdict from live evidence and arms
+        # exactly once
+        assert daemon._tick_poll() is True
+        assert list(daemon.ledger.iterations()) == [0]
+
+    def test_breaker_seeds_from_ledger_and_observes_only(
+            self, tmp_path, stub_pool):
+        spec = _dspec(tmp_path, pool_url=stub_pool.url,
+                      breaker_threshold=2, max_polls=3)
+        led = DaemonLedger(tmp_path / "brk", spec)
+        for i in (0, 1):
+            led.append_iteration(i, "armed", "ok", {})
+            led.append_iteration(i, "cooldown", "ok", {
+                "outcome": "rolled_back", "cooldown_until": 0.0,
+                "next_allowed_at": 0.0})
+        led.append_iteration(2, "armed", "ok",
+                             {"loop_dir": "x", "incumbent": "r",
+                              "evidence": {"generation": 0}})
+        daemon = Daemon(spec, tmp_path / "brk")
+        assert daemon.breaker.snapshot()["state"] == "open"
+        assert daemon.iteration_counts["rolled_back"] == 2
+        stub_pool.box["stats"] = _stub_stats(drifting=True)
+        # observe-only with work in flight: bounded by max_polls, every
+        # refused resume lands a breaker_open decision
+        summary = daemon.run_forever()
+        outcomes = [r["outcome"] for r in daemon.ledger.decisions()]
+        assert outcomes == ["breaker_open"] * 3
+        assert summary["decisions"]["breaker_open"] == 3
+        assert summary["inflight_iteration"] == 2
+        assert summary["breaker"]["state"] == "open"
+        metrics = daemon.metrics_body()
+        assert "graftpilot_breaker_state 2" in metrics
+        assert 'graftpilot_decisions_total{outcome="breaker_open"} 3' \
+            in metrics
+        assert 'graftpilot_iterations_total{outcome="rolled_back"} 2' \
+            in metrics
+
+
+class TestShadowGate:
+    def _daemon(self, tmp_path, stub_pool, **kw):
+        kw.setdefault("shadow_min_scored", 4)
+        kw.setdefault("shadow_alpha", 0.2)
+        kw.setdefault("shadow_timeout_s", 5.0)
+        spec = _dspec(tmp_path, pool_url=stub_pool.url, **kw)
+        return Daemon(spec, tmp_path / "gate")
+
+    def test_confirms_and_always_disarms(self, tmp_path, stub_pool):
+        daemon = self._daemon(tmp_path, stub_pool)
+        stub_pool.box["stats"] = _stub_stats(shadow={
+            "scored_total": 6, "wins_total": 6, "losses_total": 0,
+            "ties_total": 0})
+        gate = daemon._shadow_gate("cand-run")
+        assert gate["confirmed"] is True
+        assert gate["verdict"] == "confirmed_above"
+        assert gate["wins"] == 6 and gate["losses"] == 0
+        assert gate["pvalue"] <= 0.2
+        assert stub_pool.box["shadow_posts"] == [
+            {"path": "cand-run"}, {"path": None}]
+
+    def test_rejects_without_live_wins(self, tmp_path, stub_pool):
+        daemon = self._daemon(tmp_path, stub_pool)
+        stub_pool.box["stats"] = _stub_stats(shadow={
+            "scored_total": 8, "wins_total": 2, "losses_total": 5,
+            "ties_total": 1})
+        gate = daemon._shadow_gate("cand-run")
+        assert gate["confirmed"] is False
+        assert gate["verdict"] == "not_confirmed"
+        assert stub_pool.box["shadow_posts"][-1] == {"path": None}
+
+    def test_timeout_is_transient_and_disarms(self, tmp_path,
+                                              stub_pool):
+        daemon = self._daemon(tmp_path, stub_pool, shadow_timeout_s=0.4)
+        stub_pool.box["stats"] = _stub_stats(shadow={
+            "scored_total": 1, "wins_total": 1, "losses_total": 0,
+            "ties_total": 0})
+        with pytest.raises(TimeoutError, match="paired verdicts"):
+            daemon._shadow_gate("cand-run")
+        assert stub_pool.box["shadow_posts"][-1] == {"path": None}
+
+    def test_drain_unwinds_mid_gate(self, tmp_path, stub_pool):
+        daemon = self._daemon(tmp_path, stub_pool)
+        stub_pool.box["stats"] = _stub_stats(shadow={"scored_total": 0})
+        daemon.request_stop()
+        with pytest.raises(DaemonDrained):
+            daemon._shadow_gate("cand-run")
+        assert stub_pool.box["shadow_posts"][-1] == {"path": None}
+
+    def test_partial_arm_refuses(self, tmp_path, stub_pool):
+        daemon = self._daemon(tmp_path, stub_pool)
+        stub_pool.box["shadow_ack"] = {
+            "status": "partial", "workers": 1,
+            "errors": ["worker 1: restore failed"]}
+        with pytest.raises(RuntimeError, match="partial"):
+            daemon._shadow_gate("cand-run")
+
+    def test_chaos_site_fires_before_arming(self, tmp_path, stub_pool):
+        spec = _dspec(tmp_path, pool_url=stub_pool.url,
+                      shadow_min_scored=4, shadow_alpha=0.2,
+                      shadow_timeout_s=5.0)
+        plan = fault_plan_from_env("daemon.shadow_gate:1")
+        daemon = Daemon(spec, tmp_path / "chaos", fault_plan=plan)
+        with pytest.raises(OSError):
+            daemon._shadow_gate("cand-run")
+        assert stub_pool.box["shadow_posts"] == []  # nothing armed
+        stub_pool.box["stats"] = _stub_stats(shadow={
+            "scored_total": 6, "wins_total": 6, "losses_total": 0,
+            "ties_total": 0})
+        assert daemon._shadow_gate("cand-run")["confirmed"] is True
+
+
+class TestAdoptLandedPromote:
+    def test_adopts_when_pool_moved_past_armed_generation(
+            self, tmp_path, stub_pool):
+        spec = _dspec(tmp_path, pool_url=stub_pool.url)
+        daemon = Daemon(spec, tmp_path / "adopt")
+        stub_pool.box["rollout"] = {"generation": 1, "active": False,
+                                    "promotions_total": 1}
+        out = daemon._adopt_landed_promote(0)
+        assert out["adopted"] is True and out["generation"] == 1
+        # the pool still serving the armed generation means the promote
+        # never dispatched: run the stage normally
+        assert daemon._adopt_landed_promote(1) is None
+
+    def test_stuck_rollout_times_out(self, tmp_path, stub_pool):
+        spec = _dspec(tmp_path, pool_url=stub_pool.url,
+                      rollout_timeout_s=0.4)
+        daemon = Daemon(spec, tmp_path / "stuck")
+        stub_pool.box["rollout"] = {"generation": 0, "active": True}
+        with pytest.raises(TimeoutError, match="in flight"):
+            daemon._adopt_landed_promote(0)
+
+
+class TestDaemonSurfaces:
+    def test_status_metrics_and_http_plane(self, tmp_path, stub_pool):
+        spec = _dspec(tmp_path, pool_url=stub_pool.url)
+        daemon = Daemon(spec, tmp_path / "surf")
+        daemon.ledger.append_decision("no_drift", {})
+        daemon.decision_counts["no_drift"] += 1
+        body = daemon.status_body()
+        assert body["daemon"] == "graftpilot"
+        assert body["spec_sha"] == spec.fingerprint()
+        assert body["decisions"]["no_drift"] == 1
+        assert body["iterations_completed"] == 0
+        assert body["inflight_iteration"] is None
+        assert body["breaker"]["state"] == "closed"
+        metrics = daemon.metrics_body()
+        assert "graftpilot_breaker_state 0" in metrics
+        assert "graftpilot_confirm_streak 0" in metrics
+        assert "graftpilot_cooldown_active 0" in metrics
+
+        server = serve_status(daemon)
+        try:
+            port = server.server_address[1]
+
+            def get(path):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}",
+                        timeout=5) as resp:
+                    return resp.status, resp.read().decode()
+
+            code, status = get("/status")
+            assert code == 200
+            assert json.loads(status)["daemon"] == "graftpilot"
+            code, text = get("/metrics")
+            assert code == 200 and "graftpilot_polls_total 0" in text
+            code, health = get("/healthz")
+            assert code == 200
+            assert json.loads(health)["pid"] == os.getpid()
+            with pytest.raises(urllib.error.HTTPError) as err:
+                get("/nope")
+            assert err.value.code == 404
+        finally:
+            server.shutdown()
+
+    def test_cli_status_and_stop(self, tmp_path, stub_pool, capsys):
+        from rl_scheduler_tpu.utils.fsio import atomic_write_json
+
+        out_dir = tmp_path / "cli"
+        out_dir.mkdir()
+        with pytest.raises(SystemExit, match=DAEMON_STATE_NAME):
+            daemon_main(["status", "--out", str(out_dir)])
+
+        spec = _dspec(tmp_path, pool_url=stub_pool.url)
+        daemon = Daemon(spec, out_dir)
+        server = serve_status(daemon)
+        try:
+            atomic_write_json(out_dir / DAEMON_STATE_NAME, {
+                "pid": os.getpid(),
+                "status_port": server.server_address[1],
+                "started_at": time.time(),
+                "spec_sha": spec.fingerprint()})
+            assert daemon_main(["status", "--out", str(out_dir)]) == 0
+            body = json.loads(capsys.readouterr().out.strip())
+            assert body["daemon"] == "graftpilot"
+            assert body["spec_sha"] == spec.fingerprint()
+        finally:
+            server.shutdown()
+
+        sleeper = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(120)"])
+        # reap the sleeper as soon as SIGTERM lands — a zombie child
+        # still answers kill(pid, 0) and would read as "running"
+        threading.Thread(target=sleeper.wait, daemon=True).start()
+        try:
+            atomic_write_json(out_dir / DAEMON_STATE_NAME, {
+                "pid": sleeper.pid, "status_port": 1,
+                "started_at": time.time(), "spec_sha": "x"})
+            assert daemon_main(["stop", "--out", str(out_dir),
+                                "--timeout", "15"]) == 0
+            stopped = json.loads(capsys.readouterr().out.strip())
+            assert stopped == {"stopped": True, "pid": sleeper.pid}
+            assert sleeper.wait(timeout=10) == -signal.SIGTERM
+            # a second stop reports the already-dead pid, exit 0
+            assert daemon_main(["stop", "--out", str(out_dir),
+                                "--timeout", "5"]) == 0
+            again = json.loads(capsys.readouterr().out.strip())
+            assert again["stopped"] is False
+            assert again["reason"] == "not running"
+        finally:
+            if sleeper.poll() is None:
+                sleeper.kill()
+
+
+@pytest.mark.slow
+def test_daemon_soak_hysteresis_never_flaps(tmp_path):
+    """The anti-churn soak: a promoted iteration inside its cooldown
+    window sees persistently drifting evidence for many polls and the
+    daemon NEVER arms a second iteration — every decision is
+    ``suppressed_cooldown``, the ledger stays byte-prefix monotonic."""
+    pool = _StubPool()
+    try:
+        spec = _dspec(tmp_path, pool_url=pool.url, cooldown_s=300.0,
+                      min_spacing_s=300.0, max_polls=40,
+                      poll_interval_s=0.02)
+        now = time.time()
+        led = DaemonLedger(tmp_path / "soak", spec)
+        led.append_iteration(0, "armed", "ok", {})
+        led.append_iteration(0, "retrain", "ok", {"candidate": "c0"})
+        led.append_iteration(0, "cooldown", "ok", {
+            "outcome": "promoted", "cooldown_until": now + 300.0,
+            "next_allowed_at": now + 300.0})
+        daemon = Daemon(spec, tmp_path / "soak")
+        pool.box["stats"] = _stub_stats(drifting=True)
+        prev = daemon.ledger.path.read_bytes()
+        summary = daemon.run_forever()
+        assert daemon.ledger.path.read_bytes().startswith(prev)
+        assert summary["decisions"]["suppressed_cooldown"] == 40
+        assert summary["decisions"]["armed"] == 0
+        assert summary["iterations_completed"] == 1
+        assert list(daemon.ledger.iterations()) == [0]
+    finally:
+        pool.close()
+
+
+# ------------------------------------------------------- the drill
+
+
+def _write_table(path, cost_aws, cost_azure, lat_aws, lat_azure,
+                 rows=32):
+    """A normalized replay table with jitter small enough to stay
+    inside one drift bucket (the graftdrift drill's tables)."""
+    lines = ["cost_aws,cost_azure,latency_aws,latency_azure"]
+    for i in range(rows):
+        j = (i % 8) * 0.001
+        lines.append(f"{cost_aws + j:.4f},{cost_azure + j:.4f},"
+                     f"{lat_aws + j:.4f},{lat_azure + j:.4f}")
+    path.write_text("\n".join(lines) + "\n")
+
+
+def _bench_payload(i, num_nodes=8):
+    items = [
+        {"metadata": {"name": f"node-{j}",
+                      "labels": {"cloud": "aws" if j < num_nodes // 2
+                                 else "azure"}}}
+        for j in range(num_nodes)
+    ]
+    return json.dumps({
+        "pod": {"metadata": {"name": f"pilot-pod-{i}"},
+                "spec": {"containers": [{"resources": {
+                    "requests": {"cpu": "800m"}}}]}},
+        "nodes": {"items": items},
+    }).encode()
+
+
+def _get(port, path, timeout=10):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as resp:
+        body = resp.read()
+    if resp.headers.get("Content-Type",
+                        "").startswith("application/json"):
+        return json.loads(body)
+    return body.decode()
+
+
+def _post(port, path, payload, timeout=10):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.load(resp)
+
+
+def _load_bench():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "extender_bench",
+        REPO_ROOT / "loadgen" / "extender_bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    return bench
+
+
+def test_daemon_drill_kill_matrix(incumbent_run, tmp_path):
+    """``make daemon-drill``, the graftpilot acceptance: a 2-worker
+    drift-armed pool serves bench traffic continuously; the replay
+    regime flips mid-soak; the daemon detects the drift off ``/stats``,
+    confirms it across consecutive polls, retrains through graftloop,
+    passes the LIVE shadow sign-test gate and hot-promotes generation
+    0→1 with zero failed requests — while being SIGKILLed once in
+    EVERY daemon ledger stage (armed / mid-loop / retrain recorded /
+    shadow-gated / promoted) and resuming byte-prefix-exact each time.
+    The stationary control (before the flip) records only ``no_drift``
+    decisions and provably never retrains."""
+    from rl_scheduler_tpu.scheduler import drift as drift_mod
+
+    base_csv = tmp_path / "base.csv"
+    spike_csv = tmp_path / "spike.csv"
+    _write_table(base_csv, 0.10, 0.30, 0.20, 0.24)
+    _write_table(spike_csv, 0.95, 0.60, 0.90, 0.85)
+
+    port, cport = 0, 0
+    import socket
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        if not port:
+            port = s.getsockname()[1]
+        else:
+            cport = s.getsockname()[1]
+        s.close()
+
+    pool_trace = tmp_path / "pool_trace"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO_ROOT) + os.pathsep \
+        + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    pool_proc = subprocess.Popen(
+        [sys.executable, "-m", "rl_scheduler_tpu.scheduler.extender",
+         "--workers", "2", "--host", "127.0.0.1",
+         "--port", str(port), "--control-port", str(cport),
+         "--run", str(incumbent_run), "--backend", "cpu",
+         "--trace-dir", str(pool_trace), "--trace-max-segments", "50",
+         "--data", str(base_csv),
+         "--drift", "--drift-threshold", "0.2",
+         "--drift-fast-window", "1.0", "--drift-slow-window", "3.0",
+         "--drift-min-count", "10", "--drift-bucket-s", "0.25"],
+        env=env, start_new_session=True,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    failures, served = [], []
+    stop = threading.Event()
+
+    def _traffic():
+        i = 0
+        while not stop.is_set():
+            body = _bench_payload(i)
+            for attempt in range(4):
+                try:
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{port}/filter", data=body,
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req,
+                                                timeout=10) as resp:
+                        json.load(resp)
+                    served.append(i)
+                    break
+                except urllib.error.HTTPError as e:
+                    failures.append((i, e.code))
+                    break
+                except OSError:
+                    if attempt == 3:
+                        failures.append((i, "connect"))
+                    else:
+                        time.sleep(0.1)
+            i += 1
+            time.sleep(0.03)
+
+    pilot_dir = tmp_path / "pilot"
+    ctl_dir = tmp_path / "control"
+    daemon_common = [
+        sys.executable, "-m", "rl_scheduler_tpu.loopback.daemon",
+        "run",
+        "--trace-dir", str(pool_trace),
+        "--incumbent", str(incumbent_run),
+        "--pool", f"http://127.0.0.1:{cport}",
+        "--poll-interval", "0.3", "--poll-retries", "2",
+        "--confirm-checks", "2", "--min-trace-records", "20",
+        "--cooldown", "120", "--min-spacing", "0.5",
+        "--shadow-min-scored", "24", "--shadow-alpha", "0.2",
+        "--shadow-timeout", "60",
+        "--steps", "16", "--mix", "0.25", "--iterations", "3",
+        "--eval-every", "3", "--eval-episodes", "2",
+        "--verdict-seeds", "0-4", "--verdict-episodes", "4",
+        "--rollout-timeout", "180", "--max-stage-retries", "2",
+    ]
+
+    def _wait_marker(path, marker, proc, what, timeout_s=300.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if path.exists() and marker in path.read_text():
+                return
+            if proc.poll() is not None:
+                pytest.fail(f"daemon exited rc={proc.returncode} "
+                            f"before {what}")
+            time.sleep(0.1)
+        pytest.fail(f"{what} never appeared in {path}")
+
+    dledger = pilot_dir / DAEMON_LEDGER_NAME
+    lledger = pilot_dir / "iter-0000" / "loop_ledger.jsonl"
+    try:
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            try:
+                if _get(cport, "/healthz")["alive"] == 2:
+                    break
+            except OSError:
+                time.sleep(0.2)
+        else:
+            pytest.fail("pool never came up")
+
+        thread = threading.Thread(target=_traffic, daemon=True)
+        thread.start()
+        deadline = time.monotonic() + 120.0
+        while len(served) < 40 and time.monotonic() < deadline:
+            time.sleep(0.2)
+        assert len(served) >= 40, "traffic never ramped"
+
+        # Freeze the base-regime reference the daemon will grade
+        # against (the mandatory snapshot-after-deploy).
+        stats_url = f"http://127.0.0.1:{cport}/stats"
+        ref_path = tmp_path / "reference.json"
+        assert drift_mod.main(["snapshot", "--stats", stats_url,
+                               "--out", str(ref_path)]) == 0
+        resp = _post(cport, "/drift/reference", {"path": str(ref_path)})
+        assert resp["status"] == "loaded" and resp["workers"] == 2
+
+        # The stationary control: 3 polls over the UNCHANGED regime —
+        # only no_drift decisions, zero iterations, provably no retrain.
+        ctl = subprocess.run(
+            daemon_common + ["--out", str(ctl_dir), "--max-polls", "3"],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert ctl.returncode == 0, ctl.stderr[-2000:]
+        ctl_summary = json.loads(
+            [ln for ln in ctl.stdout.splitlines()
+             if ln.startswith("{")][-1])
+        assert ctl_summary["decisions"]["no_drift"] == 3
+        assert sum(ctl_summary["decisions"].values()) == 3
+        assert ctl_summary["iterations_completed"] == 0
+        ctl_records = (ctl_dir / DAEMON_LEDGER_NAME).read_text()
+        assert '"kind": "iteration"' not in ctl_records
+
+        # The regime flip: every worker swaps to the spike table; the
+        # drift sketches cross the threshold in both burn windows.
+        flip = _post(cport, "/telemetry/flip", {"path": str(spike_csv)})
+        assert flip["status"] == "flipped" and flip["workers"] == 2
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if _get(cport, "/stats")["drift"]["drifting"]:
+                break
+            time.sleep(0.25)
+        else:
+            pytest.fail("the flip never registered as drift")
+
+        # The kill matrix: identical argv each run (the spec
+        # fingerprint binds the ledger), one SIGKILL per daemon stage,
+        # byte-prefix asserted at every resume.
+        markers = [
+            (dledger, '"outcome": "armed"', "armed decision"),
+            (lledger, '"stage": "compile"', "loop compile stage"),
+            (dledger, '"stage": "retrain"', "daemon retrain record"),
+            (dledger, '"stage": "shadow_gate"', "shadow gate record"),
+            (dledger, '"stage": "promote"', "daemon promote record"),
+        ]
+        pilot_argv = daemon_common + ["--out", str(pilot_dir)]
+        prev_daemon, prev_loop = b"", b""
+        for i, (path, marker, what) in enumerate(markers):
+            with open(tmp_path / f"pilot_run{i}.log", "wb") as log:
+                proc = subprocess.Popen(pilot_argv, env=env,
+                                        start_new_session=True,
+                                        stdout=log,
+                                        stderr=subprocess.STDOUT)
+            try:
+                _wait_marker(path, marker, proc, what)
+            finally:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+            proc.wait(timeout=30)
+            cur = dledger.read_bytes()
+            assert cur.startswith(prev_daemon), \
+                f"daemon ledger lost bytes after kill {i} ({what})"
+            prev_daemon = cur
+            if lledger.exists():
+                cur_loop = lledger.read_bytes()
+                assert cur_loop.startswith(prev_loop), \
+                    f"loop ledger lost bytes after kill {i} ({what})"
+                prev_loop = cur_loop
+
+        # The final resume finishes the iteration (or finds it already
+        # terminal) and goes back to polling; post-promote the frozen
+        # reference no longer matches the serving generation, so the
+        # daemon cannot double-retrain — decisions return to no_drift.
+        final_log = tmp_path / "pilot_final.log"
+        with open(final_log, "wb") as log:
+            final = subprocess.Popen(pilot_argv, env=env,
+                                     start_new_session=True,
+                                     stdout=log,
+                                     stderr=subprocess.STDOUT)
+        # reap on exit so the stop subcommand's kill(pid, 0) liveness
+        # probe sees the drain instead of a zombie child of pytest
+        threading.Thread(target=final.wait, daemon=True).start()
+        # The killed run may have raced a few records past its marker
+        # (cooldown, even an early no_drift) before the SIGKILL landed
+        # — wait for the FINAL run to own the state file before
+        # trusting the status plane.
+        deadline = time.monotonic() + 120.0
+        state = None
+        while time.monotonic() < deadline:
+            try:
+                state = json.loads(
+                    (pilot_dir / DAEMON_STATE_NAME).read_text())
+                if state["pid"] == final.pid:
+                    break
+            except (OSError, ValueError):
+                pass
+            if final.poll() is not None:
+                pytest.fail(f"final daemon exited rc={final.returncode}"
+                            " before writing its state file")
+            time.sleep(0.1)
+        assert state is not None and state["pid"] == final.pid
+        _wait_marker(dledger, '"no_drift"', final,
+                     "post-promote no_drift decision")
+        assert dledger.read_bytes().startswith(prev_daemon)
+        # The final resume may still be closing out the iteration —
+        # poll the live status plane until the promote is terminal.
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline:
+            status = _get(state["status_port"], "/status")
+            if status["iterations"].get("promoted") == 1 \
+                    and status["inflight_iteration"] is None:
+                break
+            if final.poll() is not None:
+                pytest.fail(f"final daemon exited rc={final.returncode}"
+                            " before finishing the promote")
+            time.sleep(0.25)
+        assert status["iterations"]["promoted"] == 1
+        assert status["inflight_iteration"] is None
+        assert status["breaker"]["state"] == "closed"
+        assert status["cooldown_until"] > time.time()  # hysteresis on
+        assert status["incumbent"] != str(incumbent_run)
+        metrics = _get(state["status_port"], "/metrics")
+        assert 'graftpilot_iterations_total{outcome="promoted"} 1' \
+            in metrics
+        assert "graftpilot_cooldown_active 1" in metrics
+        assert "graftpilot_breaker_state 0" in metrics
+        sub = subprocess.run(
+            [sys.executable, "-m", "rl_scheduler_tpu.loopback.daemon",
+             "status", "--out", str(pilot_dir)],
+            env=env, capture_output=True, text=True, timeout=60)
+        assert sub.returncode == 0, sub.stderr[-2000:]
+        assert json.loads(sub.stdout)["iterations"]["promoted"] == 1
+
+        # SIGTERM drain via the stop subcommand ends the final run
+        # cleanly with the summary line.
+        stop_cmd = subprocess.run(
+            [sys.executable, "-m", "rl_scheduler_tpu.loopback.daemon",
+             "stop", "--out", str(pilot_dir), "--timeout", "60"],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert stop_cmd.returncode == 0, stop_cmd.stderr[-2000:]
+        assert json.loads(stop_cmd.stdout)["stopped"] is True
+        assert final.wait(timeout=60) == 0
+        summary = json.loads(
+            [ln for ln in final_log.read_text().splitlines()
+             if '"metric": "graftpilot_summary"' in ln][-1])
+        assert summary["iterations"] == {
+            "promoted": 1, "refused": 0, "shadow_rejected": 0,
+            "rolled_back": 0}
+        assert summary["decisions"]["armed"] == 1
+        assert summary["decisions"]["confirming"] >= 1
+        assert summary["decisions"]["breaker_open"] == 0
+        assert summary["breaker"]["state"] == "closed"
+        assert summary["breaker"]["opens_total"] == 0
+
+        # The ledger's own story: the shadow gate confirmed with live
+        # wins, and the promote landed generation 1 exactly once.
+        records = [json.loads(ln) for ln
+                   in dledger.read_text().splitlines()[1:]]
+        stages = {r["stage"]: r for r in records
+                  if r["kind"] == "iteration" and r["iter"] == 0}
+        gate = stages["shadow_gate"]["out"]
+        assert gate["confirmed"] is True
+        assert gate["scored"] >= 24
+        assert gate["wins"] > gate["losses"]
+        assert gate["pvalue"] <= 0.2
+        assert stages["promote"]["out"]["generation"] == 1
+        assert stages["cooldown"]["out"]["outcome"] == "promoted"
+
+        # The pool really moved: one promotion, generation 1 on every
+        # worker, and the bench's soak line samples it.
+        rollout = _get(cport, "/rollout")
+        assert rollout["generation"] == 1
+        assert rollout["promotions_total"] == 1
+        assert not rollout["active"]
+        pool_metrics = _get(cport, "/metrics")
+        assert "rl_scheduler_extender_pool_generation 1" in pool_metrics
+        bench_out = _load_bench().main(
+            ["--port", str(port), "--threads", "2", "--warmup", "2",
+             "--duration", "0.6", "--control-port", str(cport)])
+        assert bench_out["failures"] == 0
+        assert bench_out["daemon_generation"] == 1
+    finally:
+        stop.set()
+        for leftover in (pilot_dir / DAEMON_STATE_NAME,):
+            if leftover.exists():
+                try:
+                    pid = json.loads(leftover.read_text())["pid"]
+                    os.kill(pid, signal.SIGKILL)
+                except (OSError, ValueError, KeyError):
+                    pass
+        try:
+            os.killpg(pool_proc.pid, signal.SIGTERM)
+            pool_proc.wait(timeout=30)
+        except (ProcessLookupError, subprocess.TimeoutExpired):
+            try:
+                os.killpg(pool_proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            pool_proc.wait(timeout=10)
+
+    # Zero failed requests across the whole soak — flip, shadow gate
+    # and rolling promote included.
+    assert failures == [], f"dropped requests: {failures[:10]}"
+    assert len(served) >= 100

@@ -805,20 +805,8 @@ def _free_port():
     return port
 
 
-@pytest.fixture(scope="module")
-def incumbent_run(tmp_path_factory):
-    """A deliberately thin incumbent (1 iteration): the serving
-    checkpoint today's pool carries, weak enough that a fine-tune on
-    the served trace reliably beats it 5/5 paired seeds."""
-    from rl_scheduler_tpu.agent import train_ppo
-
-    root = tmp_path_factory.mktemp("loopback_cli")
-    return train_ppo.main([
-        "--env", "cluster_set", "--preset", "quick", "--num-envs", "4",
-        "--rollout-steps", "8", "--minibatch-size", "32",
-        "--iterations", "1", "--eval-every", "1", "--eval-episodes", "2",
-        "--run-name", "INCUMBENT", "--run-root", str(root),
-    ])
+# `incumbent_run` is session-scoped in conftest.py: the graftpilot
+# daemon drill shares the same one-iteration incumbent training run.
 
 
 def test_incumbent_meta_reads_newest_verified(incumbent_run):

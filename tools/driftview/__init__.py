@@ -254,9 +254,13 @@ def format_report(report: dict) -> str:
 # ------------------------------------------------------------------- gates
 
 
-def check_drift(report: dict, budgets: dict,
-                shadow_floor: float | None = None) -> list:
-    """The ``--check`` violations (each a string; non-empty = exit 2).
+def grade_report(report: dict, budgets: dict,
+                 shadow_floor: float | None = None) -> dict:
+    """The machine verdict behind ``--check`` AND ``--json``, derived
+    ONCE: per-stream grades, named gate results (each carrying its
+    violations), and the exit decision. :func:`check_drift` flattens
+    this object's violations, so the human gate and the JSON verdict
+    line can never disagree — the pin test only confirms it.
 
     Gates, in severity order: a missing ``drift`` section (a gate that
     cannot see drift must fail loudly, not pass vacuously); any
@@ -267,62 +271,114 @@ def check_drift(report: dict, budgets: dict,
     and a shadow agreement rate under the floor once enough requests
     were scored (``shadow_floor_min_scored`` — an idle shadow must not
     fail on one early disagreement)."""
-    violations = []
+    gates: list = []
+    grades: dict = {}
     drift = report.get("drift")
     if drift is None:
-        violations.append(
-            "no drift section in the stats body — serve with --drift "
-            "(or scrape a pool whose workers do)")
-        return violations
-    if not budgets.get("allow_drifting", False):
-        for name in drift.get("drifting") or []:
-            s = (drift["streams"].get(name) or {})
-            psi = s.get("psi") or {}
-            violations.append(
-                f"stream `{name}` is DRIFTING (fast PSI "
-                f"{psi.get('fast')}, slow PSI {psi.get('slow')}) — "
-                "re-snapshot the reference if this regime change is "
-                "intended, retrain if not")
-    if budgets.get("require_reference", True):
+        gates.append({"gate": "drift_section", "ok": False,
+                      "violations": [
+                          "no drift section in the stats body — serve "
+                          "with --drift (or scrape a pool whose workers "
+                          "do)"]})
+    else:
+        gates.append({"gate": "drift_section", "ok": True,
+                      "violations": []})
         for name, s in sorted(drift["streams"].items()):
-            if s.get("status") == "ok":
-                continue
-            if s.get("status") == "no_reference" \
+            if s.get("drifting"):
+                grades[name] = "drifting"
+            elif s.get("status") == "ok":
+                grades[name] = "ok"
+            elif s.get("status") == "no_reference" \
                     and not s.get("lifetime_count"):
                 # A stream the deployment never feeds (e.g. the graph
                 # family's feature columns) is not gradable — absence
                 # of data is not absence of a reference.
-                continue
-            violations.append(
-                f"stream `{name}` has status `{s.get('status')}` — "
-                "freeze a reference for the serving generation "
-                "(`drift snapshot`; mandatory re-snapshot after every "
-                "promote)")
-    ref_file = report.get("reference_file")
-    if ref_file is not None and drift.get("reference_fingerprint") \
-            and ref_file.get("fingerprint") \
-            != drift.get("reference_fingerprint"):
-        violations.append(
-            "reference mismatch: server loaded "
-            f"{str(drift['reference_fingerprint'])[:12]}… but the "
-            f"--reference file is {str(ref_file['fingerprint'])[:12]}… "
-            "— load the file (POST /drift/reference) or re-snapshot")
-    if drift.get("reference_mixed"):
-        violations.append(
-            "workers disagree on the loaded reference (mixed "
-            "fingerprints in the merged section) — re-fan the load "
-            "(POST /drift/reference reaches every worker)")
-    shadow = report.get("shadow")
-    floor = (shadow_floor if shadow_floor is not None
-             else budgets.get("shadow_agreement_floor"))
-    if shadow is not None and floor is not None:
-        min_scored = int(budgets.get("shadow_floor_min_scored", 20))
-        rate = shadow.get("agreement_rate")
-        if shadow.get("scored_total", 0) >= min_scored \
-                and rate is not None and rate < floor:
-            violations.append(
-                f"shadow agreement {rate:.4f} under the floor "
-                f"{floor:.4f} over {shadow['scored_total']} scored "
-                "requests — the candidate disagrees with the incumbent "
-                "too often to promote blind")
-    return violations
+                grades[name] = "idle"
+            else:
+                grades[name] = str(s.get("status"))
+        drifting_violations = []
+        if not budgets.get("allow_drifting", False):
+            for name in drift.get("drifting") or []:
+                s = (drift["streams"].get(name) or {})
+                psi = s.get("psi") or {}
+                drifting_violations.append(
+                    f"stream `{name}` is DRIFTING (fast PSI "
+                    f"{psi.get('fast')}, slow PSI {psi.get('slow')}) — "
+                    "re-snapshot the reference if this regime change is "
+                    "intended, retrain if not")
+        gates.append({"gate": "drifting_streams",
+                      "ok": not drifting_violations,
+                      "violations": drifting_violations})
+        coverage_violations = []
+        if budgets.get("require_reference", True):
+            for name, grade in sorted(grades.items()):
+                if grade in ("ok", "drifting", "idle"):
+                    continue
+                coverage_violations.append(
+                    f"stream `{name}` has status `{grade}` — "
+                    "freeze a reference for the serving generation "
+                    "(`drift snapshot`; mandatory re-snapshot after "
+                    "every promote)")
+        gates.append({"gate": "reference_coverage",
+                      "ok": not coverage_violations,
+                      "violations": coverage_violations})
+        match_violations = []
+        ref_file = report.get("reference_file")
+        if ref_file is not None and drift.get("reference_fingerprint") \
+                and ref_file.get("fingerprint") \
+                != drift.get("reference_fingerprint"):
+            match_violations.append(
+                "reference mismatch: server loaded "
+                f"{str(drift['reference_fingerprint'])[:12]}… but the "
+                f"--reference file is "
+                f"{str(ref_file['fingerprint'])[:12]}… "
+                "— load the file (POST /drift/reference) or re-snapshot")
+        gates.append({"gate": "reference_match",
+                      "ok": not match_violations,
+                      "violations": match_violations})
+        uniform_violations = []
+        if drift.get("reference_mixed"):
+            uniform_violations.append(
+                "workers disagree on the loaded reference (mixed "
+                "fingerprints in the merged section) — re-fan the load "
+                "(POST /drift/reference reaches every worker)")
+        gates.append({"gate": "reference_uniform",
+                      "ok": not uniform_violations,
+                      "violations": uniform_violations})
+        shadow_violations = []
+        shadow = report.get("shadow")
+        floor = (shadow_floor if shadow_floor is not None
+                 else budgets.get("shadow_agreement_floor"))
+        if shadow is not None and floor is not None:
+            min_scored = int(budgets.get("shadow_floor_min_scored", 20))
+            rate = shadow.get("agreement_rate")
+            if shadow.get("scored_total", 0) >= min_scored \
+                    and rate is not None and rate < floor:
+                shadow_violations.append(
+                    f"shadow agreement {rate:.4f} under the floor "
+                    f"{floor:.4f} over {shadow['scored_total']} scored "
+                    "requests — the candidate disagrees with the "
+                    "incumbent too often to promote blind")
+        gates.append({"gate": "shadow_floor",
+                      "ok": not shadow_violations,
+                      "violations": shadow_violations})
+    violations = [v for g in gates for v in g["violations"]]
+    failing = [g["gate"] for g in gates if not g["ok"]]
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "streams": grades,
+        "gates": gates,
+        "violations": violations,
+        "ok": not violations,
+        "exit_code": 2 if violations else 0,
+        "exit_reason": failing[0] if failing else "ok",
+    }
+
+
+def check_drift(report: dict, budgets: dict,
+                shadow_floor: float | None = None) -> list:
+    """The ``--check`` violations (each a string; non-empty = exit 2).
+    A flat view of :func:`grade_report` — one derivation, two
+    surfaces."""
+    return grade_report(report, budgets,
+                        shadow_floor=shadow_floor)["violations"]

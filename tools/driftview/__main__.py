@@ -24,8 +24,8 @@ import sys
 
 from tools.driftview import (
     build_report,
-    check_drift,
     format_report,
+    grade_report,
     load_budgets,
     load_reference,
     load_stats,
@@ -67,7 +67,9 @@ def main(argv: list | None = None) -> int:
                         "for this run")
     p.add_argument("--json", action="store_true",
                    help="suppress the human tables; print only the "
-                        "JSON line")
+                        "machine verdict line (schema_version:1 — "
+                        "per-stream grades, named gate results, exit "
+                        "reason; graded identically to --check)")
     args = p.parse_args(argv)
     if args.stats is None and args.reference is None \
             and args.trace is None:
@@ -87,11 +89,24 @@ def main(argv: list | None = None) -> int:
             "report": "driftview", **{k: v for k, v in report.items()
                                       if k != "schema_version"}}
     violations: list = []
-    if args.check:
+    if args.check or args.json:
+        # ONE derivation for both surfaces: --check's exit decision and
+        # --json's verdict line come from the same grade_report object,
+        # so a script parsing the line and an operator reading the exit
+        # code can never disagree (pinned by test).
         budgets = load_budgets(args.budgets)
-        violations = check_drift(report, budgets,
-                                 shadow_floor=args.shadow_floor)
-        line["violations"] = violations
+        grade = grade_report(report, budgets,
+                             shadow_floor=args.shadow_floor)
+        line["verdict"] = {
+            "streams": grade["streams"],
+            "gates": grade["gates"],
+            "ok": grade["ok"],
+            "exit_reason": grade["exit_reason"],
+            "would_exit": grade["exit_code"],
+        }
+        line["violations"] = grade["violations"]
+        if args.check:
+            violations = grade["violations"]
     print(json.dumps(line))
     for violation in violations:
         print(f"driftview: {violation}", file=sys.stderr)
